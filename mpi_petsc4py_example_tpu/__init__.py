@@ -1,0 +1,54 @@
+"""tpu-sparse-solve: TPU-native distributed sparse linear algebra.
+
+A brand-new framework with the capability surface of the petsc4py/slepc4py
+MPI example (`Dxslab/mpi-petsc4py-example`): distributed AIJ-style sparse
+matrices and vectors, Krylov solvers with preconditioners, a Hermitian
+eigensolver, a PETSc-style options database and row-block data distribution —
+re-designed for TPU (JAX/XLA/Pallas): row-sharded HBM storage over a
+`jax.sharding.Mesh`, jit-compiled `shard_map` Krylov loops whose reductions
+are `lax.psum` collectives over ICI, and `device_put`-based data placement
+replacing MPI point-to-point scatter.
+
+See SURVEY.md at the repo root for the reference analysis this builds to.
+"""
+
+import os as _os
+
+# The reference stack is fp64-native (PETSc/MUMPS). JAX canonicalizes to
+# float32 unless x64 is enabled, which would silently truncate the library's
+# float64 defaults — so enable it at import, PETSc-style. Opt out with
+# TPU_SOLVE_NO_X64=1 (e.g. for pure-fp32 TPU benchmarking).
+if _os.environ.get("TPU_SOLVE_NO_X64", "0") != "1":
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
+from .parallel.mesh import DeviceComm, get_default_comm, set_default_comm, as_comm
+from .parallel.partition import (
+    RowLayout, row_partition, ownership_range, slice_csr_block,
+    partition_csr, concat_csr_blocks)
+from .core.vec import Vec
+from .core.mat import Mat
+from .solvers.pc import PC
+from .solvers.ksp import KSP
+from .utils.convergence import ConvergedReason, SolveResult
+from .utils.options import Options, global_options, init, backend
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DeviceComm", "get_default_comm", "set_default_comm", "as_comm",
+    "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
+    "partition_csr", "concat_csr_blocks",
+    "Vec", "Mat", "PC", "KSP", "EPS",
+    "ConvergedReason", "SolveResult",
+    "Options", "global_options", "init", "backend",
+]
+
+
+def __getattr__(name):
+    # EPS imported lazily to keep base import light
+    if name == "EPS":
+        from .solvers.eps import EPS
+        return EPS
+    raise AttributeError(name)

@@ -1,0 +1,2 @@
+from .vec import Vec
+from .mat import Mat

@@ -1,0 +1,174 @@
+"""Distributed sparse matrix: row-sharded ELL/CSR in HBM.
+
+TPU-native equivalent of PETSc ``Mat`` (MPIAIJ) — SURVEY.md N1. The reference
+constructs it from the contract *(comm, global shape, local rebased-CSR with
+global column indices)* (``petsc_funcs.py:5-10``, ``test.py:24``); the
+constructors here accept exactly that, plus a whole-matrix convenience path.
+
+Storage: the device layout is ELL (see ops/spmv.py) with rows 1-D sharded
+over the mesh — one shard per device, padding rows empty. A host-side scipy
+CSR copy is retained when available for preconditioner factorizations
+(block-Jacobi / LU) and oracle checks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.spmv import csr_to_ell, csr_diag, ell_spmv_local
+from ..parallel.mesh import DeviceComm, as_comm
+from ..parallel.partition import RowLayout, concat_csr_blocks
+from .vec import Vec
+
+
+class Mat:
+    """Row-sharded distributed sparse matrix (AIJ-equivalent)."""
+
+    def __init__(self, comm, shape, ell_cols: jax.Array, ell_vals: jax.Array,
+                 host_csr=None, layout: RowLayout | None = None):
+        self.comm: DeviceComm = as_comm(comm)
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.layout = layout or RowLayout(self.shape[0], self.comm.size)
+        # (n_pad, K) arrays sharded on axis 0.
+        self.ell_cols = ell_cols
+        self.ell_vals = ell_vals
+        # optional host CSR triple (indptr, indices, data) of the full matrix
+        self.host_csr = host_csr
+        self._assembled = False
+
+    # ---- constructors ------------------------------------------------------
+    @classmethod
+    def create_aij(cls, comm, size, csr, dtype=jnp.float64) -> "Mat":
+        """The reference contract: global ``size``, *local* rebased CSR.
+
+        In single-controller mode the caller's "local" block is the whole
+        matrix when its indptr covers all rows (the ``mpirun -n 1`` path the
+        reference supports, ``test.py:77`` empty loop). For true per-rank
+        blocks, assemble with :meth:`from_local_blocks`.
+        """
+        nrows, ncols = size
+        indptr, indices, data = csr
+        local_rows = len(indptr) - 1
+        if local_rows == nrows:
+            return cls.from_csr(comm, size, csr, dtype=dtype)
+        raise ValueError(
+            f"local CSR has {local_rows} rows but global shape is {size}; "
+            "assemble per-rank blocks with Mat.from_local_blocks")
+
+    @classmethod
+    def from_csr(cls, comm, size, csr, dtype=jnp.float64) -> "Mat":
+        """Build from a *global* host CSR triple."""
+        comm = as_comm(comm)
+        nrows, ncols = int(size[0]), int(size[1])
+        indptr = np.asarray(csr[0], dtype=np.int64)
+        indices = np.asarray(csr[1], dtype=np.int32)
+        data = np.asarray(csr[2], dtype=dtype)
+        cols, vals = csr_to_ell(indptr, indices, data)
+        cols = comm.put_rows(cols)
+        vals = comm.put_rows(vals)
+        m = cls(comm, (nrows, ncols), cols, vals,
+                host_csr=(indptr, indices, data))
+        m._assembled = True
+        return m
+
+    @classmethod
+    def from_local_blocks(cls, comm, size, blocks, dtype=jnp.float64) -> "Mat":
+        """Build from per-rank local CSR blocks (the reference's L5 output)."""
+        indptr, indices, data = concat_csr_blocks(blocks)
+        return cls.from_csr(comm, size, (indptr, indices, data), dtype=dtype)
+
+    @classmethod
+    def from_scipy(cls, comm, A, dtype=jnp.float64) -> "Mat":
+        A = A.tocsr()
+        return cls.from_csr(comm, A.shape, (A.indptr, A.indices, A.data),
+                            dtype=dtype)
+
+    # ---- PETSc-Mat-shaped API ----------------------------------------------
+    def set_up(self):
+        return self
+
+    def assemble(self):
+        self._assembled = True
+        return self
+
+    assembly_begin = assemble
+    assembly_end = assemble
+
+    @property
+    def assembled(self) -> bool:
+        return self._assembled
+
+    @property
+    def dtype(self):
+        return self.ell_vals.dtype
+
+    @property
+    def n_pad(self) -> int:
+        return self.ell_cols.shape[0]
+
+    @property
+    def K(self) -> int:
+        """ELL width: max nonzeros per row."""
+        return self.ell_cols.shape[1]
+
+    def get_vecs(self) -> tuple[Vec, Vec]:
+        """Compatibly-sharded (x, b) pair — the reference's ``a.getVecs()``."""
+        mk = lambda: Vec(self.comm, self.shape[0], dtype=self.dtype,
+                         layout=self.layout)
+        return mk(), mk()
+
+    # ---- operator application ----------------------------------------------
+    def mult_padded(self, x_padded: jax.Array) -> jax.Array:
+        """SpMV on the padded global device array (jit-compiled, sharded).
+
+        Under jit with sharded operands XLA inserts the all-gather of ``x``
+        itself (GSPMD); solvers instead use the explicit shard_map path via
+        :meth:`device_arrays` + ops.spmv.
+        """
+        return _jit_spmv(self.ell_cols, self.ell_vals, x_padded)
+
+    def mult(self, x: Vec, y: Vec | None = None) -> Vec:
+        ypad = self.mult_padded(x.data)
+        if y is None:
+            y = Vec(self.comm, self.shape[0], data=ypad, layout=self.layout)
+        else:
+            y.data = ypad
+        return y
+
+    def diagonal(self) -> np.ndarray:
+        """Host-side global diagonal (for Jacobi preconditioning)."""
+        if self.host_csr is not None:
+            return csr_diag(*self.host_csr, self.shape[0])
+        cols = np.asarray(self.ell_cols)[: self.shape[0]]
+        vals = np.asarray(self.ell_vals)[: self.shape[0]]
+        gidx = np.arange(self.shape[0])[:, None]
+        return np.where(cols == gidx, vals, 0.0).sum(axis=1)
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+        if self.host_csr is not None:
+            indptr, indices, data = self.host_csr
+            return sp.csr_matrix((data, indices, indptr), shape=self.shape)
+        cols = np.asarray(self.ell_cols)[: self.shape[0]]
+        vals = np.asarray(self.ell_vals)[: self.shape[0]]
+        n = self.shape[0]
+        rows = np.repeat(np.arange(n), cols.shape[1])
+        mask = vals.ravel() != 0
+        return sp.csr_matrix(
+            (vals.ravel()[mask], (rows[mask], cols.ravel()[mask])),
+            shape=self.shape)
+
+    def device_arrays(self):
+        """The raw sharded ELL arrays consumed by shard_map solver kernels."""
+        return self.ell_cols, self.ell_vals
+
+    def __repr__(self):
+        return (f"Mat(shape={self.shape}, K={self.K}, "
+                f"devices={self.comm.size}, dtype={self.dtype})")
+
+
+@jax.jit
+def _jit_spmv(cols, vals, x_padded):
+    return ell_spmv_local(cols, vals, x_padded)

@@ -1,0 +1,110 @@
+"""Distributed vector: a row-sharded ``jax.Array`` in HBM.
+
+TPU-native equivalent of PETSc ``Vec`` (MPI) — reference usage:
+``b.setArray(local_rhs)`` sets the local block and ``x.array`` reads it
+(``test.py:30``, ``test.py:145``). Here the storage is one global array with a
+``NamedSharding`` over the row axis; the user-visible (possibly uneven,
+PETSc-style) ownership ranges live in a :class:`RowLayout` so local-block
+views match the reference partition exactly even though the internal device
+layout is uniform-padded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import DeviceComm, as_comm
+from ..parallel.partition import RowLayout
+
+
+class Vec:
+    """Row-sharded distributed vector of logical length ``n``.
+
+    Internally stores a zero-padded array of length ``comm.padded_size(n)``
+    sharded over the mesh. All solver arithmetic happens on the raw padded
+    array (``.data``); the class provides the PETSc-``Vec``-shaped views.
+    """
+
+    def __init__(self, comm, n: int, data: jax.Array | None = None,
+                 dtype=jnp.float64, layout: RowLayout | None = None):
+        self.comm: DeviceComm = as_comm(comm)
+        self.n = int(n)
+        self.layout = layout or RowLayout(self.n, self.comm.size)
+        if data is None:
+            n_pad = self.comm.padded_size(self.n)
+            data = jax.device_put(np.zeros(n_pad, dtype=dtype),
+                                  self.comm.row_sharding)
+        self.data = data
+
+    # ---- construction ------------------------------------------------------
+    @classmethod
+    def from_global(cls, comm, arr, dtype=None, layout=None) -> "Vec":
+        comm = as_comm(comm)
+        arr = np.asarray(arr)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        v = cls(comm, arr.shape[0], data=comm.put_rows(arr), dtype=arr.dtype,
+                layout=layout)
+        return v
+
+    def duplicate(self) -> "Vec":
+        return Vec(self.comm, self.n, data=jnp.zeros_like(self.data),
+                   layout=self.layout)
+
+    def copy(self) -> "Vec":
+        return Vec(self.comm, self.n, data=self.data, layout=self.layout)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    # ---- PETSc-shaped local views ------------------------------------------
+    def set_array(self, local, rank: int = 0):
+        """Set this rank's local block (the reference's ``b.setArray``).
+
+        In single-controller mode the caller usually owns the whole vector
+        (``rank 0`` of a 1-rank run); pass ``rank`` to set another block.
+        """
+        local = np.asarray(local)
+        rs, re = self.layout.range(rank)
+        if local.shape[0] == self.n and rs == 0 and re == self.n:
+            self.data = self.comm.put_rows(local.astype(self.data.dtype))
+            return
+        if local.shape[0] != re - rs:
+            raise ValueError(
+                f"local block for rank {rank} must have length {re - rs}, "
+                f"got {local.shape[0]}")
+        host = self.to_numpy()
+        host[rs:re] = local
+        self.data = self.comm.put_rows(host.astype(self.data.dtype))
+
+    def set_global(self, arr):
+        self.data = self.comm.put_rows(np.asarray(arr, dtype=self.data.dtype))
+
+    def local_array(self, rank: int = 0) -> np.ndarray:
+        """This rank's local block (the reference's ``x.array``)."""
+        rs, re = self.layout.range(rank)
+        return self.to_numpy()[rs:re]
+
+    @property
+    def array(self) -> np.ndarray:
+        return self.local_array(0)
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather to host, dropping padding — a counts-correct ``Gatherv``."""
+        return np.asarray(self.data)[: self.n].copy()
+
+    # ---- small amount of vector arithmetic (solvers use raw arrays) --------
+    def norm(self) -> float:
+        return float(jnp.linalg.norm(self.data))
+
+    def dot(self, other: "Vec") -> float:
+        return float(jnp.vdot(self.data, other.data))
+
+    def zero(self):
+        self.data = jnp.zeros_like(self.data)
+
+    def __len__(self):
+        return self.n

@@ -1,0 +1,1 @@
+from .spmv import csr_to_ell, ell_spmv_local, ell_diag_local, csr_diag

@@ -1,0 +1,77 @@
+"""Sparse matrix–vector product kernels and layouts.
+
+TPU-native replacement for PETSc's C CSR SpMV + VecScatter halo exchange
+(SURVEY.md N8/L0; triggered by every KSP/EPS iteration, ``test.py:50``,
+``test2.py:88``). CSR's per-row serial pointer-chasing is hostile to the TPU
+vector unit, so the device layout is **ELL** (row-padded): every row stores
+exactly ``K = max nnz/row`` (column, value) slots, padding with (0, 0.0).
+SpMV then becomes a dense-shaped gather + multiply + row-sum that XLA maps
+onto the VPU with no data-dependent shapes.
+
+Distribution: rows are 1-D sharded over the mesh; the input vector is
+``all_gather``-ed (the general VecScatter replacement — correct for any
+sparsity). Stencil operators use a matrix-free path instead (models/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def csr_to_ell(indptr, indices, data, ncols_pad_to: int | None = None):
+    """Convert host CSR to ELL ``(cols, vals)`` of shape ``(nrows, K)``.
+
+    Padding slots use column 0 and value 0.0 (contributing exactly zero to
+    the product). Vectorized host-side construction; the heavy path is also
+    available from the native C++ toolkit (native/csrkit).
+    """
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices)
+    data = np.asarray(data)
+    nrows = len(indptr) - 1
+    counts = indptr[1:] - indptr[:-1]
+    K = int(counts.max()) if nrows else 0
+    K = max(K, 1)
+    if ncols_pad_to is not None:
+        K = max(K, ncols_pad_to)
+    cols = np.zeros((nrows, K), dtype=np.int32)
+    vals = np.zeros((nrows, K), dtype=data.dtype)
+    if len(data):
+        rows = np.repeat(np.arange(nrows), counts)
+        pos = np.arange(len(data)) - np.repeat(indptr[:-1], counts)
+        cols[rows, pos] = indices
+        vals[rows, pos] = data
+    return cols, vals
+
+
+def ell_spmv_local(cols, vals, x_full):
+    """Local ELL SpMV: ``y[i] = sum_k vals[i,k] * x_full[cols[i,k]]``.
+
+    ``cols``/``vals`` are this shard's rows ``(lrows, K)``; ``x_full`` is the
+    full (gathered) input vector. Pure jnp — jit/shard_map friendly, fused by
+    XLA into a single gather+fma pass.
+    """
+    return jnp.einsum("rk,rk->r", vals, x_full[cols])
+
+
+def ell_diag_local(cols, vals, row_offset, lrows):
+    """Extract the local diagonal from ELL shards (device-side).
+
+    ``row_offset`` is the global index of this shard's first row.
+    """
+    gidx = row_offset + jnp.arange(lrows)
+    mask = cols == gidx[:, None]
+    return jnp.sum(jnp.where(mask, vals, 0.0), axis=1)
+
+
+def csr_diag(indptr, indices, data, n):
+    """Host-side diagonal extraction from a global CSR triple."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    diag = np.zeros(n, dtype=np.asarray(data).dtype)
+    counts = indptr[1:] - indptr[:-1]
+    rows = np.repeat(np.arange(n), counts)
+    hit = np.asarray(indices) == rows
+    diag[rows[hit]] = np.asarray(data)[hit]
+    return diag

@@ -1,0 +1,3 @@
+from .mesh import DeviceComm, get_default_comm, set_default_comm, as_comm
+from .partition import (RowLayout, row_partition, ownership_range,
+                        slice_csr_block, partition_csr, concat_csr_blocks)

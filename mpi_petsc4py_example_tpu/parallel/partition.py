@@ -1,0 +1,107 @@
+"""Row-block partitioning and CSR slicing, as a library.
+
+The reference hand-rolls this idiom twice (components 6-9 of SURVEY.md §2.1:
+partitioner ``test.py:67-74``/``test2.py:33-37``, CSR block slicer with indptr
+rebasing ``test.py:83-117``/``test2.py:44-70``, scatter protocol, shape bcast).
+Here it is provided once, with the exact same semantics:
+
+* 1-D contiguous row-block decomposition; ``divmod`` split with the remainder
+  spread over the lowest ranks.
+* A sliced block is the triple ``(indptr, indices, data)`` with the indptr
+  **rebased** to start at zero while column indices stay **global**.
+
+These functions are host-side (numpy); device placement of the resulting
+blocks is one ``device_put`` in :class:`..parallel.mesh.DeviceComm`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def row_partition(nrows: int, nparts: int) -> tuple[np.ndarray, np.ndarray]:
+    """Split ``nrows`` into ``nparts`` contiguous blocks, PETSc-style.
+
+    Returns ``(count, displ)``: block sizes and starting rows. Matches the
+    reference's divmod split with the remainder given to the lowest ranks
+    (``test.py:67-74``).
+    """
+    base, extra = divmod(nrows, nparts)
+    count = np.full(nparts, base, dtype=np.int64)
+    count[:extra] += 1
+    displ = np.concatenate(([0], np.cumsum(count)[:-1]))
+    return count, displ
+
+
+def ownership_range(nrows: int, nparts: int, rank: int) -> tuple[int, int]:
+    """Half-open row range ``[start, end)`` owned by ``rank``."""
+    count, displ = row_partition(nrows, nparts)
+    return int(displ[rank]), int(displ[rank] + count[rank])
+
+
+def slice_csr_block(indptr, indices, data, rstart: int, rend: int):
+    """Extract rows ``[rstart, rend)`` of a CSR matrix as a local block.
+
+    The returned indptr is rebased to start at 0; column indices stay global
+    — the contract both reference drivers establish (``test.py:84-91``,
+    ``test2.py:44-49``) and that the Mat constructor consumes (§3.3).
+    """
+    indptr = np.asarray(indptr)
+    pstart, pend = indptr[rstart], indptr[rend]
+    local_indptr = indptr[rstart:rend + 1] - pstart
+    return (np.ascontiguousarray(local_indptr),
+            np.ascontiguousarray(np.asarray(indices)[pstart:pend]),
+            np.ascontiguousarray(np.asarray(data)[pstart:pend]))
+
+
+def partition_csr(indptr, indices, data, nparts: int):
+    """Partition a global CSR into ``nparts`` row blocks (list of triples)."""
+    nrows = len(indptr) - 1
+    count, displ = row_partition(nrows, nparts)
+    return [slice_csr_block(indptr, indices, data, int(displ[i]),
+                            int(displ[i] + count[i]))
+            for i in range(nparts)]
+
+
+def concat_csr_blocks(blocks):
+    """Reassemble local CSR row blocks into a global CSR triple.
+
+    Inverse of :func:`partition_csr`; also how the Mat constructor turns the
+    facade's per-rank blocks back into one host CSR before device layout.
+    """
+    indptrs, indices, datas = zip(*blocks)
+    out_indptr = [np.asarray(indptrs[0], dtype=np.int64)]
+    offset = out_indptr[0][-1]
+    for p in indptrs[1:]:
+        p = np.asarray(p, dtype=np.int64)
+        out_indptr.append(p[1:] + offset)
+        offset += p[-1]
+    return (np.concatenate(out_indptr),
+            np.concatenate([np.asarray(i) for i in indices]),
+            np.concatenate([np.asarray(d) for d in datas]))
+
+
+@dataclass(frozen=True)
+class RowLayout:
+    """The user-visible (possibly uneven) row ownership map of a vector/matrix.
+
+    Kept separate from the internal uniform padded device layout; used to
+    answer ``.array``-style local-block queries and to gather with the *true*
+    per-shard counts (fixing the reference's equal-blocks ``Gatherv`` bug at
+    ``test.py:145``, SURVEY.md §3.1).
+    """
+    nrows: int
+    nparts: int
+
+    @property
+    def count(self) -> np.ndarray:
+        return row_partition(self.nrows, self.nparts)[0]
+
+    @property
+    def displ(self) -> np.ndarray:
+        return row_partition(self.nrows, self.nparts)[1]
+
+    def range(self, rank: int) -> tuple[int, int]:
+        return ownership_range(self.nrows, self.nparts, rank)

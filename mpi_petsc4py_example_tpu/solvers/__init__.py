@@ -1,0 +1,3 @@
+from .pc import PC
+from .ksp import KSP
+from .eps import EPS

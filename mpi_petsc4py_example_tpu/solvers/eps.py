@@ -1,0 +1,341 @@
+"""EPS — eigensolver, TPU-native equivalent of SLEPc EPS (SURVEY.md N6).
+
+Reference usage (``petsc_funcs.py:13-20``, ``test2.py:88-96``): ``EPS().create``,
+``setOperators``, ``setProblemType(HEP)``, ``setFromOptions``, ``solve``,
+``getConverged``, ``getEigenpair(i, vr, vi)``. SLEPc's default configuration —
+Krylov-Schur, nev=1, largest magnitude — is the semantic target.
+
+Algorithm: explicitly-restarted Arnoldi with full (classical, twice-applied)
+Gram–Schmidt orthogonalization. The ncv-step factorization is one jit-compiled
+``shard_map`` program (SpMV + ``lax.psum`` dots over the mesh); the small
+(ncv×ncv) Rayleigh-quotient eigenproblem is solved on host each restart, which
+mirrors SLEPc's own dense-subproblem split. For Hermitian problems (HEP) the
+projected matrix is symmetrized — full reorthogonalization makes this the
+Lanczos process with reliable numerics.
+
+Unlike the reference driver — which calls the collective ``getEigenpair``
+under ``if rank == 0:`` (a latent deadlock, SURVEY.md §3.2) — eigenpair
+extraction here is single-controller and host-replicated, so it is trivially
+collective-safe.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mat import Mat
+from ..core.vec import Vec
+from ..parallel.mesh import DeviceComm, as_comm
+from ..ops.spmv import ell_spmv_local
+from ..utils.convergence import SolveResult
+from ..utils.options import global_options
+
+DEFAULT_TOL = 1e-8        # SLEPc's EPS default
+DEFAULT_MAX_RESTARTS = 100
+
+
+class EPSProblemType:
+    HEP = "hep"       # Hermitian
+    NHEP = "nhep"     # non-Hermitian
+    GHEP = "ghep"     # generalized Hermitian (not yet supported)
+
+
+class EPSWhich:
+    LARGEST_MAGNITUDE = "largest_magnitude"
+    SMALLEST_MAGNITUDE = "smallest_magnitude"
+    LARGEST_REAL = "largest_real"
+    SMALLEST_REAL = "smallest_real"
+
+
+_ARNOLDI_CACHE: dict = {}
+
+
+def _build_arnoldi_program(comm: DeviceComm, n: int, ncv: int, dtype):
+    """ncv-step Arnoldi factorization as one SPMD program.
+
+    Returns ``(V, H)`` with ``V`` of global shape ``(ncv+1, n_pad)`` (sharded
+    on the row axis) and ``H`` the replicated ``(ncv+1, ncv)`` Hessenberg
+    matrix. Orthogonalization is classical Gram–Schmidt applied twice
+    ("CGS2"), which is communication-optimal on the mesh (two fused psums per
+    step instead of j sequential ones) and as stable as modified GS.
+    """
+    axis = comm.axis
+    key = (comm.mesh, axis, n, ncv, dtype)
+    cached = _ARNOLDI_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local_fn(cols, vals, v0):
+        lsize = v0.shape[0]
+
+        def A(v):
+            v_full = lax.all_gather(v, axis, tiled=True)
+            return ell_spmv_local(cols, vals, v_full)
+
+        def pdot_vec(Vb, w):
+            return lax.psum(Vb @ w, axis)
+
+        def pnorm(u):
+            return jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+
+        nrm0 = pnorm(v0)
+        v0n = v0 / jnp.where(nrm0 == 0, 1.0, nrm0)
+        V = jnp.zeros((ncv + 1, lsize), v0.dtype).at[0].set(v0n)
+        H = jnp.zeros((ncv + 1, ncv), v0.dtype)
+
+        def step(j, VH):
+            V, H = VH
+            w = A(V[j])
+            # CGS2: rows of V beyond j+1 are zero, so projecting against the
+            # whole basis needs no masking.
+            h1 = pdot_vec(V, w)
+            w = w - h1 @ V
+            h2 = pdot_vec(V, w)
+            w = w - h2 @ V
+            h = h1 + h2
+            b = pnorm(w)
+            V = V.at[j + 1].set(w / jnp.where(b == 0, 1.0, b))
+            H = H.at[:, j].set(h)
+            H = H.at[j + 1, j].set(b)
+            return (V, H)
+
+        V, H = lax.fori_loop(0, ncv, step, (V, H))
+        return V, H
+
+    prog = jax.jit(comm.shard_map(
+        local_fn,
+        in_specs=(P(axis, None), P(axis, None), P(axis)),
+        out_specs=(P(None, axis), P())))
+    _ARNOLDI_CACHE[key] = prog
+    return prog
+
+
+class EPS:
+    """Eigensolver context, slepc4py-``EPS``-shaped."""
+
+    ProblemType = EPSProblemType
+    Which = EPSWhich
+
+    def __init__(self, comm=None):
+        self.comm = None
+        self._mat: Mat | None = None
+        self._problem_type = EPSProblemType.NHEP
+        self._which = EPSWhich.LARGEST_MAGNITUDE
+        self.nev = 1                  # SLEPc default
+        self.ncv: int | None = None   # auto: max(2*nev, nev+15), capped at n
+        self.tol = DEFAULT_TOL
+        self.max_it = DEFAULT_MAX_RESTARTS
+        self.result = SolveResult()
+        self._eigenvalues = np.zeros(0)
+        self._eigenvectors = np.zeros((0, 0))
+        self._residuals = np.zeros(0)
+        self._nconv = 0
+        if comm is not None:
+            self.create(comm)
+
+    # ---- lifecycle / configuration -----------------------------------------
+    def create(self, comm=None):
+        self.comm = as_comm(comm)
+        return self
+
+    def destroy(self):
+        return self
+
+    def set_operators(self, A: Mat, B: Mat | None = None):
+        if B is not None:
+            raise NotImplementedError("generalized eigenproblems (GHEP) "
+                                      "are not supported yet")
+        self._mat = A
+        if self.comm is None:
+            self.create(A.comm)
+        return self
+
+    setOperators = set_operators
+
+    def set_problem_type(self, ptype):
+        ptype = str(ptype).lower()
+        if ptype not in (EPSProblemType.HEP, EPSProblemType.NHEP):
+            raise ValueError(f"unsupported problem type {ptype!r}")
+        self._problem_type = ptype
+        return self
+
+    setProblemType = set_problem_type
+
+    def set_which_eigenpairs(self, which: str):
+        self._which = str(which).lower()
+        return self
+
+    setWhichEigenpairs = set_which_eigenpairs
+
+    def set_dimensions(self, nev: int | None = None, ncv: int | None = None):
+        if nev is not None:
+            self.nev = int(nev)
+        if ncv is not None:
+            self.ncv = int(ncv)
+        return self
+
+    setDimensions = set_dimensions
+
+    def set_tolerances(self, tol=None, max_it=None):
+        if tol is not None:
+            self.tol = float(tol)
+        if max_it is not None:
+            self.max_it = int(max_it)
+        return self
+
+    setTolerances = set_tolerances
+
+    def set_from_options(self):
+        """Apply ``-eps_nev``, ``-eps_ncv``, ``-eps_tol``, ``-eps_max_it``,
+        ``-eps_hermitian``, ``-eps_which`` from the options DB
+        (the reference's ``E.setFromOptions()``, ``petsc_funcs.py:17``)."""
+        opt = global_options()
+        self.nev = opt.get_int("eps_nev", self.nev)
+        ncv = opt.get_int("eps_ncv", None)
+        if ncv is not None:
+            self.ncv = ncv
+        self.tol = opt.get_real("eps_tol", self.tol)
+        self.max_it = opt.get_int("eps_max_it", self.max_it)
+        if opt.get_bool("eps_hermitian", False):
+            self._problem_type = EPSProblemType.HEP
+        which = opt.get_string("eps_which")
+        if which:
+            self._which = which
+        return self
+
+    setFromOptions = set_from_options
+
+    # ---- solve --------------------------------------------------------------
+    def _effective_ncv(self, n: int) -> int:
+        if self.ncv is not None:
+            return min(self.ncv, n)
+        return min(n, max(2 * self.nev, self.nev + 15))
+
+    def _select(self, lam: np.ndarray) -> np.ndarray:
+        w = self._which
+        if w == EPSWhich.LARGEST_MAGNITUDE:
+            return np.argsort(-np.abs(lam))
+        if w == EPSWhich.SMALLEST_MAGNITUDE:
+            return np.argsort(np.abs(lam))
+        if w == EPSWhich.LARGEST_REAL:
+            return np.argsort(-lam.real)
+        if w == EPSWhich.SMALLEST_REAL:
+            return np.argsort(lam.real)
+        raise ValueError(f"unknown which {w!r}")
+
+    def solve(self):
+        mat = self._mat
+        if mat is None:
+            raise RuntimeError("EPS.solve: no operators set")
+        comm = mat.comm
+        n = mat.shape[0]
+        ncv = self._effective_ncv(n)
+        hermitian = self._problem_type == EPSProblemType.HEP
+        prog = _build_arnoldi_program(comm, n, ncv, mat.dtype)
+        cols, vals = mat.device_arrays()
+
+        rng = np.random.default_rng(20240901)
+        v0 = comm.put_rows(rng.standard_normal(comm.padded_size(n))
+                           .astype(mat.dtype))
+        # zero out padding so it never enters the Krylov space
+        npad = comm.padded_size(n)
+        if npad > n:
+            mask = np.zeros(npad, dtype=bool)
+            mask[:n] = True
+            v0 = v0 * comm.put_rows(mask.astype(mat.dtype))
+
+        t0 = time.perf_counter()
+        restarts = 0
+        for restarts in range(1, self.max_it + 1):
+            V, H = prog(cols, vals, v0)
+            Hm = np.asarray(H)[:ncv, :ncv]
+            beta = float(np.asarray(H)[ncv, ncv - 1])
+            if hermitian:
+                Hm = (Hm + Hm.T) / 2.0
+                lam, S = np.linalg.eigh(Hm)
+            else:
+                lam, S = np.linalg.eig(Hm)
+            order = self._select(lam)
+            lam, S = lam[order], S[:, order]
+            # Ritz residual estimate: ||A y - λ y|| = |beta| * |last row of S|
+            res = np.abs(beta) * np.abs(S[-1, :])
+            denom = np.maximum(np.abs(lam), 1e-300)
+            rel = res / denom
+            # converged = leading run of wanted Ritz pairs within tolerance
+            k = min(self.nev, ncv)
+            nconv = 0
+            while nconv < k and rel[nconv] <= self.tol:
+                nconv += 1
+            if nconv >= self.nev or ncv >= n:
+                break
+            # explicit restart: new start vector = combination of the wanted,
+            # not-yet-converged Ritz vectors
+            Vm = np.asarray(V)[:ncv, :]          # (ncv, n_pad)
+            wanted = S[:, :k].real.sum(axis=1)
+            v0_host = wanted @ Vm
+            v0 = comm.put_rows(v0_host.astype(np.asarray(Vm).dtype))
+
+        Vm = np.asarray(V)[:ncv, :]
+        vecs = (S[:, :max(self.nev, 1)].T @ Vm)[:, :n]   # (k, n)
+        # normalize
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        vecs = vecs / nrm
+        self._eigenvalues = lam[: max(self.nev, 1)]
+        self._eigenvectors = vecs
+        self._residuals = rel[: max(self.nev, 1)]
+        self._nconv = int(nconv)
+        wall = time.perf_counter() - t0
+        self.result = SolveResult(restarts, float(rel[0]) if len(rel) else 0.0,
+                                  2 if self._nconv >= self.nev else -3, wall)
+        return self
+
+    # ---- results (slepc4py-shaped, collective-safe) --------------------------
+    def get_converged(self) -> int:
+        return self._nconv
+
+    getConverged = get_converged
+
+    def get_iteration_number(self) -> int:
+        return self.result.iterations
+
+    getIterationNumber = get_iteration_number
+
+    def get_eigenvalue(self, i: int):
+        lam = self._eigenvalues[i]
+        return complex(lam)
+
+    getEigenvalue = get_eigenvalue
+
+    def get_eigenpair(self, i: int, vr: Vec | None = None,
+                      vi: Vec | None = None):
+        """Fill ``vr``/``vi`` with the i-th eigenvector and return λ.
+
+        Host-replicated — safe to call from any control context (the
+        reference calls SLEPc's collective version rank-0-only, test2.py:94-96,
+        which is a latent deadlock this design removes).
+        """
+        lam = complex(self._eigenvalues[i])
+        vec = self._eigenvectors[i]
+        if vr is not None:
+            vr.set_global(np.real(vec))
+        if vi is not None:
+            vi.set_global(np.imag(vec))
+        return lam
+
+    getEigenpair = get_eigenpair
+
+    def get_error_estimate(self, i: int) -> float:
+        return float(self._residuals[i])
+
+    getErrorEstimate = get_error_estimate
+
+    def __repr__(self):
+        return (f"EPS(problem={self._problem_type!r}, nev={self.nev}, "
+                f"which={self._which!r}, tol={self.tol})")

@@ -1,0 +1,359 @@
+"""Krylov iteration kernels as single jit-compiled SPMD programs.
+
+The reference's hot loop lives inside PETSc's C ``KSPSolve`` (``test.py:50``):
+per iteration one MatMult (local CSR SpMV + VecScatter halo), a few
+VecDot/VecNorm (local BLAS + ``MPI_Allreduce``) and VecAXPYs (SURVEY.md §3.5).
+Here the *entire* Krylov iteration is one ``lax.while_loop`` inside one
+``shard_map``-decorated, jit-compiled XLA program: SpMV is the ELL kernel with
+an ``all_gather`` of the input vector, dots/norms are ``lax.psum`` reductions
+over the mesh axis, and AXPYs fuse into neighbouring ops. Per-iteration
+launch/latency overhead — PETSc's main scaling limit at small local sizes —
+disappears.
+
+Kernels are written over *local* shards and are backend-agnostic: they take
+the operator ``A`` and preconditioner ``M`` as closures, so matrix-free
+stencil operators plug in unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.spmv import ell_spmv_local
+from ..parallel.mesh import DeviceComm
+from ..utils.convergence import ConvergedReason as CR
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies: (A, M, pdot, pnorm, b, x0, rtol, atol, maxit) ->
+#                (x, iters, rnorm, reason)
+# ---------------------------------------------------------------------------
+
+def _tol(pnorm, b, rtol, atol):
+    bnorm = pnorm(b)
+    return bnorm, jnp.maximum(rtol * bnorm, atol)
+
+
+def _reason(rnorm, tol, atol, k, maxit, brk):
+    return jnp.where(
+        brk, CR.DIVERGED_BREAKDOWN,
+        jnp.where(rnorm <= tol,
+                  jnp.where(rnorm <= atol, CR.CONVERGED_ATOL,
+                            CR.CONVERGED_RTOL),
+                  CR.DIVERGED_MAX_IT)).astype(jnp.int32)
+
+
+def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Preconditioned conjugate gradients (KSPCG equivalent)."""
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    z = M(r)
+    p = z
+    rz = pdot(r, z)
+    rnorm = pnorm(r)
+
+    def cond(st):
+        k, x, r, z, p, rz, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, z, p, rz, rn, brk = st
+        Ap = A(p)
+        pAp = pdot(p, Ap)
+        brk = pAp == 0
+        alpha = jnp.where(brk, 0.0, rz / jnp.where(brk, 1.0, pAp))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        z = M(r)
+        rz_new = pdot(r, z)
+        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
+        p = z + beta * p
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, z, p, rz_new, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, rnorm <= -1.0)
+    k, x, r, z, p, rz, rnorm, brk = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Right-preconditioned BiCGStab (KSPBCGS equivalent)."""
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rhat = r
+    rnorm = pnorm(r)
+    one = jnp.asarray(1.0, b.dtype)
+    z = jnp.zeros_like(b)
+
+    def cond(st):
+        k, x, r, p, v, rho, alpha, omega, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, p, v, rho, alpha, omega, rn, brk = st
+        rho_new = pdot(rhat, r)
+        brk = (rho_new == 0) | (omega == 0)
+        beta = jnp.where(brk, 0.0,
+                         (rho_new / jnp.where(rho == 0, 1.0, rho))
+                         * (alpha / jnp.where(omega == 0, 1.0, omega)))
+        p = r + beta * (p - omega * v)
+        phat = M(p)
+        v = A(phat)
+        rv = pdot(rhat, v)
+        brk = brk | (rv == 0)
+        alpha = jnp.where(brk, 0.0, rho_new / jnp.where(rv == 0, 1.0, rv))
+        s = r - alpha * v
+        shat = M(s)
+        t = A(shat)
+        tt = pdot(t, t)
+        omega = jnp.where(tt == 0, 0.0, pdot(t, s) / jnp.where(tt == 0, 1.0, tt))
+        x = x + alpha * phat + omega * shat
+        r = s - omega * t
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, p, v, rho_new, alpha, omega, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, z, z, one, one, one, rnorm, rnorm <= -1.0)
+    out = lax.while_loop(cond, body, st0)
+    k, x, r, p, v, rho, alpha, omega, rnorm, brk = out
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def _hessenberg_lstsq(H, beta):
+    """Solve ``min ||beta*e1 - H y||`` for upper-Hessenberg H of shape (m+1, m).
+
+    Givens rotations + masked back-substitution — only elementwise ops and
+    small matvecs, so it compiles on every backend/dtype (XLA:TPU lacks f64
+    LU/SVD, ruling out jnp.linalg.lstsq/solve here). Returns (y, |g[m]|) —
+    the second value is the least-squares residual estimate.
+    """
+    m = H.shape[1]
+    g = jnp.zeros(m + 1, H.dtype).at[0].set(beta)
+
+    def rotate(j, Hg):
+        H, g = Hg
+        a, bb = H[j, j], H[j + 1, j]
+        r = jnp.sqrt(a * a + bb * bb)
+        safe = jnp.where(r == 0, 1.0, r)
+        c = jnp.where(r == 0, 1.0, a / safe)
+        s = jnp.where(r == 0, 0.0, bb / safe)
+        rj, rj1 = H[j], H[j + 1]
+        H = H.at[j].set(c * rj + s * rj1).at[j + 1].set(-s * rj + c * rj1)
+        gj, gj1 = g[j], g[j + 1]
+        g = g.at[j].set(c * gj + s * gj1).at[j + 1].set(-s * gj + c * gj1)
+        return (H, g)
+
+    H, g = lax.fori_loop(0, m, rotate, (H, g))
+
+    def back(i_rev, y):
+        i = m - 1 - i_rev
+        rii = H[i, i]
+        # y entries below i are still zero, so the full row product is the
+        # already-solved tail sum.
+        s = g[i] - H[i, :m] @ y
+        yi = jnp.where(rii == 0, 0.0, s / jnp.where(rii == 0, 1.0, rii))
+        return y.at[i].set(yi)
+
+    y = lax.fori_loop(0, m, back, jnp.zeros(m, H.dtype))
+    return y, jnp.abs(g[m])
+
+
+def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                 restart=30, monitor=None):
+    """Left-preconditioned restarted GMRES (KSPGMRES equivalent).
+
+    Convergence is monitored in the preconditioned residual norm, matching
+    PETSc's default (KSP_NORM_PRECONDITIONED). Arnoldi uses modified
+    Gram-Schmidt; the small least-squares problem is solved per cycle.
+    """
+    m = restart
+    lsize = b.shape[0]
+    pb = M(b)
+    bnorm = pnorm(pb)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    r0 = M(b - A(x0))
+    rnorm0 = pnorm(r0)
+
+    def cycle(st):
+        k, x, rn = st
+        r = M(b - A(x))
+        beta = pnorm(r)
+        V = jnp.zeros((m + 1, lsize), b.dtype)
+        V = V.at[0].set(r / jnp.where(beta == 0, 1.0, beta))
+        H = jnp.zeros((m + 1, m), b.dtype)
+
+        def arnoldi(j, VH):
+            V, H = VH
+            w = M(A(V[j]))
+
+            def mgs(i, wH):
+                w, H = wH
+                # V rows beyond j+1 are zero, so running over all rows is a
+                # masked modified Gram-Schmidt with no explicit mask.
+                hij = pdot(V[i], w)
+                return (w - hij * V[i], H.at[i, j].set(hij))
+
+            w, H = lax.fori_loop(0, m + 1, mgs, (w, H))
+            hnorm = pnorm(w)
+            H = H.at[j + 1, j].set(hnorm)
+            V = V.at[j + 1].set(w / jnp.where(hnorm == 0, 1.0, hnorm))
+            return (V, H)
+
+        V, H = lax.fori_loop(0, m, arnoldi, (V, H))
+        y, _ = _hessenberg_lstsq(H, beta)
+        x = x + y @ V[:m]
+        rn = pnorm(M(b - A(x)))
+        if monitor is not None:
+            monitor(k + m, rn)
+        return (k + m, x, rn)
+
+    def cond(st):
+        k, x, rn = st
+        return (rn > tol) & (k < maxit)
+
+    k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
+    brk = rnorm <= -1.0
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Apply the preconditioner exactly once (KSPPREONLY equivalent).
+
+    With PC 'lu' this is the reference's direct-solve path
+    (``test.py:38-43``: preonly + PCLU + MUMPS). Two steps of iterative
+    refinement recover accuracy lost to reduced-precision application of the
+    factorization (the fp32-on-TPU story, SURVEY.md §7.3) — they are exact
+    no-ops when M is the exact inverse.
+    """
+    x = M(b)
+
+    def refine(_, x):
+        return x + M(b - A(x))
+
+    x = lax.fori_loop(0, 2, refine, x)
+    rnorm = pnorm(b - A(x))
+    return (x, jnp.int32(1), rnorm,
+            jnp.full((), CR.CONVERGED_ITS, jnp.int32))
+
+
+def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                      scale=1.0, monitor=None):
+    """Preconditioned Richardson iteration (KSPRICHARDSON equivalent)."""
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rnorm = pnorm(r)
+
+    def cond(st):
+        k, x, r, rn = st
+        return (rn > tol) & (k < maxit)
+
+    def body(st):
+        k, x, r, rn = st
+        x = x + scale * M(r)
+        r = b - A(x)
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, rn)
+
+    k, x, r, rnorm = lax.while_loop(cond, body,
+                                    (jnp.int32(0), x0, r, rnorm))
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+
+
+KSP_KERNELS = {
+    "cg": cg_kernel,
+    "bcgs": bcgs_kernel,
+    "gmres": gmres_kernel,
+    "preonly": preonly_kernel,
+    "richardson": richardson_kernel,
+}
+
+
+# ---------------------------------------------------------------------------
+# program factory: wrap a kernel body in shard_map + jit
+# ---------------------------------------------------------------------------
+
+_PROGRAM_CACHE: dict = {}
+
+# Monitor dispatch: compiled programs with monitoring enabled call a stable
+# trampoline that reads this cell, so cached programs pick up whichever
+# monitor the *current* solve installed (programs are cached per mesh/type/
+# shape key and outlive any one KSP object). Set via set_current_monitor()
+# around a solve; solves are single-controller-sequential so a cell is safe.
+_CURRENT_MONITOR = [None]
+
+
+def set_current_monitor(cb):
+    _CURRENT_MONITOR[0] = cb
+
+
+def _monitor_trampoline(dev, k, rn):
+    cb = _CURRENT_MONITOR[0]
+    if cb is not None:
+        cb(dev, k, rn)
+
+
+def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, n: int,
+                      dtype, restart: int = 30, monitored: bool = False,
+                      spmv=None, spmv_specs=None):
+    """Build (or fetch cached) the jitted SPMD solve program.
+
+    Signature of the returned callable::
+
+        x, iters, rnorm, reason = prog(op_arrays, pc_arrays, b, x0,
+                                       rtol, atol, maxit)
+
+    ``op_arrays`` is the operator's pytree of sharded arrays (default: the
+    ELL ``(cols, vals)`` pair) and ``spmv(op_local, x_local) -> y_local`` the
+    local matvec closure; pass ``spmv``/``spmv_specs`` for matrix-free
+    operators (e.g. stencils). With ``monitored=True`` the program reports
+    per-iteration residuals to the monitor installed by
+    :func:`set_current_monitor`.
+    """
+    axis = comm.axis
+    key = (comm.mesh, axis, ksp_type, pc.kind, n, dtype, restart,
+           monitored, spmv)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    kernel = KSP_KERNELS[ksp_type]
+    pc_apply = pc.local_apply(comm, n)
+    if spmv is None:
+        def spmv_local(op_local, x_local):
+            cols, vals = op_local
+            x_full = lax.all_gather(x_local, axis, tiled=True)
+            return ell_spmv_local(cols, vals, x_full)
+        op_specs = (P(axis, None), P(axis, None))
+    else:
+        spmv_local = spmv
+        op_specs = spmv_specs
+
+    monitor = None
+    if monitored:
+        def monitor(k, rn):
+            jax.debug.callback(_monitor_trampoline, lax.axis_index(axis),
+                               k, rn)
+
+    def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+        A = lambda v: spmv_local(op_arrays, v)
+        M = lambda r: pc_apply(pc_arrays, r)
+        pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
+        pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+        kw = {"monitor": monitor} if monitor is not None else {}
+        if ksp_type == "gmres":
+            kw["restart"] = restart
+        return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
+
+    in_specs = (op_specs, pc.in_specs(axis), P(axis), P(axis), P(), P(), P())
+    out_specs = (P(axis), P(), P(), P())
+    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
+    _PROGRAM_CACHE[key] = prog
+    return prog

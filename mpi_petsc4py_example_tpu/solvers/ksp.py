@@ -1,0 +1,223 @@
+"""KSP — Krylov solver object, TPU-native equivalent of PETSc KSP (SURVEY.md N3).
+
+Reference usage (``test.py:33-50``): ``KSP().create(comm)``, ``setType``,
+``getPC``, ``setOperators``, ``setFromOptions``, ``setUp``, ``solve(b, x)``.
+The same surface is provided here (snake_case canonical, camelCase aliases for
+facade/driver compatibility); ``solve`` dispatches to a cached jit-compiled
+``shard_map`` program built by :mod:`.krylov`.
+
+Solver types: ``cg``, ``gmres``, ``bcgs``, ``preonly``, ``richardson``.
+Runtime override via the options DB: ``-ksp_type``, ``-ksp_rtol``,
+``-ksp_atol``, ``-ksp_max_it``, ``-ksp_gmres_restart``, ``-ksp_monitor``,
+``-pc_type`` (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.mat import Mat
+from ..core.vec import Vec
+from ..parallel.mesh import as_comm
+from ..utils.convergence import ConvergedReason, SolveResult
+from ..utils.options import global_options
+from .krylov import KSP_KERNELS, build_ksp_program, set_current_monitor
+from .pc import PC
+
+DEFAULT_RTOL = 1e-5   # PETSc's KSP default
+DEFAULT_ATOL = 1e-50
+DEFAULT_MAX_IT = 10000
+
+
+class KSP:
+    """Krylov solver context."""
+
+    def __init__(self, comm=None):
+        self.comm = None
+        self._type = "gmres"          # PETSc's default KSP type
+        self._pc: PC | None = None
+        self._mat: Mat | None = None
+        self.rtol = DEFAULT_RTOL
+        self.atol = DEFAULT_ATOL
+        self.max_it = DEFAULT_MAX_IT
+        self.restart = 30
+        self._monitors = []
+        self._monitor_flag = False
+        self._initial_guess_nonzero = False
+        self.result = SolveResult()
+        self._prefix = ""
+        if comm is not None:
+            self.create(comm)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def create(self, comm=None):
+        self.comm = as_comm(comm)
+        self._pc = PC(self.comm)
+        return self
+
+    def destroy(self):
+        return self
+
+    # ---- configuration (petsc4py-shaped) ------------------------------------
+    def set_type(self, ksp_type: str):
+        ksp_type = str(ksp_type).lower()
+        if ksp_type not in KSP_KERNELS:
+            raise ValueError(f"unknown KSP type {ksp_type!r}; "
+                             f"available: {sorted(KSP_KERNELS)}")
+        self._type = ksp_type
+        return self
+
+    setType = set_type
+
+    def get_type(self) -> str:
+        return self._type
+
+    getType = get_type
+
+    def get_pc(self) -> PC:
+        if self._pc is None:
+            self._pc = PC(self.comm)
+        return self._pc
+
+    getPC = get_pc
+
+    def set_pc(self, pc: PC):
+        self._pc = pc
+        return self
+
+    def set_operators(self, A: Mat, P_mat: Mat | None = None):
+        self._mat = A
+        if self.comm is None:
+            self.create(A.comm)
+        self.get_pc().set_operators(P_mat if P_mat is not None else A)
+        return self
+
+    setOperators = set_operators
+
+    def set_tolerances(self, rtol=None, atol=None, divtol=None, max_it=None):
+        if rtol is not None:
+            self.rtol = float(rtol)
+        if atol is not None:
+            self.atol = float(atol)
+        if max_it is not None:
+            self.max_it = int(max_it)
+        return self
+
+    setTolerances = set_tolerances
+
+    def set_initial_guess_nonzero(self, flag: bool):
+        self._initial_guess_nonzero = bool(flag)
+        return self
+
+    setInitialGuessNonzero = set_initial_guess_nonzero
+
+    def set_options_prefix(self, prefix: str):
+        self._prefix = prefix or ""
+        return self
+
+    setOptionsPrefix = set_options_prefix
+
+    def set_monitor(self, cb):
+        """``cb(ksp, iteration, rnorm)`` per iteration (-ksp_monitor analog)."""
+        self._monitors.append(cb)
+        return self
+
+    setMonitor = set_monitor
+
+    def set_from_options(self):
+        """Apply the global options DB (the reference's ``setFromOptions``)."""
+        opt = global_options()
+        p = self._prefix
+        t = opt.get_string(p + "ksp_type")
+        if t:
+            self.set_type(t)
+        self.rtol = opt.get_real(p + "ksp_rtol", self.rtol)
+        self.atol = opt.get_real(p + "ksp_atol", self.atol)
+        self.max_it = opt.get_int(p + "ksp_max_it", self.max_it)
+        self.restart = opt.get_int(p + "ksp_gmres_restart", self.restart)
+        self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
+        pct = opt.get_string(p + "pc_type")
+        if pct:
+            self.get_pc().set_type(pct)
+        fst = opt.get_string(p + "pc_factor_mat_solver_type")
+        if fst:
+            self.get_pc().set_factor_solver_type(fst)
+        return self
+
+    setFromOptions = set_from_options
+
+    def set_up(self):
+        if self._mat is None:
+            raise RuntimeError("KSP.set_up: no operators set")
+        self.get_pc().set_up(self.get_pc()._mat or self._mat)
+        return self
+
+    setUp = set_up
+
+    # ---- solve --------------------------------------------------------------
+    def solve(self, b: Vec, x: Vec) -> SolveResult:
+        mat = self._mat
+        if mat is None:
+            raise RuntimeError("KSP.solve: no operators set")
+        self.set_up()
+        comm = mat.comm
+        pc = self.get_pc()
+
+        monitor_cb = None
+        if self._monitors or self._monitor_flag:
+            monitors = list(self._monitors)
+            if self._monitor_flag and not monitors:
+                monitors = [lambda ksp, k, rn:
+                            print(f"  {int(k):4d} KSP Residual norm {float(rn):.12e}")]
+
+            def monitor_cb(dev, k, rn, _monitors=monitors):
+                if int(dev) == 0:
+                    for m in _monitors:
+                        m(self, int(k), float(rn))
+
+        prog = build_ksp_program(comm, self._type, pc, mat.shape[0],
+                                 mat.dtype, restart=self.restart,
+                                 monitored=monitor_cb is not None)
+        x0 = x.data if self._initial_guess_nonzero else jnp.zeros_like(x.data)
+        dt = mat.dtype
+        set_current_monitor(monitor_cb)
+        t0 = time.perf_counter()
+        try:
+            xd, iters, rnorm, reason = prog(
+                mat.device_arrays(), pc.device_arrays(), b.data, x0,
+                jnp.asarray(self.rtol, dt), jnp.asarray(self.atol, dt),
+                jnp.asarray(self.max_it, jnp.int32))
+            xd.block_until_ready()
+        finally:
+            set_current_monitor(None)
+        wall = time.perf_counter() - t0
+        x.data = xd
+        self.result = SolveResult(int(iters), float(rnorm), int(reason), wall)
+        return self.result
+
+    # ---- introspection (petsc4py-shaped) ------------------------------------
+    def get_iteration_number(self) -> int:
+        return self.result.iterations
+
+    getIterationNumber = get_iteration_number
+
+    def get_residual_norm(self) -> float:
+        return self.result.residual_norm
+
+    getResidualNorm = get_residual_norm
+
+    def get_converged_reason(self) -> int:
+        return self.result.reason
+
+    getConvergedReason = get_converged_reason
+
+    @property
+    def converged(self) -> bool:
+        return self.result.converged
+
+    def __repr__(self):
+        return (f"KSP(type={self._type!r}, pc={self.get_pc().get_type()!r}, "
+                f"rtol={self.rtol}, max_it={self.max_it})")

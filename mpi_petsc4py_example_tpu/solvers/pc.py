@@ -1,0 +1,214 @@
+"""Preconditioners — TPU-native equivalent of PETSc ``PC`` (SURVEY.md N4/N5).
+
+Reference usage: ``ksp.getPC(); pc.setType('lu');
+pc.setFactorSolverType('mumps')`` (``test.py:40-43``). Types provided:
+
+* ``none``   — identity.
+* ``jacobi`` — inverse-diagonal scaling; a sharded elementwise multiply.
+* ``bjacobi``— block Jacobi: each mesh device owns its local diagonal block's
+  inverse (the TPU analog of PETSc's per-rank PCBJACOBI+LU); apply is a
+  batched dense matvec on the MXU.
+* ``lu`` / ``cholesky`` — full direct factorization. This is the MUMPS-slot
+  replacement (``test.py:43``): no multifrontal sparse direct solver exists
+  for TPU (SURVEY.md §7.4), so direct solves factorize on the host in fp64
+  (LAPACK) and apply on device as a dense matmul; KSPPREONLY adds iterative
+  refinement. Exact for reference-scale problems; large problems should
+  prefer an iterative KSP with a strong PC.
+
+Note: device-side LU is deliberately avoided — XLA:TPU implements
+LuDecomposition only for F32/C64 (observed on v5e), so factorizations happen
+on host and the device applies triangular-solve-free dense products.
+
+Each PC exposes (a) sharded device arrays and (b) a *local* apply closure
+used inside the jit-compiled shard_map solver bodies, so preconditioning
+fuses into the same XLA program as the Krylov iteration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.linalg
+from jax import lax
+
+from ..core.mat import Mat
+from ..parallel.mesh import DeviceComm
+from jax.sharding import PartitionSpec as P
+
+PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky")
+
+
+class PC:
+    """Preconditioner object, petsc4py-``PC``-shaped."""
+
+    def __init__(self, comm=None):
+        self.comm = comm
+        self._type = "none"
+        self._factor_solver_type = "tpu-dense"
+        self._mat: Mat | None = None
+        self._arrays = ()
+        self._built_for = None
+
+    # ---- petsc4py-shaped configuration -------------------------------------
+    def set_type(self, pc_type: str):
+        pc_type = str(pc_type).lower()
+        if pc_type not in PC_TYPES:
+            raise ValueError(f"unknown PC type {pc_type!r}; "
+                             f"available: {PC_TYPES}")
+        if pc_type != self._type:
+            self._type = pc_type
+            self._built_for = None
+        return self
+
+    setType = set_type
+
+    def get_type(self) -> str:
+        return self._type
+
+    getType = get_type
+
+    def set_factor_solver_type(self, name: str):
+        """Accepts the reference's solver strings ('mumps', 'superlu', ...).
+
+        All map to the TPU dense factorization — recorded for introspection.
+        """
+        self._factor_solver_type = str(name)
+        return self
+
+    setFactorSolverType = set_factor_solver_type
+
+    def set_operators(self, mat: Mat):
+        if mat is not self._mat:
+            self._mat = mat
+            self._built_for = None
+        return self
+
+    # ---- setup: build sharded device-side data ------------------------------
+    def set_up(self, mat: Mat | None = None):
+        if mat is not None:
+            self.set_operators(mat)
+        mat = self._mat
+        if mat is None:
+            raise RuntimeError("PC.set_up: no operator set")
+        if self._built_for == (mat, self._type):
+            return self
+        comm = mat.comm
+        t = self._type
+        if t == "none":
+            self._arrays = ()
+        elif t == "jacobi":
+            diag = mat.diagonal()
+            inv = np.where(diag != 0, 1.0 / np.where(diag == 0, 1.0, diag), 0.0)
+            self._arrays = (comm.put_rows(inv.astype(mat.dtype)),)
+        elif t == "bjacobi":
+            self._arrays = _build_bjacobi(comm, mat)
+        elif t in ("lu", "cholesky"):
+            self._arrays = _build_dense_lu(comm, mat)
+        self._built_for = (mat, self._type)
+        return self
+
+    setUp = set_up
+
+    # ---- what the KSP solver factory consumes -------------------------------
+    @property
+    def kind(self) -> str:
+        return "lu" if self._type == "cholesky" else self._type
+
+    def device_arrays(self) -> tuple:
+        return self._arrays
+
+    def in_specs(self, axis: str) -> tuple:
+        """shard_map in_specs matching :meth:`device_arrays`."""
+        k = self.kind
+        if k == "none":
+            return ()
+        if k == "jacobi":
+            return (P(axis),)
+        if k == "bjacobi":
+            return (P(axis),)
+        if k == "lu":
+            return (P(),)
+        raise AssertionError(k)
+
+    def local_apply(self, comm: DeviceComm, n: int):
+        """Return ``apply(pc_arrays_local, r_local) -> z_local``.
+
+        Runs *inside* shard_map: ``pc_arrays_local`` are this device's shards
+        of :meth:`device_arrays`.
+        """
+        k = self.kind
+        axis = comm.axis
+        lsize = comm.local_size(n)
+
+        if k == "none":
+            return lambda arrs, r: r
+        if k == "jacobi":
+            return lambda arrs, r: arrs[0] * r
+        if k == "bjacobi":
+            def apply(arrs, r):
+                binv = arrs[0]  # this device's (1, lsize, lsize) block inverse
+                return binv[0] @ r
+            return apply
+        if k == "lu":
+            def apply(arrs, r):
+                minv = arrs[0]  # replicated (n_pad, n_pad) inverse
+                r_full = lax.all_gather(r, axis, tiled=True)
+                z_full = minv @ r_full
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
+            return apply
+        raise AssertionError(k)
+
+    def __repr__(self):
+        return f"PC(type={self._type!r}, factor={self._factor_solver_type!r})"
+
+
+_DENSE_CAP = 16384  # host O(n^3) factorization bound for direct paths
+
+
+def _build_bjacobi(comm: DeviceComm, mat: Mat):
+    """Per-device inverse of the local (uniform-padded) diagonal block.
+
+    Factorized on host in fp64 (LAPACK), shipped as explicit inverses so the
+    device-side apply is one dense matvec on the MXU.
+    """
+    n = mat.shape[0]
+    lsize = comm.local_size(n)
+    ndev = comm.size
+    if lsize > _DENSE_CAP:
+        raise ValueError(
+            f"PC 'bjacobi' local blocks are dense ({lsize}x{lsize}); too "
+            "large — use more devices or pc 'jacobi' (SURVEY.md §7.4)")
+    A = mat.to_scipy().tocsr()
+    blocks = np.zeros((ndev, lsize, lsize), dtype=np.float64)
+    for d in range(ndev):
+        rs, re = d * lsize, min((d + 1) * lsize, n)
+        blocks[d] = np.eye(lsize)
+        if rs < n:
+            m = re - rs
+            blocks[d, :m, :m] = A[rs:re, rs:re].toarray()
+    inv = np.stack([scipy.linalg.inv(b) for b in blocks]).astype(mat.dtype)
+    inv_dev = jax.device_put(
+        inv, jax.sharding.NamedSharding(comm.mesh, P(comm.axis)))
+    return (inv_dev,)
+
+
+def _build_dense_lu(comm: DeviceComm, mat: Mat):
+    """Replicated dense inverse of the full operator (the MUMPS-slot path).
+
+    XLA:TPU has no f64 LuDecomposition, so the factorization runs on host
+    LAPACK in fp64; the device applies the (padded) inverse as one matmul.
+    Accuracy is recovered by iterative refinement in KSPPREONLY.
+    """
+    n = mat.shape[0]
+    if n > _DENSE_CAP:
+        raise ValueError(
+            f"PC 'lu' densifies the operator; n={n} is too large — use an "
+            "iterative KSP with pc 'bjacobi'/'jacobi' instead (SURVEY.md §7.4)")
+    A = mat.to_scipy().toarray().astype(np.float64)
+    inv = scipy.linalg.inv(A)
+    n_pad = comm.padded_size(n)
+    inv_pad = np.zeros((n_pad, n_pad), dtype=np.float64)
+    inv_pad[:n, :n] = inv
+    return (comm.put_replicated(inv_pad.astype(mat.dtype)),)

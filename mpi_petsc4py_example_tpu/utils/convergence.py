@@ -1,0 +1,56 @@
+"""Solver result reporting — the KSPConvergedReason family, TPU edition.
+
+The reference exposes convergence only through PETSc's runtime machinery
+(``-ksp_monitor`` etc. reachable via ``setFromOptions``, ``test.py:46``;
+SURVEY.md §5.5). Here every solve returns a structured result with the same
+reason codes petsc4py uses, so drivers and tests can assert on them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class ConvergedReason:
+    """Integer reason codes, PETSc-compatible values."""
+    CONVERGED_RTOL = 2
+    CONVERGED_ATOL = 3
+    CONVERGED_ITS = 4
+    ITERATING = 0
+    DIVERGED_NULL = -2
+    DIVERGED_MAX_IT = -3
+    DIVERGED_DTOL = -4
+    DIVERGED_BREAKDOWN = -5
+
+    _NAMES = {
+        2: "CONVERGED_RTOL", 3: "CONVERGED_ATOL", 4: "CONVERGED_ITS",
+        0: "ITERATING", -2: "DIVERGED_NULL", -3: "DIVERGED_MAX_IT",
+        -4: "DIVERGED_DTOL", -5: "DIVERGED_BREAKDOWN",
+    }
+
+    @classmethod
+    def name(cls, code: int) -> str:
+        return cls._NAMES.get(int(code), f"UNKNOWN({code})")
+
+
+@dataclass
+class SolveResult:
+    """What a KSP/EPS solve reports (iterations, residual, reason, timing)."""
+    iterations: int = 0
+    residual_norm: float = 0.0
+    reason: int = ConvergedReason.ITERATING
+    wall_time: float = 0.0
+    history: list = field(default_factory=list)
+
+    @property
+    def converged(self) -> bool:
+        return self.reason > 0
+
+    @property
+    def reason_name(self) -> str:
+        return ConvergedReason.name(self.reason)
+
+    def __repr__(self):
+        return (f"SolveResult(iters={self.iterations}, "
+                f"rnorm={self.residual_norm:.3e}, {self.reason_name}, "
+                f"{self.wall_time*1e3:.1f} ms)")

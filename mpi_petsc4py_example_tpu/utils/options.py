@@ -1,0 +1,136 @@
+"""Runtime options database — TPU equivalent of the PETSc options DB.
+
+The reference seeds PETSc's options database from argv
+(``petsc4py.init(sys.argv)``, ``test.py:5``) and applies it with
+``setFromOptions()`` on KSP (``test.py:46``) and EPS
+(``petsc_funcs.py:17``), making the drivers' hard-coded choices runtime
+overridable (SURVEY.md §3.4/§5.6). This module reproduces that: a global
+registry parsed from argv and environment, with the same flag spellings
+(``-ksp_type cg``, ``-pc_type jacobi``, ``-eps_nev 4``, ...).
+
+Environment variables of the form ``TPU_SOLVE_<KEY>=<value>`` map to option
+``<key>`` lowercased (e.g. ``TPU_SOLVE_KSP_TYPE=gmres``); the backend switch
+itself is ``TPU_SOLVE_BACKEND`` per the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV_PREFIX = "TPU_SOLVE_"
+
+
+class Options:
+    """A PETSc-style string->string options database."""
+
+    def __init__(self):
+        self._db: dict[str, str] = {}
+        self.load_env()
+
+    # ---- population --------------------------------------------------------
+    def load_env(self):
+        for k, v in os.environ.items():
+            if k.startswith(_ENV_PREFIX) and k != _ENV_PREFIX + "BACKEND":
+                self._db[k[len(_ENV_PREFIX):].lower()] = v
+
+    def parse_argv(self, argv):
+        """Parse ``-key value`` / ``-key`` (boolean) pairs, PETSc style.
+
+        A token starting with ``-`` is a value (not a new flag) when it
+        parses as a number, so negative tolerances/shifts work.
+        """
+        if argv is None:
+            return
+
+        def is_value(tok: str) -> bool:
+            if not tok.startswith("-"):
+                return True
+            try:
+                float(tok)
+                return True
+            except ValueError:
+                return False
+
+        i = 0
+        toks = list(argv)
+        # skip the program name if present
+        if toks and not toks[0].startswith("-"):
+            i = 1
+        while i < len(toks):
+            tok = toks[i]
+            if tok.startswith("-") and not is_value(tok):
+                key = tok.lstrip("-")
+                if i + 1 < len(toks) and is_value(toks[i + 1]):
+                    self._db[key] = toks[i + 1]
+                    i += 2
+                else:
+                    self._db[key] = "true"
+                    i += 1
+            else:
+                i += 1
+
+    # ---- access ------------------------------------------------------------
+    def set(self, key: str, value):
+        self._db[key.lstrip("-")] = str(value)
+
+    def clear(self, key: str | None = None):
+        if key is None:
+            self._db.clear()
+        else:
+            self._db.pop(key.lstrip("-"), None)
+
+    def get(self, key: str, default=None):
+        return self._db.get(key.lstrip("-"), default)
+
+    def get_string(self, key: str, default: str | None = None):
+        return self.get(key, default)
+
+    def get_int(self, key: str, default: int | None = None):
+        v = self.get(key)
+        return default if v is None else int(v)
+
+    def get_real(self, key: str, default: float | None = None):
+        v = self.get(key)
+        return default if v is None else float(v)
+
+    def get_bool(self, key: str, default: bool = False):
+        v = self.get(key)
+        if v is None:
+            return default
+        return str(v).lower() not in ("0", "false", "no", "off")
+
+    def has(self, key: str) -> bool:
+        return key.lstrip("-") in self._db
+
+    def as_dict(self) -> dict:
+        return dict(self._db)
+
+    def __repr__(self):
+        return f"Options({self._db})"
+
+
+_global_options: Options | None = None
+_initialized = False
+
+
+def global_options() -> Options:
+    global _global_options
+    if _global_options is None:
+        _global_options = Options()
+    return _global_options
+
+
+def init(argv=None):
+    """Seed the global options DB from argv — ``petsc4py.init`` equivalent."""
+    global _initialized
+    global_options().parse_argv(argv)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def backend() -> str:
+    """Execution backend selected by env var (north-star requirement)."""
+    return os.environ.get(_ENV_PREFIX + "BACKEND", "tpu").lower()

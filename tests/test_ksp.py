@@ -1,0 +1,190 @@
+"""KSP solver correctness: manufactured-solution oracles vs scipy.
+
+Mirrors the reference's oracle pattern (generate X, form B=A·X, solve,
+compare — ``test.py:12-17`` + ``test.py:148-149``) across every KSP type and
+PC combination, on simulated multi-device meshes.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+
+
+def poisson1d(n):
+    return sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                    [-1, 0, 1]).tocsr()
+
+
+def poisson2d(nx):
+    I = sp.eye(nx)
+    T = poisson1d(nx)
+    return (sp.kron(I, T) + sp.kron(T, I)).tocsr()
+
+
+def convdiff2d(nx, beta=0.3):
+    """Unsymmetric convection-diffusion (5-point + upwind convection)."""
+    n = nx * nx
+    A = poisson2d(nx).tolil()
+    for i in range(n):
+        if i + 1 < n:
+            A[i, i + 1] -= beta
+        if i - 1 >= 0:
+            A[i, i - 1] += beta
+    return A.tocsr()
+
+
+def manufactured(A, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random(A.shape[0])
+    return x, A @ x
+
+
+def solve(comm, A, b, ksp_type, pc_type, rtol=1e-10, **kw):
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type(ksp_type)
+    ksp.get_pc().set_type(pc_type)
+    ksp.set_tolerances(rtol=rtol, max_it=kw.pop("max_it", 5000))
+    for k, v in kw.items():
+        setattr(ksp, k, v)
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+    res = ksp.solve(bv, x)
+    return x.to_numpy(), res, ksp
+
+
+class TestCG:
+    @pytest.mark.parametrize("pc", ["none", "jacobi", "bjacobi"])
+    def test_poisson2d(self, comm, pc):
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "cg", pc)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-7, atol=1e-9)
+
+    def test_random_spd(self, comm8):
+        rng = np.random.default_rng(3)
+        B = sp.random(80, 80, density=0.1, random_state=rng)
+        A = (B @ B.T + 10 * sp.eye(80)).tocsr()
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "jacobi")
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, rtol=1e-7, atol=1e-9)
+
+    def test_residual_parity_with_scipy(self, comm8):
+        """BASELINE gate: residual parity at rtol=1e-6 vs CPU oracle."""
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "none", rtol=1e-6)
+        r_ours = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert r_ours <= 1e-6
+
+    def test_iteration_count_reasonable(self, comm8):
+        A = poisson1d(64)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "none")
+        # CG on 1-D Poisson converges in at most n iterations
+        assert res.iterations <= 64
+
+
+class TestGMRES:
+    @pytest.mark.parametrize("pc", ["none", "jacobi", "bjacobi"])
+    def test_convdiff(self, comm, pc):
+        A = convdiff2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "gmres", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_gmres_restart_config(self, comm8):
+        A = poisson2d(8)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "gmres", "jacobi", restart=10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+
+class TestBCGS:
+    @pytest.mark.parametrize("pc", ["none", "jacobi", "bjacobi"])
+    def test_convdiff(self, comm, pc):
+        A = convdiff2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "bcgs", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+
+class TestDirect:
+    def test_preonly_lu_reference_system(self, comm):
+        """The reference's exact flow: random system, preonly+LU ('mumps')."""
+        rng = np.random.default_rng(42)
+        A = sp.random(100, 100, density=0.1, format="csr", dtype=np.float64,
+                      random_state=rng)
+        X = rng.random(100)
+        B = A @ X
+        ksp_x, res, ksp = solve(comm, A, B, "preonly", "lu", max_it=1)
+        assert np.allclose(ksp_x, X)  # the reference's oracle (test.py:148)
+
+    def test_preonly_lu_mumps_string_accepted(self, comm1):
+        A = poisson1d(30)
+        M = tps.Mat.from_scipy(comm1, A)
+        ksp = tps.KSP().create(comm1)
+        ksp.set_type("preonly")
+        pc = ksp.get_pc()
+        pc.set_type("lu")
+        pc.set_factor_solver_type("mumps")  # reference string, test.py:43
+        ksp.set_operators(M)
+        x_true, b = manufactured(A)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-10)
+
+    def test_lu_rejects_huge(self, comm1):
+        pc = tps.PC()
+        pc.set_type("lu")
+        A = sp.eye(30000, format="csr")
+        M = tps.Mat.from_scipy(comm1, A)
+        with pytest.raises(ValueError, match="too large"):
+            pc.set_up(M)
+
+
+class TestKSPObject:
+    def test_defaults_match_petsc(self):
+        ksp = tps.KSP()
+        assert ksp.get_type() == "gmres"
+        assert ksp.rtol == 1e-5
+        assert ksp.max_it == 10000
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ValueError, match="unknown KSP type"):
+            tps.KSP().set_type("nosuch")
+
+    def test_monitor_called(self, comm8):
+        A = poisson1d(32)
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        seen = []
+        ksp.set_monitor(lambda ksp, k, rn: seen.append((k, rn)))
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        assert len(seen) >= 1
+        assert seen[-1][1] <= 1e-5 * np.linalg.norm(b)
+
+    def test_converged_reason_names(self):
+        assert tps.ConvergedReason.name(2) == "CONVERGED_RTOL"
+        assert tps.ConvergedReason.name(-3) == "DIVERGED_MAX_IT"
+
+    def test_max_it_divergence_reported(self, comm8):
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "none", rtol=1e-14, max_it=3)
+        assert not res.converged
+        assert res.reason == tps.ConvergedReason.DIVERGED_MAX_IT
