@@ -37,6 +37,9 @@ class Mat:
         # optional host CSR triple (indptr, indices, data) of the full matrix
         self.host_csr = host_csr
         self._assembled = False
+        # constant-diagonal fast path (set by model generators so Jacobi
+        # setup never pulls a 100M-row ELL back to host)
+        self._diag_value: float | None = None
 
     # ---- constructors ------------------------------------------------------
     @classmethod
@@ -139,6 +142,8 @@ class Mat:
 
     def diagonal(self) -> np.ndarray:
         """Host-side global diagonal (for Jacobi preconditioning)."""
+        if self._diag_value is not None:
+            return np.full(self.shape[0], self._diag_value)
         if self.host_csr is not None:
             return csr_diag(*self.host_csr, self.shape[0])
         cols = np.asarray(self.ell_cols)[: self.shape[0]]
@@ -160,9 +165,29 @@ class Mat:
             (vals.ravel()[mask], (rows[mask], cols.ravel()[mask])),
             shape=self.shape)
 
+    # ---- linear-operator protocol (consumed by solvers.krylov) -------------
     def device_arrays(self):
         """The raw sharded ELL arrays consumed by shard_map solver kernels."""
         return self.ell_cols, self.ell_vals
+
+    def local_spmv(self, comm: DeviceComm):
+        """Local SpMV closure for use inside shard_map: all_gather + ELL."""
+        axis = comm.axis
+
+        def spmv(op_local, x_local):
+            from jax import lax
+            cols, vals = op_local
+            x_full = lax.all_gather(x_local, axis, tiled=True)
+            return ell_spmv_local(cols, vals, x_full)
+
+        return spmv
+
+    def op_specs(self, axis):
+        from jax.sharding import PartitionSpec as P
+        return (P(axis, None), P(axis, None))
+
+    def program_key(self):
+        return ("ell",)
 
     def __repr__(self):
         return (f"Mat(shape={self.shape}, K={self.K}, "

@@ -1,0 +1,4 @@
+from .poisson import (poisson1d_csr, poisson2d_csr, poisson3d_csr,
+                      poisson2d_ell, poisson3d_ell)
+from .stencil import StencilPoisson3D
+from .generators import random_system, tridiag_family, convdiff2d

@@ -1,0 +1,99 @@
+"""Poisson model problems (1D/2D/3D finite-difference Laplacians).
+
+These are the benchmark operators from BASELINE.json: config 1/5 use 3D
+7-point Poisson (up to 100M DoF), config 3 uses 2D 5-point Poisson. Small
+sizes build scipy CSR (oracle-friendly); large sizes build the device ELL
+layout directly with vectorized numpy — no scipy materialization — so a
+100M-DoF operator assembles without a CSR detour.
+
+Row ordering is x-fastest (``index = x + nx*(y + ny*z)``) so a contiguous
+row block is a contiguous slab of z-planes — the layout the matrix-free
+stencil operator (models/stencil.py) shares.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..core.mat import Mat
+from ..parallel.mesh import as_comm
+
+
+def poisson1d_csr(n: int) -> sp.csr_matrix:
+    return sp.diags([-np.ones(n - 1), 2.0 * np.ones(n), -np.ones(n - 1)],
+                    [-1, 0, 1], format="csr")
+
+
+def poisson2d_csr(nx: int, ny: int | None = None) -> sp.csr_matrix:
+    ny = ny or nx
+    Tx, Ty = poisson1d_csr(nx), poisson1d_csr(ny)
+    Ix, Iy = sp.eye(nx), sp.eye(ny)
+    return (sp.kron(Iy, Tx) + sp.kron(Ty, Ix)).tocsr()
+
+
+def poisson3d_csr(nx: int, ny: int | None = None,
+                  nz: int | None = None) -> sp.csr_matrix:
+    ny = ny or nx
+    nz = nz or nx
+    A2 = poisson2d_csr(nx, ny)
+    Tz = poisson1d_csr(nz)
+    return (sp.kron(sp.eye(nz), A2) + sp.kron(Tz, sp.eye(nx * ny))).tocsr()
+
+
+def _neighbor_ell(coords, dims, strides, dtype):
+    """Vectorized ELL build for an axis-aligned stencil with Dirichlet BCs."""
+    n = coords[0].size
+    ndim = len(dims)
+    K = 2 * ndim + 1
+    cols = np.zeros((n, K), dtype=np.int32)
+    vals = np.zeros((n, K), dtype=dtype)
+    idx = np.arange(n, dtype=np.int64)
+    cols[:, 0] = idx
+    vals[:, 0] = 2.0 * ndim
+    slot = 1
+    for d in range(ndim):
+        for step in (-1, +1):
+            valid = (coords[d] + step >= 0) & (coords[d] + step < dims[d])
+            cols[:, slot] = np.where(valid, idx + step * strides[d], 0)
+            vals[:, slot] = np.where(valid, -1.0, 0.0)
+            slot += 1
+    return cols, vals
+
+
+def poisson3d_ell(comm, nx: int, ny: int | None = None, nz: int | None = None,
+                  dtype=np.float64) -> Mat:
+    """Build the 3D 7-point Poisson operator directly in ELL layout.
+
+    Scales to the 100M-DoF BASELINE config without a scipy intermediate.
+    """
+    comm = as_comm(comm)
+    ny = ny or nx
+    nz = nz or nx
+    n = nx * ny * nz
+    idx = np.arange(n, dtype=np.int64)
+    x = idx % nx
+    y = (idx // nx) % ny
+    z = idx // (nx * ny)
+    cols, vals = _neighbor_ell((x, y, z), (nx, ny, nz),
+                               (1, nx, nx * ny), dtype)
+    m = Mat(comm, (n, n), comm.put_rows(cols), comm.put_rows(vals))
+    m._diag_value = 6.0
+    m.assemble()
+    return m
+
+
+def poisson2d_ell(comm, nx: int, ny: int | None = None,
+                  dtype=np.float64) -> Mat:
+    """2D 5-point Poisson directly in ELL layout."""
+    comm = as_comm(comm)
+    ny = ny or nx
+    n = nx * ny
+    idx = np.arange(n, dtype=np.int64)
+    x = idx % nx
+    y = idx // nx
+    cols, vals = _neighbor_ell((x, y), (nx, ny), (1, nx), dtype)
+    m = Mat(comm, (n, n), comm.put_rows(cols), comm.put_rows(vals))
+    m._diag_value = 4.0
+    m.assemble()
+    return m

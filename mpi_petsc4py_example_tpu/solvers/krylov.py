@@ -300,9 +300,8 @@ def _monitor_trampoline(dev, k, rn):
         cb(dev, k, rn)
 
 
-def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, n: int,
-                      dtype, restart: int = 30, monitored: bool = False,
-                      spmv=None, spmv_specs=None):
+def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
+                      restart: int = 30, monitored: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -310,31 +309,26 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, n: int,
         x, iters, rnorm, reason = prog(op_arrays, pc_arrays, b, x0,
                                        rtol, atol, maxit)
 
-    ``op_arrays`` is the operator's pytree of sharded arrays (default: the
-    ELL ``(cols, vals)`` pair) and ``spmv(op_local, x_local) -> y_local`` the
-    local matvec closure; pass ``spmv``/``spmv_specs`` for matrix-free
-    operators (e.g. stencils). With ``monitored=True`` the program reports
+    ``operator`` is anything implementing the linear-operator protocol (see
+    core.mat.Mat and models.stencil): ``shape``, ``dtype``,
+    ``device_arrays()``, ``local_spmv(comm)``, ``op_specs(axis)`` and
+    ``program_key()``. With ``monitored=True`` the program reports
     per-iteration residuals to the monitor installed by
     :func:`set_current_monitor`.
     """
     axis = comm.axis
-    key = (comm.mesh, axis, ksp_type, pc.kind, n, dtype, restart,
-           monitored, spmv)
+    n = operator.shape[0]
+    dtype = operator.dtype
+    key = (comm.mesh, axis, ksp_type, pc.kind, n, str(dtype), restart,
+           monitored, operator.program_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
 
     kernel = KSP_KERNELS[ksp_type]
     pc_apply = pc.local_apply(comm, n)
-    if spmv is None:
-        def spmv_local(op_local, x_local):
-            cols, vals = op_local
-            x_full = lax.all_gather(x_local, axis, tiled=True)
-            return ell_spmv_local(cols, vals, x_full)
-        op_specs = (P(axis, None), P(axis, None))
-    else:
-        spmv_local = spmv
-        op_specs = spmv_specs
+    spmv_local = operator.local_spmv(comm)
+    op_specs = operator.op_specs(axis)
 
     monitor = None
     if monitored:

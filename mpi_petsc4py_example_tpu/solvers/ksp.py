@@ -178,8 +178,8 @@ class KSP:
                     for m in _monitors:
                         m(self, int(k), float(rn))
 
-        prog = build_ksp_program(comm, self._type, pc, mat.shape[0],
-                                 mat.dtype, restart=self.restart,
+        prog = build_ksp_program(comm, self._type, pc, mat,
+                                 restart=self.restart,
                                  monitored=monitor_cb is not None)
         x0 = x.data if self._initial_guess_nonzero else jnp.zeros_like(x.data)
         dt = mat.dtype
