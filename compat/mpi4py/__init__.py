@@ -1,0 +1,3 @@
+"""mpi4py facade package (no MPI required — see MPI.py)."""
+
+from . import MPI  # noqa: F401

@@ -1,0 +1,354 @@
+"""petsc4py-shaped facade over the TPU framework.
+
+Mirrors the slice of the petsc4py API the reference drivers exercise
+(``Mat().createAIJ``, ``setUp``, ``assemblyBegin/End``, ``getVecs``,
+``setArray``/``.array``, ``KSP().create/setType/getPC/setOperators/
+setFromOptions/setUp/solve``, ``PC.setType/setFactorSolverType`` —
+test.py:24-50, petsc_funcs.py:5-10), executing on the TPU device mesh.
+
+Collective semantics under virtual ranks (tools/tpurun.py): constructors and
+``solve`` are rendezvous points — every rank contributes its local block /
+arrives at the call, the rank-0 thread performs the single device-mesh
+operation, and all ranks share the resulting object, exactly how the MPIAIJ
+path behaves over real MPI.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import mpi_petsc4py_example_tpu as _tps
+from mpi_petsc4py_example_tpu.parallel.partition import RowLayout
+
+from mpi4py import MPI as _MPI
+
+DECIDE = -1
+DEFAULT = -2
+
+
+def _mpi_comm(comm):
+    """Coerce the facade's comm argument (None / MPI.Comm / DeviceComm)."""
+    if comm is None or isinstance(comm, _tps.DeviceComm):
+        return _MPI.COMM_WORLD
+    return comm
+
+
+class _UnevenLayout:
+    """Row layout with explicit (possibly driver-chosen) per-rank counts."""
+
+    def __init__(self, counts):
+        self.counts = np.asarray(counts, dtype=np.int64)
+        self.displ = np.concatenate(([0], np.cumsum(self.counts)[:-1]))
+        self.nrows = int(self.counts.sum())
+        self.nparts = len(self.counts)
+
+    def range(self, rank):
+        return int(self.displ[rank]), int(self.displ[rank] + self.counts[rank])
+
+
+class Vec:
+    """Distributed vector view: shared core Vec + this rank's block."""
+
+    def __init__(self, core_vec, layout, rank: int, comm):
+        self._core = core_vec
+        self._layout = layout
+        self._rank = rank
+        self._comm = comm
+
+    def setArray(self, local):
+        """Set this rank's local block (collective under virtual ranks)."""
+        local = np.asarray(local)
+        rank = self._rank if self._comm.Get_size() > 1 else 0
+
+        def build(blocks):
+            if self._comm.Get_size() == 1:
+                rs, re = self._layout.range(0)
+                if local.shape[0] == self._core.n:
+                    self._core.set_global(local)
+                    return True
+            host = self._core.to_numpy()
+            for r, blk in blocks:
+                rs, re = self._layout.range(r)
+                host[rs:re] = blk
+            self._core.set_global(host)
+            return True
+
+        self._comm._collective("vec_setarray", (rank, local), build)
+
+    def getArray(self):
+        rs, re = self._layout.range(self._rank)
+        return self._core.to_numpy()[rs:re]
+
+    @property
+    def array(self):
+        return self.getArray()
+
+    def getSize(self):
+        return self._core.n
+
+    def getLocalSize(self):
+        rs, re = self._layout.range(self._rank)
+        return re - rs
+
+    def norm(self):
+        return self._core.norm()
+
+    def set(self, alpha: float):
+        def build(_):
+            self._core.set_global(np.full(self._core.n, alpha))
+            return True
+        self._comm._collective("vec_set", None, build)
+
+    def duplicate(self):
+        return Vec(self._core.duplicate(), self._layout, self._rank,
+                   self._comm)
+
+    def destroy(self):
+        return self
+
+    @property
+    def core(self):
+        return self._core
+
+
+class Mat:
+    """Distributed AIJ matrix handle."""
+
+    def __init__(self):
+        self._core: _tps.Mat | None = None
+        self._layout = None
+        self._comm = None
+
+    def createAIJ(self, size=None, bsize=None, nnz=None, csr=None,
+                  comm=None):
+        """The reference contract (petsc_funcs.py:6 / test.py:24): global
+        ``size``, *local* rebased-CSR triple, communicator."""
+        comm = _mpi_comm(comm)
+        self._comm = comm
+        if csr is None:
+            raise ValueError("createAIJ requires csr=(indptr, indices, data)")
+        indptr = np.asarray(csr[0])
+        local_rows = len(indptr) - 1
+        rank = comm.Get_rank()
+
+        def build(blocks):
+            blocks = [b for _, b in sorted(blocks, key=lambda t: t[0])]
+            counts = [len(b[0]) - 1 for b in blocks]
+            dc = comm.device_comm
+            core = _tps.Mat.from_local_blocks(dc, size, blocks)
+            return core, _UnevenLayout(counts)
+
+        self._core, self._layout = comm._collective(
+            "mat_createaij", (rank, tuple(np.asarray(a) for a in csr)), build)
+        return self
+
+    createDense = None  # not part of the reference surface
+
+    # ---- assembly (no-ops: assembly happened at construction) ---------------
+    def setUp(self):
+        return self
+
+    def assemblyBegin(self):
+        return self
+
+    def assemblyEnd(self):
+        return self
+
+    def assemble(self):
+        return self
+
+    def isAssembled(self):
+        return self._core is not None and self._core.assembled
+
+    # ---- queries -------------------------------------------------------------
+    def getSize(self):
+        return self._core.shape
+
+    def getLocalSize(self):
+        rank = self._comm.Get_rank()
+        rs, re = self._layout.range(rank)
+        return (re - rs, self._core.shape[1])
+
+    def getOwnershipRange(self):
+        rank = self._comm.Get_rank()
+        return self._layout.range(rank)
+
+    def getVecs(self):
+        """Compatibly-sharded (x, b) views (the reference's a.getVecs())."""
+        rank = self._comm.Get_rank()
+
+        def build(_):
+            x, b = self._core.get_vecs()
+            return x, b
+
+        x_core, b_core = self._comm._collective("mat_getvecs", None, build)
+        return (Vec(x_core, self._layout, rank, self._comm),
+                Vec(b_core, self._layout, rank, self._comm))
+
+    createVecs = getVecs
+
+    def getDiagonal(self):
+        rank = self._comm.Get_rank()
+
+        def build(_):
+            d = self._core.diagonal()
+            v = _tps.Vec.from_global(self._core.comm, d)
+            return v
+
+        core = self._comm._collective("mat_getdiag", None, build)
+        return Vec(core, self._layout, rank, self._comm)
+
+    def mult(self, x: Vec, y: Vec):
+        def build(_):
+            self._core.mult(x.core, y.core)
+            return True
+        self._comm._collective("mat_mult", None, build)
+
+    def view(self):
+        if self._comm.Get_rank() == 0:
+            print(repr(self._core), file=sys.stderr)
+
+    def destroy(self):
+        return self
+
+    @property
+    def core(self):
+        return self._core
+
+
+class PC:
+    """Preconditioner handle (fronts solvers.pc.PC)."""
+
+    def __init__(self, core_pc):
+        self._core = core_pc
+
+    def setType(self, t):
+        self._core.set_type(t)
+
+    def getType(self):
+        return self._core.get_type()
+
+    def setFactorSolverType(self, t):
+        """Accepts the reference's 'mumps' (test.py:43) — maps to the TPU
+        dense direct path (SURVEY.md §7.4)."""
+        self._core.set_factor_solver_type(t)
+
+    def getFactorSolverType(self):
+        return self._core._factor_solver_type
+
+    def setFromOptions(self):
+        pass
+
+
+class KSP:
+    """Krylov solver handle (fronts solvers.ksp.KSP)."""
+
+    def __init__(self):
+        self._core = _tps.KSP()
+        self._comm = None
+        self._mat: Mat | None = None
+
+    def create(self, comm=None):
+        comm = _mpi_comm(comm)
+        self._comm = comm
+        self._core.create(comm.device_comm)
+        return self
+
+    def setType(self, t):
+        self._core.set_type(t)
+
+    def getType(self):
+        return self._core.get_type()
+
+    def getPC(self):
+        return PC(self._core.get_pc())
+
+    def setOperators(self, A: Mat, P=None):
+        self._mat = A
+        self._core.set_operators(A.core, P.core if P else None)
+
+    def setTolerances(self, rtol=None, atol=None, divtol=None, max_it=None):
+        self._core.set_tolerances(rtol=rtol, atol=atol, max_it=max_it)
+
+    def setInitialGuessNonzero(self, flag):
+        self._core.set_initial_guess_nonzero(flag)
+
+    def setFromOptions(self):
+        self._core.set_from_options()
+
+    def setUp(self):
+        def build(_):
+            self._core.set_up()
+            return True
+        if self._comm is not None:
+            self._comm._collective("ksp_setup", None, build)
+        else:
+            self._core.set_up()
+
+    def solve(self, b: Vec, x: Vec):
+        """Collective: the rank-0 thread runs the device-mesh solve; its
+        solver context (iterations, residual, reason) is shared to all ranks
+        so post-solve queries agree everywhere."""
+        comm = self._comm or _MPI.COMM_WORLD
+
+        def build(_):
+            self._core.solve(b.core, x.core)
+            return self._core
+
+        self._core = comm._collective("ksp_solve", None, build)
+
+    def getIterationNumber(self):
+        return self._core.get_iteration_number()
+
+    def getResidualNorm(self):
+        return self._core.get_residual_norm()
+
+    def getConvergedReason(self):
+        return self._core.get_converged_reason()
+
+    def setMonitor(self, cb):
+        self._core.set_monitor(cb)
+
+    def destroy(self):
+        return self
+
+    @property
+    def core(self):
+        return self._core
+
+
+class Options:
+    """PETSc.Options-shaped access to the global options DB."""
+
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix or ""
+
+    def _k(self, key):
+        return self._prefix + key.lstrip("-")
+
+    def setValue(self, key, value):
+        _tps.global_options().set(self._k(key), value)
+
+    def getString(self, key, default=None):
+        return _tps.global_options().get_string(self._k(key), default)
+
+    def getInt(self, key, default=None):
+        return _tps.global_options().get_int(self._k(key), default)
+
+    def getReal(self, key, default=None):
+        return _tps.global_options().get_real(self._k(key), default)
+
+    def getBool(self, key, default=False):
+        return _tps.global_options().get_bool(self._k(key), default)
+
+    def hasName(self, key):
+        return _tps.global_options().has(self._k(key))
+
+    def delValue(self, key):
+        _tps.global_options().clear(self._k(key))
+
+
+COMM_WORLD = _MPI.COMM_WORLD
+COMM_SELF = _MPI.COMM_SELF

@@ -1,0 +1,19 @@
+"""petsc4py facade package: ``petsc4py.init(argv)`` + ``petsc4py.PETSc``.
+
+The reference calls ``petsc4py.init(sys.argv)`` before importing PETSc
+(test.py:2-8) to seed the runtime options database; here that seeds the
+framework's options DB (mpi_petsc4py_example_tpu.utils.options).
+"""
+
+import mpi_petsc4py_example_tpu as _tps
+
+
+def init(argv=None, arch=None, comm=None):
+    _tps.init(argv)
+
+
+def get_config():
+    return {"backend": _tps.backend()}
+
+
+from . import PETSc  # noqa: E402  (mirrors petsc4py's submodule layout)

@@ -1,0 +1,91 @@
+"""slepc4py-shaped facade: the EPS surface the reference uses
+(petsc_funcs.py:13-20, test2.py:88-96)."""
+
+from __future__ import annotations
+
+import mpi_petsc4py_example_tpu as _tps
+from mpi_petsc4py_example_tpu.solvers.eps import (
+    EPS as _CoreEPS, EPSProblemType, EPSWhich)
+
+from mpi4py import MPI as _MPI
+from petsc4py.PETSc import Mat as _Mat, Vec as _Vec, _mpi_comm
+
+
+class EPS:
+    """Eigensolver handle (fronts solvers.eps.EPS)."""
+
+    class ProblemType:
+        HEP = EPSProblemType.HEP
+        NHEP = EPSProblemType.NHEP
+        GHEP = EPSProblemType.GHEP
+
+    class Which:
+        LARGEST_MAGNITUDE = EPSWhich.LARGEST_MAGNITUDE
+        SMALLEST_MAGNITUDE = EPSWhich.SMALLEST_MAGNITUDE
+        LARGEST_REAL = EPSWhich.LARGEST_REAL
+        SMALLEST_REAL = EPSWhich.SMALLEST_REAL
+
+    def __init__(self):
+        self._core = _CoreEPS()
+        self._comm = None
+
+    def create(self, comm=None):
+        self._comm = _mpi_comm(comm)
+        self._core.create(self._comm.device_comm)
+        return self
+
+    def setOperators(self, A: _Mat, B=None):
+        self._core.set_operators(A.core, B.core if B else None)
+
+    def setProblemType(self, ptype):
+        self._core.set_problem_type(ptype)
+
+    def setDimensions(self, nev=None, ncv=None, mpd=None):
+        self._core.set_dimensions(nev=nev, ncv=ncv)
+
+    def setTolerances(self, tol=None, max_it=None):
+        self._core.set_tolerances(tol=tol, max_it=max_it)
+
+    def setWhichEigenpairs(self, which):
+        self._core.set_which_eigenpairs(which)
+
+    def setFromOptions(self):
+        self._core.set_from_options()
+
+    def solve(self):
+        """Collective: rank-0 thread runs the device-mesh eigensolve."""
+        comm = self._comm or _MPI.COMM_WORLD
+
+        def build(_):
+            self._core.solve()
+            return self._core
+
+        self._core = comm._collective("eps_solve", None, build)
+
+    def getConverged(self):
+        return self._core.get_converged()
+
+    def getIterationNumber(self):
+        return self._core.get_iteration_number()
+
+    def getEigenvalue(self, i):
+        return self._core.get_eigenvalue(i)
+
+    def getEigenpair(self, i, vr=None, vi=None):
+        """Non-collective and host-replicated — safe under the reference's
+        rank-0-only call pattern (test2.py:94-96), which would deadlock with
+        real SLEPc (SURVEY.md §3.2)."""
+        return self._core.get_eigenpair(
+            i,
+            vr.core if isinstance(vr, _Vec) else vr,
+            vi.core if isinstance(vi, _Vec) else vi)
+
+    def getErrorEstimate(self, i):
+        return self._core.get_error_estimate(i)
+
+    def destroy(self):
+        return self
+
+    @property
+    def core(self):
+        return self._core

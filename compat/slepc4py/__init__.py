@@ -1,0 +1,3 @@
+"""slepc4py facade package."""
+
+from . import SLEPc  # noqa: F401

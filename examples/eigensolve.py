@@ -1,0 +1,78 @@
+"""Distributed Hermitian eigensolve — the reference test2.py flow, TPU backend.
+
+Driver-equivalent of reference ``test2.py``: rank-0 builds the symmetric
+tridiagonal family, scatters CSR row blocks (typed ``[buf, MPI.INT]`` sends,
+as test2.py:59-61 does), all ranks assemble through the L4 wrapper
+(``petsc_funcs.createPETScMat``) and solve the HEP eigenproblem
+(``petsc_funcs.solveSLEPcEigenvalues``); rank 0 prints the eigenvalues.
+
+Run:  python tools/tpurun.py -n 4 examples/eigensolve.py [-eps_nev 4]
+"""
+
+import numpy as np
+
+from mpi4py import MPI
+
+import petsc_funcs as pet
+
+from mpi_petsc4py_example_tpu.models import tridiag_family
+from mpi_petsc4py_example_tpu.parallel.partition import (
+    row_partition, slice_csr_block)
+from mpi_petsc4py_example_tpu.utils.options import init as options_init
+
+import sys
+
+options_init(sys.argv)
+
+
+def main():
+    comm = MPI.COMM_WORLD
+    rank = comm.Get_rank()
+    nprocs = comm.Get_size()
+
+    if rank == 0:
+        CSR = tridiag_family(100)
+        shape = CSR.shape
+        count, displ = row_partition(shape[0], nprocs)
+
+        for i in range(1, nprocs):
+            rs, re = int(displ[i]), int(displ[i] + count[i])
+            indptr, indices, data = slice_csr_block(
+                CSR.indptr, CSR.indices, CSR.data, rs, re)
+            lengths = {"indptr": len(indptr), "indices": len(indices),
+                       "data": len(data)}
+            comm.send(lengths, dest=i)
+            comm.Send([indptr.astype(np.int32), MPI.INT], dest=i)
+            comm.Send([indices.astype(np.int32), MPI.INT], dest=i)
+            comm.Send([data, MPI.DOUBLE], dest=i)
+
+        rs, re = int(displ[0]), int(displ[0] + count[0])
+        indptr, indices, data = slice_csr_block(CSR.indptr, CSR.indices,
+                                                CSR.data, rs, re)
+    else:
+        lengths = comm.recv(source=0)
+        indptr = np.empty(lengths["indptr"], dtype=np.int32)
+        indices = np.empty(lengths["indices"], dtype=np.int32)
+        data = np.empty(lengths["data"], dtype=np.double)
+        comm.Recv(indptr, source=0)
+        comm.Recv(indices, source=0)
+        comm.Recv(data, source=0)
+        shape = None
+
+    shape = comm.bcast(shape, root=0)
+
+    A = pet.createPETScMat(comm, shape, (indptr, indices, data))
+    E = pet.solveSLEPcEigenvalues(comm, A)
+
+    nconv = E.getConverged()
+    vr, wr = A.getVecs()
+    vi, wi = A.getVecs()
+
+    if rank == 0:
+        for i in range(nconv):
+            k = E.getEigenpair(i, vr, vi)
+            print("Eigenvalue: ", k)
+
+
+if __name__ == "__main__":
+    main()

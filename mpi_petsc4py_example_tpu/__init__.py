@@ -23,6 +23,15 @@ if _os.environ.get("TPU_SOLVE_NO_X64", "0") != "1":
 
     _jax.config.update("jax_enable_x64", True)
 
+# Subprocess-friendly platform override: the axon TPU plugin's sitecustomize
+# overrides the JAX_PLATFORMS env var, so honor our own knob via jax.config
+# (needed by tools/tpurun and tests that spawn drivers on forced-CPU meshes).
+_plat = _os.environ.get("TPU_SOLVE_PLATFORM")
+if _plat:
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _plat)
+
 from .parallel.mesh import DeviceComm, get_default_comm, set_default_comm, as_comm
 from .parallel.partition import (
     RowLayout, row_partition, ownership_range, slice_csr_block,
