@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Benchmark harness — creates the baseline BASELINE.md says doesn't exist.
+
+Headline metric (BASELINE.json): KSP iterations/second and time-to-rtol=1e-6
+for CG on the 3D 7-point Poisson operator, with residual parity vs a CPU
+oracle. The TPU path runs the matrix-free stencil operator (fp32, Jacobi-CG,
+one jit-compiled program); the baseline is scipy.sparse.linalg.cg (fp64 CPU)
+on the identical problem and tolerance — the stand-in for 8-rank PETSc KSPCG
+(petsc4py is not installable here; scipy is the only CPU oracle, SURVEY.md §4).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": iters_per_sec, "unit": "iters/s",
+   "vs_baseline": cpu_time / tpu_time}
+
+Usage: python bench.py [--quick] [--n NX] [--rtol R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def tpu_solve(nx: int, rtol: float):
+    """CG+Jacobi on matrix-free stencil Poisson; returns (iters, wall, x)."""
+    import jax.numpy as jnp
+
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+
+    comm = tps.DeviceComm()
+    # nz must divide the device count; nx is chosen accordingly by main()
+    op = StencilPoisson3D(comm, nx, dtype=jnp.float32)
+    n = nx ** 3
+    rng = np.random.default_rng(7)
+    x_true = rng.random(n).astype(np.float32)
+    b = np.asarray(op.mult(tps.Vec.from_global(comm, x_true)).to_numpy())
+
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(op)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=20000)
+
+    x, bv = op.get_vecs()
+    bv.set_global(b)
+    ksp.solve(bv, x)          # warm-up: compiles the program
+    x.zero()
+    t0 = time.perf_counter()
+    res = ksp.solve(bv, x)
+    wall = time.perf_counter() - t0
+    return res.iterations, wall, x.to_numpy(), b, res
+
+
+def cpu_baseline(nx: int, b: np.ndarray, rtol: float):
+    """scipy fp64 CG on the identical operator/tolerance."""
+    import scipy.sparse.linalg as spla
+
+    from mpi_petsc4py_example_tpu.models import poisson3d_csr
+
+    A = poisson3d_csr(nx).astype(np.float64)
+    bb = b.astype(np.float64)
+    iters = [0]
+
+    def cb(_):
+        iters[0] += 1
+
+    # Jacobi preconditioning to match the TPU configuration (diag = 6)
+    M = spla.LinearOperator(A.shape, matvec=lambda v: v / 6.0)
+    t0 = time.perf_counter()
+    x, info = spla.cg(A, bb, rtol=rtol, atol=0.0, maxiter=20000,
+                      M=M, callback=cb)
+    wall = time.perf_counter() - t0
+    return iters[0], wall, x, A
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small problem for smoke testing")
+    ap.add_argument("--n", type=int, default=None,
+                    help="grid points per dimension (default 128; quick 32)")
+    ap.add_argument("--rtol", type=float, default=1e-6)
+    opts = ap.parse_args()
+    nx = opts.n or (32 if opts.quick else 128)
+
+    import jax
+
+    ndev = len(jax.devices())
+    # stencil sharding needs nz % ndev == 0
+    if nx % ndev != 0:
+        nx = ((nx + ndev - 1) // ndev) * ndev
+
+    iters, wall, x_tpu, b, res = tpu_solve(nx, opts.rtol)
+
+    cpu_iters, cpu_wall, x_cpu, A = cpu_baseline(nx, b, opts.rtol)
+
+    # residual parity check in fp64 on host
+    r_tpu = np.linalg.norm(b.astype(np.float64) - A @ x_tpu.astype(np.float64))
+    r_cpu = np.linalg.norm(b.astype(np.float64) - A @ x_cpu)
+    bnorm = np.linalg.norm(b.astype(np.float64))
+    parity = bool(r_tpu <= 10 * max(r_cpu, opts.rtol * bnorm))
+
+    iters_per_sec = iters / wall if wall > 0 else 0.0
+    line = {
+        "metric": f"CG+Jacobi iters/sec, 3D Poisson {nx}^3 "
+                  f"({nx**3:,} DoF), time-to-rtol={opts.rtol:g}",
+        "value": round(iters_per_sec, 2),
+        "unit": "iters/s",
+        "vs_baseline": round(cpu_wall / wall, 3) if wall > 0 else 0.0,
+        "extra": {
+            "tpu_wall_s": round(wall, 4), "tpu_iters": iters,
+            "cpu_wall_s": round(cpu_wall, 4), "cpu_iters": cpu_iters,
+            "rel_residual_tpu": float(r_tpu / bnorm),
+            "rel_residual_cpu": float(r_cpu / bnorm),
+            "residual_parity": parity,
+            "devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

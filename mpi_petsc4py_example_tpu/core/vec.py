@@ -104,7 +104,10 @@ class Vec:
         return float(jnp.vdot(self.data, other.data))
 
     def zero(self):
-        self.data = jnp.zeros_like(self.data)
+        # host-side zeros + async device_put: avoids an eager device
+        # computation (which costs a compile + round trip on remote TPUs)
+        self.data = self.comm.put_rows(
+            np.zeros(self.data.shape[0], dtype=self.data.dtype))
 
     def __len__(self):
         return self.n

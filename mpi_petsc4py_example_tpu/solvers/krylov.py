@@ -301,7 +301,8 @@ def _monitor_trampoline(dev, k, rn):
 
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
-                      restart: int = 30, monitored: bool = False):
+                      restart: int = 30, monitored: bool = False,
+                      zero_guess: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -320,7 +321,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     n = operator.shape[0]
     dtype = operator.dtype
     key = (comm.mesh, axis, ksp_type, pc.kind, n, str(dtype), restart,
-           monitored, operator.program_key())
+           monitored, zero_guess, operator.program_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -337,6 +338,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                                k, rn)
 
     def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+        if zero_guess:
+            x0 = jnp.zeros_like(b)
         A = lambda v: spmv_local(op_arrays, v)
         M = lambda r: pc_apply(pc_arrays, r)
         pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -180,17 +181,21 @@ class KSP:
 
         prog = build_ksp_program(comm, self._type, pc, mat,
                                  restart=self.restart,
-                                 monitored=monitor_cb is not None)
-        x0 = x.data if self._initial_guess_nonzero else jnp.zeros_like(x.data)
-        dt = mat.dtype
+                                 monitored=monitor_cb is not None,
+                                 zero_guess=not self._initial_guess_nonzero)
+        # host scalars travel with the execute call — no extra device
+        # round-trips (the remote-TPU dispatch latency is ~100ms each)
+        dt = np.dtype(mat.dtype)
         set_current_monitor(monitor_cb)
         t0 = time.perf_counter()
         try:
             xd, iters, rnorm, reason = prog(
-                mat.device_arrays(), pc.device_arrays(), b.data, x0,
-                jnp.asarray(self.rtol, dt), jnp.asarray(self.atol, dt),
-                jnp.asarray(self.max_it, jnp.int32))
-            xd.block_until_ready()
+                mat.device_arrays(), pc.device_arrays(), b.data, x.data,
+                dt.type(self.rtol), dt.type(self.atol),
+                np.int32(self.max_it))
+            # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
+            # int()/float() per scalar would pay it three times)
+            iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
         finally:
             set_current_monitor(None)
         wall = time.perf_counter() - t0
