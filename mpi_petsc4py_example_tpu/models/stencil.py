@@ -68,6 +68,8 @@ class StencilPoisson3D:
         axis = comm.axis
         nx, ny, lz = self.nx, self.ny, self.lz
         ndev = comm.size
+        from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
+        use_pallas = pallas_supported(ny, nx, self._dtype)
 
         def spmv(op_local, x_local):
             u = x_local.reshape(lz, ny, nx)
@@ -82,16 +84,19 @@ class StencilPoisson3D:
             halo_lo = jnp.where(i == 0, zero_plane, up)        # plane z-1
             halo_hi = jnp.where(i == ndev - 1, zero_plane, down)  # plane z+lz
             ext = jnp.concatenate([halo_lo[None], u, halo_hi[None]], axis=0)
-            # 7-point stencil, all shifts on the VPU; boundaries in x/y get
-            # zero neighbours via the padded roll-free slicing below
-            center = 6.0 * u
-            zm = ext[:-2]          # z-1
-            zp = ext[2:]           # z+1
-            ym = jnp.pad(u[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
-            yp = jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
-            xm = jnp.pad(u[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
-            xp = jnp.pad(u[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
-            y = center - zm - zp - ym - yp - xm - xp
+            if use_pallas:
+                y = stencil3d_apply_pallas(ext, lz, ny, nx)
+            else:
+                # pure-jnp fallback: shifts on the VPU; x/y boundaries get
+                # zero neighbours from the pads
+                center = 6.0 * u
+                zm = ext[:-2]          # z-1
+                zp = ext[2:]           # z+1
+                ym = jnp.pad(u[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
+                yp = jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
+                xm = jnp.pad(u[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+                xp = jnp.pad(u[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+                y = center - zm - zp - ym - yp - xm - xp
             return y.reshape(lz * ny * nx)
 
         return spmv

@@ -1,0 +1,110 @@
+"""Pallas TPU kernel for the 7-point Poisson stencil apply.
+
+The stencil SpMV is the framework's hot op (every CG iteration, BASELINE
+configs 1/5). The jnp formulation materializes six padded temporaries per
+apply (~6 extra HBM passes); this kernel streams the extended slab
+HBM → VMEM in z-chunks with async DMA and computes the full stencil in one
+VMEM-resident pass, so HBM traffic is ~(read + write) of the slab only.
+
+Layout contract (matches models.stencil.StencilPoisson3D): the local slab is
+``(lz, ny, nx)`` x-fastest; the caller prepends/appends one halo plane
+(already exchanged over ICI via ``ppermute``), passing ``ext`` of shape
+``(lz+2, ny, nx)``. Dirichlet boundaries in x/y are realized by shifting
+with zero fill inside the kernel; z-boundaries by the caller's zero halos.
+
+Falls back to the pure-jnp path on non-TPU backends (models/stencil.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _shift_x(u, step):
+    """u shifted along the last (x) axis with zero fill."""
+    if step == -1:
+        return jnp.pad(u[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+    return jnp.pad(u[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+
+
+def _shift_y(u, step):
+    if step == -1:
+        return jnp.pad(u[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
+    return jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
+
+
+def _stencil_kernel(ext_ref, out_ref, chunk, nchunks):
+    """Grid-free kernel: fori over z-chunks, manual DMA HBM→VMEM→HBM."""
+    lz = out_ref.shape[0]
+    ny, nx = out_ref.shape[1], out_ref.shape[2]
+
+    # All index/constant dtypes are pinned to i32/f32 explicitly: with x64
+    # enabled, bare Python literals trace as i64/f64, which Mosaic's
+    # lowering cannot convert (infinite recursion in _convert_helper).
+    def process(scratch, osc, sem_in, sem_out):
+        six = jnp.asarray(6.0, out_ref.dtype)
+
+        def body(c, carry):
+            z0 = c * jnp.int32(chunk)
+            din = pltpu.make_async_copy(
+                ext_ref.at[pl.ds(z0, chunk + 2)], scratch, sem_in)
+            din.start()
+            din.wait()
+            u = scratch[1:-1]          # (chunk, ny, nx) center planes
+            zm = scratch[:-2]
+            zp = scratch[2:]
+            y = (six * u - zm - zp
+                 - _shift_y(u, -1) - _shift_y(u, +1)
+                 - _shift_x(u, -1) - _shift_x(u, +1))
+            osc[:] = y
+            dout = pltpu.make_async_copy(
+                osc, out_ref.at[pl.ds(z0, chunk)], sem_out)
+            dout.start()
+            dout.wait()
+            return carry
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                          jnp.int32(0))
+
+    pl.run_scoped(
+        process,
+        pltpu.VMEM((chunk + 2, ny, nx), out_ref.dtype),
+        pltpu.VMEM((chunk, ny, nx), out_ref.dtype),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA(()),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def stencil3d_apply_pallas(ext, lz: int, ny: int, nx: int):
+    """Apply the 7-point stencil to ``ext`` of shape ``(lz+2, ny, nx)``.
+
+    Returns the (lz, ny, nx) result. ``ext`` includes the two halo planes.
+    """
+    # pick a z-chunk that divides lz and keeps ~<=4MB in VMEM per buffer
+    budget = (4 << 20) // (ny * nx * ext.dtype.itemsize)
+    chunk = max(1, min(lz, budget))
+    while lz % chunk:
+        chunk -= 1
+    nchunks = lz // chunk
+    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), ext.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+    )(ext)
+
+
+def pallas_supported(ny: int, nx: int, dtype) -> bool:
+    """The kernel wants full (8,128)-tileable planes and a TPU backend."""
+    if jax.default_backend() != "tpu":
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),):
+        return False
+    return nx % 128 == 0 and ny % 8 == 0
