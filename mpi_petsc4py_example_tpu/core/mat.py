@@ -62,13 +62,28 @@ class Mat:
 
     @classmethod
     def from_csr(cls, comm, size, csr, dtype=jnp.float64) -> "Mat":
-        """Build from a *global* host CSR triple."""
+        """Build from a *global* host CSR triple.
+
+        Validation and the CSR->ELL layout conversion run through the native
+        C++ toolkit (native/csrkit.cpp) when available — the role PETSc's C
+        MatAssembly plays — with a vectorized-numpy fallback.
+        """
+        from ..utils import native
         comm = as_comm(comm)
         nrows, ncols = int(size[0]), int(size[1])
         indptr = np.asarray(csr[0], dtype=np.int64)
         indices = np.asarray(csr[1], dtype=np.int32)
         data = np.asarray(csr[2], dtype=dtype)
-        cols, vals = csr_to_ell(indptr, indices, data)
+        err = native.csr_validate(indptr, indices, ncols)
+        if err != 0:
+            reasons = {-1: "indptr[0] != 0", -2: "indptr not monotone",
+                       -3: "indptr[-1] != nnz", -4: "column index out of range"}
+            raise ValueError(f"malformed CSR: {reasons.get(err, err)}")
+        if native.available() and len(data) > 1_000_000:
+            cols, vals = native.csr_to_ell_native(indptr, indices, data)
+            vals = vals.astype(dtype, copy=False)
+        else:
+            cols, vals = csr_to_ell(indptr, indices, data)
         cols = comm.put_rows(cols)
         vals = comm.put_rows(vals)
         m = cls(comm, (nrows, ncols), cols, vals,

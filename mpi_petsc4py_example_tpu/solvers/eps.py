@@ -294,6 +294,9 @@ class EPS:
         wall = time.perf_counter() - t0
         self.result = SolveResult(restarts, float(rel[0]) if len(rel) else 0.0,
                                   2 if self._nconv >= self.nev else -3, wall)
+        from ..utils.profiling import record_event
+        record_event(f"EPSSolve({self._problem_type},nev={self.nev})", n,
+                     restarts, wall, self.result.reason)
         return self
 
     # ---- results (slepc4py-shaped, collective-safe) --------------------------

@@ -201,6 +201,9 @@ class KSP:
         wall = time.perf_counter() - t0
         x.data = xd
         self.result = SolveResult(int(iters), float(rnorm), int(reason), wall)
+        from ..utils.profiling import record_event
+        record_event(f"KSPSolve({self._type}+{pc.get_type()})", mat.shape[0],
+                     self.result.iterations, wall, self.result.reason)
         return self.result
 
     # ---- introspection (petsc4py-shaped) ------------------------------------

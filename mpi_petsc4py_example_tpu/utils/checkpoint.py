@@ -1,0 +1,71 @@
+"""Checkpoint / resume of distributed objects (SURVEY.md §5.4).
+
+The reference persists nothing (solutions are printed and compared in
+memory); for long-running iterative solves the framework offers ``.npz``
+save/load of Mat/Vec state. Shard layout is reconstructed from the target
+communicator at load time, so a checkpoint written on one mesh size restores
+cleanly onto another (the elastic-restart story: deterministic restart from
+persisted operator + best iterate).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.mat import Mat
+from ..core.vec import Vec
+from ..parallel.mesh import as_comm
+
+
+def save_vec(path: str, vec: Vec):
+    np.savez_compressed(path, kind="vec", n=vec.n,
+                        data=vec.to_numpy())
+
+
+def load_vec(path: str, comm=None) -> Vec:
+    comm = as_comm(comm)
+    with np.load(path) as z:
+        assert str(z["kind"]) == "vec", "not a Vec checkpoint"
+        return Vec.from_global(comm, z["data"])
+
+
+def save_mat(path: str, mat: Mat):
+    """Persist as CSR (portable, layout-independent)."""
+    A = mat.to_scipy().tocsr()
+    np.savez_compressed(path, kind="mat", shape=np.asarray(mat.shape),
+                        indptr=A.indptr, indices=A.indices, data=A.data,
+                        dtype=str(np.dtype(mat.dtype)))
+
+
+def load_mat(path: str, comm=None) -> Mat:
+    comm = as_comm(comm)
+    with np.load(path) as z:
+        assert str(z["kind"]) == "mat", "not a Mat checkpoint"
+        shape = tuple(int(s) for s in z["shape"])
+        return Mat.from_csr(comm, shape,
+                            (z["indptr"], z["indices"], z["data"]),
+                            dtype=np.dtype(str(z["dtype"])))
+
+
+def save_solve_state(path: str, mat: Mat, x: Vec, b: Vec, iteration: int = 0):
+    """One-file checkpoint of an in-progress solve (operator, iterate, rhs)."""
+    A = mat.to_scipy().tocsr()
+    np.savez_compressed(path, kind="solve_state",
+                        shape=np.asarray(mat.shape), indptr=A.indptr,
+                        indices=A.indices, data=A.data,
+                        dtype=str(np.dtype(mat.dtype)),
+                        x=x.to_numpy(), b=b.to_numpy(),
+                        iteration=iteration)
+
+
+def load_solve_state(path: str, comm=None):
+    comm = as_comm(comm)
+    with np.load(path) as z:
+        assert str(z["kind"]) == "solve_state", "not a solve-state checkpoint"
+        shape = tuple(int(s) for s in z["shape"])
+        mat = Mat.from_csr(comm, shape,
+                           (z["indptr"], z["indices"], z["data"]),
+                           dtype=np.dtype(str(z["dtype"])))
+        x = Vec.from_global(comm, z["x"], dtype=mat.dtype)
+        b = Vec.from_global(comm, z["b"], dtype=mat.dtype)
+        return mat, x, b, int(z["iteration"])

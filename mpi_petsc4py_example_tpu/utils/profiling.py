@@ -1,0 +1,103 @@
+"""Profiling and observability (SURVEY.md §5.1/§5.5).
+
+The reference reaches PETSc's ``-log_view`` / ``-ksp_monitor`` machinery
+through the options DB [external]; equivalents here:
+
+* per-iteration residual monitors — ``KSP.set_monitor`` / ``-ksp_monitor``
+  (solvers/ksp.py), driven by ``jax.debug.callback`` from inside the
+  compiled loop;
+* a solve-event log — every KSP/EPS solve records (solver, n, iterations,
+  wall, reason); ``log_view()`` prints the PETSc-``-log_view``-style summary,
+  automatically at exit when ``-log_view`` is set;
+* device tracing — :func:`trace` wraps ``jax.profiler.trace`` so a solve can
+  be captured for TensorBoard/XProf (``-tpu_profile <dir>``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import sys
+import time
+from dataclasses import dataclass, field
+
+from .options import global_options
+
+
+@dataclass
+class SolveEvent:
+    what: str          # e.g. "KSPSolve(cg+jacobi)"
+    n: int
+    iterations: int
+    wall: float
+    reason: int
+
+
+_EVENTS: list[SolveEvent] = []
+_atexit_armed = False
+
+
+def record_event(what: str, n: int, iterations: int, wall: float,
+                 reason: int):
+    global _atexit_armed
+    _EVENTS.append(SolveEvent(what, n, iterations, wall, reason))
+    if not _atexit_armed and global_options().get_bool("log_view", False):
+        _atexit_armed = True
+        atexit.register(log_view)
+
+
+def events() -> list[SolveEvent]:
+    return list(_EVENTS)
+
+
+def clear_events():
+    _EVENTS.clear()
+
+
+def log_view(file=None):
+    """Print the accumulated solve log, -log_view style."""
+    file = file or sys.stderr
+    if not _EVENTS:
+        print("log_view: no solve events recorded", file=file)
+        return
+    total = sum(e.wall for e in _EVENTS)
+    print("-" * 72, file=file)
+    print(f"{'event':32s} {'n':>10s} {'iters':>6s} {'wall (s)':>10s} "
+          f"{'it/s':>8s}", file=file)
+    print("-" * 72, file=file)
+    for e in _EVENTS:
+        its = e.iterations / e.wall if e.wall > 0 else 0.0
+        print(f"{e.what:32s} {e.n:10d} {e.iterations:6d} {e.wall:10.4f} "
+              f"{its:8.1f}", file=file)
+    print("-" * 72, file=file)
+    print(f"{len(_EVENTS)} solve(s), total wall {total:.4f} s", file=file)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace of the enclosed block (XProf/TensorBoard)."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in device traces."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class Timer:
+    """Simple wall-clock timer used by the bench harness."""
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0
+        return False

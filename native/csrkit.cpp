@@ -1,0 +1,110 @@
+// csrkit — native CSR toolkit for the TPU sparse framework.
+//
+// The reference's matrix assembly/distribution path is PETSc C code
+// (MatCreateAIJ + MatAssembly, SURVEY.md N1) driven by hand-rolled Python
+// slicing (test.py:83-117). Here the host-side data path — CSR validation,
+// row-block slicing with indptr rebasing, CSR->ELL device-layout conversion,
+// diagonal extraction — is native C++ behind a C ABI (ctypes), so assembling
+// a 100M-row operator doesn't bottleneck in the Python interpreter. The
+// Python layer (utils/native.py) compiles this on demand and falls back to
+// vectorized numpy when no toolchain is available.
+//
+// All functions use int64 indptr, int32 column indices (sufficient to 100M
+// DoF — matches the reference's int32 CSR indices, test.py:123-124) and
+// float64 values; conversion to f32 happens on device_put.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// Validate a CSR triple: monotone indptr, in-range column indices.
+// Returns 0 on success, a negative error code otherwise.
+int csr_validate(const int64_t* indptr, int64_t nrows,
+                 const int32_t* indices, int64_t nnz, int64_t ncols) {
+    if (indptr[0] != 0) return -1;
+    for (int64_t i = 0; i < nrows; ++i) {
+        if (indptr[i + 1] < indptr[i]) return -2;
+    }
+    if (indptr[nrows] != nnz) return -3;
+    for (int64_t k = 0; k < nnz; ++k) {
+        if (indices[k] < 0 || indices[k] >= ncols) return -4;
+    }
+    return 0;
+}
+
+// Max nonzeros per row (the ELL width K).
+int64_t csr_max_row_nnz(const int64_t* indptr, int64_t nrows) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < nrows; ++i)
+        k = std::max(k, indptr[i + 1] - indptr[i]);
+    return k;
+}
+
+// CSR -> ELL: cols/vals are (nrows_pad, K) row-major, pre-zeroed by caller.
+// Rows beyond nrows stay empty (padding rows of the device layout).
+void csr_to_ell(const int64_t* indptr, const int32_t* indices,
+                const double* data, int64_t nrows, int64_t K,
+                int32_t* ell_cols, double* ell_vals) {
+    for (int64_t i = 0; i < nrows; ++i) {
+        const int64_t start = indptr[i], end = indptr[i + 1];
+        int32_t* crow = ell_cols + i * K;
+        double* vrow = ell_vals + i * K;
+        for (int64_t p = start; p < end; ++p) {
+            crow[p - start] = indices[p];
+            vrow[p - start] = data[p];
+        }
+    }
+}
+
+// Slice rows [rstart, rend) into a rebased local block.
+// local_indptr has rend-rstart+1 entries; local_indices/local_data hold
+// indptr[rend]-indptr[rstart] entries (caller allocates from those bounds).
+void csr_slice_rows(const int64_t* indptr, const int32_t* indices,
+                    const double* data, int64_t rstart, int64_t rend,
+                    int64_t* local_indptr, int32_t* local_indices,
+                    double* local_data) {
+    const int64_t p0 = indptr[rstart];
+    for (int64_t i = rstart; i <= rend; ++i)
+        local_indptr[i - rstart] = indptr[i] - p0;
+    const int64_t nnz = indptr[rend] - p0;
+    std::memcpy(local_indices, indices + p0, nnz * sizeof(int32_t));
+    std::memcpy(local_data, data + p0, nnz * sizeof(double));
+}
+
+// Extract the matrix diagonal (missing diagonal entries stay 0).
+void csr_diagonal(const int64_t* indptr, const int32_t* indices,
+                  const double* data, int64_t nrows, double* diag) {
+    for (int64_t i = 0; i < nrows; ++i) {
+        diag[i] = 0.0;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+            if (indices[p] == i) { diag[i] = data[p]; break; }
+        }
+    }
+}
+
+// Row L1 norms (for diagnostics / Jacobi-style scaling).
+void csr_row_norms1(const int64_t* indptr, const double* data,
+                    int64_t nrows, double* norms) {
+    for (int64_t i = 0; i < nrows; ++i) {
+        double s = 0.0;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p)
+            s += data[p] < 0 ? -data[p] : data[p];
+        norms[i] = s;
+    }
+}
+
+// Reference SpMV (oracle/debug; the production SpMV runs on TPU).
+void csr_spmv(const int64_t* indptr, const int32_t* indices,
+              const double* data, int64_t nrows, const double* x,
+              double* y) {
+    for (int64_t i = 0; i < nrows; ++i) {
+        double s = 0.0;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p)
+            s += data[p] * x[indices[p]];
+        y[i] = s;
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Auxiliary subsystems: checkpoint/resume, solve-event log, options DB."""
+
+import io
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.utils import checkpoint, profiling
+from mpi_petsc4py_example_tpu.utils.options import Options
+
+
+class TestCheckpoint:
+    def test_vec_roundtrip(self, comm8, tmp_path):
+        v = tps.Vec.from_global(comm8, np.arange(37.0))
+        p = str(tmp_path / "v.npz")
+        checkpoint.save_vec(p, v)
+        v2 = checkpoint.load_vec(p, comm8)
+        np.testing.assert_array_equal(v2.to_numpy(), v.to_numpy())
+
+    def test_mat_roundtrip_across_mesh_sizes(self, comm8, comm1, tmp_path):
+        A = poisson2d_csr(7)
+        M = tps.Mat.from_scipy(comm8, A)
+        p = str(tmp_path / "m.npz")
+        checkpoint.save_mat(p, M)
+        M2 = checkpoint.load_mat(p, comm1)  # restore on a different mesh
+        assert (M2.to_scipy() != A).nnz == 0
+
+    def test_solve_state_resume(self, comm8, tmp_path):
+        """Interrupt a solve, checkpoint, restore, continue to convergence."""
+        A = poisson2d_csr(10)
+        x_true = np.random.default_rng(0).random(100)
+        b = A @ x_true
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-12, max_it=5)  # "interrupted" early
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        p = str(tmp_path / "state.npz")
+        checkpoint.save_solve_state(p, M, x, bv,
+                                    iteration=ksp.get_iteration_number())
+        M2, x2, b2, it0 = checkpoint.load_solve_state(p, comm8)
+        assert it0 == 5
+        ksp2 = tps.KSP().create(comm8)
+        ksp2.set_operators(M2)
+        ksp2.set_type("cg")
+        ksp2.set_tolerances(rtol=1e-10, max_it=1000)
+        ksp2.set_initial_guess_nonzero(True)  # resume from the iterate
+        res = ksp2.solve(b2, x2)
+        assert res.converged
+        np.testing.assert_allclose(x2.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
+
+
+class TestLogView:
+    def test_events_recorded_and_printed(self, comm8):
+        profiling.clear_events()
+        A = poisson2d_csr(6)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(36))
+        ksp.solve(b, x)
+        evs = profiling.events()
+        assert any(e.what.startswith("KSPSolve(cg") for e in evs)
+        buf = io.StringIO()
+        profiling.log_view(file=buf)
+        out = buf.getvalue()
+        assert "KSPSolve(cg+none)" in out
+        assert "solve(s), total wall" in out
+
+
+class TestOptionsParsing:
+    def test_negative_numeric_values(self):
+        o = Options()
+        o.parse_argv(["prog", "-ksp_atol", "-1e-12", "-shift", "-3"])
+        assert o.get_real("ksp_atol") == -1e-12
+        assert o.get_int("shift") == -3
+
+    def test_boolean_flags(self):
+        o = Options()
+        o.parse_argv(["prog", "-ksp_monitor", "-ksp_type", "cg"])
+        assert o.get_bool("ksp_monitor") is True
+        assert o.get_string("ksp_type") == "cg"
+
+    def test_env_seeding(self, monkeypatch):
+        monkeypatch.setenv("TPU_SOLVE_KSP_TYPE", "bcgs")
+        o = Options()
+        assert o.get_string("ksp_type") == "bcgs"

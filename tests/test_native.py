@@ -1,0 +1,78 @@
+"""Native C++ CSR toolkit: compile, parity vs numpy paths, validation."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.ops.spmv import csr_to_ell
+from mpi_petsc4py_example_tpu.parallel.partition import slice_csr_block
+from mpi_petsc4py_example_tpu.utils import native
+
+
+def rand_csr(n=200, density=0.05, seed=3):
+    rng = np.random.default_rng(seed)
+    return sp.random(n, n, density=density, format="csr",
+                     random_state=rng) + sp.eye(n, format="csr")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("no C++ toolchain available")
+    return lib
+
+
+class TestNative:
+    def test_compiles(self, lib):
+        assert native.available()
+
+    def test_validate_good(self, lib):
+        A = rand_csr().tocsr()
+        assert native.csr_validate(A.indptr, A.indices, A.shape[1]) == 0
+
+    def test_validate_bad_column(self, lib):
+        indptr = np.array([0, 1, 2])
+        indices = np.array([0, 99], dtype=np.int32)  # out of range for n=2
+        assert native.csr_validate(indptr, indices, 2) == -4
+
+    def test_validate_bad_indptr(self, lib):
+        indptr = np.array([0, 3, 2])
+        indices = np.array([0, 1, 0], dtype=np.int32)
+        assert native.csr_validate(indptr, indices, 2) == -2
+
+    def test_ell_parity_with_numpy(self, lib):
+        A = rand_csr().tocsr()
+        c1, v1 = native.csr_to_ell_native(A.indptr, A.indices, A.data)
+        c2, v2 = csr_to_ell(A.indptr, A.indices, A.data)
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(v1, v2)
+
+    def test_slice_parity_with_numpy(self, lib):
+        A = rand_csr().tocsr()
+        a = native.csr_slice_rows_native(A.indptr, A.indices, A.data, 50, 120)
+        b = slice_csr_block(A.indptr, A.indices, A.data, 50, 120)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_diagonal_parity(self, lib):
+        A = rand_csr().tocsr()
+        d = native.csr_diagonal_native(A.indptr, A.indices, A.data,
+                                       A.shape[0])
+        np.testing.assert_allclose(d, A.diagonal())
+
+    def test_spmv_oracle(self, lib):
+        A = rand_csr().tocsr()
+        x = np.random.default_rng(0).random(A.shape[0])
+        np.testing.assert_allclose(
+            native.csr_spmv_native(A.indptr, A.indices, A.data, x), A @ x)
+
+
+class TestMatUsesValidation:
+    def test_malformed_csr_rejected(self, comm1):
+        indptr = np.array([0, 2, 3])
+        indices = np.array([0, 7, 1], dtype=np.int32)  # col 7 out of range
+        data = np.ones(3)
+        with pytest.raises(ValueError, match="malformed CSR"):
+            tps.Mat.from_csr(comm1, (2, 3), (indptr, indices, data))
