@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Run all five BASELINE.json benchmark configs and emit JSON results.
+
+Usage: python benchmarks/run_all.py [--quick] [--out results.json]
+
+Configs (BASELINE.json `configs`):
+  1. AIJ Laplacian assembly + KSPCG/PCNONE solve (the test.py-shaped flow)
+  2. multi-rank scatter + distributed solve (test2.py-shaped, tpurun -n 4)
+  3. KSPGMRES + PCJACOBI on 2D 5-point Poisson
+  4. KSPBCGS + block-Jacobi on unsymmetric convection-diffusion
+  5. 3D 7-point Poisson, row-sharded stencil across the device mesh
+
+CPU baselines use scipy (fp64) where a matching algorithm exists; scipy is
+the only CPU oracle available (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import scipy.sparse.linalg as spla
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import (
+    StencilPoisson3D, convdiff2d, poisson2d_csr, poisson3d_csr,
+    poisson3d_ell, tridiag_family)
+
+
+def solve(comm, op, b, ksp_type, pc_type, rtol=1e-6, max_it=20000,
+          restart=30):
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(op)
+    ksp.set_type(ksp_type)
+    ksp.get_pc().set_type(pc_type)
+    ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=max_it)
+    ksp.restart = restart
+    x, bv = op.get_vecs()
+    bv.set_global(b)
+    ksp.solve(bv, x)          # warm-up / compile
+    x.zero()
+    t0 = time.perf_counter()
+    res = ksp.solve(bv, x)
+    wall = time.perf_counter() - t0
+    return x.to_numpy(), res, wall
+
+
+def manufactured(A, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    x = rng.random(A.shape[0]).astype(dtype)
+    return x, (A @ x).astype(dtype)
+
+
+def config1(comm, quick):
+    """AIJ Laplacian assembly + KSPCG, PCNONE."""
+    nx = 24 if quick else 64
+    t0 = time.perf_counter()
+    A = poisson3d_csr(nx)
+    M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
+    assembly = time.perf_counter() - t0
+    x_true, b = manufactured(A, dtype=np.float32)
+    x, res, wall = solve(comm, M, b, "cg", "none")
+    t0 = time.perf_counter()
+    x_cpu, _ = spla.cg(A, b.astype(np.float64), rtol=1e-6, atol=0.0)
+    cpu = time.perf_counter() - t0
+    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
+    return dict(config="cfg1_aij_assembly_cg_none", n=nx ** 3,
+                assembly_s=round(assembly, 4), iters=res.iterations,
+                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
+                speedup=round(cpu / wall, 2), rel_residual=float(rres))
+
+
+def config2(quick):
+    """Multi-rank scatter + distributed solve: eigensolve driver, -n 4."""
+    env = dict(os.environ)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "tpurun.py"),
+           "-n", "4", os.path.join(REPO, "examples", "eigensolve.py")]
+    t0 = time.perf_counter()
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       timeout=900, cwd=REPO)
+    wall = time.perf_counter() - t0
+    ok = r.returncode == 0 and "Eigenvalue:" in r.stdout
+    return dict(config="cfg2_multirank_scatter_eigensolve_n4", n=100,
+                wall_s=round(wall, 4), ok=bool(ok))
+
+
+def config3(comm, quick):
+    """KSPGMRES + PCJACOBI on 2D 5-point Poisson."""
+    nx = 48 if quick else 512
+    A = poisson2d_csr(nx)
+    x_true, b = manufactured(A, dtype=np.float32)
+    M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
+    x, res, wall = solve(comm, M, b, "gmres", "jacobi", max_it=40000)
+    t0 = time.perf_counter()
+    Mj = spla.LinearOperator(A.shape, matvec=lambda v: v / A.diagonal())
+    x_cpu, _ = spla.gmres(A, b.astype(np.float64), rtol=1e-6, atol=0.0,
+                          restart=30, M=Mj)
+    cpu = time.perf_counter() - t0
+    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
+    return dict(config="cfg3_gmres_jacobi_poisson2d", n=nx * nx,
+                iters=res.iterations, wall_s=round(wall, 4),
+                cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
+                rel_residual=float(rres))
+
+
+def config4(comm, quick):
+    """KSPBCGS + block-Jacobi on unsymmetric convection-diffusion."""
+    nx = 40 if quick else 256
+    A = convdiff2d(nx, beta=0.4)
+    x_true, b = manufactured(A, dtype=np.float32)
+    M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
+    x, res, wall = solve(comm, M, b, "bcgs", "bjacobi")
+    t0 = time.perf_counter()
+    ilu = spla.spilu(A.tocsc())
+    Mi = spla.LinearOperator(A.shape, matvec=ilu.solve)
+    x_cpu, _ = spla.bicgstab(A, b.astype(np.float64), rtol=1e-6, atol=0.0,
+                             M=Mi)
+    cpu = time.perf_counter() - t0
+    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
+    return dict(config="cfg4_bcgs_bjacobi_convdiff", n=nx * nx,
+                iters=res.iterations, wall_s=round(wall, 4),
+                cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
+                rel_residual=float(rres))
+
+
+def config5(comm, quick):
+    """3D 7-point Poisson, row-sharded stencil across the mesh.
+
+    The BASELINE target is 100M DoF on v5e-8; sized to the available mesh
+    (single dev chamber: 256^3 = 16.8M DoF)."""
+    import jax
+    import jax.numpy as jnp
+
+    nx = 32 if quick else 256
+    ndev = comm.size
+    if nx % ndev:
+        nx = ((nx + ndev - 1) // ndev) * ndev
+    op = StencilPoisson3D(comm, nx, dtype=jnp.float32)
+    n = nx ** 3
+    rng = np.random.default_rng(5)
+    x_true = rng.random(n).astype(np.float32)
+    b = np.asarray(op.mult(tps.Vec.from_global(comm, x_true)).to_numpy())
+    x, res, wall = solve(comm, op, b, "cg", "jacobi")
+    # residual via the operator itself (no 16M-row scipy materialization)
+    r = b - np.asarray(op.mult(tps.Vec.from_global(comm, x)).to_numpy())
+    rres = float(np.linalg.norm(r) / np.linalg.norm(b))
+    return dict(config="cfg5_poisson3d_sharded_stencil", n=n,
+                devices=ndev, iters=res.iterations, wall_s=round(wall, 4),
+                iters_per_s=round(res.iterations / wall, 1),
+                rel_residual=rres)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    opts = ap.parse_args()
+
+    import jax
+
+    comm = tps.DeviceComm()
+    results = {"platform": jax.devices()[0].platform,
+               "devices": len(jax.devices()), "configs": []}
+    for fn in (lambda: config1(comm, opts.quick),
+               lambda: config2(opts.quick),
+               lambda: config3(comm, opts.quick),
+               lambda: config4(comm, opts.quick),
+               lambda: config5(comm, opts.quick)):
+        try:
+            r = fn()
+        except Exception as e:  # noqa: BLE001 — record per-config failures
+            r = dict(config=fn.__name__, error=repr(e))
+        results["configs"].append(r)
+        print(json.dumps(r))
+    if opts.out:
+        with open(opts.out, "w") as f:
+            json.dump(results, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
