@@ -13,11 +13,14 @@ CSR copy is retained when available for preconditioner factorizations
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.spmv import csr_to_ell, csr_diag, ell_spmv_local
+from ..ops.spmv import (csr_diag, csr_find_diagonals, csr_to_dia,
+                        csr_to_ell, dia_spmv_local, ell_spmv_local)
 from ..parallel.mesh import DeviceComm, as_comm
 from ..parallel.partition import RowLayout, concat_csr_blocks
 from .vec import Vec
@@ -40,6 +43,10 @@ class Mat:
         # constant-diagonal fast path (set by model generators so Jacobi
         # setup never pulls a 100M-row ELL back to host)
         self._diag_value: float | None = None
+        # DIA fast path for banded matrices: (n_pad, D) values + static
+        # offsets; SpMV becomes shifted slices instead of a gather
+        self.dia_vals: jax.Array | None = None
+        self.dia_offsets: tuple[int, ...] = ()
 
     # ---- constructors ------------------------------------------------------
     @classmethod
@@ -84,10 +91,18 @@ class Mat:
             vals = vals.astype(dtype, copy=False)
         else:
             cols, vals = csr_to_ell(indptr, indices, data)
-        cols = comm.put_rows(cols)
-        vals = comm.put_rows(vals)
-        m = cls(comm, (nrows, ncols), cols, vals,
-                host_csr=(indptr, indices, data))
+        K = cols.shape[1]
+        m = cls(comm, (nrows, ncols), comm.put_rows(cols),
+                comm.put_rows(vals), host_csr=(indptr, indices, data))
+        # auto-select the DIA layout for banded square matrices: same-order
+        # storage as ELL but gather-free SpMV (shifted slices)
+        if nrows == ncols:
+            offsets = csr_find_diagonals(indptr, indices,
+                                         max_diags=max(2 * K, 8))
+            if offsets is not None and len(offsets) <= max(2 * K, 8):
+                dia = csr_to_dia(indptr, indices, data, nrows, offsets)
+                m.dia_vals = comm.put_rows(dia)
+                m.dia_offsets = tuple(int(o) for o in offsets)
         m._assembled = True
         return m
 
@@ -145,6 +160,8 @@ class Mat:
         itself (GSPMD); solvers instead use the explicit shard_map path via
         :meth:`device_arrays` + ops.spmv.
         """
+        if self.dia_vals is not None:
+            return _jit_dia_spmv(self.dia_vals, x_padded, self.dia_offsets)
         return _jit_spmv(self.ell_cols, self.ell_vals, x_padded)
 
     def mult(self, x: Vec, y: Vec | None = None) -> Vec:
@@ -182,15 +199,33 @@ class Mat:
 
     # ---- linear-operator protocol (consumed by solvers.krylov) -------------
     def device_arrays(self):
-        """The raw sharded ELL arrays consumed by shard_map solver kernels."""
+        """The raw sharded arrays consumed by shard_map solver kernels."""
+        if self.dia_vals is not None:
+            return (self.dia_vals,)
         return self.ell_cols, self.ell_vals
 
     def local_spmv(self, comm: DeviceComm):
-        """Local SpMV closure for use inside shard_map: all_gather + ELL."""
+        """Local SpMV closure for use inside shard_map.
+
+        DIA path (banded matrices): all_gather + static shifted slices.
+        ELL path (general sparsity): all_gather + gather.
+        """
+        from jax import lax
         axis = comm.axis
+        if self.dia_vals is not None:
+            offsets = self.dia_offsets
+            halo = max(abs(o) for o in offsets) if offsets else 0
+            lsize = comm.local_size(self.shape[0])
+
+            def spmv(op_local, x_local):
+                (dia,) = op_local
+                x_full = lax.all_gather(x_local, axis, tiled=True)
+                row0 = lax.axis_index(axis) * lsize
+                return dia_spmv_local(dia, offsets, x_full, row0, halo)
+
+            return spmv
 
         def spmv(op_local, x_local):
-            from jax import lax
             cols, vals = op_local
             x_full = lax.all_gather(x_local, axis, tiled=True)
             return ell_spmv_local(cols, vals, x_full)
@@ -199,9 +234,13 @@ class Mat:
 
     def op_specs(self, axis):
         from jax.sharding import PartitionSpec as P
+        if self.dia_vals is not None:
+            return (P(axis, None),)
         return (P(axis, None), P(axis, None))
 
     def program_key(self):
+        if self.dia_vals is not None:
+            return ("dia", self.dia_offsets)
         return ("ell",)
 
     def __repr__(self):
@@ -212,3 +251,9 @@ class Mat:
 @jax.jit
 def _jit_spmv(cols, vals, x_padded):
     return ell_spmv_local(cols, vals, x_padded)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _jit_dia_spmv(dia, x_padded, offsets):
+    halo = max(abs(o) for o in offsets) if offsets else 0
+    return dia_spmv_local(dia, offsets, x_padded, 0, halo)

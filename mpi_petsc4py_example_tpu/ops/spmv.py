@@ -66,6 +66,58 @@ def ell_diag_local(cols, vals, row_offset, lrows):
     return jnp.sum(jnp.where(mask, vals, 0.0), axis=1)
 
 
+def csr_find_diagonals(indptr, indices, max_diags: int = 32):
+    """Offsets of the occupied matrix diagonals, or None if > max_diags.
+
+    Banded operators (every BASELINE model: Poisson 2D/3D, convection-
+    diffusion, tridiagonal) have a handful of occupied diagonals; storing
+    them DIA-style turns SpMV's gather into static shifted slices — the
+    layout the TPU VPU wants (gathers are its weak spot).
+    """
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices)
+    nrows = len(indptr) - 1
+    counts = indptr[1:] - indptr[:-1]
+    rows = np.repeat(np.arange(nrows), counts)
+    offsets = np.unique(np.asarray(indices, dtype=np.int64) - rows)
+    if len(offsets) > max_diags:
+        return None
+    return offsets
+
+
+def csr_to_dia(indptr, indices, data, n, offsets):
+    """Convert CSR to DIA: ``dia[i, d] = A[i, i + offsets[d]]``."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices, dtype=np.int64)
+    data = np.asarray(data)
+    counts = indptr[1:] - indptr[:-1]
+    rows = np.repeat(np.arange(n), counts)
+    offs = indices - rows
+    dmap = {int(o): d for d, o in enumerate(offsets)}
+    dcol = np.array([dmap[int(o)] for o in offs], dtype=np.int64)
+    dia = np.zeros((n, len(offsets)), dtype=data.dtype)
+    dia[rows, dcol] = data
+    return dia
+
+
+def dia_spmv_local(dia, offsets, x_full, row_offset, halo):
+    """Local DIA SpMV: ``y[i] = sum_d dia[i,d] * x_full[i + offsets[d]]``.
+
+    ``x_full`` is the gathered global vector; ``row_offset`` the global index
+    of this shard's first row; ``halo`` the static max |offset| used to
+    zero-pad so every shifted slice is in range. All accesses are static
+    contiguous slices — no gather.
+    """
+    lrows = dia.shape[0]
+    xp = jnp.pad(x_full, (halo, halo))
+    y = jnp.zeros(lrows, dia.dtype)
+    for d, off in enumerate(offsets):
+        seg = jax.lax.dynamic_slice_in_dim(
+            xp, row_offset + int(off) + halo, lrows)
+        y = y + dia[:, d] * seg
+    return y
+
+
 def csr_diag(indptr, indices, data, n):
     """Host-side diagonal extraction from a global CSR triple."""
     indptr = np.asarray(indptr, dtype=np.int64)

@@ -56,27 +56,33 @@ class EPSWhich:
 _ARNOLDI_CACHE: dict = {}
 
 
-def _build_arnoldi_program(comm: DeviceComm, n: int, ncv: int, dtype):
+def _build_arnoldi_program(comm: DeviceComm, operator, ncv: int):
     """ncv-step Arnoldi factorization as one SPMD program.
 
-    Returns ``(V, H)`` with ``V`` of global shape ``(ncv+1, n_pad)`` (sharded
-    on the row axis) and ``H`` the replicated ``(ncv+1, ncv)`` Hessenberg
-    matrix. Orthogonalization is classical Gram–Schmidt applied twice
-    ("CGS2"), which is communication-optimal on the mesh (two fused psums per
-    step instead of j sequential ones) and as stable as modified GS.
+    ``operator`` implements the linear-operator protocol (core.mat.Mat or a
+    matrix-free operator). Returns ``(V, H)`` with ``V`` of global shape
+    ``(ncv+1, n_pad)`` (sharded on the row axis) and ``H`` the replicated
+    ``(ncv+1, ncv)`` Hessenberg matrix. Orthogonalization is classical
+    Gram–Schmidt applied twice ("CGS2"), which is communication-optimal on
+    the mesh (two fused psums per step instead of j sequential ones) and as
+    stable as modified GS.
     """
     axis = comm.axis
-    key = (comm.mesh, axis, n, ncv, dtype)
+    n = operator.shape[0]
+    key = (comm.mesh, axis, n, ncv, str(operator.dtype),
+           operator.program_key())
     cached = _ARNOLDI_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def local_fn(cols, vals, v0):
+    spmv_local = operator.local_spmv(comm)
+    op_specs = operator.op_specs(axis)
+
+    def local_fn(op_arrays, v0):
         lsize = v0.shape[0]
 
         def A(v):
-            v_full = lax.all_gather(v, axis, tiled=True)
-            return ell_spmv_local(cols, vals, v_full)
+            return spmv_local(op_arrays, v)
 
         def pdot_vec(Vb, w):
             return lax.psum(Vb @ w, axis)
@@ -110,7 +116,7 @@ def _build_arnoldi_program(comm: DeviceComm, n: int, ncv: int, dtype):
 
     prog = jax.jit(comm.shard_map(
         local_fn,
-        in_specs=(P(axis, None), P(axis, None), P(axis)),
+        in_specs=(op_specs, P(axis)),
         out_specs=(P(None, axis), P())))
     _ARNOLDI_CACHE[key] = prog
     return prog
@@ -237,8 +243,8 @@ class EPS:
         n = mat.shape[0]
         ncv = self._effective_ncv(n)
         hermitian = self._problem_type == EPSProblemType.HEP
-        prog = _build_arnoldi_program(comm, n, ncv, mat.dtype)
-        cols, vals = mat.device_arrays()
+        prog = _build_arnoldi_program(comm, mat, ncv)
+        op_arrays = mat.device_arrays()
 
         rng = np.random.default_rng(20240901)
         v0 = comm.put_rows(rng.standard_normal(comm.padded_size(n))
@@ -253,7 +259,7 @@ class EPS:
         t0 = time.perf_counter()
         restarts = 0
         for restarts in range(1, self.max_it + 1):
-            V, H = prog(cols, vals, v0)
+            V, H = prog(op_arrays, v0)
             Hm = np.asarray(H)[:ncv, :ncv]
             beta = float(np.asarray(H)[ncv, ncv - 1])
             if hermitian:

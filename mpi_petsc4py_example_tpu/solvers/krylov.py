@@ -165,12 +165,16 @@ def _hessenberg_lstsq(H, beta):
 
 
 def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                 restart=30, monitor=None):
+                 restart=30, pmatdot=None, monitor=None):
     """Left-preconditioned restarted GMRES (KSPGMRES equivalent).
 
     Convergence is monitored in the preconditioned residual norm, matching
-    PETSc's default (KSP_NORM_PRECONDITIONED). Arnoldi uses modified
-    Gram-Schmidt; the small least-squares problem is solved per cycle.
+    PETSc's default (KSP_NORM_PRECONDITIONED). Arnoldi orthogonalizes with
+    twice-applied classical Gram-Schmidt (CGS2): two fused whole-basis
+    psums per step instead of j sequential ones — communication-optimal on
+    the mesh, no dynamic basis-row indexing, and as stable as modified GS.
+    The small least-squares problem is solved per cycle with Givens
+    rotations (portable across backends/dtypes).
     """
     m = restart
     lsize = b.shape[0]
@@ -191,16 +195,14 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         def arnoldi(j, VH):
             V, H = VH
             w = M(A(V[j]))
-
-            def mgs(i, wH):
-                w, H = wH
-                # V rows beyond j+1 are zero, so running over all rows is a
-                # masked modified Gram-Schmidt with no explicit mask.
-                hij = pdot(V[i], w)
-                return (w - hij * V[i], H.at[i, j].set(hij))
-
-            w, H = lax.fori_loop(0, m + 1, mgs, (w, H))
+            # CGS2: rows of V beyond j+1 are zero, so projecting against the
+            # whole basis needs no masking; each V @ w is one fused psum.
+            h1 = pmatdot(V, w)
+            w = w - h1 @ V
+            h2 = pmatdot(V, w)
+            w = w - h2 @ V
             hnorm = pnorm(w)
+            H = H.at[:, j].set(h1 + h2)
             H = H.at[j + 1, j].set(hnorm)
             V = V.at[j + 1].set(w / jnp.where(hnorm == 0, 1.0, hnorm))
             return (V, H)
@@ -347,6 +349,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         kw = {"monitor": monitor} if monitor is not None else {}
         if ksp_type == "gmres":
             kw["restart"] = restart
+            kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
         return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
 
     in_specs = (op_specs, pc.in_specs(axis), P(axis), P(axis), P(), P(), P())

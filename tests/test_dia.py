@@ -1,0 +1,86 @@
+"""DIA (diagonal) storage auto-selection and gather-free SpMV parity."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import (convdiff2d, poisson2d_csr,
+                                             poisson3d_csr, tridiag_family)
+from mpi_petsc4py_example_tpu.ops.spmv import (csr_find_diagonals,
+                                               csr_to_dia)
+
+
+class TestDiaDetection:
+    def test_banded_matrices_selected(self, comm8):
+        for A in (poisson2d_csr(8), poisson3d_csr(4), tridiag_family(50),
+                  convdiff2d(7)):
+            M = tps.Mat.from_scipy(comm8, A.tocsr())
+            assert M.dia_vals is not None, "banded matrix should use DIA"
+
+    def test_random_matrix_not_selected(self, comm8):
+        rng = np.random.default_rng(0)
+        A = sp.random(100, 100, density=0.1, format="csr", random_state=rng)
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is None  # ~66 distinct diagonals >> K
+
+    def test_offsets_poisson2d(self):
+        A = poisson2d_csr(6)
+        offs = csr_find_diagonals(A.indptr, A.indices)
+        assert offs.tolist() == [-6, -1, 0, 1, 6]
+
+    def test_dia_roundtrip_values(self):
+        A = poisson2d_csr(5)
+        offs = csr_find_diagonals(A.indptr, A.indices)
+        dia = csr_to_dia(A.indptr, A.indices, A.data, 25, offs)
+        # center diagonal
+        d0 = list(offs).index(0)
+        np.testing.assert_array_equal(dia[:, d0], A.diagonal())
+
+
+class TestDiaSpmv:
+    @pytest.mark.parametrize("gen,n", [
+        (lambda: poisson2d_csr(9), 81),
+        (lambda: poisson3d_csr(4), 64),
+        (lambda: tridiag_family(77), 77),
+        (lambda: convdiff2d(8, beta=0.25), 64),
+    ])
+    def test_mult_parity(self, comm, gen, n):
+        A = gen().tocsr()
+        M = tps.Mat.from_scipy(comm, A)
+        assert M.dia_vals is not None
+        x = np.random.default_rng(1).random(n)
+        y = M.mult(tps.Vec.from_global(comm, x))
+        np.testing.assert_allclose(y.to_numpy(), A @ x, rtol=1e-13,
+                                   atol=1e-13)
+
+    def test_ksp_solve_through_dia(self, comm8):
+        A = poisson2d_csr(10)
+        x_true = np.random.default_rng(2).random(100)
+        b = A @ x_true
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.program_key()[0] == "dia"
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-10)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
+
+    def test_eps_through_dia(self, comm8):
+        A = tridiag_family(60)
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is not None
+        E = tps.EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.solve()
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        target = lam_exact[np.argmax(np.abs(lam_exact))]
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target,
+                                   rtol=1e-7)
