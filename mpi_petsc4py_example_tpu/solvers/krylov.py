@@ -269,10 +269,136 @@ def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
 
 
+def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """MINRES for symmetric (possibly indefinite) systems (KSPMINRES).
+
+    Paige & Saunders recurrences with left preconditioning (M must be SPD,
+    as in PETSc); the QR of the tridiagonal is updated with Givens rotations
+    in-loop, so each iteration is one SpMV + one PC apply + two psums.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r1 = b - A(x0)
+    y = M(r1)
+    beta1 = jnp.sqrt(jnp.maximum(pdot(r1, y), 0.0))
+    zero = jnp.zeros_like(b)
+    dt = b.dtype
+
+    def cond(st):
+        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        beta = st["beta"]
+        safe_b = jnp.where(beta == 0, 1.0, beta)
+        v = st["y"] / safe_b
+        yv = A(v)
+        yv = yv - jnp.where(k > 0, beta / jnp.where(st["beta_old"] == 0, 1.0,
+                                                    st["beta_old"]), 0.0) \
+            * st["r1"]
+        alfa = pdot(v, yv)
+        yv = yv - (alfa / safe_b) * st["r2"]
+        y_new = M(yv)
+        beta_new = jnp.sqrt(jnp.maximum(pdot(yv, y_new), 0.0))
+        # QR via Givens
+        oldeps = st["epsln"]
+        delta = st["cs"] * st["dbar"] + st["sn"] * alfa
+        gbar = st["sn"] * st["dbar"] - st["cs"] * alfa
+        epsln = st["sn"] * beta_new
+        dbar = -st["cs"] * beta_new
+        gamma = jnp.sqrt(gbar * gbar + beta_new * beta_new)
+        gamma = jnp.where(gamma == 0, jnp.asarray(1e-30, dt), gamma)
+        cs = gbar / gamma
+        sn = beta_new / gamma
+        phi = cs * st["phibar"]
+        phibar = sn * st["phibar"]
+        w1 = st["w2"]
+        w2 = st["w"]
+        w = (v - oldeps * w1 - delta * w2) / gamma
+        x = st["x"] + phi * w
+        rn = jnp.abs(phibar) * st["rn0_scale"]
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return dict(k=k + 1, x=x, r1=st["r2"], r2=yv, y=y_new,
+                    beta_old=beta, beta=beta_new, dbar=dbar, epsln=epsln,
+                    phibar=phibar, cs=cs, sn=sn, w=w, w2=w2,
+                    rn=rn, rn0_scale=st["rn0_scale"], brk=st["brk"])
+
+    rnorm0 = pnorm(r1)
+    scale = rnorm0 / jnp.where(beta1 == 0, 1.0, beta1)
+    st0 = dict(k=jnp.int32(0), x=x0, r1=r1, r2=r1, y=y,
+               beta_old=jnp.asarray(1.0, dt), beta=beta1,
+               dbar=jnp.asarray(0.0, dt), epsln=jnp.asarray(0.0, dt),
+               phibar=beta1, cs=jnp.asarray(-1.0, dt),
+               sn=jnp.asarray(0.0, dt), w=zero, w2=zero,
+               rn=rnorm0, rn0_scale=scale, brk=beta1 < 0)
+    st = lax.while_loop(cond, body, st0)
+    # exact final residual (the phibar estimate tracks the M-norm)
+    rn_true = pnorm(b - A(st["x"]))
+    return (st["x"], st["k"], rn_true,
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"]))
+
+
+def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                     monitor=None):
+    """Chebyshev iteration (KSPCHEBYSHEV) — the cheapest distributed smoother.
+
+    Saad's three-term form on the preconditioned operator. Eigenvalue bounds
+    follow PETSc's default recipe — ``[0.1 λmax, 1.1 λmax]`` of M⁻¹A with
+    λmax estimated by power iteration (10 steps, in-program); only the
+    convergence check and the estimation need psums, the iteration itself is
+    collective-free.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    dt = b.dtype
+
+    # power iteration for λmax of M⁻¹A (SPD assumption, as PETSc's default)
+    def power(i, v):
+        w = M(A(v))
+        return w / jnp.maximum(pnorm(w), jnp.asarray(1e-30, dt))
+
+    v0 = b / jnp.maximum(bnorm, jnp.asarray(1e-30, dt))
+    v = lax.fori_loop(0, 10, power, v0)
+    lam_max = pdot(v, M(A(v))) / jnp.maximum(pdot(v, v),
+                                             jnp.asarray(1e-30, dt))
+    emax = 1.1 * lam_max
+    emin = 0.1 * lam_max
+    theta = (emax + emin) / 2.0
+    delta = (emax - emin) / 2.0
+    sigma = theta / delta
+
+    r = b - A(x0)
+    z = M(r)
+    rnorm = pnorm(r)
+    rho = 1.0 / sigma
+    d = z / theta
+
+    def cond(st):
+        k, x, r, d, rho, rn = st
+        return (rn > tol) & (k < maxit)
+
+    def body(st):
+        k, x, r, d, rho, rn = st
+        x = x + d
+        r = r - A(d)
+        z = M(r)
+        rho_new = 1.0 / (2.0 * sigma - rho)
+        d = rho_new * rho * d + (2.0 * rho_new / delta) * z
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, d, rho_new, rn)
+
+    st0 = (jnp.int32(0), x0, r, d, rho, rnorm)
+    k, x, r, d, rho, rnorm = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+
+
 KSP_KERNELS = {
     "cg": cg_kernel,
     "bcgs": bcgs_kernel,
     "gmres": gmres_kernel,
+    "minres": minres_kernel,
+    "chebyshev": chebyshev_kernel,
     "preonly": preonly_kernel,
     "richardson": richardson_kernel,
 }

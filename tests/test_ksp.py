@@ -188,3 +188,32 @@ class TestKSPObject:
         x, res, _ = solve(comm8, A, b, "cg", "none", rtol=1e-14, max_it=3)
         assert not res.converged
         assert res.reason == tps.ConvergedReason.DIVERGED_MAX_IT
+
+
+class TestMINRES:
+    @pytest.mark.parametrize("pc", ["none", "jacobi"])
+    def test_spd(self, comm8, pc):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "minres", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_symmetric_indefinite(self, comm8):
+        """MINRES's raison d'etre: symmetric but indefinite operator."""
+        A = (poisson2d(8) - 3.0 * sp.eye(64)).tocsr()
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "minres", "none", rtol=1e-10,
+                          max_it=2000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
+
+
+class TestChebyshev:
+    def test_poisson_jacobi(self, comm8):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "chebyshev", "jacobi", rtol=1e-8,
+                          max_it=5000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-6)
