@@ -1,0 +1,118 @@
+"""Mixed-precision iterative refinement — the fp64 story on TPU.
+
+TPU v5e has no native f64 MXU; f64 arithmetic is emulated and slow
+(SURVEY.md §7.3). The TPU-native answer: run the Krylov iteration in fp32 on
+device (fast path) inside an fp64 outer refinement loop — the classic
+Wilkinson scheme. Each outer step computes the true fp64 residual
+``r = b - A·x`` (host CSR via the native toolkit, or fp64 device SpMV),
+solves the fp32 correction system ``A δ = r`` with any KSP/PC combination,
+and accumulates ``x += δ`` in fp64. For well-conditioned systems a handful
+of corrections reach full fp64 backward error at fp32 speed.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.mat import Mat
+from ..core.vec import Vec
+from ..parallel.mesh import as_comm
+from ..utils.convergence import ConvergedReason, SolveResult
+from .ksp import KSP
+
+
+class RefinedKSP:
+    """KSP-shaped mixed-precision solver: fp32 inner Krylov, fp64 refinement.
+
+    Usage matches KSP; ``set_operators`` takes the fp64 CSR (scipy matrix or
+    triple) so both precisions of the operator can be built.
+    """
+
+    def __init__(self, comm=None):
+        self.comm = as_comm(comm) if comm is not None else None
+        self.inner = KSP(self.comm)
+        self.inner_rtol = 1e-6
+        self.rtol = 1e-12
+        self.atol = 0.0
+        self.max_refine = 20
+        self._A_host = None
+        self._mat32: Mat | None = None
+        self.result = SolveResult()
+
+    def create(self, comm=None):
+        self.comm = as_comm(comm)
+        self.inner.create(self.comm)
+        return self
+
+    def set_operators(self, A_scipy):
+        """``A_scipy``: fp64 scipy sparse matrix (kept for exact residuals)."""
+        A = A_scipy.tocsr()
+        self._A_host = A
+        if self.comm is None:
+            self.create(None)
+        self._mat32 = Mat.from_scipy(self.comm, A, dtype=np.float32)
+        self.inner.set_operators(self._mat32)
+        return self
+
+    def set_type(self, t):
+        self.inner.set_type(t)
+        return self
+
+    def get_pc(self):
+        return self.inner.get_pc()
+
+    def set_tolerances(self, rtol=None, atol=None, max_refine=None,
+                       inner_rtol=None):
+        if rtol is not None:
+            self.rtol = float(rtol)
+        if atol is not None:
+            self.atol = float(atol)
+        if max_refine is not None:
+            self.max_refine = int(max_refine)
+        if inner_rtol is not None:
+            self.inner_rtol = float(inner_rtol)
+        return self
+
+    def solve(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
+        """Solve A x = b (fp64 in/out). Returns (x, result)."""
+        A = self._A_host
+        if A is None:
+            raise RuntimeError("RefinedKSP.solve: no operators set")
+        b = np.asarray(b, dtype=np.float64)
+        bnorm = np.linalg.norm(b)
+        tol = max(self.rtol * bnorm, self.atol)
+        x = np.zeros_like(b)
+        # fp32 inner solver on the correction equation
+        self.inner.set_tolerances(rtol=self.inner_rtol, max_it=20000)
+        dx, rv = self._mat32.get_vecs()
+
+        t0 = time.perf_counter()
+        total_inner = 0
+        rnorm = bnorm
+        reason = ConvergedReason.DIVERGED_MAX_IT
+        for it in range(1, self.max_refine + 1):
+            r = b - A @ x                       # exact fp64 residual
+            rnorm = np.linalg.norm(r)
+            if rnorm <= tol:
+                reason = (ConvergedReason.CONVERGED_ATOL
+                          if rnorm <= self.atol
+                          else ConvergedReason.CONVERGED_RTOL)
+                break
+            rv.set_global(r.astype(np.float32))
+            res = self.inner.solve(rv, dx)
+            total_inner += res.iterations
+            x = x + dx.to_numpy().astype(np.float64)
+            # stagnation guard: fp32 can't represent corrections below
+            # ~1e-7 of the iterate; if the residual stops improving, stop.
+            r_new = np.linalg.norm(b - A @ x)
+            if r_new >= 0.9 * rnorm:
+                rnorm = r_new
+                reason = (ConvergedReason.CONVERGED_RTOL if r_new <= tol
+                          else ConvergedReason.DIVERGED_BREAKDOWN)
+                break
+        wall = time.perf_counter() - t0
+        self.result = SolveResult(total_inner, float(rnorm), int(reason),
+                                  wall)
+        return x, self.result

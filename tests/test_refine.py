@@ -1,0 +1,68 @@
+"""Mixed-precision iterative refinement: fp32 inner solves, fp64 accuracy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.solvers.refine import RefinedKSP
+
+
+class TestRefinedKSP:
+    def test_fp64_accuracy_from_fp32_inner(self, comm8):
+        A = poisson2d_csr(12)
+        x_true = np.random.default_rng(0).random(144)
+        b = A @ x_true
+        rk = RefinedKSP().create(comm8)
+        rk.set_operators(A)
+        rk.set_type("cg")
+        rk.get_pc().set_type("jacobi")
+        rk.set_tolerances(rtol=1e-12, inner_rtol=1e-5)
+        x, res = rk.solve(b)
+        assert res.converged, res
+        # fp64-level accuracy even though the device solver ran in fp32
+        rel = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rel <= 1e-12
+        # the inner operator really is fp32
+        assert rk._mat32.dtype == np.float32
+
+    def test_beats_plain_fp32_accuracy(self, comm8):
+        A = poisson2d_csr(10)
+        x_true = np.random.default_rng(1).random(100)
+        b = A @ x_true
+        # plain fp32 CG stalls near fp32 epsilon
+        M32 = tps.Mat.from_scipy(comm8, A, dtype=np.float32)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M32)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-14, max_it=3000)
+        x32, bv = M32.get_vecs()
+        bv.set_global(b.astype(np.float32))
+        ksp.solve(bv, x32)
+        rel32 = np.linalg.norm(b - A @ x32.to_numpy().astype(np.float64)) \
+            / np.linalg.norm(b)
+        # refined reaches far below that
+        rk = RefinedKSP().create(comm8)
+        rk.set_operators(A)
+        rk.set_type("cg")
+        rk.get_pc().set_type("jacobi")
+        rk.set_tolerances(rtol=1e-13)
+        x, res = rk.solve(b)
+        rel = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rel < rel32 / 10
+        assert rel <= 1e-13
+
+    def test_unsymmetric_with_bcgs(self, comm8):
+        from mpi_petsc4py_example_tpu.models import convdiff2d
+        A = convdiff2d(9, beta=0.3)
+        x_true = np.random.default_rng(2).random(81)
+        b = A @ x_true
+        rk = RefinedKSP().create(comm8)
+        rk.set_operators(A)
+        rk.set_type("bcgs")
+        rk.get_pc().set_type("bjacobi")
+        rk.set_tolerances(rtol=1e-12)
+        x, res = rk.solve(b)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, rtol=1e-9)
