@@ -24,9 +24,13 @@ import time
 
 import numpy as np
 
+# importing the package first applies TPU_SOLVE_PLATFORM / x64 config before
+# any jax backend initialization (needed for forced-CPU smoke runs)
+import mpi_petsc4py_example_tpu  # noqa: F401
 
-def tpu_solve(nx: int, rtol: float):
-    """CG+Jacobi on matrix-free stencil Poisson; returns (iters, wall, x)."""
+
+def tpu_solve(nx: int, rtol: float, pc_type: str = "jacobi"):
+    """CG on matrix-free stencil Poisson; returns (iters, wall, x, b, res)."""
     import jax.numpy as jnp
 
     import mpi_petsc4py_example_tpu as tps
@@ -43,7 +47,7 @@ def tpu_solve(nx: int, rtol: float):
     ksp = tps.KSP().create(comm)
     ksp.set_operators(op)
     ksp.set_type("cg")
-    ksp.get_pc().set_type("jacobi")
+    ksp.get_pc().set_type(pc_type)
     ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=20000)
 
     x, bv = op.get_vecs()
@@ -95,27 +99,33 @@ def main():
     if nx % ndev != 0:
         nx = ((nx + ndev - 1) // ndev) * ndev
 
-    iters, wall, x_tpu, b, res = tpu_solve(nx, opts.rtol)
+    iters, wall, x_tpu, b, res = tpu_solve(nx, opts.rtol, pc_type="jacobi")
+    mg_iters, mg_wall, x_mg, _, _ = tpu_solve(nx, opts.rtol, pc_type="mg")
 
     cpu_iters, cpu_wall, x_cpu, A = cpu_baseline(nx, b, opts.rtol)
 
     # residual parity check in fp64 on host
-    r_tpu = np.linalg.norm(b.astype(np.float64) - A @ x_tpu.astype(np.float64))
-    r_cpu = np.linalg.norm(b.astype(np.float64) - A @ x_cpu)
     bnorm = np.linalg.norm(b.astype(np.float64))
-    parity = bool(r_tpu <= 10 * max(r_cpu, opts.rtol * bnorm))
+    r_tpu = np.linalg.norm(b.astype(np.float64) - A @ x_tpu.astype(np.float64))
+    r_mg = np.linalg.norm(b.astype(np.float64) - A @ x_mg.astype(np.float64))
+    r_cpu = np.linalg.norm(b.astype(np.float64) - A @ x_cpu)
+    parity = bool(max(r_tpu, r_mg) <= 10 * max(r_cpu, opts.rtol * bnorm))
 
+    # headline: best time-to-rtol config (CG+MG) vs the CPU oracle
+    best_wall = min(wall, mg_wall)
     iters_per_sec = iters / wall if wall > 0 else 0.0
     line = {
-        "metric": f"CG+Jacobi iters/sec, 3D Poisson {nx}^3 "
-                  f"({nx**3:,} DoF), time-to-rtol={opts.rtol:g}",
+        "metric": f"CG time-to-rtol={opts.rtol:g}, 3D Poisson {nx}^3 "
+                  f"({nx**3:,} DoF); iters/sec is the CG+Jacobi rate",
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
-        "vs_baseline": round(cpu_wall / wall, 3) if wall > 0 else 0.0,
+        "vs_baseline": round(cpu_wall / best_wall, 3) if best_wall > 0 else 0.0,
         "extra": {
-            "tpu_wall_s": round(wall, 4), "tpu_iters": iters,
+            "tpu_jacobi_wall_s": round(wall, 4), "tpu_jacobi_iters": iters,
+            "tpu_mg_wall_s": round(mg_wall, 4), "tpu_mg_iters": mg_iters,
             "cpu_wall_s": round(cpu_wall, 4), "cpu_iters": cpu_iters,
             "rel_residual_tpu": float(r_tpu / bnorm),
+            "rel_residual_mg": float(r_mg / bnorm),
             "rel_residual_cpu": float(r_cpu / bnorm),
             "residual_parity": parity,
             "devices": len(jax.devices()),

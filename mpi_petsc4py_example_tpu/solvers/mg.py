@@ -1,0 +1,80 @@
+"""Geometric multigrid V-cycle preconditioner for structured Poisson.
+
+Beyond-parity performance component (the reference's PETSc stack exposes
+PCMG/GAMG the same way): a matrix-free V-cycle on the 7-point 3D Poisson
+operator, used as a preconditioner inside CG. Damped-Jacobi smoothing
+(ω = 2/3), full-coarsening by 2× per level, trilinear prolongation /
+restriction via ``jax.image.resize``. All static shapes — one fused XLA
+program per cycle.
+
+v1 applies the cycle on the *gathered* residual (replicated work across
+devices, local slice returned): optimal on one chip, acceptable to ~8 chips
+where SpMV savings dominate; a slab-decomposed cycle is the planned
+follow-up.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply_poisson(u):
+    """7-point Dirichlet Laplacian on a (nz, ny, nx) grid."""
+    out = 6.0 * u
+    out = out.at[1:].add(-u[:-1]).at[:-1].add(-u[1:])
+    out = out.at[:, 1:].add(-u[:, :-1]).at[:, :-1].add(-u[:, 1:])
+    out = out.at[:, :, 1:].add(-u[:, :, :-1]).at[:, :, :-1].add(-u[:, :, 1:])
+    return out
+
+
+def _smooth(u, f, iters: int, omega: float = 2.0 / 3.0):
+    """Damped Jacobi sweeps for 6·u ≈ f + neighbors."""
+    def body(i, u):
+        r = f - _apply_poisson(u)
+        return u + (omega / 6.0) * r
+
+    return jax.lax.fori_loop(0, iters, body, u)
+
+
+def _restrict(r, shape_c):
+    return jax.image.resize(r, shape_c, method="linear") * 4.0
+
+
+def _prolong(e, shape_f):
+    return jax.image.resize(e, shape_f, method="linear")
+
+
+def mg_levels(nz: int, ny: int, nx: int, min_dim: int = 4):
+    """Grid hierarchy: halve every dimension while all stay even and big."""
+    levels = [(nz, ny, nx)]
+    while all(d % 2 == 0 and d // 2 >= min_dim for d in levels[-1]):
+        levels.append(tuple(d // 2 for d in levels[-1]))
+    return levels
+
+
+def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
+                coarse_iters: int = 20):
+    """Return ``vcycle(r_flat) -> z_flat`` approximating A⁻¹ r.
+
+    Pure jnp over static shapes; safe inside jit/shard_map.
+    """
+    levels = mg_levels(nz, ny, nx)
+
+    def cycle(f, li: int):
+        shape = levels[li]
+        if li == len(levels) - 1:
+            return _smooth(jnp.zeros(shape, f.dtype), f, coarse_iters)
+        u = _smooth(jnp.zeros(shape, f.dtype), f, pre)
+        r = f - _apply_poisson(u)
+        f_c = _restrict(r, levels[li + 1])
+        e_c = cycle(f_c, li + 1)
+        u = u + _prolong(e_c, shape)
+        return _smooth(u, f, post)
+
+    def vcycle(r_flat):
+        f = r_flat.reshape(nz, ny, nx)
+        z = cycle(f, 0)
+        return z.reshape(-1)
+
+    return vcycle

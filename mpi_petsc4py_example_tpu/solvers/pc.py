@@ -36,7 +36,7 @@ from ..core.mat import Mat
 from ..parallel.mesh import DeviceComm
 from jax.sharding import PartitionSpec as P
 
-PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky")
+PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg")
 
 
 class PC:
@@ -105,6 +105,12 @@ class PC:
             self._arrays = _build_bjacobi(comm, mat)
         elif t in ("lu", "cholesky"):
             self._arrays = _build_dense_lu(comm, mat)
+        elif t == "mg":
+            if not all(hasattr(mat, a) for a in ("nx", "ny", "nz")):
+                raise ValueError(
+                    "PC 'mg' is the geometric multigrid V-cycle for "
+                    "structured stencil operators (models.StencilPoisson3D)")
+            self._arrays = ()
         self._built_for = (mat, self._type)
         return self
 
@@ -121,7 +127,7 @@ class PC:
     def in_specs(self, axis: str) -> tuple:
         """shard_map in_specs matching :meth:`device_arrays`."""
         k = self.kind
-        if k == "none":
+        if k in ("none", "mg"):
             return ()
         if k == "jacobi":
             return (P(axis),)
@@ -155,6 +161,19 @@ class PC:
                 minv = arrs[0]  # replicated (n_pad, n_pad) inverse
                 r_full = lax.all_gather(r, axis, tiled=True)
                 z_full = minv @ r_full
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
+            return apply
+        if k == "mg":
+            from .mg import make_vcycle
+            op = self._mat
+            vcycle = make_vcycle(op.nz, op.ny, op.nx)
+
+            def apply(arrs, r):
+                # v1: cycle on the gathered residual (replicated), local slice
+                # back — stencil layouts have no padding (nz % ndev == 0)
+                r_full = lax.all_gather(r, axis, tiled=True)
+                z_full = vcycle(r_full)
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
             return apply

@@ -90,3 +90,34 @@ class TestStencil:
         res = ksp.solve(bv, x)
         assert res.converged
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7, atol=1e-9)
+
+
+class TestMultigridPC:
+    def test_mg_cg_iteration_count(self, comm8):
+        """V-cycle PC: CG iterations stay ~constant in mesh size."""
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        for nx, bound in ((16, 25), (32, 25)):
+            op = StencilPoisson3D(comm8, nx)
+            A = poisson3d_csr(nx)
+            x_true = np.random.default_rng(0).random(nx ** 3)
+            b = A @ x_true
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(op)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type("mg")
+            ksp.set_tolerances(rtol=1e-8, max_it=100)
+            x, bv = op.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            assert res.converged
+            assert res.iterations <= bound, (nx, res)
+            np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-5,
+                                       atol=1e-7)
+
+    def test_mg_requires_stencil_operator(self, comm8):
+        A = poisson3d_csr(4)
+        M = tps.Mat.from_scipy(comm8, A)
+        pc = tps.PC()
+        pc.set_type("mg")
+        with pytest.raises(ValueError, match="structured stencil"):
+            pc.set_up(M)
