@@ -96,12 +96,47 @@ class Vec:
         """Gather to host, dropping padding — a counts-correct ``Gatherv``."""
         return np.asarray(self.data)[: self.n].copy()
 
-    # ---- small amount of vector arithmetic (solvers use raw arrays) --------
+    # ---- vector arithmetic (petsc4py-Vec-shaped; solvers use raw arrays) ---
     def norm(self) -> float:
         return float(jnp.linalg.norm(self.data))
 
     def dot(self, other: "Vec") -> float:
         return float(jnp.vdot(self.data, other.data))
+
+    def axpy(self, alpha: float, other: "Vec"):
+        """self += alpha * other."""
+        self.data = _axpy(jnp.asarray(alpha, self.dtype), other.data,
+                          self.data)
+        return self
+
+    def aypx(self, alpha: float, other: "Vec"):
+        """self = alpha * self + other."""
+        self.data = _axpy(jnp.asarray(alpha, self.dtype), self.data,
+                          other.data)
+        return self
+
+    def scale(self, alpha: float):
+        self.data = _scale(jnp.asarray(alpha, self.dtype), self.data)
+        return self
+
+    def shift(self, alpha: float):
+        """self += alpha on the logical entries (padding stays zero)."""
+        host = self.to_numpy() + alpha
+        self.data = self.comm.put_rows(host.astype(self.data.dtype))
+        return self
+
+    def pointwise_mult(self, a: "Vec", b: "Vec"):
+        self.data = _pmult(a.data, b.data)
+        return self
+
+    def sum(self) -> float:
+        return float(jnp.sum(self.data))
+
+    def min(self) -> float:
+        return float(np.min(self.to_numpy()))
+
+    def max(self) -> float:
+        return float(np.max(self.to_numpy()))
 
     def zero(self):
         # host-side zeros + async device_put: avoids an eager device
@@ -111,3 +146,18 @@ class Vec:
 
     def __len__(self):
         return self.n
+
+
+@jax.jit
+def _axpy(alpha, x, y):
+    return y + alpha * x
+
+
+@jax.jit
+def _scale(alpha, x):
+    return alpha * x
+
+
+@jax.jit
+def _pmult(a, b):
+    return a * b

@@ -24,6 +24,7 @@ from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import as_comm
 from ..utils.convergence import ConvergedReason, SolveResult
+from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
 from .krylov import KSP_KERNELS, build_ksp_program, set_current_monitor
 from .pc import PC
@@ -159,6 +160,7 @@ class KSP:
     setUp = set_up
 
     # ---- solve --------------------------------------------------------------
+    @wrap_device_errors("KSPSolve")
     def solve(self, b: Vec, x: Vec) -> SolveResult:
         mat = self._mat
         if mat is None:
@@ -221,6 +223,20 @@ class KSP:
         return self.result.reason
 
     getConvergedReason = get_converged_reason
+
+    def view(self, file=None):
+        """Print the solver configuration (-ksp_view analog)."""
+        import sys
+        file = file or sys.stdout
+        pc = self.get_pc()
+        print(f"KSP Object: type={self._type}\n"
+              f"  tolerances: rtol={self.rtol:g}, atol={self.atol:g}, "
+              f"max_it={self.max_it}\n"
+              f"  gmres restart: {self.restart}\n"
+              f"  PC Object: type={pc.get_type()}, "
+              f"factor solver: {pc._factor_solver_type}\n"
+              f"  mesh devices: {self.comm.size if self.comm else '?'}",
+              file=file)
 
     @property
     def converged(self) -> bool:

@@ -1,0 +1,55 @@
+"""Failure detection and clean error surfacing (SURVEY.md §5.3).
+
+In the reference, any rank failure kills the mpirun job with an opaque MPI
+abort. Here device-side failures (XLA compile errors, TPU worker crashes,
+ICI faults) are caught at the solve boundary and re-raised as
+:class:`DeviceExecutionError` with actionable context — including whether
+the error signature matches a known environment failure mode (remote TPU
+worker crash/restart), so callers can checkpoint and retry deterministically
+(utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+
+class DeviceExecutionError(RuntimeError):
+    """A device-side failure during a solve, with recovery guidance."""
+
+    def __init__(self, what: str, original: Exception):
+        self.original = original
+        msg = str(original)
+        hints = []
+        if "worker process crashed" in msg or "UNAVAILABLE" in msg:
+            hints.append(
+                "the TPU worker crashed or restarted — the device may be "
+                "unavailable for a while; checkpoint state "
+                "(utils.checkpoint.save_solve_state) and retry, or fall "
+                "back to the CPU mesh")
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+            hints.append(
+                "device memory exhausted — shard over more devices, use "
+                "fp32/bf16, or the matrix-free stencil path")
+        if "LuDecomposition" in msg or "not implemented" in msg.lower():
+            hints.append(
+                "an op is unsupported on this backend/dtype — direct "
+                "factorizations must stay on host (see solvers/pc.py)")
+        hint = ("; ".join(hints)) or "see the chained exception for details"
+        super().__init__(f"{what} failed on device: {hint}")
+
+
+def wrap_device_errors(what: str):
+    """Decorator: convert jax runtime failures into DeviceExecutionError."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classify then re-raise
+                name = type(e).__name__
+                if "JaxRuntimeError" in name or "XlaRuntimeError" in name:
+                    raise DeviceExecutionError(what, e) from e
+                raise
+        return inner
+    return deco

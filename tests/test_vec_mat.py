@@ -98,3 +98,31 @@ class TestMat:
         A = random_csr()
         M = tps.Mat.from_scipy(comm8, A)
         assert (M.to_scipy() != A).nnz == 0
+
+
+class TestVecArithmetic:
+    def test_axpy_aypx_scale(self, comm8):
+        x = tps.Vec.from_global(comm8, np.arange(10.0))
+        y = tps.Vec.from_global(comm8, np.ones(10))
+        y.axpy(2.0, x)
+        np.testing.assert_allclose(y.to_numpy(), 1.0 + 2.0 * np.arange(10.0))
+        y.scale(0.5)
+        np.testing.assert_allclose(y.to_numpy(),
+                                   (1.0 + 2.0 * np.arange(10.0)) / 2)
+        z = tps.Vec.from_global(comm8, np.full(10, 3.0))
+        z.aypx(2.0, x)  # z = 2*z + x
+        np.testing.assert_allclose(z.to_numpy(), 6.0 + np.arange(10.0))
+
+    def test_pointwise_and_reductions(self, comm8):
+        a = tps.Vec.from_global(comm8, np.arange(1.0, 6.0))
+        b = tps.Vec.from_global(comm8, np.full(5, 2.0))
+        out = tps.Vec(comm8, 5)
+        out.pointwise_mult(a, b)
+        np.testing.assert_allclose(out.to_numpy(), 2.0 * np.arange(1.0, 6.0))
+        assert out.sum() == 30.0
+        assert out.min() == 2.0 and out.max() == 10.0
+
+    def test_shift_keeps_padding_clean(self, comm8):
+        v = tps.Vec.from_global(comm8, np.zeros(10))
+        v.shift(1.0)
+        assert v.sum() == 10.0  # padding (6 slots) stayed zero
