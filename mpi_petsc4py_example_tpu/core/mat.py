@@ -232,6 +232,59 @@ class Mat:
 
         return spmv
 
+    def local_spmv_t(self, comm: DeviceComm):
+        """Local transpose-SpMV closure (``y = Aᵀ x``) for shard_map bodies.
+
+        Each device forms its rows' contribution to the full output vector
+        (its rows hit columns anywhere), then one ``psum`` combines them —
+        the reverse communication pattern of the all-gather forward product.
+        Used by KSPLSQR (PETSc's MatMultTranspose slot).
+        """
+        from jax import lax
+        axis = comm.axis
+        if self.shape[0] != self.shape[1]:
+            raise ValueError(
+                "local_spmv_t supports square operators only (output is "
+                f"row-partitioned like the input); shape={self.shape}")
+        n = self.shape[0]
+        lsize = comm.local_size(n)
+        n_pad = lsize * comm.size
+        if self.dia_vals is not None:
+            offsets = self.dia_offsets
+            halo = max(abs(o) for o in offsets) if offsets else 0
+
+            def spmv_t(op_local, x_local):
+                (dia,) = op_local
+                row0 = lax.axis_index(axis) * lsize
+                # all offsets land inside one local window — accumulate
+                # there with static starts, then one dynamic write into the
+                # global buffer
+                win = jnp.zeros(lsize + 2 * halo, dia.dtype)
+                for d, off in enumerate(offsets):
+                    win = lax.dynamic_update_slice_in_dim(
+                        win,
+                        lax.dynamic_slice_in_dim(win, int(off) + halo, lsize)
+                        + dia[:, d] * x_local,
+                        int(off) + halo, axis=0)
+                buf = jnp.zeros(n_pad + 2 * halo, dia.dtype)
+                buf = lax.dynamic_update_slice_in_dim(buf, win, row0, axis=0)
+                buf = lax.psum(buf, axis)
+                y_full = lax.slice_in_dim(buf, halo, halo + n_pad)
+                return lax.dynamic_slice_in_dim(y_full, row0, lsize)
+
+            return spmv_t
+
+        def spmv_t(op_local, x_local):
+            cols, vals = op_local
+            contrib = vals * x_local[:, None]
+            y_full = jnp.zeros(n_pad, vals.dtype)
+            y_full = y_full.at[cols.ravel()].add(contrib.ravel())
+            y_full = lax.psum(y_full, axis)
+            row0 = lax.axis_index(axis) * lsize
+            return lax.dynamic_slice_in_dim(y_full, row0, lsize)
+
+        return spmv_t
+
     def op_specs(self, axis):
         from jax.sharding import PartitionSpec as P
         if self.dia_vals is not None:

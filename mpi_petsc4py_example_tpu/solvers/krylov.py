@@ -164,6 +164,22 @@ def _hessenberg_lstsq(H, beta):
     return y, jnp.abs(g[m])
 
 
+def _cgs2_step(V, w, pmatdot, pnorm):
+    """One CGS2 orthogonalization step shared by GMRES/FGMRES/Arnoldi.
+
+    Projects ``w`` against the basis rows of ``V`` twice (classical
+    Gram-Schmidt, re-applied — two fused whole-basis psums); rows of V
+    beyond the current column are zero, so no masking is needed. Returns
+    ``(h, hnorm, v_next)``.
+    """
+    h1 = pmatdot(V, w)
+    w = w - h1 @ V
+    h2 = pmatdot(V, w)
+    w = w - h2 @ V
+    hnorm = pnorm(w)
+    return h1 + h2, hnorm, w / jnp.where(hnorm == 0, 1.0, hnorm)
+
+
 def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
                  restart=30, pmatdot=None, monitor=None):
     """Left-preconditioned restarted GMRES (KSPGMRES equivalent).
@@ -195,16 +211,10 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         def arnoldi(j, VH):
             V, H = VH
             w = M(A(V[j]))
-            # CGS2: rows of V beyond j+1 are zero, so projecting against the
-            # whole basis needs no masking; each V @ w is one fused psum.
-            h1 = pmatdot(V, w)
-            w = w - h1 @ V
-            h2 = pmatdot(V, w)
-            w = w - h2 @ V
-            hnorm = pnorm(w)
-            H = H.at[:, j].set(h1 + h2)
+            h, hnorm, vnext = _cgs2_step(V, w, pmatdot, pnorm)
+            H = H.at[:, j].set(h)
             H = H.at[j + 1, j].set(hnorm)
-            V = V.at[j + 1].set(w / jnp.where(hnorm == 0, 1.0, hnorm))
+            V = V.at[j + 1].set(vnext)
             return (V, H)
 
         V, H = lax.fori_loop(0, m, arnoldi, (V, H))
@@ -393,10 +403,335 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
 
 
+def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                  preduce=None, monitor=None):
+    """Single-reduction CG (Chronopoulos–Gear recurrences; KSPPIPECG slot).
+
+    Standard CG needs three separate reductions per iteration ((p,Ap),
+    (r,z), ||r||); here all three inner products are computed from the same
+    vectors *before* the updates and fused into ONE stacked ``lax.psum`` —
+    the communication-optimal CG on a device mesh, trading one extra vector
+    recurrence for two collectives. Mathematically equivalent to CG in exact
+    arithmetic (Chronopoulos & Gear 1989).
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    u = M(r)
+    w = A(u)
+    zero = jnp.zeros_like(b)
+    dt = b.dtype
+
+    def fused(r, u, w):
+        return preduce(jnp.vdot(r, u), jnp.vdot(w, u), jnp.vdot(r, r))
+
+    def cond(st):
+        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        gamma, delta, rr = fused(st["r"], st["u"], st["w"])
+        first = k == 0
+        gold = jnp.where(st["gamma"] == 0, 1.0, st["gamma"])
+        beta = jnp.where(first, 0.0, gamma / gold)
+        aold = jnp.where(st["alpha"] == 0, 1.0, st["alpha"])
+        denom = jnp.where(first, delta, delta - beta * gamma / aold)
+        brk = denom == 0
+        alpha = jnp.where(brk, 0.0, gamma / jnp.where(brk, 1.0, denom))
+        p = st["u"] + beta * st["p"]
+        s = st["w"] + beta * st["s"]
+        x = st["x"] + alpha * p
+        r = st["r"] - alpha * s
+        u = M(r)
+        w = A(u)
+        rn = jnp.sqrt(jnp.maximum(rr, 0.0))
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return dict(k=k + 1, x=x, r=r, u=u, w=w, p=p, s=s,
+                    gamma=gamma, alpha=alpha, rn=rn, brk=brk)
+
+    st0 = dict(k=jnp.int32(0), x=x0, r=r, u=u, w=w, p=zero, s=zero,
+               gamma=jnp.asarray(0.0, dt), alpha=jnp.asarray(0.0, dt),
+               rn=pnorm(r), brk=pnorm(r) <= -1.0)
+    st = lax.while_loop(cond, body, st0)
+    rn_true = pnorm(b - A(st["x"]))
+    return (st["x"], st["k"], rn_true,
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+
+
+def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                  restart=30, pmatdot=None, monitor=None):
+    """Flexible (right-preconditioned) restarted GMRES (KSPFGMRES).
+
+    Stores the preconditioned basis ``Z[j] = M(V[j])`` explicitly, so M may
+    change between applications — required when the preconditioner is itself
+    an iterative method (multigrid with variable cycles, inner Krylov
+    solves). Convergence is monitored in the UNpreconditioned residual norm
+    (PETSc's KSP_NORM_UNPRECONDITIONED default for FGMRES).
+    """
+    m = restart
+    lsize = b.shape[0]
+    bnorm = pnorm(b)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    rnorm0 = pnorm(b - A(x0))
+
+    def cycle(st):
+        k, x, rn = st
+        r = b - A(x)
+        beta = pnorm(r)
+        V = jnp.zeros((m + 1, lsize), b.dtype)
+        V = V.at[0].set(r / jnp.where(beta == 0, 1.0, beta))
+        Z = jnp.zeros((m, lsize), b.dtype)
+        H = jnp.zeros((m + 1, m), b.dtype)
+
+        def arnoldi(j, VZH):
+            V, Z, H = VZH
+            z = M(V[j])
+            Z = Z.at[j].set(z)
+            w = A(z)
+            h, hnorm, vnext = _cgs2_step(V, w, pmatdot, pnorm)
+            H = H.at[:, j].set(h)
+            H = H.at[j + 1, j].set(hnorm)
+            V = V.at[j + 1].set(vnext)
+            return (V, Z, H)
+
+        V, Z, H = lax.fori_loop(0, m, arnoldi, (V, Z, H))
+        y, _ = _hessenberg_lstsq(H, beta)
+        x = x + y @ Z
+        rn = pnorm(b - A(x))
+        if monitor is not None:
+            monitor(k + m, rn)
+        return (k + m, x, rn)
+
+    def cond(st):
+        k, x, rn = st
+        return (rn > tol) & (k < maxit)
+
+    k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+
+
+def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Conjugate Gradient Squared (KSPCGS), right-preconditioned.
+
+    Solves ``(A·M) y = r0`` for the correction and applies ``x = x0 + M(y)``
+    once at the end, so the residual monitored in-loop is the TRUE residual
+    of the original system.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    op = lambda v: A(M(v))
+    r = b - A(x0)
+    rtilde = r
+    rnorm = pnorm(r)
+    zero = jnp.zeros_like(b)
+    dt = b.dtype
+
+    def cond(st):
+        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        rho_new = pdot(rtilde, st["r"])
+        brk = rho_new == 0
+        rho_old = jnp.where(st["rho"] == 0, 1.0, st["rho"])
+        beta = jnp.where(brk, 0.0, rho_new / rho_old)
+        u = st["r"] + beta * st["q"]
+        p = u + beta * (st["q"] + beta * st["p"])
+        v = op(p)
+        sigma = pdot(rtilde, v)
+        brk = brk | (sigma == 0)
+        alpha = jnp.where(brk, 0.0, rho_new / jnp.where(sigma == 0, 1.0, sigma))
+        q = u - alpha * v
+        uq = u + q
+        y = st["y"] + alpha * uq
+        r = st["r"] - alpha * op(uq)
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return dict(k=k + 1, y=y, r=r, p=p, q=q, rho=rho_new, rn=rn, brk=brk)
+
+    st0 = dict(k=jnp.int32(0), y=zero, r=r, p=zero, q=zero,
+               rho=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0)
+    st = lax.while_loop(cond, body, st0)
+    x = x0 + M(st["y"])
+    # converged-reason from the recurrence residual the loop monitored
+    # (PETSc semantics); the reported norm is the true residual, which may
+    # drift above it in reduced precision (CGS squares the residual poly).
+    rn_true = pnorm(b - A(x))
+    return (x, st["k"], rn_true,
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+
+
+def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Transpose-Free QMR (Freund 1993; KSPTFQMR), right-preconditioned.
+
+    Runs on the correction system ``(A·M) y = r0``; the loop monitors the
+    quasi-residual bound ``tau * sqrt(2k+1)`` (PETSc's dp), and the exact
+    residual is evaluated once after the loop for the reported norm/reason.
+    Two operator applications per (double) iteration, like BiCGStab.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    op = lambda v: A(M(v))
+    r0 = b - A(x0)
+    rstar = r0
+    tau0 = pnorm(r0)
+    zero = jnp.zeros_like(b)
+    dt = b.dtype
+    u1_0 = op(r0)
+
+    def half(st, yj, uj, alpha):
+        """One half-step of the inner j=1,2 update."""
+        w = st["w"] - alpha * uj
+        safe_a = jnp.where(alpha == 0, 1.0, alpha)
+        d = yj + (st["theta"] ** 2 * st["eta"] / safe_a) * st["d"]
+        tau_old = jnp.where(st["tau"] == 0, 1.0, st["tau"])
+        theta = pnorm(w) / tau_old
+        c2 = 1.0 / (1.0 + theta * theta)
+        tau = st["tau"] * theta * jnp.sqrt(c2)
+        eta = c2 * alpha
+        y = st["y"] + eta * d
+        return dict(st, w=w, d=d, theta=theta, tau=tau, eta=eta, y=y)
+
+    def cond(st):
+        return (st["dp"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        sigma = pdot(rstar, st["v"])
+        brk = sigma == 0
+        alpha = jnp.where(brk, 0.0,
+                          st["rho"] / jnp.where(sigma == 0, 1.0, sigma))
+        y2 = st["y1"] - alpha * st["v"]
+        u2 = op(y2)
+        st1 = half(st, st["y1"], st["u1"], alpha)
+        st2 = half(st1, y2, u2, alpha)
+        rho_new = pdot(rstar, st2["w"])
+        brk = brk | (st["rho"] == 0)
+        beta = rho_new / jnp.where(st["rho"] == 0, 1.0, st["rho"])
+        y1 = st2["w"] + beta * y2
+        u1 = op(y1)
+        v = u1 + beta * (u2 + beta * st["v"])
+        # quasi-residual bound on the true residual after 2(k+1) half-steps
+        dp = st2["tau"] * jnp.sqrt(2.0 * (k + 1) + 1.0)
+        if monitor is not None:
+            monitor(k + 1, dp)
+        return dict(st2, k=k + 1, y1=y1, u1=u1, v=v, rho=rho_new,
+                    dp=dp, brk=brk)
+
+    st0 = dict(k=jnp.int32(0), y=zero, w=r0, y1=r0, u1=u1_0, v=u1_0,
+               d=zero, theta=jnp.asarray(0.0, dt), eta=jnp.asarray(0.0, dt),
+               tau=tau0, rho=pdot(rstar, r0), dp=tau0, brk=tau0 <= -1.0)
+    st = lax.while_loop(cond, body, st0)
+    x = x0 + M(st["y"])
+    rn_true = pnorm(b - A(x))
+    return (x, st["k"], rn_true,
+            _reason(st["dp"], tol, atol, st["k"], maxit, st["brk"]))
+
+
+def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """Preconditioned Conjugate Residuals (KSPCR) for symmetric systems.
+
+    Minimizes the preconditioned residual M(b - Ax) in the A-norm sense;
+    requires symmetric A and SPD M (as PETSc documents for KSPCR). One SpMV
+    + one PC apply + two psums per iteration.
+    """
+    pb = M(b)
+    bnorm = pnorm(pb)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    r = M(b - A(x0))
+    p = r
+    w = A(r)        # A r
+    q = w           # A p
+    rho = pdot(r, w)
+    rnorm = pnorm(r)
+
+    def cond(st):
+        k, x, r, p, w, q, rho, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, p, w, q, rho, rn, brk = st
+        Mq = M(q)
+        qMq = pdot(q, Mq)
+        brk = qMq == 0
+        alpha = jnp.where(brk, 0.0, rho / jnp.where(brk, 1.0, qMq))
+        x = x + alpha * p
+        r = r - alpha * Mq
+        w = A(r)
+        rho_new = pdot(r, w)
+        beta = jnp.where(rho == 0, 0.0, rho_new / jnp.where(rho == 0, 1.0, rho))
+        p = r + beta * p
+        q = w + beta * q
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, p, w, q, rho_new, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, rnorm <= -1.0)
+    k, x, r, p, w, q, rho, rnorm, brk = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                At=None, monitor=None):
+    """LSQR (Paige & Saunders 1982; KSPLSQR) via Golub-Kahan bidiagonalization.
+
+    Solves ``min ||b - Ax||`` — usable on unsymmetric and inconsistent
+    systems. Needs the transpose product ``At`` (operators provide
+    ``local_spmv_t``; the preconditioner is ignored, matching PETSc's
+    default unpreconditioned KSPLSQR).
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    dt = b.dtype
+
+    def normalize(v):
+        nv = pnorm(v)
+        return v / jnp.where(nv == 0, 1.0, nv), nv
+
+    u, beta = normalize(b - A(x0))
+    v, alfa = normalize(At(u))
+    w = v
+
+    def cond(st):
+        return (st["phibar"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        u, beta = normalize(A(st["v"]) - st["alfa"] * st["u"])
+        v, alfa = normalize(At(u) - beta * st["v"])
+        rho = jnp.sqrt(st["rhobar"] ** 2 + beta ** 2)
+        brk = rho == 0
+        safe_rho = jnp.where(brk, 1.0, rho)
+        c = st["rhobar"] / safe_rho
+        s = beta / safe_rho
+        theta = s * alfa
+        rhobar = -c * alfa
+        phi = c * st["phibar"]
+        phibar = s * st["phibar"]
+        x = st["x"] + (phi / safe_rho) * st["w"]
+        w = v - (theta / safe_rho) * st["w"]
+        if monitor is not None:
+            monitor(k + 1, phibar)
+        return dict(k=k + 1, x=x, u=u, v=v, w=w, alfa=alfa,
+                    rhobar=rhobar, phibar=phibar, brk=brk)
+
+    st0 = dict(k=jnp.int32(0), x=x0, u=u, v=v, w=w, alfa=alfa,
+               rhobar=alfa, phibar=beta, brk=beta <= -1.0)
+    st = lax.while_loop(cond, body, st0)
+    rn_true = pnorm(b - A(st["x"]))
+    return (st["x"], st["k"], rn_true,
+            _reason(st["phibar"], tol, atol, st["k"], maxit, st["brk"]))
+
+
 KSP_KERNELS = {
     "cg": cg_kernel,
+    "pipecg": pipecg_kernel,
     "bcgs": bcgs_kernel,
     "gmres": gmres_kernel,
+    "fgmres": fgmres_kernel,
+    "cgs": cgs_kernel,
+    "tfqmr": tfqmr_kernel,
+    "cr": cr_kernel,
+    "lsqr": lsqr_kernel,
     "minres": minres_kernel,
     "chebyshev": chebyshev_kernel,
     "preonly": preonly_kernel,
@@ -457,6 +792,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     kernel = KSP_KERNELS[ksp_type]
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
+    spmv_t_local = None
+    if ksp_type == "lsqr":
+        if not hasattr(operator, "local_spmv_t"):
+            raise ValueError(
+                "KSP 'lsqr' needs the transpose product; operator "
+                f"{type(operator).__name__} provides no local_spmv_t")
+        spmv_t_local = operator.local_spmv_t(comm)
     op_specs = operator.op_specs(axis)
 
     monitor = None
@@ -473,9 +815,14 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
         pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
         kw = {"monitor": monitor} if monitor is not None else {}
-        if ksp_type == "gmres":
+        if ksp_type in ("gmres", "fgmres"):
             kw["restart"] = restart
             kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
+        elif ksp_type == "pipecg":
+            # the whole point: all per-iteration dots in ONE fused psum
+            kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts), axis)
+        elif ksp_type == "lsqr":
+            kw["At"] = lambda v: spmv_t_local(op_arrays, v)
         return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
 
     in_specs = (op_specs, pc.in_specs(axis), P(axis), P(axis), P(), P(), P())

@@ -6,10 +6,11 @@ The same surface is provided here (snake_case canonical, camelCase aliases for
 facade/driver compatibility); ``solve`` dispatches to a cached jit-compiled
 ``shard_map`` program built by :mod:`.krylov`.
 
-Solver types: ``cg``, ``gmres``, ``bcgs``, ``preonly``, ``richardson``.
-Runtime override via the options DB: ``-ksp_type``, ``-ksp_rtol``,
-``-ksp_atol``, ``-ksp_max_it``, ``-ksp_gmres_restart``, ``-ksp_monitor``,
-``-pc_type`` (SURVEY.md §5.6).
+Solver types: ``cg``, ``pipecg`` (single-reduction CG), ``gmres``,
+``fgmres``, ``bcgs``, ``cgs``, ``tfqmr``, ``cr``, ``minres``, ``chebyshev``,
+``lsqr``, ``preonly``, ``richardson``. Runtime override via the options DB:
+``-ksp_type``, ``-ksp_rtol``, ``-ksp_atol``, ``-ksp_max_it``,
+``-ksp_gmres_restart``, ``-ksp_monitor``, ``-pc_type`` (SURVEY.md §5.6).
 """
 
 from __future__ import annotations

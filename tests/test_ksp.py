@@ -217,3 +217,114 @@ class TestChebyshev:
                           max_it=5000)
         assert res.converged, res
         np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-6)
+
+
+class TestPipelinedCG:
+    """Single-reduction CG (Chronopoulos-Gear) — must match CG's answer."""
+
+    @pytest.mark.parametrize("pc", ["none", "jacobi", "bjacobi"])
+    def test_spd(self, comm8, pc):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "pipecg", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_iteration_count_close_to_cg(self, comm8):
+        A = poisson2d(12)
+        _, b = manufactured(A)
+        _, r_cg, _ = solve(comm8, A, b, "cg", "jacobi", rtol=1e-8)
+        _, r_pipe, _ = solve(comm8, A, b, "pipecg", "jacobi", rtol=1e-8)
+        assert abs(r_pipe.iterations - r_cg.iterations) <= 5
+
+
+class TestFGMRES:
+    @pytest.mark.parametrize("pc", ["jacobi", "bjacobi"])
+    def test_unsymmetric(self, comm8, pc):
+        A = convdiff2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "fgmres", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_true_residual_norm(self, comm8):
+        """FGMRES monitors the unpreconditioned residual."""
+        A = poisson2d(8)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "fgmres", "jacobi", rtol=1e-9)
+        r = np.linalg.norm(b - A @ x)
+        assert r <= 1e-9 * np.linalg.norm(b) * 1.01
+
+
+class TestCGSAndTFQMR:
+    @pytest.mark.parametrize("ksp", ["cgs", "tfqmr"])
+    @pytest.mark.parametrize("pc", ["none", "jacobi"])
+    def test_unsymmetric(self, comm8, ksp, pc):
+        A = convdiff2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, ksp, pc, rtol=1e-10, max_it=2000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
+
+    @pytest.mark.parametrize("ksp", ["cgs", "tfqmr"])
+    def test_spd(self, comm8, ksp):
+        A = poisson2d(8)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, ksp, "jacobi", rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
+
+
+class TestCR:
+    @pytest.mark.parametrize("pc", ["none", "jacobi"])
+    def test_spd(self, comm8, pc):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cr", pc, rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+
+class TestLSQR:
+    def test_banded_unsymmetric(self, comm8):
+        """DIA-layout transpose path (convdiff is banded)."""
+        A = convdiff2d(8)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "lsqr", "none", rtol=1e-12,
+                          max_it=3000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
+
+    def test_general_sparsity_ell_transpose(self, comm8):
+        """Random unsymmetric sparse matrix exercises the ELL scatter-add
+        transpose (no diagonal structure)."""
+        rng = np.random.default_rng(3)
+        n = 60
+        A = sp.random(n, n, density=0.15, random_state=3,
+                      data_rvs=lambda k: rng.random(k)).tocsr()
+        A = A + sp.diags(np.full(n, n / 4.0))  # make it nonsingular
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "lsqr", "none", rtol=1e-12,
+                          max_it=5000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
+
+    def test_transpose_mult_correct(self, comm8):
+        """Direct oracle for local_spmv_t on both layouts."""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        for Amat in (convdiff2d(8), sp.random(50, 50, density=0.2,
+                                              random_state=1).tocsr()):
+            M = tps.Mat.from_scipy(comm8, Amat)
+            comm = M.comm
+            v = np.random.default_rng(0).random(Amat.shape[0])
+            vd = tps.Vec.from_global(comm, v)
+            spmv_t = M.local_spmv_t(comm)
+            fn = jax.jit(comm.shard_map(
+                lambda op, x: spmv_t(op, x),
+                (M.op_specs(comm.axis), P(comm.axis)), P(comm.axis)))
+            out = np.asarray(fn(M.device_arrays(), vd.data))[:Amat.shape[0]]
+            np.testing.assert_allclose(out, Amat.T @ v, rtol=1e-10,
+                                       atol=1e-12)
