@@ -783,8 +783,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
-    key = (comm.mesh, axis, ksp_type, pc.kind, n, str(dtype), restart,
-           monitored, zero_guess, operator.program_key())
+    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
+           restart, monitored, zero_guess, operator.program_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached

@@ -148,6 +148,10 @@ class KSP:
         fst = opt.get_string(p + "pc_factor_mat_solver_type")
         if fst:
             self.get_pc().set_factor_solver_type(fst)
+        pc = self.get_pc()
+        pc.sor_omega = opt.get_real(p + "pc_sor_omega", pc.sor_omega)
+        pc.asm_overlap = opt.get_int(p + "pc_asm_overlap", pc.asm_overlap)
+        pc.factor_fill = opt.get_real(p + "pc_factor_fill", pc.factor_fill)
         return self
 
     setFromOptions = set_from_options

@@ -14,6 +14,14 @@ pc.setFactorSolverType('mumps')`` (``test.py:40-43``). Types provided:
   (LAPACK) and apply on device as a dense matmul; KSPPREONLY adds iterative
   refinement. Exact for reference-scale problems; large problems should
   prefer an iterative KSP with a strong PC.
+* ``sor`` / ``ssor`` — processor-local block SSOR (PETSc's parallel PCSOR
+  semantics), applied exactly as a precomputed dense inverse (``-pc_sor_omega``).
+* ``ilu`` / ``icc`` — per-device block incomplete factorization (scipy
+  ``spilu`` setup, dense (LU)⁻¹ apply; ``-pc_factor_fill``). ``icc`` is an
+  open alias of the same unsymmetric incomplete-LU path.
+* ``asm`` — restricted additive Schwarz with row-overlap windows
+  (``-pc_asm_overlap``, default 1), per-device window solves.
+* ``mg``  — geometric multigrid V-cycle for structured stencil operators.
 
 Note: device-side LU is deliberately avoided — XLA:TPU implements
 LuDecomposition only for F32/C64 (observed on v5e), so factorizations happen
@@ -36,7 +44,8 @@ from ..core.mat import Mat
 from ..parallel.mesh import DeviceComm
 from jax.sharding import PartitionSpec as P
 
-PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg")
+PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
+            "sor", "ssor", "ilu", "icc", "asm")
 
 
 class PC:
@@ -49,6 +58,9 @@ class PC:
         self._mat: Mat | None = None
         self._arrays = ()
         self._built_for = None
+        self.sor_omega = 1.0        # -pc_sor_omega (PETSc default 1)
+        self.asm_overlap = 1        # -pc_asm_overlap (PETSc default 1)
+        self.factor_fill = 10.0     # -pc_factor_fill (spilu fill_factor)
 
     # ---- petsc4py-shaped configuration -------------------------------------
     def set_type(self, pc_type: str):
@@ -91,7 +103,10 @@ class PC:
         mat = self._mat
         if mat is None:
             raise RuntimeError("PC.set_up: no operator set")
-        if self._built_for == (mat, self._type):
+        # tunables are baked into the built arrays — they are part of the key
+        build_key = (mat, self._type, self.sor_omega, self.asm_overlap,
+                     self.factor_fill)
+        if self._built_for == build_key:
             return self
         comm = mat.comm
         t = self._type
@@ -103,6 +118,12 @@ class PC:
             self._arrays = (comm.put_rows(inv.astype(mat.dtype)),)
         elif t == "bjacobi":
             self._arrays = _build_bjacobi(comm, mat)
+        elif t in ("sor", "ssor"):
+            self._arrays = _build_block_ssor(comm, mat, self.sor_omega)
+        elif t in ("ilu", "icc"):
+            self._arrays = _build_block_ilu(comm, mat, self.factor_fill)
+        elif t == "asm":
+            self._arrays = _build_asm(comm, mat, self.asm_overlap)
         elif t in ("lu", "cholesky"):
             self._arrays = _build_dense_lu(comm, mat)
         elif t == "mg":
@@ -111,7 +132,7 @@ class PC:
                     "PC 'mg' is the geometric multigrid V-cycle for "
                     "structured stencil operators (models.StencilPoisson3D)")
             self._arrays = ()
-        self._built_for = (mat, self._type)
+        self._built_for = build_key
         return self
 
     setUp = set_up
@@ -119,10 +140,24 @@ class PC:
     # ---- what the KSP solver factory consumes -------------------------------
     @property
     def kind(self) -> str:
-        return "lu" if self._type == "cholesky" else self._type
+        t = self._type
+        if t == "cholesky":
+            return "lu"
+        # sor/ssor/ilu/icc all apply as one per-device dense block matvec —
+        # the same kernel shape as block Jacobi, different block algebra
+        if t in ("sor", "ssor", "ilu", "icc"):
+            return "bjacobi"
+        return t
 
     def device_arrays(self) -> tuple:
         return self._arrays
+
+    def program_key(self):
+        """Part of the compiled-solver cache key: everything baked into the
+        local_apply closure beyond ``kind`` (currently the ASM overlap)."""
+        if self.kind == "asm":
+            return (self.kind, int(self.asm_overlap))
+        return (self.kind,)
 
     def in_specs(self, axis: str) -> tuple:
         """shard_map in_specs matching :meth:`device_arrays`."""
@@ -132,6 +167,8 @@ class PC:
         if k == "jacobi":
             return (P(axis),)
         if k == "bjacobi":
+            return (P(axis),)
+        if k == "asm":
             return (P(axis),)
         if k == "lu":
             return (P(),)
@@ -155,6 +192,29 @@ class PC:
             def apply(arrs, r):
                 binv = arrs[0]  # this device's (1, lsize, lsize) block inverse
                 return binv[0] @ r
+            return apply
+        if k == "asm":
+            ov = int(self.asm_overlap)
+            ndev = comm.size
+            fwd = [(i, (i + 1) % ndev) for i in range(ndev)]
+            bwd = [(i, (i - 1) % ndev) for i in range(ndev)]
+
+            def apply(arrs, r):
+                winv = arrs[0]   # (1, lsize+2ov, lsize+2ov) window inverse
+                if ov:
+                    # ring halo exchange: only the ov edge rows move (vs an
+                    # O(n) all_gather). Wrapped halos at the global
+                    # boundaries hit identity-padded, fully-decoupled window
+                    # slots, so their content never reaches owned rows.
+                    left = lax.ppermute(r[lsize - ov:], axis, fwd)
+                    right = lax.ppermute(r[:ov], axis, bwd)
+                    r_win = jnp.concatenate([left, r, right])
+                else:
+                    r_win = r
+                z_win = winv[0] @ r_win
+                # restricted additive Schwarz (PETSc's default): keep only
+                # the owned interior — no overlap summation, no extra comm
+                return lax.slice_in_dim(z_win, ov, ov + lsize)
             return apply
         if k == "lu":
             def apply(arrs, r):
@@ -186,31 +246,132 @@ class PC:
 _DENSE_CAP = 16384  # host O(n^3) factorization bound for direct paths
 
 
+def _per_device_inverse(A, n, lsize, ndev, block_inv):
+    """(ndev, lsize, lsize) stack of per-device block inverses.
+
+    ``block_inv(csr_block) -> dense inverse``; out-of-range / padding rows
+    get identity so padded vector slots pass through unchanged.
+    """
+    inv = np.zeros((ndev, lsize, lsize), dtype=np.float64)
+    for d in range(ndev):
+        rs, re = d * lsize, min((d + 1) * lsize, n)
+        inv[d] = np.eye(lsize)
+        if rs < n:
+            m = re - rs
+            inv[d, :m, :m] = block_inv(A[rs:re, rs:re])
+    return inv
+
+
 def _build_bjacobi(comm: DeviceComm, mat: Mat):
     """Per-device inverse of the local (uniform-padded) diagonal block.
 
     Factorized on host in fp64 (LAPACK), shipped as explicit inverses so the
     device-side apply is one dense matvec on the MXU.
     """
+    A, n, lsize = _local_dense_blocks(comm, mat, "bjacobi")
+    inv = _per_device_inverse(
+        A, n, lsize, comm.size,
+        lambda B: scipy.linalg.inv(B.toarray().astype(np.float64)))
+    return _ship_blocks(comm, inv, mat.dtype)
+
+
+def _local_dense_blocks(comm: DeviceComm, mat: Mat, pc_name: str):
+    """Host scipy CSR + per-device uniform (rs, re) row windows.
+
+    Shared setup for every block preconditioner; enforces the dense-block
+    size cap (SURVEY.md §7.4 — local factorizations densify).
+    """
     n = mat.shape[0]
     lsize = comm.local_size(n)
-    ndev = comm.size
     if lsize > _DENSE_CAP:
         raise ValueError(
-            f"PC 'bjacobi' local blocks are dense ({lsize}x{lsize}); too "
-            "large — use more devices or pc 'jacobi' (SURVEY.md §7.4)")
-    A = mat.to_scipy().tocsr()
-    blocks = np.zeros((ndev, lsize, lsize), dtype=np.float64)
+            f"PC {pc_name!r} local blocks are dense ({lsize}x{lsize}); too "
+            "large — use more devices or pc 'jacobi'/'mg' (SURVEY.md §7.4)")
+    return mat.to_scipy().tocsr(), n, lsize
+
+
+def _ship_blocks(comm: DeviceComm, blocks: np.ndarray, dtype):
+    return (jax.device_put(
+        blocks.astype(dtype),
+        jax.sharding.NamedSharding(comm.mesh, P(comm.axis))),)
+
+
+def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):
+    """Per-device block SSOR: M = (D/ω+L) (D/ω)⁻¹ (D/ω+U) · ω/(2-ω).
+
+    PETSc's parallel PCSOR is processor-local sweeps (block-Jacobi outside,
+    SOR inside) — same semantics here, with the local sweep applied
+    *exactly*: the SSOR matrix inverse is precomputed on host and applied
+    as one dense matvec on the MXU (triangular solves are sequential and
+    hostile to the TPU vector unit; an explicit inverse is one fused
+    matmul).
+    """
+    if not 0.0 < omega < 2.0:
+        raise ValueError(f"SOR omega must be in (0, 2), got {omega}")
+    A, n, lsize = _local_dense_blocks(comm, mat, "sor")
+
+    def ssor_inv(B):
+        Ad = B.toarray().astype(np.float64)
+        D = np.diag(Ad).copy()
+        D[D == 0] = 1.0
+        Dw = np.diag(D / omega)
+        M = ((Dw + np.tril(Ad, -1)) @ np.diag(omega / D)
+             @ (Dw + np.triu(Ad, 1)) / (2.0 - omega))
+        return scipy.linalg.inv(M)
+
+    inv = _per_device_inverse(A, n, lsize, comm.size, ssor_inv)
+    return _ship_blocks(comm, inv, mat.dtype)
+
+
+def _build_block_ilu(comm: DeviceComm, mat: Mat, fill: float):
+    """Per-device block ILU (PCILU; PCICC is an open alias of this path —
+    the incomplete factors come from unsymmetric ``spilu`` either way, and
+    both densify to an explicit (LU)⁻¹ for a one-matmul MXU apply (device
+    triangular solves are serial; the block is dense-capped anyway).
+    """
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spla
+    A, n, lsize = _local_dense_blocks(comm, mat, "ilu")
+
+    def ilu_inv(B):
+        Ad = sp.csc_matrix(B).astype(np.float64)
+        try:
+            f = spla.spilu(Ad, fill_factor=fill, drop_tol=1e-5)
+            return f.solve(np.eye(Ad.shape[0]))
+        except RuntimeError:        # singular pivot — fall back to exact
+            return scipy.linalg.inv(Ad.toarray())
+
+    inv = _per_device_inverse(A, n, lsize, comm.size, ilu_inv)
+    return _ship_blocks(comm, inv, mat.dtype)
+
+
+def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
+    """Restricted additive Schwarz (PCASM, PC_ASM_RESTRICT default).
+
+    Each device factorizes its row window extended by ``overlap`` rows on
+    each side; the apply solves on the window and keeps the owned interior.
+    Window rows outside the global range use identity padding.
+    """
+    ov = int(overlap)
+    if ov < 0:
+        raise ValueError(f"asm overlap must be >= 0, got {overlap}")
+    A, n, lsize = _local_dense_blocks(comm, mat, "asm")
+    if ov > lsize:
+        raise ValueError(
+            f"asm overlap {ov} exceeds the local block size {lsize} "
+            "(halo exchange is single-neighbor)")
+    ndev = comm.size
+    w = lsize + 2 * ov
+    inv = np.zeros((ndev, w, w), dtype=np.float64)
     for d in range(ndev):
-        rs, re = d * lsize, min((d + 1) * lsize, n)
-        blocks[d] = np.eye(lsize)
-        if rs < n:
-            m = re - rs
-            blocks[d, :m, :m] = A[rs:re, rs:re].toarray()
-    inv = np.stack([scipy.linalg.inv(b) for b in blocks]).astype(mat.dtype)
-    inv_dev = jax.device_put(
-        inv, jax.sharding.NamedSharding(comm.mesh, P(comm.axis)))
-    return (inv_dev,)
+        rs = d * lsize - ov
+        block = np.eye(w)
+        lo, hi = max(rs, 0), min(rs + w, n)
+        if lo < hi:
+            block[lo - rs:hi - rs, lo - rs:hi - rs] = \
+                A[lo:hi, lo:hi].toarray()
+        inv[d] = scipy.linalg.inv(block)
+    return _ship_blocks(comm, inv, mat.dtype)
 
 
 def _build_dense_lu(comm: DeviceComm, mat: Mat):
