@@ -6,9 +6,40 @@ from __future__ import annotations
 import mpi_petsc4py_example_tpu as _tps
 from mpi_petsc4py_example_tpu.solvers.eps import (
     EPS as _CoreEPS, EPSProblemType, EPSWhich)
+from mpi_petsc4py_example_tpu.solvers.st import ST as _CoreST
 
 from mpi4py import MPI as _MPI
 from petsc4py.PETSc import Mat as _Mat, Vec as _Vec, _mpi_comm
+
+
+class ST:
+    """Spectral-transformation handle (fronts solvers.st.ST)."""
+
+    class Type:
+        SHIFT = "shift"
+        SINVERT = "sinvert"
+
+    def __init__(self, core: _CoreST | None = None):
+        self._core = core if core is not None else _CoreST()
+
+    def setType(self, st_type):
+        self._core.set_type(st_type)
+
+    def getType(self):
+        return self._core.get_type()
+
+    def setShift(self, sigma):
+        self._core.set_shift(sigma)
+
+    def getShift(self):
+        return self._core.get_shift()
+
+    def setFromOptions(self):
+        self._core.set_from_options()
+
+    @property
+    def core(self):
+        return self._core
 
 
 class EPS:
@@ -24,6 +55,15 @@ class EPS:
         SMALLEST_MAGNITUDE = EPSWhich.SMALLEST_MAGNITUDE
         LARGEST_REAL = EPSWhich.LARGEST_REAL
         SMALLEST_REAL = EPSWhich.SMALLEST_REAL
+        TARGET_MAGNITUDE = EPSWhich.TARGET_MAGNITUDE
+        TARGET_REAL = EPSWhich.TARGET_REAL
+
+    class Type:
+        KRYLOVSCHUR = "krylovschur"
+        ARNOLDI = "arnoldi"
+        LANCZOS = "lanczos"
+        POWER = "power"
+        SUBSPACE = "subspace"
 
     def __init__(self):
         self._core = _CoreEPS()
@@ -48,6 +88,18 @@ class EPS:
 
     def setWhichEigenpairs(self, which):
         self._core.set_which_eigenpairs(which)
+
+    def setType(self, eps_type):
+        self._core.set_type(eps_type)
+
+    def getType(self):
+        return self._core.get_type()
+
+    def setTarget(self, target):
+        self._core.set_target(target)
+
+    def getST(self):
+        return ST(self._core.get_st())
 
     def setFromOptions(self):
         self._core.set_from_options()

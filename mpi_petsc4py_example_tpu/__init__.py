@@ -49,15 +49,18 @@ __all__ = [
     "DeviceComm", "get_default_comm", "set_default_comm", "as_comm",
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
-    "Vec", "Mat", "PC", "KSP", "EPS",
+    "Vec", "Mat", "PC", "KSP", "EPS", "ST",
     "ConvergedReason", "SolveResult",
     "Options", "global_options", "init", "backend",
 ]
 
 
 def __getattr__(name):
-    # EPS imported lazily to keep base import light
+    # EPS/ST imported lazily to keep base import light
     if name == "EPS":
         from .solvers.eps import EPS
         return EPS
+    if name == "ST":
+        from .solvers.st import ST
+        return ST
     raise AttributeError(name)

@@ -1,3 +1,4 @@
 from .pc import PC
 from .ksp import KSP
 from .eps import EPS
+from .st import ST

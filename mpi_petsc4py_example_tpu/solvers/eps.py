@@ -3,15 +3,30 @@
 Reference usage (``petsc_funcs.py:13-20``, ``test2.py:88-96``): ``EPS().create``,
 ``setOperators``, ``setProblemType(HEP)``, ``setFromOptions``, ``solve``,
 ``getConverged``, ``getEigenpair(i, vr, vi)``. SLEPc's default configuration —
-Krylov-Schur, nev=1, largest magnitude — is the semantic target.
+**Krylov-Schur**, nev=1, largest magnitude [external] — is the semantic target,
+and Krylov-Schur (thick-restart Arnoldi/Lanczos) is the default type here too.
 
-Algorithm: explicitly-restarted Arnoldi with full (classical, twice-applied)
-Gram–Schmidt orthogonalization. The ncv-step factorization is one jit-compiled
-``shard_map`` program (SpMV + ``lax.psum`` dots over the mesh); the small
-(ncv×ncv) Rayleigh-quotient eigenproblem is solved on host each restart, which
-mirrors SLEPc's own dense-subproblem split. For Hermitian problems (HEP) the
-projected matrix is symmetrized — full reorthogonalization makes this the
-Lanczos process with reliable numerics.
+Solver types (``set_type`` / ``-eps_type``):
+
+* ``krylovschur`` — thick-restart Arnoldi (Krylov-Schur). The ncv-step
+  factorization *continuation* is one jit-compiled ``shard_map`` program
+  (SpMV + ``lax.psum`` CGS2 dots over the mesh); each restart compresses the
+  basis to the k wanted Ritz/Schur vectors **on device** (one sharded matmul)
+  and re-enters the same compiled program at step k. The small (ncv x ncv)
+  projected eigenproblem is solved on host each restart — mirroring SLEPc's
+  own dense-subproblem split.
+* ``arnoldi``  — explicitly-restarted Arnoldi (restart vector = combination
+  of wanted Ritz vectors).
+* ``lanczos``  — Hermitian alias of the thick-restart path (full CGS2
+  reorthogonalization makes the factorization a numerically-reliable Lanczos
+  process).
+* ``power``    — power iteration, chunked into a jitted program.
+* ``subspace`` — subspace iteration with host Rayleigh-Ritz projection.
+
+Spectral transformations (``ST``; ``-st_type sinvert -st_shift s``) and
+generalized Hermitian problems ``A x = lambda B x`` are supported: the solver
+runs on the transformed operator (solvers/st.py) and — for GHEP — performs all
+orthogonalization in the B-inner product, then back-transforms the Ritz values.
 
 Unlike the reference driver — which calls the collective ``getEigenpair``
 under ``if rank == 0:`` (a latent deadlock, SURVEY.md §3.2) — eigenpair
@@ -32,18 +47,20 @@ from jax.sharding import PartitionSpec as P
 from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
-from ..ops.spmv import ell_spmv_local
 from ..utils.convergence import SolveResult
 from ..utils.options import global_options
+from .st import ST
 
 DEFAULT_TOL = 1e-8        # SLEPc's EPS default
 DEFAULT_MAX_RESTARTS = 100
+
+EPS_TYPES = ("krylovschur", "arnoldi", "lanczos", "power", "subspace")
 
 
 class EPSProblemType:
     HEP = "hep"       # Hermitian
     NHEP = "nhep"     # non-Hermitian
-    GHEP = "ghep"     # generalized Hermitian (not yet supported)
+    GHEP = "ghep"     # generalized Hermitian, B SPD
 
 
 class EPSWhich:
@@ -51,58 +68,75 @@ class EPSWhich:
     SMALLEST_MAGNITUDE = "smallest_magnitude"
     LARGEST_REAL = "largest_real"
     SMALLEST_REAL = "smallest_real"
+    TARGET_MAGNITUDE = "target_magnitude"
+    TARGET_REAL = "target_real"
 
 
-_ARNOLDI_CACHE: dict = {}
+_PROGRAM_CACHE: dict = {}
 
 
-def _build_arnoldi_program(comm: DeviceComm, operator, ncv: int):
-    """ncv-step Arnoldi factorization as one SPMD program.
+def _op_key(op):
+    return (op.shape[0], str(op.dtype), op.program_key())
 
-    ``operator`` implements the linear-operator protocol (core.mat.Mat or a
-    matrix-free operator). Returns ``(V, H)`` with ``V`` of global shape
-    ``(ncv+1, n_pad)`` (sharded on the row axis) and ``H`` the replicated
-    ``(ncv+1, ncv)`` Hessenberg matrix. Orthogonalization is classical
-    Gram–Schmidt applied twice ("CGS2"), which is communication-optimal on
-    the mesh (two fused psums per step instead of j sequential ones) and as
-    stable as modified GS.
+
+def _build_factorization_program(comm: DeviceComm, op, ncv: int, inner=None):
+    """Arnoldi/Lanczos factorization *continuation* as one SPMD program.
+
+    Signature: ``prog(op_arrays, inner_arrays, V, H, k) -> (V, H)``.
+
+    ``V`` has global shape ``(ncv+1, n_pad)`` sharded on the row axis; rows
+    ``0..k`` hold an orthonormal basis (row ``k`` = the new start/residual
+    direction, normalized on entry), rows beyond ``k`` are zero. ``H`` is the
+    replicated ``(ncv+1, ncv)`` projected matrix with columns ``0..k-1``
+    prefilled by the restart (arrow structure). The program runs steps
+    ``k..ncv-1`` of the factorization with CGS2 orthogonalization — two fused
+    psums per step. ``k=0`` with empty ``H`` is a fresh factorization.
+
+    ``inner`` (optional) supplies the B-inner product for generalized
+    problems: all dots/norms become ``<u, v>_B = u^T B v``.
     """
     axis = comm.axis
-    n = operator.shape[0]
-    key = (comm.mesh, axis, n, ncv, str(operator.dtype),
-           operator.program_key())
-    cached = _ARNOLDI_CACHE.get(key)
+    key = ("facto", comm.mesh, axis, ncv, _op_key(op),
+           _op_key(inner) if inner is not None else None)
+    cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
 
-    spmv_local = operator.local_spmv(comm)
-    op_specs = operator.op_specs(axis)
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+    if inner is not None:
+        b_apply = inner.local_spmv(comm)
+        b_specs = inner.op_specs(axis)
+    else:
+        b_apply = None
+        b_specs = ()
 
-    def local_fn(op_arrays, v0):
-        lsize = v0.shape[0]
-
+    def local_fn(op_arrays, b_arrays, V, H, k):
         def A(v):
-            return spmv_local(op_arrays, v)
+            return spmv(op_arrays, v)
 
-        def pdot_vec(Vb, w):
-            return lax.psum(Vb @ w, axis)
+        def Bip(v):
+            return b_apply(b_arrays, v) if b_apply is not None else v
+
+        def pdot_vec(Vb, wB):
+            return lax.psum(Vb @ wB, axis)
 
         def pnorm(u):
-            return jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+            return jnp.sqrt(lax.psum(jnp.vdot(u, Bip(u)), axis))
 
-        nrm0 = pnorm(v0)
-        v0n = v0 / jnp.where(nrm0 == 0, 1.0, nrm0)
-        V = jnp.zeros((ncv + 1, lsize), v0.dtype).at[0].set(v0n)
-        H = jnp.zeros((ncv + 1, ncv), v0.dtype)
+        vk = V[k]
+        nrm = pnorm(vk)
+        V = V.at[k].set(vk / jnp.where(nrm == 0, 1.0, nrm))
 
         def step(j, VH):
             V, H = VH
             w = A(V[j])
-            # CGS2: rows of V beyond j+1 are zero, so projecting against the
-            # whole basis needs no masking.
-            h1 = pdot_vec(V, w)
+            # CGS2 against the whole basis: rows beyond j+1 are zero, so no
+            # masking is needed; for restarts this also fills the arrow
+            # column H[0:k, k] automatically.
+            h1 = pdot_vec(V, Bip(w))
             w = w - h1 @ V
-            h2 = pdot_vec(V, w)
+            h2 = pdot_vec(V, Bip(w))
             w = w - h2 @ V
             h = h1 + h2
             b = pnorm(w)
@@ -111,14 +145,103 @@ def _build_arnoldi_program(comm: DeviceComm, operator, ncv: int):
             H = H.at[j + 1, j].set(b)
             return (V, H)
 
-        V, H = lax.fori_loop(0, ncv, step, (V, H))
+        V, H = lax.fori_loop(k, ncv, step, (V, H))
         return V, H
 
     prog = jax.jit(comm.shard_map(
         local_fn,
-        in_specs=(op_specs, P(axis)),
+        in_specs=(op_specs, b_specs, P(None, axis), P(), P()),
         out_specs=(P(None, axis), P())))
-    _ARNOLDI_CACHE[key] = prog
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _build_restart_program(comm: DeviceComm, ncv: int):
+    """Thick-restart basis compression, on device: ``V_new[0:k] = S^T V[0:ncv]``
+    (one sharded matmul — the basis never visits the host), ``V_new[k] =
+    V[ncv]`` (the residual direction), rows beyond ``k`` zeroed.
+
+    ``S`` is replicated ``(ncv, ncv)`` with columns beyond ``k`` zero.
+    """
+    axis = comm.axis
+    key = ("restart", comm.mesh, axis, ncv)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local_fn(V, S, k):
+        Vr = S.T @ V[:ncv]                       # (ncv, lsize)
+        row = jnp.arange(ncv)[:, None]
+        Vnew = jnp.zeros_like(V)
+        Vnew = Vnew.at[:ncv].set(jnp.where(row < k, Vr, 0))
+        Vnew = Vnew.at[k].set(V[ncv])
+        return Vnew
+
+    prog = jax.jit(comm.shard_map(
+        local_fn,
+        in_specs=(P(None, axis), P(), P()),
+        out_specs=P(None, axis)))
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _build_power_program(comm: DeviceComm, op, steps: int):
+    """``steps`` normalized power steps + Rayleigh quotient/residual, jitted."""
+    axis = comm.axis
+    key = ("power", comm.mesh, axis, steps, _op_key(op))
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+
+    def local_fn(op_arrays, v):
+        def A(u):
+            return spmv(op_arrays, u)
+
+        def pnorm(u):
+            return jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+
+        def step(_, u):
+            w = A(u)
+            return w / pnorm(w)
+
+        v = v / pnorm(v)
+        v = lax.fori_loop(0, steps, step, v)
+        w = A(v)
+        theta = lax.psum(jnp.vdot(v, w), axis)
+        res = pnorm(w - theta * v)
+        return v, theta, res
+
+    prog = jax.jit(comm.shard_map(
+        local_fn,
+        in_specs=(op_specs, P(axis)),
+        out_specs=(P(axis), P(), P())))
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _build_block_mult_program(comm: DeviceComm, op, m: int):
+    """Apply the operator to each of ``m`` basis rows (statically unrolled)."""
+    axis = comm.axis
+    key = ("blockmult", comm.mesh, axis, m, _op_key(op))
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+
+    def local_fn(op_arrays, Y):
+        rows = [spmv(op_arrays, Y[j]) for j in range(m)]
+        return jnp.stack(rows)
+
+    prog = jax.jit(comm.shard_map(
+        local_fn,
+        in_specs=(op_specs, P(None, axis)),
+        out_specs=P(None, axis)))
+    _PROGRAM_CACHE[key] = prog
     return prog
 
 
@@ -127,12 +250,17 @@ class EPS:
 
     ProblemType = EPSProblemType
     Which = EPSWhich
+    Type = EPS_TYPES
 
     def __init__(self, comm=None):
         self.comm = None
         self._mat: Mat | None = None
+        self._bmat: Mat | None = None
+        self._type = "krylovschur"     # SLEPc default
         self._problem_type = EPSProblemType.NHEP
         self._which = EPSWhich.LARGEST_MAGNITUDE
+        self._target: float | None = None
+        self.st = ST()
         self.nev = 1                  # SLEPc default
         self.ncv: int | None = None   # auto: max(2*nev, nev+15), capped at n
         self.tol = DEFAULT_TOL
@@ -153,11 +281,26 @@ class EPS:
     def destroy(self):
         return self
 
+    def set_type(self, eps_type: str):
+        eps_type = str(eps_type).lower()
+        if eps_type not in EPS_TYPES:
+            raise ValueError(f"unknown EPS type {eps_type!r}; "
+                             f"available: {EPS_TYPES}")
+        self._type = eps_type
+        return self
+
+    setType = set_type
+
+    def get_type(self) -> str:
+        return self._type
+
+    getType = get_type
+
     def set_operators(self, A: Mat, B: Mat | None = None):
-        if B is not None:
-            raise NotImplementedError("generalized eigenproblems (GHEP) "
-                                      "are not supported yet")
         self._mat = A
+        self._bmat = B
+        if B is not None and self._problem_type not in (EPSProblemType.GHEP,):
+            self._problem_type = EPSProblemType.GHEP
         if self.comm is None:
             self.create(A.comm)
         return self
@@ -166,7 +309,8 @@ class EPS:
 
     def set_problem_type(self, ptype):
         ptype = str(ptype).lower()
-        if ptype not in (EPSProblemType.HEP, EPSProblemType.NHEP):
+        if ptype not in (EPSProblemType.HEP, EPSProblemType.NHEP,
+                         EPSProblemType.GHEP):
             raise ValueError(f"unsupported problem type {ptype!r}")
         self._problem_type = ptype
         return self
@@ -178,6 +322,19 @@ class EPS:
         return self
 
     setWhichEigenpairs = set_which_eigenpairs
+
+    def set_target(self, target: float):
+        """Target value for ``target_*`` selections; with ST ``sinvert`` the
+        target doubles as the default shift (SLEPc's convention)."""
+        self._target = float(target)
+        return self
+
+    setTarget = set_target
+
+    def get_st(self) -> ST:
+        return self.st
+
+    getST = get_st
 
     def set_dimensions(self, nev: int | None = None, ncv: int | None = None):
         if nev is not None:
@@ -198,10 +355,14 @@ class EPS:
     setTolerances = set_tolerances
 
     def set_from_options(self):
-        """Apply ``-eps_nev``, ``-eps_ncv``, ``-eps_tol``, ``-eps_max_it``,
-        ``-eps_hermitian``, ``-eps_which`` from the options DB
+        """Apply ``-eps_type``, ``-eps_nev``, ``-eps_ncv``, ``-eps_tol``,
+        ``-eps_max_it``, ``-eps_hermitian``, ``-eps_which``, ``-eps_target``
+        plus the ST options (``-st_type``, ``-st_shift``) from the options DB
         (the reference's ``E.setFromOptions()``, ``petsc_funcs.py:17``)."""
         opt = global_options()
+        eps_type = opt.get_string("eps_type")
+        if eps_type:
+            self.set_type(eps_type)
         self.nev = opt.get_int("eps_nev", self.nev)
         ncv = opt.get_int("eps_ncv", None)
         if ncv is not None:
@@ -213,97 +374,364 @@ class EPS:
         which = opt.get_string("eps_which")
         if which:
             self._which = which
+        target = opt.get_real("eps_target", None)
+        if target is not None:
+            self.set_target(target)
+        self.st.set_from_options()
         return self
 
     setFromOptions = set_from_options
 
-    # ---- solve --------------------------------------------------------------
+    # ---- selection ----------------------------------------------------------
     def _effective_ncv(self, n: int) -> int:
         if self.ncv is not None:
             return min(self.ncv, n)
         return min(n, max(2 * self.nev, self.nev + 15))
 
-    def _select(self, lam: np.ndarray) -> np.ndarray:
+    def _metric(self, lam: np.ndarray) -> np.ndarray:
+        """Bigger = more wanted (used for both sorting and Schur selection)."""
         w = self._which
         if w == EPSWhich.LARGEST_MAGNITUDE:
-            return np.argsort(-np.abs(lam))
+            return np.abs(lam)
         if w == EPSWhich.SMALLEST_MAGNITUDE:
-            return np.argsort(np.abs(lam))
+            return -np.abs(lam)
         if w == EPSWhich.LARGEST_REAL:
-            return np.argsort(-lam.real)
+            return np.real(lam)
         if w == EPSWhich.SMALLEST_REAL:
-            return np.argsort(lam.real)
-        raise ValueError(f"unknown which {w!r}")
+            return -np.real(lam)
+        if w == EPSWhich.TARGET_MAGNITUDE:
+            tau = 0.0 if self._target is None else self._target
+            return -np.abs(lam - tau)
+        if w == EPSWhich.TARGET_REAL:
+            tau = 0.0 if self._target is None else self._target
+            return -np.abs(np.real(lam) - tau)
+        raise ValueError(f"unknown which {self._which!r}")
 
+    def _select(self, lam: np.ndarray) -> np.ndarray:
+        finite = np.where(np.isfinite(lam), self._metric(lam), -np.inf)
+        return np.argsort(-finite, kind="stable")
+
+    # ---- solve --------------------------------------------------------------
     def solve(self):
         mat = self._mat
         if mat is None:
             raise RuntimeError("EPS.solve: no operators set")
-        comm = mat.comm
-        n = mat.shape[0]
-        ncv = self._effective_ncv(n)
-        hermitian = self._problem_type == EPSProblemType.HEP
-        prog = _build_arnoldi_program(comm, mat, ncv)
-        op_arrays = mat.device_arrays()
-
-        rng = np.random.default_rng(20240901)
-        v0 = comm.put_rows(rng.standard_normal(comm.padded_size(n))
-                           .astype(mat.dtype))
-        # zero out padding so it never enters the Krylov space
-        npad = comm.padded_size(n)
-        if npad > n:
-            mask = np.zeros(npad, dtype=bool)
-            mask[:n] = True
-            v0 = v0 * comm.put_rows(mask.astype(mat.dtype))
+        if self._bmat is not None and \
+                self._problem_type != EPSProblemType.GHEP:
+            raise ValueError("two operators were set; problem type must be "
+                             "'ghep' (B must be SPD)")
+        if self._problem_type == EPSProblemType.GHEP and self._bmat is None:
+            raise ValueError("problem type 'ghep' needs operators (A, B)")
+        # SLEPc convention: a target with sinvert supplies the shift.
+        if (self._target is not None and self.st.get_type() == "sinvert"
+                and self.st.sigma == 0.0):
+            self.st.set_shift(self._target)
 
         t0 = time.perf_counter()
-        restarts = 0
-        for restarts in range(1, self.max_it + 1):
-            V, H = prog(op_arrays, v0)
-            Hm = np.asarray(H)[:ncv, :ncv]
-            beta = float(np.asarray(H)[ncv, ncv - 1])
-            if hermitian:
-                Hm = (Hm + Hm.T) / 2.0
-                lam, S = np.linalg.eigh(Hm)
-            else:
-                lam, S = np.linalg.eig(Hm)
-            order = self._select(lam)
-            lam, S = lam[order], S[:, order]
-            # Ritz residual estimate: ||A y - λ y|| = |beta| * |last row of S|
-            res = np.abs(beta) * np.abs(S[-1, :])
-            denom = np.maximum(np.abs(lam), 1e-300)
-            rel = res / denom
-            # converged = leading run of wanted Ritz pairs within tolerance
-            k = min(self.nev, ncv)
-            nconv = 0
-            while nconv < k and rel[nconv] <= self.tol:
-                nconv += 1
-            if nconv >= self.nev or ncv >= n:
-                break
-            # explicit restart: new start vector = combination of the wanted,
-            # not-yet-converged Ritz vectors
-            Vm = np.asarray(V)[:ncv, :]          # (ncv, n_pad)
-            wanted = S[:, :k].real.sum(axis=1)
-            v0_host = wanted @ Vm
-            v0 = comm.put_rows(v0_host.astype(np.asarray(Vm).dtype))
+        if self._type == "power":
+            self._solve_power()
+        elif self._type == "subspace":
+            self._solve_subspace()
+        elif self._type == "arnoldi":
+            self._solve_arnoldi_explicit()
+        else:  # krylovschur / lanczos
+            if self._type == "lanczos" and self._problem_type not in (
+                    EPSProblemType.HEP, EPSProblemType.GHEP):
+                raise ValueError("EPS 'lanczos' needs a Hermitian problem "
+                                 "type (hep/ghep)")
+            self._solve_krylovschur()
+        wall = time.perf_counter() - t0
+        self.result = SolveResult(
+            self._its, float(self._residuals[0]) if len(self._residuals)
+            else 0.0, 2 if self._nconv >= self.nev else -3, wall)
+        from ..utils.profiling import record_event
+        record_event(
+            f"EPSSolve({self._type},{self._problem_type},nev={self.nev})",
+            mat.shape[0], self._its, wall, self.result.reason)
+        return self
 
-        Vm = np.asarray(V)[:ncv, :]
-        vecs = (S[:, :max(self.nev, 1)].T @ Vm)[:, :n]   # (k, n)
-        # normalize
+    # ---- shared pieces ------------------------------------------------------
+    def _setup_operator(self):
+        comm = self._mat.comm
+        hermitian = self._problem_type in (EPSProblemType.HEP,
+                                           EPSProblemType.GHEP)
+        # Cache the built ST operator: sinvert/GHEP factorize a dense inverse
+        # on host (O(n^3)) — rebuilding it per solve() with unchanged
+        # (A, B, st) would repeat that and re-ship the replicated inverse.
+        key = (self._mat, self._bmat, self.st.get_type(), self.st.sigma)
+        cached = getattr(self, "_op_cache", None)
+        if cached is not None and cached[0] == key:
+            return comm, cached[1], cached[2], hermitian
+        op, inner = self.st.build_operator(self._mat, self._bmat)
+        self._op_cache = (key, op, inner)
+        return comm, op, inner, hermitian
+
+    def _dominant_only(self, solver: str):
+        """power/subspace converge to the *dominant* (transformed) subspace —
+        any other selection silently returns wrong pairs (SLEPc's EPSPOWER
+        errors the same way)."""
+        ok = self._which == EPSWhich.LARGEST_MAGNITUDE or (
+            self._which == EPSWhich.TARGET_MAGNITUDE
+            and self.st.get_type() == "sinvert")
+        if not ok:
+            raise ValueError(
+                f"EPS {solver!r} computes dominant eigenpairs only — use "
+                f"which='largest_magnitude' (or 'target_magnitude' with ST "
+                f"'sinvert'), not {self._which!r}; krylovschur supports all "
+                "selections")
+
+    def _rayleigh_ritz(self, Hh: np.ndarray, ncv: int, nev: int,
+                       hermitian: bool):
+        """Shared projected-eigenproblem + selection + convergence step.
+
+        Returns ``(beta, lam_t, S, order, rel, nconv)``: the subdiagonal
+        residual norm, transformed Ritz values, projected eigenvectors, the
+        which-ordering, relative residual estimates (ordered), and the count
+        of leading converged wanted pairs. The Ritz residual
+        ``|beta| |e_m^T y|`` is valid for the arrow+Hessenberg projected
+        matrix too (the Krylov-Schur relation ``T V = V H + beta v e_m^T``
+        holds after every thick restart).
+        """
+        Hm = Hh[:ncv, :ncv]
+        beta = float(Hh[ncv, ncv - 1])
+        if hermitian:
+            Hm = (Hm + Hm.T) / 2.0
+            lam_t, S = np.linalg.eigh(Hm)
+        else:
+            lam_t, S = np.linalg.eig(Hm)
+        order = self._select(self.st.back_transform(lam_t))
+        res = np.abs(beta) * np.abs(S[ncv - 1, order])
+        denom = np.maximum(np.abs(lam_t[order]), 1e-300)
+        rel = res / denom
+        nconv = 0
+        while nconv < min(nev, len(rel)) and rel[nconv] <= self.tol:
+            nconv += 1
+        return beta, lam_t, S, order, rel, nconv
+
+    def _start_vector(self, comm, n, dtype):
+        rng = np.random.default_rng(20240901)
+        npad = comm.padded_size(n)
+        v0 = rng.standard_normal(npad)
+        v0[n:] = 0.0        # padding never enters the Krylov space
+        return v0.astype(dtype)
+
+    def _store(self, lam, vecs, rel, nconv, its):
+        self._eigenvalues = np.asarray(lam)
+        self._eigenvectors = np.asarray(vecs)
+        self._residuals = np.asarray(rel, dtype=float)
+        self._nconv = int(nconv)
+        self._its = int(its)
+
+    def _extract(self, Vh, S, lam_t, order, n, count):
+        """Ritz vectors ``(count, n)`` from host basis + projected vectors,
+        back-transformed eigenvalues, normalized."""
+        take = order[:count]
+        vecs = (S[:, take].T @ Vh)[:, :n]
         nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
         nrm[nrm == 0] = 1.0
         vecs = vecs / nrm
-        self._eigenvalues = lam[: max(self.nev, 1)]
-        self._eigenvectors = vecs
-        self._residuals = rel[: max(self.nev, 1)]
-        self._nconv = int(nconv)
-        wall = time.perf_counter() - t0
-        self.result = SolveResult(restarts, float(rel[0]) if len(rel) else 0.0,
-                                  2 if self._nconv >= self.nev else -3, wall)
-        from ..utils.profiling import record_event
-        record_event(f"EPSSolve({self._problem_type},nev={self.nev})", n,
-                     restarts, wall, self.result.reason)
-        return self
+        lam = self.st.back_transform(lam_t[take])
+        return lam, vecs
+
+    # ---- krylovschur (thick restart) ----------------------------------------
+    def _solve_krylovschur(self):
+        comm, op, inner, hermitian = self._setup_operator()
+        n = op.shape[0]
+        ncv = self._effective_ncv(n)
+        nev = min(self.nev, ncv)
+        prog = _build_factorization_program(comm, op, ncv, inner)
+        restart_prog = _build_restart_program(comm, ncv)
+        op_arrays = op.device_arrays()
+        b_arrays = inner.device_arrays() if inner is not None else ()
+
+        npad = comm.padded_size(n)
+        dtype = np.dtype(str(op.dtype))
+        V_host = np.zeros((ncv + 1, npad), dtype=dtype)
+        V_host[0] = self._start_vector(comm, n, dtype)
+        V = jax.device_put(
+            V_host, jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis)))
+        H = np.zeros((ncv + 1, ncv), dtype=dtype)
+        k = 0
+
+        for restarts in range(1, self.max_it + 1):
+            V, H = prog(op_arrays, b_arrays, V, H,
+                        np.asarray(k, dtype=np.int32))
+            Hh = np.asarray(H, dtype=np.float64)
+            beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
+                Hh, ncv, nev, hermitian)
+            if nconv >= nev or ncv >= n or restarts == self.max_it:
+                break
+
+            # ---- thick restart: keep k wanted Ritz/Schur directions --------
+            k = int(min(max(nev, ncv // 2), ncv - 1))
+            if hermitian:
+                take = order[:k]
+                T_new = np.diag(lam_t[take])
+                b_new = beta * S[ncv - 1, take]
+                S_keep = S[:, take]
+            else:
+                Hm = Hh[:ncv, :ncv]
+                thresh = np.sort(self._metric(
+                    self.st.back_transform(lam_t)))[::-1][k - 1]
+
+                def want(re, im):
+                    lam = self.st.back_transform(
+                        np.asarray(re + 1j * im))
+                    return bool(self._metric(lam) >= thresh - 1e-12)
+
+                T, Z, sdim = _ordered_schur(Hm, want)
+                k = int(min(max(sdim, 1), ncv - 1))
+                # never cut through a 2x2 (complex-pair) block: T[k, k-1] != 0
+                # means rows k-1,k are coupled — truncating there would break
+                # the Krylov-Schur relation and poison later residuals
+                if 0 < k < ncv and T[k, k - 1] != 0.0:
+                    k = k - 1 if k > 1 else min(k + 1, ncv - 1)
+                k = int(min(max(k, 1), ncv - 1))
+                T_new = T[:k, :k]
+                b_new = beta * Z[ncv - 1, :k]
+                S_keep = Z[:, :k]
+
+            H = np.zeros((ncv + 1, ncv), dtype=dtype)
+            H[:k, :k] = T_new
+            H[k, :k] = b_new
+            S_pad = np.zeros((ncv, ncv), dtype=dtype)
+            S_pad[:, :k] = S_keep
+            V = restart_prog(V, S_pad, np.asarray(k, dtype=np.int32))
+
+        Vh = np.asarray(V)[:ncv]
+        count = max(nev, 1)
+        lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
+        self._store(lam, vecs, rel[:count], nconv, restarts)
+
+    # ---- explicitly-restarted arnoldi ---------------------------------------
+    def _solve_arnoldi_explicit(self):
+        comm, op, inner, hermitian = self._setup_operator()
+        n = op.shape[0]
+        ncv = self._effective_ncv(n)
+        nev = min(self.nev, ncv)
+        prog = _build_factorization_program(comm, op, ncv, inner)
+        op_arrays = op.device_arrays()
+        b_arrays = inner.device_arrays() if inner is not None else ()
+
+        npad = comm.padded_size(n)
+        dtype = np.dtype(str(op.dtype))
+        v0 = self._start_vector(comm, n, dtype)
+        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
+
+        for restarts in range(1, self.max_it + 1):
+            V_host = np.zeros((ncv + 1, npad), dtype=dtype)
+            V_host[0] = v0
+            V = jax.device_put(V_host, sharding)
+            H = np.zeros((ncv + 1, ncv), dtype=dtype)
+            V, H = prog(op_arrays, b_arrays, V, H,
+                        np.asarray(0, dtype=np.int32))
+            Hh = np.asarray(H, dtype=np.float64)
+            beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
+                Hh, ncv, nev, hermitian)
+            if nconv >= nev or ncv >= n or restarts == self.max_it:
+                break
+            # restart vector: combination of wanted, not-yet-converged Ritz
+            Vh = np.asarray(V)[:ncv]
+            wanted = S[:, order[:nev]].real.sum(axis=1)
+            v0 = (wanted @ Vh).astype(dtype)
+            v0[n:] = 0.0
+
+        Vh = np.asarray(V)[:ncv]
+        count = max(nev, 1)
+        lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
+        self._store(lam, vecs, rel[:count], nconv, restarts)
+
+    # ---- power iteration ----------------------------------------------------
+    def _solve_power(self):
+        self._dominant_only("power")
+        comm, op, inner, hermitian = self._setup_operator()
+        if inner is not None:
+            raise ValueError("EPS 'power' supports standard problems only "
+                             "(use krylovschur for GHEP)")
+        n = op.shape[0]
+        steps = 8
+        prog = _build_power_program(comm, op, steps)
+        op_arrays = op.device_arrays()
+        dtype = np.dtype(str(op.dtype))
+        v = comm.put_rows(self._start_vector(comm, n, dtype))
+
+        theta = 0.0
+        rel = np.inf
+        its = 0
+        for chunk in range(1, self.max_it + 1):
+            v, theta_a, res_a = prog(op_arrays, v)
+            theta = float(theta_a)
+            res = float(res_a)
+            rel = res / max(abs(theta), 1e-300)
+            its = chunk * steps
+            if rel <= self.tol:
+                break
+
+        lam = self.st.back_transform(np.asarray([theta]))
+        vec = np.asarray(v)[:n]
+        nrm = np.linalg.norm(vec)
+        vec = vec / (nrm if nrm else 1.0)
+        self._store(lam, vec[None, :], [rel], 1 if rel <= self.tol else 0,
+                    its)
+
+    # ---- subspace iteration --------------------------------------------------
+    def _solve_subspace(self):
+        self._dominant_only("subspace")
+        comm, op, inner, hermitian = self._setup_operator()
+        if inner is not None:
+            raise ValueError("EPS 'subspace' supports standard problems only "
+                             "(use krylovschur for GHEP)")
+        n = op.shape[0]
+        _SUBSPACE_NCV_CAP = 32   # the block spmvs are statically unrolled
+        if (self.ncv is not None and self.ncv > _SUBSPACE_NCV_CAP) or \
+                self.nev > _SUBSPACE_NCV_CAP:
+            raise ValueError(
+                f"EPS 'subspace' caps ncv at {_SUBSPACE_NCV_CAP} (the block "
+                "operator applications are unrolled into one program) — "
+                "use krylovschur for larger subspaces")
+        ncv = min(self._effective_ncv(n), _SUBSPACE_NCV_CAP)
+        nev = min(self.nev, ncv)
+        prog = _build_block_mult_program(comm, op, ncv)
+        op_arrays = op.device_arrays()
+        dtype = np.dtype(str(op.dtype))
+        npad = comm.padded_size(n)
+        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
+
+        rng = np.random.default_rng(20240901)
+        Y = rng.standard_normal((ncv, npad)).astype(dtype)
+        Y[:, n:] = 0.0
+
+        for it in range(1, self.max_it + 1):
+            Q = np.linalg.qr(Y[:, :n].T)[0].T        # (ncv, n) orthonormal rows
+            Qp = np.zeros((ncv, npad), dtype=dtype)
+            Qp[:, :n] = Q
+            W = np.asarray(prog(op_arrays, jax.device_put(Qp, sharding)))
+            Hm = Q @ W[:, :n].T           # Hm[i,j] = <q_i, A q_j>, W[j] = A q_j
+            if hermitian:
+                Hm = (Hm + Hm.T) / 2.0
+                lam_t, S = np.linalg.eigh(Hm)
+            else:
+                lam_t, S = np.linalg.eig(Hm)
+            order = self._select(self.st.back_transform(lam_t))
+            X = (S[:, order].T @ Q)                   # Ritz rows (ncv, n)
+            AX = (S[:, order].T @ W[:, :n])
+            R = AX - lam_t[order][:, None] * X
+            rel = (np.linalg.norm(R, axis=1)
+                   / np.maximum(np.abs(lam_t[order]), 1e-300))
+            nconv = 0
+            while nconv < nev and rel[nconv] <= self.tol:
+                nconv += 1
+            if nconv >= nev or it == self.max_it:
+                break
+            Y = np.zeros((ncv, npad), dtype=dtype)
+            Y[:, :n] = np.real(W[:, :n])              # power step: Y <- A Q
+
+        count = max(nev, 1)
+        lam = self.st.back_transform(lam_t[order[:count]])
+        vecs = X[:count]
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        self._store(lam, vecs / nrm, rel[:count], nconv, it)
 
     # ---- results (slepc4py-shaped, collective-safe) --------------------------
     def get_converged(self) -> int:
@@ -324,7 +752,7 @@ class EPS:
 
     def get_eigenpair(self, i: int, vr: Vec | None = None,
                       vi: Vec | None = None):
-        """Fill ``vr``/``vi`` with the i-th eigenvector and return λ.
+        """Fill ``vr``/``vi`` with the i-th eigenvector and return lambda.
 
         Host-replicated — safe to call from any control context (the
         reference calls SLEPc's collective version rank-0-only, test2.py:94-96,
@@ -346,5 +774,16 @@ class EPS:
     getErrorEstimate = get_error_estimate
 
     def __repr__(self):
-        return (f"EPS(problem={self._problem_type!r}, nev={self.nev}, "
-                f"which={self._which!r}, tol={self.tol})")
+        return (f"EPS(type={self._type!r}, problem={self._problem_type!r}, "
+                f"nev={self.nev}, which={self._which!r}, tol={self.tol})")
+
+
+def _ordered_schur(Hm: np.ndarray, want):
+    """Real Schur form with the wanted eigenvalues ordered first.
+
+    ``want(re, im) -> bool``; LAPACK keeps 2x2 (complex-pair) blocks intact,
+    so the returned ``sdim`` may differ from the requested count by one.
+    """
+    import scipy.linalg
+    T, Z, sdim = scipy.linalg.schur(Hm, output="real", sort=want)
+    return T, Z, sdim

@@ -1,0 +1,242 @@
+"""ST — spectral transformations, TPU-native equivalent of SLEPc's ST object.
+
+The reference reaches SLEPc's ST implicitly: ``E.setFromOptions()``
+(petsc_funcs.py:17) honors ``-st_type sinvert -st_shift <s>`` at runtime
+[external], which is how SLEPc users compute interior/smallest eigenvalues.
+Types:
+
+* ``shift``   — operate on ``A - sigma*I``    (theta = lambda - sigma).
+* ``sinvert`` — operate on ``(A - sigma*I)^-1`` (theta = 1/(lambda - sigma));
+  shift-and-invert, the standard route to eigenvalues nearest a target.
+
+With a generalized problem ``A x = lambda B x`` (B SPD) the transformed
+operators become ``B^-1 (A - sigma*B)`` and ``(A - sigma*B)^-1 B``; both are
+self-adjoint in the B-inner product, which the eigensolver's Lanczos
+orthogonalization uses (see :meth:`STOperator.inner_operator`).
+
+TPU mapping: the inverse applies are replicated dense inverses factorized on
+the host in fp64 (XLA:TPU has no f64 LuDecomposition — same design as PC
+``lu``, solvers/pc.py) and applied on device as one MXU matmul against the
+all-gathered vector inside the jit-compiled shard_map Arnoldi body. Forward
+(non-inverted) applies ride the operator's own sharded SpMV.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+ST_TYPES = ("shift", "sinvert")
+
+_DENSE_CAP = 16384  # same host-factorization bound as solvers/pc.py
+
+
+class ST:
+    """Spectral-transformation context, slepc4py-``ST``-shaped."""
+
+    def __init__(self):
+        self._type = "shift"
+        self.sigma = 0.0
+
+    def set_type(self, st_type: str):
+        st_type = str(st_type).lower()
+        if st_type not in ST_TYPES:
+            raise ValueError(f"unknown ST type {st_type!r}; "
+                             f"available: {ST_TYPES}")
+        self._type = st_type
+        return self
+
+    setType = set_type
+
+    def get_type(self) -> str:
+        return self._type
+
+    getType = get_type
+
+    def set_shift(self, sigma: float):
+        self.sigma = float(sigma)
+        return self
+
+    setShift = set_shift
+
+    def get_shift(self) -> float:
+        return self.sigma
+
+    getShift = get_shift
+
+    def set_from_options(self):
+        from ..utils.options import global_options
+        opt = global_options()
+        st_type = opt.get_string("st_type")
+        if st_type:
+            self.set_type(st_type)
+        self.sigma = opt.get_real("st_shift", self.sigma)
+        return self
+
+    setFromOptions = set_from_options
+
+    # ---- eigenvalue mapping -------------------------------------------------
+    def back_transform(self, theta):
+        """Map transformed eigenvalues theta back to the original lambda."""
+        theta = np.asarray(theta)
+        if self._type == "shift":
+            return theta + self.sigma
+        # sinvert: theta = 1/(lambda - sigma)
+        safe = np.where(theta == 0, 1.0, theta)
+        lam = self.sigma + 1.0 / safe
+        return np.where(theta == 0, np.inf, lam)
+
+    def is_identity(self) -> bool:
+        return self._type == "shift" and self.sigma == 0.0
+
+    # ---- operator construction ----------------------------------------------
+    def build_operator(self, A, B=None):
+        """Wrap (A, B) into the transformed operator the eigensolver runs.
+
+        Returns ``(op, inner)`` where ``op`` implements the linear-operator
+        protocol (local_spmv / device_arrays / op_specs / program_key) and
+        ``inner`` is the B-inner-product operator (``None`` for standard
+        problems — Euclidean inner product).
+        """
+        if B is None and self.is_identity():
+            return A, None
+        return STOperator(A, B, self._type, self.sigma), (B if B is not None
+                                                          else None)
+
+    def __repr__(self):
+        return f"ST(type={self._type!r}, shift={self.sigma})"
+
+
+def _dense_inverse_padded(comm, M_scipy, n, dtype):
+    """Replicated padded dense inverse (host fp64 LAPACK; zero padding)."""
+    import scipy.linalg
+    if n > _DENSE_CAP:
+        raise ValueError(
+            f"ST 'sinvert'/generalized solve densifies the operator; n={n} "
+            "is too large for the host factorization path (cap "
+            f"{_DENSE_CAP}) — use ST 'shift' with an iterative which, or "
+            "more devices (SURVEY.md §7.4)")
+    inv = scipy.linalg.inv(M_scipy.toarray().astype(np.float64))
+    n_pad = comm.padded_size(n)
+    inv_pad = np.zeros((n_pad, n_pad), dtype=np.float64)
+    inv_pad[:n, :n] = inv
+    return comm.put_replicated(inv_pad.astype(dtype))
+
+
+class STOperator:
+    """Transformed operator: one of ``A - sI``, ``(A - sI)^-1``,
+    ``B^-1 (A - sB)``, ``(A - sB)^-1 B`` — linear-operator-protocol shaped.
+
+    The shift enters as a replicated device scalar (not a compile-time
+    constant), so re-solving with a new sigma under ``shift`` reuses the
+    compiled program; ``sinvert`` re-factorizes on host but also recompiles
+    nothing (the inverse is just a different array).
+    """
+
+    def __init__(self, A, B, st_type: str, sigma: float):
+        if st_type == "sinvert" and not hasattr(A, "to_scipy"):
+            raise ValueError(
+                "ST 'sinvert' needs an assembled matrix (Mat) — "
+                "matrix-free operators expose no entries to factorize")
+        self.A = A
+        self.B = B
+        self.st_type = st_type
+        self.sigma = float(sigma)
+        self.shape = A.shape
+        self.dtype = A.dtype
+        self.comm = A.comm
+        n = A.shape[0]
+        if st_type == "sinvert":
+            M = A.to_scipy()
+            if B is not None:
+                M = M - sigma * B.to_scipy()
+            elif sigma != 0.0:
+                import scipy.sparse as sp
+                M = M - sigma * sp.eye(n, format="csr")
+            self._inv = _dense_inverse_padded(self.comm, M.tocsr(), n,
+                                              self.dtype)
+            self._binv = None
+        else:  # shift with B, or shifted standard
+            self._inv = None
+            if B is not None:
+                self._binv = _dense_inverse_padded(self.comm, B.to_scipy(),
+                                                   n, self.dtype)
+            else:
+                self._binv = None
+        self._sigma_arr = self.comm.put_replicated(
+            np.asarray(sigma, dtype=self.dtype))
+
+    # ---- linear-operator protocol ------------------------------------------
+    def program_key(self):
+        return ("st", self.st_type, self.B is not None,
+                self.A.program_key(),
+                self.B.program_key() if self.B is not None else None)
+
+    def device_arrays(self):
+        if self.st_type == "sinvert":
+            inner = self.B.device_arrays() if self.B is not None else ()
+            return (self._inv,) + tuple(inner)
+        arrs = tuple(self.A.device_arrays()) + (self._sigma_arr,)
+        if self.B is not None:
+            arrs = arrs + (self._binv,)
+        return arrs
+
+    def op_specs(self, axis):
+        if self.st_type == "sinvert":
+            inner = self.B.op_specs(axis) if self.B is not None else ()
+            return (P(),) + tuple(inner)
+        specs = tuple(self.A.op_specs(axis)) + (P(),)
+        if self.B is not None:
+            specs = specs + (P(),)
+        return specs
+
+    def local_spmv(self, comm):
+        axis = comm.axis
+        n = self.shape[0]
+        lsize = comm.local_size(n)
+
+        def matinv_apply(minv, r_local):
+            r_full = lax.all_gather(r_local, axis, tiled=True)
+            z_full = minv @ r_full
+            i = lax.axis_index(axis)
+            return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
+
+        if self.st_type == "sinvert":
+            if self.B is None:
+                def spmv(op_arrays, x):
+                    (minv,) = op_arrays
+                    return matinv_apply(minv, x)
+                return spmv
+
+            nb = len(self.B.device_arrays())
+            b_spmv = self.B.local_spmv(comm)
+
+            def spmv(op_arrays, x):
+                minv = op_arrays[0]
+                b_arrays = op_arrays[1:1 + nb]
+                return matinv_apply(minv, b_spmv(b_arrays, x))
+            return spmv
+
+        na = len(self.A.device_arrays())
+        a_spmv = self.A.local_spmv(comm)
+        if self.B is None:
+            def spmv(op_arrays, x):
+                a_arrays = op_arrays[:na]
+                sigma = op_arrays[na]
+                return a_spmv(a_arrays, x) - sigma * x
+            return spmv
+
+        def spmv(op_arrays, x):
+            a_arrays = op_arrays[:na]
+            sigma = op_arrays[na]
+            binv = op_arrays[na + 1]
+            y = a_spmv(a_arrays, x)
+            return matinv_apply(binv, y) - sigma * x
+        return spmv
+
+    def __repr__(self):
+        return (f"STOperator({self.st_type!r}, sigma={self.sigma}, "
+                f"generalized={self.B is not None})")

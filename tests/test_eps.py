@@ -99,6 +99,227 @@ class TestEPSNonHermitian:
                                    rtol=1e-5)
 
 
+class TestKrylovSchur:
+    """Thick-restart (Krylov-Schur) path — the SLEPc-default algorithm."""
+
+    def test_is_default_type(self):
+        assert EPS().get_type() == "krylovschur"
+
+    def test_converges_where_small_ncv_struggles(self, comm8):
+        # small ncv forces restarts; thick restart must still converge fast
+        A = reference_tridiag(200)
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        target = lam_exact[np.argmax(np.abs(lam_exact))]
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_dimensions(nev=2, ncv=8)
+        E.set_tolerances(tol=1e-9, max_it=200)
+        E.solve()
+        assert E.get_converged() >= 2
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target, rtol=1e-7)
+
+    def test_fewer_restarts_than_explicit_arnoldi(self, comm8):
+        A = reference_tridiag(150)
+        M = tps.Mat.from_scipy(comm8, A)
+
+        def run(eps_type):
+            E = EPS().create(comm8)
+            E.set_operators(M)
+            E.set_problem_type("hep")
+            E.set_type(eps_type)
+            E.set_dimensions(nev=3, ncv=10)
+            E.set_tolerances(tol=1e-8, max_it=500)
+            E.solve()
+            return E
+
+    # thick restart preserves a k-dimensional invariant-subspace estimate
+    # across restarts; explicit restart compresses to one vector
+        ks = run("krylovschur")
+        ar = run("arnoldi")
+        assert ks.get_converged() >= 3
+        assert ks.get_iteration_number() <= ar.get_iteration_number()
+
+    def test_nhep_thick_restart(self, comm8):
+        rng = np.random.default_rng(11)
+        n = 80
+        D = np.diag(np.linspace(1.0, n, n))
+        Q = np.linalg.qr(rng.standard_normal((n, n)))[0]
+        Adense = Q @ D @ Q.T + 0.05 * np.triu(rng.standard_normal((n, n)), 1)
+        A = sp.csr_matrix(Adense)
+        lam_exact = np.linalg.eigvals(Adense)
+        target = lam_exact[np.argmax(np.abs(lam_exact))]
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("nhep")
+        E.set_dimensions(nev=1, ncv=12)
+        E.set_tolerances(tol=1e-8, max_it=300)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target.real,
+                                   rtol=1e-6)
+
+
+class TestSpectralTransform:
+    """ST shift / shift-and-invert — SLEPc's -st_type machinery."""
+
+    def test_sinvert_smallest_eigenvalue(self, comm8):
+        # 1D Poisson: smallest eigenvalue 4 sin^2(pi/(2(n+1))) — interior
+        # convergence is slow for plain Krylov, instant with sinvert at 0
+        n = 120
+        A = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                     [-1, 0, 1]).tocsr()
+        lam_min = np.linalg.eigvalsh(A.toarray())[0]
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("sinvert")   # shift defaults to 0
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(0.0)
+        E.set_tolerances(tol=1e-10)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, lam_min,
+                                   rtol=1e-8)
+        assert E.get_iteration_number() <= 3   # sinvert makes it easy
+
+    def test_sinvert_interior_target(self, comm8):
+        A = sp.diags(np.arange(1.0, 61.0)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("sinvert")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(33.4)               # nearest eigenvalue is 33
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 33.0, rtol=1e-9)
+
+    def test_shift_transform_back(self, comm8):
+        # shift moves the spectrum; back-transform must undo it exactly
+        A = reference_tridiag(60)
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        target = lam_exact[np.argmax(np.abs(lam_exact))]
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("shift")
+        E.get_st().set_shift(-500.0)     # |lam - (-500)| max is still lam_max
+        E.set_tolerances(tol=1e-9)
+        E.solve()
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target, rtol=1e-7)
+
+    def test_sinvert_matrix_free_rejected(self, comm8):
+        from mpi_petsc4py_example_tpu.solvers.st import ST
+        st = ST()
+        st.set_type("sinvert")
+
+        class FakeOp:
+            shape = (10, 10)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="matrix-free"):
+            st.build_operator(FakeOp())
+
+
+class TestGHEP:
+    """Generalized Hermitian A x = lam B x (B SPD) vs scipy.linalg.eigh."""
+
+    @staticmethod
+    def _pencil(n=50, seed=3):
+        rng = np.random.default_rng(seed)
+        A = reference_tridiag(n)
+        d = rng.uniform(1.0, 3.0, n)
+        B = sp.diags([0.1 * np.ones(n - 1), d, 0.1 * np.ones(n - 1)],
+                     [-1, 0, 1]).tocsr()
+        return A, B
+
+    def test_ghep_largest(self, comm8):
+        import scipy.linalg
+        A, B = self._pencil()
+        lam_exact = scipy.linalg.eigh(A.toarray(), B.toarray(),
+                                      eigvals_only=True)
+        target = lam_exact[np.argmax(np.abs(lam_exact))]
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, B)
+        E = EPS().create(comm8)
+        E.set_operators(MA, MB)
+        E.set_problem_type("ghep")
+        E.set_tolerances(tol=1e-9)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target, rtol=1e-7)
+
+    def test_ghep_sinvert_smallest(self, comm8):
+        import scipy.linalg
+        A, B = self._pencil(40, seed=9)
+        lam_exact = scipy.linalg.eigh(A.toarray(), B.toarray(),
+                                      eigvals_only=True)
+        # eigenvalue of smallest magnitude
+        target = lam_exact[np.argmin(np.abs(lam_exact))]
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, B)
+        E = EPS().create(comm8)
+        E.set_operators(MA, MB)
+        E.set_problem_type("ghep")
+        E.get_st().set_type("sinvert")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(0.0)
+        E.set_tolerances(tol=1e-9)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, target, rtol=1e-7)
+
+    def test_ghep_eigenvector_residual(self, comm8):
+        A, B = self._pencil(40, seed=5)
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, B)
+        E = EPS().create(comm8)
+        E.set_operators(MA, MB)
+        E.set_problem_type("ghep")
+        E.set_tolerances(tol=1e-10)
+        E.solve()
+        vr, _ = MA.get_vecs()
+        lam = E.get_eigenpair(0, vr)
+        v = vr.to_numpy()
+        r = A @ v - lam.real * (B @ v)
+        assert np.linalg.norm(r) <= 1e-7 * abs(lam) * np.linalg.norm(v)
+
+
+class TestPowerSubspace:
+    def test_power_dominant(self, comm8):
+        A = sp.diags(np.arange(1.0, 81.0)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("power")
+        E.set_tolerances(tol=1e-8, max_it=400)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 80.0, rtol=1e-6)
+
+    def test_subspace_multiple(self, comm8):
+        A = reference_tridiag(90)
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        order = np.argsort(-np.abs(lam_exact))
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("subspace")
+        E.set_dimensions(nev=2, ncv=12)
+        E.set_tolerances(tol=1e-7, max_it=500)
+        E.solve()
+        assert E.get_converged() >= 2
+        got = np.array([E.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam_exact[order[:2]], rtol=1e-5)
+
+
 class TestEPSOptions:
     def test_set_from_options(self, comm8):
         tps.global_options().set("eps_nev", 3)
@@ -113,9 +334,24 @@ class TestEPSOptions:
         assert E.nev == 1
         assert E._which == "largest_magnitude"
 
-    def test_ghep_rejected(self, comm8):
+    def test_eps_type_and_st_from_options(self, comm8):
+        tps.global_options().set("eps_type", "arnoldi")
+        tps.global_options().set("st_type", "sinvert")
+        tps.global_options().set("st_shift", 2.5)
+        tps.global_options().set("eps_target", 3.0)
+        E = EPS().create(comm8)
+        E.set_from_options()
+        assert E.get_type() == "arnoldi"
+        assert E.get_st().get_type() == "sinvert"
+        assert E.get_st().get_shift() == 2.5
+        assert E._target == 3.0
+
+    def test_two_operators_need_ghep(self, comm8):
         A = sp.eye(10, format="csr")
         M = tps.Mat.from_scipy(comm8, A)
         E = EPS().create(comm8)
-        with pytest.raises(NotImplementedError):
-            E.set_operators(M, M)
+        E.set_operators(M, M)   # auto-switches to GHEP
+        assert E._problem_type == "ghep"
+        E.set_problem_type("hep")
+        with pytest.raises(ValueError, match="ghep"):
+            E.solve()
