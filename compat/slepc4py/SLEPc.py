@@ -15,9 +15,7 @@ from petsc4py.PETSc import Mat as _Mat, Vec as _Vec, _mpi_comm
 class ST:
     """Spectral-transformation handle (fronts solvers.st.ST)."""
 
-    class Type:
-        SHIFT = "shift"
-        SINVERT = "sinvert"
+    Type = _CoreST.Type       # aliased so new core types appear here too
 
     def __init__(self, core: _CoreST | None = None):
         self._core = core if core is not None else _CoreST()
@@ -58,12 +56,7 @@ class EPS:
         TARGET_MAGNITUDE = EPSWhich.TARGET_MAGNITUDE
         TARGET_REAL = EPSWhich.TARGET_REAL
 
-    class Type:
-        KRYLOVSCHUR = "krylovschur"
-        ARNOLDI = "arnoldi"
-        LANCZOS = "lanczos"
-        POWER = "power"
-        SUBSPACE = "subspace"
+    Type = _CoreEPS.Type      # aliased so new core types appear here too
 
     def __init__(self):
         self._core = _CoreEPS()

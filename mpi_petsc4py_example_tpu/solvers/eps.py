@@ -72,6 +72,14 @@ class EPSWhich:
     TARGET_REAL = "target_real"
 
 
+class EPSType:
+    KRYLOVSCHUR = "krylovschur"
+    ARNOLDI = "arnoldi"
+    LANCZOS = "lanczos"
+    POWER = "power"
+    SUBSPACE = "subspace"
+
+
 _PROGRAM_CACHE: dict = {}
 
 
@@ -185,6 +193,46 @@ def _build_restart_program(comm: DeviceComm, ncv: int):
     return prog
 
 
+def _build_seed_program(comm: DeviceComm, ncv: int):
+    """Build the (ncv+1, n_pad) basis on device from a start vector — only
+    the npad-sized v0 crosses host->device, never the full zero basis."""
+    axis = comm.axis
+    key = ("seed", comm.mesh, axis, ncv)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local_fn(v0):
+        V = jnp.zeros((ncv + 1, v0.shape[0]), v0.dtype)
+        return V.at[0].set(v0)
+
+    prog = jax.jit(comm.shard_map(
+        local_fn, in_specs=(P(axis),), out_specs=P(None, axis)))
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _build_arnoldi_restart_program(comm: DeviceComm, ncv: int):
+    """Explicit restart on device: new start vector = ``w @ V[:ncv]`` (the
+    wanted-Ritz combination), rest of the basis zeroed — the basis never
+    round-trips to host between restarts."""
+    axis = comm.axis
+    key = ("arnoldi_restart", comm.mesh, axis, ncv)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local_fn(V, w):
+        v0 = w @ V[:ncv]
+        Vn = jnp.zeros_like(V)
+        return Vn.at[0].set(v0)
+
+    prog = jax.jit(comm.shard_map(
+        local_fn, in_specs=(P(None, axis), P()), out_specs=P(None, axis)))
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
 def _build_power_program(comm: DeviceComm, op, steps: int):
     """``steps`` normalized power steps + Rayleigh quotient/residual, jitted."""
     axis = comm.axis
@@ -250,7 +298,7 @@ class EPS:
 
     ProblemType = EPSProblemType
     Which = EPSWhich
-    Type = EPS_TYPES
+    Type = EPSType
 
     def __init__(self, comm=None):
         self.comm = None
@@ -468,16 +516,20 @@ class EPS:
 
     def _dominant_only(self, solver: str):
         """power/subspace converge to the *dominant* (transformed) subspace —
-        any other selection silently returns wrong pairs (SLEPc's EPSPOWER
-        errors the same way)."""
-        ok = self._which == EPSWhich.LARGEST_MAGNITUDE or (
+        any other selection, or a transform under which dominance no longer
+        means "wanted" (a nonzero shift), silently returns wrong pairs
+        (SLEPc's EPSPOWER errors the same way)."""
+        ok = (self._which == EPSWhich.LARGEST_MAGNITUDE
+              and self.st.is_identity()) or (
             self._which == EPSWhich.TARGET_MAGNITUDE
             and self.st.get_type() == "sinvert")
         if not ok:
             raise ValueError(
                 f"EPS {solver!r} computes dominant eigenpairs only — use "
-                f"which='largest_magnitude' (or 'target_magnitude' with ST "
-                f"'sinvert'), not {self._which!r}; krylovschur supports all "
+                f"which='largest_magnitude' with no spectral transform, or "
+                f"'target_magnitude' with ST 'sinvert' (got "
+                f"which={self._which!r}, st={self.st.get_type()!r} "
+                f"shift={self.st.sigma}); krylovschur supports all "
                 "selections")
 
     def _rayleigh_ritz(self, Hh: np.ndarray, ncv: int, nev: int,
@@ -544,12 +596,9 @@ class EPS:
         op_arrays = op.device_arrays()
         b_arrays = inner.device_arrays() if inner is not None else ()
 
-        npad = comm.padded_size(n)
         dtype = np.dtype(str(op.dtype))
-        V_host = np.zeros((ncv + 1, npad), dtype=dtype)
-        V_host[0] = self._start_vector(comm, n, dtype)
-        V = jax.device_put(
-            V_host, jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis)))
+        seed_prog = _build_seed_program(comm, ncv)
+        V = seed_prog(comm.put_rows(self._start_vector(comm, n, dtype)))
         H = np.zeros((ncv + 1, ncv), dtype=dtype)
         k = 0
 
@@ -610,18 +659,15 @@ class EPS:
         ncv = self._effective_ncv(n)
         nev = min(self.nev, ncv)
         prog = _build_factorization_program(comm, op, ncv, inner)
+        seed_prog = _build_seed_program(comm, ncv)
+        restart_prog = _build_arnoldi_restart_program(comm, ncv)
         op_arrays = op.device_arrays()
         b_arrays = inner.device_arrays() if inner is not None else ()
 
-        npad = comm.padded_size(n)
         dtype = np.dtype(str(op.dtype))
-        v0 = self._start_vector(comm, n, dtype)
-        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
+        V = seed_prog(comm.put_rows(self._start_vector(comm, n, dtype)))
 
         for restarts in range(1, self.max_it + 1):
-            V_host = np.zeros((ncv + 1, npad), dtype=dtype)
-            V_host[0] = v0
-            V = jax.device_put(V_host, sharding)
             H = np.zeros((ncv + 1, ncv), dtype=dtype)
             V, H = prog(op_arrays, b_arrays, V, H,
                         np.asarray(0, dtype=np.int32))
@@ -631,10 +677,9 @@ class EPS:
             if nconv >= nev or ncv >= n or restarts == self.max_it:
                 break
             # restart vector: combination of wanted, not-yet-converged Ritz
-            Vh = np.asarray(V)[:ncv]
-            wanted = S[:, order[:nev]].real.sum(axis=1)
-            v0 = (wanted @ Vh).astype(dtype)
-            v0[n:] = 0.0
+            # directions, formed on device (the basis stays in HBM)
+            wanted = S[:, order[:nev]].real.sum(axis=1).astype(dtype)
+            V = restart_prog(V, wanted)
 
         Vh = np.asarray(V)[:ncv]
         count = max(nev, 1)

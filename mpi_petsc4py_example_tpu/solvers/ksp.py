@@ -152,6 +152,12 @@ class KSP:
         pc.sor_omega = opt.get_real(p + "pc_sor_omega", pc.sor_omega)
         pc.asm_overlap = opt.get_int(p + "pc_asm_overlap", pc.asm_overlap)
         pc.factor_fill = opt.get_real(p + "pc_factor_fill", pc.factor_fill)
+        pc.gamg_threshold = opt.get_real(p + "pc_gamg_threshold",
+                                         pc.gamg_threshold)
+        pc.gamg_coarse_size = opt.get_int(p + "pc_gamg_coarse_eq_limit",
+                                          pc.gamg_coarse_size)
+        pc.gamg_max_levels = opt.get_int(p + "pc_mg_levels",
+                                         pc.gamg_max_levels)
         return self
 
     setFromOptions = set_from_options

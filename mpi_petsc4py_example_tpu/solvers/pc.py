@@ -45,7 +45,7 @@ from ..parallel.mesh import DeviceComm
 from jax.sharding import PartitionSpec as P
 
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
-            "sor", "ssor", "ilu", "icc", "asm")
+            "sor", "ssor", "ilu", "icc", "asm", "gamg", "amg")
 
 
 class PC:
@@ -61,6 +61,10 @@ class PC:
         self.sor_omega = 1.0        # -pc_sor_omega (PETSc default 1)
         self.asm_overlap = 1        # -pc_asm_overlap (PETSc default 1)
         self.factor_fill = 10.0     # -pc_factor_fill (spilu fill_factor)
+        self.gamg_threshold = 0.0   # -pc_gamg_threshold (PCGAMG default 0)
+        self.gamg_coarse_size = 64  # -pc_gamg_coarse_eq_limit analog
+        self.gamg_max_levels = 10   # -pc_mg_levels analog
+        self._amg = None
 
     # ---- petsc4py-shaped configuration -------------------------------------
     def set_type(self, pc_type: str):
@@ -105,7 +109,8 @@ class PC:
             raise RuntimeError("PC.set_up: no operator set")
         # tunables are baked into the built arrays — they are part of the key
         build_key = (mat, self._type, self.sor_omega, self.asm_overlap,
-                     self.factor_fill)
+                     self.factor_fill, self.gamg_threshold,
+                     self.gamg_coarse_size, self.gamg_max_levels)
         if self._built_for == build_key:
             return self
         comm = mat.comm
@@ -126,6 +131,19 @@ class PC:
             self._arrays = _build_asm(comm, mat, self.asm_overlap)
         elif t in ("lu", "cholesky"):
             self._arrays = _build_dense_lu(comm, mat)
+        elif t in ("gamg", "amg"):
+            from .amg import AMGHierarchy
+            if not hasattr(mat, "to_scipy"):
+                raise ValueError(
+                    "PC 'gamg' needs an assembled matrix (Mat) to build the "
+                    "aggregation hierarchy; matrix-free stencil operators "
+                    "should use the geometric 'mg'")
+            self._amg = AMGHierarchy(
+                comm, mat.to_scipy(), mat.dtype,
+                threshold=self.gamg_threshold,
+                max_levels=self.gamg_max_levels,
+                coarse_size=self.gamg_coarse_size)
+            self._arrays = self._amg.device_arrays()
         elif t == "mg":
             if not all(hasattr(mat, a) for a in ("nx", "ny", "nz")):
                 raise ValueError(
@@ -143,6 +161,8 @@ class PC:
         t = self._type
         if t == "cholesky":
             return "lu"
+        if t == "amg":
+            return "gamg"
         # sor/ssor/ilu/icc all apply as one per-device dense block matvec —
         # the same kernel shape as block Jacobi, different block algebra
         if t in ("sor", "ssor", "ilu", "icc"):
@@ -157,6 +177,8 @@ class PC:
         local_apply closure beyond ``kind`` (currently the ASM overlap)."""
         if self.kind == "asm":
             return (self.kind, int(self.asm_overlap))
+        if self.kind == "gamg":
+            return self._amg.program_key()
         return (self.kind,)
 
     def in_specs(self, axis: str) -> tuple:
@@ -172,6 +194,8 @@ class PC:
             return (P(axis),)
         if k == "lu":
             return (P(),)
+        if k == "gamg":
+            return self._amg.in_specs()
         raise AssertionError(k)
 
     def local_apply(self, comm: DeviceComm, n: int):
@@ -224,6 +248,8 @@ class PC:
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
             return apply
+        if k == "gamg":
+            return self._amg.local_apply(comm)
         if k == "mg":
             from .mg import make_vcycle
             op = self._mat

@@ -34,8 +34,15 @@ ST_TYPES = ("shift", "sinvert")
 _DENSE_CAP = 16384  # same host-factorization bound as solvers/pc.py
 
 
+class STType:
+    SHIFT = "shift"
+    SINVERT = "sinvert"
+
+
 class ST:
     """Spectral-transformation context, slepc4py-``ST``-shaped."""
+
+    Type = STType
 
     def __init__(self):
         self._type = "shift"
@@ -110,11 +117,17 @@ class ST:
         return f"ST(type={self._type!r}, shift={self.sigma})"
 
 
-def _dense_inverse_padded(comm, M_scipy, n, dtype):
-    """Replicated padded dense inverse (host fp64 LAPACK; zero padding)."""
+def _dense_inverse_padded(comm, M_scipy, n, dtype, context=None):
+    """Replicated padded dense inverse (host fp64 LAPACK; zero padding).
+
+    Shared by every direct-apply path (ST sinvert/GHEP, the AMG coarse
+    level; PC 'lu' predates it): cap check, host inversion, zero-pad to the
+    mesh's padded size, replicate. ``context`` customizes the cap error.
+    """
     import scipy.linalg
     if n > _DENSE_CAP:
         raise ValueError(
+            context or
             f"ST 'sinvert'/generalized solve densifies the operator; n={n} "
             "is too large for the host factorization path (cap "
             f"{_DENSE_CAP}) — use ST 'shift' with an iterative which, or "

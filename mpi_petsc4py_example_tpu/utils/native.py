@@ -62,8 +62,11 @@ def get_lib():
                     lib = ctypes.CDLL(so)
                     lib.csr_validate.restype = ctypes.c_int
                     lib.csr_max_row_nnz.restype = ctypes.c_int64
+                    lib.csr_aggregate.restype = ctypes.c_int64
                     _lib = lib
-                except OSError:
+                except (OSError, AttributeError):
+                    # AttributeError: stale .so missing a newer symbol —
+                    # fall back to numpy rather than crash assembly
                     _lib = None
         return _lib
 
@@ -147,6 +150,24 @@ def csr_diagonal_native(indptr, indices, data, n: int):
     lib.csr_diagonal(_as(indptr, _I64), _as(indices, _I32), _as(data, _F64),
                      ctypes.c_int64(n), _as(diag, _F64))
     return diag
+
+
+def csr_aggregate_native(indptr, indices):
+    """Greedy (Vanek) aggregation over a CSR strength pattern.
+
+    Returns ``(agg, nagg)``. Falls back to the Python reference loop in
+    solvers.amg when no toolchain is available.
+    """
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    nrows = len(indptr) - 1
+    lib = get_lib()
+    if lib is None:
+        return None
+    agg = np.empty(nrows, dtype=np.int64)
+    nagg = int(lib.csr_aggregate(_as(indptr, _I64), _as(indices, _I32),
+                                 ctypes.c_int64(nrows), _as(agg, _I64)))
+    return agg, nagg
 
 
 def csr_spmv_native(indptr, indices, data, x):

@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <vector>
 
 extern "C" {
 
@@ -93,6 +94,53 @@ void csr_row_norms1(const int64_t* indptr, const double* data,
             s += data[p] < 0 ? -data[p] : data[p];
         norms[i] = s;
     }
+}
+
+// Greedy (Vanek) smoothed-aggregation pass over a CSR strength graph.
+// agg (nrows, preallocated) receives the aggregate id per node; returns the
+// aggregate count. Three passes: seed aggregates from nodes with no
+// aggregated strong neighbor; attach leftovers to a neighboring aggregate
+// (decided against the pass-1 state so attachments don't chain); sweep
+// remaining islands into new aggregates. Used by the AMG (PCGAMG-analog)
+// setup, where per-row Python loops dominate at large n.
+int64_t csr_aggregate(const int64_t* indptr, const int32_t* indices,
+                      int64_t nrows, int64_t* agg) {
+    for (int64_t i = 0; i < nrows; ++i) agg[i] = -1;
+    int64_t nagg = 0;
+    for (int64_t i = 0; i < nrows; ++i) {
+        if (agg[i] != -1) continue;
+        bool free_nbhd = true;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+            const int32_t j = indices[p];
+            if (j != i && agg[j] != -1) { free_nbhd = false; break; }
+        }
+        if (!free_nbhd) continue;
+        agg[i] = nagg;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+            const int32_t j = indices[p];
+            if (j != i) agg[j] = nagg;
+        }
+        ++nagg;
+    }
+    std::vector<int64_t> attach(agg, agg + nrows);
+    for (int64_t i = 0; i < nrows; ++i) {
+        if (agg[i] != -1) continue;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+            const int32_t j = indices[p];
+            if (j != i && agg[j] != -1) { attach[i] = agg[j]; break; }
+        }
+    }
+    std::memcpy(agg, attach.data(), nrows * sizeof(int64_t));
+    for (int64_t i = 0; i < nrows; ++i) {
+        if (agg[i] != -1) continue;
+        agg[i] = nagg;
+        for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p) {
+            const int32_t j = indices[p];
+            if (agg[j] == -1) agg[j] = nagg;
+        }
+        ++nagg;
+    }
+    return nagg;
 }
 
 // Reference SpMV (oracle/debug; the production SpMV runs on TPU).

@@ -405,3 +405,87 @@ class TestNewPCs:
             assert res.converged
         finally:
             opt.clear()
+
+
+class TestGAMG:
+    """Smoothed-aggregation AMG (PCGAMG analog) — solvers/amg.py."""
+
+    def test_cg_gamg_poisson2d(self, comm):
+        A = poisson2d(40)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "cg", "gamg", rtol=1e-9)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_much_faster_than_jacobi(self, comm8):
+        A = poisson2d(48)
+        _, b = manufactured(A)
+        _, res_j, _ = solve(comm8, A, b, "cg", "jacobi", rtol=1e-8)
+        _, res_g, _ = solve(comm8, A, b, "cg", "gamg", rtol=1e-8)
+        assert res_g.converged
+        assert res_g.iterations < res_j.iterations // 3
+
+    def test_mesh_independent_iterations(self, comm8):
+        # the AMG promise: iteration counts roughly flat as n grows
+        iters = []
+        for nx in (16, 32, 48):
+            A = poisson2d(nx)
+            _, b = manufactured(A)
+            _, res, _ = solve(comm8, A, b, "cg", "gamg", rtol=1e-8)
+            assert res.converged
+            iters.append(res.iterations)
+        assert max(iters) <= min(iters) + 6
+
+    def test_amg_alias_and_options(self, comm8):
+        A = poisson2d(24)
+        x_true, b = manufactured(A)
+        opt = tps.global_options()
+        opt.set("pc_type", "amg")
+        opt.set("pc_gamg_threshold", 0.02)
+        opt.set("pc_gamg_coarse_eq_limit", 32)
+        try:
+            M = tps.Mat.from_scipy(comm8, A)
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(M)
+            ksp.set_type("cg")
+            ksp.set_from_options()
+            assert ksp.get_pc().get_type() == "amg"
+            assert ksp.get_pc().gamg_threshold == 0.02
+            assert ksp.get_pc().gamg_coarse_size == 32
+            ksp.set_tolerances(rtol=1e-10)
+            x, bv = M.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            assert res.converged
+            np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-7)
+        finally:
+            opt.clear()
+
+    def test_tiny_matrix_direct_coarse(self, comm8):
+        # n below the coarse cap: hierarchy is a pure direct solve
+        A = poisson1d(20)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "gamg", rtol=1e-10)
+        assert res.converged and res.iterations <= 3
+        np.testing.assert_allclose(x, x_true, atol=1e-8)
+
+    def test_matrix_free_rejected(self, comm8):
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        op = StencilPoisson3D(comm8, 8)
+        pc = tps.PC()
+        pc.set_type("gamg")
+        with pytest.raises(ValueError, match="assembled"):
+            pc.set_up(op)
+
+    def test_setup_reuse_cached(self, comm8):
+        A = poisson2d(24)
+        M = tps.Mat.from_scipy(comm8, A)
+        pc = tps.PC()
+        pc.set_type("gamg")
+        pc.set_up(M)
+        h1 = pc._amg
+        pc.set_up(M)            # unchanged operator+tunables: no rebuild
+        assert pc._amg is h1
+        pc.gamg_threshold = 0.1
+        pc.set_up(M)            # tunable changed: rebuild
+        assert pc._amg is not h1

@@ -76,3 +76,26 @@ class TestMatUsesValidation:
         data = np.ones(3)
         with pytest.raises(ValueError, match="malformed CSR"):
             tps.Mat.from_csr(comm1, (2, 3), (indptr, indices, data))
+
+
+class TestNativeAggregate:
+    """native csr_aggregate vs the Python reference (solvers/amg.py)."""
+
+    def test_matches_python_reference(self):
+        import scipy.sparse as sp
+        from mpi_petsc4py_example_tpu.utils import native
+        from mpi_petsc4py_example_tpu.solvers.amg import _aggregate_py
+        if not native.available():
+            import pytest
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(0)
+        for n, density in ((60, 0.1), (200, 0.03)):
+            A = sp.random(n, n, density=density, random_state=rng,
+                          format="csr")
+            S = ((A + A.T) != 0).astype(np.float64).tocsr()
+            agg_n, nagg_n = native.csr_aggregate_native(S.indptr, S.indices)
+            agg_p, nagg_p = _aggregate_py(S.indptr, S.indices, n)
+            assert nagg_n == nagg_p
+            np.testing.assert_array_equal(agg_n, agg_p)
+            # every node aggregated, ids dense in [0, nagg)
+            assert agg_n.min() >= 0 and agg_n.max() == nagg_n - 1
