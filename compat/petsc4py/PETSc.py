@@ -213,6 +213,59 @@ class Mat:
     def destroy(self):
         return self
 
+    def setNullSpace(self, ns):
+        """Attach a NullSpace (PETSc MatSetNullSpace) — KSP then solves the
+        compatible singular system by in-program projection. Collective."""
+        core_ns = ns.core if isinstance(ns, NullSpace) else ns
+
+        def build(_):
+            self._core.set_nullspace(core_ns)
+            return True
+
+        self._comm._collective("mat_setnullspace", None, build)
+
+    def getNullSpace(self):
+        return self._core.get_nullspace()
+
+    def norm(self, norm_type="frobenius"):
+        return self._core.norm(norm_type)
+
+    def zeroRows(self, rows, diag=1.0, x=None, b=None):
+        """Collective: one thread performs the shared-core mutation."""
+        rows = tuple(int(r) for r in np.atleast_1d(rows))
+
+        def build(_):
+            self._core.zero_rows(list(rows), diag=diag,
+                                 x=x.core if isinstance(x, Vec) else x,
+                                 b=b.core if isinstance(b, Vec) else b)
+            return True
+
+        self._comm._collective("mat_zerorows", (rows, float(diag)), build)
+        return self
+
+    @property
+    def core(self):
+        return self._core
+
+
+class NullSpace:
+    """Null-space handle (fronts core.nullspace.NullSpace)."""
+
+    def __init__(self):
+        self._core = None
+
+    def create(self, constant=False, vectors=(), comm=None):
+        vecs = [v.core.to_numpy() if isinstance(v, Vec) else np.asarray(v)
+                for v in vectors]
+        self._core = _tps.NullSpace(constant=constant, vectors=vecs)
+        return self
+
+    def test(self, mat):
+        return self._core.test(mat.core if isinstance(mat, Mat) else mat)
+
+    def destroy(self):
+        return self
+
     @property
     def core(self):
         return self._core

@@ -40,6 +40,9 @@ class Mat:
         # optional host CSR triple (indptr, indices, data) of the full matrix
         self.host_csr = host_csr
         self._assembled = False
+        # bumped by every in-place mutation (axpy/scale/shift/zero_rows) so
+        # PC/EPS setup caches keyed on this Mat know to rebuild
+        self._state = 0
         # constant-diagonal fast path (set by model generators so Jacobi
         # setup never pulls a 100M-row ELL back to host)
         self._diag_value: float | None = None
@@ -151,6 +154,143 @@ class Mat:
         mk = lambda: Vec(self.comm, self.shape[0], dtype=self.dtype,
                          layout=self.layout)
         return mk(), mk()
+
+    # ---- null space (PETSc MatSetNullSpace) --------------------------------
+    def set_nullspace(self, nullspace):
+        """Attach a :class:`core.nullspace.NullSpace`; KSP then projects the
+        RHS and all operator/PC outputs onto its complement (the PETSc route
+        to compatible singular systems, e.g. pure-Neumann Poisson)."""
+        self.nullspace = nullspace
+        return self
+
+    setNullSpace = set_nullspace
+
+    def get_nullspace(self):
+        return getattr(self, "nullspace", None)
+
+    getNullSpace = get_nullspace
+
+    # ---- assembled-matrix algebra (PETSc Mat API surface) ------------------
+    def _replace_from_scipy(self, S):
+        """Rebuild this Mat's storage in place from a scipy matrix (PETSc's
+        mutating Mat ops rebuild the assembled form the same way)."""
+        S = S.tocsr()
+        rebuilt = Mat.from_csr(self.comm, S.shape,
+                               (S.indptr, S.indices, S.data),
+                               dtype=self.dtype)
+        self.shape = rebuilt.shape
+        self.layout = rebuilt.layout
+        self.ell_cols = rebuilt.ell_cols
+        self.ell_vals = rebuilt.ell_vals
+        self.host_csr = rebuilt.host_csr
+        self.dia_vals = rebuilt.dia_vals
+        self.dia_offsets = rebuilt.dia_offsets
+        self._diag_value = None
+        self._assembled = True
+        self._state += 1
+        return self
+
+    def norm(self, norm_type: str = "frobenius") -> float:
+        """Matrix norm: 'frobenius' (PETSc default), '1', or 'inf'."""
+        import scipy.sparse.linalg  # noqa: F401  (norm lives on the module)
+        import scipy.sparse as sp
+        S = self.to_scipy()
+        t = str(norm_type).lower()
+        if t in ("frobenius", "fro"):
+            return float(sp.linalg.norm(S, "fro"))
+        if t in ("1", "one"):
+            return float(np.abs(S).sum(axis=0).max())
+        if t in ("inf", "infinity"):
+            return float(np.abs(S).sum(axis=1).max())
+        raise ValueError(f"unknown norm type {norm_type!r}")
+
+    def transpose(self) -> "Mat":
+        """A new assembled Mat holding A^T."""
+        return Mat.from_scipy(self.comm, self.to_scipy().T.tocsr(),
+                              dtype=self.dtype)
+
+    def duplicate(self, copy_values: bool = True) -> "Mat":
+        S = self.to_scipy().copy()
+        if not copy_values:
+            S.data[:] = 0.0
+        return Mat.from_scipy(self.comm, S, dtype=self.dtype)
+
+    def copy(self) -> "Mat":
+        return self.duplicate(copy_values=True)
+
+    def axpy(self, alpha: float, X: "Mat") -> "Mat":
+        """Y <- Y + alpha*X (PETSc MatAXPY; rebuilds the device layout)."""
+        if X.shape != self.shape:
+            raise ValueError(f"axpy shape mismatch: {self.shape} vs {X.shape}")
+        return self._replace_from_scipy(
+            self.to_scipy() + float(alpha) * X.to_scipy())
+
+    def scale(self, alpha: float) -> "Mat":
+        """A <- alpha*A — pure device-side scaling, no host rebuild."""
+        alpha = self.dtype.type(alpha)
+        self.ell_vals = self.ell_vals * alpha
+        if self.dia_vals is not None:
+            self.dia_vals = self.dia_vals * alpha
+        if self.host_csr is not None:
+            ip, ix, dv = self.host_csr
+            self.host_csr = (ip, ix, dv * float(alpha))
+        if self._diag_value is not None:
+            self._diag_value *= float(alpha)
+        self._state += 1
+        return self
+
+    def shift(self, alpha: float) -> "Mat":
+        """A <- A + alpha*I (PETSc MatShift)."""
+        import scipy.sparse as sp
+        return self._replace_from_scipy(
+            self.to_scipy() + float(alpha) * sp.eye(self.shape[0],
+                                                    format="csr"))
+
+    def zero_rows(self, rows, diag: float = 1.0, b: Vec | None = None,
+                  x: Vec | None = None) -> "Mat":
+        """PETSc MatZeroRows: zero the given global rows, put ``diag`` on
+        their diagonal, and (given x, b) fix ``b[rows] = diag * x[rows]`` —
+        the standard way to impose Dirichlet conditions on an assembled
+        system."""
+        rows = np.asarray(rows, dtype=np.int64)
+        S = self.to_scipy().tolil()
+        S[rows, :] = 0.0
+        if diag != 0.0:
+            S[rows, rows] = diag
+        self._replace_from_scipy(S.tocsr())
+        if b is not None and x is not None:
+            bh = b.to_numpy()
+            bh[rows] = diag * x.to_numpy()[rows]
+            b.set_global(bh)
+        return self
+
+    zeroRows = zero_rows
+
+    def get_row(self, i: int):
+        """(cols, vals) of global row i (PETSc MatGetRow)."""
+        S = self.to_scipy()
+        s, e = int(S.indptr[i]), int(S.indptr[i + 1])
+        return np.asarray(S.indices[s:e]), np.asarray(S.data[s:e])
+
+    getRow = get_row
+
+    def get_info(self) -> dict:
+        """nnz / memory summary (PETSc MatGetInfo analog)."""
+        if self.host_csr is not None:
+            nnz = int(self.host_csr[0][-1])
+        else:
+            nnz = int((np.asarray(self.ell_vals)[: self.shape[0]] != 0).sum())
+        return {
+            "nnz": nnz,
+            "ell_width": self.K,
+            "dia_diagonals": len(self.dia_offsets),
+            "rows_per_device": self.comm.local_size(self.shape[0]),
+            "memory_device_bytes": int(
+                self.ell_vals.size * self.ell_vals.dtype.itemsize
+                + self.ell_cols.size * self.ell_cols.dtype.itemsize),
+        }
+
+    getInfo = get_info
 
     # ---- operator application ----------------------------------------------
     def mult_padded(self, x_padded: jax.Array) -> jax.Array:

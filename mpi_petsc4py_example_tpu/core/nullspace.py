@@ -1,0 +1,95 @@
+"""MatNullSpace — singular-operator support (PETSc MatNullSpace analog).
+
+PETSc workflows attach a null space to the matrix (``MatSetNullSpace``) so
+Krylov solvers converge on *compatible* singular systems — the canonical case
+being the pure-Neumann / periodic Poisson operator whose null space is the
+constant vector. The reference reaches this machinery through petsc4py
+[external]; here the projection happens inside the jit-compiled shard_map
+Krylov program: the RHS/initial guess and every operator/preconditioner
+output get their null-space component removed with one fused ``psum`` dot per
+basis vector (see solvers/krylov.py).
+
+The basis is orthonormalized on host (QR) once and stored replicated-free as
+a row-sharded ``(k, n_pad)`` device array.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NullSpace:
+    """petsc4py-``NullSpace``-shaped: ``create(constant=..., vectors=...)``."""
+
+    def __init__(self, constant: bool = False, vectors=()):
+        self._constant = bool(constant)
+        self._vectors = [np.asarray(getattr(v, "to_numpy", lambda: v)())
+                         for v in vectors]
+        self._built = None      # (comm, n, dtype) -> Q cache
+
+    @classmethod
+    def create(cls, constant: bool = False, vectors=(), comm=None):
+        """``comm`` is accepted for petsc4py shape compatibility (the mesh
+        communicator is taken from the matrix at solve time)."""
+        return cls(constant=constant, vectors=vectors)
+
+    @property
+    def dim(self) -> int:
+        return int(self._constant) + len(self._vectors)
+
+    def has_constant(self) -> bool:
+        return self._constant
+
+    hasConstant = has_constant
+
+    def basis_host(self, n: int) -> np.ndarray:
+        """Orthonormal (k, n) host basis of the null space."""
+        cols = []
+        if self._constant:
+            cols.append(np.ones(n))
+        for v in self._vectors:
+            if v.shape[0] != n:
+                raise ValueError(
+                    f"null-space vector has length {v.shape[0]}, matrix "
+                    f"needs {n}")
+            cols.append(np.asarray(v, dtype=np.float64))
+        if not cols:
+            raise ValueError("empty null space: pass constant=True and/or "
+                             "vectors")
+        Q, R = np.linalg.qr(np.stack(cols, axis=1))
+        if np.any(np.abs(np.diag(R)) < 1e-12 * max(1.0, np.abs(R).max())):
+            raise ValueError("null-space vectors are linearly dependent")
+        return Q.T
+
+    def device_array(self, comm, n: int, dtype):
+        """Row-sharded (k, n_pad) orthonormal basis (cached per mesh/size)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        key = (comm.mesh, n, str(np.dtype(dtype)))
+        if self._built is not None and self._built[0] == key:
+            return self._built[1]
+        Q = self.basis_host(n)
+        npad = comm.padded_size(n)
+        Qp = np.zeros((Q.shape[0], npad), dtype=np.dtype(dtype))
+        Qp[:, :n] = Q
+        arr = jax.device_put(
+            Qp, NamedSharding(comm.mesh, P(None, comm.axis)))
+        self._built = (key, arr)
+        return arr
+
+    def remove(self, v: np.ndarray) -> np.ndarray:
+        """Host-side projection (oracle/debug): v minus its null component."""
+        Q = self.basis_host(v.shape[0])
+        return v - Q.T @ (Q @ v)
+
+    def test(self, mat) -> bool:
+        """True if A @ q ≈ 0 for every basis vector (petsc4py ``ns.test``)."""
+        A = mat.to_scipy()
+        Q = self.basis_host(mat.shape[0])
+        r = np.linalg.norm(A @ Q.T, axis=0)
+        scale = abs(A).sum() / max(mat.shape[0], 1)
+        return bool(np.all(r <= 1e-10 * max(scale, 1.0)))
+
+    def __repr__(self):
+        return (f"NullSpace(constant={self._constant}, "
+                f"extra_vectors={len(self._vectors)})")

@@ -506,7 +506,9 @@ class EPS:
         # Cache the built ST operator: sinvert/GHEP factorize a dense inverse
         # on host (O(n^3)) — rebuilding it per solve() with unchanged
         # (A, B, st) would repeat that and re-ship the replicated inverse.
-        key = (self._mat, self._bmat, self.st.get_type(), self.st.sigma)
+        key = (self._mat, getattr(self._mat, "_state", 0), self._bmat,
+               getattr(self._bmat, "_state", 0), self.st.get_type(),
+               self.st.sigma)
         cached = getattr(self, "_op_cache", None)
         if cached is not None and cached[0] == key:
             return comm, cached[1], cached[2], hermitian

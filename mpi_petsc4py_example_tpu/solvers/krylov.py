@@ -765,13 +765,23 @@ def _monitor_trampoline(dev, k, rn):
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
-                      zero_guess: bool = False):
+                      zero_guess: bool = False, nullspace_dim: int = 0):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
 
         x, iters, rnorm, reason = prog(op_arrays, pc_arrays, b, x0,
                                        rtol, atol, maxit)
+
+    With ``nullspace_dim > 0`` an extra leading argument carries the
+    row-sharded (k, n_pad) orthonormal null-space basis::
+
+        x, ... = prog(op_arrays, pc_arrays, ns_basis, b, x0, rtol, atol, maxit)
+
+    and the program removes the null-space component from the RHS, the
+    initial guess, and every operator/preconditioner output (PETSc's
+    MatNullSpace semantics for compatible singular systems) — one fused
+    ``psum`` dot per basis vector, inside the same XLA program.
 
     ``operator`` is anything implementing the linear-operator protocol (see
     core.mat.Mat and models.stencil): ``shape``, ``dtype``,
@@ -784,7 +794,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     n = operator.shape[0]
     dtype = operator.dtype
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
-           restart, monitored, zero_guess, operator.program_key())
+           restart, monitored, zero_guess, operator.program_key(),
+           nullspace_dim)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -807,25 +818,44 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             jax.debug.callback(_monitor_trampoline, lax.axis_index(axis),
                                k, rn)
 
-    def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
-        if zero_guess:
-            x0 = jnp.zeros_like(b)
-        A = lambda v: spmv_local(op_arrays, v)
-        M = lambda r: pc_apply(pc_arrays, r)
-        pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
-        pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
-        kw = {"monitor": monitor} if monitor is not None else {}
-        if ksp_type in ("gmres", "fgmres"):
-            kw["restart"] = restart
-            kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
-        elif ksp_type == "pipecg":
-            # the whole point: all per-iteration dots in ONE fused psum
-            kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts), axis)
-        elif ksp_type == "lsqr":
-            kw["At"] = lambda v: spmv_t_local(op_arrays, v)
-        return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
+    def make_body(project):
+        def body(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+            if zero_guess:
+                x0 = jnp.zeros_like(b)
+            b, x0 = project(b), project(x0)
+            A = lambda v: project(spmv_local(op_arrays, v))
+            M = lambda r: project(pc_apply(pc_arrays, r))
+            pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
+            pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+            kw = {"monitor": monitor} if monitor is not None else {}
+            if ksp_type in ("gmres", "fgmres"):
+                kw["restart"] = restart
+                kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
+            elif ksp_type == "pipecg":
+                # the whole point: all per-iteration dots in ONE fused psum
+                kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),
+                                                        axis)
+            elif ksp_type == "lsqr":
+                kw["At"] = lambda v: spmv_t_local(op_arrays, v)
+            return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
+        return body
 
-    in_specs = (op_specs, pc.in_specs(axis), P(axis), P(axis), P(), P(), P())
+    if nullspace_dim:
+        def local_fn(op_arrays, pc_arrays, ns_q, b, x0, rtol, atol, maxit):
+            def project(v):
+                return v - lax.psum(ns_q @ v, axis) @ ns_q
+            return make_body(project)(op_arrays, pc_arrays, b, x0,
+                                      rtol, atol, maxit)
+
+        in_specs = (op_specs, pc.in_specs(axis), P(None, axis),
+                    P(axis), P(axis), P(), P(), P())
+    else:
+        def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+            return make_body(lambda v: v)(op_arrays, pc_arrays, b, x0,
+                                          rtol, atol, maxit)
+
+        in_specs = (op_specs, pc.in_specs(axis),
+                    P(axis), P(axis), P(), P(), P())
     out_specs = (P(axis), P(), P(), P())
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
     _PROGRAM_CACHE[key] = prog

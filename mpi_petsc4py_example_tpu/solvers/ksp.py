@@ -192,18 +192,26 @@ class KSP:
                     for m in _monitors:
                         m(self, int(k), float(rn))
 
+        nullspace = getattr(mat, "nullspace", None)
+        if nullspace is not None and nullspace.dim == 0:
+            nullspace = None        # empty null space: nothing to project
         prog = build_ksp_program(comm, self._type, pc, mat,
                                  restart=self.restart,
                                  monitored=monitor_cb is not None,
-                                 zero_guess=not self._initial_guess_nonzero)
+                                 zero_guess=not self._initial_guess_nonzero,
+                                 nullspace_dim=(nullspace.dim if nullspace
+                                                else 0))
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each)
         dt = np.dtype(mat.dtype)
+        ns_args = ((nullspace.device_array(comm, mat.shape[0], dt),)
+                   if nullspace else ())
         set_current_monitor(monitor_cb)
         t0 = time.perf_counter()
         try:
             xd, iters, rnorm, reason = prog(
-                mat.device_arrays(), pc.device_arrays(), b.data, x.data,
+                mat.device_arrays(), pc.device_arrays(), *ns_args,
+                b.data, x.data,
                 dt.type(self.rtol), dt.type(self.atol),
                 np.int32(self.max_it))
             # one batched D2H fetch (a remote-TPU round trip costs ~100ms;

@@ -107,8 +107,11 @@ class PC:
         mat = self._mat
         if mat is None:
             raise RuntimeError("PC.set_up: no operator set")
-        # tunables are baked into the built arrays — they are part of the key
-        build_key = (mat, self._type, self.sor_omega, self.asm_overlap,
+        # tunables are baked into the built arrays — they are part of the
+        # key, as is the matrix's mutation counter (axpy/shift/zero_rows
+        # rebuild the operator in place without changing its identity)
+        build_key = (mat, getattr(mat, "_state", 0), self._type,
+                     self.sor_omega, self.asm_overlap,
                      self.factor_fill, self.gamg_threshold,
                      self.gamg_coarse_size, self.gamg_max_levels)
         if self._built_for == build_key:

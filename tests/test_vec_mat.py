@@ -126,3 +126,199 @@ class TestVecArithmetic:
         v = tps.Vec.from_global(comm8, np.zeros(10))
         v.shift(1.0)
         assert v.sum() == 10.0  # padding (6 slots) stayed zero
+
+
+class TestMatAlgebra:
+    """PETSc Mat API surface: norm/transpose/axpy/scale/shift/zero_rows."""
+
+    @staticmethod
+    def _rand(comm, n=40, seed=0):
+        rng = np.random.default_rng(seed)
+        A = sp.random(n, n, density=0.15, random_state=rng, format="csr")
+        A = A + sp.eye(n)
+        return tps.Mat.from_scipy(comm, A), A.tocsr()
+
+    def test_norms(self, comm8):
+        M, A = self._rand(comm8)
+        assert np.isclose(M.norm("frobenius"), sp.linalg.norm(A, "fro"))
+        assert np.isclose(M.norm("1"), np.abs(A.toarray()).sum(0).max())
+        assert np.isclose(M.norm("inf"), np.abs(A.toarray()).sum(1).max())
+
+    def test_transpose_mult(self, comm8):
+        M, A = self._rand(comm8, seed=1)
+        Mt = M.transpose()
+        x = np.random.default_rng(2).random(A.shape[0])
+        xv, yv = Mt.get_vecs()
+        xv.set_global(x)
+        y = Mt.mult(xv).to_numpy()
+        np.testing.assert_allclose(y, A.T @ x, rtol=1e-12)
+
+    def test_axpy_scale_shift(self, comm8):
+        M, A = self._rand(comm8, seed=3)
+        X, B = self._rand(comm8, seed=4)
+        M.axpy(2.5, X)
+        M.scale(0.5)
+        M.shift(1.25)
+        expect = ((A + 2.5 * B) * 0.5 + 1.25 * sp.eye(A.shape[0])).tocsr()
+        got = M.to_scipy()
+        np.testing.assert_allclose(got.toarray(), expect.toarray(),
+                                   rtol=1e-12)
+
+    def test_duplicate_independent(self, comm8):
+        M, A = self._rand(comm8, seed=5)
+        D = M.duplicate()
+        D.scale(0.0)
+        np.testing.assert_allclose(M.to_scipy().toarray(), A.toarray())
+        assert D.norm() == 0.0
+
+    def test_zero_rows_dirichlet(self, comm8):
+        # impose Dirichlet rows the PETSc way and check the solve honors them
+        n = 30
+        A = sp.diags([-np.ones(n-1), 2*np.ones(n), -np.ones(n-1)],
+                     [-1, 0, 1]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        x, b = M.get_vecs()
+        rng = np.random.default_rng(6)
+        b.set_global(rng.random(n))
+        xbc = np.zeros(n); xbc[0] = 3.0; xbc[-1] = -2.0
+        x.set_global(xbc)
+        M.zero_rows([0, n - 1], diag=1.0, b=b, x=x)
+        S = M.to_scipy().toarray()
+        assert S[0, 0] == 1.0 and np.all(S[0, 1:] == 0)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M); ksp.set_type("gmres")
+        ksp.set_tolerances(rtol=1e-12)
+        xs, bs = M.get_vecs()
+        bs.set_global(b.to_numpy())
+        res = ksp.solve(bs, xs)
+        sol = xs.to_numpy()
+        assert res.converged
+        assert np.isclose(sol[0], 3.0) and np.isclose(sol[-1], -2.0)
+
+    def test_get_row_and_info(self, comm8):
+        M, A = self._rand(comm8, seed=7)
+        cols, vals = M.get_row(5)
+        s, e = A.indptr[5], A.indptr[6]
+        np.testing.assert_array_equal(cols, A.indices[s:e])
+        np.testing.assert_allclose(vals, A.data[s:e])
+        info = M.get_info()
+        assert info["nnz"] == A.nnz
+
+
+class TestNullSpace:
+    """Singular (Neumann-type) systems via MatNullSpace projection."""
+
+    @staticmethod
+    def _neumann1d(n):
+        # 1D Laplacian with pure Neumann BCs: singular, nullspace = const
+        main = 2 * np.ones(n); main[0] = main[-1] = 1.0
+        return sp.diags([-np.ones(n-1), main, -np.ones(n-1)],
+                        [-1, 0, 1]).tocsr()
+
+    def test_nullspace_test_method(self, comm8):
+        A = self._neumann1d(50)
+        M = tps.Mat.from_scipy(comm8, A)
+        ns = tps.NullSpace(constant=True)
+        assert ns.test(M)
+        Mbad = tps.Mat.from_scipy(comm8, A + sp.eye(50))
+        assert not ns.test(Mbad)
+
+    def test_cg_singular_neumann(self, comm):
+        n = 64
+        A = self._neumann1d(n)
+        ns = tps.NullSpace(constant=True)
+        # compatible RHS: project a random b onto range(A) = const^perp
+        rng = np.random.default_rng(1)
+        b = ns.remove(rng.random(n))
+        M = tps.Mat.from_scipy(comm, A)
+        M.set_nullspace(ns)
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M); ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-10, max_it=5000)
+        x, bv = M.get_vecs(); bv.set_global(b)
+        res = ksp.solve(bv, x)
+        sol = x.to_numpy()
+        assert res.converged
+        # solution solves the system and is mean-free (nullspace removed)
+        assert np.linalg.norm(A @ sol - b) <= 1e-8 * np.linalg.norm(b)
+        assert abs(sol.mean()) < 1e-10
+
+    def test_incompatible_rhs_least_squares(self, comm8):
+        # b with a nullspace component: solver must still converge on the
+        # projected (compatible) part — PETSc MatNullSpace semantics
+        n = 48
+        A = self._neumann1d(n)
+        ns = tps.NullSpace(constant=True)
+        rng = np.random.default_rng(2)
+        b_raw = rng.random(n)        # NOT projected
+        M = tps.Mat.from_scipy(comm8, A)
+        M.set_nullspace(ns)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M); ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-10, max_it=5000)
+        x, bv = M.get_vecs(); bv.set_global(b_raw)
+        res = ksp.solve(bv, x)
+        sol = x.to_numpy()
+        assert res.converged
+        b_proj = ns.remove(b_raw)
+        assert np.linalg.norm(A @ sol - b_proj) <= 1e-8 * np.linalg.norm(b_proj)
+
+    def test_vector_nullspace(self, comm8):
+        # block-diagonal singular operator with a known non-constant null
+        # vector supplied explicitly
+        n = 40
+        d = np.arange(1.0, n + 1); d[7] = 0.0
+        A = sp.diags(d).tocsr()
+        null = np.zeros(n); null[7] = 1.0
+        ns = tps.NullSpace(vectors=[null])
+        assert ns.dim == 1 and ns.test(tps.Mat.from_scipy(comm8, A))
+        rng = np.random.default_rng(3)
+        b = rng.random(n); b[7] = 0.0      # compatible
+        M = tps.Mat.from_scipy(comm8, A)
+        M.set_nullspace(ns)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M); ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-12, max_it=1000)
+        x, bv = M.get_vecs(); bv.set_global(b)
+        res = ksp.solve(bv, x)
+        sol = x.to_numpy()
+        assert res.converged
+        np.testing.assert_allclose(sol[d != 0], (b / np.where(d == 0, 1, d))[d != 0], atol=1e-9)
+        assert abs(sol[7]) < 1e-10
+
+
+class TestMutationInvalidatesPC:
+    def test_pc_rebuilds_after_shift(self, comm8):
+        # PC setup caches must key on the matrix mutation state — a stale
+        # LU after Mat.shift would silently solve the old system
+        n = 24
+        A = sp.diags(np.linspace(1.0, 5.0, n)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M); ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        x, b = M.get_vecs()
+        b.set_global(np.ones(n))
+        ksp.solve(b, x)
+        np.testing.assert_allclose(x.to_numpy(),
+                                   1.0 / np.linspace(1.0, 5.0, n),
+                                   rtol=1e-10)
+        M.shift(1.0)           # in-place mutation
+        x2, b2 = M.get_vecs()
+        b2.set_global(np.ones(n))
+        ksp.solve(b2, x2)
+        np.testing.assert_allclose(x2.to_numpy(),
+                                   1.0 / (np.linspace(1.0, 5.0, n) + 1.0),
+                                   rtol=1e-10)
+
+    def test_empty_nullspace_ignored(self, comm8):
+        A = sp.eye(12, format="csr")
+        M = tps.Mat.from_scipy(comm8, A)
+        M.set_nullspace(tps.NullSpace())   # dim == 0
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M); ksp.set_type("cg")
+        x, b = M.get_vecs(); b.set_global(np.ones(12))
+        res = ksp.solve(b, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), np.ones(12), rtol=1e-10)
