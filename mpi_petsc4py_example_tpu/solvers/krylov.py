@@ -722,6 +722,150 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
             _reason(st["phibar"], tol, atol, st["k"], maxit, st["brk"]))
 
 
+def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                At=None):
+    """Biconjugate gradients (KSPBICG): dual recurrences on A and A^T.
+
+    The shadow system uses ``M`` for the transpose preconditioner apply —
+    exact for the symmetric PCs here (none/jacobi/SPD block inverses), the
+    same contract PETSc's PCApplyTranspose fulfills.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rt = r
+    z = M(r)
+    zt = M(rt)
+    p = z
+    pt = zt
+    rho = pdot(rt, z)
+    rnorm = pnorm(r)
+
+    def cond(st):
+        k, x, r, rt, p, pt, rho, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, rt, p, pt, rho, rn, brk = st
+        q = A(p)
+        qt = At(pt)
+        pq = pdot(pt, q)
+        brk = (pq == 0) | (rho == 0)
+        alpha = jnp.where(brk, 0.0, rho / jnp.where(pq == 0, 1.0, pq))
+        x = x + alpha * p
+        r = r - alpha * q
+        rt = rt - alpha * qt
+        z = M(r)
+        zt = M(rt)
+        rho_new = pdot(rt, z)
+        beta = jnp.where(rho == 0, 0.0,
+                         rho_new / jnp.where(rho == 0, 1.0, rho))
+        p = z + beta * p
+        pt = zt + beta * pt
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, rt, p, pt, rho_new, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, rt, p, pt, rho, rnorm, rnorm <= -1.0)
+    k, x, r, rt, p, pt, rho, rnorm, brk = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+               restart=30, pmatdot=None):
+    """Restarted GCR (KSPGCR): flexible — the preconditioner may change
+    between iterations (like fgmres), with explicitly stored (v, z) pairs.
+
+    The stored search directions live in fixed (restart, n_local) buffers;
+    orthogonalization against them is one fused ``psum`` matvec (empty slots
+    are zero rows, so no masking is needed).
+    """
+    m = restart
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rnorm = pnorm(r)
+    V = jnp.zeros((m,) + b.shape, b.dtype)
+    Z = jnp.zeros_like(V)
+
+    def cond(st):
+        k, slot, x, r, V, Z, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, slot, x, r, V, Z, rn, brk = st
+        wiped = (slot != 0).astype(b.dtype)
+        V = V * wiped            # restart boundary: clear the direction set
+        Z = Z * wiped
+        z = M(r)
+        v = A(z)
+        bcoef = pmatdot(V, v)
+        v = v - bcoef @ V
+        z = z - bcoef @ Z
+        nv = pnorm(v)
+        brk = nv == 0
+        nv_safe = jnp.where(brk, 1.0, nv)
+        v = v / nv_safe
+        z = z / nv_safe
+        alpha = pdot(r, v)
+        x = x + alpha * z
+        r = r - alpha * v
+        V = V.at[slot].set(v)
+        Z = Z.at[slot].set(z)
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, (slot + 1) % m, x, r, V, Z, rn, brk)
+
+    st0 = (jnp.int32(0), jnp.int32(0), x0, r, V, Z, rnorm, rnorm <= -1.0)
+    k, slot, x, r, V, Z, rnorm, brk = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                At=None):
+    """CG on the normal equations A^T A x = A^T b (KSPCGNE).
+
+    Squares the condition number but handles unsymmetric/rank-deficient
+    square systems with only A and A^T products; the PC applies to the
+    normal-equations residual. Convergence is tested on ||b - Ax|| like the
+    other kernels.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    s = At(r)
+    z = M(s)
+    p = z
+    gamma = pdot(s, z)
+    rnorm = pnorm(r)
+
+    def cond(st):
+        k, x, r, p, gamma, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, p, gamma, rn, brk = st
+        q = A(p)
+        qq = pdot(q, q)
+        brk = qq == 0
+        alpha = jnp.where(brk, 0.0, gamma / jnp.where(brk, 1.0, qq))
+        x = x + alpha * p
+        r = r - alpha * q
+        s = At(r)
+        z = M(s)
+        gamma_new = pdot(s, z)
+        beta = jnp.where(gamma == 0, 0.0,
+                         gamma_new / jnp.where(gamma == 0, 1.0, gamma))
+        p = z + beta * p
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, p, gamma_new, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, p, gamma, rnorm, rnorm <= -1.0)
+    k, x, r, p, gamma, rnorm, brk = lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
 KSP_KERNELS = {
     "cg": cg_kernel,
     "pipecg": pipecg_kernel,
@@ -736,7 +880,13 @@ KSP_KERNELS = {
     "chebyshev": chebyshev_kernel,
     "preonly": preonly_kernel,
     "richardson": richardson_kernel,
+    "bicg": bicg_kernel,
+    "gcr": gcr_kernel,
+    "cgne": cgne_kernel,
 }
+
+# kernels needing the transpose product A^T v (operator.local_spmv_t)
+_NEEDS_TRANSPOSE = ("lsqr", "bicg", "cgne")
 
 
 # ---------------------------------------------------------------------------
@@ -801,13 +951,21 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         return cached
 
     kernel = KSP_KERNELS[ksp_type]
+    if ksp_type == "bicg" and pc.kind not in ("none", "jacobi"):
+        # BiCG's shadow recurrence needs M^T; only symmetric-by-construction
+        # PC applies can stand in for it here (PETSc routes this through
+        # PCApplyTranspose, which these block/sweep PCs don't provide)
+        raise ValueError(
+            f"KSP 'bicg' needs a symmetric preconditioner apply (pc 'none' "
+            f"or 'jacobi'), got {pc.get_type()!r} — use bcgs/gmres/gcr for "
+            "general preconditioning")
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
     spmv_t_local = None
-    if ksp_type == "lsqr":
+    if ksp_type in _NEEDS_TRANSPOSE:
         if not hasattr(operator, "local_spmv_t"):
             raise ValueError(
-                "KSP 'lsqr' needs the transpose product; operator "
+                f"KSP {ksp_type!r} needs the transpose product; operator "
                 f"{type(operator).__name__} provides no local_spmv_t")
         spmv_t_local = operator.local_spmv_t(comm)
     op_specs = operator.op_specs(axis)
@@ -828,15 +986,19 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
             pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
             kw = {"monitor": monitor} if monitor is not None else {}
-            if ksp_type in ("gmres", "fgmres"):
+            if ksp_type in ("gmres", "fgmres", "gcr"):
                 kw["restart"] = restart
                 kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
             elif ksp_type == "pipecg":
                 # the whole point: all per-iteration dots in ONE fused psum
                 kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),
                                                         axis)
-            elif ksp_type == "lsqr":
-                kw["At"] = lambda v: spmv_t_local(op_arrays, v)
+            elif ksp_type in _NEEDS_TRANSPOSE:
+                # the adjoint of the projected operator v -> P(Av) is
+                # w -> A^T(Pw): project BEFORE the transpose product (P is
+                # the null(A) projector; projecting after would be wrong for
+                # unsymmetric A). project is the identity without a nullspace.
+                kw["At"] = lambda v: spmv_t_local(op_arrays, project(v))
             return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
         return body
 

@@ -489,3 +489,62 @@ class TestGAMG:
         pc.gamg_threshold = 0.1
         pc.set_up(M)            # tunable changed: rebuild
         assert pc._amg is not h1
+
+
+class TestBiCGAndGCRAndCGNE:
+    def test_bicg_unsymmetric(self, comm8):
+        A = convdiff2d(16)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "bicg", "jacobi", rtol=1e-10,
+                          max_it=2000)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_bicg_matches_cg_on_spd(self, comm8):
+        # on SPD systems BiCG reduces to CG (same iterates)
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        x_b, res_b, _ = solve(comm8, A, b, "bicg", "jacobi", rtol=1e-10)
+        x_c, res_c, _ = solve(comm8, A, b, "cg", "jacobi", rtol=1e-10)
+        assert res_b.converged and abs(res_b.iterations - res_c.iterations) <= 1
+        np.testing.assert_allclose(x_b, x_c, atol=1e-8)
+
+    def test_gcr_unsymmetric(self, comm):
+        A = convdiff2d(16)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "gcr", "jacobi", rtol=1e-10,
+                          max_it=3000)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_gcr_flexible_with_gamg(self, comm8):
+        A = poisson2d(32)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "gcr", "gamg", rtol=1e-9)
+        assert res.converged and res.iterations <= 25
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_cgne_unsymmetric(self, comm8):
+        A = convdiff2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cgne", "none", rtol=1e-9,
+                          max_it=20000)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-5)
+
+    def test_transpose_free_operator_rejected(self, comm8):
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        op = StencilPoisson3D(comm8, 8)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("bicg")
+        x, b = op.get_vecs()
+        b.set_global(np.ones(op.shape[0]))
+        with pytest.raises(ValueError, match="transpose"):
+            ksp.solve(b, x)
+
+    def test_bicg_rejects_unsymmetric_pc(self, comm8):
+        A = convdiff2d(8)
+        x_true, b = manufactured(A)
+        with pytest.raises(ValueError, match="symmetric preconditioner"):
+            solve(comm8, A, b, "bicg", "ilu")
