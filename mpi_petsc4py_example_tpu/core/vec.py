@@ -97,8 +97,19 @@ class Vec:
         return np.asarray(self.data)[: self.n].copy()
 
     # ---- vector arithmetic (petsc4py-Vec-shaped; solvers use raw arrays) ---
-    def norm(self) -> float:
-        return float(jnp.linalg.norm(self.data))
+    def norm(self, norm_type: str = "2") -> float:
+        """Vector norm: '2' (default, PETSc NORM_2), '1', or 'inf'.
+
+        Padding entries are zero by construction, so device-side reductions
+        over the padded array are exact for all three norms."""
+        t = str(norm_type).lower()
+        if t in ("2", "fro", "frobenius"):
+            return float(jnp.linalg.norm(self.data))
+        if t in ("1", "one"):
+            return float(jnp.sum(jnp.abs(self.data)))
+        if t in ("inf", "infinity"):
+            return float(jnp.max(jnp.abs(self.data)))
+        raise ValueError(f"unknown norm type {norm_type!r}")
 
     def dot(self, other: "Vec") -> float:
         return float(jnp.vdot(self.data, other.data))
@@ -132,11 +143,63 @@ class Vec:
     def sum(self) -> float:
         return float(jnp.sum(self.data))
 
-    def min(self) -> float:
-        return float(np.min(self.to_numpy()))
+    def mean(self) -> float:
+        return float(jnp.sum(self.data)) / self.n
 
-    def max(self) -> float:
-        return float(np.max(self.to_numpy()))
+    def min(self) -> tuple[int, float]:
+        """(location, value) of the minimum — petsc4py's ``vec.min()``."""
+        h = self.to_numpy()
+        i = int(np.argmin(h))
+        return i, float(h[i])
+
+    def max(self) -> tuple[int, float]:
+        """(location, value) of the maximum — petsc4py's ``vec.max()``."""
+        h = self.to_numpy()
+        i = int(np.argmax(h))
+        return i, float(h[i])
+
+    def waxpy(self, alpha: float, x: "Vec", y: "Vec"):
+        """self = alpha*x + y (PETSc VecWAXPY)."""
+        self.data = _axpy(jnp.asarray(alpha, self.dtype), x.data, y.data)
+        return self
+
+    def axpby(self, alpha: float, beta: float, x: "Vec"):
+        """self = alpha*x + beta*self (PETSc VecAXPBY)."""
+        self.data = _axpby(jnp.asarray(alpha, self.dtype),
+                           jnp.asarray(beta, self.dtype), x.data, self.data)
+        return self
+
+    def pointwise_divide(self, a: "Vec", b: "Vec"):
+        """self = a / b elementwise; 0/0 on padding stays 0."""
+        self.data = _pdiv(a.data, b.data)
+        return self
+
+    def reciprocal(self):
+        """self = 1/self on nonzero entries (PETSc VecReciprocal; padding
+        and exact zeros stay zero, matching the Jacobi-diagonal convention)."""
+        self.data = _precip(self.data)
+        return self
+
+    def normalize(self) -> float:
+        """Scale to unit 2-norm; returns the prior norm."""
+        nrm = self.norm()
+        if nrm != 0:
+            self.scale(1.0 / nrm)
+        return nrm
+
+    def set_value(self, i: int, v: float):
+        """Point insert by global index (assembly-time convenience)."""
+        h = self.to_numpy()
+        h[i] = v
+        self.set_global(h)
+        return self
+
+    setValue = set_value
+
+    def set(self, alpha: float):
+        """self[:] = alpha (PETSc VecSet)."""
+        self.set_global(np.full(self.n, alpha))
+        return self
 
     def zero(self):
         # host-side zeros + async device_put: avoids an eager device
@@ -161,3 +224,18 @@ def _scale(alpha, x):
 @jax.jit
 def _pmult(a, b):
     return a * b
+
+
+@jax.jit
+def _axpby(alpha, beta, x, y):
+    return alpha * x + beta * y
+
+
+@jax.jit
+def _pdiv(a, b):
+    return jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b))
+
+
+@jax.jit
+def _precip(x):
+    return jnp.where(x == 0, 0.0, 1.0 / jnp.where(x == 0, 1.0, x))
