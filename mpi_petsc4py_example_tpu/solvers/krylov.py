@@ -866,6 +866,319 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
 
 
+def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+    """SYMMLQ (Paige & Saunders 1975; KSPSYMMLQ) for symmetric systems.
+
+    The LQ companion of MINRES: iterates in the Krylov space with an LQ
+    factorization of the tridiagonal, keeping the error (not the residual)
+    monotone — the classical choice for symmetric *indefinite* systems where
+    CG's recurrences break. Preconditioned Lanczos as in MINRES (M must be
+    SPD). The loop monitors the CG-point residual estimate and transfers to
+    the CG point on exit; the reported norm is the exact final residual.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    dt = b.dtype
+    r0 = b - A(x0)
+    rnorm0 = pnorm(r0)
+
+    y = M(r0)
+    beta1sq = pdot(r0, y)
+    beta1 = jnp.sqrt(jnp.maximum(beta1sq, 0.0))
+    safe_b1 = jnp.where(beta1 == 0, 1.0, beta1)
+    v = y / safe_b1
+    y2 = A(v)
+    alfa = pdot(v, y2)
+    y2 = y2 - (alfa / safe_b1) * r0
+    r2 = y2
+    y3 = M(r2)
+    betasq = pdot(r2, y3)
+    beta = jnp.sqrt(jnp.maximum(betasq, 0.0))
+    # recurrence norms live in the M-weighted space; rescale estimates so
+    # the tolerance test runs on the unpreconditioned residual norm
+    scale = rnorm0 / safe_b1
+
+    def cond(st):
+        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k = st["k"]
+        beta_c = st["beta"]
+        safe_beta = jnp.where(beta_c == 0, 1.0, beta_c)
+        v = st["y"] / safe_beta
+        yv = A(v)
+        oldb_safe = jnp.where(st["oldb"] == 0, 1.0, st["oldb"])
+        yv = yv - (beta_c / oldb_safe) * st["r1"]
+        alfa = pdot(v, yv)
+        yv = yv - (alfa / safe_beta) * st["r2"]
+        r1 = st["r2"]
+        r2 = yv
+        y_new = M(r2)
+        oldb = beta_c
+        betasq = pdot(r2, y_new)
+        brk = st["brk"] | (betasq < 0)
+        beta_new = jnp.sqrt(jnp.maximum(betasq, 0.0))
+        # plane rotation (LQ factorization of the tridiagonal)
+        gamma = jnp.sqrt(st["gbar"] ** 2 + oldb ** 2)
+        gamma = jnp.where(gamma == 0, jnp.asarray(1e-30, dt), gamma)
+        cs = st["gbar"] / gamma
+        sn = oldb / gamma
+        delta = cs * st["dbar"] + sn * alfa
+        gbar = sn * st["dbar"] - cs * alfa
+        epsln = sn * beta_new
+        dbar = -cs * beta_new
+        # update the LQ point
+        z = st["rhs1"] / gamma
+        x = st["x"] + (z * cs) * st["w"] + (z * sn) * v
+        w = sn * st["w"] - cs * v
+        bstep = st["snprod"] * cs * z + st["bstep"]
+        snprod = st["snprod"] * sn
+        rhs1 = st["rhs2"] - delta * z
+        rhs2 = -epsln * z
+        # CG-point residual estimate for the convergence test
+        qrnorm = snprod * beta1
+        gbar_safe = jnp.where(gbar == 0, jnp.asarray(1e-30, dt), gbar)
+        cgnorm = qrnorm * beta_new / jnp.abs(gbar_safe)
+        rn = cgnorm * scale
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return dict(k=k + 1, x=x, w=w, r1=r1, r2=r2, y=y_new,
+                    oldb=oldb, beta=beta_new, gbar=gbar, dbar=dbar,
+                    rhs1=rhs1, rhs2=rhs2, snprod=snprod, bstep=bstep,
+                    rn=rn, brk=brk)
+
+    zero = jnp.zeros_like(b)
+    st0 = dict(k=jnp.int32(0), x=zero, w=zero, r1=r0, r2=r2, y=y3,
+               oldb=beta1, beta=beta, gbar=alfa, dbar=beta,
+               rhs1=beta1, rhs2=jnp.asarray(0.0, dt),
+               snprod=jnp.asarray(1.0, dt), bstep=jnp.asarray(0.0, dt),
+               rn=rnorm0, brk=(beta1sq < 0) | (betasq < 0))
+    st = lax.while_loop(cond, body, st0)
+    # transfer LQ point -> CG point, then add the component along v1 —
+    # only if the loop actually iterated (the transfer IS one CG step; an
+    # already-converged initial guess must come back untouched)
+    gbar_safe = jnp.where(st["gbar"] == 0, 1.0, st["gbar"])
+    zbar = st["rhs1"] / gbar_safe
+    bstep = st["snprod"] * zbar + st["bstep"]
+    xc = st["x"] + zbar * st["w"]
+    xc = xc + (bstep / safe_b1) * M(r0)
+    x = x0 + jnp.where(st["k"] > 0, xc, jnp.zeros_like(b))
+    rn_true = pnorm(b - A(x))
+    return (x, st["k"], rn_true,
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"]))
+
+
+def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+               restart=30, pmatdot=None, monitor=None):
+    """Truncated flexible CG (Notay; KSPFCG).
+
+    The preconditioner may change between iterations; new directions are
+    A-orthogonalized against a sliding window of the last ``restart`` stored
+    pairs ``(p_i, Ap_i)``. The whole-window projection is one fused ``psum``
+    matvec per iteration (empty slots are zero rows — no masking needed).
+    """
+    m = restart
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rnorm = pnorm(r)
+    Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
+    APbuf = jnp.zeros_like(Pbuf)
+    eta = jnp.zeros(m, b.dtype)
+
+    def cond(st):
+        k, slot, x, r, Pb, APb, eta, rn, brk = st
+        return (rn > tol) & (k < maxit) & ~brk
+
+    def body(st):
+        k, slot, x, r, Pb, APb, eta, rn, brk = st
+        z = M(r)
+        c = pmatdot(APb, z)                 # z . Ap_i over the window
+        coef = jnp.where(eta != 0, c / jnp.where(eta == 0, 1.0, eta), 0.0)
+        p = z - coef @ Pb
+        Ap = A(p)
+        pAp = pdot(p, Ap)
+        brk = pAp == 0
+        alpha = jnp.where(brk, 0.0,
+                          pdot(p, r) / jnp.where(brk, 1.0, pAp))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        Pb = Pb.at[slot].set(p)
+        APb = APb.at[slot].set(Ap)
+        eta = eta.at[slot].set(pAp)
+        rn = pnorm(r)
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, (slot + 1) % m, x, r, Pb, APb, eta, rn, brk)
+
+    st0 = (jnp.int32(0), jnp.int32(0), x0, r, Pbuf, APbuf, eta,
+           rnorm, rnorm <= -1.0)
+    k, slot, x, r, Pbuf, APbuf, eta, rnorm, brk = \
+        lax.while_loop(cond, body, st0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+
+
+def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                  restart=30, aug=2, pmatdot=None, monitor=None):
+    """LGMRES (Baker, Jessup & Manteuffel 2005; KSPLGMRES).
+
+    Restarted GMRES whose search space is augmented with the ``aug`` most
+    recent *error approximations* (the correction vectors of previous
+    cycles) — recovering much of the convergence lost to restarting on
+    problems where plain GMRES(m) stalls. Until the augmentation slots fill,
+    their zero rows contribute harmless zero columns to the small
+    least-squares problem (the masked back-substitution returns 0 for them).
+    """
+    if aug <= 0:      # PETSc semantics: zero augmentation = plain GMRES(m)
+        return gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                            restart=restart, pmatdot=pmatdot, monitor=monitor)
+    m = restart
+    s = m + aug
+    lsize = b.shape[0]
+    pb = M(b)
+    bnorm = pnorm(pb)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    rnorm0 = pnorm(M(b - A(x0)))
+    Z0 = jnp.zeros((aug, lsize), b.dtype)
+
+    def cycle(st):
+        k, x, Z, rn = st
+        r = M(b - A(x))
+        beta = pnorm(r)
+        V = jnp.zeros((s + 1, lsize), b.dtype)
+        V = V.at[0].set(r / jnp.where(beta == 0, 1.0, beta))
+        W = jnp.zeros((s, lsize), b.dtype)
+        H = jnp.zeros((s + 1, s), b.dtype)
+
+        def arnoldi(j, VWH):
+            V, W, H = VWH
+            vj = lax.dynamic_index_in_dim(V, j, keepdims=False)
+            zj = lax.dynamic_index_in_dim(
+                Z, jnp.clip(j - m, 0, aug - 1), keepdims=False)
+            wexp = jnp.where(j < m, vj, zj)
+            W = W.at[j].set(wexp)
+            u = M(A(wexp))
+            h, hnorm, vnext = _cgs2_step(V, u, pmatdot, pnorm)
+            H = H.at[:, j].set(h)
+            H = H.at[j + 1, j].set(hnorm)
+            V = V.at[j + 1].set(vnext)
+            return (V, W, H)
+
+        V, W, H = lax.fori_loop(0, s, arnoldi, (V, W, H))
+        y, _ = _hessenberg_lstsq(H, beta)
+        dx = y @ W
+        x = x + dx
+        ndx = pnorm(dx)
+        znew = dx / jnp.where(ndx == 0, 1.0, ndx)
+        Z = jnp.roll(Z, 1, axis=0).at[0].set(znew)
+        rn = pnorm(M(b - A(x)))
+        if monitor is not None:
+            monitor(k + s, rn)
+        return (k + s, x, Z, rn)
+
+    def cond(st):
+        k, x, Z, rn = st
+        return (rn > tol) & (k < maxit)
+
+    k, x, Z, rnorm = lax.while_loop(
+        cond, cycle, (jnp.int32(0), x0, Z0, rnorm0))
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+
+
+def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
+                 ell=2, monitor=None):
+    """BiCGStab(ℓ) (Sleijpen & Fokkema 1993; KSPBCGSL), right-preconditioned.
+
+    Combines ℓ BiCG steps with an ℓ-th-degree minimum-residual polynomial
+    update per outer iteration — more robust than BiCGStab (ℓ=1) on
+    operators with complex spectra, where the degree-1 MR polynomial
+    stagnates. ℓ is a static unroll (default 2, ``-ksp_bcgsl_ell``); runs on
+    the correction system ``(A·M) y = r0`` with ``x = x0 + M(y)`` applied
+    once at the end, so the in-loop residual is the true residual.
+    """
+    L = int(ell)
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    op = lambda v: A(M(v))
+    r0 = b - A(x0)
+    rtilde = r0
+    rnorm = pnorm(r0)
+    dt = b.dtype
+    Rb = jnp.zeros((L + 1,) + b.shape, dt).at[0].set(r0)
+    Ub = jnp.zeros_like(Rb)
+
+    def safe(x):
+        return jnp.where(x == 0, jnp.asarray(1.0, dt), x)
+
+    def cond(st):
+        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+
+    def body(st):
+        k, y, R, U = st["k"], st["y"], st["R"], st["U"]
+        rho0, alpha, omega, brk = (st["rho0"], st["alpha"], st["omega"],
+                                   st["brk"])
+        rho0 = -omega * rho0
+        # ---- BiCG part (static unroll over j) ----
+        for j in range(L):
+            rho1 = pdot(R[j], rtilde)
+            brk = brk | (rho0 == 0)
+            beta = alpha * rho1 / safe(rho0)
+            rho0 = rho1
+            for i in range(j + 1):
+                U = U.at[i].set(R[i] - beta * U[i])
+            U = U.at[j + 1].set(op(U[j]))
+            gam = pdot(U[j + 1], rtilde)
+            brk = brk | (gam == 0)
+            alpha = rho0 / safe(gam)
+            for i in range(j + 1):
+                R = R.at[i].set(R[i] - alpha * U[i + 1])
+            R = R.at[j + 1].set(op(R[j]))
+            y = y + alpha * U[0]
+        # ---- MR part: min ||R[0] - [R1..RL] g|| via modified Gram-Schmidt
+        tau = [[jnp.asarray(0.0, dt)] * (L + 1) for _ in range(L + 1)]
+        sigma = [jnp.asarray(0.0, dt)] * (L + 1)
+        gamma_p = [jnp.asarray(0.0, dt)] * (L + 1)
+        for j in range(1, L + 1):
+            for i in range(1, j):
+                tau[i][j] = pdot(R[j], R[i]) / safe(sigma[i])
+                R = R.at[j].set(R[j] - tau[i][j] * R[i])
+            sigma[j] = pdot(R[j], R[j])
+            brk = brk | (sigma[j] == 0)
+            gamma_p[j] = pdot(R[0], R[j]) / safe(sigma[j])
+        gamma = [jnp.asarray(0.0, dt)] * (L + 1)
+        gamma_pp = [jnp.asarray(0.0, dt)] * (L + 1)
+        gamma[L] = gamma_p[L]
+        omega = gamma[L]
+        brk = brk | (omega == 0)
+        for j in range(L - 1, 0, -1):
+            gamma[j] = gamma_p[j] - sum(
+                (tau[j][i] * gamma[i] for i in range(j + 1, L + 1)),
+                jnp.asarray(0.0, dt))
+        for j in range(1, L):
+            gamma_pp[j] = gamma[j + 1] + sum(
+                (tau[j][i] * gamma[i + 1] for i in range(j + 1, L)),
+                jnp.asarray(0.0, dt))
+        # ---- update ----
+        y = y + gamma[1] * R[0]
+        R = R.at[0].set(R[0] - gamma_p[L] * R[L])
+        U = U.at[0].set(U[0] - gamma[L] * U[L])
+        for j in range(1, L):
+            U = U.at[0].set(U[0] - gamma[j] * U[j])
+            y = y + gamma_pp[j] * R[j]
+            R = R.at[0].set(R[0] - gamma_p[j] * R[j])
+        rn = pnorm(R[0])
+        if monitor is not None:
+            monitor(k + L, rn)
+        return dict(k=k + L, y=y, R=R, U=U, rho0=rho0, alpha=alpha,
+                    omega=omega, rn=rn, brk=brk)
+
+    st0 = dict(k=jnp.int32(0), y=jnp.zeros_like(b), R=Rb, U=Ub,
+               rho0=jnp.asarray(1.0, dt), alpha=jnp.asarray(0.0, dt),
+               omega=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0)
+    st = lax.while_loop(cond, body, st0)
+    x = x0 + M(st["y"])
+    rn_true = pnorm(b - A(x))
+    return (x, st["k"], rn_true,
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+
+
 KSP_KERNELS = {
     "cg": cg_kernel,
     "pipecg": pipecg_kernel,
@@ -883,6 +1196,14 @@ KSP_KERNELS = {
     "bicg": bicg_kernel,
     "gcr": gcr_kernel,
     "cgne": cgne_kernel,
+    "symmlq": symmlq_kernel,
+    "fcg": fcg_kernel,
+    "lgmres": lgmres_kernel,
+    "bcgsl": bcgsl_kernel,
+    # PETSc's flexible BiCGStab variants: the bcgs kernel here is already
+    # right-preconditioned (flexible by construction), so they share it
+    "fbcgs": bcgs_kernel,
+    "fbcgsr": bcgs_kernel,
 }
 
 # kernels needing the transpose product A^T v (operator.local_spmv_t)
@@ -915,7 +1236,8 @@ def _monitor_trampoline(dev, k, rn):
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
-                      zero_guess: bool = False, nullspace_dim: int = 0):
+                      zero_guess: bool = False, nullspace_dim: int = 0,
+                      aug: int = 2, ell: int = 2):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -945,7 +1267,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     dtype = operator.dtype
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart, monitored, zero_guess, operator.program_key(),
-           nullspace_dim)
+           nullspace_dim, aug, ell)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -986,9 +1308,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
             pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
             kw = {"monitor": monitor} if monitor is not None else {}
-            if ksp_type in ("gmres", "fgmres", "gcr"):
+            if ksp_type in ("gmres", "fgmres", "gcr", "fcg", "lgmres"):
                 kw["restart"] = restart
                 kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
+                if ksp_type == "lgmres":
+                    kw["aug"] = aug
+            elif ksp_type == "bcgsl":
+                kw["ell"] = ell
             elif ksp_type == "pipecg":
                 # the whole point: all per-iteration dots in ONE fused psum
                 kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),

@@ -6,11 +6,13 @@ The same surface is provided here (snake_case canonical, camelCase aliases for
 facade/driver compatibility); ``solve`` dispatches to a cached jit-compiled
 ``shard_map`` program built by :mod:`.krylov`.
 
-Solver types: ``cg``, ``pipecg`` (single-reduction CG), ``gmres``,
-``fgmres``, ``bcgs``, ``cgs``, ``tfqmr``, ``cr``, ``minres``, ``chebyshev``,
-``lsqr``, ``preonly``, ``richardson``. Runtime override via the options DB:
-``-ksp_type``, ``-ksp_rtol``, ``-ksp_atol``, ``-ksp_max_it``,
-``-ksp_gmres_restart``, ``-ksp_monitor``, ``-pc_type`` (SURVEY.md §5.6).
+Solver types: ``cg``, ``pipecg`` (single-reduction CG), ``fcg``, ``gmres``,
+``fgmres``, ``lgmres``, ``bcgs``, ``fbcgs``/``fbcgsr``, ``bcgsl``, ``cgs``,
+``tfqmr``, ``cr``, ``gcr``, ``minres``, ``symmlq``, ``chebyshev``, ``bicg``,
+``cgne``, ``lsqr``, ``preonly``, ``richardson``. Runtime override via the
+options DB: ``-ksp_type``, ``-ksp_rtol``, ``-ksp_atol``, ``-ksp_max_it``,
+``-ksp_gmres_restart``, ``-ksp_lgmres_augment``, ``-ksp_bcgsl_ell``,
+``-ksp_monitor``, ``-pc_type`` (SURVEY.md §5.6).
 """
 
 from __future__ import annotations
@@ -47,6 +49,8 @@ class KSP:
         self.atol = DEFAULT_ATOL
         self.max_it = DEFAULT_MAX_IT
         self.restart = 30
+        self.lgmres_augment = 2       # -ksp_lgmres_augment (KSPLGMRES aug_k)
+        self.bcgsl_ell = 2            # -ksp_bcgsl_ell (KSPBCGSL default)
         self._monitors = []
         self._monitor_flag = False
         self._initial_guess_nonzero = False
@@ -141,6 +145,9 @@ class KSP:
         self.atol = opt.get_real(p + "ksp_atol", self.atol)
         self.max_it = opt.get_int(p + "ksp_max_it", self.max_it)
         self.restart = opt.get_int(p + "ksp_gmres_restart", self.restart)
+        self.lgmres_augment = opt.get_int(p + "ksp_lgmres_augment",
+                                          self.lgmres_augment)
+        self.bcgsl_ell = opt.get_int(p + "ksp_bcgsl_ell", self.bcgsl_ell)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         pct = opt.get_string(p + "pc_type")
         if pct:
@@ -200,7 +207,9 @@ class KSP:
                                  monitored=monitor_cb is not None,
                                  zero_guess=not self._initial_guess_nonzero,
                                  nullspace_dim=(nullspace.dim if nullspace
-                                                else 0))
+                                                else 0),
+                                 aug=self.lgmres_augment,
+                                 ell=self.bcgsl_ell)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each)
         dt = np.dtype(mat.dtype)

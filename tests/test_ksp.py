@@ -548,3 +548,113 @@ class TestBiCGAndGCRAndCGNE:
         x_true, b = manufactured(A)
         with pytest.raises(ValueError, match="symmetric preconditioner"):
             solve(comm8, A, b, "bicg", "ilu")
+
+
+class TestSymmlqFcgLgmresBcgsl:
+    def test_symmlq_spd(self, comm):
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "symmlq", "jacobi", rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_symmlq_indefinite(self, comm8):
+        # symmetric indefinite (shifted Laplacian) — CG's breakdown case,
+        # SYMMLQ's home turf
+        A = (poisson2d(12) - 3.0 * sp.eye(144)).tocsr()
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "symmlq", "none", rtol=1e-10,
+                          max_it=2000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-7)
+
+    def test_fcg_spd(self, comm):
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "fcg", "jacobi", rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+
+    def test_fcg_flexible_with_gamg(self, comm8):
+        A = poisson2d(32)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "fcg", "gamg", rtol=1e-9)
+        assert res.converged and res.iterations <= 25
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_lgmres_unsymmetric(self, comm8):
+        A = convdiff2d(16)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "lgmres", "jacobi", rtol=1e-10,
+                          restart=10, max_it=3000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_lgmres_beats_restarted_gmres(self, comm8):
+        # small restart makes GMRES(m) stall; augmentation recovers it
+        A = convdiff2d(20, beta=0.8)
+        x_true, b = manufactured(A)
+        x_l, res_l, _ = solve(comm8, A, b, "lgmres", "none", rtol=1e-8,
+                              restart=6, max_it=4000)
+        x_g, res_g, _ = solve(comm8, A, b, "gmres", "none", rtol=1e-8,
+                              restart=6, max_it=4000)
+        assert res_l.converged
+        assert res_l.iterations <= res_g.iterations
+        np.testing.assert_allclose(x_l, x_true, atol=1e-6)
+
+    def test_bcgsl_unsymmetric(self, comm):
+        A = convdiff2d(16)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm, A, b, "bcgsl", "jacobi", rtol=1e-10,
+                          max_it=3000)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_bcgsl_ell3(self, comm8):
+        A = convdiff2d(12, beta=0.6)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "bcgsl", "jacobi", rtol=1e-10,
+                          max_it=3000, bcgsl_ell=3)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_fbcgs_alias(self, comm8):
+        A = convdiff2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "fbcgs", "ilu", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_options_db_new_keys(self, comm8):
+        tps.global_options().parse_argv(
+            ["prog", "-ksp_type", "lgmres", "-ksp_lgmres_augment", "4",
+             "-ksp_bcgsl_ell", "3"])
+        ksp = tps.KSP().create(comm8)
+        ksp.set_from_options()
+        assert ksp.get_type() == "lgmres"
+        assert ksp.lgmres_augment == 4
+        assert ksp.bcgsl_ell == 3
+
+    def test_lgmres_aug0_is_plain_gmres(self, comm8):
+        A = convdiff2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "lgmres", "jacobi", rtol=1e-9,
+                          lgmres_augment=0)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_symmlq_converged_guess_untouched(self, comm8):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("symmlq")
+        ksp.set_tolerances(rtol=1e-6, max_it=500)
+        ksp.set_initial_guess_nonzero(True)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        x.set_global(x_true)          # exact solution as the initial guess
+        res = ksp.solve(bv, x)
+        assert res.converged and res.iterations == 0
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=0, atol=1e-12)
