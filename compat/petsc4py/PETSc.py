@@ -146,6 +146,26 @@ class Mat:
 
     createDense = None  # not part of the reference surface
 
+    def createShell(self, size, mult, mult_transpose=None, diagonal=None,
+                    comm=None):
+        """MatCreateShell analog: a matrix-free operator from a user
+        ``mult`` function on the full global vector (jax-traceable)."""
+        comm = _mpi_comm(comm)
+        self._comm = comm
+        if np.isscalar(size):
+            size = (int(size), int(size))
+
+        def build(_):
+            core = _tps.ShellMat(comm.device_comm, size, mult,
+                                 mult_transpose=mult_transpose,
+                                 diagonal=diagonal)
+            return core, _UnevenLayout(
+                RowLayout(size[0], comm.Get_size()).count)
+
+        self._core, self._layout = comm._collective("mat_createshell", None,
+                                                    build)
+        return self
+
     # ---- assembly (no-ops: assembly happened at construction) ---------------
     def setUp(self):
         return self
@@ -291,6 +311,18 @@ class PC:
     def getFactorSolverType(self):
         return self._core._factor_solver_type
 
+    def setShellApply(self, fn):
+        self._core.set_shell_apply(fn)
+
+    def setCompositeType(self, ctype):
+        self._core.set_composite_type(ctype)
+
+    def setCompositePCs(self, *types):
+        self._core.set_composite_pcs(*types)
+
+    def getCompositePC(self, i):
+        return PC(self._core.get_composite_pc(i))
+
     def setFromOptions(self):
         pass
 
@@ -323,7 +355,8 @@ class KSP:
         self._core.set_operators(A.core, P.core if P else None)
 
     def setTolerances(self, rtol=None, atol=None, divtol=None, max_it=None):
-        self._core.set_tolerances(rtol=rtol, atol=atol, max_it=max_it)
+        self._core.set_tolerances(rtol=rtol, atol=atol, divtol=divtol,
+                                  max_it=max_it)
 
     def setInitialGuessNonzero(self, flag):
         self._core.set_initial_guess_nonzero(flag)

@@ -38,6 +38,7 @@ from .parallel.partition import (
     partition_csr, concat_csr_blocks)
 from .core.vec import Vec
 from .core.mat import Mat
+from .core.shell import ShellMat
 from .core.nullspace import NullSpace
 from .solvers.pc import PC
 from .solvers.ksp import KSP
@@ -50,7 +51,7 @@ __all__ = [
     "DeviceComm", "get_default_comm", "set_default_comm", "as_comm",
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
-    "Vec", "Mat", "NullSpace", "PC", "KSP", "EPS", "ST",
+    "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST",
     "ConvergedReason", "SolveResult",
     "Options", "global_options", "init", "backend",
 ]

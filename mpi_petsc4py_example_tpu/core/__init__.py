@@ -1,2 +1,3 @@
 from .vec import Vec
 from .mat import Mat
+from .shell import ShellMat

@@ -1,0 +1,124 @@
+"""Matrix-free shell operators — the PETSc ``MatShell`` equivalent.
+
+PETSc lets drivers supply their own ``MatMult`` through ``MatCreateShell`` /
+``MATSHELL`` so Krylov solvers run on operators that are never assembled
+(reference capability surface: the KSP/EPS solvers at ``test.py:50`` /
+``test2.py:88`` only ever *apply* the operator — SURVEY.md N3/N6). Here a
+shell operator is a **jax-traceable function on the full input vector**: the
+framework all-gathers the sharded vector inside the compiled shard_map
+program, applies the user function on every device, and keeps the local row
+block — so a shell operator composes with every KSP/EPS type and
+preconditioner exactly like an assembled :class:`~.mat.Mat`.
+
+For operators with sharding-aware structure (e.g. stencils with neighbor
+halos) implement the full linear-operator protocol instead, as
+``models.stencil.StencilPoisson3D`` does — shell operators trade peak
+scalability for zero-boilerplate matrix-free usage.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import DeviceComm, as_comm, full_vector_local_apply
+from ..parallel.partition import RowLayout
+from .vec import Vec
+
+_uid = itertools.count(1)
+
+
+class ShellMat:
+    """Matrix-free operator defined by a user ``mult`` function.
+
+    Parameters
+    ----------
+    comm : DeviceComm
+    shape : int | (int, int)
+        Global operator shape (square — row and column partition coincide).
+    mult : callable
+        ``y = mult(x)`` on the full (unsharded) global vector; must be
+        jax-traceable (jnp ops, no Python control flow on values). It runs
+        replicated on every mesh device inside the compiled solver program.
+    mult_transpose : callable, optional
+        ``y = mult_transpose(x)`` — enables transpose-needing KSP types
+        (``lsqr``, ``bicg``, ``cgne``) and unsymmetric eigenproblems.
+    diagonal : callable | array, optional
+        The operator diagonal (for PC ``jacobi``): an array of length n or a
+        zero-argument callable returning one.
+    """
+
+    def __init__(self, comm, shape, mult, mult_transpose=None, diagonal=None,
+                 dtype=jnp.float64):
+        self.comm: DeviceComm = as_comm(comm)
+        if np.isscalar(shape):
+            shape = (int(shape), int(shape))
+        self.shape = (int(shape[0]), int(shape[1]))
+        if self.shape[0] != self.shape[1]:
+            raise ValueError(
+                f"ShellMat must be square (row/column partitions coincide); "
+                f"got {self.shape}")
+        self._mult = mult
+        self._mult_t = mult_transpose
+        self._diagonal = diagonal
+        self.dtype = jnp.dtype(dtype)
+        self.layout = RowLayout(self.shape[0], self.comm.size)
+        self._key = ("shellmat", next(_uid))
+        self._jit_mult = jax.jit(mult)   # host-level apply, compiled once
+
+    # ---- Mat-shaped conveniences -------------------------------------------
+    def get_vecs(self) -> tuple[Vec, Vec]:
+        mk = lambda: Vec(self.comm, self.shape[0], dtype=self.dtype,
+                         layout=self.layout)
+        return mk(), mk()
+
+    getVecs = get_vecs
+
+    def diagonal(self) -> np.ndarray:
+        if self._diagonal is None:
+            raise ValueError(
+                "this ShellMat provides no diagonal — pass diagonal= at "
+                "construction to use PC 'jacobi'")
+        d = self._diagonal() if callable(self._diagonal) else self._diagonal
+        return np.asarray(d)
+
+    def mult(self, x: Vec, y: Vec | None = None) -> Vec:
+        """Host-level apply (the solvers use :meth:`local_spmv` instead)."""
+        n = self.shape[0]
+        xh = jnp.asarray(x.to_numpy(), dtype=self.dtype)
+        yh = np.asarray(self._jit_mult(xh))
+        if y is None:
+            return Vec.from_global(self.comm, yh, dtype=self.dtype)
+        y.set_global(yh)
+        return y
+
+    # ---- linear-operator protocol (consumed by solvers.krylov/eps) ----------
+    def device_arrays(self):
+        return ()
+
+    def op_specs(self, axis):
+        return ()
+
+    def program_key(self):
+        return self._key
+
+    def _wrap(self, fn, comm: DeviceComm):
+        apply = full_vector_local_apply(fn, comm, self.shape[0])
+        return lambda op_local, x_local: apply(x_local)
+
+    def local_spmv(self, comm: DeviceComm):
+        return self._wrap(self._mult, comm)
+
+    def local_spmv_t(self, comm: DeviceComm):
+        if self._mult_t is None:
+            raise ValueError(
+                "this ShellMat provides no mult_transpose — required by "
+                "transpose-needing KSP types (lsqr/bicg/cgne)")
+        return self._wrap(self._mult_t, comm)
+
+    def __repr__(self):
+        return (f"ShellMat(shape={self.shape}, devices={self.comm.size}, "
+                f"dtype={self.dtype})")

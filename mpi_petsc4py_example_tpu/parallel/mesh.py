@@ -137,6 +137,28 @@ class DeviceComm:
                              out_specs=out_specs, check_vma=check_vma)
 
 
+def full_vector_local_apply(fn, comm: DeviceComm, n: int):
+    """Lift ``y = fn(x)`` on the full global vector to a shard-local apply.
+
+    Returns ``apply(x_local) -> y_local`` for use inside shard_map bodies:
+    all-gathers the sharded vector, applies ``fn`` replicated per device on
+    the unpadded length-``n`` view, and hands back this device's row block.
+    Shared by shell operators (core.shell.ShellMat) and PCSHELL.
+    """
+    axis = comm.axis
+    lsize = comm.local_size(n)
+    n_pad = lsize * comm.size
+
+    def apply(x_local):
+        x_full = lax.all_gather(x_local, axis, tiled=True)
+        y = fn(x_full[:n] if n_pad != n else x_full)
+        ypad = jnp.pad(y, (0, n_pad - n)) if n_pad != n else y
+        i = lax.axis_index(axis)
+        return lax.dynamic_slice_in_dim(ypad, i * lsize, lsize)
+
+    return apply
+
+
 _default_comm: DeviceComm | None = None
 
 

@@ -32,21 +32,34 @@ from ..utils.convergence import ConvergedReason as CR
 #                (x, iters, rnorm, reason)
 # ---------------------------------------------------------------------------
 
+def _dmax(rnorm0, dtol):
+    """Divergence ceiling: ``dtol * rnorm0`` — the INITIAL residual norm, as
+    in PETSc's KSPConvergedDefault DIVERGED_DTOL test (a merely-large initial
+    guess must not trigger instant divergence). ``dtol`` None/<=0 disables."""
+    if dtol is None:
+        return jnp.inf
+    return jnp.where(dtol > 0, dtol * rnorm0, jnp.inf)
+
+
 def _tol(pnorm, b, rtol, atol):
     bnorm = pnorm(b)
     return bnorm, jnp.maximum(rtol * bnorm, atol)
 
 
-def _reason(rnorm, tol, atol, k, maxit, brk):
+def _reason(rnorm, tol, atol, k, maxit, brk, dmax=None):
+    diverged = (CR.DIVERGED_MAX_IT if dmax is None else
+                jnp.where(rnorm >= dmax, CR.DIVERGED_DTOL,
+                          CR.DIVERGED_MAX_IT))
     return jnp.where(
         brk, CR.DIVERGED_BREAKDOWN,
         jnp.where(rnorm <= tol,
                   jnp.where(rnorm <= atol, CR.CONVERGED_ATOL,
                             CR.CONVERGED_RTOL),
-                  CR.DIVERGED_MAX_IT)).astype(jnp.int32)
+                  diverged)).astype(jnp.int32)
 
 
-def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+              dtol=None):
     """Preconditioned conjugate gradients (KSPCG equivalent)."""
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
@@ -54,10 +67,11 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     p = z
     rz = pdot(r, z)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
 
     def cond(st):
         k, x, r, z, p, rz, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, x, r, z, p, rz, rn, brk = st
@@ -78,21 +92,23 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
 
     st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, rnorm <= -1.0)
     k, x, r, z, p, rz, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
-def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                dtol=None):
     """Right-preconditioned BiCGStab (KSPBCGS equivalent)."""
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     rhat = r
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
     one = jnp.asarray(1.0, b.dtype)
     z = jnp.zeros_like(b)
 
     def cond(st):
         k, x, r, p, v, rho, alpha, omega, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, x, r, p, v, rho, alpha, omega, rn, brk = st
@@ -122,7 +138,7 @@ def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     st0 = (jnp.int32(0), x0, r, z, z, one, one, one, rnorm, rnorm <= -1.0)
     out = lax.while_loop(cond, body, st0)
     k, x, r, p, v, rho, alpha, omega, rnorm, brk = out
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def _hessenberg_lstsq(H, beta):
@@ -181,7 +197,7 @@ def _cgs2_step(V, w, pmatdot, pnorm):
 
 
 def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                 restart=30, pmatdot=None, monitor=None):
+                 restart=30, pmatdot=None, monitor=None, dtol=None):
     """Left-preconditioned restarted GMRES (KSPGMRES equivalent).
 
     Convergence is monitored in the preconditioned residual norm, matching
@@ -199,6 +215,7 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     tol = jnp.maximum(rtol * bnorm, atol)
     r0 = M(b - A(x0))
     rnorm0 = pnorm(r0)
+    dmax = _dmax(rnorm0, dtol)
 
     def cycle(st):
         k, x, rn = st
@@ -227,14 +244,15 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     def cond(st):
         k, x, rn = st
-        return (rn > tol) & (k < maxit)
+        return (rn > tol) & (rn < dmax) & (k < maxit)
 
     k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
     brk = rnorm <= -1.0
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
-def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                   dtol=None):
     """Apply the preconditioner exactly once (KSPPREONLY equivalent).
 
     With PC 'lu' this is the reference's direct-solve path
@@ -255,15 +273,16 @@ def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
 
 
 def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                      scale=1.0, monitor=None):
+                      scale=1.0, monitor=None, dtol=None):
     """Preconditioned Richardson iteration (KSPRICHARDSON equivalent)."""
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
 
     def cond(st):
         k, x, r, rn = st
-        return (rn > tol) & (k < maxit)
+        return (rn > tol) & (rn < dmax) & (k < maxit)
 
     def body(st):
         k, x, r, rn = st
@@ -276,10 +295,12 @@ def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     k, x, r, rnorm = lax.while_loop(cond, body,
                                     (jnp.int32(0), x0, r, rnorm))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
+                                dmax)
 
 
-def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                  dtol=None):
     """MINRES for symmetric (possibly indefinite) systems (KSPMINRES).
 
     Paige & Saunders recurrences with left preconditioning (M must be SPD,
@@ -290,11 +311,13 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     r1 = b - A(x0)
     y = M(r1)
     beta1 = jnp.sqrt(jnp.maximum(pdot(r1, y), 0.0))
+    dmax = _dmax(pnorm(r1), dtol)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
     def cond(st):
-        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -345,11 +368,11 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     # exact final residual (the phibar estimate tracks the M-norm)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
-            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"]))
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
 def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                     monitor=None):
+                     monitor=None, dtol=None):
     """Chebyshev iteration (KSPCHEBYSHEV) — the cheapest distributed smoother.
 
     Saad's three-term form on the preconditioned operator. Eigenvalue bounds
@@ -379,12 +402,13 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     z = M(r)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
     rho = 1.0 / sigma
     d = z / theta
 
     def cond(st):
         k, x, r, d, rho, rn = st
-        return (rn > tol) & (k < maxit)
+        return (rn > tol) & (rn < dmax) & (k < maxit)
 
     def body(st):
         k, x, r, d, rho, rn = st
@@ -400,11 +424,12 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     st0 = (jnp.int32(0), x0, r, d, rho, rnorm)
     k, x, r, d, rho, rnorm = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
+                                dmax)
 
 
 def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                  preduce=None, monitor=None):
+                  preduce=None, monitor=None, dtol=None):
     """Single-reduction CG (Chronopoulos–Gear recurrences; KSPPIPECG slot).
 
     Standard CG needs three separate reductions per iteration ((p,Ap),
@@ -418,6 +443,7 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     u = M(r)
     w = A(u)
+    dmax = _dmax(pnorm(r), dtol)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
@@ -425,7 +451,8 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         return preduce(jnp.vdot(r, u), jnp.vdot(w, u), jnp.vdot(r, r))
 
     def cond(st):
-        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -455,11 +482,11 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     st = lax.while_loop(cond, body, st0)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
 def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                  restart=30, pmatdot=None, monitor=None):
+                  restart=30, pmatdot=None, monitor=None, dtol=None):
     """Flexible (right-preconditioned) restarted GMRES (KSPFGMRES).
 
     Stores the preconditioned basis ``Z[j] = M(V[j])`` explicitly, so M may
@@ -473,6 +500,7 @@ def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     bnorm = pnorm(b)
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(b - A(x0))
+    dmax = _dmax(rnorm0, dtol)
 
     def cycle(st):
         k, x, rn = st
@@ -504,13 +532,15 @@ def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     def cond(st):
         k, x, rn = st
-        return (rn > tol) & (k < maxit)
+        return (rn > tol) & (rn < dmax) & (k < maxit)
 
     k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
+                                dmax)
 
 
-def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+               dtol=None):
     """Conjugate Gradient Squared (KSPCGS), right-preconditioned.
 
     Solves ``(A·M) y = r0`` for the correction and applies ``x = x0 + M(y)``
@@ -522,11 +552,13 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     r = b - A(x0)
     rtilde = r
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
     def cond(st):
-        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -558,10 +590,11 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     # drift above it in reduced precision (CGS squares the residual poly).
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
-def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                 dtol=None):
     """Transpose-Free QMR (Freund 1993; KSPTFQMR), right-preconditioned.
 
     Runs on the correction system ``(A·M) y = r0``; the loop monitors the
@@ -574,6 +607,7 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     r0 = b - A(x0)
     rstar = r0
     tau0 = pnorm(r0)
+    dmax = _dmax(tau0, dtol)
     zero = jnp.zeros_like(b)
     dt = b.dtype
     u1_0 = op(r0)
@@ -592,7 +626,8 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
         return dict(st, w=w, d=d, theta=theta, tau=tau, eta=eta, y=y)
 
     def cond(st):
-        return (st["dp"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["dp"] > tol) & (st["dp"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -624,10 +659,11 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     x = x0 + M(st["y"])
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["dp"], tol, atol, st["k"], maxit, st["brk"]))
+            _reason(st["dp"], tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
-def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+              dtol=None):
     """Preconditioned Conjugate Residuals (KSPCR) for symmetric systems.
 
     Minimizes the preconditioned residual M(b - Ax) in the A-norm sense;
@@ -643,10 +679,11 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     q = w           # A p
     rho = pdot(r, w)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
 
     def cond(st):
         k, x, r, p, w, q, rho, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, x, r, p, w, q, rho, rn, brk = st
@@ -668,11 +705,11 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
 
     st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, rnorm <= -1.0)
     k, x, r, p, w, q, rho, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                At=None, monitor=None):
+                At=None, monitor=None, dtol=None):
     """LSQR (Paige & Saunders 1982; KSPLSQR) via Golub-Kahan bidiagonalization.
 
     Solves ``min ||b - Ax||`` — usable on unsymmetric and inconsistent
@@ -690,9 +727,11 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     u, beta = normalize(b - A(x0))
     v, alfa = normalize(At(u))
     w = v
+    dmax = _dmax(beta, dtol)
 
     def cond(st):
-        return (st["phibar"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["phibar"] > tol) & (st["phibar"] < dmax)
+                & (st["k"] < maxit) & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -719,11 +758,12 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     st = lax.while_loop(cond, body, st0)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
-            _reason(st["phibar"], tol, atol, st["k"], maxit, st["brk"]))
+            _reason(st["phibar"], tol, atol, st["k"], maxit, st["brk"],
+                    dmax))
 
 
 def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-                At=None):
+                At=None, dtol=None):
     """Biconjugate gradients (KSPBICG): dual recurrences on A and A^T.
 
     The shadow system uses ``M`` for the transpose preconditioner apply —
@@ -739,10 +779,11 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     pt = zt
     rho = pdot(rt, z)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
 
     def cond(st):
         k, x, r, rt, p, pt, rho, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, x, r, rt, p, pt, rho, rn, brk = st
@@ -768,11 +809,11 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     st0 = (jnp.int32(0), x0, r, rt, p, pt, rho, rnorm, rnorm <= -1.0)
     k, x, r, rt, p, pt, rho, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-               restart=30, pmatdot=None):
+               restart=30, pmatdot=None, dtol=None):
     """Restarted GCR (KSPGCR): flexible — the preconditioner may change
     between iterations (like fgmres), with explicitly stored (v, z) pairs.
 
@@ -784,12 +825,13 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
     V = jnp.zeros((m,) + b.shape, b.dtype)
     Z = jnp.zeros_like(V)
 
     def cond(st):
         k, slot, x, r, V, Z, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, slot, x, r, V, Z, rn, brk = st
@@ -818,11 +860,11 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     st0 = (jnp.int32(0), jnp.int32(0), x0, r, V, Z, rnorm, rnorm <= -1.0)
     k, slot, x, r, V, Z, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-                At=None):
+                At=None, dtol=None):
     """CG on the normal equations A^T A x = A^T b (KSPCGNE).
 
     Squares the condition number but handles unsymmetric/rank-deficient
@@ -837,10 +879,11 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     p = z
     gamma = pdot(s, z)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
 
     def cond(st):
         k, x, r, p, gamma, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, x, r, p, gamma, rn, brk = st
@@ -863,10 +906,11 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     st0 = (jnp.int32(0), x0, r, p, gamma, rnorm, rnorm <= -1.0)
     k, x, r, p, gamma, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
-def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
+def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                  dtol=None):
     """SYMMLQ (Paige & Saunders 1975; KSPSYMMLQ) for symmetric systems.
 
     The LQ companion of MINRES: iterates in the Krylov space with an LQ
@@ -880,6 +924,7 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     dt = b.dtype
     r0 = b - A(x0)
     rnorm0 = pnorm(r0)
+    dmax = _dmax(rnorm0, dtol)
 
     y = M(r0)
     beta1sq = pdot(r0, y)
@@ -898,7 +943,8 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     scale = rnorm0 / safe_b1
 
     def cond(st):
-        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k = st["k"]
@@ -960,15 +1006,15 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None):
     zbar = st["rhs1"] / gbar_safe
     bstep = st["snprod"] * zbar + st["bstep"]
     xc = st["x"] + zbar * st["w"]
-    xc = xc + (bstep / safe_b1) * M(r0)
+    xc = xc + (bstep / safe_b1) * y      # y = M(r0) from initialization
     x = x0 + jnp.where(st["k"] > 0, xc, jnp.zeros_like(b))
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"]))
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
 def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-               restart=30, pmatdot=None, monitor=None):
+               restart=30, pmatdot=None, monitor=None, dtol=None):
     """Truncated flexible CG (Notay; KSPFCG).
 
     The preconditioner may change between iterations; new directions are
@@ -980,13 +1026,14 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
     Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
     APbuf = jnp.zeros_like(Pbuf)
     eta = jnp.zeros(m, b.dtype)
 
     def cond(st):
         k, slot, x, r, Pb, APb, eta, rn, brk = st
-        return (rn > tol) & (k < maxit) & ~brk
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
         k, slot, x, r, Pb, APb, eta, rn, brk = st
@@ -1013,11 +1060,11 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
            rnorm, rnorm <= -1.0)
     k, slot, x, r, Pbuf, APbuf, eta, rnorm, brk = \
         lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                  restart=30, aug=2, pmatdot=None, monitor=None):
+                  restart=30, aug=2, pmatdot=None, monitor=None, dtol=None):
     """LGMRES (Baker, Jessup & Manteuffel 2005; KSPLGMRES).
 
     Restarted GMRES whose search space is augmented with the ``aug`` most
@@ -1029,7 +1076,8 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     """
     if aug <= 0:      # PETSc semantics: zero augmentation = plain GMRES(m)
         return gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                            restart=restart, pmatdot=pmatdot, monitor=monitor)
+                            restart=restart, pmatdot=pmatdot, monitor=monitor,
+                            dtol=dtol)
     m = restart
     s = m + aug
     lsize = b.shape[0]
@@ -1037,6 +1085,7 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     bnorm = pnorm(pb)
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(M(b - A(x0)))
+    dmax = _dmax(rnorm0, dtol)
     Z0 = jnp.zeros((aug, lsize), b.dtype)
 
     def cycle(st):
@@ -1076,15 +1125,16 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     def cond(st):
         k, x, Z, rn = st
-        return (rn > tol) & (k < maxit)
+        return (rn > tol) & (rn < dmax) & (k < maxit)
 
     k, x, Z, rnorm = lax.while_loop(
         cond, cycle, (jnp.int32(0), x0, Z0, rnorm0))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0)
+    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
+                                dmax)
 
 
 def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                 ell=2, monitor=None):
+                 ell=2, monitor=None, dtol=None):
     """BiCGStab(ℓ) (Sleijpen & Fokkema 1993; KSPBCGSL), right-preconditioned.
 
     Combines ℓ BiCG steps with an ℓ-th-degree minimum-residual polynomial
@@ -1095,11 +1145,14 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     once at the end, so the in-loop residual is the true residual.
     """
     L = int(ell)
+    if L < 1:
+        raise ValueError(f"-ksp_bcgsl_ell must be >= 1, got {L}")
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     op = lambda v: A(M(v))
     r0 = b - A(x0)
     rtilde = r0
     rnorm = pnorm(r0)
+    dmax = _dmax(rnorm, dtol)
     dt = b.dtype
     Rb = jnp.zeros((L + 1,) + b.shape, dt).at[0].set(r0)
     Ub = jnp.zeros_like(Rb)
@@ -1108,7 +1161,8 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         return jnp.where(x == 0, jnp.asarray(1.0, dt), x)
 
     def cond(st):
-        return (st["rn"] > tol) & (st["k"] < maxit) & ~st["brk"]
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
+                & ~st["brk"])
 
     def body(st):
         k, y, R, U = st["k"], st["y"], st["R"], st["U"]
@@ -1163,7 +1217,11 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
             U = U.at[0].set(U[0] - gamma[j] * U[j])
             y = y + gamma_pp[j] * R[j]
             R = R.at[0].set(R[0] - gamma_p[j] * R[j])
-        rn = pnorm(R[0])
+        # freeze the iterate on breakdown (brk was False at loop entry; the
+        # safe()-substituted updates after the flag are garbage) — siblings
+        # do the same via alpha = where(brk, 0, ...)
+        y = jnp.where(brk, st["y"], y)
+        rn = jnp.where(brk, st["rn"], pnorm(R[0]))
         if monitor is not None:
             monitor(k + L, rn)
         return dict(k=k + L, y=y, R=R, U=U, rho0=rho0, alpha=alpha,
@@ -1176,7 +1234,7 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     x = x0 + M(st["y"])
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"]))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
 
 
 KSP_KERNELS = {
@@ -1243,12 +1301,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     Signature of the returned callable::
 
         x, iters, rnorm, reason = prog(op_arrays, pc_arrays, b, x0,
-                                       rtol, atol, maxit)
+                                       rtol, atol, dtol, maxit)
 
     With ``nullspace_dim > 0`` an extra leading argument carries the
     row-sharded (k, n_pad) orthonormal null-space basis::
 
-        x, ... = prog(op_arrays, pc_arrays, ns_basis, b, x0, rtol, atol, maxit)
+        x, ... = prog(op_arrays, pc_arrays, ns_basis, b, x0, rtol, atol,
+                      dtol, maxit)
 
     and the program removes the null-space component from the RHS, the
     initial guess, and every operator/preconditioner output (PETSc's
@@ -1265,9 +1324,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    # normalize knobs a solver type doesn't consume, so changing e.g.
+    # bcgsl_ell never recompiles an unrelated CG program
+    restart_k = restart if ksp_type in ("gmres", "fgmres", "gcr", "fcg",
+                                        "lgmres") else 0
+    aug_k = aug if ksp_type == "lgmres" else 0
+    ell_k = ell if ksp_type == "bcgsl" else 0
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
-           restart, monitored, zero_guess, operator.program_key(),
-           nullspace_dim, aug, ell)
+           restart_k, monitored, zero_guess, operator.program_key(),
+           nullspace_dim, aug_k, ell_k)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1299,7 +1364,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                                k, rn)
 
     def make_body(project):
-        def body(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+        def body(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
             if zero_guess:
                 x0 = jnp.zeros_like(b)
             b, x0 = project(b), project(x0)
@@ -1308,6 +1373,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
             pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
             kw = {"monitor": monitor} if monitor is not None else {}
+            kw["dtol"] = dtol
             if ksp_type in ("gmres", "fgmres", "gcr", "fcg", "lgmres"):
                 kw["restart"] = restart
                 kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
@@ -1329,21 +1395,22 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         return body
 
     if nullspace_dim:
-        def local_fn(op_arrays, pc_arrays, ns_q, b, x0, rtol, atol, maxit):
+        def local_fn(op_arrays, pc_arrays, ns_q, b, x0, rtol, atol, dtol,
+                     maxit):
             def project(v):
                 return v - lax.psum(ns_q @ v, axis) @ ns_q
             return make_body(project)(op_arrays, pc_arrays, b, x0,
-                                      rtol, atol, maxit)
+                                      rtol, atol, dtol, maxit)
 
         in_specs = (op_specs, pc.in_specs(axis), P(None, axis),
-                    P(axis), P(axis), P(), P(), P())
+                    P(axis), P(axis), P(), P(), P(), P())
     else:
-        def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, maxit):
+        def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
             return make_body(lambda v: v)(op_arrays, pc_arrays, b, x0,
-                                          rtol, atol, maxit)
+                                          rtol, atol, dtol, maxit)
 
         in_specs = (op_specs, pc.in_specs(axis),
-                    P(axis), P(axis), P(), P(), P())
+                    P(axis), P(axis), P(), P(), P(), P())
     out_specs = (P(axis), P(), P(), P())
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
     _PROGRAM_CACHE[key] = prog

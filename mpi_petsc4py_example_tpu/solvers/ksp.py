@@ -34,6 +34,7 @@ from .pc import PC
 
 DEFAULT_RTOL = 1e-5   # PETSc's KSP default
 DEFAULT_ATOL = 1e-50
+DEFAULT_DIVTOL = 1e5  # PETSc's KSP dtol default (DIVERGED_DTOL trigger)
 DEFAULT_MAX_IT = 10000
 
 
@@ -47,6 +48,7 @@ class KSP:
         self._mat: Mat | None = None
         self.rtol = DEFAULT_RTOL
         self.atol = DEFAULT_ATOL
+        self.divtol = DEFAULT_DIVTOL
         self.max_it = DEFAULT_MAX_IT
         self.restart = 30
         self.lgmres_augment = 2       # -ksp_lgmres_augment (KSPLGMRES aug_k)
@@ -109,6 +111,8 @@ class KSP:
             self.rtol = float(rtol)
         if atol is not None:
             self.atol = float(atol)
+        if divtol is not None:
+            self.divtol = float(divtol)
         if max_it is not None:
             self.max_it = int(max_it)
         return self
@@ -143,6 +147,7 @@ class KSP:
             self.set_type(t)
         self.rtol = opt.get_real(p + "ksp_rtol", self.rtol)
         self.atol = opt.get_real(p + "ksp_atol", self.atol)
+        self.divtol = opt.get_real(p + "ksp_divtol", self.divtol)
         self.max_it = opt.get_int(p + "ksp_max_it", self.max_it)
         self.restart = opt.get_int(p + "ksp_gmres_restart", self.restart)
         self.lgmres_augment = opt.get_int(p + "ksp_lgmres_augment",
@@ -165,6 +170,15 @@ class KSP:
                                           pc.gamg_coarse_size)
         pc.gamg_max_levels = opt.get_int(p + "pc_mg_levels",
                                          pc.gamg_max_levels)
+        pc.bjacobi_blocks = opt.get_int(p + "pc_bjacobi_blocks",
+                                        pc.bjacobi_blocks)
+        ct = opt.get_string(p + "pc_composite_type")
+        if ct:
+            pc.set_composite_type(ct)
+        cp = opt.get_string(p + "pc_composite_pcs")
+        if cp:
+            pc.set_composite_pcs(*[s.strip() for s in cp.split(",")
+                                   if s.strip()])
         return self
 
     setFromOptions = set_from_options
@@ -222,7 +236,7 @@ class KSP:
                 mat.device_arrays(), pc.device_arrays(), *ns_args,
                 b.data, x.data,
                 dt.type(self.rtol), dt.type(self.atol),
-                np.int32(self.max_it))
+                dt.type(self.divtol), np.int32(self.max_it))
             # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
             # int()/float() per scalar would pay it three times)
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))

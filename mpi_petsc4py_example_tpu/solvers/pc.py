@@ -34,6 +34,8 @@ fuses into the same XLA program as the Krylov iteration.
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,7 +47,14 @@ from ..parallel.mesh import DeviceComm
 from jax.sharding import PartitionSpec as P
 
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
-            "sor", "ssor", "ilu", "icc", "asm", "gamg", "amg")
+            "sor", "ssor", "ilu", "icc", "asm", "gamg", "amg",
+            "shell", "composite")
+
+_COMPOSITE_TYPES = ("additive", "multiplicative")
+
+# global shell-apply counter: program caches key on it, so two PC instances
+# with different shell functions never collide (same scheme as ShellMat)
+_shell_uid = itertools.count(1)
 
 
 class PC:
@@ -64,7 +73,16 @@ class PC:
         self.gamg_threshold = 0.0   # -pc_gamg_threshold (PCGAMG default 0)
         self.gamg_coarse_size = 64  # -pc_gamg_coarse_eq_limit analog
         self.gamg_max_levels = 10   # -pc_mg_levels analog
+        self.bjacobi_blocks = 0     # -pc_bjacobi_blocks (0 = one per device,
+                                    # auto-split past the dense cap)
         self._amg = None
+        # PCSHELL: user apply (full-vector jax-traceable callable) + a uid so
+        # compiled-program caches distinguish different shell functions
+        self._shell_apply = None
+        self._shell_uid = 0
+        # PCCOMPOSITE: child PCs + combination type
+        self.composite_type = "additive"   # PETSc's PC_COMPOSITE_ADDITIVE
+        self._sub_pcs: list[PC] = []
 
     # ---- petsc4py-shaped configuration -------------------------------------
     def set_type(self, pc_type: str):
@@ -94,11 +112,75 @@ class PC:
 
     setFactorSolverType = set_factor_solver_type
 
+    # ---- PCSHELL (user-defined preconditioner) ------------------------------
+    def set_shell_apply(self, fn):
+        """PCShellSetApply analog: ``z = fn(r)`` on the full global residual.
+
+        ``fn`` must be jax-traceable (jnp ops only) — it is inlined into the
+        compiled shard_map solver program, running replicated per device.
+        """
+        self._shell_apply = fn
+        self._shell_uid = next(_shell_uid)
+        self._built_for = None
+        return self
+
+    setShellApply = set_shell_apply
+
+    # ---- PCCOMPOSITE (combination of preconditioners) -----------------------
+    def set_composite_type(self, ctype: str):
+        """'additive' (z = Σ Mᵢr) or 'multiplicative' (Gauss-Seidel-style
+        sweeps with residual updates between children — needs the operator)."""
+        ctype = str(ctype).lower()
+        if ctype not in _COMPOSITE_TYPES:
+            raise ValueError(f"unknown composite type {ctype!r}; "
+                             f"available: {_COMPOSITE_TYPES}")
+        if ctype != self.composite_type:
+            self.composite_type = ctype
+            self._built_for = None
+        return self
+
+    setCompositeType = set_composite_type
+
+    def set_composite_pcs(self, *types):
+        """Create the child PCs from type names (PCCompositeAddPCType)."""
+        if len(types) == 1 and isinstance(types[0], (list, tuple)):
+            types = tuple(types[0])
+        self._sub_pcs = []
+        for t in types:
+            self.add_composite_pc(t)
+        return self
+
+    setCompositePCs = set_composite_pcs
+
+    def add_composite_pc(self, pc_type: str):
+        child = PC(self.comm)
+        child.set_type(pc_type)
+        self._sub_pcs.append(child)
+        self._built_for = None
+        return child
+
+    addCompositePC = add_composite_pc
+
+    def get_composite_pc(self, i: int) -> "PC":
+        """Child PC ``i`` — tune its options before ``set_up``."""
+        return self._sub_pcs[i]
+
+    getCompositePC = get_composite_pc
+
     def set_operators(self, mat: Mat):
         if mat is not self._mat:
             self._mat = mat
             self._built_for = None
         return self
+
+    def _tunables_key(self):
+        """Every tunable baked into the built arrays, recursively through
+        composite children — the rebuild-detection part of the setup key."""
+        return (self._type, self.sor_omega, self.asm_overlap,
+                self.factor_fill, self.gamg_threshold,
+                self.gamg_coarse_size, self.gamg_max_levels,
+                self.bjacobi_blocks, self._shell_uid, self.composite_type,
+                tuple(c._tunables_key() for c in self._sub_pcs))
 
     # ---- setup: build sharded device-side data ------------------------------
     def set_up(self, mat: Mat | None = None):
@@ -110,10 +192,7 @@ class PC:
         # tunables are baked into the built arrays — they are part of the
         # key, as is the matrix's mutation counter (axpy/shift/zero_rows
         # rebuild the operator in place without changing its identity)
-        build_key = (mat, getattr(mat, "_state", 0), self._type,
-                     self.sor_omega, self.asm_overlap,
-                     self.factor_fill, self.gamg_threshold,
-                     self.gamg_coarse_size, self.gamg_max_levels)
+        build_key = (mat, getattr(mat, "_state", 0), self._tunables_key())
         if self._built_for == build_key:
             return self
         comm = mat.comm
@@ -125,7 +204,7 @@ class PC:
             inv = np.where(diag != 0, 1.0 / np.where(diag == 0, 1.0, diag), 0.0)
             self._arrays = (comm.put_rows(inv.astype(mat.dtype)),)
         elif t == "bjacobi":
-            self._arrays = _build_bjacobi(comm, mat)
+            self._arrays = _build_bjacobi(comm, mat, self.bjacobi_blocks)
         elif t in ("sor", "ssor"):
             self._arrays = _build_block_ssor(comm, mat, self.sor_omega)
         elif t in ("ilu", "icc"):
@@ -153,6 +232,27 @@ class PC:
                     "PC 'mg' is the geometric multigrid V-cycle for "
                     "structured stencil operators (models.StencilPoisson3D)")
             self._arrays = ()
+        elif t == "shell":
+            if self._shell_apply is None:
+                raise RuntimeError(
+                    "PC 'shell' has no apply function — call "
+                    "set_shell_apply(fn) first")
+            self._arrays = ()
+        elif t == "composite":
+            if not self._sub_pcs:
+                raise RuntimeError(
+                    "PC 'composite' has no children — call "
+                    "set_composite_pcs('jacobi', 'sor', ...) first")
+            arrays = []
+            for child in self._sub_pcs:
+                child.set_up(mat)
+                arrays.extend(child.device_arrays())
+            if self.composite_type == "multiplicative":
+                # the residual updates between children need A; ship the
+                # operator's (already-device-resident) arrays along — same
+                # buffers, no copy
+                arrays.extend(mat.device_arrays())
+            self._arrays = tuple(arrays)
         self._built_for = build_key
         return self
 
@@ -177,11 +277,22 @@ class PC:
 
     def program_key(self):
         """Part of the compiled-solver cache key: everything baked into the
-        local_apply closure beyond ``kind`` (currently the ASM overlap)."""
+        local_apply closure beyond ``kind`` (ASM overlap, shell fn identity,
+        composite structure)."""
         if self.kind == "asm":
             return (self.kind, int(self.asm_overlap))
         if self.kind == "gamg":
             return self._amg.program_key()
+        if self.kind == "shell":
+            return ("shell", self._shell_uid)
+        if self.kind == "composite":
+            # multiplicative bakes the preconditioning matrix's spmv closure
+            # (static DIA offsets, array count) into the apply — key on it
+            mat_key = (self._mat.program_key()
+                       if (self.composite_type == "multiplicative"
+                           and self._mat is not None) else ())
+            return (("composite", self.composite_type, mat_key)
+                    + tuple(c.program_key() for c in self._sub_pcs))
         return (self.kind,)
 
     def in_specs(self, axis: str) -> tuple:
@@ -199,6 +310,15 @@ class PC:
             return (P(),)
         if k == "gamg":
             return self._amg.in_specs()
+        if k == "shell":
+            return ()
+        if k == "composite":
+            specs = []
+            for child in self._sub_pcs:
+                specs.extend(child.in_specs(axis))
+            if self.composite_type == "multiplicative":
+                specs.extend(self._mat.op_specs(axis))
+            return tuple(specs)
         raise AssertionError(k)
 
     def local_apply(self, comm: DeviceComm, n: int):
@@ -217,8 +337,11 @@ class PC:
             return lambda arrs, r: arrs[0] * r
         if k == "bjacobi":
             def apply(arrs, r):
-                binv = arrs[0]  # this device's (1, lsize, lsize) block inverse
-                return binv[0] @ r
+                binv = arrs[0]  # this device's (nb, bs, bs) block inverses
+                nb, bs = binv.shape[0], binv.shape[1]
+                # nb > 1 (-pc_bjacobi_blocks): one batched MXU matmul
+                return jnp.einsum("bij,bj->bi", binv,
+                                  r.reshape(nb, bs)).reshape(-1)
             return apply
         if k == "asm":
             ov = int(self.asm_overlap)
@@ -253,6 +376,40 @@ class PC:
             return apply
         if k == "gamg":
             return self._amg.local_apply(comm)
+        if k == "shell":
+            from ..parallel.mesh import full_vector_local_apply
+            shell = full_vector_local_apply(self._shell_apply, comm, n)
+            return lambda arrs, r: shell(r)
+        if k == "composite":
+            subs = [(c.local_apply(comm, n), len(c.device_arrays()))
+                    for c in self._sub_pcs]
+            if self.composite_type == "additive":
+                def apply(arrs, r):
+                    z = jnp.zeros_like(r)
+                    i = 0
+                    for ap, na in subs:
+                        z = z + ap(arrs[i:i + na], r)
+                        i += na
+                    return z
+                return apply
+            # multiplicative: z ← z + Mᵢ (r - A z) sweeps; the operator's
+            # arrays ride at the tail of the PC array tuple (see set_up)
+            spmv = self._mat.local_spmv(comm)
+            nmat = len(self._mat.device_arrays())
+
+            def apply(arrs, r):
+                mat_arrs = arrs[len(arrs) - nmat:] if nmat else ()
+                z = None
+                i = 0
+                for ap, na in subs:
+                    sub = arrs[i:i + na]
+                    i += na
+                    if z is None:
+                        z = ap(sub, r)
+                    else:
+                        z = z + ap(sub, r - spmv(mat_arrs, z))
+                return z
+            return apply
         if k == "mg":
             from .mg import make_vcycle
             op = self._mat
@@ -273,6 +430,7 @@ class PC:
 
 
 _DENSE_CAP = 16384  # host O(n^3) factorization bound for direct paths
+_AUTO_BLOCK_TARGET = 2048  # bjacobi auto-split block size (memory-frugal)
 
 
 def _per_device_inverse(A, n, lsize, ndev, block_inv):
@@ -291,17 +449,70 @@ def _per_device_inverse(A, n, lsize, ndev, block_inv):
     return inv
 
 
-def _build_bjacobi(comm: DeviceComm, mat: Mat):
-    """Per-device inverse of the local (uniform-padded) diagonal block.
+def _bjacobi_block_count(lsize: int, ndev: int, blocks: int) -> int:
+    """Blocks per device for PCBJACOBI.
+
+    ``blocks`` is the PETSc-style *total* block count (``-pc_bjacobi_blocks``;
+    0 = default). PETSc defaults to one block per process; here the default
+    additionally auto-splits when the per-device block would exceed the dense
+    factorization cap (the TPU analog has no sparse local LU to fall back on,
+    SURVEY.md §7.4). Blocks must tile the local rows evenly (uniform padded
+    layout), so the count snaps to a divisor of ``lsize``.
+    """
+    if blocks:
+        if blocks % ndev:
+            raise ValueError(
+                f"-pc_bjacobi_blocks {blocks} must be a multiple of the "
+                f"device count {ndev}")
+        nb = blocks // ndev
+        if lsize % nb:
+            raise ValueError(
+                f"-pc_bjacobi_blocks: {nb} blocks/device must divide the "
+                f"local row count {lsize}")
+        return nb
+    if lsize <= _DENSE_CAP:
+        return 1
+    # auto-split: target much smaller blocks than the hard cap — the blocks
+    # densify (O(bs²) memory each, O(bs³) host factorization), so past the
+    # cap we want many MXU-friendly blocks, not a few enormous ones
+    nb = -(-lsize // _AUTO_BLOCK_TARGET)
+    while lsize % nb:
+        nb += 1
+    return nb
+
+
+def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
+    """Per-device inverses of the local diagonal block(s).
 
     Factorized on host in fp64 (LAPACK), shipped as explicit inverses so the
-    device-side apply is one dense matvec on the MXU.
+    device-side apply is one batched dense matvec on the MXU. With
+    ``-pc_bjacobi_blocks`` (or past the dense cap) each device holds several
+    smaller blocks instead of one ``lsize`` × ``lsize`` one.
     """
-    A, n, lsize = _local_dense_blocks(comm, mat, "bjacobi")
+    _require_assembled(mat, "bjacobi")
+    n = mat.shape[0]
+    lsize = comm.local_size(n)
+    nb = _bjacobi_block_count(lsize, comm.size, int(blocks))
+    if lsize // nb > _DENSE_CAP:
+        raise ValueError(
+            f"PC 'bjacobi' blocks are dense ({lsize // nb}x{lsize // nb}); "
+            "too large — raise -pc_bjacobi_blocks, use more devices, or pc "
+            "'jacobi'/'gamg' (SURVEY.md §7.4)")
+    A = mat.to_scipy().tocsr()
+    bs = lsize // nb
     inv = _per_device_inverse(
-        A, n, lsize, comm.size,
+        A, n, bs, comm.size * nb,
         lambda B: scipy.linalg.inv(B.toarray().astype(np.float64)))
     return _ship_blocks(comm, inv, mat.dtype)
+
+
+def _require_assembled(mat, pc_name: str):
+    """Block/direct PCs factorize host CSR — matrix-free operators can't."""
+    if not hasattr(mat, "to_scipy"):
+        raise ValueError(
+            f"PC {pc_name!r} factorizes the assembled matrix; matrix-free "
+            f"operators ({type(mat).__name__}) work with pc 'none'/'jacobi'/"
+            "'shell'/'mg' instead")
 
 
 def _local_dense_blocks(comm: DeviceComm, mat: Mat, pc_name: str):
@@ -310,6 +521,7 @@ def _local_dense_blocks(comm: DeviceComm, mat: Mat, pc_name: str):
     Shared setup for every block preconditioner; enforces the dense-block
     size cap (SURVEY.md §7.4 — local factorizations densify).
     """
+    _require_assembled(mat, pc_name)
     n = mat.shape[0]
     lsize = comm.local_size(n)
     if lsize > _DENSE_CAP:
@@ -410,6 +622,7 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     LAPACK in fp64; the device applies the (padded) inverse as one matmul.
     Accuracy is recovered by iterative refinement in KSPPREONLY.
     """
+    _require_assembled(mat, "lu")
     n = mat.shape[0]
     if n > _DENSE_CAP:
         raise ValueError(

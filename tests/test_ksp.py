@@ -658,3 +658,62 @@ class TestSymmlqFcgLgmresBcgsl:
         res = ksp.solve(bv, x)
         assert res.converged and res.iterations == 0
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=0, atol=1e-12)
+
+
+class TestDivtol:
+    """KSPSetTolerances dtol — divergence detection (KSP_DIVERGED_DTOL)."""
+
+    def test_richardson_divergence_detected(self, comm8):
+        # unpreconditioned Richardson on diag(5): error amplified 4x/iter
+        A = sp.diags(np.full(40, 5.0)).tocsr()
+        b = np.ones(40)
+        x, res, _ = solve(comm8, A, b, "richardson", "none", rtol=1e-10,
+                          max_it=300)
+        assert res.reason == tps.ConvergedReason.DIVERGED_DTOL
+        assert res.iterations < 300      # stopped early, not at max_it
+
+    def test_divtol_disabled_runs_to_maxit(self, comm8):
+        A = sp.diags(np.full(40, 5.0)).tocsr()
+        b = np.ones(40)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("richardson")
+        ksp.get_pc().set_type("none")
+        ksp.set_tolerances(rtol=1e-10, divtol=0.0, max_it=25)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.reason == tps.ConvergedReason.DIVERGED_MAX_IT
+        assert res.iterations == 25
+
+    def test_converging_solve_unaffected(self, comm8):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "cg", "jacobi", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, rtol=1e-7, atol=1e-9)
+
+    def test_divtol_from_options(self, comm8):
+        tps.global_options().parse_argv(["prog", "-ksp_divtol", "1e3"])
+        ksp = tps.KSP().create(comm8)
+        ksp.set_from_options()
+        assert ksp.divtol == 1e3
+
+    def test_large_initial_guess_not_false_divergence(self, comm8):
+        """dtol baselines on the INITIAL residual (PETSc), so a far-off
+        nonzero guess on a trivial system must converge, not DIVERGED_DTOL."""
+        A = sp.eye(16, format="csr")
+        b = 1e-3 * np.ones(16)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-10)
+        ksp.set_initial_guess_nonzero(True)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        x.set_global(1e6 * np.ones(16))
+        res = ksp.solve(bv, x)
+        assert res.converged, res
+        np.testing.assert_allclose(x.to_numpy(), b, rtol=1e-6)
