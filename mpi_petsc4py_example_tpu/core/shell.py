@@ -87,7 +87,6 @@ class ShellMat:
 
     def mult(self, x: Vec, y: Vec | None = None) -> Vec:
         """Host-level apply (the solvers use :meth:`local_spmv` instead)."""
-        n = self.shape[0]
         xh = jnp.asarray(x.to_numpy(), dtype=self.dtype)
         yh = np.asarray(self._jit_mult(xh))
         if y is None:

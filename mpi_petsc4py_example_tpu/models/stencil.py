@@ -83,12 +83,16 @@ class StencilPoisson3D:
             # Dirichlet: the global boundary receives no wrap-around halo
             halo_lo = jnp.where(i == 0, zero_plane, up)        # plane z-1
             halo_hi = jnp.where(i == ndev - 1, zero_plane, down)  # plane z+lz
-            ext = jnp.concatenate([halo_lo[None], u, halo_hi[None]], axis=0)
             if use_pallas:
-                y = stencil3d_apply_pallas(ext, lz, ny, nx)
+                # halo planes ride as separate inputs — no concatenated
+                # extended-slab copy in HBM (2 full passes saved per apply)
+                y = stencil3d_apply_pallas(u, halo_lo[None], halo_hi[None],
+                                           lz, ny, nx)
             else:
                 # pure-jnp fallback: shifts on the VPU; x/y boundaries get
                 # zero neighbours from the pads
+                ext = jnp.concatenate([halo_lo[None], u, halo_hi[None]],
+                                      axis=0)
                 center = 6.0 * u
                 zm = ext[:-2]          # z-1
                 zp = ext[2:]           # z+1

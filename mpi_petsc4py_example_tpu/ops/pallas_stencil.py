@@ -2,15 +2,18 @@
 
 The stencil SpMV is the framework's hot op (every CG iteration, BASELINE
 configs 1/5). The jnp formulation materializes six padded temporaries per
-apply (~6 extra HBM passes); this kernel streams the extended slab
-HBM → VMEM in z-chunks with async DMA and computes the full stencil in one
-VMEM-resident pass, so HBM traffic is ~(read + write) of the slab only.
+apply (~6 extra HBM passes); this kernel streams the slab HBM → VMEM in
+z-chunks with double-buffered async DMA and computes the full stencil in one
+VMEM-resident pass. The two z-halo planes (already exchanged over ICI via
+``ppermute``) are passed as separate arrays and DMA'd straight into the
+chunk scratch — no concatenated "extended slab" copy in HBM, so traffic is
+exactly read(u) + write(y) + two planes.
 
 Layout contract (matches models.stencil.StencilPoisson3D): the local slab is
-``(lz, ny, nx)`` x-fastest; the caller prepends/appends one halo plane
-(already exchanged over ICI via ``ppermute``), passing ``ext`` of shape
-``(lz+2, ny, nx)``. Dirichlet boundaries in x/y are realized by shifting
-with zero fill inside the kernel; z-boundaries by the caller's zero halos.
+``(lz, ny, nx)`` x-fastest; ``halo_lo``/``halo_hi`` are the neighbour planes
+``(1, ny, nx)`` below/above (zero at the global Dirichlet boundaries).
+Dirichlet boundaries in x/y are realized by shifting with zero fill inside
+the kernel.
 
 Falls back to the pure-jnp path on non-TPU backends (models/stencil.py).
 """
@@ -38,56 +41,130 @@ def _shift_y(u, step):
     return jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
 
 
-def _stencil_kernel(ext_ref, out_ref, chunk, nchunks):
-    """Grid-free kernel: fori over z-chunks, manual DMA HBM→VMEM→HBM."""
-    lz = out_ref.shape[0]
-    ny, nx = out_ref.shape[1], out_ref.shape[2]
+def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks):
+    """Grid-free kernel: double-buffered z-chunk pipeline, manual DMA.
 
-    # All index/constant dtypes are pinned to i32/f32 explicitly: with x64
-    # enabled, bare Python literals trace as i64/f64, which Mosaic's
-    # lowering cannot convert (infinite recursion in _convert_helper).
-    def process(scratch, osc, sem_in, sem_out):
+    Per chunk ``c`` the scratch holds planes ``[z0-1, z0+chunk+1)`` of the
+    extended slab: the center comes from ``u``, the edge planes from ``u``'s
+    neighbouring chunks or from the halo arrays at the slab ends. All
+    index/constant dtypes are pinned to i32/f32 explicitly: with x64 enabled,
+    bare Python literals trace as i64/f64, which Mosaic cannot lower.
+    """
+    def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out):
         six = jnp.asarray(6.0, out_ref.dtype)
+        one = jnp.int32(1)
+
+        def start_in(c, slot):
+            """Kick off the three input DMAs for chunk ``c`` into bank ``slot``."""
+            z0 = c * jnp.int32(chunk)
+            pltpu.make_async_copy(
+                u_ref.at[pl.ds(z0, chunk)], sc.at[slot, pl.ds(one, chunk)],
+                sem_c.at[slot]).start()
+            # lower edge plane: u[z0-1], or halo_lo for the first chunk
+            @pl.when(c == 0)
+            def _():
+                pltpu.make_async_copy(lo_ref, sc.at[slot, pl.ds(0, 1)],
+                                      sem_lo.at[slot]).start()
+
+            @pl.when(c > 0)
+            def _():
+                pltpu.make_async_copy(u_ref.at[pl.ds(z0 - one, 1)],
+                                      sc.at[slot, pl.ds(0, 1)],
+                                      sem_lo.at[slot]).start()
+            # upper edge plane: u[z0+chunk], or halo_hi for the last chunk
+            @pl.when(c == nchunks - 1)
+            def _():
+                pltpu.make_async_copy(
+                    hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                    sem_hi.at[slot]).start()
+
+            @pl.when(c < nchunks - 1)
+            def _():
+                pltpu.make_async_copy(
+                    u_ref.at[pl.ds(z0 + jnp.int32(chunk), 1)],
+                    sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                    sem_hi.at[slot]).start()
+
+        def wait_in(slot):
+            # matching waits for the three start_in copies (shapes must agree)
+            pltpu.make_async_copy(
+                u_ref.at[pl.ds(0, chunk)], sc.at[slot, pl.ds(one, chunk)],
+                sem_c.at[slot]).wait()
+            pltpu.make_async_copy(lo_ref, sc.at[slot, pl.ds(0, 1)],
+                                  sem_lo.at[slot]).wait()
+            pltpu.make_async_copy(
+                hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                sem_hi.at[slot]).wait()
+
+        start_in(jnp.int32(0), jnp.int32(0))
 
         def body(c, carry):
-            z0 = c * jnp.int32(chunk)
-            din = pltpu.make_async_copy(
-                ext_ref.at[pl.ds(z0, chunk + 2)], scratch, sem_in)
-            din.start()
-            din.wait()
-            u = scratch[1:-1]          # (chunk, ny, nx) center planes
-            zm = scratch[:-2]
-            zp = scratch[2:]
+            slot = lax_rem(c)
+            nslot = lax_rem(c + 1)
+
+            @pl.when(c + 1 < nchunks)
+            def _():
+                start_in(c + 1, nslot)
+
+            wait_in(slot)
+            buf = sc[slot]
+            u = buf[1:-1]          # (chunk, ny, nx) center planes
+            zm = buf[:-2]
+            zp = buf[2:]
             y = (six * u - zm - zp
                  - _shift_y(u, -1) - _shift_y(u, +1)
                  - _shift_x(u, -1) - _shift_x(u, +1))
-            osc[:] = y
-            dout = pltpu.make_async_copy(
-                osc, out_ref.at[pl.ds(z0, chunk)], sem_out)
-            dout.start()
-            dout.wait()
+            # wait for the output DMA that used this osc bank two chunks ago
+            @pl.when(c >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    osc.at[slot], out_ref.at[pl.ds(0, chunk)],
+                    sem_out.at[slot]).wait()
+            osc[slot] = y
+            pltpu.make_async_copy(
+                osc.at[slot],
+                out_ref.at[pl.ds(c * jnp.int32(chunk), chunk)],
+                sem_out.at[slot]).start()
             return carry
+
+        def lax_rem(c):
+            return jax.lax.rem(c, jnp.int32(2))
 
         jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
                           jnp.int32(0))
+        # drain the last (up to) two in-flight output DMAs
+        last = jnp.int32(nchunks - 1)
 
+        @pl.when(jnp.int32(nchunks) >= 2)
+        def _():
+            pltpu.make_async_copy(
+                osc.at[lax_rem(last + 1)], out_ref.at[pl.ds(0, chunk)],
+                sem_out.at[lax_rem(last + 1)]).wait()
+
+        pltpu.make_async_copy(
+            osc.at[lax_rem(last)], out_ref.at[pl.ds(0, chunk)],
+            sem_out.at[lax_rem(last)]).wait()
+
+    ny, nx = out_ref.shape[1], out_ref.shape[2]
     pl.run_scoped(
         process,
-        pltpu.VMEM((chunk + 2, ny, nx), out_ref.dtype),
-        pltpu.VMEM((chunk, ny, nx), out_ref.dtype),
-        pltpu.SemaphoreType.DMA(()),
-        pltpu.SemaphoreType.DMA(()),
+        pltpu.VMEM((2, chunk + 2, ny, nx), out_ref.dtype),
+        pltpu.VMEM((2, chunk, ny, nx), out_ref.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def stencil3d_apply_pallas(ext, lz: int, ny: int, nx: int):
-    """Apply the 7-point stencil to ``ext`` of shape ``(lz+2, ny, nx)``.
-
-    Returns the (lz, ny, nx) result. ``ext`` includes the two halo planes.
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int):
+    """Apply the 7-point stencil to the local slab ``u`` of shape
+    ``(lz, ny, nx)`` with neighbour planes ``halo_lo``/``halo_hi`` of shape
+    ``(1, ny, nx)``. Returns the (lz, ny, nx) result.
     """
-    # pick a z-chunk that divides lz and keeps ~<=4MB in VMEM per buffer
-    budget = (4 << 20) // (ny * nx * ext.dtype.itemsize)
+    # pick a z-chunk that divides lz and keeps ~<=2MB per VMEM bank
+    budget = (2 << 20) // (ny * nx * u.dtype.itemsize)
     chunk = max(1, min(lz, budget))
     while lz % chunk:
         chunk -= 1
@@ -95,10 +172,10 @@ def stencil3d_apply_pallas(ext, lz: int, ny: int, nx: int):
     kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), ext.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-    )(ext)
+    )(u, halo_lo, halo_hi)
 
 
 def pallas_supported(ny: int, nx: int, dtype) -> bool:

@@ -459,6 +459,8 @@ def _bjacobi_block_count(lsize: int, ndev: int, blocks: int) -> int:
     SURVEY.md §7.4). Blocks must tile the local rows evenly (uniform padded
     layout), so the count snaps to a divisor of ``lsize``.
     """
+    if blocks < 0:
+        raise ValueError(f"-pc_bjacobi_blocks must be positive, got {blocks}")
     if blocks:
         if blocks % ndev:
             raise ValueError(
@@ -476,8 +478,15 @@ def _bjacobi_block_count(lsize: int, ndev: int, blocks: int) -> int:
     # densify (O(bs²) memory each, O(bs³) host factorization), so past the
     # cap we want many MXU-friendly blocks, not a few enormous ones
     nb = -(-lsize // _AUTO_BLOCK_TARGET)
-    while lsize % nb:
+    # snap up to a divisor of lsize, but don't degenerate: if no divisor
+    # keeps blocks >= ~cap/8 rows (e.g. lsize prime), the split is useless
+    while lsize % nb and lsize // nb > _AUTO_BLOCK_TARGET // 8:
         nb += 1
+    if lsize % nb:
+        raise ValueError(
+            f"PC 'bjacobi' cannot auto-split {lsize} local rows into even "
+            "dense blocks — set -pc_bjacobi_blocks explicitly or use pc "
+            "'jacobi'/'gamg'")
     return nb
 
 
