@@ -105,6 +105,35 @@ class Vec:
         return Vec(self._core.duplicate(), self._layout, self._rank,
                    self._comm)
 
+    def view(self, viewer=None):
+        """Dump to a binary Viewer (VecView) or print a summary."""
+        if isinstance(viewer, Viewer):
+            viewer._check_mode(read=False)
+
+            def build(_):
+                _tps.petsc_io.save_vec(viewer.path, self._core)
+                return True
+            self._comm._collective("vec_view_binary", None, build)
+            return
+        if self._comm.Get_rank() == 0:
+            print(repr(self._core), file=sys.stderr)
+
+    def load(self, viewer):
+        """VecLoad: fill this Vec from a PETSc binary Vec file."""
+        viewer._check_mode(read=True)
+
+        def build(_):
+            arr = _tps.petsc_io.read_vec(viewer.path)
+            if arr.shape[0] != self._core.n:
+                raise ValueError(
+                    f"VecLoad size mismatch: file has {arr.shape[0]} "
+                    f"entries, Vec has {self._core.n} (PETSc errors on "
+                    "this too)")
+            self._core.set_global(arr.astype(self._core.dtype))
+            return True
+        self._comm._collective("vec_load_binary", None, build)
+        return self
+
     def destroy(self):
         return self
 
@@ -226,9 +255,32 @@ class Mat:
             return True
         self._comm._collective("mat_mult", None, build)
 
-    def view(self):
+    def view(self, viewer=None):
+        """Print a summary, or dump to a binary Viewer (MatView)."""
+        if isinstance(viewer, Viewer):
+            viewer._check_mode(read=False)
+
+            def build(_):
+                _tps.petsc_io.save_mat(viewer.path, self._core)
+                return True
+            self._comm._collective("mat_view_binary", None, build)
+            return
         if self._comm.Get_rank() == 0:
             print(repr(self._core), file=sys.stderr)
+
+    def load(self, viewer):
+        """MatLoad: read a PETSc binary Mat file (collective)."""
+        viewer._check_mode(read=True)
+        comm = self._comm or _MPI.COMM_WORLD
+        self._comm = comm
+
+        def build(_):
+            core = _tps.petsc_io.load_mat(viewer.path, comm.device_comm)
+            counts = RowLayout(core.shape[0], comm.Get_size()).count
+            return core, _UnevenLayout(counts)
+
+        self._core, self._layout = comm._collective("mat_load", None, build)
+        return self
 
     def destroy(self):
         return self
@@ -266,6 +318,42 @@ class Mat:
     @property
     def core(self):
         return self._core
+
+
+class Viewer:
+    """Binary viewer handle (PetscViewerBinaryOpen analog).
+
+    Only the binary file viewer is provided — the slice of the Viewer API
+    needed for MatView/MatLoad/VecView/VecLoad interop with real PETSc
+    binary files (utils/petsc_io.py documents the byte layout).
+    """
+
+    def __init__(self):
+        self.path = None
+        self.mode = "r"
+
+    def createBinary(self, name, mode="r", comm=None):
+        self.path = str(name)
+        self.mode = str(mode).lower()
+        if self.mode not in ("r", "w", "a"):
+            raise ValueError(f"unknown viewer mode {mode!r}")
+        return self
+
+    def _check_mode(self, read: bool):
+        if self.path is None:
+            raise RuntimeError(
+                "Viewer has no file — call createBinary(path, mode) first")
+        if read and self.mode != "r":
+            raise ValueError(
+                f"viewer opened with mode {self.mode!r} cannot be read "
+                "(PETSc raises on this too)")
+        if not read and self.mode == "r":
+            raise ValueError(
+                "viewer opened read-only cannot be written "
+                "(PETSc raises on this too)")
+
+    def destroy(self):
+        return self
 
 
 class NullSpace:

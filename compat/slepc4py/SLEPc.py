@@ -128,6 +128,13 @@ class EPS:
     def getErrorEstimate(self, i):
         return self._core.get_error_estimate(i)
 
+    class ErrorType:
+        ABSOLUTE = "absolute"
+        RELATIVE = "relative"
+
+    def computeError(self, i, etype="relative"):
+        return self._core.compute_error(i, etype)
+
     def destroy(self):
         return self
 

@@ -44,6 +44,7 @@ from .solvers.pc import PC
 from .solvers.ksp import KSP
 from .utils.convergence import ConvergedReason, SolveResult
 from .utils.options import Options, global_options, init, backend
+from .utils import petsc_io
 
 __version__ = "0.1.0"
 
@@ -53,7 +54,7 @@ __all__ = [
     "partition_csr", "concat_csr_blocks",
     "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST",
     "ConvergedReason", "SolveResult",
-    "Options", "global_options", "init", "backend",
+    "Options", "global_options", "init", "backend", "petsc_io",
 ]
 
 

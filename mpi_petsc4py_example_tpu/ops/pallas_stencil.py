@@ -157,14 +157,21 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5))
-def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
+                           interpret: bool = False,
+                           max_chunk: int | None = None):
     """Apply the 7-point stencil to the local slab ``u`` of shape
     ``(lz, ny, nx)`` with neighbour planes ``halo_lo``/``halo_hi`` of shape
     ``(1, ny, nx)``. Returns the (lz, ny, nx) result.
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter on any
+    backend — used by CI to pin the DMA pipeline's correctness off-TPU.
     """
     # pick a z-chunk that divides lz and keeps ~<=2MB per VMEM bank
     budget = (2 << 20) // (ny * nx * u.dtype.itemsize)
+    if max_chunk is not None:
+        budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
     chunk = max(1, min(lz, budget))
     while lz % chunk:
         chunk -= 1
@@ -175,6 +182,7 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int):
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        interpret=interpret,
     )(u, halo_lo, halo_hi)
 
 

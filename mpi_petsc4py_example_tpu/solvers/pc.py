@@ -460,7 +460,7 @@ def _bjacobi_block_count(lsize: int, ndev: int, blocks: int) -> int:
     layout), so the count snaps to a divisor of ``lsize``.
     """
     if blocks < 0:
-        raise ValueError(f"-pc_bjacobi_blocks must be positive, got {blocks}")
+        blocks = 0   # PETSC_DECIDE (-1) and friends: let the library choose
     if blocks:
         if blocks % ndev:
             raise ValueError(

@@ -355,3 +355,50 @@ class TestEPSOptions:
         E.set_problem_type("hep")
         with pytest.raises(ValueError, match="ghep"):
             E.solve()
+
+
+class TestComputeError:
+    def test_hep_residual(self, comm8):
+        import scipy.sparse as sp
+        n = 50
+        A = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                     [-1, 0, 1]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_dimensions(nev=2)
+        eps.solve()
+        assert eps.get_converged() >= 2
+        for i in range(2):
+            err = eps.compute_error(i)                 # relative, true residual
+            assert err < 1e-7, err
+            abs_err = eps.compute_error(i, "absolute")
+            lam = abs(eps.get_eigenvalue(i))
+            np.testing.assert_allclose(abs_err, err * lam, rtol=1e-10)
+
+    def test_generalized_residual(self, comm8):
+        import scipy.sparse as sp
+        n = 40
+        rng = np.random.default_rng(2)
+        A = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                     [-1, 0, 1]).tocsr()
+        B = sp.diags(1.0 + rng.random(n)).tocsr()
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, B)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(MA, MB)
+        eps.set_dimensions(nev=1)
+        eps.solve()
+        assert eps.get_converged() >= 1
+        assert eps.compute_error(0) < 1e-7
+
+    def test_bad_type_raises(self, comm8):
+        import scipy.sparse as sp
+        A = sp.eye(10, format="csr")
+        M = tps.Mat.from_scipy(comm8, A)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.solve()
+        with pytest.raises(ValueError, match="unknown error type"):
+            eps.compute_error(0, "bogus")

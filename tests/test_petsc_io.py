@@ -1,0 +1,151 @@
+"""PETSc binary viewer format interop (utils/petsc_io.py).
+
+Byte-exact golden files pin the layout to PETSc's documented big-endian
+format, so files round-trip with real PETSc MatLoad/VecLoad.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.utils import petsc_io
+
+
+def poisson2d(nx):
+    T = sp.diags([-np.ones(nx - 1), 2 * np.ones(nx), -np.ones(nx - 1)],
+                 [-1, 0, 1])
+    return (sp.kron(sp.eye(nx), T) + sp.kron(T, sp.eye(nx))).tocsr()
+
+
+class TestByteLayout:
+    def test_mat_golden_bytes(self, tmp_path):
+        """[[1, 2], [0, 3]] must serialize to PETSc's exact AIJ byte layout."""
+        A = sp.csr_matrix(np.array([[1.0, 2.0], [0.0, 3.0]]))
+        p = tmp_path / "a.petsc"
+        petsc_io.write_mat(p, A)
+        expected = (
+            np.array([1211216, 2, 2, 3], dtype=">i4").tobytes()   # header
+            + np.array([2, 1], dtype=">i4").tobytes()             # row lens
+            + np.array([0, 1, 1], dtype=">i4").tobytes()          # columns
+            + np.array([1.0, 2.0, 3.0], dtype=">f8").tobytes())   # values
+        assert p.read_bytes() == expected
+
+    def test_vec_golden_bytes(self, tmp_path):
+        p = tmp_path / "v.petsc"
+        petsc_io.write_vec(p, np.array([0.5, -1.25]))
+        expected = (np.array([1211214, 2], dtype=">i4").tobytes()
+                    + np.array([0.5, -1.25], dtype=">f8").tobytes())
+        assert p.read_bytes() == expected
+
+
+class TestRoundTrip:
+    def test_mat(self, tmp_path):
+        rng = np.random.default_rng(3)
+        A = sp.random(60, 45, density=0.08, random_state=rng).tocsr()
+        p = tmp_path / "m.petsc"
+        petsc_io.write_mat(p, A)
+        B = petsc_io.read_mat(p)
+        assert B.shape == A.shape
+        assert (A != B).nnz == 0
+
+    def test_vec(self, tmp_path):
+        v = np.random.default_rng(4).random(77)
+        p = tmp_path / "v.petsc"
+        petsc_io.write_vec(p, v)
+        np.testing.assert_array_equal(petsc_io.read_vec(p), v)
+
+    def test_sharded_mat_vec(self, comm8, tmp_path):
+        """save_mat/load_mat through the row-sharded framework objects."""
+        A = poisson2d(8)
+        M = tps.Mat.from_scipy(comm8, A)
+        x = np.random.default_rng(5).random(64)
+        v = tps.Vec.from_global(comm8, x)
+        petsc_io.save_mat(tmp_path / "m.petsc", M)
+        petsc_io.save_vec(tmp_path / "v.petsc", v)
+        M2 = petsc_io.load_mat(tmp_path / "m.petsc", comm8)
+        v2 = petsc_io.load_vec(tmp_path / "v.petsc", comm8)
+        assert (M2.to_scipy() != A).nnz == 0
+        np.testing.assert_array_equal(v2.to_numpy(), x)
+
+    def test_loaded_mat_solves(self, comm8, tmp_path):
+        A = poisson2d(8)
+        x_true = np.random.default_rng(0).random(64)
+        b = A @ x_true
+        petsc_io.write_mat(tmp_path / "m.petsc", A)
+        M = petsc_io.load_mat(tmp_path / "m.petsc", comm8)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-10)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
+
+
+class TestErrors:
+    def test_wrong_classid(self, tmp_path):
+        p = tmp_path / "v.petsc"
+        petsc_io.write_vec(p, np.ones(3))
+        with pytest.raises(ValueError, match="not a PETSc Mat"):
+            petsc_io.read_mat(p)
+        petsc_io.write_mat(tmp_path / "m.petsc", sp.eye(3, format="csr"))
+        with pytest.raises(ValueError, match="not a PETSc Vec"):
+            petsc_io.read_vec(tmp_path / "m.petsc")
+
+    def test_truncated(self, tmp_path):
+        p = tmp_path / "m.petsc"
+        petsc_io.write_mat(p, sp.eye(5, format="csr"))
+        data = p.read_bytes()
+        p.write_bytes(data[:-12])
+        with pytest.raises(ValueError, match="truncated"):
+            petsc_io.read_mat(p)
+
+    def test_bad_rowlens(self, tmp_path):
+        p = tmp_path / "m.petsc"
+        hdr = np.array([1211216, 2, 2, 3], dtype=">i4")
+        rl = np.array([1, 1], dtype=">i4")           # sums to 2, claims 3
+        p.write_bytes(hdr.tobytes() + rl.tobytes()
+                      + np.zeros(3, dtype=">i4").tobytes()
+                      + np.zeros(3, dtype=">f8").tobytes())
+        with pytest.raises(ValueError, match="row lengths"):
+            petsc_io.read_mat(p)
+
+
+class TestFacadeViewer:
+    def test_matview_matload(self, tmp_path):
+        import os
+        import sys
+        compat = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+        if compat not in sys.path:
+            sys.path.insert(0, compat)
+        from petsc4py import PETSc
+
+        A = poisson2d(6)
+        m = PETSc.Mat().createAIJ(size=A.shape,
+                                  csr=(A.indptr, A.indices, A.data))
+        path = str(tmp_path / "fac.petsc")
+        vw = PETSc.Viewer().createBinary(path, "w")
+        m.view(vw)
+        m2 = PETSc.Mat().load(PETSc.Viewer().createBinary(path, "r"))
+        assert m2.getSize() == A.shape
+
+        x, b = m2.getVecs()
+        x_true = np.random.default_rng(1).random(36)
+        b.setArray(A @ x_true)
+        vpath = str(tmp_path / "b.petsc")
+        b.view(PETSc.Viewer().createBinary(vpath, "w"))
+        b2 = m2.getVecs()[1]
+        b2.load(PETSc.Viewer().createBinary(vpath, "r"))
+        np.testing.assert_allclose(b2.array, A @ x_true)
+
+        ksp = PETSc.KSP().create()
+        ksp.setOperators(m2)
+        ksp.setType("cg")
+        ksp.setTolerances(rtol=1e-10)
+        ksp.solve(b2, x)
+        np.testing.assert_allclose(x.array, x_true, rtol=1e-7, atol=1e-9)
