@@ -111,7 +111,8 @@ class Vec:
             viewer._check_mode(read=False)
 
             def build(_):
-                _tps.petsc_io.save_vec(viewer.path, self._core)
+                _tps.petsc_io.save_vec(viewer.handle, self._core)
+                viewer.handle.flush()
                 return True
             self._comm._collective("vec_view_binary", None, build)
             return
@@ -123,7 +124,7 @@ class Vec:
         viewer._check_mode(read=True)
 
         def build(_):
-            arr = _tps.petsc_io.read_vec(viewer.path)
+            arr = _tps.petsc_io.read_vec(viewer.handle)
             if arr.shape[0] != self._core.n:
                 raise ValueError(
                     f"VecLoad size mismatch: file has {arr.shape[0]} "
@@ -261,7 +262,8 @@ class Mat:
             viewer._check_mode(read=False)
 
             def build(_):
-                _tps.petsc_io.save_mat(viewer.path, self._core)
+                _tps.petsc_io.save_mat(viewer.handle, self._core)
+                viewer.handle.flush()
                 return True
             self._comm._collective("mat_view_binary", None, build)
             return
@@ -275,7 +277,7 @@ class Mat:
         self._comm = comm
 
         def build(_):
-            core = _tps.petsc_io.load_mat(viewer.path, comm.device_comm)
+            core = _tps.petsc_io.load_mat(viewer.handle, comm.device_comm)
             counts = RowLayout(core.shape[0], comm.Get_size()).count
             return core, _UnevenLayout(counts)
 
@@ -331,6 +333,7 @@ class Viewer:
     def __init__(self):
         self.path = None
         self.mode = "r"
+        self._file = None
 
     def createBinary(self, name, mode="r", comm=None):
         self.path = str(name)
@@ -338,6 +341,20 @@ class Viewer:
         if self.mode not in ("r", "w", "a"):
             raise ValueError(f"unknown viewer mode {mode!r}")
         return self
+
+    @property
+    def handle(self):
+        """The open file, cursor persisting across objects — several
+        MatView/VecView calls stream into one file and several loads read
+        them back in order (PETSc's standard Mat-then-Vec file layout)."""
+        if self._file is None:
+            if self.path is None:
+                raise RuntimeError(
+                    "Viewer has no file — call createBinary(path, mode) "
+                    "first")
+            self._file = open(self.path,
+                              {"r": "rb", "w": "wb", "a": "ab"}[self.mode])
+        return self._file
 
     def _check_mode(self, read: bool):
         if self.path is None:
@@ -353,7 +370,12 @@ class Viewer:
                 "(PETSc raises on this too)")
 
     def destroy(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
         return self
+
+    flush = destroy
 
 
 class NullSpace:

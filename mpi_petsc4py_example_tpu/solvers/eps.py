@@ -54,7 +54,8 @@ from .st import ST
 DEFAULT_TOL = 1e-8        # SLEPc's EPS default
 DEFAULT_MAX_RESTARTS = 100
 
-EPS_TYPES = ("krylovschur", "arnoldi", "lanczos", "power", "subspace")
+EPS_TYPES = ("krylovschur", "arnoldi", "lanczos", "power", "subspace",
+             "lobpcg")
 
 
 class EPSProblemType:
@@ -78,6 +79,7 @@ class EPSType:
     LANCZOS = "lanczos"
     POWER = "power"
     SUBSPACE = "subspace"
+    LOBPCG = "lobpcg"
 
 
 _PROGRAM_CACHE: dict = {}
@@ -293,6 +295,25 @@ def _build_block_mult_program(comm: DeviceComm, op, m: int):
     return prog
 
 
+def _apply_blocked(S, apply_m, m):
+    """Apply an m-row block program to a ``(k, n)`` host block, k arbitrary.
+
+    Chunks the rows into m-row blocks (zero-padding the tail) so one compiled
+    block-mult program serves every basis size LOBPCG produces.
+    """
+    k = S.shape[0]
+    out = np.zeros_like(S)
+    for s in range(0, k, m):
+        blk = S[s:s + m]
+        if blk.shape[0] < m:
+            pad = np.zeros((m, S.shape[1]))
+            pad[:blk.shape[0]] = blk
+            out[s:s + m] = apply_m(pad)[:blk.shape[0]]
+        else:
+            out[s:s + m] = apply_m(blk)
+    return out
+
+
 class EPS:
     """Eigensolver context, slepc4py-``EPS``-shaped."""
 
@@ -480,6 +501,8 @@ class EPS:
             self._solve_power()
         elif self._type == "subspace":
             self._solve_subspace()
+        elif self._type == "lobpcg":
+            self._solve_lobpcg()
         elif self._type == "arnoldi":
             self._solve_arnoldi_explicit()
         else:  # krylovschur / lanczos
@@ -779,6 +802,141 @@ class EPS:
         nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
         nrm[nrm == 0] = 1.0
         self._store(lam, vecs / nrm, rel[:count], nconv, it)
+
+    # ---- LOBPCG --------------------------------------------------------------
+    def _solve_lobpcg(self):
+        """Locally Optimal Block Preconditioned CG (Knyazev 2001; EPSLOBPCG).
+
+        Extreme eigenpairs of a Hermitian (or generalized Hermitian) pencil:
+        each iteration Rayleigh-Ritzes over the 3m-dimensional trial space
+        span[X, T·R, P] (iterates, preconditioned residuals, previous search
+        directions). The m-row block operator applications run on the mesh
+        (one compiled program, same block-mult kernel as EPS 'subspace'); the
+        3m×3m projected problem is host LAPACK. The preconditioner T is
+        inverse-diagonal (Jacobi) when the operator exposes a diagonal,
+        identity otherwise — the analog of SLEPc's default STPRECOND.
+
+        Restricted to ``which`` in {smallest_real, largest_real}: LOBPCG
+        converges to extreme ends of the spectrum only (SLEPc's EPSLOBPCG has
+        the same restriction).
+        """
+        import scipy.linalg
+        if self._problem_type not in (EPSProblemType.HEP,
+                                      EPSProblemType.GHEP):
+            raise ValueError("EPS 'lobpcg' needs a Hermitian problem type "
+                             "(hep/ghep)")
+        if self._which not in (EPSWhich.SMALLEST_REAL, EPSWhich.LARGEST_REAL):
+            raise ValueError(
+                "EPS 'lobpcg' computes extreme eigenvalues — set "
+                "which='smallest_real' or 'largest_real' (got "
+                f"{self._which!r}); krylovschur supports all selections")
+        if not self.st.is_identity():
+            raise ValueError("EPS 'lobpcg' supports no spectral transform — "
+                             "use krylovschur with ST 'sinvert'")
+        comm = self._mat.comm
+        op = self._mat
+        bop = self._bmat
+        n = op.shape[0]
+        _LOBPCG_BS_CAP = 16   # block spmvs are statically unrolled
+        m = min(max(self.nev, 1), _LOBPCG_BS_CAP, n)
+        if self.nev > _LOBPCG_BS_CAP:
+            raise ValueError(
+                f"EPS 'lobpcg' caps the block size at {_LOBPCG_BS_CAP} — "
+                "use krylovschur for more pairs")
+        prog = _build_block_mult_program(comm, op, m)
+        bprog = (_build_block_mult_program(comm, bop, m)
+                 if bop is not None else None)
+        op_arrays = op.device_arrays()
+        dtype = np.dtype(str(op.dtype))
+        npad = comm.padded_size(n)
+        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
+
+        def block_apply(which_prog, arrays, M_host):
+            """Host (m, n) block -> device block program -> host (m, n)."""
+            Mp = np.zeros((m, npad), dtype=dtype)
+            Mp[:, :n] = M_host
+            out = np.asarray(which_prog(arrays, jax.device_put(Mp, sharding)))
+            return out[:, :n].astype(np.float64)
+
+        A_apply = lambda Mh: block_apply(prog, op_arrays, Mh)
+        if bop is not None:
+            b_arrays = bop.device_arrays()
+            B_apply = lambda Mh: block_apply(bprog, b_arrays, Mh)
+        else:
+            B_apply = lambda Mh: Mh
+
+        try:
+            diag = np.asarray(op.diagonal(), dtype=np.float64)
+            diag = np.where(np.abs(diag) > 0, diag, 1.0)
+            T_apply = lambda Rh: Rh / diag[None, :]
+        except (ValueError, AttributeError):
+            T_apply = lambda Rh: Rh
+
+        sign = -1.0 if self._which == EPSWhich.LARGEST_REAL else 1.0
+
+        rng = np.random.default_rng(20240901)
+        X = rng.standard_normal((m, n))
+        X = np.linalg.qr(X.T)[0].T
+        Pdir = np.zeros((0, n))
+        theta = np.zeros(m)
+        rel = np.full(m, np.inf)
+        nconv = 0
+
+        def rr_basis(S):
+            """Drop near-dependent rows (rank-revealing QR), orthonormalize."""
+            Q, R, _ = scipy.linalg.qr(S.T, mode="economic", pivoting=True)
+            d = np.abs(np.diag(R))
+            keep = d > max(d[0], 1e-300) * 1e-12
+            return Q[:, keep].T
+
+        it = 0
+        AX = BX = None
+        for it in range(1, self.max_it + 1):
+            if AX is None:        # later iterations reuse Cᵀ(AS)/Cᵀ(BS)
+                AX = A_apply(X)
+                BX = B_apply(X)
+            # current Ritz values of the block (Rayleigh quotients)
+            theta = np.sum(X * AX, axis=1) / np.sum(X * BX, axis=1)
+            R = AX - theta[:, None] * BX
+            rel = (np.linalg.norm(R, axis=1)
+                   / np.maximum(np.abs(theta), 1e-300))
+            order0 = np.argsort(sign * theta, kind="stable")
+            nconv = 0
+            while nconv < min(self.nev, m) and rel[order0[nconv]] <= self.tol:
+                nconv += 1
+            if nconv >= min(self.nev, m) or it == self.max_it:
+                break
+            W = T_apply(R)
+            S = rr_basis(np.vstack([X, W, Pdir]) if len(Pdir)
+                         else np.vstack([X, W]))
+            AS = _apply_blocked(S, A_apply, m)
+            BS = _apply_blocked(S, B_apply, m) if bop is not None else S
+            Ag = S @ AS.T
+            Bg = S @ BS.T
+            Ag = (Ag + Ag.T) / 2.0
+            Bg = (Bg + Bg.T) / 2.0
+            lam_g, C = scipy.linalg.eigh(sign * Ag, Bg)
+            C = C[:, :m]                      # m best in the wanted direction
+            Xn = C.T @ S
+            # new search directions: the part of Xn outside span(X)
+            Pdir = Xn - (Xn @ X.T) @ X
+            nrm = np.linalg.norm(Pdir, axis=1)
+            Pdir = Pdir[nrm > 1e-12]
+            # Xn's rows are the Ritz vectors (B-orthonormal: Cᵀ Bg C = I) —
+            # re-orthonormalizing with plain QR would MIX them and stall
+            # generalized problems. A(Xn)/B(Xn) come free from the projected
+            # basis images — two device block-mults saved per iteration.
+            X = Xn
+            AX = C.T @ AS
+            BX = (C.T @ BS) if bop is not None else Xn
+
+        order = np.argsort(sign * theta, kind="stable")
+        count = max(min(self.nev, m), 1)
+        take = order[:count]
+        vecs = X[take]
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        self._store(theta[take], vecs / nrm, rel[take], nconv, it)
 
     # ---- results (slepc4py-shaped, collective-safe) --------------------------
     def get_converged(self) -> int:

@@ -29,6 +29,21 @@ _I = np.dtype(">i4")     # PetscInt32, big-endian
 _R = np.dtype(">f8")     # PetscScalar (real, double), big-endian
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _open(path_or_file, mode):
+    """Accept a path (opened fresh) or an open binary file object (used in
+    place, cursor advances) — the latter is how a Viewer streams several
+    objects through one file, PETSc's standard Mat-then-Vec layout."""
+    if hasattr(path_or_file, "read") or hasattr(path_or_file, "write"):
+        yield path_or_file
+    else:
+        with open(path_or_file, mode) as f:
+            yield f
+
+
 def _read(f, dtype, count):
     buf = f.read(dtype.itemsize * count)
     if len(buf) != dtype.itemsize * count:
@@ -39,18 +54,18 @@ def _read(f, dtype, count):
 def write_vec(path, arr) -> None:
     """Write a 1-D array as a PETSc binary Vec (``VecView`` layout)."""
     arr = np.asarray(arr, dtype=np.float64).ravel()
-    with open(path, "wb") as f:
-        np.array([VEC_FILE_CLASSID, arr.size], dtype=_I).tofile(f)
-        arr.astype(_R).tofile(f)
+    with _open(path, "wb") as f:
+        f.write(np.array([VEC_FILE_CLASSID, arr.size], dtype=_I).tobytes())
+        f.write(arr.astype(_R).tobytes())
 
 
 def read_vec(path) -> np.ndarray:
-    """Read a PETSc binary Vec file -> float64 numpy array."""
-    with open(path, "rb") as f:
+    """Read a PETSc binary Vec -> float64 numpy array."""
+    with _open(path, "rb") as f:
         classid, n = _read(f, _I, 2)
         if classid != VEC_FILE_CLASSID:
             raise ValueError(
-                f"{path!r} is not a PETSc Vec file (classid {classid}, "
+                f"{path!r} is not a PETSc Vec (classid {classid}, "
                 f"expected {VEC_FILE_CLASSID})")
         if n < 0:
             raise ValueError(f"corrupt PETSc Vec file: n={n}")
@@ -60,27 +75,31 @@ def read_vec(path) -> np.ndarray:
 def write_mat(path, A) -> None:
     """Write a scipy sparse matrix as a PETSc binary Mat (AIJ layout)."""
     A = A.tocsr()
+    # PETSc's SeqAIJ invariant: column indices sorted within each row
+    if not A.has_sorted_indices:
+        A = A.copy()
+        A.sort_indices()
     indptr = np.asarray(A.indptr, dtype=np.int64)
     rowlens = (indptr[1:] - indptr[:-1]).astype(np.int64)
     nnz = int(indptr[-1])
     if max(A.shape[0], A.shape[1], nnz) >= 2 ** 31:
         raise ValueError("matrix too large for 32-bit PETSc binary format")
-    with open(path, "wb") as f:
-        np.array([MAT_FILE_CLASSID, A.shape[0], A.shape[1], nnz],
-                 dtype=_I).tofile(f)
-        rowlens.astype(_I).tofile(f)
-        np.asarray(A.indices, dtype=np.int64).astype(_I).tofile(f)
-        np.asarray(A.data, dtype=np.float64).astype(_R).tofile(f)
+    with _open(path, "wb") as f:
+        f.write(np.array([MAT_FILE_CLASSID, A.shape[0], A.shape[1], nnz],
+                         dtype=_I).tobytes())
+        f.write(rowlens.astype(_I).tobytes())
+        f.write(np.asarray(A.indices, dtype=np.int64).astype(_I).tobytes())
+        f.write(np.asarray(A.data, dtype=np.float64).astype(_R).tobytes())
 
 
 def read_mat(path):
-    """Read a PETSc binary Mat file -> scipy CSR matrix (float64)."""
+    """Read a PETSc binary Mat -> scipy CSR matrix (float64)."""
     import scipy.sparse as sp
-    with open(path, "rb") as f:
+    with _open(path, "rb") as f:
         classid, nrows, ncols, nnz = _read(f, _I, 4)
         if classid != MAT_FILE_CLASSID:
             raise ValueError(
-                f"{path!r} is not a PETSc Mat file (classid {classid}, "
+                f"{path!r} is not a PETSc Mat (classid {classid}, "
                 f"expected {MAT_FILE_CLASSID})")
         if nrows < 0 or ncols < 0 or nnz < 0:
             raise ValueError(

@@ -402,3 +402,84 @@ class TestComputeError:
         eps.solve()
         with pytest.raises(ValueError, match="unknown error type"):
             eps.compute_error(0, "bogus")
+
+
+class TestLOBPCG:
+    def _tridiag(self, n=60):
+        import scipy.sparse as sp
+        return sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                        [-1, 0, 1]).tocsr()
+
+    def test_smallest(self, comm8):
+        A = self._tridiag()
+        M = tps.Mat.from_scipy(comm8, A)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_type("lobpcg")
+        eps.set_which_eigenpairs("smallest_real")
+        eps.set_dimensions(nev=3)
+        eps.set_tolerances(tol=1e-9, max_it=300)
+        eps.solve()
+        assert eps.get_converged() >= 3
+        exact = np.sort(np.linalg.eigvalsh(A.toarray()))[:3]
+        got = np.sort([eps.get_eigenvalue(i).real for i in range(3)])
+        np.testing.assert_allclose(got, exact, rtol=1e-6)
+        for i in range(3):
+            assert eps.compute_error(i) < 1e-6
+
+    def test_largest(self, comm8):
+        A = self._tridiag()
+        M = tps.Mat.from_scipy(comm8, A)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_type("lobpcg")
+        eps.set_which_eigenpairs("largest_real")
+        eps.set_dimensions(nev=2)
+        eps.set_tolerances(tol=1e-9, max_it=300)
+        eps.solve()
+        assert eps.get_converged() >= 2
+        exact = np.sort(np.linalg.eigvalsh(A.toarray()))[-2:]
+        got = np.sort([eps.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, exact, rtol=1e-6)
+
+    def test_generalized(self, comm8):
+        import scipy.sparse as sp
+        import scipy.linalg
+        n = 50
+        A = self._tridiag(n)
+        Bd = 1.0 + np.random.default_rng(1).random(n)
+        B = sp.diags(Bd).tocsr()
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, B)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(MA, MB)
+        eps.set_type("lobpcg")
+        eps.set_which_eigenpairs("smallest_real")
+        eps.set_dimensions(nev=2)
+        eps.set_tolerances(tol=1e-9, max_it=400)
+        eps.solve()
+        assert eps.get_converged() >= 2
+        exact = np.sort(scipy.linalg.eigh(A.toarray(), np.diag(Bd),
+                                          eigvals_only=True))[:2]
+        got = np.sort([eps.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, exact, rtol=1e-6)
+
+    def test_which_restriction(self, comm8):
+        M = tps.Mat.from_scipy(comm8, self._tridiag(20))
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_type("lobpcg")
+        with pytest.raises(ValueError, match="extreme eigenvalues"):
+            eps.solve()
+
+    def test_hermitian_restriction(self, comm8):
+        M = tps.Mat.from_scipy(comm8, self._tridiag(20))
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)          # default NHEP
+        eps.set_type("lobpcg")
+        eps.set_which_eigenpairs("smallest_real")
+        with pytest.raises(ValueError, match="Hermitian problem"):
+            eps.solve()

@@ -149,3 +149,70 @@ class TestFacadeViewer:
         ksp.setTolerances(rtol=1e-10)
         ksp.solve(b2, x)
         np.testing.assert_allclose(x.array, x_true, rtol=1e-7, atol=1e-9)
+
+    def test_multi_object_file(self, tmp_path):
+        """PETSc's standard one-file Mat-then-Vec layout (e.g. what ex10
+        consumes) streams through a single viewer with a persistent cursor."""
+        import os
+        import sys
+        compat = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+        if compat not in sys.path:
+            sys.path.insert(0, compat)
+        from petsc4py import PETSc
+
+        A = poisson2d(5)
+        rhs = np.random.default_rng(2).random(25)
+        m = PETSc.Mat().createAIJ(size=A.shape,
+                                  csr=(A.indptr, A.indices, A.data))
+        x, b = m.getVecs()
+        b.setArray(rhs)
+        path = str(tmp_path / "system.petsc")
+        w = PETSc.Viewer().createBinary(path, "w")
+        m.view(w)
+        b.view(w)
+        w.destroy()
+
+        r = PETSc.Viewer().createBinary(path, "r")
+        m2 = PETSc.Mat().load(r)
+        b2 = m2.getVecs()[1]
+        b2.load(r)
+        r.destroy()
+        assert m2.getSize() == A.shape
+        np.testing.assert_allclose(b2.array, rhs)
+
+    def test_mode_enforced(self, tmp_path):
+        import os
+        import sys
+        compat = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+        if compat not in sys.path:
+            sys.path.insert(0, compat)
+        from petsc4py import PETSc
+
+        A = poisson2d(4)
+        m = PETSc.Mat().createAIJ(size=A.shape,
+                                  csr=(A.indptr, A.indices, A.data))
+        path = str(tmp_path / "x.petsc")
+        petsc_io.write_mat(path, A)
+        rv = PETSc.Viewer().createBinary(path, "r")
+        with pytest.raises(ValueError, match="cannot be written"):
+            m.view(rv)
+        wv = PETSc.Viewer().createBinary(str(tmp_path / "y.petsc"), "w")
+        with pytest.raises(ValueError, match="cannot be read"):
+            PETSc.Mat().load(wv)
+
+    def test_unsorted_indices_sorted_on_write(self, tmp_path):
+        import scipy.sparse as sp
+        indptr = np.array([0, 2, 3])
+        indices = np.array([1, 0, 1])     # row 0 unsorted (legal scipy)
+        data = np.array([2.0, 1.0, 3.0])
+        A = sp.csr_matrix((data, indices, indptr), shape=(2, 2))
+        assert not A.has_sorted_indices
+        p = tmp_path / "u.petsc"
+        petsc_io.write_mat(p, A)
+        raw_cols = np.frombuffer(p.read_bytes(), dtype=">i4",
+                                 count=3, offset=(4 + 2) * 4)
+        assert list(raw_cols) == [0, 1, 1]     # sorted within row 0
+        B = petsc_io.read_mat(p)
+        assert (B != A).nnz == 0
