@@ -336,6 +336,9 @@ class Viewer:
         self._file = None
 
     def createBinary(self, name, mode="r", comm=None):
+        if self._file is not None:       # reuse: drop the old file first
+            self._file.close()
+            self._file = None
         self.path = str(name)
         self.mode = str(mode).lower()
         if self.mode not in ("r", "w", "a"):
@@ -375,7 +378,11 @@ class Viewer:
             self._file = None
         return self
 
-    flush = destroy
+    def flush(self):
+        """Flush buffered writes; the handle (and cursor) stay valid."""
+        if self._file is not None and self.mode != "r":
+            self._file.flush()
+        return self
 
 
 class NullSpace:

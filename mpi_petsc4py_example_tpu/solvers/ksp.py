@@ -53,6 +53,9 @@ class KSP:
         self.restart = 30
         self.lgmres_augment = 2       # -ksp_lgmres_augment (KSPLGMRES aug_k)
         self.bcgsl_ell = 2            # -ksp_bcgsl_ell (KSPBCGSL default)
+        self.unroll = 4               # -ksp_unroll: masked steps per loop
+                                      # dispatch (amortizes per-iteration
+                                      # runtime overhead; results identical)
         self._monitors = []
         self._monitor_flag = False
         self._initial_guess_nonzero = False
@@ -153,6 +156,7 @@ class KSP:
         self.lgmres_augment = opt.get_int(p + "ksp_lgmres_augment",
                                           self.lgmres_augment)
         self.bcgsl_ell = opt.get_int(p + "ksp_bcgsl_ell", self.bcgsl_ell)
+        self.unroll = opt.get_int(p + "ksp_unroll", self.unroll)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         pct = opt.get_string(p + "pc_type")
         if pct:
@@ -223,7 +227,8 @@ class KSP:
                                  nullspace_dim=(nullspace.dim if nullspace
                                                 else 0),
                                  aug=self.lgmres_augment,
-                                 ell=self.bcgsl_ell)
+                                 ell=self.bcgsl_ell,
+                                 unroll=self.unroll)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each)
         dt = np.dtype(mat.dtype)

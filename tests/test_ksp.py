@@ -717,3 +717,56 @@ class TestDivtol:
         res = ksp.solve(bv, x)
         assert res.converged, res
         np.testing.assert_allclose(x.to_numpy(), b, rtol=1e-6)
+
+
+class TestUnroll:
+    """-ksp_unroll packs masked CG steps per loop dispatch — results and
+    iteration counts must be identical to unroll=1."""
+
+    @pytest.mark.parametrize("unroll", [2, 4, 7])
+    def test_identical_results(self, comm8, unroll):
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+
+        def run(u):
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(M)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type("jacobi")
+            ksp.set_tolerances(rtol=1e-10)
+            ksp.unroll = u
+            x, bv = M.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            return x.to_numpy(), res
+
+        x1, r1 = run(1)
+        xu, ru = run(unroll)
+        assert ru.iterations == r1.iterations
+        assert ru.reason == r1.reason
+        np.testing.assert_array_equal(xu, x1)     # bit-identical
+
+    def test_option_wiring(self, comm8):
+        tps.global_options().parse_argv(["prog", "-ksp_unroll", "6"])
+        ksp = tps.KSP().create(comm8)
+        ksp.set_from_options()
+        assert ksp.unroll == 6
+
+    def test_monitored_stays_exact(self, comm8):
+        """Monitored solves fall back to unroll=1 — one callback per step."""
+        A = poisson2d(8)
+        _, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        seen = []
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-8)
+        ksp.unroll = 4
+        ksp.set_monitor(lambda k, it, rn: seen.append(it))
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert len(seen) == res.iterations
+        assert seen == sorted(set(seen))          # each step exactly once

@@ -216,3 +216,52 @@ class TestFacadeViewer:
         assert list(raw_cols) == [0, 1, 1]     # sorted within row 0
         B = petsc_io.read_mat(p)
         assert (B != A).nnz == 0
+
+    def test_flush_keeps_cursor(self, tmp_path):
+        import os
+        import sys
+        compat = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+        if compat not in sys.path:
+            sys.path.insert(0, compat)
+        from petsc4py import PETSc
+
+        A = poisson2d(4)
+        rhs = np.random.default_rng(3).random(16)
+        m = PETSc.Mat().createAIJ(size=A.shape,
+                                  csr=(A.indptr, A.indices, A.data))
+        _, b = m.getVecs()
+        b.setArray(rhs)
+        path = str(tmp_path / "f.petsc")
+        w = PETSc.Viewer().createBinary(path, "w")
+        m.view(w)
+        w.flush()                 # must NOT truncate or rewind
+        b.view(w)
+        w.destroy()
+        r = PETSc.Viewer().createBinary(path, "r")
+        m2 = PETSc.Mat().load(r)
+        b2 = m2.getVecs()[1]
+        b2.load(r)
+        np.testing.assert_allclose(b2.array, rhs)
+
+    def test_viewer_reuse_new_path(self, tmp_path):
+        import os
+        import sys
+        compat = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+        if compat not in sys.path:
+            sys.path.insert(0, compat)
+        from petsc4py import PETSc
+
+        A = poisson2d(4)
+        m = PETSc.Mat().createAIJ(size=A.shape,
+                                  csr=(A.indptr, A.indices, A.data))
+        v = PETSc.Viewer().createBinary(str(tmp_path / "a.petsc"), "w")
+        m.view(v)
+        v.createBinary(str(tmp_path / "b.petsc"), "w")   # reuse with new path
+        m.view(v)
+        v.destroy()
+        assert (tmp_path / "a.petsc").exists()
+        assert (tmp_path / "b.petsc").exists()
+        B = petsc_io.read_mat(tmp_path / "b.petsc")
+        assert (B != A).nnz == 0
