@@ -32,7 +32,8 @@ if _plat:
 
     _jax.config.update("jax_platforms", _plat)
 
-from .parallel.mesh import DeviceComm, get_default_comm, set_default_comm, as_comm
+from .parallel.mesh import (DeviceComm, get_default_comm, set_default_comm,
+                            as_comm, init_multihost)
 from .parallel.partition import (
     RowLayout, row_partition, ownership_range, slice_csr_block,
     partition_csr, concat_csr_blocks)
@@ -50,6 +51,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "DeviceComm", "get_default_comm", "set_default_comm", "as_comm",
+    "init_multihost",
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
     "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST",

@@ -279,7 +279,7 @@ class Mat:
         if self.host_csr is not None:
             nnz = int(self.host_csr[0][-1])
         else:
-            nnz = int((np.asarray(self.ell_vals)[: self.shape[0]] != 0).sum())
+            nnz = int((self.comm.host_fetch(self.ell_vals)[: self.shape[0]] != 0).sum())
         return {
             "nnz": nnz,
             "ell_width": self.K,
@@ -318,8 +318,8 @@ class Mat:
             return np.full(self.shape[0], self._diag_value)
         if self.host_csr is not None:
             return csr_diag(*self.host_csr, self.shape[0])
-        cols = np.asarray(self.ell_cols)[: self.shape[0]]
-        vals = np.asarray(self.ell_vals)[: self.shape[0]]
+        cols = self.comm.host_fetch(self.ell_cols)[: self.shape[0]]
+        vals = self.comm.host_fetch(self.ell_vals)[: self.shape[0]]
         gidx = np.arange(self.shape[0])[:, None]
         return np.where(cols == gidx, vals, 0.0).sum(axis=1)
 
@@ -328,8 +328,8 @@ class Mat:
         if self.host_csr is not None:
             indptr, indices, data = self.host_csr
             return sp.csr_matrix((data, indices, indptr), shape=self.shape)
-        cols = np.asarray(self.ell_cols)[: self.shape[0]]
-        vals = np.asarray(self.ell_vals)[: self.shape[0]]
+        cols = self.comm.host_fetch(self.ell_cols)[: self.shape[0]]
+        vals = self.comm.host_fetch(self.ell_vals)[: self.shape[0]]
         n = self.shape[0]
         rows = np.repeat(np.arange(n), cols.shape[1])
         mask = vals.ravel() != 0

@@ -63,8 +63,7 @@ class NullSpace:
 
     def device_array(self, comm, n: int, dtype):
         """Row-sharded (k, n_pad) orthonormal basis (cached per mesh/size)."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
         key = (comm.mesh, n, str(np.dtype(dtype)))
         if self._built is not None and self._built[0] == key:
             return self._built[1]
@@ -72,8 +71,7 @@ class NullSpace:
         npad = comm.padded_size(n)
         Qp = np.zeros((Q.shape[0], npad), dtype=np.dtype(dtype))
         Qp[:, :n] = Q
-        arr = jax.device_put(
-            Qp, NamedSharding(comm.mesh, P(None, comm.axis)))
+        arr = comm.put_spec(Qp, P(None, comm.axis))
         self._built = (key, arr)
         return arr
 

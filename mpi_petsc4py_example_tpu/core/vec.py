@@ -34,8 +34,7 @@ class Vec:
         self.layout = layout or RowLayout(self.n, self.comm.size)
         if data is None:
             n_pad = self.comm.padded_size(self.n)
-            data = jax.device_put(np.zeros(n_pad, dtype=dtype),
-                                  self.comm.row_sharding)
+            data = self.comm.put_rows(np.zeros(n_pad, dtype=dtype))
         self.data = data
 
     # ---- construction ------------------------------------------------------
@@ -93,8 +92,9 @@ class Vec:
         return self.local_array(0)
 
     def to_numpy(self) -> np.ndarray:
-        """Gather to host, dropping padding — a counts-correct ``Gatherv``."""
-        return np.asarray(self.data)[: self.n].copy()
+        """Gather to host, dropping padding — a counts-correct ``Gatherv``
+        (multi-process meshes gather the remote shards over DCN)."""
+        return self.comm.host_fetch(self.data)[: self.n].copy()
 
     # ---- vector arithmetic (petsc4py-Vec-shaped; solvers use raw arrays) ---
     def norm(self, norm_type: str = "2") -> float:

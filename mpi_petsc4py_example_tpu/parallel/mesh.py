@@ -91,22 +91,58 @@ class DeviceComm:
         pad = [(0, n_pad - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
         return np.pad(arr, pad)
 
+    @property
+    def multiprocess(self) -> bool:
+        """True when the mesh spans several controller processes (DCN mode:
+        ``jax.distributed.initialize`` ran and devices belong to more than
+        one host — the reference's multi-node ``mpirun`` analog)."""
+        return jax.process_count() > 1
+
+    def _put(self, arr, sharding) -> jax.Array:
+        """SPMD data placement: every process holds the same host array (the
+        reference's replicated-driver model); single-process uses one
+        ``device_put``, multi-process builds the global array from the
+        per-process addressable pieces."""
+        if not self.multiprocess:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+
     def put_rows(self, arr, dtype=None) -> jax.Array:
         """Host array -> device array sharded on the leading (row) axis.
 
         This is the TPU-native replacement for the reference's hand-written
         scatter protocol (pickled lengths + 4 buffered ``Send``s,
         ``test.py:101-106``): one ``device_put`` with a ``NamedSharding`` and
-        the runtime moves each block to its device.
+        the runtime moves each block to its device (over PCIe/ICI; across
+        hosts each process places only its addressable shards).
         """
         arr = np.asarray(arr, dtype=dtype)
         arr = self.pad_rows(arr)
-        return jax.device_put(arr, self.row_sharding)
+        return self._put(arr, self.row_sharding)
+
+    def put_axis0(self, arr, dtype=None) -> jax.Array:
+        """Axis-0 sharding WITHOUT row padding (pre-shaped block stacks)."""
+        return self._put(np.asarray(arr, dtype=dtype), self.row_sharding)
 
     def put_replicated(self, arr, dtype=None) -> jax.Array:
         """Host array -> replicated device array (the analog of ``bcast``)."""
-        return jax.device_put(np.asarray(arr, dtype=dtype),
-                              self.replicated_sharding)
+        return self._put(np.asarray(arr, dtype=dtype),
+                         self.replicated_sharding)
+
+    def put_spec(self, arr, spec: P, dtype=None) -> jax.Array:
+        """Host array -> device array with an arbitrary PartitionSpec."""
+        return self._put(np.asarray(arr, dtype=dtype),
+                         NamedSharding(self.mesh, spec))
+
+    def host_fetch(self, x) -> np.ndarray:
+        """Device array -> full host copy on EVERY process (the
+        counts-correct ``Gatherv``+``bcast``). Single-process is one D2H
+        copy; multi-process gathers the remote shards over DCN."""
+        if not self.multiprocess or getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
     # ---- collective helpers (usable INSIDE shard_map) ----------------------
     def psum(self, x):
@@ -157,6 +193,25 @@ def full_vector_local_apply(fn, comm: DeviceComm, n: int):
         return lax.dynamic_slice_in_dim(ypad, i * lsize, lsize)
 
     return apply
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int, **kw) -> DeviceComm:
+    """Join a multi-controller job and return the global communicator.
+
+    The DCN analog of launching under ``mpirun -n N`` across nodes
+    (reference L1, SURVEY.md §5.8): every controller process calls this with
+    the same coordinator address; afterwards ``jax.devices()`` spans all
+    hosts and the returned :class:`DeviceComm` is the global 1-D mesh.
+    Collectives inside compiled solver programs ride ICI within a host/pod
+    and DCN across — placement is unchanged framework code either way.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kw)
+    comm = DeviceComm()
+    set_default_comm(comm)
+    return comm
 
 
 _default_comm: DeviceComm | None = None

@@ -672,7 +672,7 @@ class EPS:
             S_pad[:, :k] = S_keep
             V = restart_prog(V, S_pad, np.asarray(k, dtype=np.int32))
 
-        Vh = np.asarray(V)[:ncv]
+        Vh = comm.host_fetch(V)[:ncv]
         count = max(nev, 1)
         lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
         self._store(lam, vecs, rel[:count], nconv, restarts)
@@ -706,7 +706,7 @@ class EPS:
             wanted = S[:, order[:nev]].real.sum(axis=1).astype(dtype)
             V = restart_prog(V, wanted)
 
-        Vh = np.asarray(V)[:ncv]
+        Vh = comm.host_fetch(V)[:ncv]
         count = max(nev, 1)
         lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
         self._store(lam, vecs, rel[:count], nconv, restarts)
@@ -738,7 +738,7 @@ class EPS:
                 break
 
         lam = self.st.back_transform(np.asarray([theta]))
-        vec = np.asarray(v)[:n]
+        vec = comm.host_fetch(v)[:n]
         nrm = np.linalg.norm(vec)
         vec = vec / (nrm if nrm else 1.0)
         self._store(lam, vec[None, :], [rel], 1 if rel <= self.tol else 0,
@@ -765,8 +765,6 @@ class EPS:
         op_arrays = op.device_arrays()
         dtype = np.dtype(str(op.dtype))
         npad = comm.padded_size(n)
-        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
-
         rng = np.random.default_rng(20240901)
         Y = rng.standard_normal((ncv, npad)).astype(dtype)
         Y[:, n:] = 0.0
@@ -775,7 +773,7 @@ class EPS:
             Q = np.linalg.qr(Y[:, :n].T)[0].T        # (ncv, n) orthonormal rows
             Qp = np.zeros((ncv, npad), dtype=dtype)
             Qp[:, :n] = Q
-            W = np.asarray(prog(op_arrays, jax.device_put(Qp, sharding)))
+            W = comm.host_fetch(prog(op_arrays, comm.put_spec(Qp, P(None, comm.axis))))
             Hm = Q @ W[:, :n].T           # Hm[i,j] = <q_i, A q_j>, W[j] = A q_j
             if hermitian:
                 Hm = (Hm + Hm.T) / 2.0
@@ -849,13 +847,13 @@ class EPS:
         op_arrays = op.device_arrays()
         dtype = np.dtype(str(op.dtype))
         npad = comm.padded_size(n)
-        sharding = jax.sharding.NamedSharding(comm.mesh, P(None, comm.axis))
 
         def block_apply(which_prog, arrays, M_host):
             """Host (m, n) block -> device block program -> host (m, n)."""
             Mp = np.zeros((m, npad), dtype=dtype)
             Mp[:, :n] = M_host
-            out = np.asarray(which_prog(arrays, jax.device_put(Mp, sharding)))
+            out = comm.host_fetch(
+                which_prog(arrays, comm.put_spec(Mp, P(None, comm.axis))))
             return out[:, :n].astype(np.float64)
 
         A_apply = lambda Mh: block_apply(prog, op_arrays, Mh)

@@ -541,9 +541,7 @@ def _local_dense_blocks(comm: DeviceComm, mat: Mat, pc_name: str):
 
 
 def _ship_blocks(comm: DeviceComm, blocks: np.ndarray, dtype):
-    return (jax.device_put(
-        blocks.astype(dtype),
-        jax.sharding.NamedSharding(comm.mesh, P(comm.axis))),)
+    return (comm.put_axis0(blocks.astype(dtype)),)
 
 
 def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):

@@ -1,0 +1,106 @@
+"""Multi-host (multi-controller) execution over a process-spanning mesh.
+
+The reference scales across nodes with ``mpirun`` over MPI/DCN; the TPU-native
+analog is ``jax.distributed.initialize`` + a global ``Mesh`` whose devices
+live in several controller processes (SURVEY.md §5.8). JAX's CPU backend
+supports real multi-process coordination on one machine, so this launches two
+controller processes with 4 virtual devices each (8-device global mesh) and
+runs a distributed KSP solve end-to-end — the framework's analog of the
+reference's oversubscribed multi-node test (SURVEY.md §4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(coordinator_address=sys.argv[1],
+                               num_processes=2,
+                               process_id=int(sys.argv[2]))
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, jax.devices()
+
+    import numpy as np
+    import scipy.sparse as sp
+    sys.path.insert(0, {repo!r})
+    import mpi_petsc4py_example_tpu as tps
+
+    comm = tps.DeviceComm()
+    assert comm.size == 8 and comm.multiprocess
+
+    nx = 8
+    T = sp.diags([-np.ones(nx - 1), 2 * np.ones(nx), -np.ones(nx - 1)],
+                 [-1, 0, 1])
+    A = (sp.kron(sp.eye(nx), T) + sp.kron(T, sp.eye(nx))).tocsr()
+    x_true = np.random.default_rng(0).random(nx * nx)   # same seed everywhere
+    b = A @ x_true
+
+    M = tps.Mat.from_scipy(comm, A)
+    for pc_type in ("jacobi", "bjacobi"):
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type(pc_type)
+        ksp.set_tolerances(rtol=1e-10)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged, (pc_type, res)
+        err = np.abs(x.to_numpy() - x_true).max()
+        assert err < 1e-7, (pc_type, err)
+
+    # eigensolve across the process-spanning mesh (test2.py analog)
+    eps = tps.EPS().create(comm)
+    eps.set_operators(M)
+    eps.set_problem_type("hep")
+    eps.set_dimensions(nev=2)
+    eps.solve()
+    assert eps.get_converged() >= 2
+    lam_max = np.sort(np.linalg.eigvalsh(A.toarray()))[-1]
+    got = abs(eps.get_eigenvalue(0))
+    assert abs(got - lam_max) < 1e-6 * lam_max, (got, lam_max)
+    print(f"MULTIHOST-OK p{{int(sys.argv[2])}}", flush=True)
+""").format(repo=REPO)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_solve(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    coord = f"localhost:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=550)
+            outs.append(out)
+    finally:
+        for p in procs:        # a hung worker must not outlive the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"MULTIHOST-OK p{pid}" in out, out
