@@ -447,6 +447,13 @@ class PC:
 class KSP:
     """Krylov solver handle (fronts solvers.ksp.KSP)."""
 
+    class NormType:
+        DEFAULT = -1
+        NONE = 0
+        PRECONDITIONED = 1
+        UNPRECONDITIONED = 2
+        NATURAL = 3
+
     def __init__(self):
         self._core = _tps.KSP()
         self._comm = None
@@ -477,6 +484,12 @@ class KSP:
 
     def setInitialGuessNonzero(self, flag):
         self._core.set_initial_guess_nonzero(flag)
+
+    def setNormType(self, norm_type):
+        self._core.set_norm_type(norm_type)
+
+    def getNormType(self):
+        return self._core.get_norm_type()
 
     def setFromOptions(self):
         self._core.set_from_options()

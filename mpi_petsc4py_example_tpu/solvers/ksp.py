@@ -56,6 +56,7 @@ class KSP:
         self.unroll = 4               # -ksp_unroll: masked steps per loop
                                       # dispatch (amortizes per-iteration
                                       # runtime overhead; results identical)
+        self._norm_type = "default"   # -ksp_norm_type (KSPSetNormType)
         self._monitors = []
         self._monitor_flag = False
         self._initial_guess_nonzero = False
@@ -128,6 +129,70 @@ class KSP:
 
     setInitialGuessNonzero = set_initial_guess_nonzero
 
+    # Which residual norm each kernel's convergence test monitors. PETSc's
+    # KSPSetNormType switches this per solver; here each kernel has one
+    # fixed monitoring norm (fused into its compiled recurrence), so setting
+    # a matching type is a no-op, 'none' disables the test entirely
+    # (KSP_NORM_NONE: fixed max_it iterations, reason CONVERGED_ITS — the
+    # smoother configuration), and a mismatched type raises.
+    _KERNEL_NORMS = {
+        "gmres": "preconditioned", "lgmres": "preconditioned",
+        "cr": "preconditioned", "symmlq": "unpreconditioned",
+        "preonly": "none",
+    }
+
+    # petsc4py's integer KSP.NormType enum values
+    _NORM_BY_INT = {-1: "default", 0: "none", 1: "preconditioned",
+                    2: "unpreconditioned", 3: "natural"}
+
+    def set_norm_type(self, norm_type):
+        if isinstance(norm_type, (int, np.integer)):
+            norm_type = self._NORM_BY_INT.get(int(norm_type), norm_type)
+        t = str(norm_type).lower().replace("ksp_norm_", "")
+        if t == "natural":
+            raise ValueError(
+                "norm type 'natural' is not provided — kernels monitor the "
+                "preconditioned or unpreconditioned residual norm "
+                "(see KSP._KERNEL_NORMS); use 'default'")
+        if t not in ("default", "none", "preconditioned", "unpreconditioned"):
+            raise ValueError(f"unknown norm type {norm_type!r}")
+        self._norm_type = t
+        return self
+
+    setNormType = set_norm_type
+
+    def get_norm_type(self) -> str:
+        if self._norm_type != "default":
+            return self._norm_type
+        return self._KERNEL_NORMS.get(self._type, "unpreconditioned")
+
+    getNormType = get_norm_type
+
+    # restarted solvers advance the counter a full cycle at a time — a
+    # fixed-iteration contract can't hold for them (PETSc's KSPSetNormType
+    # likewise rejects unsupported combinations)
+    _CYCLE_GRANULAR = ("gmres", "fgmres", "lgmres")
+
+    def _check_norm_type(self):
+        t = self._norm_type
+        if t == "default":
+            return
+        if t == "none":
+            if self._type in self._CYCLE_GRANULAR:
+                raise ValueError(
+                    f"norm type 'none' is unavailable for restarted KSP "
+                    f"{self._type!r} (iterations advance a whole restart "
+                    "cycle at a time); use richardson/chebyshev/cg for "
+                    "fixed-iteration smoothing")
+            return
+        have = self._KERNEL_NORMS.get(self._type, "unpreconditioned")
+        if t != have:
+            raise ValueError(
+                f"KSP {self._type!r} monitors the {have} residual norm "
+                f"(fused into its compiled recurrence); norm type {t!r} is "
+                "not available for it — use 'default', 'none', or a solver "
+                "whose monitoring norm matches")
+
     def set_options_prefix(self, prefix: str):
         self._prefix = prefix or ""
         return self
@@ -157,6 +222,9 @@ class KSP:
                                           self.lgmres_augment)
         self.bcgsl_ell = opt.get_int(p + "ksp_bcgsl_ell", self.bcgsl_ell)
         self.unroll = opt.get_int(p + "ksp_unroll", self.unroll)
+        nt = opt.get_string(p + "ksp_norm_type")
+        if nt:
+            self.set_norm_type(nt)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         pct = opt.get_string(p + "pc_type")
         if pct:
@@ -201,9 +269,18 @@ class KSP:
         mat = self._mat
         if mat is None:
             raise RuntimeError("KSP.solve: no operators set")
+        self._check_norm_type()
         self.set_up()
         comm = mat.comm
         pc = self.get_pc()
+        # KSP_NORM_NONE: neutralize the convergence test — max_it iterations,
+        # reason CONVERGED_ITS (the smoother configuration). The monitored
+        # norm is still computed in-program (eliding it entirely would need a
+        # per-kernel compile variant); only the exit condition is disabled.
+        norm_none = self._norm_type == "none" and self._type != "preonly"
+        rtol, atol, divtol = self.rtol, self.atol, self.divtol
+        if norm_none:
+            rtol, atol, divtol = 0.0, 0.0, 0.0
 
         monitor_cb = None
         if self._monitors or self._monitor_flag:
@@ -240,8 +317,8 @@ class KSP:
             xd, iters, rnorm, reason = prog(
                 mat.device_arrays(), pc.device_arrays(), *ns_args,
                 b.data, x.data,
-                dt.type(self.rtol), dt.type(self.atol),
-                dt.type(self.divtol), np.int32(self.max_it))
+                dt.type(rtol), dt.type(atol),
+                dt.type(divtol), np.int32(self.max_it))
             # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
             # int()/float() per scalar would pay it three times)
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
@@ -249,6 +326,12 @@ class KSP:
             set_current_monitor(None)
         wall = time.perf_counter() - t0
         x.data = xd
+        # breakdown stays visible (PETSc's NORM_NONE does not mask it);
+        # every other exit is the fixed-iteration contract. An exactly-zero
+        # residual (b = 0) still exits immediately — running further steps
+        # on a zero vector is a no-op.
+        if norm_none and int(reason) != ConvergedReason.DIVERGED_BREAKDOWN:
+            reason = ConvergedReason.CONVERGED_ITS
         self.result = SolveResult(int(iters), float(rnorm), int(reason), wall)
         from ..utils.profiling import record_event
         record_event(f"KSPSolve({self._type}+{pc.get_type()})", mat.shape[0],

@@ -770,3 +770,109 @@ class TestUnroll:
         res = ksp.solve(bv, x)
         assert len(seen) == res.iterations
         assert seen == sorted(set(seen))          # each step exactly once
+
+
+class TestNormType:
+    """KSPSetNormType: 'none' disables the convergence test (smoother mode);
+    mismatched types raise rather than silently mislabeling the monitor."""
+
+    def test_none_runs_fixed_iterations(self, comm8):
+        A = poisson2d(10)
+        _, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_norm_type("none")
+        ksp.set_tolerances(rtol=1e-10, max_it=7)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.iterations == 7
+        assert res.reason == tps.ConvergedReason.CONVERGED_ITS
+        assert res.converged
+
+    def test_none_as_smoother_reduces_residual(self, comm8):
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("richardson")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_norm_type("none")
+        ksp.set_tolerances(max_it=5)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        r = np.linalg.norm(b - A @ x.to_numpy())
+        assert r < np.linalg.norm(b)          # smoothing happened
+
+    def test_matching_type_accepted(self, comm8):
+        ksp = tps.KSP().create(comm8)
+        ksp.set_type("gmres")
+        ksp.set_norm_type("preconditioned")
+        ksp.set_operators(tps.Mat.from_scipy(comm8, poisson2d(4)))
+        ksp._check_norm_type()                # no raise
+        assert ksp.get_norm_type() == "preconditioned"
+
+    def test_mismatched_type_raises(self, comm8):
+        A = poisson2d(4)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("gmres")
+        ksp.set_norm_type("unpreconditioned")
+        x, bv = M.get_vecs()
+        with pytest.raises(ValueError, match="monitors the preconditioned"):
+            ksp.solve(bv, x)
+
+    def test_option_wiring(self, comm8):
+        tps.global_options().parse_argv(["prog", "-ksp_norm_type", "none"])
+        ksp = tps.KSP().create(comm8)
+        ksp.set_from_options()
+        assert ksp.get_norm_type() == "none"
+
+    def test_default_reporting(self):
+        assert tps.KSP().set_type("cg").get_norm_type() == "unpreconditioned"
+        assert tps.KSP().set_type("gmres").get_norm_type() == "preconditioned"
+
+    def test_restarted_rejects_none(self, comm8):
+        M = tps.Mat.from_scipy(comm8, poisson2d(4))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("gmres")
+        ksp.set_norm_type("none")
+        x, bv = M.get_vecs()
+        with pytest.raises(ValueError, match="restarted"):
+            ksp.solve(bv, x)
+
+    def test_natural_rejected_at_set(self):
+        with pytest.raises(ValueError, match="natural"):
+            tps.KSP().set_norm_type("natural")
+
+    def test_integer_enum_accepted(self):
+        ksp = tps.KSP()
+        ksp.set_norm_type(0)                      # petsc4py NormType.NONE
+        assert ksp.get_norm_type() == "none"
+        ksp.set_norm_type(2)
+        assert ksp._norm_type == "unpreconditioned"
+
+    def test_breakdown_stays_visible_under_none(self, comm8):
+        """NORM_NONE must not mask a genuine CG breakdown."""
+        A = sp.diags([1.0] * 8 + [-1.0] * 8).tocsr()   # indefinite
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_norm_type("none")
+        ksp.set_tolerances(max_it=50)
+        x, bv = M.get_vecs()
+        b = np.ones(16)
+        b[8:] = 1.0
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        # on this matrix CG either breaks down (visible) or completes ITS
+        assert res.reason in (tps.ConvergedReason.CONVERGED_ITS,
+                              tps.ConvergedReason.DIVERGED_BREAKDOWN)
