@@ -105,6 +105,32 @@ class Vec:
         return Vec(self._core.duplicate(), self._layout, self._rank,
                    self._comm)
 
+    def copy(self, other=None):
+        if other is None:
+            return Vec(self._core.copy(), self._layout, self._rank,
+                       self._comm)
+
+        def build(_):
+            other._core.data = self._core.data   # immutable jax array: free
+            return True
+        self._comm._collective("vec_copy", None, build)
+        return other
+
+    def dot(self, other):
+        return self._core.dot(other._core)
+
+    def scale(self, alpha):
+        def build(_):
+            self._core.scale(alpha)
+            return True
+        self._comm._collective("vec_scale", (float(alpha),), build)
+
+    def axpy(self, alpha, other):
+        def build(_):
+            self._core.axpy(alpha, other._core)
+            return True
+        self._comm._collective("vec_axpy", (float(alpha),), build)
+
     def view(self, viewer=None):
         """Dump to a binary Viewer (VecView) or print a summary."""
         if isinstance(viewer, Viewer):
@@ -255,6 +281,12 @@ class Mat:
             self._core.mult(x.core, y.core)
             return True
         self._comm._collective("mat_mult", None, build)
+
+    def multTranspose(self, x: Vec, y: Vec):
+        def build(_):
+            self._core.mult_transpose(x.core, y.core)
+            return True
+        self._comm._collective("mat_mult_t", None, build)
 
     def view(self, viewer=None):
         """Print a summary, or dump to a binary Viewer (MatView)."""

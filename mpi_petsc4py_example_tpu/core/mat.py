@@ -312,6 +312,20 @@ class Mat:
             y.data = ypad
         return y
 
+    def mult_transpose(self, x: Vec, y: Vec | None = None) -> Vec:
+        """``y = Aᵀ x`` (PETSc MatMultTranspose) via the distributed
+        transpose-SpMV program (scatter-psum, the reverse pattern of the
+        all-gather forward product)."""
+        prog = _mult_t_program(self)
+        ypad = prog(self.device_arrays(), x.data)
+        if y is None:
+            return Vec(self.comm, self.shape[0], data=ypad,
+                       layout=self.layout)
+        y.data = ypad
+        return y
+
+    multTranspose = mult_transpose
+
     def diagonal(self) -> np.ndarray:
         """Host-side global diagonal (for Jacobi preconditioning)."""
         if self._diag_value is not None:
@@ -439,6 +453,25 @@ class Mat:
     def __repr__(self):
         return (f"Mat(shape={self.shape}, K={self.K}, "
                 f"devices={self.comm.size}, dtype={self.dtype})")
+
+
+_MULT_T_CACHE: dict = {}
+
+
+def _mult_t_program(mat: Mat):
+    """Cached jitted shard_map program for the transpose product."""
+    from jax.sharding import PartitionSpec as P
+    comm = mat.comm
+    key = (comm.mesh, mat.program_key(), mat.shape, str(mat.dtype))
+    prog = _MULT_T_CACHE.get(key)
+    if prog is None:
+        spmv_t = mat.local_spmv_t(comm)
+        axis = comm.axis
+        prog = jax.jit(comm.shard_map(
+            spmv_t, in_specs=(mat.op_specs(axis), P(axis)),
+            out_specs=P(axis)))
+        _MULT_T_CACHE[key] = prog
+    return prog
 
 
 @jax.jit

@@ -68,6 +68,7 @@ class ShellMat:
         self.layout = RowLayout(self.shape[0], self.comm.size)
         self._key = ("shellmat", next(_uid))
         self._jit_mult = jax.jit(mult)   # host-level apply, compiled once
+        self._jit_mult_t = None          # lazily jitted on first use
 
     # ---- Mat-shaped conveniences -------------------------------------------
     def get_vecs(self) -> tuple[Vec, Vec]:
@@ -93,6 +94,23 @@ class ShellMat:
             return Vec.from_global(self.comm, yh, dtype=self.dtype)
         y.set_global(yh)
         return y
+
+    def mult_transpose(self, x: Vec, y: Vec | None = None) -> Vec:
+        """Host-level transpose apply (MatMultTranspose for shell operators)."""
+        if self._mult_t is None:
+            raise ValueError(
+                "this ShellMat provides no mult_transpose — pass it at "
+                "construction")
+        if self._jit_mult_t is None:
+            self._jit_mult_t = jax.jit(self._mult_t)
+        xh = jnp.asarray(x.to_numpy(), dtype=self.dtype)
+        yh = np.asarray(self._jit_mult_t(xh))
+        if y is None:
+            return Vec.from_global(self.comm, yh, dtype=self.dtype)
+        y.set_global(yh)
+        return y
+
+    multTranspose = mult_transpose
 
     # ---- linear-operator protocol (consumed by solvers.krylov/eps) ----------
     def device_arrays(self):

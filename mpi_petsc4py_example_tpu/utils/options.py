@@ -25,6 +25,7 @@ class Options:
 
     def __init__(self):
         self._db: dict[str, str] = {}
+        self._queried: set[str] = set()
         self.load_env()
 
     # ---- population --------------------------------------------------------
@@ -76,11 +77,16 @@ class Options:
     def clear(self, key: str | None = None):
         if key is None:
             self._db.clear()
+            self._queried.clear()
         else:
-            self._db.pop(key.lstrip("-"), None)
+            key = key.lstrip("-")
+            self._db.pop(key, None)
+            self._queried.discard(key)   # deletion drops the used-mark too
 
     def get(self, key: str, default=None):
-        return self._db.get(key.lstrip("-"), default)
+        key = key.lstrip("-")
+        self._queried.add(key)
+        return self._db.get(key, default)
 
     def get_string(self, key: str, default: str | None = None):
         return self.get(key, default)
@@ -100,10 +106,22 @@ class Options:
         return str(v).lower() not in ("0", "false", "no", "off")
 
     def has(self, key: str) -> bool:
-        return key.lstrip("-") in self._db
+        key = key.lstrip("-")
+        self._queried.add(key)      # a presence check is a use (PETSc too)
+        return key in self._db
 
     def as_dict(self) -> dict:
         return dict(self._db)
+
+    def unused(self) -> list[str]:
+        """Options set but never queried — PETSc's ``-options_left`` report.
+
+        Typo'd flags (``-kps_type``) silently change nothing; this surfaces
+        them. ``set_from_options`` queries every key a solver understands, so
+        anything left is either misspelled or aimed at an object that never
+        consulted the database.
+        """
+        return sorted(k for k in self._db if k not in self._queried)
 
     def __repr__(self):
         return f"Options({self._db})"

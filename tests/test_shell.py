@@ -333,3 +333,16 @@ class TestFacadeShell:
         ksp.setTolerances(rtol=1e-10)
         ksp.solve(b, x)
         np.testing.assert_allclose(x.array, x_true, rtol=1e-7, atol=1e-9)
+
+    def test_mult_transpose_host_level(self, comm8):
+        A = poisson2d(6) + sp.diags(np.arange(36.0))
+        A = A.tocsr()
+        S = shell_from_scipy(comm8, A)
+        x = np.random.default_rng(3).random(36)
+        y = S.mult_transpose(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_allclose(y, A.T @ x, rtol=1e-12)
+
+    def test_mult_transpose_missing_raises(self, comm1):
+        S = tps.ShellMat(comm1, 8, lambda v: 2.0 * v)
+        with pytest.raises(ValueError, match="mult_transpose"):
+            S.mult_transpose(tps.Vec.from_global(comm1, np.ones(8)))

@@ -323,3 +323,43 @@ class TestMutationInvalidatesPC:
         res = ksp.solve(b, x)
         assert res.converged
         np.testing.assert_allclose(x.to_numpy(), np.ones(12), rtol=1e-10)
+
+
+class TestMultTranspose:
+    def test_matches_scipy(self, comm):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(5)
+        A = sp.random(40, 40, density=0.15, random_state=rng).tocsr()
+        M = tps.Mat.from_scipy(comm, A)
+        x = rng.random(40)
+        y = M.mult_transpose(tps.Vec.from_global(comm, x)).to_numpy()
+        np.testing.assert_allclose(y, A.T @ x, rtol=1e-12)
+
+    def test_banded_dia_path(self, comm8):
+        import scipy.sparse as sp
+        n = 48
+        A = sp.diags([np.arange(1, n), 2 + np.arange(n, dtype=float),
+                      3 * np.ones(n - 1)], [-1, 0, 1]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is not None        # DIA layout selected
+        x = np.random.default_rng(0).random(n)
+        y = M.mult_transpose(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_allclose(y, A.T @ x, rtol=1e-12)
+
+
+class TestOptionsLeft:
+    def test_unused_reported(self):
+        opt = tps.global_options()
+        opt.set("kps_type", "cg")            # typo — never consulted
+        opt.set("ksp_rtol", "1e-8")
+        ksp = tps.KSP()
+        ksp.set_from_options()               # queries every ksp_* key
+        left = opt.unused()
+        assert "kps_type" in left
+        assert "ksp_rtol" not in left
+
+    def test_clear_resets(self):
+        opt = tps.global_options()
+        opt.set("zzz", 1)
+        opt.clear()
+        assert opt.unused() == []
