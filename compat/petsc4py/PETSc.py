@@ -110,6 +110,11 @@ class Vec:
             return Vec(self._core.copy(), self._layout, self._rank,
                        self._comm)
 
+        if other._core.n != self._core.n:
+            raise ValueError(
+                f"Vec.copy size mismatch: {self._core.n} vs "
+                f"{other._core.n} (petsc4py errors on this too)")
+
         def build(_):
             other._core.data = self._core.data   # immutable jax array: free
             return True

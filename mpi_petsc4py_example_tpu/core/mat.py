@@ -370,6 +370,33 @@ class Mat:
             offsets = self.dia_offsets
             halo = max(abs(o) for o in offsets) if offsets else 0
             lsize = comm.local_size(self.shape[0])
+            ndev = comm.size
+
+            if ndev > 1 and 0 < halo <= lsize:
+                # scalable banded path: every occupied diagonal reaches at
+                # most one neighbour shard, so the VecScatter is a ring
+                # ppermute of `halo` boundary rows each way — O(halo) bytes
+                # on the ICI instead of replicating the whole vector
+                # (SURVEY.md §7.4-3: the all_gather fallback bounds scaling)
+                # open chain, not a ring: shards with no incoming pair
+                # (the global edges) receive zeros from ppermute itself —
+                # no wrap transfer, no masking needed
+                fwd = [(i, i + 1) for i in range(ndev - 1)]
+                bwd = [(i, i - 1) for i in range(1, ndev)]
+
+                def spmv(op_local, x_local):
+                    (dia,) = op_local
+                    left = lax.ppermute(x_local[-halo:], axis, fwd)
+                    right = lax.ppermute(x_local[:halo], axis, bwd)
+                    ext = jnp.concatenate([left, x_local, right])
+                    y = jnp.zeros(lsize, dia.dtype)
+                    for d, off in enumerate(offsets):
+                        seg = lax.slice_in_dim(ext, halo + int(off),
+                                               halo + int(off) + lsize)
+                        y = y + dia[:, d] * seg
+                    return y
+
+                return spmv
 
             def spmv(op_local, x_local):
                 (dia,) = op_local

@@ -84,3 +84,72 @@ class TestDiaSpmv:
         target = lam_exact[np.argmax(np.abs(lam_exact))]
         np.testing.assert_allclose(E.get_eigenvalue(0).real, target,
                                    rtol=1e-7)
+
+
+class TestPpermuteHaloPath:
+    """Banded SpMV with halo <= lsize rides a ring ppermute of boundary rows
+    instead of an all_gather (the scalable VecScatter, SURVEY.md §7.4-3)."""
+
+    def test_band_crossing_shards(self, comm8):
+        n = 96                      # lsize 12, band ±3 crosses every boundary
+        rng = np.random.default_rng(4)
+        A = sp.diags([rng.random(n - 3), rng.random(n - 1),
+                      2 + rng.random(n), rng.random(n - 1),
+                      rng.random(n - 3)], [-3, -1, 0, 1, 3]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is not None
+        halo = max(abs(o) for o in M.dia_offsets)
+        assert 0 < halo <= comm8.local_size(n)   # ppermute path active
+        x_true = rng.random(n)
+        b = A @ x_true
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("bcgs")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-11, max_it=2000)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
+
+    def test_uneven_padding(self, comm):
+        """n not divisible by the device count: padding rows at the global
+        end must stay inert through the halo exchange."""
+        n = 50
+        A = sp.diags([-np.ones(n - 2), 2 * np.ones(n), -np.ones(n - 2)],
+                     [-2, 0, 2]).tocsr()
+        M = tps.Mat.from_scipy(comm, A)
+        x_true = np.random.default_rng(1).random(n)
+        b = A @ x_true
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-11)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
+
+    def test_wide_band_falls_back_to_allgather(self, comm8):
+        n = 64                      # lsize 8; band ±16 exceeds it
+        A = (sp.eye(n) * 4 + sp.diags([np.ones(n - 16)] * 2,
+                                      [-16, 16])).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        halo = max(abs(o) for o in M.dia_offsets)
+        assert halo > comm8.local_size(n)        # all_gather fallback
+        x_true = np.random.default_rng(2).random(n)
+        b = A @ x_true
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-11)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7,
+                                   atol=1e-9)
