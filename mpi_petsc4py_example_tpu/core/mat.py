@@ -433,13 +433,10 @@ class Mat:
         if self.dia_vals is not None:
             offsets = self.dia_offsets
             halo = max(abs(o) for o in offsets) if offsets else 0
+            ndev = comm.size
 
-            def spmv_t(op_local, x_local):
-                (dia,) = op_local
-                row0 = lax.axis_index(axis) * lsize
-                # all offsets land inside one local window — accumulate
-                # there with static starts, then one dynamic write into the
-                # global buffer
+            def accumulate_window(dia, x_local):
+                """Local rows' contributions over the ±halo column window."""
                 win = jnp.zeros(lsize + 2 * halo, dia.dtype)
                 for d, off in enumerate(offsets):
                     win = lax.dynamic_update_slice_in_dim(
@@ -447,6 +444,33 @@ class Mat:
                         lax.dynamic_slice_in_dim(win, int(off) + halo, lsize)
                         + dia[:, d] * x_local,
                         int(off) + halo, axis=0)
+                return win
+
+            if ndev > 1 and 0 < halo <= lsize:
+                # open-chain spill exchange: a shard's contributions reach at
+                # most one neighbour each way, so ship the two halo spills
+                # over ppermute instead of psum-ing an O(n) buffer
+                fwd = [(i, i + 1) for i in range(ndev - 1)]
+                bwd = [(i, i - 1) for i in range(1, ndev)]
+
+                def spmv_t(op_local, x_local):
+                    (dia,) = op_local
+                    win = accumulate_window(dia, x_local)
+                    spill_l = win[:halo]           # belongs to rank i-1
+                    spill_r = win[halo + lsize:]   # belongs to rank i+1
+                    from_left = lax.ppermute(spill_r, axis, fwd)
+                    from_right = lax.ppermute(spill_l, axis, bwd)
+                    y = win[halo:halo + lsize]
+                    y = y.at[:halo].add(from_left)
+                    y = y.at[lsize - halo:].add(from_right)
+                    return y
+
+                return spmv_t
+
+            def spmv_t(op_local, x_local):
+                (dia,) = op_local
+                row0 = lax.axis_index(axis) * lsize
+                win = accumulate_window(dia, x_local)
                 buf = jnp.zeros(n_pad + 2 * halo, dia.dtype)
                 buf = lax.dynamic_update_slice_in_dim(buf, win, row0, axis=0)
                 buf = lax.psum(buf, axis)
