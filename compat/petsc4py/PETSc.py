@@ -561,6 +561,9 @@ class KSP:
     def getConvergedReason(self):
         return self._core.get_converged_reason()
 
+    def getTolerances(self):
+        return self._core.get_tolerances()
+
     def setMonitor(self, cb):
         self._core.set_monitor(cb)
 

@@ -128,6 +128,14 @@ class EPS:
     def getErrorEstimate(self, i):
         return self._core.get_error_estimate(i)
 
+    def getDimensions(self):
+        """(nev, ncv, mpd) — the slepc4py 3-tuple (mpd tracks ncv here)."""
+        nev, ncv = self._core.get_dimensions()
+        return (nev, ncv, ncv)
+
+    def getTolerances(self):
+        return self._core.get_tolerances()
+
     class ErrorType:
         ABSOLUTE = "absolute"
         RELATIVE = "relative"

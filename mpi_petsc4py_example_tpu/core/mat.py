@@ -446,10 +446,19 @@ class Mat:
                         int(off) + halo, axis=0)
                 return win
 
-            if ndev > 1 and 0 < halo <= lsize:
+            if halo == 0:
+                # purely diagonal: the transpose product is entirely local
+                def spmv_t(op_local, x_local):
+                    (dia,) = op_local
+                    return dia[:, 0] * x_local
+
+                return spmv_t
+
+            if halo <= lsize:
                 # open-chain spill exchange: a shard's contributions reach at
                 # most one neighbour each way, so ship the two halo spills
-                # over ppermute instead of psum-ing an O(n) buffer
+                # over ppermute instead of psum-ing an O(n) buffer (an empty
+                # chain on a 1-device mesh zero-fills both spills)
                 fwd = [(i, i + 1) for i in range(ndev - 1)]
                 bwd = [(i, i - 1) for i in range(1, ndev)]
 

@@ -947,6 +947,23 @@ class EPS:
 
     getIterationNumber = get_iteration_number
 
+    def get_dimensions(self):
+        """(nev, ncv) — slepc4py's getDimensions, ncv resolved from the
+        auto rule when unset (never None, like slepc4py)."""
+        if self.ncv is not None:
+            return (self.nev, self.ncv)
+        if self._mat is not None:
+            return (self.nev, self._effective_ncv(self._mat.shape[0]))
+        return (self.nev, max(2 * self.nev, self.nev + 15))
+
+    getDimensions = get_dimensions
+
+    def get_tolerances(self):
+        """(tol, max_it) — slepc4py's getTolerances."""
+        return (self.tol, self.max_it)
+
+    getTolerances = get_tolerances
+
     def get_eigenvalue(self, i: int):
         lam = self._eigenvalues[i]
         return complex(lam)

@@ -354,6 +354,22 @@ class KSP:
 
     getConvergedReason = get_converged_reason
 
+    def get_tolerances(self):
+        """(rtol, atol, divtol, max_it) — petsc4py's getTolerances."""
+        return (self.rtol, self.atol, self.divtol, self.max_it)
+
+    getTolerances = get_tolerances
+
+    def get_operators(self):
+        """(A, P) — the operator and the preconditioning matrix.
+
+        Raises before ``set_operators``, like petsc4py."""
+        if self._mat is None:
+            raise RuntimeError("KSP.get_operators: no operators set")
+        return (self._mat, self.get_pc()._mat)
+
+    getOperators = get_operators
+
     def view(self, file=None):
         """Print the solver configuration (-ksp_view analog)."""
         import sys

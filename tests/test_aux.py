@@ -94,3 +94,35 @@ class TestOptionsParsing:
         monkeypatch.setenv("TPU_SOLVE_KSP_TYPE", "bcgs")
         o = Options()
         assert o.get_string("ksp_type") == "bcgs"
+
+
+class TestGetters:
+    def test_ksp_tolerances_operators(self, comm8):
+        import scipy.sparse as sp
+        A = sp.eye(10, format="csr")
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_tolerances(rtol=1e-7, atol=1e-40, divtol=1e4, max_it=77)
+        assert ksp.get_tolerances() == (1e-7, 1e-40, 1e4, 77)
+        Aop, Pop = ksp.get_operators()
+        assert Aop is M and Pop is M
+
+    def test_eps_dimensions_tolerances(self, comm8):
+        eps = tps.EPS().create(comm8)
+        eps.set_dimensions(nev=3, ncv=12)
+        eps.set_tolerances(tol=1e-6, max_it=55)
+        assert eps.get_dimensions() == (3, 12)
+        assert eps.get_tolerances() == (1e-6, 55)
+
+    def test_ksp_operators_unset_raises(self, comm8):
+        with pytest.raises(RuntimeError, match="no operators"):
+            tps.KSP().create(comm8).get_operators()
+
+    def test_eps_auto_ncv_resolved(self, comm8):
+        import scipy.sparse as sp
+        eps = tps.EPS().create(comm8)
+        eps.set_dimensions(nev=2)
+        assert eps.get_dimensions() == (2, 17)     # max(4, 17) unsized
+        eps.set_operators(tps.Mat.from_scipy(comm8, sp.eye(10, format="csr")))
+        assert eps.get_dimensions() == (2, 10)     # capped at n

@@ -188,3 +188,14 @@ class TestPpermuteHaloPath:
             res = ksp.solve(bv, x)
             np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-6,
                                        atol=1e-8)
+
+    def test_transpose_diagonal_only(self, comm):
+        """halo == 0 (diagonal matrix): transpose product is fully local."""
+        n = 40
+        d = 1.0 + np.random.default_rng(6).random(n)
+        A = sp.diags(d).tocsr()
+        M = tps.Mat.from_scipy(comm, A)
+        assert M.dia_offsets == (0,)
+        x = np.random.default_rng(7).random(n)
+        y = M.mult_transpose(tps.Vec.from_global(comm, x)).to_numpy()
+        np.testing.assert_allclose(y, d * x, rtol=1e-14)
