@@ -102,7 +102,9 @@ class Mat:
         if nrows == ncols:
             offsets = csr_find_diagonals(indptr, indices,
                                          max_diags=max(2 * K, 8))
-            if offsets is not None and len(offsets) <= max(2 * K, 8):
+            # an empty offsets set (all-zero matrix) stays on the ELL path —
+            # the DIA kernels assume at least one stored diagonal
+            if offsets is not None and 0 < len(offsets) <= max(2 * K, 8):
                 dia = csr_to_dia(indptr, indices, data, nrows, offsets)
                 m.dia_vals = comm.put_rows(dia)
                 m.dia_offsets = tuple(int(o) for o in offsets)

@@ -950,10 +950,10 @@ class EPS:
     def get_dimensions(self):
         """(nev, ncv) — slepc4py's getDimensions, ncv resolved from the
         auto rule when unset (never None, like slepc4py)."""
+        if self._mat is not None:     # the size the solver actually uses
+            return (self.nev, self._effective_ncv(self._mat.shape[0]))
         if self.ncv is not None:
             return (self.nev, self.ncv)
-        if self._mat is not None:
-            return (self.nev, self._effective_ncv(self._mat.shape[0]))
         return (self.nev, max(2 * self.nev, self.nev + 15))
 
     getDimensions = get_dimensions

@@ -98,7 +98,6 @@ class TestOptionsParsing:
 
 class TestGetters:
     def test_ksp_tolerances_operators(self, comm8):
-        import scipy.sparse as sp
         A = sp.eye(10, format="csr")
         M = tps.Mat.from_scipy(comm8, A)
         ksp = tps.KSP().create(comm8)
@@ -120,7 +119,6 @@ class TestGetters:
             tps.KSP().create(comm8).get_operators()
 
     def test_eps_auto_ncv_resolved(self, comm8):
-        import scipy.sparse as sp
         eps = tps.EPS().create(comm8)
         eps.set_dimensions(nev=2)
         assert eps.get_dimensions() == (2, 17)     # max(4, 17) unsized

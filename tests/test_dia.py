@@ -199,3 +199,12 @@ class TestPpermuteHaloPath:
         x = np.random.default_rng(7).random(n)
         y = M.mult_transpose(tps.Vec.from_global(comm, x)).to_numpy()
         np.testing.assert_allclose(y, d * x, rtol=1e-14)
+
+    def test_zero_matrix_stays_ell(self, comm8):
+        """An all-zero square matrix must not select DIA (no diagonals)."""
+        A = sp.csr_matrix((10, 10))
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is None
+        x = np.ones(10)
+        y = M.mult_transpose(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_array_equal(y, np.zeros(10))
