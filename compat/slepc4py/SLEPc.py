@@ -7,6 +7,7 @@ import mpi_petsc4py_example_tpu as _tps
 from mpi_petsc4py_example_tpu.solvers.eps import (
     EPS as _CoreEPS, EPSProblemType, EPSWhich)
 from mpi_petsc4py_example_tpu.solvers.st import ST as _CoreST
+from mpi_petsc4py_example_tpu.solvers.svd import SVD as _CoreSVD
 
 from mpi4py import MPI as _MPI
 from petsc4py.PETSc import Mat as _Mat, Vec as _Vec, _mpi_comm
@@ -149,3 +150,60 @@ class EPS:
     @property
     def core(self):
         return self._core
+
+
+class SVD:
+    """Singular value solver handle (fronts solvers.svd.SVD)."""
+
+    Which = _CoreSVD.Which    # aliased so new core selections appear here too
+
+    def __init__(self):
+        self._core = _CoreSVD()
+        self._comm = None
+
+    def create(self, comm=None):
+        self._comm = _mpi_comm(comm)
+        self._core.create(self._comm.device_comm)
+        return self
+
+    def setOperator(self, A: _Mat):
+        self._core.set_operator(A.core)
+
+    def setDimensions(self, nsv=None, ncv=None, mpd=None):
+        self._core.set_dimensions(nsv=nsv, ncv=ncv)
+
+    def setTolerances(self, tol=None, max_it=None):
+        self._core.set_tolerances(tol=tol, max_it=max_it)
+
+    def setWhichSingularTriplets(self, which):
+        self._core.set_which_singular_triplets(which)
+
+    def setFromOptions(self):
+        self._core.set_from_options()
+
+    def solve(self):
+        comm = self._comm or _MPI.COMM_WORLD
+
+        def build(_):
+            self._core.solve()
+            return self._core
+
+        self._core = comm._collective("svd_solve", None, build)
+
+    def getConverged(self):
+        return self._core.get_converged()
+
+    def getValue(self, i):
+        return self._core.get_value(i)
+
+    def getSingularTriplet(self, i, U=None, V=None):
+        return self._core.get_singular_triplet(
+            i,
+            U.core if isinstance(U, _Vec) else U,
+            V.core if isinstance(V, _Vec) else V)
+
+    def getIterationNumber(self):
+        return self._core.get_iteration_number()
+
+    def destroy(self):
+        return self

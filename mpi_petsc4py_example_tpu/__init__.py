@@ -54,18 +54,21 @@ __all__ = [
     "init_multihost",
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
-    "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST",
+    "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST", "SVD",
     "ConvergedReason", "SolveResult",
     "Options", "global_options", "init", "backend", "petsc_io",
 ]
 
 
 def __getattr__(name):
-    # EPS/ST imported lazily to keep base import light
+    # EPS/ST/SVD imported lazily to keep base import light
     if name == "EPS":
         from .solvers.eps import EPS
         return EPS
     if name == "ST":
         from .solvers.st import ST
         return ST
+    if name == "SVD":
+        from .solvers.svd import SVD
+        return SVD
     raise AttributeError(name)

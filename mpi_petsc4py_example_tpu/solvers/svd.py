@@ -1,0 +1,214 @@
+"""SVD — singular value solver, the SLEPc ``SVD`` module's TPU equivalent.
+
+SLEPc ships an SVD object alongside EPS (slepc4py ``SLEPc.SVD``); its default
+``cross`` method solves the eigenproblem of the cross-product matrix
+``AᵀA`` — exactly the design here: the (sparse) cross product assembles on
+host (the same host-setup/device-iterate split as the PC factorizations),
+the Hermitian eigensolve runs as the framework's compiled EPS programs over
+the mesh, and singular triplets come back as ``σᵢ = sqrt(λᵢ)``,
+``vᵢ`` the eigenvector, ``uᵢ = A vᵢ / σᵢ``.
+
+Supports rectangular operators (``m x n`` with any shape ratio: the smaller
+cross product is used), largest/smallest selection, and the slepc4py
+result surface (``get_converged``, ``get_singular_triplet``, ``get_value``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.mat import Mat
+from ..parallel.mesh import as_comm
+from ..utils.convergence import ConvergedReason, SolveResult
+from ..utils.options import global_options
+
+SVD_WHICH = ("largest", "smallest")
+
+
+class SVD:
+    """Singular value solver context, slepc4py-``SVD``-shaped."""
+
+    class Which:
+        LARGEST = "largest"
+        SMALLEST = "smallest"
+
+    def __init__(self, comm=None):
+        self.comm = as_comm(comm) if comm is not None else None
+        self._mat: Mat | None = None
+        self.nsv = 1                  # SLEPc default
+        self.ncv: int | None = None
+        self.tol = 1e-8
+        self.max_it = 100
+        self._which = "largest"       # SLEPc's default selection
+        self.result = SolveResult()
+        self._sigma = np.zeros(0)
+        self._U = np.zeros((0, 0))
+        self._V = np.zeros((0, 0))
+        self._residuals = np.zeros(0)
+        self._nconv = 0
+
+    # ---- lifecycle / configuration -----------------------------------------
+    def create(self, comm=None):
+        self.comm = as_comm(comm)
+        return self
+
+    def destroy(self):
+        return self
+
+    def set_operator(self, A: Mat):
+        self._mat = A
+        if self.comm is None:
+            self.comm = A.comm
+        return self
+
+    setOperator = set_operator
+
+    def set_dimensions(self, nsv: int | None = None, ncv: int | None = None):
+        if nsv is not None:
+            self.nsv = int(nsv)
+        if ncv is not None:
+            self.ncv = int(ncv)
+        return self
+
+    setDimensions = set_dimensions
+
+    def set_tolerances(self, tol=None, max_it=None):
+        if tol is not None:
+            self.tol = float(tol)
+        if max_it is not None:
+            self.max_it = int(max_it)
+        return self
+
+    setTolerances = set_tolerances
+
+    def set_which_singular_triplets(self, which: str):
+        which = str(which).lower()
+        if which not in SVD_WHICH:
+            raise ValueError(f"unknown which {which!r}; available: "
+                             f"{SVD_WHICH}")
+        self._which = which
+        return self
+
+    setWhichSingularTriplets = set_which_singular_triplets
+
+    def set_from_options(self):
+        opt = global_options()
+        self.nsv = opt.get_int("svd_nsv", self.nsv)
+        ncv = opt.get_int("svd_ncv", 0)
+        if ncv:
+            self.ncv = ncv
+        self.tol = opt.get_real("svd_tol", self.tol)
+        self.max_it = opt.get_int("svd_max_it", self.max_it)
+        w = opt.get_string("svd_which")
+        if w:
+            self.set_which_singular_triplets(w)
+        return self
+
+    setFromOptions = set_from_options
+
+    # ---- solve --------------------------------------------------------------
+    def solve(self):
+        """Cross-product eigensolve: EPS on ``AᵀA`` (or ``AAᵀ`` when that is
+        smaller), σ = sqrt(λ), the other-side vectors recovered via A."""
+        from .eps import EPS
+        mat = self._mat
+        if mat is None:
+            raise RuntimeError("SVD.solve: no operator set")
+        A = mat.to_scipy().tocsr()
+        m, n = A.shape
+        use_left = m < n              # eigensolve the smaller cross product
+        C = (A @ A.T if use_left else A.T @ A).tocsr()
+        t0 = time.perf_counter()
+
+        eps = EPS().create(self.comm)
+        eps.set_operators(Mat.from_scipy(self.comm, C, dtype=mat.dtype))
+        eps.set_problem_type("hep")
+        k = min(self.nsv, C.shape[0])
+        eps.set_dimensions(nev=k, ncv=self.ncv)
+        # relative accuracy transfers: δσ/σ = δλ/(2λ), so the eigensolver
+        # tolerance maps one-to-one onto the singular-value tolerance
+        eps.set_tolerances(tol=self.tol, max_it=self.max_it)
+        if self._which == "largest":
+            eps.set_which_eigenpairs("largest_real")
+        else:
+            eps.set_type("lobpcg")
+            eps.set_which_eigenpairs("smallest_real")
+        eps.solve()
+
+        nconv = min(eps.get_converged(), k)
+        sig, W, other, res = [], [], [], []
+        for i in range(nconv):
+            lam = eps.get_eigenvalue(i).real
+            s = float(np.sqrt(max(lam, 0.0)))
+            w = np.real(eps._eigenvectors[i])     # eigenvector of C
+            w = w / (np.linalg.norm(w) or 1.0)
+            if s > np.finfo(np.float64).tiny ** 0.5:
+                o = (A.T @ w if use_left else A @ w) / s
+            else:                                  # zero singular value
+                o = np.zeros(n if use_left else m)
+            sig.append(s)
+            W.append(w)
+            other.append(o)
+            # residual on the side OPPOSITE the constructed vector — the
+            # constructed side is zero by construction and measures nothing
+            u, v = (w, o) if use_left else (o, w)
+            if use_left:
+                r_abs = float(np.linalg.norm(A @ v - s * u))
+            else:
+                r_abs = float(np.linalg.norm(A.T @ u - s * v))
+            # relative in σ, absolute once σ is numerically zero (dividing
+            # by tiny would report ~1e300 for exactly-singular matrices)
+            res.append(r_abs / s if s > np.finfo(np.float64).tiny ** 0.5
+                       else r_abs)
+        order = np.argsort(np.asarray(sig))
+        if self._which == "largest":
+            order = order[::-1]
+        self._sigma = np.asarray(sig)[order]
+        if use_left:
+            self._U = np.asarray(W)[order] if W else np.zeros((0, m))
+            self._V = np.asarray(other)[order] if other else np.zeros((0, n))
+        else:
+            self._V = np.asarray(W)[order] if W else np.zeros((0, n))
+            self._U = np.asarray(other)[order] if other else np.zeros((0, m))
+        self._residuals = np.asarray(res)[order] if res else np.zeros(0)
+        self._nconv = int(nconv)
+        wall = time.perf_counter() - t0
+        self.result = SolveResult(
+            eps.get_iteration_number(),
+            float(self._residuals[0]) if len(self._residuals) else 0.0,
+            (ConvergedReason.CONVERGED_RTOL if nconv >= k
+             else ConvergedReason.DIVERGED_MAX_IT), wall)
+        return self
+
+    # ---- results (slepc4py-shaped) -----------------------------------------
+    def get_converged(self) -> int:
+        return self._nconv
+
+    getConverged = get_converged
+
+    def get_value(self, i: int) -> float:
+        return float(self._sigma[i])
+
+    getValue = get_value
+
+    def get_singular_triplet(self, i: int, U=None, V=None) -> float:
+        """Fill ``U``/``V`` (Vec) with the i-th singular vectors and return
+        σᵢ — host-replicated, collective-safe like EPS.get_eigenpair."""
+        if U is not None:
+            U.set_global(self._U[i])
+        if V is not None:
+            V.set_global(self._V[i])
+        return float(self._sigma[i])
+
+    getSingularTriplet = get_singular_triplet
+
+    def get_iteration_number(self) -> int:
+        return self.result.iterations
+
+    getIterationNumber = get_iteration_number
+
+    def __repr__(self):
+        return (f"SVD(nsv={self.nsv}, which={self._which!r}, "
+                f"tol={self.tol})")
