@@ -785,18 +785,19 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
 
 def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-                At=None, dtol=None):
+                At=None, Mt=None, dtol=None):
     """Biconjugate gradients (KSPBICG): dual recurrences on A and A^T.
 
-    The shadow system uses ``M`` for the transpose preconditioner apply —
-    exact for the symmetric PCs here (none/jacobi/SPD block inverses), the
-    same contract PETSc's PCApplyTranspose fulfills.
+    The shadow system preconditions with ``Mt`` — the PCApplyTranspose
+    closure (falls back to ``M`` for symmetric applies).
     """
+    if Mt is None:
+        Mt = M
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     rt = r
     z = M(r)
-    zt = M(rt)
+    zt = Mt(rt)
     p = z
     pt = zt
     rho = pdot(rt, z)
@@ -818,7 +819,7 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         r = r - alpha * q
         rt = rt - alpha * qt
         z = M(r)
-        zt = M(rt)
+        zt = Mt(rt)
         rho_new = pdot(rt, z)
         beta = jnp.where(rho == 0, 0.0,
                          rho_new / jnp.where(rho == 0, 1.0, rho))
@@ -1369,14 +1370,18 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         return cached
 
     kernel = KSP_KERNELS[ksp_type]
-    if ksp_type == "bicg" and pc.kind not in ("none", "jacobi"):
-        # BiCG's shadow recurrence needs M^T; only symmetric-by-construction
-        # PC applies can stand in for it here (PETSc routes this through
-        # PCApplyTranspose, which these block/sweep PCs don't provide)
-        raise ValueError(
-            f"KSP 'bicg' needs a symmetric preconditioner apply (pc 'none' "
-            f"or 'jacobi'), got {pc.get_type()!r} — use bcgs/gmres/gcr for "
-            "general preconditioning")
+    pc_apply_t = None
+    if ksp_type == "bicg":
+        # BiCG's shadow recurrence preconditions with Mᵀ — PETSc's
+        # PCApplyTranspose slot (PC.local_apply_transpose here)
+        pc_apply_t = pc.local_apply_transpose(comm, n)
+        if pc_apply_t is None:
+            raise ValueError(
+                f"KSP 'bicg' needs a preconditioner with a transpose apply "
+                f"(PCApplyTranspose); pc {pc.get_type()!r} provides none — "
+                "supported: none/jacobi, the block kinds (bjacobi/sor/ssor/"
+                "ilu/icc), lu/cholesky, and composite-additive of those; "
+                "or use bcgs/gmres/gcr for general preconditioning")
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
     spmv_t_local = None
@@ -1424,6 +1429,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # the null(A) projector; projecting after would be wrong for
                 # unsymmetric A). project is the identity without a nullspace.
                 kw["At"] = lambda v: spmv_t_local(op_arrays, project(v))
+                if ksp_type == "bicg":
+                    # same adjoint rule for the preconditioner: (P M)^T = M^T P
+                    kw["Mt"] = lambda r: pc_apply_t(pc_arrays, project(r))
             return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
         return body
 

@@ -425,6 +425,52 @@ class PC:
             return apply
         raise AssertionError(k)
 
+    def local_apply_transpose(self, comm: DeviceComm, n: int):
+        """``apply_t(pc_arrays_local, r_local) -> z_local`` for ``Mᵀ``
+        (PETSc's PCApplyTranspose slot — KSPBICG's shadow recurrence).
+
+        Returns None when the type provides no transpose apply. Diagonal
+        applies (none/jacobi) are symmetric and reuse the forward closure;
+        block kinds (bjacobi/sor/ssor/ilu/icc) and lu/cholesky transpose
+        their shipped explicit inverses ((B⁻¹)ᵀ = (Bᵀ)⁻¹ — one transposed
+        batched matvec); composite-additive sums its children's transposes.
+        asm/mg/gamg/shell/composite-multiplicative provide none.
+        """
+        k = self.kind
+        axis = comm.axis
+        lsize = comm.local_size(n)
+        if k in ("none", "jacobi"):
+            return self.local_apply(comm, n)      # diagonal: symmetric
+        if k == "bjacobi":
+            def apply_t(arrs, r):
+                binv = arrs[0]  # (nb, bs, bs) explicit block inverses
+                nb, bs = binv.shape[0], binv.shape[1]
+                return jnp.einsum("bij,bi->bj", binv,
+                                  r.reshape(nb, bs)).reshape(-1)
+            return apply_t
+        if k == "lu":
+            def apply_t(arrs, r):
+                minv = arrs[0]  # replicated (n_pad, n_pad) inverse of A
+                r_full = lax.all_gather(r, axis, tiled=True)
+                z_full = minv.T @ r_full
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
+            return apply_t
+        if k == "composite" and self.composite_type == "additive":
+            subs = [(c.local_apply_transpose(comm, n),
+                     len(c.device_arrays())) for c in self._sub_pcs]
+            if any(ap is None for ap, _ in subs):
+                return None
+            def apply_t(arrs, r):
+                z = jnp.zeros_like(r)
+                i = 0
+                for ap, na in subs:
+                    z = z + ap(arrs[i:i + na], r)
+                    i += na
+                return z
+            return apply_t
+        return None     # asm/mg/gamg/shell/multiplicative: no transpose
+
     def __repr__(self):
         return f"PC(type={self._type!r}, factor={self._factor_solver_type!r})"
 
