@@ -59,6 +59,7 @@ class KSP:
         self._norm_type = "default"   # -ksp_norm_type (KSPSetNormType)
         self._monitors = []
         self._monitor_flag = False
+        self._view_flag = False       # -ksp_view: print config after solve
         self._initial_guess_nonzero = False
         self.result = SolveResult()
         self._prefix = ""
@@ -226,6 +227,7 @@ class KSP:
         if nt:
             self.set_norm_type(nt)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
+        self._view_flag = opt.get_bool(p + "ksp_view", False)
         pct = opt.get_string(p + "pc_type")
         if pct:
             self.get_pc().set_type(pct)
@@ -336,6 +338,8 @@ class KSP:
         from ..utils.profiling import record_event
         record_event(f"KSPSolve({self._type}+{pc.get_type()})", mat.shape[0],
                      self.result.iterations, wall, self.result.reason)
+        if self._view_flag:           # -ksp_view, PETSc prints after solve
+            self.view()
         return self.result
 
     # ---- introspection (petsc4py-shaped) ------------------------------------
@@ -377,7 +381,8 @@ class KSP:
         pc = self.get_pc()
         print(f"KSP Object: type={self._type}\n"
               f"  tolerances: rtol={self.rtol:g}, atol={self.atol:g}, "
-              f"max_it={self.max_it}\n"
+              f"divtol={self.divtol:g}, max_it={self.max_it}\n"
+              f"  norm type: {self.get_norm_type()}\n"
               f"  gmres restart: {self.restart}\n"
               f"  PC Object: type={pc.get_type()}, "
               f"factor solver: {pc._factor_solver_type}\n"

@@ -124,3 +124,19 @@ class TestGetters:
         assert eps.get_dimensions() == (2, 17)     # max(4, 17) unsized
         eps.set_operators(tps.Mat.from_scipy(comm8, sp.eye(10, format="csr")))
         assert eps.get_dimensions() == (2, 10)     # capped at n
+
+    def test_ksp_view_flag(self, comm8, capsys):
+        """-ksp_view prints the solver configuration after the solve."""
+        A = poisson2d_csr(6)
+        tps.global_options().parse_argv(["prog", "-ksp_view"])
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_from_options()
+        x, bv = M.get_vecs()
+        bv.set_global(np.ones(36))
+        ksp.solve(bv, x)
+        out = capsys.readouterr().out
+        assert "KSP Object: type=cg" in out
+        assert "norm type:" in out and "divtol=" in out
