@@ -1380,8 +1380,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 f"KSP 'bicg' needs a preconditioner with a transpose apply "
                 f"(PCApplyTranspose); pc {pc.get_type()!r} provides none — "
                 "supported: none/jacobi, the block kinds (bjacobi/sor/ssor/"
-                "ilu/icc), lu/cholesky, and composite-additive of those; "
-                "or use bcgs/gmres/gcr for general preconditioning")
+                "ilu/icc), lu/cholesky, composite-additive of those, and "
+                "shell with set_shell_apply_transpose; or use bcgs/gmres/"
+                "gcr for general preconditioning")
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
     spmv_t_local = None

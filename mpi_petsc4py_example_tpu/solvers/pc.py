@@ -79,6 +79,7 @@ class PC:
         # PCSHELL: user apply (full-vector jax-traceable callable) + a uid so
         # compiled-program caches distinguish different shell functions
         self._shell_apply = None
+        self._shell_apply_t = None
         self._shell_uid = 0
         # PCCOMPOSITE: child PCs + combination type
         self.composite_type = "additive"   # PETSc's PC_COMPOSITE_ADDITIVE
@@ -125,6 +126,16 @@ class PC:
         return self
 
     setShellApply = set_shell_apply
+
+    def set_shell_apply_transpose(self, fn):
+        """PCShellSetApplyTranspose analog: ``z = fn(r)`` for ``Mᵀ`` —
+        enables KSPBICG with a shell preconditioner."""
+        self._shell_apply_t = fn
+        self._shell_uid = next(_shell_uid)
+        self._built_for = None
+        return self
+
+    setShellApplyTranspose = set_shell_apply_transpose
 
     # ---- PCCOMPOSITE (combination of preconditioners) -----------------------
     def set_composite_type(self, ctype: str):
@@ -433,8 +444,9 @@ class PC:
         applies (none/jacobi) are symmetric and reuse the forward closure;
         block kinds (bjacobi/sor/ssor/ilu/icc) and lu/cholesky transpose
         their shipped explicit inverses ((B⁻¹)ᵀ = (Bᵀ)⁻¹ — one transposed
-        batched matvec); composite-additive sums its children's transposes.
-        asm/mg/gamg/shell/composite-multiplicative provide none.
+        batched matvec); composite-additive sums its children's transposes;
+        shell uses the user's ``set_shell_apply_transpose`` function.
+        asm/mg/gamg/composite-multiplicative provide none.
         """
         k = self.kind
         axis = comm.axis
@@ -456,6 +468,12 @@ class PC:
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
             return apply_t
+        if k == "shell":
+            if self._shell_apply_t is None:
+                return None
+            from ..parallel.mesh import full_vector_local_apply
+            shell_t = full_vector_local_apply(self._shell_apply_t, comm, n)
+            return lambda arrs, r: shell_t(r)
         if k == "composite" and self.composite_type == "additive":
             subs = [(c.local_apply_transpose(comm, n),
                      len(c.device_arrays())) for c in self._sub_pcs]
@@ -469,7 +487,7 @@ class PC:
                     i += na
                 return z
             return apply_t
-        return None     # asm/mg/gamg/shell/multiplicative: no transpose
+        return None     # asm/mg/gamg/composite-multiplicative: no transpose
 
     def __repr__(self):
         return f"PC(type={self._type!r}, factor={self._factor_solver_type!r})"

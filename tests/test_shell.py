@@ -346,3 +346,23 @@ class TestFacadeShell:
         S = tps.ShellMat(comm1, 8, lambda v: 2.0 * v)
         with pytest.raises(ValueError, match="mult_transpose"):
             S.mult_transpose(tps.Vec.from_global(comm1, np.ones(8)))
+
+    def test_bicg_with_shell_transpose(self, comm8):
+        """A shell PC with both applies runs under bicg; without the
+        transpose apply bicg raises the PCApplyTranspose error."""
+        n = 36
+        w = 1.0 + np.arange(n) / 4.0
+        A = (poisson2d(6) + sp.diags(w)).tocsr()
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+        dinv = jnp.asarray(1.0 / A.diagonal())
+
+        pc = tps.PC(comm8)
+        pc.set_type("shell")
+        pc.set_shell_apply(lambda r: dinv * r)
+        with pytest.raises(ValueError, match="PCApplyTranspose"):
+            run_ksp(comm8, M, b, "bicg", pc=pc)
+        pc.set_shell_apply_transpose(lambda r: dinv * r)  # symmetric here
+        x, res, _ = run_ksp(comm8, M, b, "bicg", pc=pc)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, rtol=1e-7, atol=1e-9)
