@@ -180,8 +180,8 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
 

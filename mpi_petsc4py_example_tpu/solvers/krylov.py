@@ -163,6 +163,85 @@ def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
+def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
+                  dtol=None, preduce=None):
+    """Flexible BiCGStab with rearranged, merged reductions (KSPFBCGSR).
+
+    Mathematically equivalent to right-preconditioned BiCGStab (so it
+    tolerates a variable preconditioner, like ``fbcgs``), but the recurrence
+    is reorganized the way PETSc's FBCGSR is: instead of four separate global
+    reductions per iteration (rho, r̂·v, t·s/t·t, ‖r‖), the scalars are
+    re-derived so one psum covers the ``r̂·v`` phase and one *fused* psum
+    covers ``(t·s, t·t, r̂·t, s·s)`` — two reduction phases per iteration.
+    The next rho and the residual norm come from scalar identities::
+
+        r       = s - ω t
+        (r̂, r)  = (r̂, s) - ω (r̂, t) = (ρ - α r̂·v) - ω r̂·t
+        ‖r‖²    = s·s - 2ω t·s + ω² t·t
+
+    The final residual norm is recomputed as ‖b - A x‖ on exit, so the
+    scalar-recurrence drift never leaks into the reported norm.
+    """
+    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    r = b - A(x0)
+    rhat = r
+    rnorm = pnorm(r)
+    dmax = _dmax(rnorm, dtol)
+    one = jnp.asarray(1.0, b.dtype)
+    z = jnp.zeros_like(b)
+
+    def cond(st):
+        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk = st
+        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
+
+    def body(st):
+        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk = st
+        brk = (rho_cur == 0) | (omega == 0)
+        beta = jnp.where(brk, 0.0,
+                         (rho_cur / jnp.where(rho == 0, 1.0, rho))
+                         * (alpha / jnp.where(omega == 0, 1.0, omega)))
+        p = r + beta * (p - omega * v)
+        phat = M(p)
+        v = A(phat)
+        rv = pdot(rhat, v)                       # reduction phase 1
+        brk = brk | (rv == 0)
+        alpha = jnp.where(brk, 0.0, rho_cur / jnp.where(rv == 0, 1.0, rv))
+        s = r - alpha * v
+        shat = M(s)
+        t = A(shat)
+        # reduction phase 2: all remaining dots in ONE fused psum
+        ts, tt, rt, ss = preduce(jnp.vdot(t, s), jnp.vdot(t, t),
+                                 jnp.vdot(rhat, t), jnp.vdot(s, s))
+        omega = jnp.where(tt == 0, 0.0, ts / jnp.where(tt == 0, 1.0, tt))
+        x = x + alpha * phat + omega * shat
+        r = s - omega * t
+        # ω = t·s/t·t minimizes this quantity, so near stagnation the
+        # subtraction cancels; its noise floor is O(eps·s·s). Clamping to
+        # exactly 0 would fake an instant-convergence exit (breaking the
+        # fixed-iteration contract under tol=0 and mislabeling ATOL), so
+        # floor at the noise level instead — below it the recurrence cannot
+        # resolve the norm anyway (an exactly-zero r costs at most one
+        # extra iteration before the floor itself falls under tolerance).
+        eps = jnp.asarray(jnp.finfo(b.dtype).eps, b.dtype)
+        rn = jnp.sqrt(jnp.maximum(ss - 2 * omega * ts + omega * omega * tt,
+                                  eps * ss))
+        rho_next = (rho_cur - alpha * rv) - omega * rt
+        if monitor is not None:
+            monitor(k + 1, rn)
+        return (k + 1, x, r, p, v, rho_cur, rho_next, alpha, omega, rn, brk)
+
+    st0 = (jnp.int32(0), x0, r, z, z, one, rnorm * rnorm, one, one,
+           rnorm, rnorm <= -1.0)
+    out = lax.while_loop(cond, body, st0)
+    k, x, rn, brk = out[0], out[1], out[9], out[10]
+    # judge convergence on the norm the loop actually tested (the scalar
+    # recurrence), report the recomputed true norm — as bcgsl does; judging
+    # on rn_true could mislabel a converged exit as DIVERGED_MAX_IT when the
+    # recurrence drifts marginally across the tolerance
+    rn_true = pnorm(b - A(x))
+    return x, k, rn_true, _reason(rn, tol, atol, k, maxit, brk, dmax)
+
+
 def _hessenberg_lstsq(H, beta):
     """Solve ``min ||beta*e1 - H y||`` for upper-Hessenberg H of shape (m+1, m).
 
@@ -1281,10 +1360,11 @@ KSP_KERNELS = {
     "fcg": fcg_kernel,
     "lgmres": lgmres_kernel,
     "bcgsl": bcgsl_kernel,
-    # PETSc's flexible BiCGStab variants: the bcgs kernel here is already
-    # right-preconditioned (flexible by construction), so they share it
+    # PETSc's fbcgs: the bcgs kernel here is already right-preconditioned
+    # (flexible by construction), so it shares the kernel; fbcgsr is the
+    # distinct merged-reduction recurrence
     "fbcgs": bcgs_kernel,
-    "fbcgsr": bcgs_kernel,
+    "fbcgsr": fbcgsr_kernel,
 }
 
 # kernels needing the transpose product A^T v (operator.local_spmv_t)
@@ -1420,7 +1500,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     kw["aug"] = aug
             elif ksp_type == "bcgsl":
                 kw["ell"] = ell
-            elif ksp_type == "pipecg":
+            elif ksp_type in ("pipecg", "fbcgsr"):
                 # the whole point: all per-iteration dots in ONE fused psum
                 kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),
                                                         axis)

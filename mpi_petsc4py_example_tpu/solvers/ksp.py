@@ -172,7 +172,7 @@ class KSP:
     # restarted solvers advance the counter a full cycle at a time — a
     # fixed-iteration contract can't hold for them (PETSc's KSPSetNormType
     # likewise rejects unsupported combinations)
-    _CYCLE_GRANULAR = ("gmres", "fgmres", "lgmres")
+    _CYCLE_GRANULAR = ("gmres", "fgmres", "lgmres", "bcgsl")
 
     def _check_norm_type(self):
         t = self._norm_type
@@ -181,10 +181,11 @@ class KSP:
         if t == "none":
             if self._type in self._CYCLE_GRANULAR:
                 raise ValueError(
-                    f"norm type 'none' is unavailable for restarted KSP "
+                    f"norm type 'none' is unavailable for KSP "
                     f"{self._type!r} (iterations advance a whole restart "
-                    "cycle at a time); use richardson/chebyshev/cg for "
-                    "fixed-iteration smoothing")
+                    "cycle — or ell steps for bcgsl — at a time, so a "
+                    "fixed max_it contract cannot hold); use richardson/"
+                    "chebyshev/cg for fixed-iteration smoothing")
             return
         have = self._KERNEL_NORMS.get(self._type, "unpreconditioned")
         if t != have:

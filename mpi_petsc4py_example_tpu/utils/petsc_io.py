@@ -14,8 +14,12 @@ Format (PETSc's documented binary layout, all **big-endian**):
 * Vec:        int32 classid ``1211214``, int32 n, float64[n] values.
 
 Standard PETSc builds use 32-bit indices and real float64 scalars — the
-layout written here. Loading rejects files from ``--with-64-bit-indices`` or
-complex builds with a clear message rather than misparsing them.
+layout written here. Loading rejects files from ``--with-64-bit-indices``
+builds (their int64 header reads as classid 0). Complex-build files carry an
+identical header, so they are detected heuristically: when loading by path,
+leftover payload bytes that do not start another PETSc object raise a clear
+error instead of returning interleaved re/im garbage. Streamed (open file
+object) reads cannot look ahead and skip the check.
 """
 
 from __future__ import annotations
@@ -44,11 +48,53 @@ def _open(path_or_file, mode):
             yield f
 
 
+def _display_name(path_or_file):
+    """Readable name for error messages: the path itself, or the underlying
+    file's name when streamed through an open Viewer file object."""
+    if hasattr(path_or_file, "read") or hasattr(path_or_file, "write"):
+        return getattr(path_or_file, "name", repr(path_or_file))
+    return repr(path_or_file)
+
+
 def _read(f, dtype, count):
     buf = f.read(dtype.itemsize * count)
     if len(buf) != dtype.itemsize * count:
         raise ValueError("truncated PETSc binary file")
     return np.frombuffer(buf, dtype=dtype, count=count)
+
+
+def _check_trailing(f, path):
+    """Complex-build detection for path-opened reads.
+
+    A complex-scalar PETSc build (``--with-scalar-type=complex``) writes an
+    identical header but 16-byte scalars, so a real-build parse consumes only
+    half the payload. Any legitimate leftover bytes must start another PETSc
+    object header; leftover imaginary halves never do. Only called when this
+    module opened the file itself — a streamed Viewer file object must keep
+    its cursor at the object boundary, so the caller skips the check there.
+    """
+    if hasattr(path, "read") or hasattr(path, "write"):
+        return
+    peek = f.read(4)
+    if not peek:
+        return
+    if len(peek) < 4:
+        raise ValueError(
+            f"{_display_name(path)}: {len(peek)} stray byte(s) after the "
+            "object — corrupt or truncated PETSc binary file")
+    cid = int(np.frombuffer(peek, dtype=_I, count=1)[0])
+    # any PETSc object classid (Vec 1211214, Mat 1211216, IS 1211218, Bag,
+    # DM, ... — all allocated from the same small block) means a legitimate
+    # multi-object file; a complex-build leftover starts mid-payload at some
+    # double (re or im half), whose big-endian high 4 bytes only decode into
+    # this range for ~1e-308 subnormals — never real data
+    if 1211200 <= cid <= 1211240:
+        return
+    raise ValueError(
+        f"{_display_name(path)}: bytes after the object do not start "
+        "another PETSc object — this looks like a PETSc complex-scalar "
+        "build file (--with-scalar-type=complex), which is unsupported "
+        "(real float64 scalars only)")
 
 
 def write_vec(path, arr) -> None:
@@ -65,11 +111,13 @@ def read_vec(path) -> np.ndarray:
         classid, n = _read(f, _I, 2)
         if classid != VEC_FILE_CLASSID:
             raise ValueError(
-                f"{path!r} is not a PETSc Vec (classid {classid}, "
+                f"{_display_name(path)} is not a PETSc Vec (classid {classid}, "
                 f"expected {VEC_FILE_CLASSID})")
         if n < 0:
             raise ValueError(f"corrupt PETSc Vec file: n={n}")
-        return _read(f, _R, int(n)).astype(np.float64)
+        vals = _read(f, _R, int(n)).astype(np.float64)
+        _check_trailing(f, path)
+        return vals
 
 
 def write_mat(path, A) -> None:
@@ -99,7 +147,7 @@ def read_mat(path):
         classid, nrows, ncols, nnz = _read(f, _I, 4)
         if classid != MAT_FILE_CLASSID:
             raise ValueError(
-                f"{path!r} is not a PETSc Mat (classid {classid}, "
+                f"{_display_name(path)} is not a PETSc Mat (classid {classid}, "
                 f"expected {MAT_FILE_CLASSID})")
         if nrows < 0 or ncols < 0 or nnz < 0:
             raise ValueError(
@@ -111,6 +159,7 @@ def read_mat(path):
                 "corrupt PETSc Mat file: row lengths do not sum to nnz")
         indices = _read(f, _I, int(nnz)).astype(np.int32)
         data = _read(f, _R, int(nnz)).astype(np.float64)
+        _check_trailing(f, path)
     if len(indices) and (indices.min() < 0 or indices.max() >= ncols):
         raise ValueError("corrupt PETSc Mat file: column index out of range")
     indptr = np.concatenate(([0], np.cumsum(rowlens)))

@@ -628,6 +628,25 @@ class TestSymmlqFcgLgmresBcgsl:
         assert res.converged
         np.testing.assert_allclose(x, x_true, atol=1e-7)
 
+    def test_fbcgsr_merged_reductions(self, comm8):
+        # distinct recurrence (krylov.py::fbcgsr_kernel): same answer as
+        # bcgs on an unsymmetric system, via two fused reduction phases
+        A = convdiff2d(12)
+        x_true, b = manufactured(A)
+        x, res, _ = solve(comm8, A, b, "fbcgsr", "ilu", rtol=1e-10)
+        assert res.converged, res
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_fbcgsr_iteration_parity_with_bcgs(self, comm8):
+        # mathematically equivalent recurrences: iteration counts track each
+        # other closely on a well-conditioned SPD system
+        A = poisson2d(12)
+        x_true, b = manufactured(A)
+        _, res_f, _ = solve(comm8, A, b, "fbcgsr", "jacobi", rtol=1e-8)
+        _, res_b, _ = solve(comm8, A, b, "bcgs", "jacobi", rtol=1e-8)
+        assert res_f.converged and res_b.converged
+        assert abs(res_f.iterations - res_b.iterations) <= 3
+
     def test_options_db_new_keys(self, comm8):
         tps.global_options().parse_argv(
             ["prog", "-ksp_type", "lgmres", "-ksp_lgmres_augment", "4",
@@ -848,7 +867,19 @@ class TestNormType:
         ksp.set_type("gmres")
         ksp.set_norm_type("none")
         x, bv = M.get_vecs()
-        with pytest.raises(ValueError, match="restarted"):
+        with pytest.raises(ValueError, match="restart cycle"):
+            ksp.solve(bv, x)
+
+    def test_bcgsl_rejects_none(self, comm8):
+        # bcgsl advances ell steps per loop body, so a fixed max_it contract
+        # cannot hold under norm type 'none'
+        M = tps.Mat.from_scipy(comm8, poisson2d(4))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("bcgsl")
+        ksp.set_norm_type("none")
+        x, bv = M.get_vecs()
+        with pytest.raises(ValueError, match="ell steps"):
             ksp.solve(bv, x)
 
     def test_natural_rejected_at_set(self):

@@ -104,6 +104,31 @@ class TestErrors:
         with pytest.raises(ValueError, match="truncated"):
             petsc_io.read_mat(p)
 
+    def test_complex_build_vec_rejected(self, tmp_path):
+        # a --with-scalar-type=complex build writes the same header but
+        # 16-byte scalars; a real-build parse leaves the imaginary halves
+        # behind, which never start another PETSc object header
+        p = tmp_path / "vc.petsc"
+        n = 5
+        hdr = np.array([1211214, n], dtype=">i4")
+        interleaved = np.zeros(2 * n, dtype=">f8")
+        interleaved[0::2] = np.arange(1.0, n + 1)      # real parts
+        interleaved[1::2] = 0.25                        # imaginary parts
+        p.write_bytes(hdr.tobytes() + interleaved.tobytes())
+        with pytest.raises(ValueError, match="complex-scalar"):
+            petsc_io.read_vec(p)
+
+    def test_complex_build_mat_rejected(self, tmp_path):
+        p = tmp_path / "mc.petsc"
+        hdr = np.array([1211216, 2, 2, 2], dtype=">i4")
+        rl = np.array([1, 1], dtype=">i4")
+        idx = np.array([0, 1], dtype=">i4")
+        vals = np.array([1.0, 0.5, 2.0, -0.5], dtype=">f8")  # re/im pairs
+        p.write_bytes(hdr.tobytes() + rl.tobytes() + idx.tobytes()
+                      + vals.tobytes())
+        with pytest.raises(ValueError, match="complex-scalar"):
+            petsc_io.read_mat(p)
+
     def test_bad_rowlens(self, tmp_path):
         p = tmp_path / "m.petsc"
         hdr = np.array([1211216, 2, 2, 3], dtype=">i4")
