@@ -37,6 +37,13 @@ def main():
     for p in (repo, compat):
         if p not in sys.path:
             sys.path.insert(0, p)
+    # like ``python script.py`` (and mpirun): the script's own directory leads
+    # sys.path, so a driver's sibling modules (e.g. the reference repo's
+    # petsc_funcs.py, /root/reference/test2.py:4) shadow the compat copies
+    script_dir = os.path.dirname(os.path.abspath(opts.script))
+    if script_dir in sys.path:
+        sys.path.remove(script_dir)
+    sys.path.insert(0, script_dir)
 
     sys.argv = [opts.script] + opts.args
 
