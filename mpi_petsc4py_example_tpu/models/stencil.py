@@ -64,16 +64,15 @@ class StencilPoisson3D:
     def program_key(self):
         return ("stencil3d", self.nx, self.ny, self.nz, self.comm.size)
 
-    def local_spmv(self, comm: DeviceComm):
+    def _halo_exchange(self, comm: DeviceComm):
+        """Local ``u (lz,ny,nx) -> (halo_lo, halo_hi)``: ring exchange of the
+        boundary z-planes (one ``lax.ppermute`` each way), with zero planes at
+        the global Dirichlet boundaries. Shared by the plain SpMV and the
+        fused CG matvec+dot so the boundary logic exists exactly once."""
         axis = comm.axis
-        nx, ny, lz = self.nx, self.ny, self.lz
         ndev = comm.size
-        from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
-        use_pallas = pallas_supported(ny, nx, self._dtype)
 
-        def spmv(op_local, x_local):
-            u = x_local.reshape(lz, ny, nx)
-            # ring halo exchange of boundary z-planes (one plane each way)
+        def exchange(u):
             up = lax.ppermute(u[-1], axis,
                               perm=[(i, (i + 1) % ndev) for i in range(ndev)])
             down = lax.ppermute(u[0], axis,
@@ -83,6 +82,19 @@ class StencilPoisson3D:
             # Dirichlet: the global boundary receives no wrap-around halo
             halo_lo = jnp.where(i == 0, zero_plane, up)        # plane z-1
             halo_hi = jnp.where(i == ndev - 1, zero_plane, down)  # plane z+lz
+            return halo_lo, halo_hi
+
+        return exchange
+
+    def local_spmv(self, comm: DeviceComm):
+        nx, ny, lz = self.nx, self.ny, self.lz
+        from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
+        use_pallas = pallas_supported(ny, nx, self._dtype)
+        exchange = self._halo_exchange(comm)
+
+        def spmv(op_local, x_local):
+            u = x_local.reshape(lz, ny, nx)
+            halo_lo, halo_hi = exchange(u)
             if use_pallas:
                 # halo planes ride as separate inputs — no concatenated
                 # extended-slab copy in HBM (2 full passes saved per apply)
@@ -104,6 +116,39 @@ class StencilPoisson3D:
             return y.reshape(lz * ny * nx)
 
         return spmv
+
+    # uniform diagonal value — lets CG's Jacobi apply collapse to a scalar
+    # multiply (z = r/6) and its rz dot collapse to ||r||^2/6, eliminating
+    # two full HBM reduction passes per iteration (see krylov.cg_stencil_kernel)
+    uniform_diagonal = 6.0
+
+    def local_matvec_dot(self, comm: DeviceComm):
+        """Fused local ``v -> (A v, psum <v, A v>)`` for the CG fast path.
+
+        Uses the fused Pallas kernel when supported; otherwise the jnp
+        stencil plus an XLA-fused vdot (still one program, one psum).
+        """
+        axis = comm.axis
+        nx, ny, lz = self.nx, self.ny, self.lz
+        from ..ops.pallas_stencil import (pallas_supported,
+                                          stencil3d_dot_pallas)
+        use_pallas = pallas_supported(ny, nx, self._dtype)
+        spmv = self.local_spmv(comm)
+        exchange = self._halo_exchange(comm)
+
+        def matvec_dot(op_local, x_local):
+            if use_pallas:
+                u = x_local.reshape(lz, ny, nx)
+                halo_lo, halo_hi = exchange(u)
+                y, part = stencil3d_dot_pallas(u, halo_lo[None],
+                                               halo_hi[None], lz, ny, nx)
+                y = y.reshape(lz * ny * nx)
+            else:
+                y = spmv(op_local, x_local)
+                part = jnp.vdot(x_local, y)
+            return y, lax.psum(part, axis)
+
+        return matvec_dot
 
     # ---- Mat-compatible conveniences ----------------------------------------
     def get_vecs(self) -> tuple[Vec, Vec]:

@@ -41,7 +41,8 @@ def _shift_y(u, step):
     return jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
 
 
-def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks):
+def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
+                    dot_ref=None):
     """Grid-free kernel: double-buffered z-chunk pipeline, manual DMA.
 
     Per chunk ``c`` the scratch holds planes ``[z0-1, z0+chunk+1)`` of the
@@ -125,13 +126,22 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks):
                 osc.at[slot],
                 out_ref.at[pl.ds(c * jnp.int32(chunk), chunk)],
                 sem_out.at[slot]).start()
-            return carry
+            if dot_ref is None:
+                return carry
+            # fused <u, A u> partial: u and y are both VMEM-resident right
+            # here — the reduction costs no extra HBM pass (the separate
+            # pdot(p, Ap) it replaces re-reads both from HBM)
+            return carry + jnp.sum(u * y)
 
         def lax_rem(c):
             return jax.lax.rem(c, jnp.int32(2))
 
-        jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
-                          jnp.int32(0))
+        carry0 = (jnp.int32(0) if dot_ref is None
+                  else jnp.asarray(0.0, out_ref.dtype))
+        acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                                carry0)
+        if dot_ref is not None:
+            dot_ref[0] = acc
         # drain the last (up to) two in-flight output DMAs
         last = jnp.int32(nchunks - 1)
 
@@ -157,6 +167,19 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks):
     )
 
 
+def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
+                max_chunk: int | None):
+    """z-chunk that divides ``lz`` and keeps ~<=2MB per VMEM bank — the one
+    pipeline geometry both kernel entry points share."""
+    budget = (2 << 20) // (ny * nx * itemsize)
+    if max_chunk is not None:
+        budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
+    chunk = max(1, min(lz, budget))
+    while lz % chunk:
+        chunk -= 1
+    return chunk, lz // chunk
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
 def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
                            interpret: bool = False,
@@ -168,14 +191,7 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
     ``interpret=True`` runs the kernel through the Pallas interpreter on any
     backend — used by CI to pin the DMA pipeline's correctness off-TPU.
     """
-    # pick a z-chunk that divides lz and keeps ~<=2MB per VMEM bank
-    budget = (2 << 20) // (ny * nx * u.dtype.itemsize)
-    if max_chunk is not None:
-        budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
-    chunk = max(1, min(lz, budget))
-    while lz % chunk:
-        chunk -= 1
-    nchunks = lz // chunk
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk)
     kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
     return pl.pallas_call(
         kernel,
@@ -184,6 +200,36 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
+                         interpret: bool = False,
+                         max_chunk: int | None = None):
+    """Fused stencil apply + local dot: returns ``(A u, <u, A u>_local)``.
+
+    Same double-buffered DMA pipeline as :func:`stencil3d_apply_pallas`; the
+    ``<p, Ap>`` reduction CG needs every iteration is accumulated chunk by
+    chunk while both operands are VMEM-resident, saving the two extra HBM
+    read passes of a separate dot (the hot-loop fusion SURVEY.md §3.5 calls
+    for). The partial is local to the shard — psum it over the mesh axis.
+    """
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk)
+    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
+
+    def kern(u_ref, lo_ref, hi_ref, out_ref, dot_ref):
+        kernel(u_ref, lo_ref, hi_ref, out_ref, dot_ref=dot_ref)
+
+    y, dot = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
+                   jax.ShapeDtypeStruct((1,), u.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        interpret=interpret,
+    )(u, halo_lo, halo_hi)
+    return y, dot[0]
 
 
 def pallas_supported(ny: int, nx: int, dtype) -> bool:

@@ -53,9 +53,15 @@ class KSP:
         self.restart = 30
         self.lgmres_augment = 2       # -ksp_lgmres_augment (KSPLGMRES aug_k)
         self.bcgsl_ell = 2            # -ksp_bcgsl_ell (KSPBCGSL default)
-        self.unroll = 4               # -ksp_unroll: masked steps per loop
-                                      # dispatch (amortizes per-iteration
-                                      # runtime overhead; results identical)
+        self.unroll = 1               # -ksp_unroll: masked steps per loop
+                                      # dispatch (results identical). Default
+                                      # 1: measured on the target runtime,
+                                      # in-loop iteration dispatch is ~10 µs —
+                                      # the ~100 ms cost earlier attributed to
+                                      # it is per-PROGRAM-CALL tunnel latency,
+                                      # which unrolling cannot amortize; >1
+                                      # also disables the fused stencil-CG
+                                      # fast path (krylov.cg_stencil_kernel)
         self._norm_type = "default"   # -ksp_norm_type (KSPSetNormType)
         self._monitors = []
         self._monitor_flag = False

@@ -213,8 +213,8 @@ class TestLiteralReferenceDrivers:
         assert r.returncode == 0, r.stderr
         assert "Eigenvalue:" in r.stdout, r.stdout
         # dominant eigenvalue of the n=100 symmetric tridiagonal family
-        lam = float(r.stdout.split("Eigenvalue:")[1].strip().strip("()")
-                    .split("+")[0])
+        lam = complex(
+            r.stdout.split("Eigenvalue:")[1].strip().splitlines()[0]).real
         CSR = tridiag_family(100)
         lam_exact = np.linalg.eigvalsh(CSR.toarray())
         target = lam_exact[np.argmax(np.abs(lam_exact))]

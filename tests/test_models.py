@@ -92,6 +92,102 @@ class TestStencil:
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7, atol=1e-9)
 
 
+    @pytest.mark.parametrize("pc_type", ["jacobi", "none"])
+    def test_cg_fast_path_matches_generic_kernel(self, comm8, pc_type):
+        """The fused stencil-CG fast path (krylov.cg_stencil_kernel, engaged
+        at unroll=1 with PC none/jacobi) must match the generic cg_kernel
+        (forced via unroll=2) in iterations, solution, and residual norm."""
+        op = StencilPoisson3D(comm8, 8)
+        A = poisson3d_csr(8)
+        x_true = np.random.default_rng(11).random(512)
+        b = A @ x_true
+        results = {}
+        for unroll in (1, 2):
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(op)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type(pc_type)
+            ksp.set_tolerances(rtol=1e-10, max_it=500)
+            ksp.unroll = unroll
+            x, bv = op.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            assert res.converged
+            results[unroll] = (res.iterations, res.residual_norm,
+                               x.to_numpy())
+        it_f, rn_f, x_f = results[1]
+        it_g, rn_g, x_g = results[2]
+        assert it_f == it_g
+        np.testing.assert_allclose(rn_f, rn_g, rtol=1e-6)
+        np.testing.assert_allclose(x_f, x_g, rtol=1e-9, atol=1e-12)
+
+    def test_cg_separate_pmat_uses_its_diagonal(self, comm8):
+        """set_operators(A, P): jacobi must precondition with diag(P), not
+        collapse to the stencil's uniform diagonal (fast path must not
+        engage)."""
+        op = StencilPoisson3D(comm8, 8)
+        A = poisson3d_csr(8)
+        x_true = np.random.default_rng(13).random(512)
+        b = A @ x_true
+        # P with a very different diagonal: scaled identity 100 I
+        import scipy.sparse as sp
+        P_mat = tps.Mat.from_scipy(comm8, sp.eye(512, format="csr") * 100.0)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op, P_mat)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-10, max_it=500)
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7, atol=1e-9)
+        # jacobi with diag(P)=100I is CG on A scaled: same search directions
+        # as unpreconditioned CG; iteration count must match pc 'none', and
+        # the uniform-diag fast path (which would use diag(A)=6) is bypassed
+        ksp2 = tps.KSP().create(comm8)
+        ksp2.set_operators(op)
+        ksp2.set_type("cg")
+        ksp2.get_pc().set_type("none")
+        ksp2.set_tolerances(rtol=1e-10, max_it=500)
+        x2, bv2 = op.get_vecs()
+        bv2.set_global(b)
+        res2 = ksp2.solve(bv2, x2)
+        assert res.iterations == res2.iterations
+
+    def test_cg_fast_path_monitor_and_norm_none(self, comm8):
+        """Fast path keeps monitor callbacks and the norm-type-'none'
+        fixed-iteration contract."""
+        op = StencilPoisson3D(comm8, 8)
+        A = poisson3d_csr(8)
+        b = A @ np.random.default_rng(12).random(512)
+        seen = []
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-8, max_it=200)
+        ksp.set_monitor(lambda k, it, rn: seen.append((it, rn)))
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        assert len(seen) == res.iterations
+        assert seen[-1][1] <= seen[0][1]
+
+        ksp2 = tps.KSP().create(comm8)
+        ksp2.set_operators(op)
+        ksp2.set_type("cg")
+        ksp2.get_pc().set_type("jacobi")
+        ksp2.set_norm_type("none")
+        ksp2.set_tolerances(rtol=0.0, atol=0.0, max_it=37)
+        x2, bv2 = op.get_vecs()
+        bv2.set_global(b)
+        res2 = ksp2.solve(bv2, x2)
+        assert res2.iterations == 37
+        assert res2.reason == tps.ConvergedReason.CONVERGED_ITS
+
+
 class TestMultigridPC:
     def test_mg_cg_iteration_count(self, comm8):
         """V-cycle PC: CG iterations stay ~constant in mesh size."""

@@ -12,7 +12,8 @@ import pytest
 
 import jax.numpy as jnp
 
-from mpi_petsc4py_example_tpu.ops.pallas_stencil import stencil3d_apply_pallas
+from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+    stencil3d_apply_pallas, stencil3d_dot_pallas)
 
 
 def reference_stencil(u, lo, hi):
@@ -45,6 +46,30 @@ def test_interpret_parity(lz, max_chunk):
     ref = reference_stencil(u.astype(np.float64), lo.astype(np.float64),
                             hi.astype(np.float64))
     np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("lz,max_chunk", [
+    (4, None),   # single chunk
+    (6, 2),      # nchunks == 3
+    (8, 1),      # chunk == 1 plane
+])
+def test_fused_dot_parity(lz, max_chunk):
+    """stencil3d_dot_pallas returns (A u, <u, A u>) matching the plain
+    kernel + a separate dot — the fused reduction CG's fast path relies on
+    (krylov.cg_stencil_kernel)."""
+    ny, nx = 8, 128
+    rng = np.random.default_rng(100 + lz)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    lo = rng.random((1, ny, nx)).astype(np.float32)
+    hi = rng.random((1, ny, nx)).astype(np.float32)
+    y, dot = stencil3d_dot_pallas(
+        jnp.asarray(u), jnp.asarray(lo), jnp.asarray(hi),
+        lz, ny, nx, True, max_chunk)
+    ref = reference_stencil(u.astype(np.float64), lo.astype(np.float64),
+                            hi.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(dot), float((u.astype(np.float64)
+                                                  * ref).sum()), rtol=1e-5)
 
 
 def test_zero_halos_dirichlet():
