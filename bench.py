@@ -132,8 +132,10 @@ def on_chip_rate(nx, reps=3, lo=20, hi=520):
             hi2 = max(int(actual * 0.9), hi)
             if hi2 not in solvers:
                 solvers[hi2] = _fixed_iter_solver(nx, hi2)
+            # the delta stayed shorter than intended — compensate with
+            # extra samples beyond the user's --reps
+            reps = max(reps, 5)
         hi = hi2
-    reps = max(reps, 5)               # short deltas need the extra samples
     return [one_delta(lo, hi)[0] for _ in range(reps)]
 
 

@@ -171,7 +171,13 @@ def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
                 max_chunk: int | None):
     """z-chunk that divides ``lz`` and keeps ~<=2MB per VMEM bank — the one
     pipeline geometry both kernel entry points share."""
-    budget = (2 << 20) // (ny * nx * itemsize)
+    plane = ny * nx * itemsize
+    budget = (2 << 20) // plane
+    # total scoped VMEM is 2 input banks of (chunk+2) planes + 2 output banks
+    # of chunk planes = (4*chunk+4) planes, plus shift temporaries — keep it
+    # ~<=10MB of the 16MB scoped limit (512-wide planes OOM'd at the 2MB
+    # budget alone: 16.39M > 16M)
+    budget = min(budget, int(((10 << 20) // plane - 4) // 4))
     if max_chunk is not None:
         budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
     chunk = max(1, min(lz, budget))

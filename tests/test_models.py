@@ -92,6 +92,34 @@ class TestStencil:
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-7, atol=1e-9)
 
 
+    def test_cg_fast_path_engages(self, comm8, monkeypatch):
+        """Guard against the dispatch silently regressing: the headline
+        stencil+jacobi+cg+unroll=1 configuration must actually select
+        cg_stencil_kernel (the parity tests below would pass vacuously if
+        both runs fell back to the generic kernel)."""
+        from mpi_petsc4py_example_tpu.solvers import krylov
+        calls = []
+        orig = krylov.cg_stencil_kernel
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(krylov, "cg_stencil_kernel", spy)
+        # unique grid shape: a program cached by another test for the same
+        # (mesh, operator key, pc) would bypass kernel construction entirely
+        op = StencilPoisson3D(comm8, 4, 6, 16)
+        b = poisson3d_csr(4, 6, 16) @ np.random.default_rng(10).random(384)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-8)
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        assert ksp.solve(bv, x).converged
+        assert calls, "stencil-CG fast path did not engage"
+
     @pytest.mark.parametrize("pc_type", ["jacobi", "none"])
     def test_cg_fast_path_matches_generic_kernel(self, comm8, pc_type):
         """The fused stencil-CG fast path (krylov.cg_stencil_kernel, engaged
