@@ -98,15 +98,18 @@ def _fixed_iter_solver(nx, max_it):
     return ksp, x, bv
 
 
-def on_chip_rate(nx, reps=3, lo=20, hi=520):
-    """Delta-method per-iteration time for CG+Jacobi at nx^3 (see module
-    docstring); returns per_iter_seconds list.
+def delta_rate(make_solver, reps=3, lo=20, hi=520, autoscale=True):
+    """Delta-method on-chip per-iteration time (see module docstring);
+    returns a per_iter_seconds list.
 
-    The iteration delta is auto-scaled so the measured loop time is well
-    above the run-to-run launch-latency noise (~tens of ms): a pilot delta
-    estimates the rate, then ``hi`` is re-chosen for ~0.75 s of loop work.
+    ``make_solver(max_it) -> (ksp, x, bv)`` builds a warmed fixed-iteration
+    solver (norm type 'none'). The iteration delta is auto-scaled so the
+    measured loop time is well above the run-to-run launch-latency noise
+    (~tens of ms): a pilot delta estimates the rate, then ``hi`` is
+    re-chosen for ~0.75 s of loop work. The one measurement protocol shared
+    by bench.py and benchmarks/run_all.py (config 5).
     """
-    solvers = {m: _fixed_iter_solver(nx, m) for m in (lo, hi)}
+    solvers = {m: make_solver(m) for m in (lo, hi)}
 
     def one_delta(a, b_):
         ws, its = {}, {}
@@ -124,19 +127,25 @@ def on_chip_rate(nx, reps=3, lo=20, hi=520):
 
     pilot, _ = one_delta(lo, hi)
     target = int(0.75 / max(pilot, 1e-7))
-    if target > 2 * (hi - lo):        # delta too small for the noise floor
+    if autoscale and target > 2 * (hi - lo):  # delta too small for the noise
         hi2 = lo + min(target, 200000)
-        solvers[hi2] = _fixed_iter_solver(nx, hi2)
+        solvers[hi2] = make_solver(hi2)
         _, actual = one_delta(lo, hi2)
         if actual < hi2:              # recurrence blow-up: stay under it
             hi2 = max(int(actual * 0.9), hi)
             if hi2 not in solvers:
-                solvers[hi2] = _fixed_iter_solver(nx, hi2)
+                solvers[hi2] = make_solver(hi2)
             # the delta stayed shorter than intended — compensate with
             # extra samples beyond the user's --reps
             reps = max(reps, 5)
         hi = hi2
     return [one_delta(lo, hi)[0] for _ in range(reps)]
+
+
+def on_chip_rate(nx, reps=3, lo=20, hi=520):
+    """Delta-method per-iteration time for CG+Jacobi at nx^3."""
+    return delta_rate(lambda m: _fixed_iter_solver(nx, m),
+                      reps=reps, lo=lo, hi=hi)
 
 
 def cpu_baseline(nx, b: np.ndarray, rtol: float):

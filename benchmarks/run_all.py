@@ -160,8 +160,9 @@ def config5(comm, quick):
     r = b - np.asarray(op.mult(tps.Vec.from_global(comm, x)).to_numpy())
     rres = float(np.linalg.norm(r) / np.linalg.norm(b))
 
-    # on-chip rate, delta method (see bench.py): two fixed-iteration solvers
-    # built once (program cache already warm from solve() above)
+    # on-chip rate: the shared delta-method protocol (bench.delta_rate)
+    from bench import delta_rate
+
     def make_fixed(max_it):
         ksp = tps.KSP().create(comm)
         ksp.set_operators(op)
@@ -171,20 +172,11 @@ def config5(comm, quick):
         ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=max_it)
         xv, bv = op.get_vecs()
         bv.set_global(b)
+        ksp.solve(bv, xv)     # warm (program cache shared with solve())
         return ksp, xv, bv
 
-    lo_it = 20
-    hi_it = 120 if quick else 320
-    solvers = {m: make_fixed(m) for m in (lo_it, hi_it)}
-    pers = []
-    for _ in range(3):
-        ws, its = {}, {}
-        for m, (ksp, xv, bv) in solvers.items():
-            xv.zero()
-            t0 = time.perf_counter()
-            rr = ksp.solve(bv, xv)
-            ws[m], its[m] = time.perf_counter() - t0, rr.iterations
-        pers.append((ws[hi_it] - ws[lo_it]) / max(its[hi_it] - its[lo_it], 1))
+    pers = delta_rate(make_fixed, reps=3, lo=20,
+                      hi=120 if quick else 320, autoscale=not quick)
     per = float(np.median(pers))
     return dict(config="cfg5_poisson3d_sharded_stencil", n=n,
                 devices=ndev, iters=res.iterations, wall_s=round(wall, 4),

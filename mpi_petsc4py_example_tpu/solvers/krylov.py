@@ -1515,9 +1515,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 f"KSP 'bicg' needs a preconditioner with a transpose apply "
                 f"(PCApplyTranspose); pc {pc.get_type()!r} provides none — "
                 "supported: none/jacobi, the block kinds (bjacobi/sor/ssor/"
-                "ilu/icc), lu/cholesky, composite-additive of those, and "
-                "shell with set_shell_apply_transpose; or use bcgs/gmres/"
-                "gcr for general preconditioning")
+                "ilu/icc), lu/cholesky (dense mode; the large-n tridiagonal "
+                "cyclic-reduction mode has no transpose), composite-additive "
+                "of those, and shell with set_shell_apply_transpose; or use "
+                "bcgs/gmres/gcr for general preconditioning")
     # CG fast path: matrix-free stencil operators with a uniform diagonal
     # and PC none/jacobi get the fused matvec+dot kernel and the scalar
     # Jacobi identities (see cg_stencil_kernel). Dispatch is part of the

@@ -67,6 +67,10 @@ class PC:
         self._mat: Mat | None = None
         self._arrays = ()
         self._built_for = None
+        self._factor_mode = "dense"  # 'dense' | 'crtri' (set in set_up for
+                                     # lu/cholesky: tridiagonal operators
+                                     # past the dense cap use parallel
+                                     # cyclic reduction, solvers/tridiag.py)
         self.sor_omega = 1.0        # -pc_sor_omega (PETSc default 1)
         self.asm_overlap = 1        # -pc_asm_overlap (PETSc default 1)
         self.factor_fill = 10.0     # -pc_factor_fill (spilu fill_factor)
@@ -223,7 +227,14 @@ class PC:
         elif t == "asm":
             self._arrays = _build_asm(comm, mat, self.asm_overlap)
         elif t in ("lu", "cholesky"):
-            self._arrays = _build_dense_lu(comm, mat)
+            if (mat.shape[0] > _DENSE_CAP
+                    and set(getattr(mat, "dia_offsets", ())) and
+                    set(mat.dia_offsets) <= {-1, 0, 1}):
+                self._arrays = _build_tridiag_cr(comm, mat)
+                self._factor_mode = "crtri"
+            else:
+                self._arrays = _build_dense_lu(comm, mat)
+                self._factor_mode = "dense"
         elif t in ("gamg", "amg"):
             from .amg import AMGHierarchy
             if not hasattr(mat, "to_scipy"):
@@ -273,6 +284,8 @@ class PC:
     @property
     def kind(self) -> str:
         t = self._type
+        if t in ("lu", "cholesky") and self._factor_mode == "crtri":
+            return "crtri"
         if t == "cholesky":
             return "lu"
         if t == "amg":
@@ -294,6 +307,9 @@ class PC:
             return (self.kind, int(self.asm_overlap))
         if self.kind == "gamg":
             return self._amg.program_key()
+        if self.kind == "crtri":
+            # sweep count is baked into the apply loop
+            return ("crtri", int(self._arrays[0].shape[0]))
         if self.kind == "shell":
             return ("shell", self._shell_uid)
         if self.kind == "composite":
@@ -319,6 +335,8 @@ class PC:
             return (P(axis),)
         if k == "lu":
             return (P(),)
+        if k == "crtri":
+            return (P(), P(), P())   # replicated (S,n) alphas/gammas, (n,) b
         if k == "gamg":
             return self._amg.in_specs()
         if k == "shell":
@@ -385,6 +403,20 @@ class PC:
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
             return apply
+        if k == "crtri":
+            from .tridiag import pcr_apply
+            n_pad = comm.padded_size(n)
+
+            def apply(arrs, r):
+                alphas, gammas, bfin = arrs
+                r_full = lax.all_gather(r, axis, tiled=True)
+                x = pcr_apply(r_full[:n], alphas, gammas, bfin)
+                if n_pad > n:     # padding slots pass through as zero
+                    x = jnp.concatenate(
+                        [x, jnp.zeros((n_pad - n,), x.dtype)])
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(x, i * lsize, lsize)
+            return apply
         if k == "gamg":
             return self._amg.local_apply(comm)
         if k == "shell":
@@ -446,13 +478,21 @@ class PC:
         their shipped explicit inverses ((B⁻¹)ᵀ = (Bᵀ)⁻¹ — one transposed
         batched matvec); composite-additive sums its children's transposes;
         shell uses the user's ``set_shell_apply_transpose`` function.
-        asm/mg/gamg/composite-multiplicative provide none.
+        asm/mg/gamg/composite-multiplicative provide none, as does lu in
+        cyclic-reduction mode (the PCR sweeps factorize A, not Aᵀ; shipping
+        a second factorization for the rare transpose user would double the
+        replicated setup memory — recorded in PARITY.md).
         """
         k = self.kind
         axis = comm.axis
         lsize = comm.local_size(n)
         if k in ("none", "jacobi"):
             return self.local_apply(comm, n)      # diagonal: symmetric
+        if k == "crtri" and self._type == "cholesky":
+            # cholesky's contract is a symmetric operator: M = M^T, the
+            # forward PCR apply IS the transpose apply, no second
+            # factorization needed (lu makes no symmetry promise -> None)
+            return self.local_apply(comm, n)
         if k == "bjacobi":
             def apply_t(arrs, r):
                 binv = arrs[0]  # (nb, bs, bs) explicit block inverses
@@ -686,6 +726,37 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
     return _ship_blocks(comm, inv, mat.dtype)
 
 
+_CR_CAP = 1 << 23  # replicated (S, n) sweep arrays: ~2.7 GB fp64 at 8.4M rows
+
+
+def _build_tridiag_cr(comm: DeviceComm, mat: Mat):
+    """Parallel-cyclic-reduction factorization of a tridiagonal operator —
+    the scalable direct path the dense cap excluded (MUMPS slot for exactly
+    the banded family ``test2.py:6-18`` ships; SURVEY.md §7.4-1).
+
+    Host fp64 setup once (the MUMPS symbolic+numeric analog at setUp,
+    reference stack §3.1); the device apply is ``ceil(log2 n)`` shifted
+    fused multiply-add sweeps over the gathered rhs (solvers/tridiag.py).
+    """
+    from .tridiag import pcr_setup
+    _require_assembled(mat, "lu")
+    n = mat.shape[0]
+    if n > _CR_CAP:
+        raise ValueError(
+            f"PC 'lu' (cyclic reduction) replicates ceil(log2 n) sweep "
+            f"arrays; n={n} exceeds the {_CR_CAP} cap — use an iterative "
+            "KSP with pc 'jacobi'/'gamg' instead")
+    A = mat.to_scipy().tocsr()
+    a = np.concatenate([[0.0], np.asarray(A.diagonal(-1))])
+    b = np.asarray(A.diagonal(0))
+    c = np.concatenate([np.asarray(A.diagonal(1)), [0.0]])
+    alphas, gammas, bfin = pcr_setup(a, b, c)
+    dt = mat.dtype
+    return (comm.put_replicated(alphas.astype(dt)),
+            comm.put_replicated(gammas.astype(dt)),
+            comm.put_replicated(bfin.astype(dt)))
+
+
 def _build_dense_lu(comm: DeviceComm, mat: Mat):
     """Replicated dense inverse of the full operator (the MUMPS-slot path).
 
@@ -697,8 +768,10 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     n = mat.shape[0]
     if n > _DENSE_CAP:
         raise ValueError(
-            f"PC 'lu' densifies the operator; n={n} is too large — use an "
-            "iterative KSP with pc 'bjacobi'/'jacobi' instead (SURVEY.md §7.4)")
+            f"PC 'lu' densifies general operators; n={n} is too large — "
+            "tridiagonal operators take the cyclic-reduction direct path "
+            "automatically; otherwise use an iterative KSP with pc "
+            "'bjacobi'/'jacobi' instead (SURVEY.md §7.4)")
     A = mat.to_scipy().toarray().astype(np.float64)
     inv = scipy.linalg.inv(A)
     n_pad = comm.padded_size(n)

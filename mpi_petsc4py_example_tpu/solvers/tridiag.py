@@ -1,0 +1,154 @@
+"""Parallel cyclic reduction (PCR) — the scalable direct solver for
+tridiagonal operators.
+
+The reference's MUMPS slot (``test.py:41-43``: PC 'lu' +
+``setFactorSolverType('mumps')``) factorizes arbitrarily large sparse
+systems; a general multifrontal solver has no TPU-friendly equivalent
+(SURVEY.md §7.4-1), but the *banded* family the reference itself ships —
+``test2.py:6-18`` builds a symmetric tridiagonal — admits cyclic reduction,
+which is pure data-parallel arithmetic: ``ceil(log2 n)`` sweeps of shifted
+elementwise fused multiply-adds, no elimination tree, no pivot search, no
+sequential recursion. Exactly the shape the VPU wants.
+
+Split chosen here (mirrors how the block preconditioners are built):
+
+- **setup on host, fp64** (:func:`pcr_setup`): the coefficient transforms
+  of PCR do not involve the right-hand side, so the per-sweep reduction
+  multipliers ``(alpha_k, gamma_k)`` and the final diagonal are precomputed
+  once per factorization — the analog of MUMPS's symbolic+numeric phase at
+  ``ksp.setUp()`` (reference call stack, SURVEY.md §3.1).
+- **apply on device** (:func:`pcr_apply`): per solve, ``S = ceil(log2 n)``
+  sweeps of ``d += alpha * shift(d, +2^k) + gamma * shift(d, -2^k)`` then
+  one divide — O(n log n) work, O(n) memory traffic per sweep, all static
+  shapes/shifts so XLA fuses each sweep into one pass.
+
+PCR is pivotless: like Thomas/cyclic-reduction solvers everywhere, it is
+exact for diagonally dominant / SPD tridiagonal systems and runs in fp64 by
+default; KSPPREONLY's iterative-refinement steps polish the rest (see
+``krylov.preonly_kernel``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray):
+    """Precompute PCR sweep coefficients for the tridiagonal (a, b, c).
+
+    ``a`` is the subdiagonal (a[0] ignored/0), ``b`` the diagonal, ``c``
+    the superdiagonal (c[-1] ignored/0), all length n, fp64.
+
+    Returns ``(alphas, gammas, bfin)``: two (S, n) arrays of per-sweep
+    neighbour multipliers (S = ceil(log2 n)) and the length-n fully-reduced
+    diagonal, such that for any rhs d::
+
+        for k in range(S):
+            s = 1 << k
+            d = d + alphas[k] * shift_up(d, s) + gammas[k] * shift_down(d, s)
+        x = d / bfin
+
+    where ``shift_up(d, s)[i] = d[i-s]`` (zero fill) and ``shift_down``
+    mirrors it. Rows beyond either end behave as identity equations.
+    """
+    a = np.asarray(a, np.float64).copy()
+    b = np.asarray(b, np.float64).copy()
+    c = np.asarray(c, np.float64).copy()
+    n = b.shape[0]
+    if n == 0:
+        raise ValueError("pcr_setup: empty system")
+    a[0] = 0.0
+    c[-1] = 0.0
+    if np.any(b == 0):
+        raise ValueError(
+            "PCR hit a zero diagonal entry — the pivotless tridiagonal "
+            "reduction needs a nonzero (ideally dominant) diagonal; use an "
+            "iterative KSP with pc 'jacobi'/'gamg' instead")
+    b0_mul_ones = a + b + c   # A · ones, for the post-setup probe solve
+    S = max(1, int(np.ceil(np.log2(n)))) if n > 1 else 1
+    alphas = np.zeros((S, n), np.float64)
+    gammas = np.zeros((S, n), np.float64)
+
+    def up(v, s):      # v[i-s], identity-row fill
+        return np.concatenate([np.zeros(s), v[:-s]]) if s < n else \
+            np.zeros(n)
+
+    def down(v, s):    # v[i+s]
+        return np.concatenate([v[s:], np.zeros(s)]) if s < n else \
+            np.zeros(n)
+
+    def upb(v, s):     # diagonal of identity rows is 1, not 0
+        return np.concatenate([np.ones(s), v[:-s]]) if s < n else np.ones(n)
+
+    def downb(v, s):
+        return np.concatenate([v[s:], np.ones(s)]) if s < n else np.ones(n)
+
+    for k in range(S):
+        s = 1 << k
+        alpha = -a / upb(b, s)
+        gamma = -c / downb(b, s)
+        alphas[k] = alpha
+        gammas[k] = gamma
+        a_new = alpha * up(a, s)
+        c_new = gamma * down(c, s)
+        b_new = b + alpha * up(c, s) + gamma * down(a, s)
+        if np.any(b_new == 0) or not np.all(np.isfinite(b_new)):
+            raise ValueError(
+                "PCR reduction broke down (zero/non-finite reduced "
+                "diagonal) — the pivotless factorization is unstable for "
+                "this matrix; use an iterative KSP with pc 'jacobi'/'gamg'")
+        a, b, c = a_new, b_new, c_new
+    if np.any(a != 0) or np.any(c != 0):
+        raise AssertionError("PCR did not fully reduce — internal error")
+    # factorization probe: zero/inf sweeps are caught above, but pivotless
+    # element growth can also destroy accuracy while every intermediate
+    # stays finite (e.g. a tiny diagonal under large off-diagonals). Solve
+    # one known system (A·1) and demand the answer back — the direct-path
+    # analog of MUMPS's backward-error analysis.
+    d1 = b0_mul_ones
+    x1 = pcr_apply_np(d1, alphas, gammas, b)
+    # threshold: catastrophic growth yields errors of order >= 1, while
+    # legitimate ill-conditioning stays ~kappa*eps (<= ~1e-4 at kappa 1e12)
+    if not np.all(np.isfinite(x1)) or np.max(np.abs(x1 - 1.0)) > 1e-3:
+        raise ValueError(
+            "PCR factorization failed its probe solve (pivotless element "
+            "growth) — this tridiagonal needs a pivoted factorization; use "
+            "an iterative KSP with pc 'jacobi'/'gamg' instead")
+    return alphas, gammas, b
+
+
+def pcr_apply_np(d, alphas, gammas, bfin):
+    """Host-numpy mirror of :func:`pcr_apply` — used by the setup-time
+    factorization probe (and as an oracle in tests)."""
+    d = np.asarray(d, np.float64).copy()
+    n = d.shape[0]
+    for k in range(alphas.shape[0]):
+        s = 1 << k
+        du = np.concatenate([np.zeros(s), d[:-s]]) if s < n else \
+            np.zeros(n)
+        dd = np.concatenate([d[s:], np.zeros(s)]) if s < n else \
+            np.zeros(n)
+        d = d + alphas[k] * du + gammas[k] * dd
+    return d / bfin
+
+
+def pcr_apply(d, alphas, gammas, bfin):
+    """Device-side PCR solve: apply the precomputed sweeps to rhs ``d``.
+
+    ``d`` is the full-length (n,) rhs; arrays as from :func:`pcr_setup`
+    (any common floating dtype). Pure jnp — callable inside jit/shard_map.
+    """
+    import jax.numpy as jnp
+
+    n = d.shape[0]
+    S = alphas.shape[0]
+    for k in range(S):
+        s = 1 << k
+        if s < n:
+            du = jnp.concatenate([jnp.zeros((s,), d.dtype), d[:-s]])
+            dd = jnp.concatenate([d[s:], jnp.zeros((s,), d.dtype)])
+        else:
+            du = jnp.zeros_like(d)
+            dd = jnp.zeros_like(d)
+        d = d + alphas[k] * du + gammas[k] * dd
+    return d / bfin

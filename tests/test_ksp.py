@@ -144,9 +144,14 @@ class TestDirect:
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-10)
 
     def test_lu_rejects_huge(self, comm1):
+        """Past the dense cap, general (non-tridiagonal) operators are
+        rejected; banded ones take the cyclic-reduction path instead
+        (tests/test_tridiag.py)."""
         pc = tps.PC()
         pc.set_type("lu")
-        A = sp.eye(30000, format="csr")
+        n = 30000
+        A = sp.diags([np.full(n, 4.0), np.full(n - 9000, 0.5)],
+                     [0, 9000], format="csr")
         M = tps.Mat.from_scipy(comm1, A)
         with pytest.raises(ValueError, match="too large"):
             pc.set_up(M)

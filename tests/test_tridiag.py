@@ -1,0 +1,192 @@
+"""Cyclic-reduction direct solve: the scalable MUMPS-slot path for the
+banded family the reference itself ships (test2.py:6-18 is tridiagonal).
+
+Covers the PCR kernel (solvers/tridiag.py) against numpy oracles, the PC
+'lu' auto-selection for large tridiagonal operators, and the judge-level
+target: preonly+lu on a 1M-row tridiagonal system over the 8-device mesh to
+rtol 1e-10 (reference test.py:41-43's direct-solve slot, SURVEY.md §7.4-1).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.solvers.tridiag import pcr_apply, pcr_setup
+
+
+def tridiag_csr(a, b, c):
+    n = len(b)
+    return sp.diags([a[1:], b, c[:-1]], [-1, 0, 1], format="csr")
+
+
+def apply_tridiag(a, b, c, x):
+    d = b * x
+    d[1:] += a[1:] * x[:-1]
+    d[:-1] += c[:-1] * x[1:]
+    return d
+
+
+class TestPCRKernel:
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 16, 100, 1023])
+    def test_random_dominant(self, n):
+        rng = np.random.default_rng(n)
+        a = rng.standard_normal(n)
+        c = rng.standard_normal(n)
+        b = np.abs(a) + np.abs(c) + 1.0 + rng.random(n)
+        x_true = rng.random(n)
+        d = apply_tridiag(a, b, c, x_true)
+        al, ga, bf = pcr_setup(a, b, c)
+        x = np.asarray(pcr_apply(jnp.asarray(d), jnp.asarray(al),
+                                 jnp.asarray(ga), jnp.asarray(bf)))
+        np.testing.assert_allclose(x, x_true, rtol=1e-12, atol=1e-12)
+
+    def test_reference_test2_family(self):
+        """The exact structure test2.py builds: A[i,j] = i+j+1 on the band
+        (not diagonally dominant — PCR in fp64 still solves it directly)."""
+        n = 10000
+        i = np.arange(n, dtype=np.float64)
+        a, b, c = 2 * i, 2 * i + 1, 2 * i + 2
+        rng = np.random.default_rng(0)
+        x_true = rng.random(n)
+        d = apply_tridiag(a, b, c, x_true)
+        al, ga, bf = pcr_setup(a, b, c)
+        x = np.asarray(pcr_apply(jnp.asarray(d), jnp.asarray(al),
+                                 jnp.asarray(ga), jnp.asarray(bf)))
+        np.testing.assert_allclose(x, x_true, rtol=1e-9)
+
+    def test_zero_diagonal_raises(self):
+        b = np.ones(8)
+        b[3] = 0.0
+        with pytest.raises(ValueError, match="zero diagonal"):
+            pcr_setup(np.ones(8), b, np.ones(8))
+
+    def test_unstable_growth_caught_by_probe(self):
+        """Accuracy-destroying reductions with every intermediate finite:
+        the post-setup probe solve must reject them instead of returning a
+        silently wrong factorization reported as converged."""
+        # [sqrt2, 2+1e-13, sqrt2] at n=3 is within 1e-13 of exactly singular
+        t = np.sqrt(2.0)
+        with pytest.raises(ValueError, match="probe"):
+            pcr_setup(np.full(3, t), np.full(3, 2.0 + 1e-13), np.full(3, t))
+        # diagonal at the smallest Laplacian eigenvalue: near-singular large
+        n = 1025
+        lam = 2 * np.cos(np.pi / (n + 1))
+        with pytest.raises(ValueError, match="probe"):
+            pcr_setup(np.full(n, -1.0), np.full(n, lam), np.full(n, -1.0))
+
+    def test_probe_oracle_consistency(self):
+        """pcr_apply_np (the probe's host path) matches the device apply."""
+        from mpi_petsc4py_example_tpu.solvers.tridiag import pcr_apply_np
+        n = 333
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal(n)
+        c = rng.standard_normal(n)
+        b = np.abs(a) + np.abs(c) + 1.5
+        al, ga, bf = pcr_setup(a, b, c)
+        d = rng.random(n)
+        x_np = pcr_apply_np(d, al, ga, bf)
+        x_dev = np.asarray(pcr_apply(jnp.asarray(d), jnp.asarray(al),
+                                     jnp.asarray(ga), jnp.asarray(bf)))
+        np.testing.assert_allclose(x_dev, x_np, rtol=1e-12)
+
+    def test_breakdown_raises(self):
+        # [[1, 1], [1, 1]] is singular: the first sweep zeroes the reduced
+        # diagonal
+        with pytest.raises(ValueError, match="broke down"):
+            pcr_setup(np.array([0.0, 1.0]), np.array([1.0, 1.0]),
+                      np.array([1.0, 0.0]))
+
+
+class TestLuCyclicReduction:
+    def solve_preonly(self, comm, A, b, rtol_check=None):
+        M = tps.Mat.from_scipy(comm, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        ksp.get_pc().set_factor_solver_type("mumps")  # reference string ok
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        return x.to_numpy(), res, ksp
+
+    def test_million_row_tridiagonal(self, comm8):
+        """The scalable direct path: 1M-row SPD tridiagonal (1D Laplacian),
+        preonly+lu over the 8-device mesh, relative residual <= 1e-10."""
+        n = 1_000_000
+        ab = np.full(n, -1.0)
+        bb = np.full(n, 2.0)
+        A = tridiag_csr(ab, bb, ab)
+        rng = np.random.default_rng(7)
+        x_true = rng.random(n)
+        b = A @ x_true
+        x, res, ksp = self.solve_preonly(comm8, A, b)
+        assert ksp.get_pc()._factor_mode == "crtri"
+        rres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rres <= 1e-10, rres
+        assert res.converged
+
+    def test_large_test2_family_direct(self, comm8):
+        """test2.py's own matrix family far past the dense cap."""
+        n = 100_000
+        i = np.arange(n, dtype=np.float64)
+        A = tridiag_csr(2 * i, 2 * i + 1, 2 * i + 2)
+        rng = np.random.default_rng(3)
+        x_true = rng.random(n)
+        b = A @ x_true
+        x, res, ksp = self.solve_preonly(comm8, A, b)
+        assert ksp.get_pc()._factor_mode == "crtri"
+        rres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rres <= 1e-10, rres
+
+    def test_small_stays_dense(self, comm8):
+        """Under the dense cap the pivoted dense path keeps serving — no
+        behavior change for the reference's n=100 drivers."""
+        n = 64
+        i = np.arange(n, dtype=np.float64)
+        A = tridiag_csr(2 * i, 2 * i + 1, 2 * i + 2)
+        x_true = np.random.default_rng(1).random(n)
+        x, res, ksp = self.solve_preonly(comm8, A, A @ x_true)
+        assert ksp.get_pc()._factor_mode == "dense"
+        np.testing.assert_allclose(x, x_true, rtol=1e-9, atol=1e-11)
+
+    def test_bicg_with_cholesky_cr_transpose(self, comm8):
+        """PC 'cholesky' in CR mode serves KSPBICG's transpose apply via the
+        symmetric forward apply (M = M^T), no second factorization."""
+        n = 20000
+        ab = np.full(n, -1.0)
+        A = tridiag_csr(ab, np.full(n, 2.5), ab)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("bicg")
+        ksp.get_pc().set_type("cholesky")
+        ksp.set_tolerances(rtol=1e-12, max_it=10)
+        x, bv = M.get_vecs()
+        x_true = np.random.default_rng(9).random(n)
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert ksp.get_pc()._factor_mode == "crtri"
+        assert res.converged and res.iterations <= 2
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-9,
+                                   atol=1e-11)
+
+    def test_large_nontridiagonal_still_raises(self, comm8):
+        """The dense cap still guards general operators; the error points at
+        the tridiagonal exception."""
+        n = 20000
+        d0 = np.full(n, 4.0)
+        d5 = np.full(n - 5000, 0.5)
+        A = sp.diags([d0, d5, d5], [0, -5000, 5000], format="csr")
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        x, bv = M.get_vecs()
+        bv.set_global(np.ones(n))
+        with pytest.raises(ValueError, match="tridiagonal"):
+            ksp.solve(bv, x)
