@@ -174,6 +174,18 @@ class TestLuCyclicReduction:
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-9,
                                    atol=1e-11)
 
+    def test_cholesky_cr_rejects_unsymmetric(self, comm8):
+        """cholesky's symmetric-operator contract is enforced in CR mode
+        (its transpose-apply reuse depends on it; PETSc errors likewise)."""
+        n = 20000
+        A = tridiag_csr(np.full(n, -1.0), np.full(n, 4.0),
+                        np.full(n, -2.0))              # sub != super
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        pc = tps.PC()
+        pc.set_type("cholesky")
+        with pytest.raises(ValueError, match="symmetric"):
+            pc.set_up(M)
+
     def test_large_nontridiagonal_still_raises(self, comm8):
         """The dense cap still guards general operators; the error points at
         the tridiagonal exception."""
