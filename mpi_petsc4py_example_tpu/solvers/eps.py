@@ -49,6 +49,7 @@ from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
 from ..utils.convergence import SolveResult
 from ..utils.options import global_options
+from ..utils.profiling import record_sync
 from .st import ST
 
 DEFAULT_TOL = 1e-8        # SLEPc's EPS default
@@ -630,7 +631,12 @@ class EPS:
         for restarts in range(1, self.max_it + 1):
             V, H = prog(op_arrays, b_arrays, V, H,
                         np.asarray(k, dtype=np.int32))
+            # the ONE blocking D2H point per restart: the small replicated
+            # projected matrix (the basis V stays on device; the restart
+            # compression is a device matmul). Counted because on remote
+            # runtimes this fetch, not the ncv SpMVs, dominates the cycle.
             Hh = np.asarray(H, dtype=np.float64)
+            record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
             if nconv >= nev or ncv >= n or restarts == self.max_it:
@@ -673,6 +679,7 @@ class EPS:
             V = restart_prog(V, S_pad, np.asarray(k, dtype=np.int32))
 
         Vh = comm.host_fetch(V)[:ncv]
+        record_sync("EPS basis fetch/solve")
         count = max(nev, 1)
         lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
         self._store(lam, vecs, rel[:count], nconv, restarts)
@@ -697,6 +704,7 @@ class EPS:
             V, H = prog(op_arrays, b_arrays, V, H,
                         np.asarray(0, dtype=np.int32))
             Hh = np.asarray(H, dtype=np.float64)
+            record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
             if nconv >= nev or ncv >= n or restarts == self.max_it:
@@ -707,6 +715,7 @@ class EPS:
             V = restart_prog(V, wanted)
 
         Vh = comm.host_fetch(V)[:ncv]
+        record_sync("EPS basis fetch/solve")
         count = max(nev, 1)
         lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
         self._store(lam, vecs, rel[:count], nconv, restarts)
@@ -732,6 +741,7 @@ class EPS:
             v, theta_a, res_a = prog(op_arrays, v)
             theta = float(theta_a)
             res = float(res_a)
+            record_sync("EPS power fetch/chunk", 2)
             rel = res / max(abs(theta), 1e-300)
             its = chunk * steps
             if rel <= self.tol:
@@ -739,6 +749,7 @@ class EPS:
 
         lam = self.st.back_transform(np.asarray([theta]))
         vec = comm.host_fetch(v)[:n]
+        record_sync("EPS basis fetch/solve")
         nrm = np.linalg.norm(vec)
         vec = vec / (nrm if nrm else 1.0)
         self._store(lam, vec[None, :], [rel], 1 if rel <= self.tol else 0,
@@ -774,6 +785,7 @@ class EPS:
             Qp = np.zeros((ncv, npad), dtype=dtype)
             Qp[:, :n] = Q
             W = comm.host_fetch(prog(op_arrays, comm.put_spec(Qp, P(None, comm.axis))))
+            record_sync("EPS subspace fetch/iter")
             Hm = Q @ W[:, :n].T           # Hm[i,j] = <q_i, A q_j>, W[j] = A q_j
             if hermitian:
                 Hm = (Hm + Hm.T) / 2.0
@@ -854,6 +866,7 @@ class EPS:
             Mp[:, :n] = M_host
             out = comm.host_fetch(
                 which_prog(arrays, comm.put_spec(Mp, P(None, comm.axis))))
+            record_sync("EPS lobpcg fetch/block-mult")
             return out[:, :n].astype(np.float64)
 
         A_apply = lambda Mh: block_apply(prog, op_arrays, Mh)

@@ -331,6 +331,8 @@ class KSP:
             # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
             # int()/float() per scalar would pay it three times)
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
+            from ..utils.profiling import record_sync
+            record_sync("KSP result fetch/solve")
         finally:
             set_current_monitor(None)
         wall = time.perf_counter() - t0

@@ -227,11 +227,18 @@ class PC:
         elif t == "asm":
             self._arrays = _build_asm(comm, mat, self.asm_overlap)
         elif t in ("lu", "cholesky"):
+            if t == "cholesky" and hasattr(mat, "to_scipy"):
+                # PETSc's cholesky requires a symmetric operator at any
+                # size (and crtri's transpose-apply reuse depends on it)
+                D = (mat.to_scipy() - mat.to_scipy().T).tocsr()
+                if D.nnz and abs(D).max() != 0:
+                    raise ValueError(
+                        "PC 'cholesky' needs a symmetric operator — use "
+                        "pc 'lu' for unsymmetric matrices")
             if (mat.shape[0] > _DENSE_CAP
                     and set(getattr(mat, "dia_offsets", ())) and
                     set(mat.dia_offsets) <= {-1, 0, 1}):
-                self._arrays = _build_tridiag_cr(
-                    comm, mat, require_symmetric=(t == "cholesky"))
+                self._arrays = _build_tridiag_cr(comm, mat)
                 self._factor_mode = "crtri"
             else:
                 self._arrays = _build_dense_lu(comm, mat)
@@ -730,8 +737,7 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
 _CR_CAP = 1 << 23  # replicated (S, n) sweep arrays: ~2.7 GB fp64 at 8.4M rows
 
 
-def _build_tridiag_cr(comm: DeviceComm, mat: Mat,
-                      require_symmetric: bool = False):
+def _build_tridiag_cr(comm: DeviceComm, mat: Mat):
     """Parallel-cyclic-reduction factorization of a tridiagonal operator —
     the scalable direct path the dense cap excluded (MUMPS slot for exactly
     the banded family ``test2.py:6-18`` ships; SURVEY.md §7.4-1).
@@ -752,13 +758,6 @@ def _build_tridiag_cr(comm: DeviceComm, mat: Mat,
     a = np.concatenate([[0.0], np.asarray(A.diagonal(-1))])
     b = np.asarray(A.diagonal(0))
     c = np.concatenate([np.asarray(A.diagonal(1)), [0.0]])
-    if require_symmetric and not np.array_equal(a[1:], c[:-1]):
-        # PETSc's cholesky likewise errors on unsymmetric operators; the
-        # symmetry also backs crtri-cholesky's transpose-apply reuse
-        raise ValueError(
-            "PC 'cholesky' needs a symmetric operator (sub- and "
-            "superdiagonal differ) — use pc 'lu' for unsymmetric "
-            "tridiagonals")
     alphas, gammas, bfin = pcr_setup(a, b, c)
     dt = mat.dtype
     return (comm.put_replicated(alphas.astype(dt)),

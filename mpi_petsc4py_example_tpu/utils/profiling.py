@@ -34,6 +34,7 @@ class SolveEvent:
 
 
 _EVENTS: list[SolveEvent] = []
+_SYNCS: dict[str, int] = {}
 _atexit_armed = False
 
 
@@ -46,12 +47,29 @@ def record_event(what: str, n: int, iterations: int, wall: float,
         atexit.register(log_view)
 
 
+def record_sync(kind: str, count: int = 1):
+    """Count a host<->device synchronization point (a blocking D2H fetch).
+
+    On the dev runtime each such point costs a full ~0.1 s tunnel round
+    trip — far more than the device work between them — so the *count* is
+    the latency-critical metric (SURVEY.md §3.5 applied to restarts):
+    EPS restarts fetch the projected matrix once per cycle, KSP solves
+    fetch the (iters, rnorm, reason) triple once per solve.
+    """
+    _SYNCS[kind] = _SYNCS.get(kind, 0) + count
+
+
+def sync_counts() -> dict[str, int]:
+    return dict(_SYNCS)
+
+
 def events() -> list[SolveEvent]:
     return list(_EVENTS)
 
 
 def clear_events():
     _EVENTS.clear()
+    _SYNCS.clear()
 
 
 def log_view(file=None):
@@ -71,6 +89,9 @@ def log_view(file=None):
               f"{its:8.1f}", file=file)
     print("-" * 72, file=file)
     print(f"{len(_EVENTS)} solve(s), total wall {total:.4f} s", file=file)
+    if _SYNCS:
+        parts = ", ".join(f"{k}: {v}" for k, v in sorted(_SYNCS.items()))
+        print(f"host-device sync points: {parts}", file=file)
 
 
 @contextlib.contextmanager

@@ -76,6 +76,31 @@ class TestLogView:
         assert "KSPSolve(cg+none)" in out
         assert "solve(s), total wall" in out
 
+    def test_sync_points_counted(self, comm8):
+        """log_view reports host-device sync counts: one KSP result fetch
+        per solve, one EPS projected-matrix fetch per restart."""
+        profiling.clear_events()
+        A = poisson2d_csr(6)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(36))
+        ksp.solve(b, x)
+        ksp.solve(b, x)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.solve()
+        sc = profiling.sync_counts()
+        assert sc.get("KSP result fetch/solve") == 2
+        assert sc.get("EPS H fetch/restart", 0) == eps._its
+        assert sc.get("EPS basis fetch/solve") == 1
+        buf = io.StringIO()
+        profiling.log_view(file=buf)
+        assert "host-device sync points" in buf.getvalue()
+
 
 class TestOptionsParsing:
     def test_negative_numeric_values(self):
