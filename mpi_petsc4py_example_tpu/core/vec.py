@@ -111,8 +111,13 @@ class Vec:
             return float(jnp.max(jnp.abs(self.data)))
         raise ValueError(f"unknown norm type {norm_type!r}")
 
-    def dot(self, other: "Vec") -> float:
-        return float(jnp.vdot(self.data, other.data))
+    def dot(self, other: "Vec"):
+        """<self, other> (conjugating for complex dtypes, like VecDot)."""
+        from ..utils.dtypes import is_complex
+        v = jnp.vdot(self.data, other.data)
+        if is_complex(self.dtype):
+            return complex(v)
+        return float(v)
 
     def axpy(self, alpha: float, other: "Vec"):
         """self += alpha * other."""

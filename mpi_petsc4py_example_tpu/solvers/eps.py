@@ -49,6 +49,7 @@ from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
 from ..utils.convergence import SolveResult
 from ..utils.options import global_options
+from ..utils.dtypes import is_complex
 from ..utils.profiling import record_sync
 from .st import ST
 
@@ -525,6 +526,12 @@ class EPS:
     # ---- shared pieces ------------------------------------------------------
     def _setup_operator(self):
         comm = self._mat.comm
+        if is_complex(self._mat.dtype):
+            raise ValueError(
+                "EPS operates on real-scalar operators only (complex "
+                "eigenvalues of real NHEP problems are returned) — complex "
+                "operator support covers KSP cg/bcgs/preonly, tracked in "
+                "PARITY.md")
         hermitian = self._problem_type in (EPSProblemType.HEP,
                                            EPSProblemType.GHEP)
         # Cache the built ST operator: sinvert/GHEP factorize a dense inverse

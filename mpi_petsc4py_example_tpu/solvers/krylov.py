@@ -23,6 +23,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.spmv import ell_spmv_local
+from ..utils.dtypes import is_complex
 from ..parallel.mesh import DeviceComm
 from ..utils.convergence import ConvergedReason as CR
 
@@ -1453,6 +1454,11 @@ def _monitor_trampoline(dev, k, rn):
 # kernels supporting masked multi-step unrolling per while_loop iteration
 _UNROLLABLE = ("cg",)
 
+# kernels whose recurrences are complex-correct with the conjugating pdot
+# (PETSc complex-build slice): CG for Hermitian positive definite, BiCGStab
+# for general complex systems, direct preonly, Richardson smoothing
+_COMPLEX_KSP = ("cg", "bcgs", "preonly", "richardson")
+
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
@@ -1486,6 +1492,12 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    if is_complex(dtype) and ksp_type not in _COMPLEX_KSP:
+        raise ValueError(
+            f"KSP {ksp_type!r} is not validated for complex operators — "
+            f"complex-scalar types: {sorted(_COMPLEX_KSP)} (PETSc complex "
+            "builds; gmres et al. need complex Givens rotations, tracked "
+            "in PARITY.md)")
     # normalize knobs a solver type doesn't consume, so changing e.g.
     # bcgsl_ell never recompiles an unrelated CG program
     restart_k = restart if ksp_type in ("gmres", "fgmres", "gcr", "fcg",
@@ -1558,8 +1570,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             b, x0 = project(b), project(x0)
             A = lambda v: project(spmv_local(op_arrays, v))
             M = lambda r: project(pc_apply(pc_arrays, r))
+            # vdot conjugates its first argument — the complex-correct inner
+            # product; norms take the real part (vdot(u,u) carries a ~0
+            # imaginary component for complex dtypes) so every kernel's
+            # convergence scalar stays real-typed
             pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
-            pnorm = lambda u: jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+            pnorm = lambda u: jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, u),
+                                                         axis)))
             kw = {"monitor": monitor} if monitor is not None else {}
             kw["dtol"] = dtol
             if stencil_cg:

@@ -316,9 +316,12 @@ class KSP:
                                  ell=self.bcgsl_ell,
                                  unroll=self.unroll)
         # host scalars travel with the execute call — no extra device
-        # round-trips (the remote-TPU dispatch latency is ~100ms each)
-        dt = np.dtype(mat.dtype)
-        ns_args = ((nullspace.device_array(comm, mat.shape[0], dt),)
+        # round-trips (the remote-TPU dispatch latency is ~100ms each).
+        # Tolerances are always REAL-typed: for complex operators the
+        # kernels' norms take the real part (krylov pnorm)
+        op_dt = np.dtype(mat.dtype)
+        dt = np.dtype(op_dt.type(0).real.dtype)
+        ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
         set_current_monitor(monitor_cb)
         t0 = time.perf_counter()

@@ -44,7 +44,13 @@ from jax import lax
 
 from ..core.mat import Mat
 from ..parallel.mesh import DeviceComm
+from ..utils.dtypes import is_complex
 from jax.sharding import PartitionSpec as P
+
+# kinds whose builders/applies are complex-correct (PETSc complex-build
+# slice): diagonal scaling, dense/block inverses (host LAPACK handles
+# complex), and shell (user-supplied)
+_COMPLEX_PC = ("none", "jacobi", "bjacobi", "lu", "cholesky", "shell")
 
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
             "sor", "ssor", "ilu", "icc", "asm", "gamg", "amg",
@@ -212,6 +218,11 @@ class PC:
             return self
         comm = mat.comm
         t = self._type
+        if is_complex(mat.dtype) and t not in _COMPLEX_PC:
+            raise ValueError(
+                f"PC {t!r} is not validated for complex operators — "
+                f"complex-scalar kinds: {sorted(_COMPLEX_PC)} (PETSc "
+                "complex builds; tracked in PARITY.md)")
         if t == "none":
             self._arrays = ()
         elif t == "jacobi":
@@ -228,14 +239,19 @@ class PC:
             self._arrays = _build_asm(comm, mat, self.asm_overlap)
         elif t in ("lu", "cholesky"):
             if t == "cholesky" and hasattr(mat, "to_scipy"):
-                # PETSc's cholesky requires a symmetric operator at any
-                # size (and crtri's transpose-apply reuse depends on it)
-                D = (mat.to_scipy() - mat.to_scipy().T).tocsr()
-                if D.nnz and abs(D).max() != 0:
+                # PETSc's cholesky assumes a symmetric (complex: Hermitian)
+                # operator (crtri's transpose-apply reuse depends on it).
+                # Tolerance-based: ulp-level assembly asymmetry must not
+                # reject an SPD operator that factorizes fine.
+                S = mat.to_scipy()
+                D = (S - S.conj().T).tocsr()
+                scale = abs(S).max() or 1.0
+                if D.nnz and abs(D).max() > 1e-10 * scale:
                     raise ValueError(
-                        "PC 'cholesky' needs a symmetric operator — use "
-                        "pc 'lu' for unsymmetric matrices")
+                        "PC 'cholesky' needs a symmetric (Hermitian) "
+                        "operator — use pc 'lu' for unsymmetric matrices")
             if (mat.shape[0] > _DENSE_CAP
+                    and not is_complex(mat.dtype)
                     and set(getattr(mat, "dia_offsets", ())) and
                     set(mat.dia_offsets) <= {-1, 0, 1}):
                 self._arrays = _build_tridiag_cr(comm, mat)
@@ -545,13 +561,13 @@ _DENSE_CAP = 16384  # host O(n^3) factorization bound for direct paths
 _AUTO_BLOCK_TARGET = 2048  # bjacobi auto-split block size (memory-frugal)
 
 
-def _per_device_inverse(A, n, lsize, ndev, block_inv):
+def _per_device_inverse(A, n, lsize, ndev, block_inv, host_dt=np.float64):
     """(ndev, lsize, lsize) stack of per-device block inverses.
 
     ``block_inv(csr_block) -> dense inverse``; out-of-range / padding rows
     get identity so padded vector slots pass through unchanged.
     """
-    inv = np.zeros((ndev, lsize, lsize), dtype=np.float64)
+    inv = np.zeros((ndev, lsize, lsize), dtype=host_dt)
     for d in range(ndev):
         rs, re = d * lsize, min((d + 1) * lsize, n)
         inv[d] = np.eye(lsize)
@@ -621,9 +637,11 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
             "'jacobi'/'gamg' (SURVEY.md §7.4)")
     A = mat.to_scipy().tocsr()
     bs = lsize // nb
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
     inv = _per_device_inverse(
         A, n, bs, comm.size * nb,
-        lambda B: scipy.linalg.inv(B.toarray().astype(np.float64)))
+        lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
+        host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
 
 
@@ -775,14 +793,17 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     _require_assembled(mat, "lu")
     n = mat.shape[0]
     if n > _DENSE_CAP:
+        hint = ("tridiagonal operators take the cyclic-reduction direct "
+                "path automatically" if not is_complex(mat.dtype) else
+                "the cyclic-reduction tridiagonal path is real-only")
         raise ValueError(
             f"PC 'lu' densifies general operators; n={n} is too large — "
-            "tridiagonal operators take the cyclic-reduction direct path "
-            "automatically; otherwise use an iterative KSP with pc "
+            f"{hint}; otherwise use an iterative KSP with pc "
             "'bjacobi'/'jacobi' instead (SURVEY.md §7.4)")
-    A = mat.to_scipy().toarray().astype(np.float64)
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    A = mat.to_scipy().toarray().astype(host_dt)
     inv = scipy.linalg.inv(A)
     n_pad = comm.padded_size(n)
-    inv_pad = np.zeros((n_pad, n_pad), dtype=np.float64)
+    inv_pad = np.zeros((n_pad, n_pad), dtype=host_dt)
     inv_pad[:n, :n] = inv
     return (comm.put_replicated(inv_pad.astype(mat.dtype)),)

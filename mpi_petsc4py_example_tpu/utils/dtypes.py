@@ -1,0 +1,10 @@
+"""Small dtype predicates shared across solver/object modules."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_complex(dtype) -> bool:
+    """True for complex64/complex128 (accepts np/jnp dtypes and strings)."""
+    return np.issubdtype(np.dtype(str(dtype)), np.complexfloating)

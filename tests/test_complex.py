@@ -1,0 +1,157 @@
+"""Complex-scalar support (PETSc complex-build slice, SURVEY.md §2.2 N1-N3).
+
+PETSc/SLEPc are compiled real OR complex; this framework carries dtype per
+object instead. Validated complex surface: Vec/Mat (ELL + DIA SpMV,
+transpose product), KSP cg (Hermitian positive definite), bcgs (general),
+preonly, richardson, with PC none/jacobi/bjacobi/lu/cholesky. Everything
+else rejects complex operators with a clear error (recorded in PARITY.md).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import mpi_petsc4py_example_tpu as tps
+
+
+def random_complex_csr(n, density=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = sp.random(n, n, density=density, format="csr", dtype=np.float64,
+                  random_state=rng)
+    B = sp.random(n, n, density=density, format="csr", dtype=np.float64,
+                  random_state=rng)
+    return (A + 1j * B).tocsr()
+
+
+def hermitian_spd(n, seed=0, shift=20.0):
+    B = random_complex_csr(n, seed=seed)
+    return (B + B.conj().T + sp.eye(n) * shift).tocsr()
+
+
+def cvec(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.random(n) + 1j * rng.random(n)
+
+
+class TestComplexVecMat:
+    def test_spmv_ell(self, comm8):
+        A = random_complex_csr(64)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        x = cvec(64)
+        y = M.mult(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_allclose(y, A @ x, rtol=1e-13)
+
+    def test_spmv_dia_banded(self, comm8):
+        n = 96
+        d = cvec(n, 2)
+        A = sp.diags([d[1:], d * 3 + 2.0, d[:-1].conj()], [-1, 0, 1],
+                     format="csr")
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        assert M.dia_offsets  # banded layout engaged for complex too
+        x = cvec(n, 3)
+        y = M.mult(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_allclose(y, A @ x, rtol=1e-13)
+
+    def test_mult_transpose_unconjugated(self, comm8):
+        """MatMultTranspose is A^T (not A^H), matching PETSc."""
+        A = random_complex_csr(48, seed=4)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        x = cvec(48, 5)
+        y = M.mult_transpose(tps.Vec.from_global(comm8, x)).to_numpy()
+        np.testing.assert_allclose(y, A.T @ x, rtol=1e-13)
+
+    def test_vec_dot_conjugates_norm_real(self, comm8):
+        u = tps.Vec.from_global(comm8, cvec(32, 6))
+        v = tps.Vec.from_global(comm8, cvec(32, 7))
+        d = u.dot(v)
+        assert isinstance(d, complex)
+        np.testing.assert_allclose(d, np.vdot(u.to_numpy(), v.to_numpy()),
+                                   rtol=1e-13)
+        nrm = u.norm()
+        assert isinstance(nrm, float)
+        np.testing.assert_allclose(nrm, np.linalg.norm(u.to_numpy()),
+                                   rtol=1e-13)
+
+
+class TestComplexKSP:
+    def solve(self, comm, A, ksp_type, pc_type, rtol=1e-12):
+        M = tps.Mat.from_scipy(comm, A, dtype=np.complex128)
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type(ksp_type)
+        ksp.get_pc().set_type(pc_type)
+        ksp.set_tolerances(rtol=rtol, max_it=2000)
+        x_true = cvec(A.shape[0], 11)
+        x, bv = M.get_vecs()
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        return x.to_numpy(), x_true, res
+
+    def test_cg_hermitian(self, comm8):
+        A = hermitian_spd(100)
+        x, x_true, res = self.solve(comm8, A, "cg", "jacobi")
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-9)
+
+    @pytest.mark.parametrize("pc_type", ["none", "jacobi", "bjacobi"])
+    def test_bcgs_general(self, comm8, pc_type):
+        A = (random_complex_csr(80, seed=8) + sp.eye(80) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, "bcgs", pc_type)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-8)
+
+    def test_preonly_lu_direct(self, comm8):
+        A = (random_complex_csr(60, seed=9) + sp.eye(60) * 8).tocsr()
+        x, x_true, res = self.solve(comm8, A, "preonly", "lu")
+        np.testing.assert_allclose(x, x_true, atol=1e-11)
+
+    def test_cholesky_hermitian_accepts_rejects(self, comm8):
+        H = hermitian_spd(40, seed=12)
+        x, x_true, res = self.solve(comm8, H, "preonly", "cholesky")
+        np.testing.assert_allclose(x, x_true, atol=1e-11)
+        # complex-symmetric-but-not-Hermitian must be rejected
+        B = random_complex_csr(40, seed=13)
+        S = (B + B.T + sp.eye(40) * 9).tocsr()       # S = S^T, S != S^H
+        M = tps.Mat.from_scipy(comm8, S, dtype=np.complex128)
+        pc = tps.PC()
+        pc.set_type("cholesky")
+        with pytest.raises(ValueError, match="Hermitian"):
+            pc.set_up(M)
+
+    def test_residual_norm_is_real(self, comm8):
+        A = hermitian_spd(50, seed=14)
+        _, _, res = self.solve(comm8, A, "cg", "none")
+        assert isinstance(res.residual_norm, float)
+        assert res.residual_norm >= 0.0
+
+
+class TestComplexGates:
+    def test_gmres_rejects(self, comm8):
+        A = hermitian_spd(30)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("gmres")
+        x, bv = M.get_vecs()
+        bv.set_global(cvec(30))
+        with pytest.raises(ValueError, match="complex"):
+            ksp.solve(bv, x)
+
+    def test_pc_sor_rejects(self, comm8):
+        A = hermitian_spd(30)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        pc = tps.PC()
+        pc.set_type("sor")
+        with pytest.raises(ValueError, match="complex"):
+            pc.set_up(M)
+
+    def test_eps_rejects(self, comm8):
+        A = hermitian_spd(30)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        with pytest.raises(ValueError, match="real-scalar"):
+            eps.solve()
