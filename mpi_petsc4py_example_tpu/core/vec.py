@@ -112,9 +112,12 @@ class Vec:
         raise ValueError(f"unknown norm type {norm_type!r}")
 
     def dot(self, other: "Vec"):
-        """<self, other> (conjugating for complex dtypes, like VecDot)."""
+        """PETSc VecDot(self, other) = otherᴴ · self — conjugates the
+        SECOND argument for complex dtypes (petsc4py parity; note numpy's
+        ``np.vdot(u, v)`` conjugates the first, i.e. equals ``v.dot(u)``
+        here)."""
         from ..utils.dtypes import is_complex
-        v = jnp.vdot(self.data, other.data)
+        v = jnp.vdot(other.data, self.data)
         if is_complex(self.dtype):
             return complex(v)
         return float(v)
@@ -207,10 +210,12 @@ class Vec:
         return self
 
     def zero(self):
-        # host-side zeros + async device_put: avoids an eager device
-        # computation (which costs a compile + round trip on remote TPUs)
-        self.data = self.comm.put_rows(
-            np.zeros(self.data.shape[0], dtype=self.data.dtype))
+        # on-device zeros: a host buffer + device_put would ship O(n) bytes
+        # through the runtime per call (~2.8 s for a 537 MB vector on the
+        # dev tunnel — it silently serialized into whatever consumed the
+        # vector next); jnp.zeros_like dispatches a tiny cached program and
+        # preserves the sharding
+        self.data = jnp.zeros_like(self.data)
 
     def __len__(self):
         return self.n

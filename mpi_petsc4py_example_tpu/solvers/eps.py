@@ -530,8 +530,8 @@ class EPS:
             raise ValueError(
                 "EPS operates on real-scalar operators only (complex "
                 "eigenvalues of real NHEP problems are returned) — complex "
-                "operator support covers KSP cg/bcgs/preonly, tracked in "
-                "PARITY.md")
+                "operators are supported by the KSP linear solvers (see "
+                "krylov._COMPLEX_KSP), tracked in PARITY.md")
         hermitian = self._problem_type in (EPSProblemType.HEP,
                                            EPSProblemType.GHEP)
         # Cache the built ST operator: sinvert/GHEP factorize a dense inverse

@@ -310,16 +310,22 @@ def _hessenberg_lstsq(H, beta):
     g = jnp.zeros(m + 1, H.dtype).at[0].set(beta)
 
     def rotate(j, Hg):
+        # complex-capable Givens: c real, s = sign(a)·conj(b)/r, applied as
+        # [c, s; -conj(s), c] — zeroes H[j+1, j] for any scalar field and
+        # reduces to the textbook real rotation (conj = identity) otherwise
         H, g = Hg
         a, bb = H[j, j], H[j + 1, j]
-        r = jnp.sqrt(a * a + bb * bb)
+        aa = jnp.abs(a)
+        r = jnp.sqrt(aa * aa + jnp.abs(bb) ** 2)
         safe = jnp.where(r == 0, 1.0, r)
-        c = jnp.where(r == 0, 1.0, a / safe)
-        s = jnp.where(r == 0, 0.0, bb / safe)
+        sgn = jnp.where(aa == 0, 1.0, a / jnp.where(aa == 0, 1.0, aa))
+        c = jnp.where(r == 0, 1.0, aa / safe)
+        s = jnp.where(r == 0, 0.0, sgn * jnp.conj(bb) / safe)
+        sc = jnp.conj(s)
         rj, rj1 = H[j], H[j + 1]
-        H = H.at[j].set(c * rj + s * rj1).at[j + 1].set(-s * rj + c * rj1)
+        H = H.at[j].set(c * rj + s * rj1).at[j + 1].set(-sc * rj + c * rj1)
         gj, gj1 = g[j], g[j + 1]
-        g = g.at[j].set(c * gj + s * gj1).at[j + 1].set(-s * gj + c * gj1)
+        g = g.at[j].set(c * gj + s * gj1).at[j + 1].set(-sc * gj + c * gj1)
         return (H, g)
 
     H, g = lax.fori_loop(0, m, rotate, (H, g))
@@ -1454,10 +1460,14 @@ def _monitor_trampoline(dev, k, rn):
 # kernels supporting masked multi-step unrolling per while_loop iteration
 _UNROLLABLE = ("cg",)
 
-# kernels whose recurrences are complex-correct with the conjugating pdot
-# (PETSc complex-build slice): CG for Hermitian positive definite, BiCGStab
-# for general complex systems, direct preonly, Richardson smoothing
-_COMPLEX_KSP = ("cg", "bcgs", "preonly", "richardson")
+# kernels whose recurrences are complex-correct with the conjugating pdot,
+# conjugating basis projections, and the complex-capable Givens rotations
+# (PETSc complex-build slice): CG/FCG for Hermitian positive definite,
+# BiCGStab for general systems, the GMRES family, direct preonly,
+# Richardson smoothing. gcr stays real-only (its descent recurrence
+# stagnates on complex operators — gated until audited).
+_COMPLEX_KSP = ("cg", "fcg", "bcgs", "gmres", "fgmres", "lgmres",
+                "preonly", "richardson")
 
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
@@ -1496,8 +1506,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         raise ValueError(
             f"KSP {ksp_type!r} is not validated for complex operators — "
             f"complex-scalar types: {sorted(_COMPLEX_KSP)} (PETSc complex "
-            "builds; gmres et al. need complex Givens rotations, tracked "
-            "in PARITY.md)")
+            "builds; the remaining recurrences are unaudited for complex "
+            "arithmetic, tracked in PARITY.md)")
     # normalize knobs a solver type doesn't consume, so changing e.g.
     # bcgsl_ell never recompiles an unrelated CG program
     restart_k = restart if ksp_type in ("gmres", "fgmres", "gcr", "fcg",
@@ -1590,7 +1600,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 kw["unroll"] = unroll_k
             if ksp_type in ("gmres", "fgmres", "gcr", "fcg", "lgmres"):
                 kw["restart"] = restart
-                kw["pmatdot"] = lambda Vb, w: lax.psum(Vb @ w, axis)
+                # conj for complex-correct basis projections (identity on
+                # real dtypes, where XLA elides it)
+                kw["pmatdot"] = lambda Vb, w: lax.psum(jnp.conj(Vb) @ w,
+                                                       axis)
                 if ksp_type == "lgmres":
                     kw["aug"] = aug
             elif ksp_type == "bcgsl":

@@ -6,5 +6,6 @@ import numpy as np
 
 
 def is_complex(dtype) -> bool:
-    """True for complex64/complex128 (accepts np/jnp dtypes and strings)."""
-    return np.issubdtype(np.dtype(str(dtype)), np.complexfloating)
+    """True for complex64/complex128 (accepts np/jnp dtype instances,
+    scalar-type classes like ``np.complex128``, and dtype strings)."""
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)

@@ -67,7 +67,9 @@ class TestComplexVecMat:
         v = tps.Vec.from_global(comm8, cvec(32, 7))
         d = u.dot(v)
         assert isinstance(d, complex)
-        np.testing.assert_allclose(d, np.vdot(u.to_numpy(), v.to_numpy()),
+        # PETSc VecDot(x, y) = y^H x — the conjugate sits on the second
+        # argument (numpy's vdot conjugates the first, hence the swap)
+        np.testing.assert_allclose(d, np.vdot(v.to_numpy(), u.to_numpy()),
                                    rtol=1e-13)
         nrm = u.norm()
         assert isinstance(nrm, float)
@@ -102,6 +104,20 @@ class TestComplexKSP:
         assert res.converged
         np.testing.assert_allclose(x, x_true, atol=1e-8)
 
+    @pytest.mark.parametrize("ksp_type", ["gmres", "fgmres", "lgmres"])
+    def test_gmres_family_general(self, comm8, ksp_type):
+        """Complex Givens rotations + conjugating basis projections."""
+        A = (random_complex_csr(80, seed=15) + sp.eye(80) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, ksp_type, "jacobi", rtol=1e-11)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-9)
+
+    def test_fcg_hermitian(self, comm8):
+        A = hermitian_spd(80, seed=16)
+        x, x_true, res = self.solve(comm8, A, "fcg", "jacobi")
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-9)
+
     def test_preonly_lu_direct(self, comm8):
         A = (random_complex_csr(60, seed=9) + sp.eye(60) * 8).tocsr()
         x, x_true, res = self.solve(comm8, A, "preonly", "lu")
@@ -128,12 +144,12 @@ class TestComplexKSP:
 
 
 class TestComplexGates:
-    def test_gmres_rejects(self, comm8):
+    def test_gcr_rejects(self, comm8):
         A = hermitian_spd(30)
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
         ksp = tps.KSP().create(comm8)
         ksp.set_operators(M)
-        ksp.set_type("gmres")
+        ksp.set_type("gcr")
         x, bv = M.get_vecs()
         bv.set_global(cvec(30))
         with pytest.raises(ValueError, match="complex"):
