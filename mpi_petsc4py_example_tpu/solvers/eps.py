@@ -49,7 +49,7 @@ from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
 from ..utils.convergence import SolveResult
 from ..utils.options import global_options
-from ..utils.dtypes import is_complex
+from ..utils.dtypes import host_dtype, is_complex
 from ..utils.profiling import record_sync
 from .st import ST
 
@@ -131,10 +131,11 @@ def _build_factorization_program(comm: DeviceComm, op, ncv: int, inner=None):
             return b_apply(b_arrays, v) if b_apply is not None else v
 
         def pdot_vec(Vb, wB):
-            return lax.psum(Vb @ wB, axis)
+            # conj for complex-correct projections (identity on real dtypes)
+            return lax.psum(jnp.conj(Vb) @ wB, axis)
 
         def pnorm(u):
-            return jnp.sqrt(lax.psum(jnp.vdot(u, Bip(u)), axis))
+            return jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, Bip(u)), axis)))
 
         vk = V[k]
         nrm = pnorm(vk)
@@ -527,11 +528,16 @@ class EPS:
     def _setup_operator(self):
         comm = self._mat.comm
         if is_complex(self._mat.dtype):
-            raise ValueError(
-                "EPS operates on real-scalar operators only (complex "
-                "eigenvalues of real NHEP problems are returned) — complex "
-                "operators are supported by the KSP linear solvers (see "
-                "krylov._COMPLEX_KSP), tracked in PARITY.md")
+            ok = (self._problem_type == EPSProblemType.HEP
+                  and self._type in ("krylovschur", "lanczos")
+                  and self._bmat is None
+                  and self.st.get_type() == "shift")
+            if not ok:
+                raise ValueError(
+                    "complex EPS support covers Hermitian standard problems "
+                    "(HEP) with krylovschur/lanczos and the plain shift ST "
+                    "— NHEP/GHEP, the other solver types, and sinvert are "
+                    "real-only (tracked in PARITY.md)")
         hermitian = self._problem_type in (EPSProblemType.HEP,
                                            EPSProblemType.GHEP)
         # Cache the built ST operator: sinvert/GHEP factorize a dense inverse
@@ -578,9 +584,10 @@ class EPS:
         holds after every thick restart).
         """
         Hm = Hh[:ncv, :ncv]
-        beta = float(Hh[ncv, ncv - 1])
+        # the subdiagonal entry is a norm — real by construction
+        beta = float(np.real(Hh[ncv, ncv - 1]))
         if hermitian:
-            Hm = (Hm + Hm.T) / 2.0
+            Hm = (Hm + Hm.conj().T) / 2.0
             lam_t, S = np.linalg.eigh(Hm)
         else:
             lam_t, S = np.linalg.eig(Hm)
@@ -642,7 +649,7 @@ class EPS:
             # projected matrix (the basis V stays on device; the restart
             # compression is a device matmul). Counted because on remote
             # runtimes this fetch, not the ncv SpMVs, dominates the cycle.
-            Hh = np.asarray(H, dtype=np.float64)
+            Hh = np.asarray(H, dtype=host_dtype(dtype))
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
@@ -710,7 +717,7 @@ class EPS:
             H = np.zeros((ncv + 1, ncv), dtype=dtype)
             V, H = prog(op_arrays, b_arrays, V, H,
                         np.asarray(0, dtype=np.int32))
-            Hh = np.asarray(H, dtype=np.float64)
+            Hh = np.asarray(H, dtype=host_dtype(dtype))
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
@@ -1000,6 +1007,13 @@ class EPS:
         """
         lam = complex(self._eigenvalues[i])
         vec = self._eigenvectors[i]
+        if vr is not None and is_complex(vr.dtype):
+            # complex-build semantics (slepc4py): vr carries the full
+            # complex eigenvector, vi is unused (zeroed here)
+            vr.set_global(vec)
+            if vi is not None:
+                vi.set_global(np.zeros_like(vec))
+            return lam
         if vr is not None:
             vr.set_global(np.real(vec))
         if vi is not None:
@@ -1030,19 +1044,27 @@ class EPS:
 
         def apply(op, v):
             vv = Vec.from_global(self.comm, v, dtype=op.dtype)
-            return np.asarray(op.mult(vv).to_numpy(), dtype=np.float64)
+            return np.asarray(op.mult(vv).to_numpy(),
+                              dtype=host_dtype(op.dtype))
 
-        vr, vi = np.real(vec), np.imag(vec)
-        # apply to the real and imaginary parts separately (operators are
-        # real; complex pairs only arise for NHEP)
-        Avr = apply(A, vr)
-        Avi = apply(A, vi) if np.any(vi) else np.zeros_like(Avr)
-        if self._bmat is not None:
-            Bvr = apply(self._bmat, vr)
-            Bvi = apply(self._bmat, vi) if np.any(vi) else np.zeros_like(Bvr)
+        if is_complex(A.dtype):
+            # complex operator: apply to the complex vector directly
+            Av = apply(A, vec)
+            Bv = apply(self._bmat, vec) if self._bmat is not None else vec
+            r = Av - lam * Bv
         else:
-            Bvr, Bvi = vr, vi
-        r = (Avr + 1j * Avi) - lam * (Bvr + 1j * Bvi)
+            vr, vi = np.real(vec), np.imag(vec)
+            # apply to the real and imaginary parts separately (real
+            # operators; complex pairs only arise for NHEP)
+            Avr = apply(A, vr)
+            Avi = apply(A, vi) if np.any(vi) else np.zeros_like(Avr)
+            if self._bmat is not None:
+                Bvr = apply(self._bmat, vr)
+                Bvi = (apply(self._bmat, vi) if np.any(vi)
+                       else np.zeros_like(Bvr))
+            else:
+                Bvr, Bvi = vr, vi
+            r = (Avr + 1j * Avi) - lam * (Bvr + 1j * Bvi)
         err = float(np.linalg.norm(r))
         t = str(error_type).lower()
         if t in ("relative", "eps_error_relative"):

@@ -9,3 +9,10 @@ def is_complex(dtype) -> bool:
     """True for complex64/complex128 (accepts np/jnp dtype instances,
     scalar-type classes like ``np.complex128``, and dtype strings)."""
     return np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+def host_dtype(dtype):
+    """Host fp64-precision counterpart: complex128 for complex operators,
+    float64 otherwise — the dtype host-side projected problems, fetches,
+    and factorizations run in."""
+    return np.complex128 if is_complex(dtype) else np.float64

@@ -2,9 +2,11 @@
 
 PETSc/SLEPc are compiled real OR complex; this framework carries dtype per
 object instead. Validated complex surface: Vec/Mat (ELL + DIA SpMV,
-transpose product), KSP cg (Hermitian positive definite), bcgs (general),
-preonly, richardson, with PC none/jacobi/bjacobi/lu/cholesky. Everything
-else rejects complex operators with a clear error (recorded in PARITY.md).
+transpose product), KSP cg/fcg (Hermitian positive definite), bcgs and the
+gmres family (general), preonly, richardson, PC none/jacobi/bjacobi/lu/
+cholesky, and EPS Hermitian standard problems with krylovschur/lanczos.
+Everything else rejects complex operators with a clear error (recorded in
+PARITY.md).
 """
 
 import numpy as np
@@ -163,11 +165,57 @@ class TestComplexGates:
         with pytest.raises(ValueError, match="complex"):
             pc.set_up(M)
 
-    def test_eps_rejects(self, comm8):
+    def test_eps_nhep_and_power_reject(self, comm8):
         A = hermitian_spd(30)
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
         eps = tps.EPS().create(comm8)
         eps.set_operators(M)
-        eps.set_problem_type("hep")
-        with pytest.raises(ValueError, match="real-scalar"):
+        eps.set_problem_type("nhep")
+        with pytest.raises(ValueError, match="Hermitian standard"):
             eps.solve()
+        eps2 = tps.EPS().create(comm8)
+        eps2.set_operators(M)
+        eps2.set_problem_type("hep")
+        eps2.set_type("power")
+        with pytest.raises(ValueError, match="Hermitian standard"):
+            eps2.solve()
+
+
+class TestComplexEPS:
+    def test_hermitian_krylovschur(self, comm8):
+        """Complex Hermitian standard eigenproblem (SLEPc complex-build
+        HEP): conjugating CGS2 projections + complex projected problem."""
+        n = 120
+        B = random_complex_csr(n, density=0.15, seed=21)
+        H = (B + B.conj().T).tocsr() + sp.diags(np.linspace(1, 50, n))
+        M = tps.Mat.from_scipy(comm8, H, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_dimensions(nev=4)
+        eps.solve()
+        assert eps.get_converged() >= 4
+        lam_exact = np.linalg.eigvalsh(H.toarray())
+        lam_exact = lam_exact[np.argsort(-np.abs(lam_exact))]
+        for i in range(4):
+            lam = eps.get_eigenvalue(i)
+            np.testing.assert_allclose(lam.real, lam_exact[i], rtol=1e-9)
+            assert abs(lam.imag) < 1e-9
+            assert eps.compute_error(i) < 1e-7
+
+    def test_complex_eigenpair_extraction(self, comm8):
+        """Complex-build getEigenpair semantics: vr carries the full complex
+        eigenvector, vi is zero; the pair satisfies A v = lambda v."""
+        H = hermitian_spd(60, seed=22, shift=30.0)
+        M = tps.Mat.from_scipy(comm8, H, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.solve()
+        assert eps.get_converged() >= 1
+        vr, vi = M.get_vecs()
+        lam = eps.get_eigenpair(0, vr, vi)
+        v = vr.to_numpy()
+        assert np.linalg.norm(np.imag(v)) > 0  # genuinely complex vector
+        assert np.allclose(vi.to_numpy(), 0)
+        assert np.linalg.norm(H @ v - lam.real * v) < 1e-8
