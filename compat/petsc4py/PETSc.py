@@ -151,11 +151,16 @@ class Vec:
             print(repr(self._core), file=sys.stderr)
 
     def load(self, viewer):
-        """VecLoad: fill this Vec from a PETSc binary Vec file."""
+        """VecLoad: fill this Vec from a PETSc binary Vec file.
+
+        A complex-dtype Vec reads the complex-build scalar layout — like
+        PETSc, where the build's scalar type decides the file format."""
         viewer._check_mode(read=True)
+        from mpi_petsc4py_example_tpu.utils.dtypes import is_complex
+        scalar = "complex" if is_complex(self._core.dtype) else "real"
 
         def build(_):
-            arr = _tps.petsc_io.read_vec(viewer.handle)
+            arr = _tps.petsc_io.read_vec(viewer.handle, scalar=scalar)
             if arr.shape[0] != self._core.n:
                 raise ValueError(
                     f"VecLoad size mismatch: file has {arr.shape[0]} "
@@ -307,14 +312,19 @@ class Mat:
         if self._comm.Get_rank() == 0:
             print(repr(self._core), file=sys.stderr)
 
-    def load(self, viewer):
-        """MatLoad: read a PETSc binary Mat file (collective)."""
+    def load(self, viewer, scalar: str = "real"):
+        """MatLoad: read a PETSc binary Mat file (collective).
+
+        ``scalar='complex'`` reads complex-build files ((re, im) f8 pairs —
+        in PETSc the build's scalar type decides; the file carries no flag).
+        """
         viewer._check_mode(read=True)
         comm = self._comm or _MPI.COMM_WORLD
         self._comm = comm
 
         def build(_):
-            core = _tps.petsc_io.load_mat(viewer.handle, comm.device_comm)
+            core = _tps.petsc_io.load_mat(viewer.handle, comm.device_comm,
+                                          scalar=scalar)
             counts = RowLayout(core.shape[0], comm.Get_size()).count
             return core, _UnevenLayout(counts)
 

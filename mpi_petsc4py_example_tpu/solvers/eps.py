@@ -498,6 +498,19 @@ class EPS:
         if (self._target is not None and self.st.get_type() == "sinvert"
                 and self.st.sigma == 0.0):
             self.st.set_shift(self._target)
+        # complex gate at the single dispatch point so every solver type is
+        # covered (lobpcg in particular never calls _setup_operator)
+        if is_complex(mat.dtype):
+            ok = (self._problem_type == EPSProblemType.HEP
+                  and self._type in ("krylovschur", "lanczos")
+                  and self._bmat is None
+                  and self.st.get_type() == "shift")
+            if not ok:
+                raise ValueError(
+                    "complex EPS support covers Hermitian standard problems "
+                    "(HEP) with krylovschur/lanczos and the plain shift ST "
+                    "— NHEP/GHEP, the other solver types, and sinvert are "
+                    "real-only (tracked in PARITY.md)")
 
         t0 = time.perf_counter()
         if self._type == "power":
@@ -527,17 +540,6 @@ class EPS:
     # ---- shared pieces ------------------------------------------------------
     def _setup_operator(self):
         comm = self._mat.comm
-        if is_complex(self._mat.dtype):
-            ok = (self._problem_type == EPSProblemType.HEP
-                  and self._type in ("krylovschur", "lanczos")
-                  and self._bmat is None
-                  and self.st.get_type() == "shift")
-            if not ok:
-                raise ValueError(
-                    "complex EPS support covers Hermitian standard problems "
-                    "(HEP) with krylovschur/lanczos and the plain shift ST "
-                    "— NHEP/GHEP, the other solver types, and sinvert are "
-                    "real-only (tracked in PARITY.md)")
         hermitian = self._problem_type in (EPSProblemType.HEP,
                                            EPSProblemType.GHEP)
         # Cache the built ST operator: sinvert/GHEP factorize a dense inverse

@@ -1012,7 +1012,10 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         nv_safe = jnp.where(brk, 1.0, nv)
         v = v / nv_safe
         z = z / nv_safe
-        alpha = pdot(r, v)
+        # the projection of r onto the normalized direction is <v, r> —
+        # conjugate on v (pdot conjugates its first argument); real dtypes
+        # are unaffected, complex ones stagnate with the order flipped
+        alpha = pdot(v, r)
         x = x + alpha * z
         r = r - alpha * v
         V = V.at[slot].set(v)
@@ -1463,10 +1466,9 @@ _UNROLLABLE = ("cg",)
 # kernels whose recurrences are complex-correct with the conjugating pdot,
 # conjugating basis projections, and the complex-capable Givens rotations
 # (PETSc complex-build slice): CG/FCG for Hermitian positive definite,
-# BiCGStab for general systems, the GMRES family, direct preonly,
-# Richardson smoothing. gcr stays real-only (its descent recurrence
-# stagnates on complex operators — gated until audited).
-_COMPLEX_KSP = ("cg", "fcg", "bcgs", "gmres", "fgmres", "lgmres",
+# BiCGStab/GCR for general systems, the GMRES family, direct preonly,
+# Richardson smoothing.
+_COMPLEX_KSP = ("cg", "fcg", "bcgs", "gmres", "fgmres", "lgmres", "gcr",
                 "preonly", "richardson")
 
 

@@ -13,13 +13,17 @@ Format (PETSc's documented binary layout, all **big-endian**):
   float64[nnz] values.
 * Vec:        int32 classid ``1211214``, int32 n, float64[n] values.
 
-Standard PETSc builds use 32-bit indices and real float64 scalars — the
-layout written here. Loading rejects files from ``--with-64-bit-indices``
-builds (their int64 header reads as classid 0). Complex-build files carry an
-identical header, so they are detected heuristically: when loading by path,
-leftover payload bytes that do not start another PETSc object raise a clear
-error instead of returning interleaved re/im garbage. Streamed (open file
-object) reads cannot look ahead and skip the check.
+Standard PETSc builds use 32-bit indices and float64 scalars; complex
+builds (``--with-scalar-type=complex``) write the identical header with
+16-byte ``(re, im)`` scalar pairs. Both are supported: writers auto-detect
+the input dtype, readers take ``scalar='real'|'complex'`` (the file carries
+no flag — like PETSc itself, the reader must know the writing build's
+scalar type). Loading rejects ``--with-64-bit-indices`` files (their int64
+header reads as classid 0). Real-scalar loads of complex-build files are
+detected heuristically: when loading by path, leftover payload bytes that
+do not start another PETSc object raise a clear error pointing at
+``scalar='complex'``. Streamed (open file object) reads cannot look ahead
+and skip the check.
 """
 
 from __future__ import annotations
@@ -30,7 +34,16 @@ MAT_FILE_CLASSID = 1211216
 VEC_FILE_CLASSID = 1211214
 
 _I = np.dtype(">i4")     # PetscInt32, big-endian
-_R = np.dtype(">f8")     # PetscScalar (real, double), big-endian
+_R = np.dtype(">f8")     # PetscScalar (real build, double), big-endian
+_C = np.dtype(">c16")    # PetscScalar (complex build): (re, im) f8 pairs
+
+
+def _scalar_dtype(scalar: str):
+    if scalar == "real":
+        return _R, np.float64
+    if scalar == "complex":
+        return _C, np.complex128
+    raise ValueError(f"scalar must be 'real' or 'complex', got {scalar!r}")
 
 
 import contextlib
@@ -93,20 +106,24 @@ def _check_trailing(f, path):
     raise ValueError(
         f"{_display_name(path)}: bytes after the object do not start "
         "another PETSc object — this looks like a PETSc complex-scalar "
-        "build file (--with-scalar-type=complex), which is unsupported "
-        "(real float64 scalars only)")
+        "build file (--with-scalar-type=complex); load it with "
+        "scalar='complex'")
 
 
 def write_vec(path, arr) -> None:
-    """Write a 1-D array as a PETSc binary Vec (``VecView`` layout)."""
-    arr = np.asarray(arr, dtype=np.float64).ravel()
+    """Write a 1-D array as a PETSc binary Vec (``VecView`` layout).
+
+    Complex input writes the complex-build layout ((re, im) f8 pairs)."""
+    arr = np.asarray(arr).ravel()
+    file_dt, _ = _scalar_dtype("complex" if np.iscomplexobj(arr) else "real")
     with _open(path, "wb") as f:
         f.write(np.array([VEC_FILE_CLASSID, arr.size], dtype=_I).tobytes())
-        f.write(arr.astype(_R).tobytes())
+        f.write(arr.astype(file_dt).tobytes())
 
 
-def read_vec(path) -> np.ndarray:
-    """Read a PETSc binary Vec -> float64 numpy array."""
+def read_vec(path, scalar: str = "real") -> np.ndarray:
+    """Read a PETSc binary Vec -> float64 (or complex128) numpy array."""
+    file_dt, host_dt = _scalar_dtype(scalar)
     with _open(path, "rb") as f:
         classid, n = _read(f, _I, 2)
         if classid != VEC_FILE_CLASSID:
@@ -115,13 +132,15 @@ def read_vec(path) -> np.ndarray:
                 f"expected {VEC_FILE_CLASSID})")
         if n < 0:
             raise ValueError(f"corrupt PETSc Vec file: n={n}")
-        vals = _read(f, _R, int(n)).astype(np.float64)
+        vals = _read(f, file_dt, int(n)).astype(host_dt)
         _check_trailing(f, path)
         return vals
 
 
 def write_mat(path, A) -> None:
-    """Write a scipy sparse matrix as a PETSc binary Mat (AIJ layout)."""
+    """Write a scipy sparse matrix as a PETSc binary Mat (AIJ layout).
+
+    Complex input writes the complex-build layout ((re, im) f8 pairs)."""
     A = A.tocsr()
     # PETSc's SeqAIJ invariant: column indices sorted within each row
     if not A.has_sorted_indices:
@@ -137,12 +156,15 @@ def write_mat(path, A) -> None:
                          dtype=_I).tobytes())
         f.write(rowlens.astype(_I).tobytes())
         f.write(np.asarray(A.indices, dtype=np.int64).astype(_I).tobytes())
-        f.write(np.asarray(A.data, dtype=np.float64).astype(_R).tobytes())
+        file_dt, _ = _scalar_dtype("complex" if np.iscomplexobj(A.data)
+                                   else "real")
+        f.write(np.asarray(A.data).astype(file_dt).tobytes())
 
 
-def read_mat(path):
-    """Read a PETSc binary Mat -> scipy CSR matrix (float64)."""
+def read_mat(path, scalar: str = "real"):
+    """Read a PETSc binary Mat -> scipy CSR matrix (float64/complex128)."""
     import scipy.sparse as sp
+    file_dt, host_dt = _scalar_dtype(scalar)
     with _open(path, "rb") as f:
         classid, nrows, ncols, nnz = _read(f, _I, 4)
         if classid != MAT_FILE_CLASSID:
@@ -158,7 +180,7 @@ def read_mat(path):
             raise ValueError(
                 "corrupt PETSc Mat file: row lengths do not sum to nnz")
         indices = _read(f, _I, int(nnz)).astype(np.int32)
-        data = _read(f, _R, int(nnz)).astype(np.float64)
+        data = _read(f, file_dt, int(nnz)).astype(host_dt)
         _check_trailing(f, path)
     if len(indices) and (indices.min() < 0 or indices.max() >= ncols):
         raise ValueError("corrupt PETSc Mat file: column index out of range")
@@ -174,13 +196,14 @@ def save_mat(path, mat) -> None:
     write_mat(path, mat.to_scipy())
 
 
-def load_mat(path, comm=None, dtype=None):
+def load_mat(path, comm=None, dtype=None, scalar: str = "real"):
     """``MatLoad``: read a PETSc binary Mat into a row-sharded Mat."""
     import jax.numpy as jnp
 
     from ..core.mat import Mat
-    A = read_mat(path)
-    return Mat.from_scipy(comm, A, dtype=dtype or jnp.float64)
+    A = read_mat(path, scalar=scalar)
+    default = jnp.complex128 if scalar == "complex" else jnp.float64
+    return Mat.from_scipy(comm, A, dtype=dtype or default)
 
 
 def save_vec(path, vec) -> None:
@@ -188,9 +211,9 @@ def save_vec(path, vec) -> None:
     write_vec(path, vec.to_numpy())
 
 
-def load_vec(path, comm=None, dtype=None):
+def load_vec(path, comm=None, dtype=None, scalar: str = "real"):
     """``VecLoad``: read a PETSc binary Vec into a row-sharded Vec."""
     from ..core.vec import Vec
-    arr = read_vec(path)
+    arr = read_vec(path, scalar=scalar)
     return Vec.from_global(comm, arr if dtype is None
                            else arr.astype(dtype))
