@@ -501,15 +501,12 @@ class EPS:
         # complex gate at the single dispatch point so every solver type is
         # covered (lobpcg in particular never calls _setup_operator)
         if is_complex(mat.dtype):
-            ok = (self._problem_type == EPSProblemType.HEP
-                  and self._type in ("krylovschur", "lanczos")
-                  and self._bmat is None
-                  and self.st.get_type() == "shift")
+            ok = self._type in ("krylovschur", "lanczos", "arnoldi")
             if not ok:
                 raise ValueError(
-                    "complex EPS support covers Hermitian standard problems "
-                    "(HEP) with krylovschur/lanczos and the plain shift ST "
-                    "— NHEP/GHEP, the other solver types, and sinvert are "
+                    "complex EPS support covers the Krylov types "
+                    "(krylovschur/lanczos/arnoldi) for HEP/GHEP/NHEP with "
+                    "shift or sinvert ST — power/subspace/lobpcg are "
                     "real-only (tracked in PARITY.md)")
 
         t0 = time.perf_counter()
@@ -726,8 +723,12 @@ class EPS:
             if nconv >= nev or ncv >= n or restarts == self.max_it:
                 break
             # restart vector: combination of wanted, not-yet-converged Ritz
-            # directions, formed on device (the basis stays in HBM)
-            wanted = S[:, order[:nev]].real.sum(axis=1).astype(dtype)
+            # directions, formed on device (the basis stays in HBM).
+            # Real dtype needs a real vector (complex-pair Ritz columns
+            # collapse to their real part); complex dtype keeps the full
+            # combination.
+            comb = S[:, order[:nev]].sum(axis=1)
+            wanted = (comb if is_complex(dtype) else comb.real).astype(dtype)
             V = restart_prog(V, wanted)
 
         Vh = comm.host_fetch(V)[:ncv]
@@ -1083,11 +1084,18 @@ class EPS:
 
 
 def _ordered_schur(Hm: np.ndarray, want):
-    """Real Schur form with the wanted eigenvalues ordered first.
+    """Schur form with the wanted eigenvalues ordered first.
 
-    ``want(re, im) -> bool``; LAPACK keeps 2x2 (complex-pair) blocks intact,
-    so the returned ``sdim`` may differ from the requested count by one.
+    ``want(re, im) -> bool``. Real input: real Schur form — LAPACK keeps
+    2x2 (complex-pair) blocks intact, so the returned ``sdim`` may differ
+    from the requested count by one. Complex input: complex (triangular)
+    Schur form — no 2x2 blocks exist, scipy's sort callback receives one
+    complex argument.
     """
     import scipy.linalg
+    if np.iscomplexobj(Hm):
+        T, Z, sdim = scipy.linalg.schur(
+            Hm, output="complex", sort=lambda lam: want(lam.real, lam.imag))
+        return T, Z, sdim
     T, Z, sdim = scipy.linalg.schur(Hm, output="real", sort=want)
     return T, Z, sdim

@@ -132,9 +132,11 @@ def _dense_inverse_padded(comm, M_scipy, n, dtype, context=None):
             "is too large for the host factorization path (cap "
             f"{_DENSE_CAP}) — use ST 'shift' with an iterative which, or "
             "more devices (SURVEY.md §7.4)")
-    inv = scipy.linalg.inv(M_scipy.toarray().astype(np.float64))
+    from ..utils.dtypes import host_dtype
+    host_dt = host_dtype(dtype)
+    inv = scipy.linalg.inv(M_scipy.toarray().astype(host_dt))
     n_pad = comm.padded_size(n)
-    inv_pad = np.zeros((n_pad, n_pad), dtype=np.float64)
+    inv_pad = np.zeros((n_pad, n_pad), dtype=host_dt)
     inv_pad[:n, :n] = inv
     return comm.put_replicated(inv_pad.astype(dtype))
 

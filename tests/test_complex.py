@@ -2,11 +2,12 @@
 
 PETSc/SLEPc are compiled real OR complex; this framework carries dtype per
 object instead. Validated complex surface: Vec/Mat (ELL + DIA SpMV,
-transpose product), KSP cg/fcg (Hermitian positive definite), bcgs and the
-gmres family (general), preonly, richardson, PC none/jacobi/bjacobi/lu/
-cholesky, and EPS Hermitian standard problems with krylovschur/lanczos.
-Everything else rejects complex operators with a clear error (recorded in
-PARITY.md).
+transpose product), KSP cg/fcg (Hermitian positive definite), bcgs, the
+gmres family and gcr (general), preonly, richardson, PC none/jacobi/
+bjacobi/lu/cholesky, EPS HEP/GHEP/NHEP with the Krylov types
+(krylovschur/lanczos/arnoldi) under shift or sinvert ST, and the binary
+viewer's complex-build layout. Everything else rejects complex operators
+with a clear error (recorded in PARITY.md).
 """
 
 import numpy as np
@@ -202,23 +203,19 @@ class TestComplexGates:
         eps.set_operators(M)
         eps.set_problem_type("hep")
         eps.set_type("lobpcg")
-        with pytest.raises(ValueError, match="Hermitian standard"):
+        with pytest.raises(ValueError, match="real-only"):
             eps.solve()
 
-    def test_eps_nhep_and_power_reject(self, comm8):
+    def test_eps_power_subspace_reject(self, comm8):
         A = hermitian_spd(30)
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
-        eps = tps.EPS().create(comm8)
-        eps.set_operators(M)
-        eps.set_problem_type("nhep")
-        with pytest.raises(ValueError, match="Hermitian standard"):
-            eps.solve()
-        eps2 = tps.EPS().create(comm8)
-        eps2.set_operators(M)
-        eps2.set_problem_type("hep")
-        eps2.set_type("power")
-        with pytest.raises(ValueError, match="Hermitian standard"):
-            eps2.solve()
+        for t in ("power", "subspace"):
+            eps = tps.EPS().create(comm8)
+            eps.set_operators(M)
+            eps.set_problem_type("hep")
+            eps.set_type(t)
+            with pytest.raises(ValueError, match="real-only"):
+                eps.solve()
 
 
 class TestComplexBinaryIO:
@@ -269,6 +266,70 @@ class TestComplexEPS:
             np.testing.assert_allclose(lam.real, lam_exact[i], rtol=1e-9)
             assert abs(lam.imag) < 1e-9
             assert eps.compute_error(i) < 1e-7
+
+    def test_nhep_complex(self, comm8):
+        """General complex non-Hermitian eigenproblem: complex Schur
+        ordering in the thick restart (triangular form, no 2x2 blocks)."""
+        n = 80
+        C = random_complex_csr(n, density=0.15, seed=25)
+        A = (C + sp.diags(np.linspace(1, 40, n))).tocsr()
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("nhep")
+        eps.set_dimensions(nev=3)
+        eps.solve()
+        assert eps.get_converged() >= 3
+        lam_exact = np.linalg.eigvals(A.toarray())
+        lam_exact = lam_exact[np.argsort(-np.abs(lam_exact))]
+        for i in range(3):
+            lam = eps.get_eigenvalue(i)
+            assert abs(lam - lam_exact[i]) < 1e-6
+            assert eps.compute_error(i) < 1e-6
+
+    def test_ghep_complex(self, comm8):
+        """Generalized complex Hermitian A x = lambda B x (B Hermitian
+        positive definite, B-inner-product Lanczos)."""
+        import scipy.linalg
+        n = 80
+        C = random_complex_csr(n, density=0.15, seed=26)
+        A = (C + C.conj().T).tocsr() + sp.diags(np.linspace(1, 30, n))
+        B = (0.1 * (C + C.conj().T)).tocsr() + sp.eye(n) * 5.0
+        MA = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        MB = tps.Mat.from_scipy(comm8, B, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(MA, MB)
+        eps.set_problem_type("ghep")
+        eps.set_dimensions(nev=3)
+        eps.solve()
+        assert eps.get_converged() >= 3
+        lam_exact = scipy.linalg.eigh(A.toarray(), B.toarray(),
+                                      eigvals_only=True)
+        lam_exact = lam_exact[np.argsort(-np.abs(lam_exact))]
+        for i in range(3):
+            np.testing.assert_allclose(eps.get_eigenvalue(i).real,
+                                       lam_exact[i], rtol=1e-8)
+
+    def test_sinvert_complex_interior(self, comm8):
+        """Shift-and-invert on a complex Hermitian operator: interior
+        eigenvalues nearest the target (complex host factorization)."""
+        n = 80
+        C = random_complex_csr(n, density=0.15, seed=27)
+        H = (C + C.conj().T).tocsr() + sp.diags(np.linspace(1, 30, n))
+        M = tps.Mat.from_scipy(comm8, H, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_dimensions(nev=2)
+        eps.set_which_eigenpairs("target_magnitude")
+        eps.set_target(15.0)
+        eps.st.set_type("sinvert")
+        eps.solve()
+        assert eps.get_converged() >= 2
+        lam_h = np.linalg.eigvalsh(H.toarray())
+        near = set(np.round(lam_h[np.argsort(np.abs(lam_h - 15.0))][:2], 8))
+        got = {round(eps.get_eigenvalue(i).real, 8) for i in range(2)}
+        assert got == near
 
     def test_complex_eigenpair_extraction(self, comm8):
         """Complex-build getEigenpair semantics: vr carries the full complex
