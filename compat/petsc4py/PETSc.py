@@ -577,6 +577,12 @@ class KSP:
     def setMonitor(self, cb):
         self._core.set_monitor(cb)
 
+    def setConvergenceHistory(self, length=None, reset=False):
+        self._core.set_convergence_history(length=length, reset=reset)
+
+    def getConvergenceHistory(self):
+        return self._core.get_convergence_history()
+
     def destroy(self):
         return self
 

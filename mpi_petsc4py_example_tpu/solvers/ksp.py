@@ -66,6 +66,7 @@ class KSP:
         self._monitors = []
         self._monitor_flag = False
         self._view_flag = False       # -ksp_view: print config after solve
+        self._reason_flag = False     # -ksp_converged_reason: print after
         self._initial_guess_nonzero = False
         self.result = SolveResult()
         self._prefix = ""
@@ -214,6 +215,34 @@ class KSP:
 
     setMonitor = set_monitor
 
+    def set_convergence_history(self, length: int | None = None,
+                                reset: bool = False):
+        """KSPSetResidualHistory analog: record the per-iteration residual
+        norms of subsequent solves (retrievable via
+        :meth:`get_convergence_history`).
+
+        Implemented through the monitored program variant — enabling it
+        recompiles the solver once with the in-loop reporting callback.
+        ``reset=False`` (petsc4py's default) accumulates across solves;
+        ``reset=True`` clears at each solve. ``length`` truncates, ``None``
+        keeps everything. Calling again replaces the history (PETSc
+        semantics), never stacks recorders — the recorder lives outside
+        the user-monitor list, so it neither suppresses ``-ksp_monitor``'s
+        default printout nor shows up as a user monitor.
+        """
+        self._history = []
+        self._history_length = length
+        self._history_reset = bool(reset)
+        return self
+
+    setConvergenceHistory = set_convergence_history
+
+    def get_convergence_history(self):
+        """The recorded residual norms (numpy array), oldest first."""
+        return np.asarray(getattr(self, "_history", []), dtype=float)
+
+    getConvergenceHistory = get_convergence_history
+
     def set_from_options(self):
         """Apply the global options DB (the reference's ``setFromOptions``)."""
         opt = global_options()
@@ -235,6 +264,7 @@ class KSP:
             self.set_norm_type(nt)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         self._view_flag = opt.get_bool(p + "ksp_view", False)
+        self._reason_flag = opt.get_bool(p + "ksp_converged_reason", False)
         pct = opt.get_string(p + "pc_type")
         if pct:
             self.get_pc().set_type(pct)
@@ -286,17 +316,27 @@ class KSP:
         # reason CONVERGED_ITS (the smoother configuration). The monitored
         # norm is still computed in-program (eliding it entirely would need a
         # per-kernel compile variant); only the exit condition is disabled.
+        if getattr(self, "_history_reset", False):
+            self._history.clear()
         norm_none = self._norm_type == "none" and self._type != "preonly"
         rtol, atol, divtol = self.rtol, self.atol, self.divtol
         if norm_none:
             rtol, atol, divtol = 0.0, 0.0, 0.0
 
         monitor_cb = None
-        if self._monitors or self._monitor_flag:
+        history_on = hasattr(self, "_history")
+        if self._monitors or self._monitor_flag or history_on:
             monitors = list(self._monitors)
-            if self._monitor_flag and not monitors:
-                monitors = [lambda ksp, k, rn:
-                            print(f"  {int(k):4d} KSP Residual norm {float(rn):.12e}")]
+            if self._monitor_flag and not self._monitors:
+                monitors.append(
+                    lambda ksp, k, rn:
+                    print(f"  {int(k):4d} KSP Residual norm {float(rn):.12e}"))
+            if history_on:
+                def record(_ksp, _it, rn):
+                    if (self._history_length is None
+                            or len(self._history) < self._history_length):
+                        self._history.append(float(rn))
+                monitors.append(record)
 
             def monitor_cb(dev, k, rn, _monitors=monitors):
                 if int(dev) == 0:
@@ -352,6 +392,12 @@ class KSP:
                      self.result.iterations, wall, self.result.reason)
         if self._view_flag:           # -ksp_view, PETSc prints after solve
             self.view()
+        if self._reason_flag:         # -ksp_converged_reason
+            verb = ("converged" if self.result.converged else
+                    "did not converge")
+            print(f"Linear solve {verb} due to "
+                  f"{ConvergedReason.name(self.result.reason)} "
+                  f"iterations {self.result.iterations}")
         return self.result
 
     # ---- introspection (petsc4py-shaped) ------------------------------------

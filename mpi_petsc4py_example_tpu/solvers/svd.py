@@ -116,10 +116,13 @@ class SVD:
         mat = self._mat
         if mat is None:
             raise RuntimeError("SVD.solve: no operator set")
+        from ..utils.dtypes import is_complex
         A = mat.to_scipy().tocsr()
         m, n = A.shape
+        cplx = is_complex(mat.dtype)
+        AH = A.conj().T if cplx else A.T     # Hermitian adjoint
         use_left = m < n              # eigensolve the smaller cross product
-        C = (A @ A.T if use_left else A.T @ A).tocsr()
+        C = (A @ AH if use_left else AH @ A).tocsr()
         t0 = time.perf_counter()
 
         eps = EPS().create(self.comm)
@@ -133,7 +136,10 @@ class SVD:
         if self._which == "largest":
             eps.set_which_eigenpairs("largest_real")
         else:
-            eps.set_type("lobpcg")
+            # lobpcg is the efficient smallest-pair solver but real-only;
+            # complex operators fall back to krylovschur smallest_real
+            if not cplx:
+                eps.set_type("lobpcg")
             eps.set_which_eigenpairs("smallest_real")
         eps.solve()
 
@@ -142,12 +148,14 @@ class SVD:
         for i in range(nconv):
             lam = eps.get_eigenvalue(i).real
             s = float(np.sqrt(max(lam, 0.0)))
-            w = np.real(eps._eigenvectors[i])     # eigenvector of C
+            w = eps._eigenvectors[i]              # eigenvector of C
+            if not cplx:
+                w = np.real(w)
             w = w / (np.linalg.norm(w) or 1.0)
             if s > np.finfo(np.float64).tiny ** 0.5:
-                o = (A.T @ w if use_left else A @ w) / s
+                o = (AH @ w if use_left else A @ w) / s
             else:                                  # zero singular value
-                o = np.zeros(n if use_left else m)
+                o = np.zeros(n if use_left else m, dtype=w.dtype)
             sig.append(s)
             W.append(w)
             other.append(o)
@@ -157,7 +165,7 @@ class SVD:
             if use_left:
                 r_abs = float(np.linalg.norm(A @ v - s * u))
             else:
-                r_abs = float(np.linalg.norm(A.T @ u - s * v))
+                r_abs = float(np.linalg.norm(AH @ u - s * v))
             # relative in σ, absolute once σ is numerically zero (dividing
             # by tiny would report ~1e300 for exactly-singular matrices)
             res.append(r_abs / s if s > np.finfo(np.float64).tiny ** 0.5

@@ -76,6 +76,70 @@ class TestLogView:
         assert "KSPSolve(cg+none)" in out
         assert "solve(s), total wall" in out
 
+    def test_convergence_history(self, comm8):
+        """KSPSetResidualHistory analog: per-iteration residual norms."""
+        A = poisson2d_csr(8)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-10)
+        ksp.set_convergence_history()
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(64))
+        res = ksp.solve(b, x)
+        h = ksp.get_convergence_history()
+        assert len(h) == res.iterations
+        assert h[-1] < h[0]                   # monotone-ish decrease
+        np.testing.assert_allclose(h[-1], res.residual_norm, rtol=1e-6)
+        # reset=False (petsc4py default): second solve accumulates
+        x.zero()
+        res2 = ksp.solve(b, x)
+        assert len(ksp.get_convergence_history()) == (res.iterations
+                                                      + res2.iterations)
+        # calling again REPLACES (no stacked recorders); reset=True clears
+        # per solve; length truncates
+        ksp.set_convergence_history(length=3, reset=True)
+        x.zero()
+        res3 = ksp.solve(b, x)
+        assert len(ksp.get_convergence_history()) == 3
+        x.zero()
+        ksp.solve(b, x)
+        assert len(ksp.get_convergence_history()) == 3   # cleared, refilled
+
+    def test_history_does_not_suppress_monitor_flag(self, comm8, capsys):
+        """-ksp_monitor's default printout and the history recorder are
+        independent (as in PETSc)."""
+        tps.init(["prog", "-ksp_monitor"])
+        A = poisson2d_csr(6)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_from_options()
+        ksp.set_convergence_history()
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(36))
+        res = ksp.solve(b, x)
+        out = capsys.readouterr().out
+        assert "KSP Residual norm" in out
+        assert len(ksp.get_convergence_history()) == res.iterations
+
+    def test_converged_reason_flag(self, comm8, capsys):
+        """-ksp_converged_reason prints PETSc's post-solve line."""
+        tps.init(["prog", "-ksp_converged_reason"])
+        A = poisson2d_csr(6)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_from_options()
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(36))
+        ksp.solve(b, x)
+        out = capsys.readouterr().out
+        assert "Linear solve converged due to CONVERGED_RTOL" in out
+
     def test_sync_points_counted(self, comm8):
         """log_view reports host-device sync counts: one KSP result fetch
         per solve, one EPS projected-matrix fetch per restart."""
