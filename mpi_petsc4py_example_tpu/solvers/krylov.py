@@ -1464,12 +1464,17 @@ def _monitor_trampoline(dev, k, rn):
 _UNROLLABLE = ("cg",)
 
 # kernels whose recurrences are complex-correct with the conjugating pdot,
-# conjugating basis projections, and the complex-capable Givens rotations
-# (PETSc complex-build slice): CG/FCG for Hermitian positive definite,
-# BiCGStab/GCR for general systems, the GMRES family, direct preonly,
-# Richardson smoothing.
-_COMPLEX_KSP = ("cg", "fcg", "bcgs", "gmres", "fgmres", "lgmres", "gcr",
-                "preonly", "richardson")
+# conjugating basis projections, the complex-capable Givens rotations, and
+# the adjoint (A^H) transpose wiring (PETSc complex-build slice):
+# CG/FCG for Hermitian positive definite, CR/Chebyshev for Hermitian,
+# BiCGStab(+flexible/ell)/CGS/GCR and the GMRES family for general
+# systems, CGNE/LSQR on the adjoint normal equations, direct preonly,
+# Richardson smoothing. Still real-only: bicg (bilinear-form shadow
+# recurrence), pipecg/fbcgsr (fused-reduction scalar identities carry
+# mixed real/complex state), minres/symmlq/tfqmr (ditto).
+_COMPLEX_KSP = ("cg", "fcg", "bcgs", "fbcgs", "bcgsl", "cgs", "gmres",
+                "fgmres", "lgmres", "gcr", "cr", "chebyshev", "cgne",
+                "lsqr", "preonly", "richardson")
 
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
@@ -1619,9 +1624,18 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # w -> A^T(Pw): project BEFORE the transpose product (P is
                 # the null(A) projector; projecting after would be wrong for
                 # unsymmetric A). project is the identity without a nullspace.
-                kw["At"] = lambda v: spmv_t_local(op_arrays, project(v))
+                if is_complex(dtype):
+                    # cgne/lsqr normal equations need the ADJOINT A^H for
+                    # complex scalars: A^H v = conj(A^T conj(v)). (bicg is
+                    # gated complex — its bilinear-form shadow recurrence
+                    # does not transfer — so only At needs the wrapper.)
+                    kw["At"] = lambda v: jnp.conj(
+                        spmv_t_local(op_arrays, jnp.conj(project(v))))
+                else:
+                    kw["At"] = lambda v: spmv_t_local(op_arrays, project(v))
                 if ksp_type == "bicg":
-                    # same adjoint rule for the preconditioner: (P M)^T = M^T P
+                    # same adjoint rule for the preconditioner:
+                    # (P M)^T = M^T P
                     kw["Mt"] = lambda r: pc_apply_t(pc_arrays, project(r))
             return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
         return body

@@ -107,6 +107,30 @@ class TestComplexKSP:
         assert res.converged
         np.testing.assert_allclose(x, x_true, atol=1e-8)
 
+    @pytest.mark.parametrize("ksp_type", ["cgs", "bcgsl", "fbcgs"])
+    def test_bicgstab_family_general(self, comm8, ksp_type):
+        A = (random_complex_csr(70, seed=17) + sp.eye(70) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, ksp_type, "jacobi", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    @pytest.mark.parametrize("ksp_type", ["cr", "chebyshev"])
+    def test_hermitian_types(self, comm8, ksp_type):
+        A = hermitian_spd(70, seed=18, shift=25.0)
+        pc = "none" if ksp_type == "chebyshev" else "jacobi"
+        x, x_true, res = self.solve(comm8, A, ksp_type, pc, rtol=1e-9)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-6)
+
+    @pytest.mark.parametrize("ksp_type", ["cgne", "lsqr"])
+    def test_adjoint_normal_equations(self, comm8, ksp_type):
+        """cgne/lsqr run on A^H A for complex operators (the adjoint, not
+        the plain transpose — A^T A is not even Hermitian)."""
+        A = (random_complex_csr(60, seed=19) + sp.eye(60) * 8).tocsr()
+        x, x_true, res = self.solve(comm8, A, ksp_type, "none", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
     @pytest.mark.parametrize("ksp_type", ["gmres", "fgmres", "lgmres", "gcr"])
     def test_gmres_family_general(self, comm8, ksp_type):
         """Complex Givens rotations + conjugating basis projections."""
@@ -147,12 +171,14 @@ class TestComplexKSP:
 
 
 class TestComplexGates:
-    def test_minres_rejects(self, comm8):
+    @pytest.mark.parametrize("ksp_type", ["minres", "bicg", "pipecg",
+                                          "tfqmr"])
+    def test_real_only_types_reject(self, comm8, ksp_type):
         A = hermitian_spd(30)
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
         ksp = tps.KSP().create(comm8)
         ksp.set_operators(M)
-        ksp.set_type("minres")
+        ksp.set_type(ksp_type)
         x, bv = M.get_vecs()
         bv.set_global(cvec(30))
         with pytest.raises(ValueError, match="complex"):
