@@ -254,7 +254,8 @@ def _build_power_program(comm: DeviceComm, op, steps: int):
             return spmv(op_arrays, u)
 
         def pnorm(u):
-            return jnp.sqrt(lax.psum(jnp.vdot(u, u), axis))
+            # real-typed also for complex vectors (vdot(u,u) has ~0 imag)
+            return jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, u), axis)))
 
         def step(_, u):
             w = A(u)
@@ -501,13 +502,13 @@ class EPS:
         # complex gate at the single dispatch point so every solver type is
         # covered (lobpcg in particular never calls _setup_operator)
         if is_complex(mat.dtype):
-            ok = self._type in ("krylovschur", "lanczos", "arnoldi")
+            ok = self._type in ("krylovschur", "lanczos", "arnoldi",
+                                "power", "subspace")
             if not ok:
                 raise ValueError(
-                    "complex EPS support covers the Krylov types "
-                    "(krylovschur/lanczos/arnoldi) for HEP/GHEP/NHEP with "
-                    "shift or sinvert ST — power/subspace/lobpcg are "
-                    "real-only (tracked in PARITY.md)")
+                    "complex EPS support covers krylovschur/lanczos/arnoldi/"
+                    "power/subspace for HEP/GHEP/NHEP with shift or sinvert "
+                    "ST — lobpcg is real-only (tracked in PARITY.md)")
 
         t0 = time.perf_counter()
         if self._type == "power":
@@ -756,7 +757,8 @@ class EPS:
         its = 0
         for chunk in range(1, self.max_it + 1):
             v, theta_a, res_a = prog(op_arrays, v)
-            theta = float(theta_a)
+            theta = (complex(theta_a) if is_complex(dtype)
+                     else float(theta_a))
             res = float(res_a)
             record_sync("EPS power fetch/chunk", 2)
             rel = res / max(abs(theta), 1e-300)
@@ -803,9 +805,10 @@ class EPS:
             Qp[:, :n] = Q
             W = comm.host_fetch(prog(op_arrays, comm.put_spec(Qp, P(None, comm.axis))))
             record_sync("EPS subspace fetch/iter")
-            Hm = Q @ W[:, :n].T           # Hm[i,j] = <q_i, A q_j>, W[j] = A q_j
+            # Hm[i,j] = <q_i, A q_j> (conjugate on the projector row)
+            Hm = Q.conj() @ W[:, :n].T
             if hermitian:
-                Hm = (Hm + Hm.T) / 2.0
+                Hm = (Hm + Hm.conj().T) / 2.0
                 lam_t, S = np.linalg.eigh(Hm)
             else:
                 lam_t, S = np.linalg.eig(Hm)
@@ -821,7 +824,10 @@ class EPS:
             if nconv >= nev or it == self.max_it:
                 break
             Y = np.zeros((ncv, npad), dtype=dtype)
-            Y[:, :n] = np.real(W[:, :n])              # power step: Y <- A Q
+            # power step: Y <- A Q (real dtypes drop the spurious imaginary
+            # parts complex-pair arithmetic can introduce; complex keep all)
+            Y[:, :n] = (W[:, :n] if is_complex(dtype)
+                        else np.real(W[:, :n]))
 
         count = max(nev, 1)
         lam = self.st.back_transform(lam_t[order[:count]])

@@ -232,16 +232,6 @@ class TestComplexGates:
         with pytest.raises(ValueError, match="real-only"):
             eps.solve()
 
-    def test_eps_power_subspace_reject(self, comm8):
-        A = hermitian_spd(30)
-        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
-        for t in ("power", "subspace"):
-            eps = tps.EPS().create(comm8)
-            eps.set_operators(M)
-            eps.set_problem_type("hep")
-            eps.set_type(t)
-            with pytest.raises(ValueError, match="real-only"):
-                eps.solve()
 
 
 class TestComplexBinaryIO:
@@ -402,6 +392,27 @@ class TestComplexEPS:
         near = set(np.round(lam_h[np.argsort(np.abs(lam_h - 15.0))][:2], 8))
         got = {round(eps.get_eigenvalue(i).real, 8) for i in range(2)}
         assert got == near
+
+    @pytest.mark.parametrize("eps_type", ["power", "subspace"])
+    def test_power_subspace_complex_dominant(self, comm8, eps_type):
+        """Dominant pair of a complex Hermitian operator via the simple
+        iterations (conjugating Rayleigh projections)."""
+        n = 80
+        B = random_complex_csr(n, density=0.15, seed=28)
+        H = (B + B.conj().T).tocsr() + sp.diags(np.linspace(1, 50, n))
+        M = tps.Mat.from_scipy(comm8, H, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.set_type(eps_type)
+        eps.set_dimensions(nev=1)
+        eps.solve()
+        assert eps.get_converged() >= 1
+        lam_exact = np.linalg.eigvalsh(H.toarray())
+        dom = lam_exact[np.argmax(np.abs(lam_exact))]
+        np.testing.assert_allclose(eps.get_eigenvalue(0).real, dom,
+                                   rtol=1e-7)
+        assert eps.compute_error(0) < 1e-6
 
     def test_complex_eigenpair_extraction(self, comm8):
         """Complex-build getEigenpair semantics: vr carries the full complex
