@@ -59,6 +59,14 @@ def _reason(rnorm, tol, atol, k, maxit, brk, dmax=None):
                   diverged)).astype(jnp.int32)
 
 
+def _mon0(monitor, rn0):
+    """Report the iteration-0 (initial) residual norm. petsc4py's monitors
+    and KSPSetResidualHistory include it — history length is iterations+1,
+    and drivers index history[0] for the starting norm."""
+    if monitor is not None:
+        monitor(jnp.int32(0), rn0)
+
+
 def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
               dtol=None, unroll=1):
     """Preconditioned conjugate gradients (KSPCG equivalent).
@@ -79,6 +87,7 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rz = pdot(r, z)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def active(st):
         k, x, r, z, p, rz, rn, brk = st
@@ -146,6 +155,7 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     rz = rr * inv_diag
     p = r * inv_diag
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def active(st):
         k, x, r, p, rz, rn, brk = st
@@ -181,6 +191,7 @@ def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rhat = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     one = jnp.asarray(1.0, b.dtype)
     z = jnp.zeros_like(b)
 
@@ -243,6 +254,7 @@ def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rhat = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     one = jnp.asarray(1.0, b.dtype)
     z = jnp.zeros_like(b)
 
@@ -379,6 +391,7 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r0 = M(b - A(x0))
     rnorm0 = pnorm(r0)
     dmax = _dmax(rnorm0, dtol)
+    _mon0(monitor, rnorm0)
 
     def cycle(st):
         k, x, rn = st
@@ -442,6 +455,7 @@ def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def cond(st):
         k, x, r, rn = st
@@ -521,6 +535,7 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     rnorm0 = pnorm(r1)
     scale = rnorm0 / jnp.where(beta1 == 0, 1.0, beta1)
+    _mon0(monitor, rnorm0)
     st0 = dict(k=jnp.int32(0), x=x0, r1=r1, r2=r1, y=y,
                beta_old=jnp.asarray(1.0, dt), beta=beta1,
                dbar=jnp.asarray(0.0, dt), epsln=jnp.asarray(0.0, dt),
@@ -568,6 +583,7 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     dmax = _dmax(rnorm, dtol)
     rho = 1.0 / sigma
     d = z / theta
+    _mon0(monitor, rnorm)
 
     def cond(st):
         k, x, r, d, rho, rn = st
@@ -606,7 +622,9 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     u = M(r)
     w = A(u)
-    dmax = _dmax(pnorm(r), dtol)
+    rn0 = pnorm(r)
+    dmax = _dmax(rn0, dtol)
+    _mon0(monitor, rn0)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
@@ -664,6 +682,7 @@ def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(b - A(x0))
     dmax = _dmax(rnorm0, dtol)
+    _mon0(monitor, rnorm0)
 
     def cycle(st):
         k, x, rn = st
@@ -716,6 +735,7 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rtilde = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
@@ -771,6 +791,7 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rstar = r0
     tau0 = pnorm(r0)
     dmax = _dmax(tau0, dtol)
+    _mon0(monitor, tau0)
     zero = jnp.zeros_like(b)
     dt = b.dtype
     u1_0 = op(r0)
@@ -843,6 +864,7 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rho = pdot(r, w)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def cond(st):
         k, x, r, p, w, q, rho, rn, brk = st
@@ -891,6 +913,7 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     v, alfa = normalize(At(u))
     w = v
     dmax = _dmax(beta, dtol)
+    _mon0(monitor, beta)
 
     def cond(st):
         return ((st["phibar"] > tol) & (st["phibar"] < dmax)
@@ -944,6 +967,7 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rho = pdot(rt, z)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def cond(st):
         k, x, r, rt, p, pt, rho, rn, brk = st
@@ -990,6 +1014,7 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     r = b - A(x0)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     V = jnp.zeros((m,) + b.shape, b.dtype)
     Z = jnp.zeros_like(V)
 
@@ -1047,6 +1072,7 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     gamma = pdot(s, z)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
 
     def cond(st):
         k, x, r, p, gamma, rn, brk = st
@@ -1092,6 +1118,7 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     r0 = b - A(x0)
     rnorm0 = pnorm(r0)
     dmax = _dmax(rnorm0, dtol)
+    _mon0(monitor, rnorm0)
 
     y = M(r0)
     beta1sq = pdot(r0, y)
@@ -1194,6 +1221,7 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
     APbuf = jnp.zeros_like(Pbuf)
     eta = jnp.zeros(m, b.dtype)
@@ -1253,6 +1281,7 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(M(b - A(x0)))
     dmax = _dmax(rnorm0, dtol)
+    _mon0(monitor, rnorm0)
     Z0 = jnp.zeros((aug, lsize), b.dtype)
 
     def cycle(st):
@@ -1320,6 +1349,7 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     rtilde = r0
     rnorm = pnorm(r0)
     dmax = _dmax(rnorm, dtol)
+    _mon0(monitor, rnorm)
     dt = b.dtype
     Rb = jnp.zeros((L + 1,) + b.shape, dt).at[0].set(r0)
     Ub = jnp.zeros_like(Rb)
@@ -1554,6 +1584,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # cache key via pc.program_key() + operator.program_key().
     stencil_cg = (ksp_type == "cg" and nullspace_dim == 0
                   and unroll_k == 1
+                  # the fused Pallas partial sums u*y without a conjugate and
+                  # carries a real-typed rr — real operators only
+                  and not is_complex(dtype)
                   and pc.get_type() in ("none", "jacobi")
                   and hasattr(operator, "local_matvec_dot")
                   and getattr(operator, "uniform_diagonal", None) is not None
@@ -1576,6 +1609,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
     monitor = None
     if monitored:
+        # unordered callbacks (ordered effects are single-device-only); the
+        # KSP solve buffers the (k, rn) reports and dispatches them sorted
+        # by k after the program completes, so delivery order is irrelevant
         def monitor(k, rn):
             jax.debug.callback(_monitor_trampoline, lax.axis_index(axis),
                                k, rn)

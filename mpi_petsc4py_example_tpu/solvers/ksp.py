@@ -219,19 +219,25 @@ class KSP:
                                 reset: bool = False):
         """KSPSetResidualHistory analog: record the per-iteration residual
         norms of subsequent solves (retrievable via
-        :meth:`get_convergence_history`).
+        :meth:`get_convergence_history`). Like petsc4py, the iteration-0
+        initial residual is included; one entry is recorded per convergence
+        check — per iteration for most types (``iterations + 1`` entries),
+        per restart cycle for the cycle-granular kernels
+        (gmres/fgmres/lgmres, and per ℓ-step for bcgsl).
 
         Implemented through the monitored program variant — enabling it
         recompiles the solver once with the in-loop reporting callback.
         ``reset=False`` (petsc4py's default) accumulates across solves;
-        ``reset=True`` clears at each solve. ``length`` truncates, ``None``
-        keeps everything. Calling again replaces the history (PETSc
-        semantics), never stacks recorders — the recorder lives outside
-        the user-monitor list, so it neither suppresses ``-ksp_monitor``'s
-        default printout nor shows up as a user monitor.
+        ``reset=True`` clears at each solve. ``length`` truncates and
+        defaults to petsc4py's 10000-entry bound (with ``reset=False`` the
+        history grows across solves for the KSP's lifetime — unbounded
+        would leak on long-running drivers). Calling again replaces the
+        history (PETSc semantics), never stacks recorders — the recorder
+        lives outside the user-monitor list, so it neither suppresses
+        ``-ksp_monitor``'s default printout nor shows up as a user monitor.
         """
         self._history = []
-        self._history_length = length
+        self._history_length = 10000 if length is None else int(length)
         self._history_reset = bool(reset)
         return self
 
@@ -324,6 +330,7 @@ class KSP:
             rtol, atol, divtol = 0.0, 0.0, 0.0
 
         monitor_cb = None
+        monitor_buf = []
         history_on = hasattr(self, "_history")
         if self._monitors or self._monitor_flag or history_on:
             monitors = list(self._monitors)
@@ -333,15 +340,17 @@ class KSP:
                     print(f"  {int(k):4d} KSP Residual norm {float(rn):.12e}"))
             if history_on:
                 def record(_ksp, _it, rn):
-                    if (self._history_length is None
-                            or len(self._history) < self._history_length):
+                    if len(self._history) < self._history_length:
                         self._history.append(float(rn))
                 monitors.append(record)
 
-            def monitor_cb(dev, k, rn, _monitors=monitors):
+            # the in-program reports arrive as UNORDERED debug callbacks
+            # (ordered effects are single-device-only); buffer them and
+            # dispatch sorted by iteration after the program completes, so
+            # async delivery can never hand history[0] a later residual
+            def monitor_cb(dev, k, rn):
                 if int(dev) == 0:
-                    for m in _monitors:
-                        m(self, int(k), float(rn))
+                    monitor_buf.append((int(k), float(rn)))
 
         nullspace = getattr(mat, "nullspace", None)
         if nullspace is not None and nullspace.dim == 0:
@@ -376,6 +385,11 @@ class KSP:
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
             from ..utils.profiling import record_sync
             record_sync("KSP result fetch/solve")
+            if monitor_cb is not None:
+                jax.effects_barrier()     # all callbacks delivered
+                for k_it, k_rn in sorted(monitor_buf, key=lambda t: t[0]):
+                    for m in monitors:
+                        m(self, k_it, k_rn)
         finally:
             set_current_monitor(None)
         wall = time.perf_counter() - t0

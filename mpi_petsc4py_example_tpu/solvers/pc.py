@@ -246,12 +246,15 @@ class PC:
                 S = mat.to_scipy()
                 D = (S - S.conj().T).tocsr()
                 scale = abs(S).max() or 1.0
-                if D.nnz and abs(D).max() > 1e-10 * scale:
+                # tolerance scales with the operator dtype: fp32 assembly
+                # carries ~eps-relative accumulation asymmetry that must not
+                # reject a legitimately symmetric operator
+                rel = max(1e-10, 100 * float(np.finfo(np.dtype(mat.dtype)).eps))
+                if D.nnz and abs(D).max() > rel * scale:
                     raise ValueError(
                         "PC 'cholesky' needs a symmetric (Hermitian) "
                         "operator — use pc 'lu' for unsymmetric matrices")
             if (mat.shape[0] > _DENSE_CAP
-                    and not is_complex(mat.dtype)
                     and set(getattr(mat, "dia_offsets", ())) and
                     set(mat.dia_offsets) <= {-1, 0, 1}):
                 self._arrays = _build_tridiag_cr(comm, mat)
@@ -513,10 +516,15 @@ class PC:
         if k in ("none", "jacobi"):
             return self.local_apply(comm, n)      # diagonal: symmetric
         if k == "crtri" and self._type == "cholesky":
-            # cholesky's contract is a symmetric operator: M = M^T, the
-            # forward PCR apply IS the transpose apply, no second
-            # factorization needed (lu makes no symmetry promise -> None)
-            return self.local_apply(comm, n)
+            # cholesky's contract is a symmetric (complex: Hermitian)
+            # operator. Real: M = M^T, the forward PCR apply IS the
+            # transpose apply. Complex Hermitian: M^T = conj(M), so
+            # M^T r = conj(M(conj(r))) — still no second factorization
+            # (lu makes no symmetry promise -> None).
+            fwd = self.local_apply(comm, n)
+            if self._mat is not None and is_complex(self._mat.dtype):
+                return lambda arrs, r: jnp.conj(fwd(arrs, jnp.conj(r)))
+            return fwd
         if k == "bjacobi":
             def apply_t(arrs, r):
                 binv = arrs[0]  # (nb, bs, bs) explicit block inverses
@@ -773,10 +781,11 @@ def _build_tridiag_cr(comm: DeviceComm, mat: Mat):
             f"arrays; n={n} exceeds the {_CR_CAP} cap — use an iterative "
             "KSP with pc 'jacobi'/'gamg' instead")
     A = mat.to_scipy().tocsr()
-    a = np.concatenate([[0.0], np.asarray(A.diagonal(-1))])
-    b = np.asarray(A.diagonal(0))
-    c = np.concatenate([np.asarray(A.diagonal(1)), [0.0]])
-    alphas, gammas, bfin = pcr_setup(a, b, c)
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    a = np.concatenate([[0.0], np.asarray(A.diagonal(-1))]).astype(host_dt)
+    b = np.asarray(A.diagonal(0), dtype=host_dt)
+    c = np.concatenate([np.asarray(A.diagonal(1)), [0.0]]).astype(host_dt)
+    alphas, gammas, bfin = pcr_setup(a, b, c, apply_dtype=mat.dtype)
     dt = mat.dtype
     return (comm.put_replicated(alphas.astype(dt)),
             comm.put_replicated(gammas.astype(dt)),
@@ -794,8 +803,7 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     n = mat.shape[0]
     if n > _DENSE_CAP:
         hint = ("tridiagonal operators take the cyclic-reduction direct "
-                "path automatically" if not is_complex(mat.dtype) else
-                "the cyclic-reduction tridiagonal path is real-only")
+                "path automatically")
         raise ValueError(
             f"PC 'lu' densifies general operators; n={n} is too large — "
             f"{hint}; otherwise use an iterative KSP with pc "

@@ -33,11 +33,14 @@ from __future__ import annotations
 import numpy as np
 
 
-def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray):
+def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray,
+              apply_dtype=None):
     """Precompute PCR sweep coefficients for the tridiagonal (a, b, c).
 
     ``a`` is the subdiagonal (a[0] ignored/0), ``b`` the diagonal, ``c``
-    the superdiagonal (c[-1] ignored/0), all length n, fp64.
+    the superdiagonal (c[-1] ignored/0), all length n. Setup runs in host
+    fp64 (complex inputs: complex128 — the coefficient transforms are
+    rational with real constants, so the complex case is the same sweep).
 
     Returns ``(alphas, gammas, bfin)``: two (S, n) arrays of per-sweep
     neighbour multipliers (S = ceil(log2 n)) and the length-n fully-reduced
@@ -50,10 +53,19 @@ def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray):
 
     where ``shift_up(d, s)[i] = d[i-s]`` (zero fill) and ``shift_down``
     mirrors it. Rows beyond either end behave as identity equations.
+
+    ``apply_dtype``: the dtype the device apply will run in. When it is
+    lower-precision than the setup dtype, the factorization probe is re-run
+    through the cast coefficients — a factorization can pass the fp64 probe
+    yet lose its accuracy entirely at fp32 apply time (catastrophic, not
+    roundoff-scale: the second probe gates at 0.1 because legitimate
+    reduced-precision roundoff is recovered by KSPPREONLY's refinement).
     """
-    a = np.asarray(a, np.float64).copy()
-    b = np.asarray(b, np.float64).copy()
-    c = np.asarray(c, np.float64).copy()
+    host_dt = (np.complex128
+               if any(np.iscomplexobj(v) for v in (a, b, c)) else np.float64)
+    a = np.asarray(a, host_dt).copy()
+    b = np.asarray(b, host_dt).copy()
+    c = np.asarray(c, host_dt).copy()
     n = b.shape[0]
     if n == 0:
         raise ValueError("pcr_setup: empty system")
@@ -66,22 +78,24 @@ def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray):
             "iterative KSP with pc 'jacobi'/'gamg' instead")
     b0_mul_ones = a + b + c   # A · ones, for the post-setup probe solve
     S = max(1, int(np.ceil(np.log2(n)))) if n > 1 else 1
-    alphas = np.zeros((S, n), np.float64)
-    gammas = np.zeros((S, n), np.float64)
+    alphas = np.zeros((S, n), host_dt)
+    gammas = np.zeros((S, n), host_dt)
 
     def up(v, s):      # v[i-s], identity-row fill
-        return np.concatenate([np.zeros(s), v[:-s]]) if s < n else \
-            np.zeros(n)
+        return np.concatenate([np.zeros(s, host_dt), v[:-s]]) if s < n else \
+            np.zeros(n, host_dt)
 
     def down(v, s):    # v[i+s]
-        return np.concatenate([v[s:], np.zeros(s)]) if s < n else \
-            np.zeros(n)
+        return np.concatenate([v[s:], np.zeros(s, host_dt)]) if s < n else \
+            np.zeros(n, host_dt)
 
     def upb(v, s):     # diagonal of identity rows is 1, not 0
-        return np.concatenate([np.ones(s), v[:-s]]) if s < n else np.ones(n)
+        return (np.concatenate([np.ones(s, host_dt), v[:-s]]) if s < n
+                else np.ones(n, host_dt))
 
     def downb(v, s):
-        return np.concatenate([v[s:], np.ones(s)]) if s < n else np.ones(n)
+        return (np.concatenate([v[s:], np.ones(s, host_dt)]) if s < n
+                else np.ones(n, host_dt))
 
     for k in range(S):
         s = 1 << k
@@ -114,20 +128,38 @@ def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray):
             "PCR factorization failed its probe solve (pivotless element "
             "growth) — this tridiagonal needs a pivoted factorization; use "
             "an iterative KSP with pc 'jacobi'/'gamg' instead")
+    if apply_dtype is not None and \
+            np.finfo(np.dtype(apply_dtype)).eps > np.finfo(host_dt).eps:
+        # second probe through the dtype the device will actually apply:
+        # the fp64 gate says nothing about fp32 sweep accuracy. Gate only
+        # on catastrophic loss — plain fp32 roundoff (even at moderate
+        # conditioning) is what preonly's refinement steps exist for.
+        cast = np.dtype(apply_dtype)
+        x1c = pcr_apply_np(d1.astype(cast), alphas.astype(cast),
+                           gammas.astype(cast), b.astype(cast))
+        if not np.all(np.isfinite(x1c)) or np.max(np.abs(x1c - 1.0)) > 0.1:
+            raise ValueError(
+                f"PCR factorization failed its probe solve in the operator "
+                f"dtype {cast} (the fp64 factorization is fine, but the "
+                "reduced-precision apply loses it) — assemble the operator "
+                "in float64/complex128 or use an iterative KSP")
     return alphas, gammas, b
 
 
 def pcr_apply_np(d, alphas, gammas, bfin):
     """Host-numpy mirror of :func:`pcr_apply` — used by the setup-time
-    factorization probe (and as an oracle in tests)."""
-    d = np.asarray(d, np.float64).copy()
+    factorization probe (and as an oracle in tests). Runs in the common
+    dtype of the rhs and the sweep arrays (fp64/complex128 setup probes,
+    fp32/complex64 cast-dtype probes)."""
+    dt = np.result_type(np.asarray(d).dtype, alphas.dtype)
+    d = np.asarray(d, dt).copy()
     n = d.shape[0]
     for k in range(alphas.shape[0]):
         s = 1 << k
-        du = np.concatenate([np.zeros(s), d[:-s]]) if s < n else \
-            np.zeros(n)
-        dd = np.concatenate([d[s:], np.zeros(s)]) if s < n else \
-            np.zeros(n)
+        du = np.concatenate([np.zeros(s, dt), d[:-s]]) if s < n else \
+            np.zeros(n, dt)
+        dd = np.concatenate([d[s:], np.zeros(s, dt)]) if s < n else \
+            np.zeros(n, dt)
         d = d + alphas[k] * du + gammas[k] * dd
     return d / bfin
 

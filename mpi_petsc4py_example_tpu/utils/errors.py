@@ -29,6 +29,12 @@ class DeviceExecutionError(RuntimeError):
             hints.append(
                 "device memory exhausted — shard over more devices, use "
                 "fp32/bf16, or the matrix-free stencil path")
+        if "host send/recv callbacks" in msg or "debug.callback" in msg:
+            hints.append(
+                "this runtime does not support in-program host callbacks — "
+                "-ksp_monitor and set_convergence_history need a "
+                "callback-capable runtime (the CPU mesh has one); run the "
+                "solve without monitors here")
         if "LuDecomposition" in msg or "not implemented" in msg.lower():
             hints.append(
                 "an op is unsupported on this backend/dtype — direct "

@@ -89,14 +89,17 @@ class TestLogView:
         b.set_global(A @ np.ones(64))
         res = ksp.solve(b, x)
         h = ksp.get_convergence_history()
-        assert len(h) == res.iterations
+        # petsc4py semantics: the iteration-0 initial residual is included
+        assert len(h) == res.iterations + 1
         assert h[-1] < h[0]                   # monotone-ish decrease
+        np.testing.assert_allclose(h[0], np.linalg.norm(A @ np.ones(64)),
+                                   rtol=1e-6)
         np.testing.assert_allclose(h[-1], res.residual_norm, rtol=1e-6)
         # reset=False (petsc4py default): second solve accumulates
         x.zero()
         res2 = ksp.solve(b, x)
         assert len(ksp.get_convergence_history()) == (res.iterations
-                                                      + res2.iterations)
+                                                      + res2.iterations + 2)
         # calling again REPLACES (no stacked recorders); reset=True clears
         # per solve; length truncates
         ksp.set_convergence_history(length=3, reset=True)
@@ -123,7 +126,8 @@ class TestLogView:
         res = ksp.solve(b, x)
         out = capsys.readouterr().out
         assert "KSP Residual norm" in out
-        assert len(ksp.get_convergence_history()) == res.iterations
+        assert "   0 KSP Residual norm" in out    # iteration-0 line, as PETSc
+        assert len(ksp.get_convergence_history()) == res.iterations + 1
 
     def test_converged_reason_flag(self, comm8, capsys):
         """-ksp_converged_reason prints PETSc's post-solve line."""

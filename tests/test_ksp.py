@@ -795,7 +795,7 @@ class TestUnroll:
         x, bv = M.get_vecs()
         bv.set_global(b)
         res = ksp.solve(bv, x)
-        assert len(seen) == res.iterations
+        assert len(seen) == res.iterations + 1    # +1: the iteration-0 norm
         assert seen == sorted(set(seen))          # each step exactly once
 
 

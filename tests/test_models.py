@@ -200,7 +200,8 @@ class TestStencil:
         bv.set_global(b)
         res = ksp.solve(bv, x)
         assert res.converged
-        assert len(seen) == res.iterations
+        assert len(seen) == res.iterations + 1    # +1: the iteration-0 norm
+        assert seen[0][0] == 0
         assert seen[-1][1] <= seen[0][1]
 
         ksp2 = tps.KSP().create(comm8)
