@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.spmv import csr_to_ell, ell_spmv_local
+from ..utils.dtypes import is_complex
 
 DEFAULT_THRESHOLD = 0.0     # PCGAMG default: keep all connections
 DEFAULT_COARSE_SIZE = 64
@@ -116,12 +117,13 @@ def _tentative_prolongator(agg: np.ndarray, nagg: int):
 
 def _smoothed_prolongator(A, P0, omega: float = 4.0 / 3.0):
     """P = (I - omega/rho(D^-1 A) * D^-1 A) P0 (damped-Jacobi smoothing)."""
-    d = A.diagonal().astype(np.float64)
+    host_dt = np.complex128 if np.iscomplexobj(A.data) else np.float64
+    d = A.diagonal().astype(host_dt)
     d[d == 0] = 1.0
     dinv = 1.0 / d
     # cheap rho(D^-1 A) estimate: a few power iterations
     rng = np.random.default_rng(7)
-    x = rng.standard_normal(A.shape[0])
+    x = rng.standard_normal(A.shape[0]).astype(host_dt)
     x /= np.linalg.norm(x)
     rho = 1.0
     for _ in range(10):
@@ -155,7 +157,10 @@ def sa_setup(A, threshold: float = DEFAULT_THRESHOLD,
         P0 = _tentative_prolongator(agg, nagg)
         Pl = _smoothed_prolongator(A, P0)
         levels.append((A, Pl))
-        A = (Pl.T @ A @ Pl).tocsr()
+        # Galerkin triple product with the ADJOINT restriction (P^H A P):
+        # keeps complex-Hermitian fine operators Hermitian on every level
+        # (plain P^T for real matrices, where conj is the identity)
+        A = (Pl.conj().T @ A @ Pl).tocsr()
     return levels, A
 
 
@@ -181,10 +186,11 @@ class AMGHierarchy:
         self.lsizes = [comm.local_size(n) for n in self.sizes]
         self._arrays = []
         self._specs = []
+        host_dt = np.complex128 if is_complex(dtype) else np.float64
         for A, Pl in levels:
             acols, avals = csr_to_ell(A.indptr, A.indices, A.data)
             pcols, pvals = csr_to_ell(Pl.indptr, Pl.indices, Pl.data)
-            d = A.diagonal().astype(np.float64)
+            d = A.diagonal().astype(host_dt)
             d[d == 0] = 1.0
             self._arrays += [
                 comm.put_rows(acols), comm.put_rows(avals.astype(dtype)),
@@ -247,9 +253,10 @@ class AMGHierarchy:
                 # pre-smooth (one weighted-Jacobi step from zero)
                 z = omega * dinv * r_local
                 rr = r_local - Az(z)
-                # restrict: rc = P^T rr (scatter-add + psum, reverse of the
-                # all-gather prolongation)
-                contrib = pvals * rr[:, None]
+                # restrict: rc = P^H rr (scatter-add + psum, reverse of the
+                # all-gather prolongation; conj matches the Galerkin P^H A P
+                # and is the identity for real dtypes)
+                contrib = jnp.conj(pvals) * rr[:, None]
                 buf = jnp.zeros(npad_c, rr.dtype)
                 buf = buf.at[pcols.ravel()].add(contrib.ravel())
                 buf = lax.psum(buf, axis)

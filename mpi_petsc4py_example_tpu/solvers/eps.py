@@ -310,7 +310,7 @@ def _apply_blocked(S, apply_m, m):
     for s in range(0, k, m):
         blk = S[s:s + m]
         if blk.shape[0] < m:
-            pad = np.zeros((m, S.shape[1]))
+            pad = np.zeros((m, S.shape[1]), dtype=S.dtype)
             pad[:blk.shape[0]] = blk
             out[s:s + m] = apply_m(pad)[:blk.shape[0]]
         else:
@@ -499,17 +499,6 @@ class EPS:
         if (self._target is not None and self.st.get_type() == "sinvert"
                 and self.st.sigma == 0.0):
             self.st.set_shift(self._target)
-        # complex gate at the single dispatch point so every solver type is
-        # covered (lobpcg in particular never calls _setup_operator)
-        if is_complex(mat.dtype):
-            ok = self._type in ("krylovschur", "lanczos", "arnoldi",
-                                "power", "subspace")
-            if not ok:
-                raise ValueError(
-                    "complex EPS support covers krylovschur/lanczos/arnoldi/"
-                    "power/subspace for HEP/GHEP/NHEP with shift or sinvert "
-                    "ST — lobpcg is real-only (tracked in PARITY.md)")
-
         t0 = time.perf_counter()
         if self._type == "power":
             self._solve_power()
@@ -883,6 +872,8 @@ class EPS:
         dtype = np.dtype(str(op.dtype))
         npad = comm.padded_size(n)
 
+        hdt = host_dtype(dtype)
+
         def block_apply(which_prog, arrays, M_host):
             """Host (m, n) block -> device block program -> host (m, n)."""
             Mp = np.zeros((m, npad), dtype=dtype)
@@ -890,7 +881,7 @@ class EPS:
             out = comm.host_fetch(
                 which_prog(arrays, comm.put_spec(Mp, P(None, comm.axis))))
             record_sync("EPS lobpcg fetch/block-mult")
-            return out[:, :n].astype(np.float64)
+            return out[:, :n].astype(hdt)
 
         A_apply = lambda Mh: block_apply(prog, op_arrays, Mh)
         if bop is not None:
@@ -900,7 +891,7 @@ class EPS:
             B_apply = lambda Mh: Mh
 
         try:
-            diag = np.asarray(op.diagonal(), dtype=np.float64)
+            diag = np.asarray(op.diagonal(), dtype=hdt)
             diag = np.where(np.abs(diag) > 0, diag, 1.0)
             T_apply = lambda Rh: Rh / diag[None, :]
         except (ValueError, AttributeError):
@@ -909,9 +900,11 @@ class EPS:
         sign = -1.0 if self._which == EPSWhich.LARGEST_REAL else 1.0
 
         rng = np.random.default_rng(20240901)
-        X = rng.standard_normal((m, n))
+        X = rng.standard_normal((m, n)).astype(hdt)
+        if is_complex(dtype):
+            X = X + 1j * rng.standard_normal((m, n))
         X = np.linalg.qr(X.T)[0].T
-        Pdir = np.zeros((0, n))
+        Pdir = np.zeros((0, n), dtype=hdt)
         theta = np.zeros(m)
         rel = np.full(m, np.inf)
         nconv = 0
@@ -929,8 +922,10 @@ class EPS:
             if AX is None:        # later iterations reuse Cᵀ(AS)/Cᵀ(BS)
                 AX = A_apply(X)
                 BX = B_apply(X)
-            # current Ritz values of the block (Rayleigh quotients)
-            theta = np.sum(X * AX, axis=1) / np.sum(X * BX, axis=1)
+            # current Ritz values of the block (Rayleigh quotients <x,Ax>/
+            # <x,Bx> with the Hermitian inner product — real for HEP/GHEP)
+            theta = np.real(np.sum(X.conj() * AX, axis=1)
+                            / np.sum(X.conj() * BX, axis=1))
             R = AX - theta[:, None] * BX
             rel = (np.linalg.norm(R, axis=1)
                    / np.maximum(np.abs(theta), 1e-300))
@@ -945,15 +940,18 @@ class EPS:
                          else np.vstack([X, W]))
             AS = _apply_blocked(S, A_apply, m)
             BS = _apply_blocked(S, B_apply, m) if bop is not None else S
-            Ag = S @ AS.T
-            Bg = S @ BS.T
-            Ag = (Ag + Ag.T) / 2.0
-            Bg = (Bg + Bg.T) / 2.0
+            # projected pencil in the Hermitian inner product (conj on the
+            # projector rows; plain .T would not even be Hermitian for
+            # complex operators)
+            Ag = S.conj() @ AS.T
+            Bg = S.conj() @ BS.T
+            Ag = (Ag + Ag.conj().T) / 2.0
+            Bg = (Bg + Bg.conj().T) / 2.0
             lam_g, C = scipy.linalg.eigh(sign * Ag, Bg)
             C = C[:, :m]                      # m best in the wanted direction
             Xn = C.T @ S
             # new search directions: the part of Xn outside span(X)
-            Pdir = Xn - (Xn @ X.T) @ X
+            Pdir = Xn - (Xn @ X.conj().T) @ X
             nrm = np.linalg.norm(Pdir, axis=1)
             Pdir = Pdir[nrm > 1e-12]
             # Xn's rows are the Ritz vectors (B-orthonormal: Cᵀ Bg C = I) —

@@ -290,15 +290,22 @@ def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         # floor at the noise level instead — below it the recurrence cannot
         # resolve the norm anyway (an exactly-zero r costs at most one
         # extra iteration before the floor itself falls under tolerance).
-        eps = jnp.asarray(jnp.finfo(b.dtype).eps, b.dtype)
-        rn = jnp.sqrt(jnp.maximum(ss - 2 * omega * ts + omega * omega * tt,
-                                  eps * ss))
+        # Complex form: ‖s - ωt‖² = s·s - 2Re(ω̄·(t,s)) + |ω|²·t·t with the
+        # Hermitian inner product ((t,s) = vdot(t,s)); (s,s)/(t,t) are real
+        # by construction. Reduces exactly to the textbook real identity.
+        eps = jnp.finfo(b.dtype).eps
+        rn2 = (jnp.real(ss) - 2 * jnp.real(jnp.conj(omega) * ts)
+               + jnp.abs(omega) ** 2 * jnp.real(tt))
+        rn = jnp.sqrt(jnp.maximum(rn2, eps * jnp.real(ss)))
         rho_next = (rho_cur - alpha * rv) - omega * rt
         if monitor is not None:
             monitor(k + 1, rn)
         return (k + 1, x, r, p, v, rho_cur, rho_next, alpha, omega, rn, brk)
 
-    st0 = (jnp.int32(0), x0, r, z, z, one, rnorm * rnorm, one, one,
+    # rho_cur starts at (r̂, r₀) = ‖r₀‖² — real-valued, but typed to the
+    # operator scalar so the carry stays dtype-consistent on complex builds
+    st0 = (jnp.int32(0), x0, r, z, z, one,
+           jnp.asarray(rnorm * rnorm, b.dtype), one, one,
            rnorm, rnorm <= -1.0)
     out = lax.while_loop(cond, body, st0)
     k, x, rn, brk = out[0], out[1], out[9], out[10]
@@ -487,10 +494,12 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     bnorm, tol = _tol(pnorm, b, rtol, atol)
     r1 = b - A(x0)
     y = M(r1)
-    beta1 = jnp.sqrt(jnp.maximum(pdot(r1, y), 0.0))
+    # Hermitian A + SPD M: every Lanczos/rotation scalar is real in exact
+    # arithmetic — carry them real-typed (complex vectors, real scalars)
+    beta1 = jnp.sqrt(jnp.maximum(jnp.real(pdot(r1, y)), 0.0))
     dmax = _dmax(pnorm(r1), dtol)
     zero = jnp.zeros_like(b)
-    dt = b.dtype
+    dt = jnp.real(jnp.zeros((), b.dtype)).dtype
 
     def cond(st):
         return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
@@ -505,10 +514,10 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         yv = yv - jnp.where(k > 0, beta / jnp.where(st["beta_old"] == 0, 1.0,
                                                     st["beta_old"]), 0.0) \
             * st["r1"]
-        alfa = pdot(v, yv)
+        alfa = jnp.real(pdot(v, yv))
         yv = yv - (alfa / safe_b) * st["r2"]
         y_new = M(yv)
-        beta_new = jnp.sqrt(jnp.maximum(pdot(yv, y_new), 0.0))
+        beta_new = jnp.sqrt(jnp.maximum(jnp.real(pdot(yv, y_new)), 0.0))
         # QR via Givens
         oldeps = st["epsln"]
         delta = st["cs"] * st["dbar"] + st["sn"] * alfa
@@ -651,7 +660,9 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         r = st["r"] - alpha * s
         u = M(r)
         w = A(u)
-        rn = jnp.sqrt(jnp.maximum(rr, 0.0))
+        # rr = <r, r> is real by construction; take the real part so the
+        # carried norm stays real-typed for complex operators
+        rn = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))
         if monitor is not None:
             monitor(k + 1, rn)
         return dict(k=k + 1, x=x, r=r, u=u, w=w, p=p, s=s,
@@ -836,8 +847,11 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         return dict(st2, k=k + 1, y1=y1, u1=u1, v=v, rho=rho_new,
                     dp=dp, brk=brk)
 
+    # mixed-dtype carry for complex builds: theta/tau/dp are norms (real),
+    # eta/rho are Krylov coefficients (operator scalar)
+    rdt = jnp.real(jnp.zeros((), dt)).dtype
     st0 = dict(k=jnp.int32(0), y=zero, w=r0, y1=r0, u1=u1_0, v=u1_0,
-               d=zero, theta=jnp.asarray(0.0, dt), eta=jnp.asarray(0.0, dt),
+               d=zero, theta=jnp.asarray(0.0, rdt), eta=jnp.asarray(0.0, dt),
                tau=tau0, rho=pdot(rstar, r0), dp=tau0, brk=tau0 <= -1.0)
     st = lax.while_loop(cond, body, st0)
     x = x0 + M(st["y"])
@@ -954,6 +968,13 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     The shadow system preconditions with ``Mt`` — the PCApplyTranspose
     closure (falls back to ``M`` for symmetric applies).
+
+    Complex builds use PETSc's Hermitian variant: the shadow sequence runs
+    on ``A^H``/``M^H`` (the caller wires ``At``/``Mt`` as adjoints) and its
+    coefficient updates carry the CONJUGATED alpha/beta — with the
+    Hermitian inner product this preserves the biorthogonality relations
+    ``(r̃_i, z_j) = 0``. ``conj`` is the identity on real scalars, so one
+    kernel serves both builds.
     """
     if Mt is None:
         Mt = M
@@ -982,14 +1003,14 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         alpha = jnp.where(brk, 0.0, rho / jnp.where(pq == 0, 1.0, pq))
         x = x + alpha * p
         r = r - alpha * q
-        rt = rt - alpha * qt
+        rt = rt - jnp.conj(alpha) * qt
         z = M(r)
         zt = Mt(rt)
         rho_new = pdot(rt, z)
         beta = jnp.where(rho == 0, 0.0,
                          rho_new / jnp.where(rho == 0, 1.0, rho))
         p = z + beta * p
-        pt = zt + beta * pt
+        pt = zt + jnp.conj(beta) * pt
         rn = pnorm(r)
         if monitor is not None:
             monitor(k + 1, rn)
@@ -1114,23 +1135,25 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     the CG point on exit; the reported norm is the exact final residual.
     """
     bnorm, tol = _tol(pnorm, b, rtol, atol)
-    dt = b.dtype
+    # Hermitian A + SPD M: the Lanczos/LQ scalars are real in exact
+    # arithmetic — carry them real-typed (complex vectors, real scalars)
+    dt = jnp.real(jnp.zeros((), b.dtype)).dtype
     r0 = b - A(x0)
     rnorm0 = pnorm(r0)
     dmax = _dmax(rnorm0, dtol)
     _mon0(monitor, rnorm0)
 
     y = M(r0)
-    beta1sq = pdot(r0, y)
+    beta1sq = jnp.real(pdot(r0, y))
     beta1 = jnp.sqrt(jnp.maximum(beta1sq, 0.0))
     safe_b1 = jnp.where(beta1 == 0, 1.0, beta1)
     v = y / safe_b1
     y2 = A(v)
-    alfa = pdot(v, y2)
+    alfa = jnp.real(pdot(v, y2))
     y2 = y2 - (alfa / safe_b1) * r0
     r2 = y2
     y3 = M(r2)
-    betasq = pdot(r2, y3)
+    betasq = jnp.real(pdot(r2, y3))
     beta = jnp.sqrt(jnp.maximum(betasq, 0.0))
     # recurrence norms live in the M-weighted space; rescale estimates so
     # the tolerance test runs on the unpreconditioned residual norm
@@ -1148,13 +1171,13 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         yv = A(v)
         oldb_safe = jnp.where(st["oldb"] == 0, 1.0, st["oldb"])
         yv = yv - (beta_c / oldb_safe) * st["r1"]
-        alfa = pdot(v, yv)
+        alfa = jnp.real(pdot(v, yv))
         yv = yv - (alfa / safe_beta) * st["r2"]
         r1 = st["r2"]
         r2 = yv
         y_new = M(r2)
         oldb = beta_c
-        betasq = pdot(r2, y_new)
+        betasq = jnp.real(pdot(r2, y_new))
         brk = st["brk"] | (betasq < 0)
         beta_new = jnp.sqrt(jnp.maximum(betasq, 0.0))
         # plane rotation (LQ factorization of the tridiagonal)
@@ -1493,18 +1516,12 @@ def _monitor_trampoline(dev, k, rn):
 # kernels supporting masked multi-step unrolling per while_loop iteration
 _UNROLLABLE = ("cg",)
 
-# kernels whose recurrences are complex-correct with the conjugating pdot,
-# conjugating basis projections, the complex-capable Givens rotations, and
-# the adjoint (A^H) transpose wiring (PETSc complex-build slice):
-# CG/FCG for Hermitian positive definite, CR/Chebyshev for Hermitian,
-# BiCGStab(+flexible/ell)/CGS/GCR and the GMRES family for general
-# systems, CGNE/LSQR on the adjoint normal equations, direct preonly,
-# Richardson smoothing. Still real-only: bicg (bilinear-form shadow
-# recurrence), pipecg/fbcgsr (fused-reduction scalar identities carry
-# mixed real/complex state), minres/symmlq/tfqmr (ditto).
-_COMPLEX_KSP = ("cg", "fcg", "bcgs", "fbcgs", "bcgsl", "cgs", "gmres",
-                "fgmres", "lgmres", "gcr", "cr", "chebyshev", "cgne",
-                "lsqr", "preonly", "richardson")
+# Every KSP type is complex-capable (the PETSc complex-build contract):
+# the conjugating pdot, conjugating basis projections, complex-capable
+# Givens rotations, adjoint (A^H/M^H) transpose wiring for bicg/cgne/lsqr,
+# real-typed norm carries in the fused-identity kernels
+# (pipecg/fbcgsr/tfqmr), and real Lanczos scalars for the Hermitian
+# three-term kernels (minres/symmlq).
 
 
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
@@ -1539,12 +1556,6 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
-    if is_complex(dtype) and ksp_type not in _COMPLEX_KSP:
-        raise ValueError(
-            f"KSP {ksp_type!r} is not validated for complex operators — "
-            f"complex-scalar types: {sorted(_COMPLEX_KSP)} (PETSc complex "
-            "builds; the remaining recurrences are unaudited for complex "
-            "arithmetic, tracked in PARITY.md)")
     # normalize knobs a solver type doesn't consume, so changing e.g.
     # bcgsl_ell never recompiles an unrelated CG program
     restart_k = restart if ksp_type in ("gmres", "fgmres", "gcr", "fcg",
@@ -1661,18 +1672,24 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # the null(A) projector; projecting after would be wrong for
                 # unsymmetric A). project is the identity without a nullspace.
                 if is_complex(dtype):
-                    # cgne/lsqr normal equations need the ADJOINT A^H for
-                    # complex scalars: A^H v = conj(A^T conj(v)). (bicg is
-                    # gated complex — its bilinear-form shadow recurrence
-                    # does not transfer — so only At needs the wrapper.)
+                    # complex scalars need the ADJOINT A^H, not A^T:
+                    # cgne/lsqr's normal equations are A^H A (the plain-
+                    # transpose product is not even Hermitian), and bicg's
+                    # Hermitian-variant shadow sequence runs on A^H.
+                    # A^H v = conj(A^T conj(v)).
                     kw["At"] = lambda v: jnp.conj(
                         spmv_t_local(op_arrays, jnp.conj(project(v))))
                 else:
                     kw["At"] = lambda v: spmv_t_local(op_arrays, project(v))
                 if ksp_type == "bicg":
                     # same adjoint rule for the preconditioner:
-                    # (P M)^T = M^T P
-                    kw["Mt"] = lambda r: pc_apply_t(pc_arrays, project(r))
+                    # (P M)^T = M^T P, and complex M^H = conj(M^T(conj ·))
+                    if is_complex(dtype):
+                        kw["Mt"] = lambda r: jnp.conj(
+                            pc_apply_t(pc_arrays, jnp.conj(project(r))))
+                    else:
+                        kw["Mt"] = lambda r: pc_apply_t(pc_arrays,
+                                                        project(r))
             return kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
         return body
 

@@ -47,11 +47,6 @@ from ..parallel.mesh import DeviceComm
 from ..utils.dtypes import is_complex
 from jax.sharding import PartitionSpec as P
 
-# kinds whose builders/applies are complex-correct (PETSc complex-build
-# slice): diagonal scaling, dense/block inverses (host LAPACK handles
-# complex), and shell (user-supplied)
-_COMPLEX_PC = ("none", "jacobi", "bjacobi", "lu", "cholesky", "shell")
-
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
             "sor", "ssor", "ilu", "icc", "asm", "gamg", "amg",
             "shell", "composite")
@@ -73,10 +68,11 @@ class PC:
         self._mat: Mat | None = None
         self._arrays = ()
         self._built_for = None
-        self._factor_mode = "dense"  # 'dense' | 'crtri' (set in set_up for
-                                     # lu/cholesky: tridiagonal operators
-                                     # past the dense cap use parallel
-                                     # cyclic reduction, solvers/tridiag.py)
+        self._factor_mode = "dense"  # 'dense' | 'crtri' | 'crband' (set in
+                                     # set_up for lu/cholesky: banded
+                                     # operators past the dense cap use
+                                     # scalar/block parallel cyclic
+                                     # reduction, solvers/tridiag.py)
         self.sor_omega = 1.0        # -pc_sor_omega (PETSc default 1)
         self.asm_overlap = 1        # -pc_asm_overlap (PETSc default 1)
         self.factor_fill = 10.0     # -pc_factor_fill (spilu fill_factor)
@@ -218,11 +214,6 @@ class PC:
             return self
         comm = mat.comm
         t = self._type
-        if is_complex(mat.dtype) and t not in _COMPLEX_PC:
-            raise ValueError(
-                f"PC {t!r} is not validated for complex operators — "
-                f"complex-scalar kinds: {sorted(_COMPLEX_PC)} (PETSc "
-                "complex builds; tracked in PARITY.md)")
         if t == "none":
             self._arrays = ()
         elif t == "jacobi":
@@ -254,11 +245,25 @@ class PC:
                     raise ValueError(
                         "PC 'cholesky' needs a symmetric (Hermitian) "
                         "operator — use pc 'lu' for unsymmetric matrices")
-            if (mat.shape[0] > _DENSE_CAP
-                    and set(getattr(mat, "dia_offsets", ())) and
-                    set(mat.dia_offsets) <= {-1, 0, 1}):
+            offs = set(getattr(mat, "dia_offsets", ()) or ())
+            bw = max((abs(int(o)) for o in offs), default=0)
+            if (mat.shape[0] > _DENSE_CAP and offs
+                    and offs <= {-1, 0, 1}):
                 self._arrays = _build_tridiag_cr(comm, mat)
                 self._factor_mode = "crtri"
+            elif (mat.shape[0] > _DENSE_CAP and offs
+                    and 1 < bw <= _BCR_MAX_BW):
+                # banded with small bandwidth: block cyclic reduction —
+                # bw x bw blocks cover every offset in [-bw..bw]
+                if mat.shape[0] * bw > _CR_CAP:
+                    raise ValueError(
+                        f"PC {t!r} (block cyclic reduction) replicates "
+                        f"sweep arrays scaling with n*bandwidth; "
+                        f"n={mat.shape[0]} at bandwidth {bw} exceeds the "
+                        f"{_CR_CAP} cap — use an iterative KSP with pc "
+                        "'jacobi'/'gamg' instead")
+                self._arrays = _build_banded_bcr(comm, mat, bw)
+                self._factor_mode = "crband"
             else:
                 self._arrays = _build_dense_lu(comm, mat)
                 self._factor_mode = "dense"
@@ -311,8 +316,9 @@ class PC:
     @property
     def kind(self) -> str:
         t = self._type
-        if t in ("lu", "cholesky") and self._factor_mode == "crtri":
-            return "crtri"
+        if t in ("lu", "cholesky") and self._factor_mode in ("crtri",
+                                                             "crband"):
+            return self._factor_mode
         if t == "cholesky":
             return "lu"
         if t == "amg":
@@ -337,6 +343,10 @@ class PC:
         if self.kind == "crtri":
             # sweep count is baked into the apply loop
             return ("crtri", int(self._arrays[0].shape[0]))
+        if self.kind == "crband":
+            # (S, N, b) are all baked into the apply loop
+            return ("crband",) + tuple(int(s)
+                                       for s in self._arrays[0].shape[:3])
         if self.kind == "shell":
             return ("shell", self._shell_uid)
         if self.kind == "composite":
@@ -362,8 +372,8 @@ class PC:
             return (P(axis),)
         if k == "lu":
             return (P(),)
-        if k == "crtri":
-            return (P(), P(), P())   # replicated (S,n) alphas/gammas, (n,) b
+        if k in ("crtri", "crband"):
+            return (P(), P(), P())   # replicated sweep arrays + diagonal
         if k == "gamg":
             return self._amg.in_specs()
         if k == "shell":
@@ -444,6 +454,25 @@ class PC:
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(x, i * lsize, lsize)
             return apply
+        if k == "crband":
+            from .tridiag import bpcr_apply
+            n_pad = comm.padded_size(n)
+
+            def apply(arrs, r):
+                alphas, gammas, binv = arrs
+                Nb = binv.shape[0] * binv.shape[1]
+                r_full = lax.all_gather(r, axis, tiled=True)
+                d = r_full[:n]
+                if Nb > n:        # identity-padded tail block rows
+                    d = jnp.concatenate(
+                        [d, jnp.zeros((Nb - n,), d.dtype)])
+                x = bpcr_apply(d, alphas, gammas, binv)[:n]
+                if n_pad > n:
+                    x = jnp.concatenate(
+                        [x, jnp.zeros((n_pad - n,), x.dtype)])
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(x, i * lsize, lsize)
+            return apply
         if k == "gamg":
             return self._amg.local_apply(comm)
         if k == "shell":
@@ -515,7 +544,7 @@ class PC:
         lsize = comm.local_size(n)
         if k in ("none", "jacobi"):
             return self.local_apply(comm, n)      # diagonal: symmetric
-        if k == "crtri" and self._type == "cholesky":
+        if k in ("crtri", "crband") and self._type == "cholesky":
             # cholesky's contract is a symmetric (complex: Hermitian)
             # operator. Real: M = M^T, the forward PCR apply IS the
             # transpose apply. Complex Hermitian: M^T = conj(M), so
@@ -695,9 +724,10 @@ def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):
     if not 0.0 < omega < 2.0:
         raise ValueError(f"SOR omega must be in (0, 2), got {omega}")
     A, n, lsize = _local_dense_blocks(comm, mat, "sor")
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
 
     def ssor_inv(B):
-        Ad = B.toarray().astype(np.float64)
+        Ad = B.toarray().astype(host_dt)
         D = np.diag(Ad).copy()
         D[D == 0] = 1.0
         Dw = np.diag(D / omega)
@@ -705,7 +735,8 @@ def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):
              @ (Dw + np.triu(Ad, 1)) / (2.0 - omega))
         return scipy.linalg.inv(M)
 
-    inv = _per_device_inverse(A, n, lsize, comm.size, ssor_inv)
+    inv = _per_device_inverse(A, n, lsize, comm.size, ssor_inv,
+                              host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
 
 
@@ -718,16 +749,18 @@ def _build_block_ilu(comm: DeviceComm, mat: Mat, fill: float):
     import scipy.sparse as sp
     import scipy.sparse.linalg as spla
     A, n, lsize = _local_dense_blocks(comm, mat, "ilu")
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
 
     def ilu_inv(B):
-        Ad = sp.csc_matrix(B).astype(np.float64)
+        Ad = sp.csc_matrix(B).astype(host_dt)
         try:
             f = spla.spilu(Ad, fill_factor=fill, drop_tol=1e-5)
-            return f.solve(np.eye(Ad.shape[0]))
+            return f.solve(np.eye(Ad.shape[0], dtype=host_dt))
         except RuntimeError:        # singular pivot — fall back to exact
             return scipy.linalg.inv(Ad.toarray())
 
-    inv = _per_device_inverse(A, n, lsize, comm.size, ilu_inv)
+    inv = _per_device_inverse(A, n, lsize, comm.size, ilu_inv,
+                              host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
 
 
@@ -748,10 +781,11 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
             "(halo exchange is single-neighbor)")
     ndev = comm.size
     w = lsize + 2 * ov
-    inv = np.zeros((ndev, w, w), dtype=np.float64)
+    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    inv = np.zeros((ndev, w, w), dtype=host_dt)
     for d in range(ndev):
         rs = d * lsize - ov
-        block = np.eye(w)
+        block = np.eye(w, dtype=host_dt)
         lo, hi = max(rs, 0), min(rs + w, n)
         if lo < hi:
             block[lo - rs:hi - rs, lo - rs:hi - rs] = \
@@ -761,6 +795,30 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
 
 
 _CR_CAP = 1 << 23  # replicated (S, n) sweep arrays: ~2.7 GB fp64 at 8.4M rows
+_BCR_MAX_BW = 16   # block CR bandwidth cap: blocks are bw x bw, memory and
+                   # setup scale with n * bw (checked against _CR_CAP)
+
+
+def _build_banded_bcr(comm: DeviceComm, mat: Mat, bw: int):
+    """Block-cyclic-reduction factorization of a banded operator with
+    bandwidth ``1 < bw <= _BCR_MAX_BW`` — the MUMPS-slot direct path for
+    small-bandwidth systems past the dense cap (pentadiagonal Poisson
+    lines, coupled tridiagonal families; reference ``test.py:41-43``).
+
+    Host fp64/complex128 setup with batched b×b LAPACK inverses (pivoted
+    within blocks, pivotless across — guarded by the probe solve); the
+    device apply is ``ceil(log2 N)`` sweeps of two batched (N, b, b)×(N, b)
+    MXU products (solvers/tridiag.py::bpcr_apply).
+    """
+    from .tridiag import banded_to_blocks, bpcr_setup
+    _require_assembled(mat, "lu")
+    A = mat.to_scipy().tocsr()
+    Ab, Bb, Cb = banded_to_blocks(A, bw)
+    alphas, gammas, binv = bpcr_setup(Ab, Bb, Cb, apply_dtype=mat.dtype)
+    dt = mat.dtype
+    return (comm.put_replicated(alphas.astype(dt)),
+            comm.put_replicated(gammas.astype(dt)),
+            comm.put_replicated(binv.astype(dt)))
 
 
 def _build_tridiag_cr(comm: DeviceComm, mat: Mat):

@@ -129,17 +129,23 @@ class SVD:
         eps.set_operators(Mat.from_scipy(self.comm, C, dtype=mat.dtype))
         eps.set_problem_type("hep")
         k = min(self.nsv, C.shape[0])
-        eps.set_dimensions(nev=k, ncv=self.ncv)
         # relative accuracy transfers: δσ/σ = δλ/(2λ), so the eigensolver
         # tolerance maps one-to-one onto the singular-value tolerance
         eps.set_tolerances(tol=self.tol, max_it=self.max_it)
         if self._which == "largest":
+            eps.set_dimensions(nev=k, ncv=self.ncv)
             eps.set_which_eigenpairs("largest_real")
+        elif k <= 16:
+            # lobpcg: the efficient smallest-pair solver (complex-capable).
+            # A single-vector block converges poorly on the squared
+            # spectrum of A^H A — run at least a 3-block (extra converged
+            # pairs are simply dropped below)
+            eps.set_type("lobpcg")
+            eps.set_dimensions(nev=min(max(k, 3), C.shape[0]), ncv=self.ncv)
+            eps.set_which_eigenpairs("smallest_real")
         else:
-            # lobpcg is the efficient smallest-pair solver but real-only;
-            # complex operators fall back to krylovschur smallest_real
-            if not cplx:
-                eps.set_type("lobpcg")
+            # past lobpcg's block cap: krylovschur smallest_real
+            eps.set_dimensions(nev=k, ncv=self.ncv)
             eps.set_which_eigenpairs("smallest_real")
         eps.solve()
 

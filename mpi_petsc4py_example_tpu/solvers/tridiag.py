@@ -184,3 +184,200 @@ def pcr_apply(d, alphas, gammas, bfin):
             dd = jnp.zeros_like(d)
         d = d + alphas[k] * du + gammas[k] * dd
     return d / bfin
+
+
+# ---------------------------------------------------------------------------
+# BLOCK cyclic reduction: direct solves for bandwidth b > 1
+# ---------------------------------------------------------------------------
+# A matrix with dia_offsets ⊆ [-b..b] is block-tridiagonal in b×b blocks
+# (pentadiagonal = b=2, etc.). The same log2(N) sweep structure applies with
+# the scalar divisions replaced by batched b×b inverses/matmuls — exactly
+# the MXU-friendly shape: every sweep is two (N, b, b) × (N, b) batched
+# products. This extends the MUMPS-slot direct path (reference
+# ``test.py:41-43``) from tridiagonal to small-bandwidth banded systems
+# (SURVEY.md §7.4-1); general sparsity beyond banded stays iterative+strong
+# -PC, documented in PARITY.md.
+
+
+def banded_to_blocks(A_csr, b: int):
+    """Extract block-tridiagonal (sub, diag, super) = (N, b, b) stacks from
+    a sparse matrix with bandwidth <= b.
+
+    Rows are grouped b at a time (the tail block is padded with identity
+    rows, which decouple). Vectorized over the stored diagonals — no
+    per-block slicing.
+    """
+    n = A_csr.shape[0]
+    N = -(-n // b)
+    host_dt = (np.complex128 if np.iscomplexobj(A_csr.data)
+               else np.float64)
+    Ab = np.zeros((N, b, b), host_dt)
+    Cb = np.zeros((N, b, b), host_dt)
+    Bb = np.zeros((N, b, b), host_dt)
+    Bb[:] = np.eye(b, dtype=host_dt)        # padded tail rows stay identity
+    # real rows get their true diagonal (dense .diagonal(0) includes zeros)
+    for o in range(-b, b + 1):
+        vals = np.asarray(A_csr.diagonal(o))
+        if o >= 0:
+            r = np.arange(0, n - o)
+        else:
+            r = np.arange(-o, n)
+        c = r + o
+        i_r, br = r // b, r % b
+        i_c, bc = c // b, c % b
+        mid = i_c == i_r
+        lo = i_c == i_r - 1
+        hi = i_c == i_r + 1
+        if o == 0:
+            # overwrite the identity diagonal for every REAL row first
+            Bb[i_r, br, bc] = vals
+            continue
+        Bb[i_r[mid], br[mid], bc[mid]] = vals[mid]
+        Ab[i_r[lo], br[lo], bc[lo]] = vals[lo]
+        Cb[i_r[hi], br[hi], bc[hi]] = vals[hi]
+    return Ab, Bb, Cb
+
+
+def bpcr_setup(Ab, Bb, Cb, apply_dtype=None):
+    """Precompute block-PCR sweep coefficients for the block-tridiagonal
+    ``(Ab, Bb, Cb)`` — each ``(N, b, b)``, ``Ab[0]``/``Cb[-1]`` ignored.
+
+    Returns ``(alphas, gammas, binv)``: two ``(S, N, b, b)`` stacks of
+    per-sweep neighbour multiplier blocks (``S = ceil(log2 N)``) and the
+    batched inverse of the fully-reduced diagonal, such that for any rhs
+    ``D`` of shape (N, b)::
+
+        for k in range(S):
+            s = 1 << k
+            D = D + alphas[k] @ shift_up(D, s) + gammas[k] @ shift_down(D, s)
+        X = binv @ D          # batched (N, b, b) x (N, b)
+
+    Same host-fp64 (complex: complex128) setup + probe-solve discipline as
+    the scalar :func:`pcr_setup`; within-block arithmetic is pivoted
+    (LAPACK batched inverses), the cross-block elimination is pivotless.
+    """
+    host_dt = (np.complex128
+               if any(np.iscomplexobj(v) for v in (Ab, Bb, Cb))
+               else np.float64)
+    A = np.asarray(Ab, host_dt).copy()
+    B = np.asarray(Bb, host_dt).copy()
+    C = np.asarray(Cb, host_dt).copy()
+    N, b = B.shape[0], B.shape[1]
+    if N == 0:
+        raise ValueError("bpcr_setup: empty system")
+    A[0] = 0.0
+    C[-1] = 0.0
+    ones_b = np.ones(b, host_dt)
+    d1 = (A + B + C) @ ones_b               # A · ones, for the probe solve
+    S = max(1, int(np.ceil(np.log2(N)))) if N > 1 else 1
+    alphas = np.zeros((S, N, b, b), host_dt)
+    gammas = np.zeros((S, N, b, b), host_dt)
+
+    def shift(M, s, fill_identity=False):
+        """out[i] = M[i - s] (s may be negative); out-of-range blocks are
+        zero (identity when fill_identity — the virtual rows' diagonal)."""
+        out = np.zeros_like(M)
+        if fill_identity:
+            out[:] = np.eye(b, dtype=host_dt)
+        if abs(s) < N:
+            if s > 0:
+                out[s:] = M[:-s]
+            elif s < 0:
+                out[:s] = M[-s:]
+            else:
+                out[:] = M
+        return out
+
+    def binv_or_raise(M, what):
+        try:
+            return np.linalg.inv(M)
+        except np.linalg.LinAlgError:
+            raise ValueError(
+                f"block PCR hit a singular {what} block — the pivotless "
+                "cross-block reduction needs nonsingular (ideally "
+                "dominant) diagonal blocks; use an iterative KSP with pc "
+                "'jacobi'/'gamg' instead") from None
+
+    for k in range(S):
+        s = 1 << k
+        Bu_inv = binv_or_raise(shift(B, s, fill_identity=True), "shifted")
+        Bd_inv = binv_or_raise(shift(B, -s, fill_identity=True), "shifted")
+        alpha = -np.matmul(A, Bu_inv)
+        gamma = -np.matmul(C, Bd_inv)
+        alphas[k] = alpha
+        gammas[k] = gamma
+        A_new = np.matmul(alpha, shift(A, s))
+        C_new = np.matmul(gamma, shift(C, -s))
+        B_new = (B + np.matmul(alpha, shift(C, s))
+                 + np.matmul(gamma, shift(A, -s)))
+        if not np.all(np.isfinite(B_new)):
+            raise ValueError(
+                "block PCR reduction broke down (non-finite reduced "
+                "diagonal) — the pivotless cross-block factorization is "
+                "unstable for this matrix; use an iterative KSP with pc "
+                "'jacobi'/'gamg' instead")
+        A, B, C = A_new, B_new, C_new
+    if np.any(A != 0) or np.any(C != 0):
+        raise AssertionError("block PCR did not fully reduce — internal "
+                             "error")
+    binv = binv_or_raise(B, "reduced diagonal")
+    # probe solve (the MUMPS backward-error analog, as in pcr_setup)
+    x1 = bpcr_apply_np(d1, alphas, gammas, binv)
+    if not np.all(np.isfinite(x1)) or np.max(np.abs(x1 - 1.0)) > 1e-3:
+        raise ValueError(
+            "block PCR factorization failed its probe solve (pivotless "
+            "cross-block element growth) — this banded system needs a "
+            "pivoted factorization; use an iterative KSP with pc "
+            "'jacobi'/'gamg' instead")
+    if apply_dtype is not None and \
+            np.finfo(np.dtype(apply_dtype)).eps > np.finfo(host_dt).eps:
+        cast = np.dtype(apply_dtype)
+        x1c = bpcr_apply_np(d1.astype(cast), alphas.astype(cast),
+                            gammas.astype(cast), binv.astype(cast))
+        if not np.all(np.isfinite(x1c)) or np.max(np.abs(x1c - 1.0)) > 0.1:
+            raise ValueError(
+                f"block PCR factorization failed its probe solve in the "
+                f"operator dtype {cast} — assemble the operator in "
+                "float64/complex128 or use an iterative KSP")
+    return alphas, gammas, binv
+
+
+def bpcr_apply_np(D, alphas, gammas, binv):
+    """Host-numpy mirror of :func:`bpcr_apply` (probe + test oracle).
+    ``D``: (N, b) rhs blocks."""
+    dt = np.result_type(np.asarray(D).dtype, alphas.dtype)
+    D = np.asarray(D, dt).copy()
+    N, b = D.shape
+    for k in range(alphas.shape[0]):
+        s = 1 << k
+        Du = np.zeros_like(D)
+        Dd = np.zeros_like(D)
+        if s < N:
+            Du[s:] = D[:-s]
+            Dd[:-s] = D[s:]
+        D = (D + np.einsum("nij,nj->ni", alphas[k], Du)
+             + np.einsum("nij,nj->ni", gammas[k], Dd))
+    return np.einsum("nij,nj->ni", binv, D)
+
+
+def bpcr_apply(d, alphas, gammas, binv):
+    """Device-side block-PCR solve: ``d`` is the flat (N*b,) rhs; arrays as
+    from :func:`bpcr_setup`. Each sweep is two batched (N, b, b) x (N, b)
+    MXU products over static shifts — pure jnp, safe inside jit/shard_map.
+    """
+    import jax.numpy as jnp
+
+    N, b = binv.shape[0], binv.shape[1]
+    D = d.reshape(N, b)
+    S = alphas.shape[0]
+    for k in range(S):
+        s = 1 << k
+        if s < N:
+            Du = jnp.concatenate([jnp.zeros((s, b), D.dtype), D[:-s]])
+            Dd = jnp.concatenate([D[s:], jnp.zeros((s, b), D.dtype)])
+        else:
+            Du = jnp.zeros_like(D)
+            Dd = jnp.zeros_like(D)
+        D = (D + jnp.einsum("nij,nj->ni", alphas[k], Du)
+             + jnp.einsum("nij,nj->ni", gammas[k], Dd))
+    return jnp.einsum("nij,nj->ni", binv, D).reshape(-1)

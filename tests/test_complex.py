@@ -1,13 +1,11 @@
 """Complex-scalar support (PETSc complex-build slice, SURVEY.md §2.2 N1-N3).
 
 PETSc/SLEPc are compiled real OR complex; this framework carries dtype per
-object instead. Validated complex surface: Vec/Mat (ELL + DIA SpMV,
-transpose product), KSP cg/fcg (Hermitian positive definite), bcgs, the
-gmres family and gcr (general), preonly, richardson, PC none/jacobi/
-bjacobi/lu/cholesky, EPS HEP/GHEP/NHEP with the Krylov types
-(krylovschur/lanczos/arnoldi) under shift or sinvert ST, and the binary
-viewer's complex-build layout. Everything else rejects complex operators
-with a clear error (recorded in PARITY.md).
+object instead. The complex surface is complete: all 22 KSP types, all 15
+PC kinds, all 6 EPS types (HEP/GHEP/NHEP, shift/sinvert ST), SVD, the
+cyclic-reduction direct path, and the binary viewer's complex-build layout
+(see PARITY.md for per-type notes — Hermitian types require Hermitian
+operators, as in PETSc).
 """
 
 import numpy as np
@@ -170,28 +168,200 @@ class TestComplexKSP:
         assert res.residual_norm >= 0.0
 
 
-class TestComplexGates:
-    @pytest.mark.parametrize("ksp_type", ["minres", "bicg", "pipecg",
-                                          "tfqmr"])
-    def test_real_only_types_reject(self, comm8, ksp_type):
-        A = hermitian_spd(30)
+class TestComplexKSPFull:
+    """The six types un-gated last: every KSP type now runs on complex
+    operators (the full PETSc complex-build contract). Each is validated
+    against manufactured complex systems on two seeds."""
+
+    solve = TestComplexKSP.solve
+
+    @pytest.mark.parametrize("seed", [21, 22])
+    def test_pipecg_hermitian(self, comm8, seed):
+        """Fused-reduction CG: complex Krylov coefficients, real norm carry."""
+        A = hermitian_spd(90, seed=seed)
+        x, x_true, res = self.solve(comm8, A, "pipecg", "jacobi", rtol=1e-11)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-8)
+
+    @pytest.mark.parametrize("seed", [23, 24])
+    def test_fbcgsr_general(self, comm8, seed):
+        """Merged-reduction BiCGStab: the ‖r‖² scalar identity uses the
+        complex form ss - 2Re(ω̄·ts) + |ω|²·tt."""
+        A = (random_complex_csr(80, seed=seed) + sp.eye(80) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, "fbcgsr", "jacobi", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    @pytest.mark.parametrize("seed", [25, 26])
+    @pytest.mark.parametrize("ksp_type", ["minres", "symmlq"])
+    def test_minres_symmlq_hermitian_indefinite(self, comm8, ksp_type, seed):
+        """Hermitian Lanczos: real tridiagonal scalars, complex vectors —
+        on an INDEFINITE Hermitian operator (the regime CG cannot serve)."""
+        H = hermitian_spd(80, seed=seed, shift=0.0)
+        # shift to straddle zero: eigenvalues on both sides
+        lam = np.linalg.eigvalsh(H.toarray())
+        A = (H - sp.eye(80) * np.median(lam)).tocsr()
+        x, x_true, res = self.solve(comm8, A, ksp_type, "none", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-6)
+
+    @pytest.mark.parametrize("seed", [27, 28])
+    def test_tfqmr_general(self, comm8, seed):
+        A = (random_complex_csr(70, seed=seed) + sp.eye(70) * 12).tocsr()
+        x, x_true, res = self.solve(comm8, A, "tfqmr", "jacobi", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    @pytest.mark.parametrize("seed", [29, 30])
+    @pytest.mark.parametrize("pc_type", ["jacobi", "bjacobi"])
+    def test_bicg_general(self, comm8, pc_type, seed):
+        """Hermitian-variant BiCG: shadow sequence on A^H/M^H with
+        conjugated coefficients (PETSc's complex KSPBICG)."""
+        A = (random_complex_csr(64, seed=seed) + sp.eye(64) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, "bicg", pc_type, rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_bicg_matches_real_build_on_real_data(self, comm8):
+        """conj() additions are the identity on real scalars: a real system
+        solved through the complex path gives the real-build iterates."""
+        rng = np.random.default_rng(31)
+        Ar = (sp.random(50, 50, density=0.3, format="csr",
+                        random_state=rng) + sp.eye(50) * 8).tocsr()
+        x_true = rng.random(50)
+
+        def run(dtype):
+            M = tps.Mat.from_scipy(comm8, Ar, dtype=dtype)
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(M)
+            ksp.set_type("bicg")
+            ksp.get_pc().set_type("jacobi")
+            ksp.set_tolerances(rtol=1e-12, max_it=500)
+            x, bv = M.get_vecs()
+            bv.set_global((Ar @ x_true).astype(dtype))
+            res = ksp.solve(bv, x)
+            return x.to_numpy(), res.iterations
+
+        xr, itr = run(np.float64)
+        xc, itc = run(np.complex128)
+        assert itr == itc
+        np.testing.assert_allclose(np.real(xc), xr, atol=1e-10)
+        assert np.max(np.abs(np.imag(xc))) < 1e-12
+
+
+def hermitian_poisson2d(n, theta=0.3):
+    """Gauge-phased 2D Laplacian: Hermitian positive definite with genuinely
+    complex off-diagonals (diagonally dominant + Dirichlet boundary)."""
+    I = sp.eye(n)
+    T = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], (n, n))
+    P = (sp.kron(I, T) + sp.kron(T, I)).tocsr()
+    ph = np.exp(1j * theta)
+    D = sp.diags(P.diagonal())
+    U = sp.triu(P, 1)
+    return (D + ph * U + np.conj(ph) * U.conj().T).tocsr()
+
+
+class TestComplexPC:
+    """The PC kinds un-gated last: every PC type now builds for complex
+    operators with complex128 host factorizations."""
+
+    solve = TestComplexKSP.solve
+
+    @pytest.mark.parametrize("seed", [33, 34])
+    @pytest.mark.parametrize("pc_type", ["sor", "ssor", "ilu", "icc", "asm"])
+    def test_block_kinds_general(self, comm8, pc_type, seed):
+        A = (random_complex_csr(80, seed=seed) + sp.eye(80) * 10).tocsr()
+        x, x_true, res = self.solve(comm8, A, "gmres", pc_type, rtol=1e-11)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-8)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_gamg_hermitian(self, comm8, seed):
+        """Smoothed aggregation with the adjoint Galerkin product P^H A P —
+        coarse levels stay Hermitian, CG+gamg converges on the
+        gauge-phased complex Laplacian."""
+        A = hermitian_poisson2d(12, theta=0.3 + 0.1 * seed)
+        x, x_true, res = self.solve(comm8, A, "cg", "gamg", rtol=1e-10)
+        assert res.converged
+        np.testing.assert_allclose(x, x_true, atol=1e-7)
+
+    def test_gamg_coarse_hermitian(self, comm8):
+        """Every Galerkin level of a Hermitian fine operator is Hermitian."""
+        from mpi_petsc4py_example_tpu.solvers.amg import sa_setup
+        A = hermitian_poisson2d(10)
+        levels, Ac = sa_setup(A)
+        for L, _ in levels:
+            assert np.allclose((L - L.conj().T).toarray(), 0, atol=1e-12)
+        assert np.allclose((Ac - Ac.conj().T).toarray(), 0, atol=1e-12)
+
+    @pytest.mark.parametrize("ctype", ["additive", "multiplicative"])
+    def test_composite(self, comm8, ctype):
+        A = (random_complex_csr(60, seed=35) + sp.eye(60) * 10).tocsr()
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
         ksp = tps.KSP().create(comm8)
         ksp.set_operators(M)
-        ksp.set_type(ksp_type)
+        ksp.set_type("gmres")
+        pc = ksp.get_pc()
+        pc.set_type("composite")
+        pc.set_composite_type(ctype)
+        pc.set_composite_pcs("jacobi", "sor")
+        ksp.set_tolerances(rtol=1e-11, max_it=500)
+        x_true = cvec(60, 36)
         x, bv = M.get_vecs()
-        bv.set_global(cvec(30))
-        with pytest.raises(ValueError, match="complex"):
-            ksp.solve(bv, x)
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-8)
 
-    def test_pc_sor_rejects(self, comm8):
-        A = hermitian_spd(30)
+
+class TestComplexCyclicReduction:
+    def test_direct_solve_hermitian_tridiag(self, comm8):
+        """preonly+lu past the dense cap on a COMPLEX Hermitian tridiagonal
+        — the CR direct path, complex-build (closes the PARITY divergence)."""
+        n = 20000
+        rng = np.random.default_rng(37)
+        off = (rng.random(n - 1) - 0.5) + 1j * (rng.random(n - 1) - 0.5)
+        A = sp.diags([off.conj(), np.full(n, 3.0 + 0j), off], [-1, 0, 1],
+                     format="csr")
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
-        pc = tps.PC()
-        pc.set_type("sor")
-        with pytest.raises(ValueError, match="complex"):
-            pc.set_up(M)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        x_true = cvec(n, 38)
+        x, bv = M.get_vecs()
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert ksp.get_pc()._factor_mode == "crtri"
+        rres = (np.linalg.norm(A @ x.to_numpy() - A @ x_true)
+                / np.linalg.norm(A @ x_true))
+        assert rres <= 1e-10, rres
+        assert res.converged
 
+    def test_bicg_cholesky_cr_hermitian_transpose(self, comm8):
+        """Complex cholesky-mode CR serves BiCG's adjoint preconditioner
+        through the conj-wrapped forward apply (M Hermitian => M^H = M)."""
+        n = 20000
+        rng = np.random.default_rng(39)
+        off = 0.3 * ((rng.random(n - 1) - 0.5) + 1j * (rng.random(n - 1) - 0.5))
+        A = sp.diags([off.conj(), np.full(n, 2.0 + 0j), off], [-1, 0, 1],
+                     format="csr")
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("bicg")
+        ksp.get_pc().set_type("cholesky")
+        ksp.set_tolerances(rtol=1e-12, max_it=10)
+        x_true = cvec(n, 40)
+        x, bv = M.get_vecs()
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert ksp.get_pc()._factor_mode == "crtri"
+        assert res.converged and res.iterations <= 3
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-8)
+
+
+class TestComplexGates:
     def test_facade_viewer_complex_roundtrip(self, comm8, tmp_path):
         """Compat Viewer: a complex Vec written via VecView reads back via
         VecLoad with the complex-build layout (the Vec's own dtype selects
@@ -220,17 +390,70 @@ class TestComplexGates:
         fv2.load(r)
         np.testing.assert_allclose(core2.to_numpy(), v, rtol=1e-15)
 
-    def test_eps_lobpcg_rejects(self, comm8):
-        """The gate sits at the solve() dispatch, so lobpcg (which skips
-        _setup_operator) is covered too."""
-        A = hermitian_spd(30)
+    @pytest.mark.parametrize("which", ["smallest_real", "largest_real"])
+    def test_eps_lobpcg_complex_hermitian(self, comm8, which):
+        """LOBPCG on a complex Hermitian operator: the projected pencil uses
+        the Hermitian inner product (conj on the projector rows), extreme
+        pairs match dense eigh."""
+        A = hermitian_poisson2d(9, theta=0.4)
+        lam_all = np.linalg.eigvalsh(A.toarray())
         M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
         eps = tps.EPS().create(comm8)
         eps.set_operators(M)
         eps.set_problem_type("hep")
         eps.set_type("lobpcg")
-        with pytest.raises(ValueError, match="real-only"):
-            eps.solve()
+        eps.set_which_eigenpairs(which)
+        eps.set_dimensions(nev=3)
+        eps.set_tolerances(tol=1e-9, max_it=300)
+        eps.solve()
+        assert eps.get_converged() >= 3
+        want = (lam_all[:3] if which == "smallest_real"
+                else lam_all[::-1][:3])
+        got = np.sort([eps.get_eigenvalue(i).real for i in range(3)])
+        np.testing.assert_allclose(np.sort(got), np.sort(want), rtol=1e-7)
+        for i in range(3):
+            assert eps.compute_error(i) <= 1e-6
+
+    def test_eps_lobpcg_complex_ghep(self, comm8):
+        """Generalized complex Hermitian pencil (B SPD) through LOBPCG."""
+        A = hermitian_poisson2d(8, theta=0.25)
+        n = A.shape[0]
+        rng = np.random.default_rng(41)
+        B = sp.diags(1.0 + rng.random(n)).tocsr().astype(complex)
+        lam_all = np.sort(np.real(
+            np.linalg.eigvals(np.linalg.inv(B.toarray()) @ A.toarray())))
+        MA = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        MB = tps.Mat.from_scipy(comm8, B, dtype=np.complex128)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(MA, MB)
+        eps.set_problem_type("ghep")
+        eps.set_type("lobpcg")
+        eps.set_which_eigenpairs("smallest_real")
+        eps.set_dimensions(nev=2)
+        eps.set_tolerances(tol=1e-9, max_it=400)
+        eps.solve()
+        assert eps.get_converged() >= 2
+        got = np.sort([eps.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam_all[:2], rtol=1e-6)
+
+    def test_complex_svd_smallest_uses_lobpcg(self, comm8):
+        """Complex smallest-triplet requests now run LOBPCG directly (the
+        krylovschur fallback is gone)."""
+        A = (random_complex_csr(40, seed=42) + sp.eye(40) * 5).tocsr()
+        sv = np.linalg.svd(A.toarray(), compute_uv=False)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.complex128)
+        svd = tps.SVD().create(comm8)
+        svd.set_operator(M)
+        svd.set_which_singular_triplets("smallest")
+        svd.set_dimensions(nsv=1)
+        svd.set_tolerances(tol=1e-9, max_it=400)
+        svd.solve()
+        assert svd.get_converged() >= 1
+        s = svd.get_value(0)
+        np.testing.assert_allclose(s, sv[-1], rtol=1e-6)
+        u, v = svd._U[0], svd._V[0]
+        np.testing.assert_allclose(np.linalg.norm(A @ v - s * u), 0,
+                                   atol=1e-6)
 
 
 
@@ -285,9 +508,9 @@ class TestComplexSVD:
             u, v = svd._U[i], svd._V[i]
             assert np.linalg.norm(A @ v - sig * u) < 1e-7 * sig
 
-    def test_smallest_triplet_krylovschur_fallback(self, comm8):
-        """Complex smallest-sigma requests route around the real-only
-        lobpcg (PARITY.md claim) — krylovschur smallest_real on A^H A."""
+    def test_smallest_triplet_default_options(self, comm8):
+        """Complex smallest-sigma with DEFAULT tolerances runs the (now
+        complex-capable) lobpcg path on A^H A and still converges."""
         n = 30
         rng = np.random.default_rng(34)
         A = (sp.random(n, n, density=0.4, format="csr", dtype=np.float64,

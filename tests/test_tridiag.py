@@ -186,6 +186,91 @@ class TestLuCyclicReduction:
         with pytest.raises(ValueError, match="symmetric"):
             pc.set_up(M)
 
+    def test_million_row_pentadiagonal_block_cr(self, comm8):
+        """Bandwidth-2 direct path (VERDICT r2 #2): 1M-row pentadiagonal
+        SPD operator, preonly+lu over the 8-device mesh, rel-res <= 1e-10
+        — block cyclic reduction with 2x2 blocks."""
+        n = 1_000_000
+        d1 = np.full(n - 1, -1.0)
+        d2 = np.full(n - 2, -0.5)
+        A = sp.diags([d2, d1, np.full(n, 4.0), d1, d2],
+                     [-2, -1, 0, 1, 2], format="csr")
+        rng = np.random.default_rng(11)
+        x_true = rng.random(n)
+        b = A @ x_true
+        x, res, ksp = self.solve_preonly(comm8, A, b)
+        assert ksp.get_pc()._factor_mode == "crband"
+        rres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rres <= 1e-10, rres
+        assert res.converged
+
+    def test_bandwidth8_block_cr(self, comm8):
+        """Bandwidth-8 banded system past the dense cap: 8x8-block CR."""
+        n = 100_000
+        bw = 8
+        rng = np.random.default_rng(13)
+        diags = [0.1 * (rng.random(n - abs(o)) - 0.5)
+                 for o in range(-bw, bw + 1) if o != 0]
+        offs = [o for o in range(-bw, bw + 1) if o != 0]
+        A = (sp.diags(diags, offs) + sp.eye(n) * 3.0).tocsr()
+        x_true = rng.random(n)
+        b = A @ x_true
+        x, res, ksp = self.solve_preonly(comm8, A, b)
+        assert ksp.get_pc()._factor_mode == "crband"
+        rres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert rres <= 1e-10, rres
+
+    def test_block_cr_uneven_tail(self, comm8):
+        """n not divisible by the block size: identity-padded tail block."""
+        n = 16387                      # > dense cap, prime-ish, n % 2 = 1
+        d1 = np.full(n - 1, -1.0)
+        d2 = np.full(n - 2, -0.4)
+        A = sp.diags([d2, d1, np.full(n, 3.5), d1, d2],
+                     [-2, -1, 0, 1, 2], format="csr")
+        x_true = np.random.default_rng(15).random(n)
+        b = A @ x_true
+        x, res, ksp = self.solve_preonly(comm8, A, b)
+        assert ksp.get_pc()._factor_mode == "crband"
+        np.testing.assert_allclose(x, x_true, rtol=1e-9, atol=1e-11)
+
+    def test_bicg_cholesky_block_cr_transpose(self, comm8):
+        """cholesky in block-CR mode serves BICG's transpose apply through
+        the symmetric forward apply, like the tridiagonal mode."""
+        n = 20000
+        d1 = np.full(n - 1, -1.0)
+        d2 = np.full(n - 2, -0.3)
+        A = sp.diags([d2, d1, np.full(n, 3.0), d1, d2],
+                     [-2, -1, 0, 1, 2], format="csr")
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("bicg")
+        ksp.get_pc().set_type("cholesky")
+        ksp.set_tolerances(rtol=1e-12, max_it=10)
+        x, bv = M.get_vecs()
+        x_true = np.random.default_rng(17).random(n)
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert ksp.get_pc()._factor_mode == "crband"
+        assert res.converged and res.iterations <= 2
+        np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-9,
+                                   atol=1e-11)
+
+    def test_block_cr_probe_rejects_unstable(self):
+        """Cross-block element growth is caught by the probe, as in the
+        scalar path."""
+        from mpi_petsc4py_example_tpu.solvers.tridiag import (
+            banded_to_blocks, bpcr_setup)
+        # near-singular banded operator: tridiagonal Laplacian at its
+        # smallest eigenvalue, viewed as 2x2 blocks
+        n = 1024
+        lam = 2 * np.cos(np.pi / (n + 1))
+        A = sp.diags([np.full(n - 1, -1.0), np.full(n, lam),
+                      np.full(n - 1, -1.0)], [-1, 0, 1], format="csr")
+        Ab, Bb, Cb = banded_to_blocks(A, 2)
+        with pytest.raises(ValueError, match="probe|singular|broke"):
+            bpcr_setup(Ab, Bb, Cb)
+
     def test_large_nontridiagonal_still_raises(self, comm8):
         """The dense cap still guards general operators; the error points at
         the tridiagonal exception."""
