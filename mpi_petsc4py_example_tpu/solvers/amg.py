@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.spmv import csr_to_ell, ell_spmv_local
-from ..utils.dtypes import is_complex
+from ..utils.dtypes import host_dtype
 
 DEFAULT_THRESHOLD = 0.0     # PCGAMG default: keep all connections
 DEFAULT_COARSE_SIZE = 64
@@ -117,7 +117,7 @@ def _tentative_prolongator(agg: np.ndarray, nagg: int):
 
 def _smoothed_prolongator(A, P0, omega: float = 4.0 / 3.0):
     """P = (I - omega/rho(D^-1 A) * D^-1 A) P0 (damped-Jacobi smoothing)."""
-    host_dt = np.complex128 if np.iscomplexobj(A.data) else np.float64
+    host_dt = host_dtype(A.dtype)
     d = A.diagonal().astype(host_dt)
     d[d == 0] = 1.0
     dinv = 1.0 / d
@@ -186,7 +186,7 @@ class AMGHierarchy:
         self.lsizes = [comm.local_size(n) for n in self.sizes]
         self._arrays = []
         self._specs = []
-        host_dt = np.complex128 if is_complex(dtype) else np.float64
+        host_dt = host_dtype(dtype)
         for A, Pl in levels:
             acols, avals = csr_to_ell(A.indptr, A.indices, A.data)
             pcols, pvals = csr_to_ell(Pl.indptr, Pl.indices, Pl.data)

@@ -67,8 +67,15 @@ def _mon0(monitor, rn0):
         monitor(jnp.int32(0), rn0)
 
 
+def _nat(rz):
+    """KSP_NORM_NATURAL: sqrt <r, M r> — the scalar the CG-family
+    recurrences already carry (real by construction for the SPD/Hermitian
+    operators these types require)."""
+    return jnp.sqrt(jnp.maximum(jnp.real(rz), 0.0))
+
+
 def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-              dtol=None, unroll=1):
+              dtol=None, unroll=1, natural=False):
     """Preconditioned conjugate gradients (KSPCG equivalent).
 
     ``unroll`` packs that many CG steps into each ``while_loop`` body with
@@ -79,13 +86,22 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     (measured ~100-300 µs through the remote-TPU tunnel — more than the
     whole compute of a mid-sized step) this overhead, not FLOPs or HBM, is
     the iteration-rate ceiling.
+
+    ``natural`` switches the monitored norm to KSP_NORM_NATURAL
+    (sqrt <r, M r> — the rz scalar CG already computes, zero extra
+    reductions); the relative tolerance is then taken against the initial
+    natural norm (= the natural norm of b for the default zero guess).
     """
-    bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
     z = M(r)
     p = z
     rz = pdot(r, z)
-    rnorm = pnorm(r)
+    if natural:
+        rnorm = _nat(rz)
+        tol = jnp.maximum(rtol * rnorm, atol)
+    else:
+        bnorm, tol = _tol(pnorm, b, rtol, atol)
+        rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
 
@@ -111,7 +127,7 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
         p = jnp.where(cont, z + beta * p, p)
         rz = jnp.where(cont, rz_new, rz)
-        rn = jnp.where(cont, pnorm(r), rn)
+        rn = jnp.where(cont, _nat(rz_new) if natural else pnorm(r), rn)
         k = k + cont.astype(jnp.int32)
         if monitor is not None:
             monitor(k, rn)
@@ -861,22 +877,28 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
 
 def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-              dtol=None):
+              dtol=None, natural=False):
     """Preconditioned Conjugate Residuals (KSPCR) for symmetric systems.
 
     Minimizes the preconditioned residual M(b - Ax) in the A-norm sense;
     requires symmetric A and SPD M (as PETSc documents for KSPCR). One SpMV
-    + one PC apply + two psums per iteration.
+    + one PC apply + two psums per iteration. ``natural`` monitors
+    sqrt <r, A r> of the preconditioned residual (the rho scalar the
+    recurrence already carries), relative to its initial value.
     """
-    pb = M(b)
-    bnorm = pnorm(pb)
-    tol = jnp.maximum(rtol * bnorm, atol)
     r = M(b - A(x0))
     p = r
     w = A(r)        # A r
     q = w           # A p
     rho = pdot(r, w)
-    rnorm = pnorm(r)
+    if natural:
+        rnorm = _nat(rho)
+        tol = jnp.maximum(rtol * rnorm, atol)
+    else:
+        pb = M(b)
+        bnorm = pnorm(pb)
+        tol = jnp.maximum(rtol * bnorm, atol)
+        rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
 
@@ -897,7 +919,7 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         beta = jnp.where(rho == 0, 0.0, rho_new / jnp.where(rho == 0, 1.0, rho))
         p = r + beta * p
         q = w + beta * q
-        rn = pnorm(r)
+        rn = _nat(rho_new) if natural else pnorm(r)
         if monitor is not None:
             monitor(k + 1, rn)
         return (k + 1, x, r, p, w, q, rho_new, rn, brk)
@@ -1231,18 +1253,27 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
 
 def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-               restart=30, pmatdot=None, monitor=None, dtol=None):
+               restart=30, pmatdot=None, monitor=None, dtol=None,
+               natural=False):
     """Truncated flexible CG (Notay; KSPFCG).
 
     The preconditioner may change between iterations; new directions are
     A-orthogonalized against a sliding window of the last ``restart`` stored
     pairs ``(p_i, Ap_i)``. The whole-window projection is one fused ``psum``
     matvec per iteration (empty slots are zero rows — no masking needed).
+    ``z = M r`` for the CURRENT residual is carried in the loop state (it is
+    needed one iteration later anyway), so the ``natural`` norm
+    sqrt <r, M r> costs one extra psum and no extra PC applies.
     """
     m = restart
-    bnorm, tol = _tol(pnorm, b, rtol, atol)
     r = b - A(x0)
-    rnorm = pnorm(r)
+    z0 = M(r)
+    if natural:
+        rnorm = _nat(pdot(r, z0))
+        tol = jnp.maximum(rtol * rnorm, atol)
+    else:
+        bnorm, tol = _tol(pnorm, b, rtol, atol)
+        rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
     Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
@@ -1250,12 +1281,11 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     eta = jnp.zeros(m, b.dtype)
 
     def cond(st):
-        k, slot, x, r, Pb, APb, eta, rn, brk = st
+        k, slot, x, r, z, Pb, APb, eta, rn, brk = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, slot, x, r, Pb, APb, eta, rn, brk = st
-        z = M(r)
+        k, slot, x, r, z, Pb, APb, eta, rn, brk = st
         c = pmatdot(APb, z)                 # z . Ap_i over the window
         coef = jnp.where(eta != 0, c / jnp.where(eta == 0, 1.0, eta), 0.0)
         p = z - coef @ Pb
@@ -1266,17 +1296,18 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
                           pdot(p, r) / jnp.where(brk, 1.0, pAp))
         x = x + alpha * p
         r = r - alpha * Ap
+        z = M(r)
         Pb = Pb.at[slot].set(p)
         APb = APb.at[slot].set(Ap)
         eta = eta.at[slot].set(pAp)
-        rn = pnorm(r)
+        rn = _nat(pdot(r, z)) if natural else pnorm(r)
         if monitor is not None:
             monitor(k + 1, rn)
-        return (k + 1, (slot + 1) % m, x, r, Pb, APb, eta, rn, brk)
+        return (k + 1, (slot + 1) % m, x, r, z, Pb, APb, eta, rn, brk)
 
-    st0 = (jnp.int32(0), jnp.int32(0), x0, r, Pbuf, APbuf, eta,
+    st0 = (jnp.int32(0), jnp.int32(0), x0, r, z0, Pbuf, APbuf, eta,
            rnorm, rnorm <= -1.0)
-    k, slot, x, r, Pbuf, APbuf, eta, rnorm, brk = \
+    k, slot, x, r, z0, Pbuf, APbuf, eta, rnorm, brk = \
         lax.while_loop(cond, body, st0)
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
@@ -1527,7 +1558,8 @@ _UNROLLABLE = ("cg",)
 def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
                       zero_guess: bool = False, nullspace_dim: int = 0,
-                      aug: int = 2, ell: int = 2, unroll: int = 1):
+                      aug: int = 2, ell: int = 2, unroll: int = 1,
+                      natural: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -1567,9 +1599,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # monitored programs stay at 1
     unroll_k = (max(1, int(unroll))
                 if ksp_type in _UNROLLABLE and not monitored else 1)
+    natural_k = bool(natural) and ksp_type in ("cg", "fcg", "cr")
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
-           nullspace_dim, aug_k, ell_k, unroll_k)
+           nullspace_dim, aug_k, ell_k, unroll_k, natural_k)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1594,7 +1627,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # Jacobi identities (see cg_stencil_kernel). Dispatch is part of the
     # cache key via pc.program_key() + operator.program_key().
     stencil_cg = (ksp_type == "cg" and nullspace_dim == 0
-                  and unroll_k == 1
+                  and unroll_k == 1 and not natural_k
                   # the fused Pallas partial sums u*y without a conjugate and
                   # carries a real-typed rr — real operators only
                   and not is_complex(dtype)
@@ -1643,6 +1676,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                                                          axis)))
             kw = {"monitor": monitor} if monitor is not None else {}
             kw["dtol"] = dtol
+            if natural_k:
+                kw["natural"] = True
             if stencil_cg:
                 inv_diag = (jnp.asarray(1.0, b.dtype) if pc.get_type() == "none"
                             else jnp.asarray(1.0 / operator.uniform_diagonal,

@@ -153,16 +153,17 @@ class KSP:
     _NORM_BY_INT = {-1: "default", 0: "none", 1: "preconditioned",
                     2: "unpreconditioned", 3: "natural"}
 
+    # types whose recurrence already computes the natural norm scalar
+    # <r, M r> (KSP_NORM_NATURAL, PETSc's NormType 3) — zero extra
+    # reductions; other types raise, as PETSc does for unsupported combos
+    _NATURAL_TYPES = ("cg", "fcg", "cr")
+
     def set_norm_type(self, norm_type):
         if isinstance(norm_type, (int, np.integer)):
             norm_type = self._NORM_BY_INT.get(int(norm_type), norm_type)
         t = str(norm_type).lower().replace("ksp_norm_", "")
-        if t == "natural":
-            raise ValueError(
-                "norm type 'natural' is not provided — kernels monitor the "
-                "preconditioned or unpreconditioned residual norm "
-                "(see KSP._KERNEL_NORMS); use 'default'")
-        if t not in ("default", "none", "preconditioned", "unpreconditioned"):
+        if t not in ("default", "none", "preconditioned",
+                     "unpreconditioned", "natural"):
             raise ValueError(f"unknown norm type {norm_type!r}")
         self._norm_type = t
         return self
@@ -193,6 +194,14 @@ class KSP:
                     "cycle — or ell steps for bcgsl — at a time, so a "
                     "fixed max_it contract cannot hold); use richardson/"
                     "chebyshev/cg for fixed-iteration smoothing")
+            return
+        if t == "natural":
+            if self._type not in self._NATURAL_TYPES:
+                raise ValueError(
+                    f"norm type 'natural' (sqrt <r, M r>) is available for "
+                    f"KSP {sorted(self._NATURAL_TYPES)} whose recurrences "
+                    f"already carry that scalar; {self._type!r} does not — "
+                    "use 'default'")
             return
         have = self._KERNEL_NORMS.get(self._type, "unpreconditioned")
         if t != have:
@@ -363,7 +372,8 @@ class KSP:
                                                 else 0),
                                  aug=self.lgmres_augment,
                                  ell=self.bcgsl_ell,
-                                 unroll=self.unroll)
+                                 unroll=self.unroll,
+                                 natural=self._norm_type == "natural")
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the

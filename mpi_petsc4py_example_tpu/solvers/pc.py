@@ -44,7 +44,7 @@ from jax import lax
 
 from ..core.mat import Mat
 from ..parallel.mesh import DeviceComm
-from ..utils.dtypes import is_complex
+from ..utils.dtypes import host_dtype, is_complex
 from jax.sharding import PartitionSpec as P
 
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
@@ -674,7 +674,7 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
             "'jacobi'/'gamg' (SURVEY.md §7.4)")
     A = mat.to_scipy().tocsr()
     bs = lsize // nb
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    host_dt = host_dtype(mat.dtype)
     inv = _per_device_inverse(
         A, n, bs, comm.size * nb,
         lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
@@ -724,7 +724,7 @@ def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):
     if not 0.0 < omega < 2.0:
         raise ValueError(f"SOR omega must be in (0, 2), got {omega}")
     A, n, lsize = _local_dense_blocks(comm, mat, "sor")
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    host_dt = host_dtype(mat.dtype)
 
     def ssor_inv(B):
         Ad = B.toarray().astype(host_dt)
@@ -749,7 +749,7 @@ def _build_block_ilu(comm: DeviceComm, mat: Mat, fill: float):
     import scipy.sparse as sp
     import scipy.sparse.linalg as spla
     A, n, lsize = _local_dense_blocks(comm, mat, "ilu")
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    host_dt = host_dtype(mat.dtype)
 
     def ilu_inv(B):
         Ad = sp.csc_matrix(B).astype(host_dt)
@@ -781,7 +781,7 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
             "(halo exchange is single-neighbor)")
     ndev = comm.size
     w = lsize + 2 * ov
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    host_dt = host_dtype(mat.dtype)
     inv = np.zeros((ndev, w, w), dtype=host_dt)
     for d in range(ndev):
         rs = d * lsize - ov
@@ -839,7 +839,7 @@ def _build_tridiag_cr(comm: DeviceComm, mat: Mat):
             f"arrays; n={n} exceeds the {_CR_CAP} cap — use an iterative "
             "KSP with pc 'jacobi'/'gamg' instead")
     A = mat.to_scipy().tocsr()
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+    host_dt = host_dtype(mat.dtype)
     a = np.concatenate([[0.0], np.asarray(A.diagonal(-1))]).astype(host_dt)
     b = np.asarray(A.diagonal(0), dtype=host_dt)
     c = np.concatenate([np.asarray(A.diagonal(1)), [0.0]]).astype(host_dt)
@@ -860,13 +860,13 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     _require_assembled(mat, "lu")
     n = mat.shape[0]
     if n > _DENSE_CAP:
-        hint = ("tridiagonal operators take the cyclic-reduction direct "
-                "path automatically")
         raise ValueError(
             f"PC 'lu' densifies general operators; n={n} is too large — "
-            f"{hint}; otherwise use an iterative KSP with pc "
-            "'bjacobi'/'jacobi' instead (SURVEY.md §7.4)")
-    host_dt = np.complex128 if is_complex(mat.dtype) else np.float64
+            f"banded operators up to bandwidth {_BCR_MAX_BW} take the "
+            "(block) cyclic-reduction direct path automatically; otherwise "
+            "use an iterative KSP with pc 'bjacobi'/'jacobi' instead "
+            "(SURVEY.md §7.4)")
+    host_dt = host_dtype(mat.dtype)
     A = mat.to_scipy().toarray().astype(host_dt)
     inv = scipy.linalg.inv(A)
     n_pad = comm.padded_size(n)

@@ -61,8 +61,8 @@ def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray,
     roundoff-scale: the second probe gates at 0.1 because legitimate
     reduced-precision roundoff is recovered by KSPPREONLY's refinement).
     """
-    host_dt = (np.complex128
-               if any(np.iscomplexobj(v) for v in (a, b, c)) else np.float64)
+    from ..utils.dtypes import host_dtype
+    host_dt = host_dtype(np.result_type(*(np.asarray(v) for v in (a, b, c))))
     a = np.asarray(a, host_dt).copy()
     b = np.asarray(b, host_dt).copy()
     c = np.asarray(c, host_dt).copy()
@@ -209,8 +209,8 @@ def banded_to_blocks(A_csr, b: int):
     """
     n = A_csr.shape[0]
     N = -(-n // b)
-    host_dt = (np.complex128 if np.iscomplexobj(A_csr.data)
-               else np.float64)
+    from ..utils.dtypes import host_dtype
+    host_dt = host_dtype(A_csr.dtype)
     Ab = np.zeros((N, b, b), host_dt)
     Cb = np.zeros((N, b, b), host_dt)
     Bb = np.zeros((N, b, b), host_dt)
@@ -256,9 +256,9 @@ def bpcr_setup(Ab, Bb, Cb, apply_dtype=None):
     the scalar :func:`pcr_setup`; within-block arithmetic is pivoted
     (LAPACK batched inverses), the cross-block elimination is pivotless.
     """
-    host_dt = (np.complex128
-               if any(np.iscomplexobj(v) for v in (Ab, Bb, Cb))
-               else np.float64)
+    from ..utils.dtypes import host_dtype
+    host_dt = host_dtype(
+        np.result_type(*(np.asarray(v) for v in (Ab, Bb, Cb))))
     A = np.asarray(Ab, host_dt).copy()
     B = np.asarray(Bb, host_dt).copy()
     C = np.asarray(Cb, host_dt).copy()

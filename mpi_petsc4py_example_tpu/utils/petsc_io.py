@@ -20,10 +20,11 @@ the input dtype, readers take ``scalar='real'|'complex'`` (the file carries
 no flag — like PETSc itself, the reader must know the writing build's
 scalar type). Loading rejects ``--with-64-bit-indices`` files (their int64
 header reads as classid 0). Real-scalar loads of complex-build files are
-detected heuristically: when loading by path, leftover payload bytes that
-do not start another PETSc object raise a clear error pointing at
-``scalar='complex'``. Streamed (open file object) reads cannot look ahead
-and skip the check.
+detected heuristically: leftover payload bytes that do not start another
+PETSc object raise a clear error pointing at ``scalar='complex'`` — for
+path loads and for seekable streamed Viewer reads alike (the stream is
+peeked and rewound to the object boundary); only non-seekable streams
+skip the check.
 """
 
 from __future__ import annotations
@@ -77,17 +78,27 @@ def _read(f, dtype, count):
 
 
 def _check_trailing(f, path):
-    """Complex-build detection for path-opened reads.
+    """Complex-build detection after a real-scalar parse.
 
     A complex-scalar PETSc build (``--with-scalar-type=complex``) writes an
     identical header but 16-byte scalars, so a real-build parse consumes only
-    half the payload. Any legitimate leftover bytes must start another PETSc
-    object header; leftover imaginary halves never do. Only called when this
-    module opened the file itself — a streamed Viewer file object must keep
-    its cursor at the object boundary, so the caller skips the check there.
+    half the payload. Any legitimate following bytes must start another
+    PETSc object header; leftover imaginary halves never do.
+
+    Path-opened reads consume the 4 peeked bytes (the file is closed right
+    after). Streamed Viewer file objects get the SAME check via
+    peek-and-rewind when the stream is seekable (regular files are), so the
+    cursor stays at the object boundary for the next ``load``;
+    non-seekable streams skip the check — they cannot look ahead.
     """
-    if hasattr(path, "read") or hasattr(path, "write"):
-        return
+    streamed = hasattr(path, "read") or hasattr(path, "write")
+    if streamed:
+        try:
+            if not f.seekable():
+                return
+            pos = f.tell()
+        except (AttributeError, OSError):
+            return
     peek = f.read(4)
     if not peek:
         return
@@ -102,6 +113,8 @@ def _check_trailing(f, path):
     # double (re or im half), whose big-endian high 4 bytes only decode into
     # this range for ~1e-308 subnormals — never real data
     if 1211200 <= cid <= 1211240:
+        if streamed:
+            f.seek(pos)        # leave the cursor at the object boundary
         return
     raise ValueError(
         f"{_display_name(path)}: bytes after the object do not start "

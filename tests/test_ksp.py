@@ -844,6 +844,79 @@ class TestNormType:
         ksp._check_norm_type()                # no raise
         assert ksp.get_norm_type() == "preconditioned"
 
+    @pytest.mark.parametrize("ksp_type", ["cg", "fcg", "cr"])
+    def test_natural_semantics(self, comm8, ksp_type):
+        """KSP_NORM_NATURAL (PETSc's CG default): the monitored norm is
+        sqrt <r, M r>, relative tolerance against its initial value. With
+        jacobi M the exact value is checkable against the true residual."""
+        A = poisson2d(10)
+        x_true, b = manufactured(A)
+        d = A.diagonal()
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type(ksp_type)
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_norm_type("natural")          # string key
+        ksp.set_tolerances(rtol=1e-9, max_it=500)
+        ksp.set_convergence_history()
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-6)
+        h = ksp.get_convergence_history()
+        if ksp_type in ("cg", "fcg"):
+            # natural norm of b (zero initial guess): sqrt(b . b/d)
+            np.testing.assert_allclose(h[0], np.sqrt(b @ (b / d)),
+                                       rtol=1e-10)
+            # the reported final norm is the natural norm of the true
+            # residual
+            r = b - A @ x.to_numpy()
+            np.testing.assert_allclose(res.residual_norm,
+                                       np.sqrt(max(r @ (r / d), 0.0)),
+                                       rtol=1e-5, atol=1e-12)
+        assert h[-1] <= 1e-9 * h[0]
+
+    def test_natural_int_constant_and_reject(self, comm8):
+        """petsc4py's integer NormType 3 maps to natural; unsupported types
+        raise at solve (like PETSc's KSPSetUp check)."""
+        M = tps.Mat.from_scipy(comm8, poisson2d(4))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_norm_type(3)
+        assert ksp.get_norm_type() == "natural"
+        ksp.set_type("gmres")
+        x, bv = M.get_vecs()
+        with pytest.raises(ValueError, match="natural"):
+            ksp.solve(bv, x)
+
+    def test_natural_matches_default_iterates(self, comm8):
+        """The natural norm changes only the MONITORED quantity — the CG
+        iterates are identical, so the solution matches the default-norm
+        solve at the same iteration count."""
+        A = poisson2d(8)
+        x_true, b = manufactured(A)
+        M = tps.Mat.from_scipy(comm8, A)
+
+        def run(norm):
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(M)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type("jacobi")
+            if norm:
+                ksp.set_norm_type(norm)
+            ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=25)
+            x, bv = M.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            return x.to_numpy(), res
+        xa, ra = run(None)
+        xb, rb = run("natural")
+        assert ra.iterations == rb.iterations == 25
+        np.testing.assert_allclose(xa, xb, rtol=1e-12, atol=1e-14)
+
     def test_mismatched_type_raises(self, comm8):
         A = poisson2d(4)
         M = tps.Mat.from_scipy(comm8, A)
@@ -887,9 +960,9 @@ class TestNormType:
         with pytest.raises(ValueError, match="ell steps"):
             ksp.solve(bv, x)
 
-    def test_natural_rejected_at_set(self):
-        with pytest.raises(ValueError, match="natural"):
-            tps.KSP().set_norm_type("natural")
+    def test_natural_accepted_at_set(self):
+        ksp = tps.KSP().set_norm_type("natural")
+        assert ksp.get_norm_type() == "natural"
 
     def test_integer_enum_accepted(self):
         ksp = tps.KSP()

@@ -129,6 +129,36 @@ class TestErrors:
         with pytest.raises(ValueError, match="complex-scalar"):
             petsc_io.read_mat(p)
 
+    def test_complex_build_detected_on_streamed_read(self, tmp_path):
+        """Seekable streamed (Viewer-style) reads get the same complex-build
+        heuristic as path loads: the stream is peeked and rewound."""
+        p = tmp_path / "vc_stream.petsc"
+        n = 5
+        hdr = np.array([1211214, n], dtype=">i4")
+        interleaved = np.zeros(2 * n, dtype=">f8")
+        interleaved[0::2] = np.arange(1.0, n + 1)
+        interleaved[1::2] = 0.25
+        p.write_bytes(hdr.tobytes() + interleaved.tobytes())
+        with open(p, "rb") as f:
+            with pytest.raises(ValueError, match="complex-scalar"):
+                petsc_io.read_vec(f)
+
+    def test_streamed_multi_object_cursor_preserved(self, tmp_path):
+        """The peek-and-rewind must leave the cursor at the object boundary:
+        a Mat-then-Vec stream (PETSc's standard layout) reads both."""
+        import scipy.sparse as sp
+        p = tmp_path / "mv.petsc"
+        A = sp.eye(4, format="csr") * 2.0
+        v = np.arange(4.0)
+        with open(p, "wb") as f:
+            petsc_io.write_mat(f, A)
+            petsc_io.write_vec(f, v)
+        with open(p, "rb") as f:
+            A2 = petsc_io.read_mat(f)
+            v2 = petsc_io.read_vec(f)
+        np.testing.assert_allclose(A2.toarray(), A.toarray())
+        np.testing.assert_allclose(v2, v)
+
     def test_bad_rowlens(self, tmp_path):
         p = tmp_path / "m.petsc"
         hdr = np.array([1211216, 2, 2, 3], dtype=">i4")

@@ -273,7 +273,7 @@ class TestLuCyclicReduction:
 
     def test_large_nontridiagonal_still_raises(self, comm8):
         """The dense cap still guards general operators; the error points at
-        the tridiagonal exception."""
+        the banded cyclic-reduction exception."""
         n = 20000
         d0 = np.full(n, 4.0)
         d5 = np.full(n - 5000, 0.5)
@@ -285,5 +285,5 @@ class TestLuCyclicReduction:
         ksp.get_pc().set_type("lu")
         x, bv = M.get_vecs()
         bv.set_global(np.ones(n))
-        with pytest.raises(ValueError, match="tridiagonal"):
+        with pytest.raises(ValueError, match="banded"):
             ksp.solve(bv, x)
