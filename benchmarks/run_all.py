@@ -54,6 +54,40 @@ def solve(comm, op, b, ksp_type, pc_type, rtol=1e-6, max_it=20000,
     return x.to_numpy(), res, wall
 
 
+def onchip_breakdown(comm, op, b, ksp_type, pc_type):
+    """Delta-method on-chip per-iteration time + fixed per-solve latency.
+
+    Separates kernel cost from the remote runtime's dispatch+fetch floor
+    (the dominant e2e term for small problems — see BASELINE.md cfg1/cfg4
+    breakdown): slope between two fixed-iteration solves = pure loop time;
+    a 1-iteration solve = the fixed latency.
+    """
+    import bench
+
+    def make_solver(max_it):
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(op)
+        ksp.set_type(ksp_type)
+        ksp.get_pc().set_type(pc_type)
+        ksp.set_norm_type("none")
+        ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=max_it)
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        return ksp, x, bv
+    rates = bench.delta_rate(make_solver)
+    per_iter = float(np.median(rates))
+    ksp, x, bv = make_solver(1)
+    fixed = []
+    for _ in range(3):
+        x.zero()
+        t0 = time.perf_counter()
+        ksp.solve(bv, x)
+        fixed.append(time.perf_counter() - t0)
+    return dict(onchip_per_iter_us=round(per_iter * 1e6, 2),
+                fixed_latency_ms=round(min(fixed) * 1e3, 1))
+
+
 def manufactured(A, seed=0, dtype=np.float64):
     rng = np.random.default_rng(seed)
     x = rng.random(A.shape[0]).astype(dtype)
@@ -73,10 +107,13 @@ def config1(comm, quick):
     x_cpu, _ = spla.cg(A, b.astype(np.float64), rtol=1e-6, atol=0.0)
     cpu = time.perf_counter() - t0
     rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
-    return dict(config="cfg1_aij_assembly_cg_none", n=nx ** 3,
-                assembly_s=round(assembly, 4), iters=res.iterations,
-                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
-                speedup=round(cpu / wall, 2), rel_residual=float(rres))
+    out = dict(config="cfg1_aij_assembly_cg_none", n=nx ** 3,
+               assembly_s=round(assembly, 4), iters=res.iterations,
+               wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
+               speedup=round(cpu / wall, 2), rel_residual=float(rres))
+    if not quick:
+        out.update(onchip_breakdown(comm, M, b, "cg", "none"))
+    return out
 
 
 def config2(quick):
@@ -126,10 +163,13 @@ def config4(comm, quick):
                              M=Mi)
     cpu = time.perf_counter() - t0
     rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
-    return dict(config="cfg4_bcgs_bjacobi_convdiff", n=nx * nx,
-                iters=res.iterations, wall_s=round(wall, 4),
-                cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
-                rel_residual=float(rres))
+    out = dict(config="cfg4_bcgs_bjacobi_convdiff", n=nx * nx,
+               iters=res.iterations, wall_s=round(wall, 4),
+               cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
+               rel_residual=float(rres))
+    if not quick:
+        out.update(onchip_breakdown(comm, M, b, "bcgs", "bjacobi"))
+    return out
 
 
 def config5(comm, quick):

@@ -36,6 +36,7 @@ collective-safe.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -91,24 +92,52 @@ def _op_key(op):
     return (op.shape[0], str(op.dtype), op.program_key())
 
 
-def _build_factorization_program(comm: DeviceComm, op, ncv: int, inner=None):
-    """Arnoldi/Lanczos factorization *continuation* as one SPMD program.
+def _facto_steps(spmv, b_apply, axis, ncv):
+    """The shared CGS2 Arnoldi/Lanczos continuation body: run steps
+    ``k..ncv-1`` on (V, H). Used by every fused program variant."""
+    def run(op_arrays, b_arrays, V, H, k):
+        def A(v):
+            return spmv(op_arrays, v)
 
-    Signature: ``prog(op_arrays, inner_arrays, V, H, k) -> (V, H)``.
+        def Bip(v):
+            return b_apply(b_arrays, v) if b_apply is not None else v
 
-    ``V`` has global shape ``(ncv+1, n_pad)`` sharded on the row axis; rows
-    ``0..k`` hold an orthonormal basis (row ``k`` = the new start/residual
-    direction, normalized on entry), rows beyond ``k`` are zero. ``H`` is the
-    replicated ``(ncv+1, ncv)`` projected matrix with columns ``0..k-1``
-    prefilled by the restart (arrow structure). The program runs steps
-    ``k..ncv-1`` of the factorization with CGS2 orthogonalization — two fused
-    psums per step. ``k=0`` with empty ``H`` is a fresh factorization.
+        def pdot_vec(Vb, wB):
+            return lax.psum(jnp.conj(Vb) @ wB, axis)
 
-    ``inner`` (optional) supplies the B-inner product for generalized
-    problems: all dots/norms become ``<u, v>_B = u^T B v``.
-    """
+        def pnorm(u):
+            return jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, Bip(u)), axis)))
+
+        vk = V[k]
+        nrm = pnorm(vk)
+        V = V.at[k].set(vk / jnp.where(nrm == 0, 1.0, nrm))
+
+        def step(j, VH):
+            V, H = VH
+            w = A(V[j])
+            h1 = pdot_vec(V, Bip(w))
+            w = w - h1 @ V
+            h2 = pdot_vec(V, Bip(w))
+            w = w - h2 @ V
+            h = h1 + h2
+            b = pnorm(w)
+            V = V.at[j + 1].set(w / jnp.where(b == 0, 1.0, b))
+            H = H.at[:, j].set(h)
+            H = H.at[j + 1, j].set(b)
+            return (V, H)
+
+        return lax.fori_loop(k, ncv, step, (V, H))
+    return run
+
+
+def _build_seed_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
+    """Seed + full factorization fused: ``prog(op_arrays, b_arrays, v0) ->
+    (V, H)`` — builds the (ncv+1, n_pad) basis on device from the flat
+    start vector and runs all ncv steps in the same program (one
+    compile-cache entry + one dispatch instead of two; the remote-runtime
+    round trip is ~100 ms each)."""
     axis = comm.axis
-    key = ("facto", comm.mesh, axis, ncv, _op_key(op),
+    key = ("seedfacto", comm.mesh, axis, ncv, _op_key(op),
            _op_key(inner) if inner is not None else None)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
@@ -122,120 +151,224 @@ def _build_factorization_program(comm: DeviceComm, op, ncv: int, inner=None):
     else:
         b_apply = None
         b_specs = ()
+    run = _facto_steps(spmv, b_apply, axis, ncv)
 
-    def local_fn(op_arrays, b_arrays, V, H, k):
-        def A(v):
-            return spmv(op_arrays, v)
-
-        def Bip(v):
-            return b_apply(b_arrays, v) if b_apply is not None else v
-
-        def pdot_vec(Vb, wB):
-            # conj for complex-correct projections (identity on real dtypes)
-            return lax.psum(jnp.conj(Vb) @ wB, axis)
-
-        def pnorm(u):
-            return jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, Bip(u)), axis)))
-
-        vk = V[k]
-        nrm = pnorm(vk)
-        V = V.at[k].set(vk / jnp.where(nrm == 0, 1.0, nrm))
-
-        def step(j, VH):
-            V, H = VH
-            w = A(V[j])
-            # CGS2 against the whole basis: rows beyond j+1 are zero, so no
-            # masking is needed; for restarts this also fills the arrow
-            # column H[0:k, k] automatically.
-            h1 = pdot_vec(V, Bip(w))
-            w = w - h1 @ V
-            h2 = pdot_vec(V, Bip(w))
-            w = w - h2 @ V
-            h = h1 + h2
-            b = pnorm(w)
-            V = V.at[j + 1].set(w / jnp.where(b == 0, 1.0, b))
-            H = H.at[:, j].set(h)
-            H = H.at[j + 1, j].set(b)
-            return (V, H)
-
-        V, H = lax.fori_loop(k, ncv, step, (V, H))
-        return V, H
+    def local_fn(op_arrays, b_arrays, v0):
+        V = jnp.zeros((ncv + 1, v0.shape[0]), v0.dtype).at[0].set(v0)
+        H = jnp.zeros((ncv + 1, ncv), v0.dtype)
+        return run(op_arrays, b_arrays, V, H, 0)
 
     prog = jax.jit(comm.shard_map(
         local_fn,
-        in_specs=(op_specs, b_specs, P(None, axis), P(), P()),
+        in_specs=(op_specs, b_specs, P(axis)),
         out_specs=(P(None, axis), P())))
     _PROGRAM_CACHE[key] = prog
     return prog
 
 
-def _build_restart_program(comm: DeviceComm, ncv: int):
-    """Thick-restart basis compression, on device: ``V_new[0:k] = S^T V[0:ncv]``
-    (one sharded matmul — the basis never visits the host), ``V_new[k] =
-    V[ncv]`` (the residual direction), rows beyond ``k`` zeroed.
-
-    ``S`` is replicated ``(ncv, ncv)`` with columns beyond ``k`` zero.
-    """
+def _build_restart_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
+    """Thick-restart compression + factorization continuation fused:
+    ``prog(op_arrays, b_arrays, V, H_prefill, S, k) -> (V, H)`` — the basis
+    compression (one sharded matmul) and the steps ``k..ncv-1`` run as ONE
+    program, so each restart costs one dispatch + one small H fetch."""
     axis = comm.axis
-    key = ("restart", comm.mesh, axis, ncv)
+    key = ("restartfacto", comm.mesh, axis, ncv, _op_key(op),
+           _op_key(inner) if inner is not None else None)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def local_fn(V, S, k):
-        Vr = S.T @ V[:ncv]                       # (ncv, lsize)
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+    if inner is not None:
+        b_apply = inner.local_spmv(comm)
+        b_specs = inner.op_specs(axis)
+    else:
+        b_apply = None
+        b_specs = ()
+    run = _facto_steps(spmv, b_apply, axis, ncv)
+
+    def local_fn(op_arrays, b_arrays, V, H, S, k):
+        Vr = S.T @ V[:ncv]
         row = jnp.arange(ncv)[:, None]
         Vnew = jnp.zeros_like(V)
         Vnew = Vnew.at[:ncv].set(jnp.where(row < k, Vr, 0))
         Vnew = Vnew.at[k].set(V[ncv])
-        return Vnew
+        return run(op_arrays, b_arrays, Vnew, H, k)
 
     prog = jax.jit(comm.shard_map(
         local_fn,
-        in_specs=(P(None, axis), P(), P()),
-        out_specs=P(None, axis)))
+        in_specs=(op_specs, b_specs, P(None, axis), P(), P(), P()),
+        out_specs=(P(None, axis), P())))
     _PROGRAM_CACHE[key] = prog
     return prog
 
 
-def _build_seed_program(comm: DeviceComm, ncv: int):
-    """Build the (ncv+1, n_pad) basis on device from a start vector — only
-    the npad-sized v0 crosses host->device, never the full zero basis."""
+def _build_arnoldi_restart_facto_program(comm: DeviceComm, op, ncv: int,
+                                         inner=None):
+    """Explicit (arnoldi) restart + factorization fused:
+    ``prog(op_arrays, b_arrays, V, w) -> (V, H)`` — the new start vector
+    ``w @ V[:ncv]`` and the fresh ncv-step factorization in one program."""
     axis = comm.axis
-    key = ("seed", comm.mesh, axis, ncv)
+    key = ("arnoldifacto", comm.mesh, axis, ncv, _op_key(op),
+           _op_key(inner) if inner is not None else None)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
 
-    def local_fn(v0):
-        V = jnp.zeros((ncv + 1, v0.shape[0]), v0.dtype)
-        return V.at[0].set(v0)
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+    if inner is not None:
+        b_apply = inner.local_spmv(comm)
+        b_specs = inner.op_specs(axis)
+    else:
+        b_apply = None
+        b_specs = ()
+    run = _facto_steps(spmv, b_apply, axis, ncv)
 
-    prog = jax.jit(comm.shard_map(
-        local_fn, in_specs=(P(axis),), out_specs=P(None, axis)))
-    _PROGRAM_CACHE[key] = prog
-    return prog
-
-
-def _build_arnoldi_restart_program(comm: DeviceComm, ncv: int):
-    """Explicit restart on device: new start vector = ``w @ V[:ncv]`` (the
-    wanted-Ritz combination), rest of the basis zeroed — the basis never
-    round-trips to host between restarts."""
-    axis = comm.axis
-    key = ("arnoldi_restart", comm.mesh, axis, ncv)
-    cached = _PROGRAM_CACHE.get(key)
-    if cached is not None:
-        return cached
-
-    def local_fn(V, w):
+    def local_fn(op_arrays, b_arrays, V, w):
         v0 = w @ V[:ncv]
-        Vn = jnp.zeros_like(V)
-        return Vn.at[0].set(v0)
+        Vn = jnp.zeros_like(V).at[0].set(v0)
+        H = jnp.zeros((ncv + 1, ncv), V.dtype)
+        return run(op_arrays, b_arrays, Vn, H, 0)
 
     prog = jax.jit(comm.shard_map(
-        local_fn, in_specs=(P(None, axis), P()), out_specs=P(None, axis)))
+        local_fn,
+        in_specs=(op_specs, b_specs, P(None, axis), P()),
+        out_specs=(P(None, axis), P())))
     _PROGRAM_CACHE[key] = prog
     return prog
+
+
+def _build_hep_loop_program(comm: DeviceComm, op, ncv: int, k_keep: int,
+                            nev: int, inner=None, which: str = "",
+                            st_type: str = "shift"):
+    """The ENTIRE Hermitian Krylov-Schur solve as ONE compiled program.
+
+    ``prog(op_arrays, b_arrays, v0, tol, sigma, tau, max_restarts) ->
+    (V, H, restarts, nconv)`` — a ``lax.while_loop`` over thick restarts:
+    each iteration solves the ncv×ncv projected problem with
+    ``jnp.linalg.eigh`` ON DEVICE, selects/orders by the ``which`` metric of
+    the back-transformed Ritz values (static ST-type branch, runtime
+    ``sigma``/``tau``), compresses the basis, and continues the
+    factorization — no host round trips until the final (V, H) fetch, so a
+    converged HEP/GHEP solve costs O(1) sync points instead of one per
+    restart (on the ~100 ms/fetch remote runtime, that fetch — not the ncv
+    SpMVs — dominated each cycle).
+
+    Used only where the device ``eigh`` carries full working precision
+    (see ``_device_eigh_trustworthy``): the CPU backend at any dtype and
+    the TPU at f32/f64 (measured 2e-13 f64 eigh accuracy under x64 mode,
+    which the package enables; complex eigh is CPU-only on this runtime —
+    a lower-precision eigh would inject backward error into every thick
+    restart, so the gate matters).
+    """
+    axis = comm.axis
+    key = ("heploop", comm.mesh, axis, ncv, k_keep, nev, _op_key(op),
+           _op_key(inner) if inner is not None else None, which, st_type)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    spmv = op.local_spmv(comm)
+    op_specs = op.op_specs(axis)
+    if inner is not None:
+        b_apply = inner.local_spmv(comm)
+        b_specs = inner.op_specs(axis)
+    else:
+        b_apply = None
+        b_specs = ()
+    run = _facto_steps(spmv, b_apply, axis, ncv)
+
+    def back_transform(lam, sigma):
+        if st_type == "sinvert":
+            safe = jnp.where(lam == 0, 1.0, lam)
+            return jnp.where(lam == 0, jnp.inf, sigma + 1.0 / safe)
+        return lam + sigma                     # 'shift' (identity at 0)
+
+    def metric(lam_bt, tau):
+        # mirrors EPS._metric for real (HEP) spectra
+        if which == EPSWhich.LARGEST_MAGNITUDE:
+            return jnp.abs(lam_bt)
+        if which == EPSWhich.SMALLEST_MAGNITUDE:
+            return -jnp.abs(lam_bt)
+        if which == EPSWhich.LARGEST_REAL:
+            return lam_bt
+        if which == EPSWhich.SMALLEST_REAL:
+            return -lam_bt
+        if which == EPSWhich.TARGET_MAGNITUDE:
+            return -jnp.abs(lam_bt - tau)
+        if which == EPSWhich.TARGET_REAL:
+            return -jnp.abs(lam_bt - tau)
+        raise ValueError(f"unsupported which {which!r} for the fused "
+                         "HEP loop")
+
+    def local_fn(op_arrays, b_arrays, v0, tol, sigma, tau, max_restarts):
+        dt = v0.dtype
+        V0 = jnp.zeros((ncv + 1, v0.shape[0]), dt).at[0].set(v0)
+        H0 = jnp.zeros((ncv + 1, ncv), dt)
+        V, H = run(op_arrays, b_arrays, V0, H0, 0)
+
+        def rr(H):
+            Hm = H[:ncv, :ncv]
+            Hm = (Hm + Hm.conj().T) / 2.0
+            lam, S = jnp.linalg.eigh(Hm)       # lam real, ascending
+            beta = jnp.real(H[ncv, ncv - 1])
+            m = jnp.where(jnp.isfinite(lam),
+                          metric(back_transform(lam, sigma), tau), -jnp.inf)
+            order = jnp.argsort(-m)
+            res = jnp.abs(beta) * jnp.abs(S[ncv - 1, order])
+            rel = res / jnp.maximum(jnp.abs(lam[order]), 1e-300)
+            lead = jnp.cumprod((rel[:nev] <= tol).astype(jnp.int32))
+            return lam, S, order, jnp.sum(lead), beta
+
+        def nconv_of(H):
+            return rr(H)[3]
+
+        def cond(st):
+            V, H, restarts, nconv = st
+            return (nconv < nev) & (restarts < max_restarts)
+
+        def body(st):
+            V, H, restarts, _ = st
+            lam, S, order, _, beta = rr(H)
+            take = order[:k_keep]
+            S_keep = S[:, take]                    # (ncv, k)
+            # thick restart: exact for device-precision eigenvectors
+            H_new = jnp.zeros_like(H)
+            H_new = H_new.at[jnp.arange(k_keep),
+                             jnp.arange(k_keep)].set(lam[take].astype(dt))
+            H_new = H_new.at[k_keep, :k_keep].set(
+                (beta * S[ncv - 1, take]).astype(dt))
+            Vr = S_keep.T @ V[:ncv]                # (k, lsize)
+            V_new = jnp.zeros_like(V).at[:k_keep].set(Vr)
+            V_new = V_new.at[k_keep].set(V[ncv])
+            V2, H2 = run(op_arrays, b_arrays, V_new, H_new, k_keep)
+            return (V2, H2, restarts + 1, nconv_of(H2))
+
+        st = lax.while_loop(cond, body,
+                            (V, H, jnp.int32(1), nconv_of(H)))
+        V, H, restarts, nconv = st
+        return V, H, restarts, nconv
+
+    prog = jax.jit(comm.shard_map(
+        local_fn,
+        in_specs=(op_specs, b_specs, P(axis), P(), P(), P(), P()),
+        out_specs=(P(None, axis), P(), P(), P())))
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _device_eigh_trustworthy(comm: DeviceComm, dtype) -> bool:
+    """True when ``jnp.linalg.eigh`` on this mesh carries the full working
+    precision of ``dtype``: the CPU backend (LAPACK) always does, and the
+    TPU runtime's eigh is full-precision for f32/f64 under x64 mode
+    (measured 2e-13 on f64 — the package enables x64 at import). Complex
+    eigh is CPU-only (this TPU runtime has no complex support at all)."""
+    platform = comm.devices[0].platform
+    if platform == "cpu":
+        return True
+    return not is_complex(dtype)
 
 
 def _build_power_program(comm: DeviceComm, op, steps: int):
@@ -620,24 +753,78 @@ class EPS:
         n = op.shape[0]
         ncv = self._effective_ncv(n)
         nev = min(self.nev, ncv)
-        prog = _build_factorization_program(comm, op, ncv, inner)
-        restart_prog = _build_restart_program(comm, ncv)
+        dtype = np.dtype(str(op.dtype))
         op_arrays = op.device_arrays()
         b_arrays = inner.device_arrays() if inner is not None else ()
+        v0 = comm.put_rows(self._start_vector(comm, n, dtype))
+        k_keep = int(min(max(nev, ncv // 2), ncv - 1))
 
-        dtype = np.dtype(str(op.dtype))
-        seed_prog = _build_seed_program(comm, ncv)
-        V = seed_prog(comm.put_rows(self._start_vector(comm, n, dtype)))
-        H = np.zeros((ncv + 1, ncv), dtype=dtype)
+        # ---- fused whole-solve path: every restart's projected eigh runs
+        # ON DEVICE inside one while_loop program — O(1) sync points/solve.
+        # Requires a Hermitian problem (real projected spectrum, no Schur
+        # ordering) and a device eigh at full working precision. On remote
+        # (tunnel) runtimes the big fused program costs ~1s more to load
+        # from the compile cache than the two small host-loop programs, so
+        # tiny problems — where the per-restart H fetch it eliminates is
+        # cheap — default to the host loop (override: TPU_SOLVE_EPS_FUSED).
+        fused_env = os.environ.get("TPU_SOLVE_EPS_FUSED", "")
+        if fused_env in ("0", "false"):
+            want_fused = False
+        elif fused_env in ("1", "true"):
+            want_fused = True
+        else:
+            want_fused = (comm.devices[0].platform == "cpu" or n >= 4096)
+        if (want_fused and hermitian and ncv < n and k_keep >= 1
+                and self._which in (
+                    EPSWhich.LARGEST_MAGNITUDE, EPSWhich.SMALLEST_MAGNITUDE,
+                    EPSWhich.LARGEST_REAL, EPSWhich.SMALLEST_REAL,
+                    EPSWhich.TARGET_MAGNITUDE, EPSWhich.TARGET_REAL)
+                and _device_eigh_trustworthy(comm, dtype)):
+            prog = _build_hep_loop_program(
+                comm, op, ncv, k_keep, nev, inner,
+                which=self._which, st_type=self.st.get_type())
+            tau = 0.0 if self._target is None else float(self._target)
+            V, H, restarts_a, _ = prog(
+                op_arrays, b_arrays, v0,
+                np.float64(self.tol), np.float64(self.st.sigma),
+                np.float64(tau), np.int32(self.max_it))
+            # the ONE blocking D2H point: H for the final (host, full-f64)
+            # Rayleigh-Ritz used for extraction/reporting
+            Hh = np.asarray(H, dtype=host_dtype(dtype))
+            record_sync("EPS H fetch/solve")
+            restarts = int(restarts_a)
+            beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
+                Hh, ncv, nev, hermitian)
+            Vh = comm.host_fetch(V)[:ncv]
+            record_sync("EPS basis fetch/solve")
+            count = max(nev, 1)
+            lam, vecs = self._extract(Vh, S, lam_t, order, n, count)
+            self._store(lam, vecs, rel[:count], nconv, restarts)
+            return
+
+        # ---- host-eigh loop (NHEP Schur ordering, complex-on-TPU,
+        # degenerate sizes, and small-n remote solves where the big fused
+        # program's compile-cache load outweighs the fetches it saves):
+        # seed+factorization and compression+factorization each run as ONE
+        # fused program, so a restart costs one dispatch + one small H
+        # fetch.
+        seed_prog = _build_seed_facto_program(comm, op, ncv, inner)
+        restart_prog = _build_restart_facto_program(comm, op, ncv, inner)
+        V = None
+        H_prefill = np.zeros((ncv + 1, ncv), dtype=dtype)
+        S_pad = np.zeros((ncv, ncv), dtype=dtype)
         k = 0
 
         for restarts in range(1, self.max_it + 1):
-            V, H = prog(op_arrays, b_arrays, V, H,
-                        np.asarray(k, dtype=np.int32))
+            if V is None:
+                V, H = seed_prog(op_arrays, b_arrays, v0)
+            else:
+                V, H = restart_prog(op_arrays, b_arrays, V, H_prefill,
+                                    S_pad, np.asarray(k, dtype=np.int32))
             # the ONE blocking D2H point per restart: the small replicated
             # projected matrix (the basis V stays on device; the restart
-            # compression is a device matmul). Counted because on remote
-            # runtimes this fetch, not the ncv SpMVs, dominates the cycle.
+            # compression runs inside the same program). Counted because on
+            # remote runtimes this fetch, not the ncv SpMVs, dominates.
             Hh = np.asarray(H, dtype=host_dtype(dtype))
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
@@ -646,7 +833,7 @@ class EPS:
                 break
 
             # ---- thick restart: keep k wanted Ritz/Schur directions --------
-            k = int(min(max(nev, ncv // 2), ncv - 1))
+            k = k_keep
             if hermitian:
                 take = order[:k]
                 T_new = np.diag(lam_t[take])
@@ -674,12 +861,11 @@ class EPS:
                 b_new = beta * Z[ncv - 1, :k]
                 S_keep = Z[:, :k]
 
-            H = np.zeros((ncv + 1, ncv), dtype=dtype)
-            H[:k, :k] = T_new
-            H[k, :k] = b_new
+            H_prefill = np.zeros((ncv + 1, ncv), dtype=dtype)
+            H_prefill[:k, :k] = T_new
+            H_prefill[k, :k] = b_new
             S_pad = np.zeros((ncv, ncv), dtype=dtype)
             S_pad[:, :k] = S_keep
-            V = restart_prog(V, S_pad, np.asarray(k, dtype=np.int32))
 
         Vh = comm.host_fetch(V)[:ncv]
         record_sync("EPS basis fetch/solve")
@@ -693,19 +879,22 @@ class EPS:
         n = op.shape[0]
         ncv = self._effective_ncv(n)
         nev = min(self.nev, ncv)
-        prog = _build_factorization_program(comm, op, ncv, inner)
-        seed_prog = _build_seed_program(comm, ncv)
-        restart_prog = _build_arnoldi_restart_program(comm, ncv)
+        seed_prog = _build_seed_facto_program(comm, op, ncv, inner)
+        restart_prog = _build_arnoldi_restart_facto_program(comm, op, ncv,
+                                                           inner)
         op_arrays = op.device_arrays()
         b_arrays = inner.device_arrays() if inner is not None else ()
 
         dtype = np.dtype(str(op.dtype))
-        V = seed_prog(comm.put_rows(self._start_vector(comm, n, dtype)))
+        V = None
+        wanted = None
 
         for restarts in range(1, self.max_it + 1):
-            H = np.zeros((ncv + 1, ncv), dtype=dtype)
-            V, H = prog(op_arrays, b_arrays, V, H,
-                        np.asarray(0, dtype=np.int32))
+            if V is None:
+                V, H = seed_prog(op_arrays, b_arrays, comm.put_rows(
+                    self._start_vector(comm, n, dtype)))
+            else:
+                V, H = restart_prog(op_arrays, b_arrays, V, wanted)
             Hh = np.asarray(H, dtype=host_dtype(dtype))
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
@@ -719,7 +908,6 @@ class EPS:
             # combination.
             comb = S[:, order[:nev]].sum(axis=1)
             wanted = (comb if is_complex(dtype) else comb.real).astype(dtype)
-            V = restart_prog(V, wanted)
 
         Vh = comm.host_fetch(V)[:ncv]
         record_sync("EPS basis fetch/solve")

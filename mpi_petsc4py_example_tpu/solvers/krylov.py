@@ -99,9 +99,14 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     if natural:
         rnorm = _nat(rz)
         tol = jnp.maximum(rtol * rnorm, atol)
+        # a negative <r, M r> means M (or A) is indefinite — the natural
+        # norm is undefined there; flag breakdown instead of letting the
+        # 0-clamped norm fake instant convergence
+        brk0 = jnp.real(rz) < 0
     else:
         bnorm, tol = _tol(pnorm, b, rtol, atol)
         rnorm = pnorm(r)
+        brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
 
@@ -124,6 +129,8 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         r = jnp.where(cont, r - alpha * Ap, r)
         z = jnp.where(cont, M(r), z)
         rz_new = pdot(r, z)
+        if natural:
+            brk_new = brk_new | (cont & (jnp.real(rz_new) < 0))
         beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
         p = jnp.where(cont, z + beta * p, p)
         rz = jnp.where(cont, rz_new, rz)
@@ -138,13 +145,13 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
             st = step(st)
         return st
 
-    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, rnorm <= -1.0)
+    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, brk0)
     k, x, r, z, p, rz, rnorm, brk = lax.while_loop(active, body, st0)
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
 def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
-                      monitor=None, dtol=None):
+                      monitor=None, dtol=None, grid3d=None):
     """CG fast path for uniform-diagonal stencil operators (the BASELINE
     cfg1/cfg5 hot loop, reference ``test.py:50``'s iterative analog).
 
@@ -156,15 +163,24 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     - the Jacobi apply collapses to a scalar multiply (the stencil diagonal
       is uniform), folded into the p-update — no ``z`` vector exists at all;
     - ``rz = <r, M r> = inv_diag * ||r||²`` reuses the residual-norm
-      reduction, so each iteration has exactly two reduction phases
-      (``pAp`` inside Adot, ``rr`` fused into the r-update by XLA) and ~11
-      vector-sized HBM passes instead of ~17.
+      reduction;
+    - the loop state lives in the operator's GRID shape (``grid3d``),
+      reshaped once at entry/exit: a flat->3D reshape around the Pallas
+      call inside the loop body materializes full-array copies (measured
+      +9 HBM passes / 2.5x per-iteration at 256³); on 3D carries the whole
+      step runs in ~6 passes (~0.51 ms at 256³ fp32 vs the 11-pass model's
+      0.90 — the model overcounted, XLA fuses the update chain).
 
     Convergence, breakdown, and divergence semantics match ``cg_kernel`` at
     ``unroll=1`` exactly; iteration counts and the monitored norm
     (unpreconditioned ``||r||``) are the same.
     """
-    bnorm, tol = _tol(pnorm, b, rtol, atol)
+    flat = b.shape
+    if grid3d is not None:
+        b = b.reshape(grid3d)
+        x0 = x0.reshape(grid3d)
+    bnorm = pnorm(b)
+    tol = jnp.maximum(rtol * bnorm, atol)
     r = b - Adot(x0)[0]
     rr = pdot(r, r)
     rnorm = jnp.sqrt(rr)
@@ -196,6 +212,8 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0)
     k, x, r, p, rz, rnorm, brk = lax.while_loop(active, body, st0)
+    if grid3d is not None:
+        x = x.reshape(flat)
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
 
@@ -894,11 +912,13 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     if natural:
         rnorm = _nat(rho)
         tol = jnp.maximum(rtol * rnorm, atol)
+        brk0 = jnp.real(rho) < 0     # indefinite A: natural norm undefined
     else:
         pb = M(b)
         bnorm = pnorm(pb)
         tol = jnp.maximum(rtol * bnorm, atol)
         rnorm = pnorm(r)
+        brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
 
@@ -916,6 +936,8 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         r = r - alpha * Mq
         w = A(r)
         rho_new = pdot(r, w)
+        if natural:
+            brk = brk | (jnp.real(rho_new) < 0)
         beta = jnp.where(rho == 0, 0.0, rho_new / jnp.where(rho == 0, 1.0, rho))
         p = r + beta * p
         q = w + beta * q
@@ -924,7 +946,7 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
             monitor(k + 1, rn)
         return (k + 1, x, r, p, w, q, rho_new, rn, brk)
 
-    st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, rnorm <= -1.0)
+    st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, brk0)
     k, x, r, p, w, q, rho, rnorm, brk = lax.while_loop(cond, body, st0)
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
 
@@ -1267,13 +1289,17 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     """
     m = restart
     r = b - A(x0)
-    z0 = M(r)
     if natural:
-        rnorm = _nat(pdot(r, z0))
+        z0 = M(r)
+        rz0 = pdot(r, z0)
+        rnorm = _nat(rz0)
         tol = jnp.maximum(rtol * rnorm, atol)
+        brk0 = jnp.real(rz0) < 0     # indefinite M: natural norm undefined
     else:
+        z0 = jnp.zeros_like(b)       # placeholder: body computes z at top
         bnorm, tol = _tol(pnorm, b, rtol, atol)
         rnorm = pnorm(r)
+        brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
     _mon0(monitor, rnorm)
     Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
@@ -1286,6 +1312,9 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
     def body(st):
         k, slot, x, r, z, Pb, APb, eta, rn, brk = st
+        if not natural:
+            z = M(r)       # default mode applies M at the top; natural
+                           # mode carries the end-of-body z (same count)
         c = pmatdot(APb, z)                 # z . Ap_i over the window
         coef = jnp.where(eta != 0, c / jnp.where(eta == 0, 1.0, eta), 0.0)
         p = z - coef @ Pb
@@ -1296,17 +1325,22 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
                           pdot(p, r) / jnp.where(brk, 1.0, pAp))
         x = x + alpha * p
         r = r - alpha * Ap
-        z = M(r)
         Pb = Pb.at[slot].set(p)
         APb = APb.at[slot].set(Ap)
         eta = eta.at[slot].set(pAp)
-        rn = _nat(pdot(r, z)) if natural else pnorm(r)
+        if natural:
+            z = M(r)
+            rz = pdot(r, z)
+            brk = brk | (jnp.real(rz) < 0)
+            rn = _nat(rz)
+        else:
+            rn = pnorm(r)
         if monitor is not None:
             monitor(k + 1, rn)
         return (k + 1, (slot + 1) % m, x, r, z, Pb, APb, eta, rn, brk)
 
     st0 = (jnp.int32(0), jnp.int32(0), x0, r, z0, Pbuf, APbuf, eta,
-           rnorm, rnorm <= -1.0)
+           rnorm, brk0)
     k, slot, x, r, z0, Pbuf, APbuf, eta, rnorm, brk = \
         lax.while_loop(cond, body, st0)
     return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
@@ -1519,6 +1553,12 @@ KSP_KERNELS = {
 # kernels needing the transpose product A^T v (operator.local_spmv_t)
 _NEEDS_TRANSPOSE = ("lsqr", "bicg", "cgne")
 
+# kernels accepting KSP_NORM_NATURAL — the single source both this module's
+# dispatch and KSP.set_norm_type validation read (cg/fcg: sqrt <r, M r>;
+# cr: sqrt <r̃, A r̃> of the preconditioned residual — the scalar its own
+# recurrence carries)
+NATURAL_TYPES = ("cg", "fcg", "cr")
+
 
 # ---------------------------------------------------------------------------
 # program factory: wrap a kernel body in shard_map + jit
@@ -1599,7 +1639,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # monitored programs stay at 1
     unroll_k = (max(1, int(unroll))
                 if ksp_type in _UNROLLABLE and not monitored else 1)
-    natural_k = bool(natural) and ksp_type in ("cg", "fcg", "cr")
+    natural_k = bool(natural) and ksp_type in NATURAL_TYPES
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k)
@@ -1633,6 +1673,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                   and not is_complex(dtype)
                   and pc.get_type() in ("none", "jacobi")
                   and hasattr(operator, "local_matvec_dot")
+                  and hasattr(operator, "grid3d")
                   and getattr(operator, "uniform_diagonal", None) is not None
                   # a jacobi PC built from a SEPARATE preconditioning matrix
                   # (set_operators(A, P)) must not collapse to A's diagonal
@@ -1682,9 +1723,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 inv_diag = (jnp.asarray(1.0, b.dtype) if pc.get_type() == "none"
                             else jnp.asarray(1.0 / operator.uniform_diagonal,
                                              b.dtype))
+                # 3D-carry variant: the stencil path is real-dtype, so the
+                # reductions are plain sums (see cg_stencil_kernel docstring
+                # for why the grid shape is kept through the loop)
+                pdot3 = lambda u, v: lax.psum(jnp.sum(u * v), axis)
+                pnorm3 = lambda u: jnp.sqrt(lax.psum(jnp.sum(u * u), axis))
                 return cg_stencil_kernel(
                     lambda v: matvec_dot(op_arrays, v), inv_diag,
-                    pdot, pnorm, b, x0, rtol, atol, maxit, **kw)
+                    pdot3, pnorm3, b, x0, rtol, atol, maxit,
+                    grid3d=operator.grid3d, **kw)
             if unroll_k > 1:
                 kw["unroll"] = unroll_k
             if ksp_type in ("gmres", "fgmres", "gcr", "fcg", "lgmres"):

@@ -29,7 +29,8 @@ from ..parallel.mesh import as_comm
 from ..utils.convergence import ConvergedReason, SolveResult
 from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
-from .krylov import KSP_KERNELS, build_ksp_program, set_current_monitor
+from .krylov import (KSP_KERNELS, NATURAL_TYPES, build_ksp_program,
+                     set_current_monitor)
 from .pc import PC
 
 DEFAULT_RTOL = 1e-5   # PETSc's KSP default
@@ -153,10 +154,11 @@ class KSP:
     _NORM_BY_INT = {-1: "default", 0: "none", 1: "preconditioned",
                     2: "unpreconditioned", 3: "natural"}
 
-    # types whose recurrence already computes the natural norm scalar
-    # <r, M r> (KSP_NORM_NATURAL, PETSc's NormType 3) — zero extra
-    # reductions; other types raise, as PETSc does for unsupported combos
-    _NATURAL_TYPES = ("cg", "fcg", "cr")
+    # types whose recurrence already carries a natural-norm scalar
+    # (KSP_NORM_NATURAL, PETSc's NormType 3): cg/fcg monitor sqrt <r, M r>,
+    # cr monitors sqrt <r̃, A r̃> of its preconditioned residual. Shared
+    # with the kernel dispatch so the two lists cannot drift.
+    _NATURAL_TYPES = NATURAL_TYPES
 
     def set_norm_type(self, norm_type):
         if isinstance(norm_type, (int, np.integer)):
@@ -198,9 +200,11 @@ class KSP:
         if t == "natural":
             if self._type not in self._NATURAL_TYPES:
                 raise ValueError(
-                    f"norm type 'natural' (sqrt <r, M r>) is available for "
-                    f"KSP {sorted(self._NATURAL_TYPES)} whose recurrences "
-                    f"already carry that scalar; {self._type!r} does not — "
+                    f"norm type 'natural' is available for KSP "
+                    f"{sorted(self._NATURAL_TYPES)} whose recurrences "
+                    f"already carry a natural-norm scalar (cg/fcg: "
+                    f"sqrt <r, M r>; cr: sqrt <r̃, A r̃> of the "
+                    f"preconditioned residual); {self._type!r} does not — "
                     "use 'default'")
             return
         have = self._KERNEL_NORMS.get(self._type, "unpreconditioned")

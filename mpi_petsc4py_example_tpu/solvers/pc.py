@@ -39,7 +39,6 @@ import itertools
 import jax
 import jax.numpy as jnp
 import numpy as np
-import scipy.linalg
 from jax import lax
 
 from ..core.mat import Mat
@@ -663,6 +662,7 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
     ``-pc_bjacobi_blocks`` (or past the dense cap) each device holds several
     smaller blocks instead of one ``lsize`` × ``lsize`` one.
     """
+    import scipy.linalg
     _require_assembled(mat, "bjacobi")
     n = mat.shape[0]
     lsize = comm.local_size(n)
@@ -721,6 +721,7 @@ def _build_block_ssor(comm: DeviceComm, mat: Mat, omega: float):
     hostile to the TPU vector unit; an explicit inverse is one fused
     matmul).
     """
+    import scipy.linalg
     if not 0.0 < omega < 2.0:
         raise ValueError(f"SOR omega must be in (0, 2), got {omega}")
     A, n, lsize = _local_dense_blocks(comm, mat, "sor")
@@ -746,6 +747,7 @@ def _build_block_ilu(comm: DeviceComm, mat: Mat, fill: float):
     both densify to an explicit (LU)⁻¹ for a one-matmul MXU apply (device
     triangular solves are serial; the block is dense-capped anyway).
     """
+    import scipy.linalg
     import scipy.sparse as sp
     import scipy.sparse.linalg as spla
     A, n, lsize = _local_dense_blocks(comm, mat, "ilu")
@@ -771,6 +773,7 @@ def _build_asm(comm: DeviceComm, mat: Mat, overlap: int):
     each side; the apply solves on the window and keeps the owned interior.
     Window rows outside the global range use identity padding.
     """
+    import scipy.linalg
     ov = int(overlap)
     if ov < 0:
         raise ValueError(f"asm overlap must be >= 0, got {overlap}")
@@ -857,6 +860,7 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
     LAPACK in fp64; the device applies the (padded) inverse as one matmul.
     Accuracy is recovered by iterative refinement in KSPPREONLY.
     """
+    import scipy.linalg
     _require_assembled(mat, "lu")
     n = mat.shape[0]
     if n > _DENSE_CAP:

@@ -92,6 +92,25 @@ def log_view(file=None):
     if _SYNCS:
         parts = ", ".join(f"{k}: {v}" for k, v in sorted(_SYNCS.items()))
         print(f"host-device sync points: {parts}", file=file)
+    print(f"compiled programs held: {program_count()}", file=file)
+
+
+def program_count() -> int:
+    """Total jit-compiled solver programs cached this process (KSP + EPS)
+    — each costs one trace + compile-cache load per fresh process, the
+    dominant fixed cost of short driver runs on remote runtimes."""
+    n = 0
+    try:
+        from ..solvers.krylov import _PROGRAM_CACHE as kc
+        n += len(kc)
+    except Exception:       # noqa: BLE001 — introspection only
+        pass
+    try:
+        from ..solvers.eps import _PROGRAM_CACHE as ec
+        n += len(ec)
+    except Exception:       # noqa: BLE001
+        pass
+    return n
 
 
 @contextlib.contextmanager
