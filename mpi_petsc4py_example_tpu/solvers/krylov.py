@@ -59,12 +59,70 @@ def _reason(rnorm, tol, atol, k, maxit, brk, dmax=None):
                   diverged)).astype(jnp.int32)
 
 
-def _mon0(monitor, rn0):
-    """Report the iteration-0 (initial) residual norm. petsc4py's monitors
-    and KSPSetResidualHistory include it — history length is iterations+1,
-    and drivers index history[0] for the starting norm."""
+# the in-program history buffer has a STATIC capacity (maxit is a runtime
+# scalar); the KSP solve sizes it from max_it + restart (cycle-granular
+# kernels record at k+restart) rounded up to a power of two so capacity
+# changes rarely recompile, under this hard ceiling (2M f64 entries = 16 MB)
+_HIST_CAP_CEIL = 1 << 21
+
+
+def hist_capacity(max_it: int, restart: int) -> int:
+    """Power-of-two history capacity covering every recordable slot
+    (iterations 0..max_it, plus the restart overshoot of cycle kernels)."""
+    need = int(max_it) + int(restart) + 2
+    return min(1 << max(need - 1, 1).bit_length(), _HIST_CAP_CEIL)
+
+
+class _HistMonitor:
+    """Functional in-program residual recorder.
+
+    Kernels call ``hist = monitor(hist, k, rn)`` — a pure ``.at[k].set``
+    into a (-1)-initialized buffer threaded through the loop carry, so
+    monitoring needs NO host callback (the axon TPU runtime rejects
+    ``jax.debug.callback`` entirely, and even where callbacks work they
+    cost an in-loop host round trip). The KSP solve fetches the buffer
+    once afterwards and replays the written entries, in order, to the
+    user monitors — cycle-granular kernels (gmres: one entry per restart)
+    leave gaps, which replay skips naturally. The sentinel is -1 because
+    every monitored quantity is a nonnegative norm, while NaN (a
+    legitimately recordable blown-up residual) must survive the replay
+    filter. Writes beyond the capacity are dropped (mode='drop'), never
+    clamped onto the last slot.
+    """
+
+    def __init__(self, dtype, cap):
+        # norms are real scalars whatever the operator dtype
+        self.dtype = jnp.real(jnp.zeros((), dtype)).dtype
+        self.cap = int(cap)
+
+    def init(self):
+        return jnp.full((self.cap,), -1.0, self.dtype)
+
+    def __call__(self, hist, k, rn):
+        return hist.at[k].set(rn.astype(self.dtype), mode="drop")
+
+
+def _no_hist(dtype):
+    """Zero-size placeholder carried when monitoring is off — compiled
+    away entirely, but keeps every kernel's carry structure uniform."""
+    return jnp.zeros((0,), jnp.real(jnp.zeros((), dtype)).dtype)
+
+
+def _hist0(monitor, dtype):
+    """The history carry every kernel threads through its loop: the real
+    recorder when monitoring, a zero-size placeholder otherwise."""
+    return monitor.init() if monitor is not None else _no_hist(dtype)
+
+
+def _mon0(monitor, rn0, dtype):
+    """Build the history carry and record the iteration-0 (initial)
+    residual norm. petsc4py's monitors and KSPSetResidualHistory include
+    it — history length is iterations+1, and drivers index history[0] for
+    the starting norm."""
+    hist = _hist0(monitor, dtype)
     if monitor is not None:
-        monitor(jnp.int32(0), rn0)
+        return monitor(hist, jnp.int32(0), rn0)
+    return hist
 
 
 def _nat(rz):
@@ -108,14 +166,14 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         rnorm = pnorm(r)
         brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def active(st):
-        k, x, r, z, p, rz, rn, brk = st
+        k, x, r, z, p, rz, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def step(st):
-        k, x, r, z, p, rz, rn, brk = st
+        k, x, r, z, p, rz, rn, brk, hist = st
         cont = active(st)
         Ap = A(p)
         pAp = pdot(p, Ap)
@@ -137,17 +195,18 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         rn = jnp.where(cont, _nat(rz_new) if natural else pnorm(r), rn)
         k = k + cont.astype(jnp.int32)
         if monitor is not None:
-            monitor(k, rn)
-        return (k, x, r, z, p, rz, rn, brk | brk_new)
+            hist = monitor(hist, k, rn)
+        return (k, x, r, z, p, rz, rn, brk | brk_new, hist)
 
     def body(st):
         for _ in range(max(1, int(unroll))):
             st = step(st)
         return st
 
-    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, brk0)
-    k, x, r, z, p, rz, rnorm, brk = lax.while_loop(active, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, brk0, hist)
+    k, x, r, z, p, rz, rnorm, brk, hist = lax.while_loop(active, body, st0)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -187,14 +246,14 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     rz = rr * inv_diag
     p = r * inv_diag
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def active(st):
-        k, x, r, p, rz, rn, brk = st
+        k, x, r, p, rz, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, p, rz, rn, brk = st
+        k, x, r, p, rz, rn, brk, hist = st
         Ap, pAp = Adot(p)
         brk_new = pAp == 0
         alpha = jnp.where(brk_new, 0.0, rz / jnp.where(brk_new, 1.0, pAp))
@@ -207,14 +266,15 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
         rn = jnp.sqrt(rr)
         k = k + 1
         if monitor is not None:
-            monitor(k, rn)
-        return (k, x, r, p, rz_new, rn, brk | brk_new)
+            hist = monitor(hist, k, rn)
+        return (k, x, r, p, rz_new, rn, brk | brk_new, hist)
 
-    st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0)
-    k, x, r, p, rz, rnorm, brk = lax.while_loop(active, body, st0)
+    st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0, hist)
+    k, x, r, p, rz, rnorm, brk, hist = lax.while_loop(active, body, st0)
     if grid3d is not None:
         x = x.reshape(flat)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -225,16 +285,16 @@ def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rhat = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
     one = jnp.asarray(1.0, b.dtype)
     z = jnp.zeros_like(b)
 
     def cond(st):
-        k, x, r, p, v, rho, alpha, omega, rn, brk = st
+        k, x, r, p, v, rho, alpha, omega, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, p, v, rho, alpha, omega, rn, brk = st
+        k, x, r, p, v, rho, alpha, omega, rn, brk, hist = st
         rho_new = pdot(rhat, r)
         brk = (rho_new == 0) | (omega == 0)
         beta = jnp.where(brk, 0.0,
@@ -255,13 +315,15 @@ def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         r = s - omega * t
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, p, v, rho_new, alpha, omega, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, p, v, rho_new, alpha, omega, rn, brk, hist)
 
-    st0 = (jnp.int32(0), x0, r, z, z, one, one, one, rnorm, rnorm <= -1.0)
+    st0 = (jnp.int32(0), x0, r, z, z, one, one, one, rnorm, rnorm <= -1.0,
+           hist)
     out = lax.while_loop(cond, body, st0)
-    k, x, r, p, v, rho, alpha, omega, rnorm, brk = out
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    k, x, r, p, v, rho, alpha, omega, rnorm, brk, hist = out
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -288,16 +350,16 @@ def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rhat = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
     one = jnp.asarray(1.0, b.dtype)
     z = jnp.zeros_like(b)
 
     def cond(st):
-        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk = st
+        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk = st
+        k, x, r, p, v, rho, rho_cur, alpha, omega, rn, brk, hist = st
         brk = (rho_cur == 0) | (omega == 0)
         beta = jnp.where(brk, 0.0,
                          (rho_cur / jnp.where(rho == 0, 1.0, rho))
@@ -333,22 +395,24 @@ def fbcgsr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         rn = jnp.sqrt(jnp.maximum(rn2, eps * jnp.real(ss)))
         rho_next = (rho_cur - alpha * rv) - omega * rt
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, p, v, rho_cur, rho_next, alpha, omega, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, p, v, rho_cur, rho_next, alpha, omega, rn,
+                brk, hist)
 
     # rho_cur starts at (r̂, r₀) = ‖r₀‖² — real-valued, but typed to the
     # operator scalar so the carry stays dtype-consistent on complex builds
     st0 = (jnp.int32(0), x0, r, z, z, one,
            jnp.asarray(rnorm * rnorm, b.dtype), one, one,
-           rnorm, rnorm <= -1.0)
+           rnorm, rnorm <= -1.0, hist)
     out = lax.while_loop(cond, body, st0)
-    k, x, rn, brk = out[0], out[1], out[9], out[10]
+    k, x, rn, brk, hist = out[0], out[1], out[9], out[10], out[11]
     # judge convergence on the norm the loop actually tested (the scalar
     # recurrence), report the recomputed true norm — as bcgsl does; judging
     # on rn_true could mislabel a converged exit as DIVERGED_MAX_IT when the
     # recurrence drifts marginally across the tolerance
     rn_true = pnorm(b - A(x))
-    return x, k, rn_true, _reason(rn, tol, atol, k, maxit, brk, dmax)
+    return (x, k, rn_true, _reason(rn, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def _hessenberg_lstsq(H, beta):
@@ -432,10 +496,10 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r0 = M(b - A(x0))
     rnorm0 = pnorm(r0)
     dmax = _dmax(rnorm0, dtol)
-    _mon0(monitor, rnorm0)
+    hist0 = _mon0(monitor, rnorm0, b.dtype)
 
     def cycle(st):
-        k, x, rn = st
+        k, x, rn, hist = st
         r = M(b - A(x))
         beta = pnorm(r)
         V = jnp.zeros((m + 1, lsize), b.dtype)
@@ -456,16 +520,18 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         x = x + y @ V[:m]
         rn = pnorm(M(b - A(x)))
         if monitor is not None:
-            monitor(k + m, rn)
-        return (k + m, x, rn)
+            hist = monitor(hist, k + m, rn)
+        return (k + m, x, rn, hist)
 
     def cond(st):
-        k, x, rn = st
+        k, x, rn, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit)
 
-    k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
+    k, x, rnorm, hist = lax.while_loop(
+        cond, cycle, (jnp.int32(0), x0, rnorm0, hist0))
     brk = rnorm <= -1.0
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -486,7 +552,8 @@ def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     x = lax.fori_loop(0, 2, refine, x)
     rnorm = pnorm(b - A(x))
     return (x, jnp.int32(1), rnorm,
-            jnp.full((), CR.CONVERGED_ITS, jnp.int32))
+            jnp.full((), CR.CONVERGED_ITS, jnp.int32),
+            _hist0(monitor, b.dtype))
 
 
 def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -496,25 +563,25 @@ def richardson_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - A(x0)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def cond(st):
-        k, x, r, rn = st
+        k, x, r, rn, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit)
 
     def body(st):
-        k, x, r, rn = st
+        k, x, r, rn, hist = st
         x = x + scale * M(r)
         r = b - A(x)
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, rn)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, rn, hist)
 
-    k, x, r, rnorm = lax.while_loop(cond, body,
-                                    (jnp.int32(0), x0, r, rnorm))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
-                                dmax)
+    k, x, r, rnorm, hist = lax.while_loop(
+        cond, body, (jnp.int32(0), x0, r, rnorm, hist))
+    return (x, k, rnorm,
+            _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0, dmax), hist)
 
 
 def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -569,27 +636,30 @@ def minres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         w = (v - oldeps * w1 - delta * w2) / gamma
         x = st["x"] + phi * w
         rn = jnp.abs(phibar) * st["rn0_scale"]
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, rn)
+            hist = monitor(hist, k + 1, rn)
         return dict(k=k + 1, x=x, r1=st["r2"], r2=yv, y=y_new,
                     beta_old=beta, beta=beta_new, dbar=dbar, epsln=epsln,
                     phibar=phibar, cs=cs, sn=sn, w=w, w2=w2,
-                    rn=rn, rn0_scale=st["rn0_scale"], brk=st["brk"])
+                    rn=rn, rn0_scale=st["rn0_scale"], brk=st["brk"],
+                    hist=hist)
 
     rnorm0 = pnorm(r1)
     scale = rnorm0 / jnp.where(beta1 == 0, 1.0, beta1)
-    _mon0(monitor, rnorm0)
+    hist = _mon0(monitor, rnorm0, b.dtype)
     st0 = dict(k=jnp.int32(0), x=x0, r1=r1, r2=r1, y=y,
                beta_old=jnp.asarray(1.0, dt), beta=beta1,
                dbar=jnp.asarray(0.0, dt), epsln=jnp.asarray(0.0, dt),
                phibar=beta1, cs=jnp.asarray(-1.0, dt),
                sn=jnp.asarray(0.0, dt), w=zero, w2=zero,
-               rn=rnorm0, rn0_scale=scale, brk=beta1 < 0)
+               rn=rnorm0, rn0_scale=scale, brk=beta1 < 0, hist=hist)
     st = lax.while_loop(cond, body, st0)
     # exact final residual (the phibar estimate tracks the M-norm)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
-            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -626,14 +696,14 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     dmax = _dmax(rnorm, dtol)
     rho = 1.0 / sigma
     d = z / theta
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def cond(st):
-        k, x, r, d, rho, rn = st
+        k, x, r, d, rho, rn, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit)
 
     def body(st):
-        k, x, r, d, rho, rn = st
+        k, x, r, d, rho, rn, hist = st
         x = x + d
         r = r - A(d)
         z = M(r)
@@ -641,13 +711,13 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         d = rho_new * rho * d + (2.0 * rho_new / delta) * z
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, d, rho_new, rn)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, d, rho_new, rn, hist)
 
-    st0 = (jnp.int32(0), x0, r, d, rho, rnorm)
-    k, x, r, d, rho, rnorm = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
-                                dmax)
+    st0 = (jnp.int32(0), x0, r, d, rho, rnorm, hist)
+    k, x, r, d, rho, rnorm, hist = lax.while_loop(cond, body, st0)
+    return (x, k, rnorm,
+            _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0, dmax), hist)
 
 
 def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -667,7 +737,7 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     w = A(u)
     rn0 = pnorm(r)
     dmax = _dmax(rn0, dtol)
-    _mon0(monitor, rn0)
+    hist = _mon0(monitor, rn0, b.dtype)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
@@ -697,18 +767,20 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         # rr = <r, r> is real by construction; take the real part so the
         # carried norm stays real-typed for complex operators
         rn = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, rn)
+            hist = monitor(hist, k + 1, rn)
         return dict(k=k + 1, x=x, r=r, u=u, w=w, p=p, s=s,
-                    gamma=gamma, alpha=alpha, rn=rn, brk=brk)
+                    gamma=gamma, alpha=alpha, rn=rn, brk=brk, hist=hist)
 
     st0 = dict(k=jnp.int32(0), x=x0, r=r, u=u, w=w, p=zero, s=zero,
                gamma=jnp.asarray(0.0, dt), alpha=jnp.asarray(0.0, dt),
-               rn=pnorm(r), brk=pnorm(r) <= -1.0)
+               rn=pnorm(r), brk=pnorm(r) <= -1.0, hist=hist)
     st = lax.while_loop(cond, body, st0)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -727,10 +799,10 @@ def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(b - A(x0))
     dmax = _dmax(rnorm0, dtol)
-    _mon0(monitor, rnorm0)
+    hist0 = _mon0(monitor, rnorm0, b.dtype)
 
     def cycle(st):
-        k, x, rn = st
+        k, x, rn, hist = st
         r = b - A(x)
         beta = pnorm(r)
         V = jnp.zeros((m + 1, lsize), b.dtype)
@@ -754,16 +826,17 @@ def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         x = x + y @ Z
         rn = pnorm(b - A(x))
         if monitor is not None:
-            monitor(k + m, rn)
-        return (k + m, x, rn)
+            hist = monitor(hist, k + m, rn)
+        return (k + m, x, rn, hist)
 
     def cond(st):
-        k, x, rn = st
+        k, x, rn, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit)
 
-    k, x, rnorm = lax.while_loop(cond, cycle, (jnp.int32(0), x0, rnorm0))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
-                                dmax)
+    k, x, rnorm, hist = lax.while_loop(
+        cond, cycle, (jnp.int32(0), x0, rnorm0, hist0))
+    return (x, k, rnorm,
+            _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0, dmax), hist)
 
 
 def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -780,7 +853,7 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rtilde = r
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
     zero = jnp.zeros_like(b)
     dt = b.dtype
 
@@ -805,12 +878,15 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         y = st["y"] + alpha * uq
         r = st["r"] - alpha * op(uq)
         rn = pnorm(r)
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, rn)
-        return dict(k=k + 1, y=y, r=r, p=p, q=q, rho=rho_new, rn=rn, brk=brk)
+            hist = monitor(hist, k + 1, rn)
+        return dict(k=k + 1, y=y, r=r, p=p, q=q, rho=rho_new, rn=rn,
+                    brk=brk, hist=hist)
 
     st0 = dict(k=jnp.int32(0), y=zero, r=r, p=zero, q=zero,
-               rho=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0)
+               rho=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0,
+               hist=hist)
     st = lax.while_loop(cond, body, st0)
     x = x0 + M(st["y"])
     # converged-reason from the recurrence residual the loop monitored
@@ -818,7 +894,8 @@ def cgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     # drift above it in reduced precision (CGS squares the residual poly).
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -836,7 +913,7 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rstar = r0
     tau0 = pnorm(r0)
     dmax = _dmax(tau0, dtol)
-    _mon0(monitor, tau0)
+    hist = _mon0(monitor, tau0, b.dtype)
     zero = jnp.zeros_like(b)
     dt = b.dtype
     u1_0 = op(r0)
@@ -876,22 +953,25 @@ def tfqmr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         v = u1 + beta * (u2 + beta * st["v"])
         # quasi-residual bound on the true residual after 2(k+1) half-steps
         dp = st2["tau"] * jnp.sqrt(2.0 * (k + 1) + 1.0)
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, dp)
+            hist = monitor(hist, k + 1, dp)
         return dict(st2, k=k + 1, y1=y1, u1=u1, v=v, rho=rho_new,
-                    dp=dp, brk=brk)
+                    dp=dp, brk=brk, hist=hist)
 
     # mixed-dtype carry for complex builds: theta/tau/dp are norms (real),
     # eta/rho are Krylov coefficients (operator scalar)
     rdt = jnp.real(jnp.zeros((), dt)).dtype
     st0 = dict(k=jnp.int32(0), y=zero, w=r0, y1=r0, u1=u1_0, v=u1_0,
                d=zero, theta=jnp.asarray(0.0, rdt), eta=jnp.asarray(0.0, dt),
-               tau=tau0, rho=pdot(rstar, r0), dp=tau0, brk=tau0 <= -1.0)
+               tau=tau0, rho=pdot(rstar, r0), dp=tau0, brk=tau0 <= -1.0,
+               hist=hist)
     st = lax.while_loop(cond, body, st0)
     x = x0 + M(st["y"])
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["dp"], tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(st["dp"], tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -920,14 +1000,14 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         rnorm = pnorm(r)
         brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def cond(st):
-        k, x, r, p, w, q, rho, rn, brk = st
+        k, x, r, p, w, q, rho, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, p, w, q, rho, rn, brk = st
+        k, x, r, p, w, q, rho, rn, brk, hist = st
         Mq = M(q)
         qMq = pdot(q, Mq)
         brk = qMq == 0
@@ -943,12 +1023,14 @@ def cr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         q = w + beta * q
         rn = _nat(rho_new) if natural else pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, p, w, q, rho_new, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, p, w, q, rho_new, rn, brk, hist)
 
-    st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, brk0)
-    k, x, r, p, w, q, rho, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    st0 = (jnp.int32(0), x0, r, p, w, q, rho, rnorm, brk0, hist)
+    k, x, r, p, w, q, rho, rnorm, brk, hist = lax.while_loop(cond, body,
+                                                             st0)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -971,7 +1053,7 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     v, alfa = normalize(At(u))
     w = v
     dmax = _dmax(beta, dtol)
-    _mon0(monitor, beta)
+    hist = _mon0(monitor, beta, b.dtype)
 
     def cond(st):
         return ((st["phibar"] > tol) & (st["phibar"] < dmax)
@@ -992,18 +1074,19 @@ def lsqr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         phibar = s * st["phibar"]
         x = st["x"] + (phi / safe_rho) * st["w"]
         w = v - (theta / safe_rho) * st["w"]
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, phibar)
+            hist = monitor(hist, k + 1, phibar)
         return dict(k=k + 1, x=x, u=u, v=v, w=w, alfa=alfa,
-                    rhobar=rhobar, phibar=phibar, brk=brk)
+                    rhobar=rhobar, phibar=phibar, brk=brk, hist=hist)
 
     st0 = dict(k=jnp.int32(0), x=x0, u=u, v=v, w=w, alfa=alfa,
-               rhobar=alfa, phibar=beta, brk=beta <= -1.0)
+               rhobar=alfa, phibar=beta, brk=beta <= -1.0, hist=hist)
     st = lax.while_loop(cond, body, st0)
     rn_true = pnorm(b - A(st["x"]))
     return (st["x"], st["k"], rn_true,
             _reason(st["phibar"], tol, atol, st["k"], maxit, st["brk"],
-                    dmax))
+                    dmax), st["hist"])
 
 
 def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1032,14 +1115,14 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     rho = pdot(rt, z)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def cond(st):
-        k, x, r, rt, p, pt, rho, rn, brk = st
+        k, x, r, rt, p, pt, rho, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, rt, p, pt, rho, rn, brk = st
+        k, x, r, rt, p, pt, rho, rn, brk, hist = st
         q = A(p)
         qt = At(pt)
         pq = pdot(pt, q)
@@ -1057,12 +1140,14 @@ def bicg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         pt = zt + jnp.conj(beta) * pt
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, rt, p, pt, rho_new, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, rt, p, pt, rho_new, rn, brk, hist)
 
-    st0 = (jnp.int32(0), x0, r, rt, p, pt, rho, rnorm, rnorm <= -1.0)
-    k, x, r, rt, p, pt, rho, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    st0 = (jnp.int32(0), x0, r, rt, p, pt, rho, rnorm, rnorm <= -1.0, hist)
+    k, x, r, rt, p, pt, rho, rnorm, brk, hist = lax.while_loop(cond, body,
+                                                               st0)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1079,16 +1164,16 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     r = b - A(x0)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
     V = jnp.zeros((m,) + b.shape, b.dtype)
     Z = jnp.zeros_like(V)
 
     def cond(st):
-        k, slot, x, r, V, Z, rn, brk = st
+        k, slot, x, r, V, Z, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, slot, x, r, V, Z, rn, brk = st
+        k, slot, x, r, V, Z, rn, brk, hist = st
         wiped = (slot != 0).astype(b.dtype)
         V = V * wiped            # restart boundary: clear the direction set
         Z = Z * wiped
@@ -1112,12 +1197,14 @@ def gcr_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         Z = Z.at[slot].set(z)
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, (slot + 1) % m, x, r, V, Z, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, (slot + 1) % m, x, r, V, Z, rn, brk, hist)
 
-    st0 = (jnp.int32(0), jnp.int32(0), x0, r, V, Z, rnorm, rnorm <= -1.0)
-    k, slot, x, r, V, Z, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    st0 = (jnp.int32(0), jnp.int32(0), x0, r, V, Z, rnorm, rnorm <= -1.0,
+           hist)
+    k, slot, x, r, V, Z, rnorm, brk, hist = lax.while_loop(cond, body, st0)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1137,14 +1224,14 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     gamma = pdot(s, z)
     rnorm = pnorm(r)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
 
     def cond(st):
-        k, x, r, p, gamma, rn, brk = st
+        k, x, r, p, gamma, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, x, r, p, gamma, rn, brk = st
+        k, x, r, p, gamma, rn, brk, hist = st
         q = A(p)
         qq = pdot(q, q)
         brk = qq == 0
@@ -1159,12 +1246,13 @@ def cgne_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         p = z + beta * p
         rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, x, r, p, gamma_new, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, x, r, p, gamma_new, rn, brk, hist)
 
-    st0 = (jnp.int32(0), x0, r, p, gamma, rnorm, rnorm <= -1.0)
-    k, x, r, p, gamma, rnorm, brk = lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    st0 = (jnp.int32(0), x0, r, p, gamma, rnorm, rnorm <= -1.0, hist)
+    k, x, r, p, gamma, rnorm, brk, hist = lax.while_loop(cond, body, st0)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1185,7 +1273,7 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     r0 = b - A(x0)
     rnorm0 = pnorm(r0)
     dmax = _dmax(rnorm0, dtol)
-    _mon0(monitor, rnorm0)
+    hist = _mon0(monitor, rnorm0, b.dtype)
 
     y = M(r0)
     beta1sq = jnp.real(pdot(r0, y))
@@ -1246,19 +1334,20 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
         gbar_safe = jnp.where(gbar == 0, jnp.asarray(1e-30, dt), gbar)
         cgnorm = qrnorm * beta_new / jnp.abs(gbar_safe)
         rn = cgnorm * scale
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + 1, rn)
+            hist = monitor(hist, k + 1, rn)
         return dict(k=k + 1, x=x, w=w, r1=r1, r2=r2, y=y_new,
                     oldb=oldb, beta=beta_new, gbar=gbar, dbar=dbar,
                     rhs1=rhs1, rhs2=rhs2, snprod=snprod, bstep=bstep,
-                    rn=rn, brk=brk)
+                    rn=rn, brk=brk, hist=hist)
 
     zero = jnp.zeros_like(b)
     st0 = dict(k=jnp.int32(0), x=zero, w=zero, r1=r0, r2=r2, y=y3,
                oldb=beta1, beta=beta, gbar=alfa, dbar=beta,
                rhs1=beta1, rhs2=jnp.asarray(0.0, dt),
                snprod=jnp.asarray(1.0, dt), bstep=jnp.asarray(0.0, dt),
-               rn=rnorm0, brk=(beta1sq < 0) | (betasq < 0))
+               rn=rnorm0, brk=(beta1sq < 0) | (betasq < 0), hist=hist)
     st = lax.while_loop(cond, body, st0)
     # transfer LQ point -> CG point, then add the component along v1 —
     # only if the loop actually iterated (the transfer IS one CG step; an
@@ -1271,7 +1360,8 @@ def symmlq_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     x = x0 + jnp.where(st["k"] > 0, xc, jnp.zeros_like(b))
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(rn_true, tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -1301,17 +1391,17 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         rnorm = pnorm(r)
         brk0 = rnorm <= -1.0
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist = _mon0(monitor, rnorm, b.dtype)
     Pbuf = jnp.zeros((m,) + b.shape, b.dtype)
     APbuf = jnp.zeros_like(Pbuf)
     eta = jnp.zeros(m, b.dtype)
 
     def cond(st):
-        k, slot, x, r, z, Pb, APb, eta, rn, brk = st
+        k, slot, x, r, z, Pb, APb, eta, rn, brk, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
 
     def body(st):
-        k, slot, x, r, z, Pb, APb, eta, rn, brk = st
+        k, slot, x, r, z, Pb, APb, eta, rn, brk, hist = st
         if not natural:
             z = M(r)       # default mode applies M at the top; natural
                            # mode carries the end-of-body z (same count)
@@ -1336,14 +1426,16 @@ def fcg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         else:
             rn = pnorm(r)
         if monitor is not None:
-            monitor(k + 1, rn)
-        return (k + 1, (slot + 1) % m, x, r, z, Pb, APb, eta, rn, brk)
+            hist = monitor(hist, k + 1, rn)
+        return (k + 1, (slot + 1) % m, x, r, z, Pb, APb, eta, rn, brk,
+                hist)
 
     st0 = (jnp.int32(0), jnp.int32(0), x0, r, z0, Pbuf, APbuf, eta,
-           rnorm, brk0)
-    k, slot, x, r, z0, Pbuf, APbuf, eta, rnorm, brk = \
+           rnorm, brk0, hist)
+    k, slot, x, r, z0, Pbuf, APbuf, eta, rnorm, brk, hist = \
         lax.while_loop(cond, body, st0)
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist)
 
 
 def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -1369,11 +1461,11 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     tol = jnp.maximum(rtol * bnorm, atol)
     rnorm0 = pnorm(M(b - A(x0)))
     dmax = _dmax(rnorm0, dtol)
-    _mon0(monitor, rnorm0)
+    hist0 = _mon0(monitor, rnorm0, b.dtype)
     Z0 = jnp.zeros((aug, lsize), b.dtype)
 
     def cycle(st):
-        k, x, Z, rn = st
+        k, x, Z, rn, hist = st
         r = M(b - A(x))
         beta = pnorm(r)
         V = jnp.zeros((s + 1, lsize), b.dtype)
@@ -1404,17 +1496,17 @@ def lgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         Z = jnp.roll(Z, 1, axis=0).at[0].set(znew)
         rn = pnorm(M(b - A(x)))
         if monitor is not None:
-            monitor(k + s, rn)
-        return (k + s, x, Z, rn)
+            hist = monitor(hist, k + s, rn)
+        return (k + s, x, Z, rn, hist)
 
     def cond(st):
-        k, x, Z, rn = st
+        k, x, Z, rn, hist = st
         return (rn > tol) & (rn < dmax) & (k < maxit)
 
-    k, x, Z, rnorm = lax.while_loop(
-        cond, cycle, (jnp.int32(0), x0, Z0, rnorm0))
-    return x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0,
-                                dmax)
+    k, x, Z, rnorm, hist = lax.while_loop(
+        cond, cycle, (jnp.int32(0), x0, Z0, rnorm0, hist0))
+    return (x, k, rnorm,
+            _reason(rnorm, tol, atol, k, maxit, rnorm <= -1.0, dmax), hist)
 
 
 def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -1437,7 +1529,7 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     rtilde = r0
     rnorm = pnorm(r0)
     dmax = _dmax(rnorm, dtol)
-    _mon0(monitor, rnorm)
+    hist0 = _mon0(monitor, rnorm, b.dtype)
     dt = b.dtype
     Rb = jnp.zeros((L + 1,) + b.shape, dt).at[0].set(r0)
     Ub = jnp.zeros_like(Rb)
@@ -1507,19 +1599,22 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
         # do the same via alpha = where(brk, 0, ...)
         y = jnp.where(brk, st["y"], y)
         rn = jnp.where(brk, st["rn"], pnorm(R[0]))
+        hist = st["hist"]
         if monitor is not None:
-            monitor(k + L, rn)
+            hist = monitor(hist, k + L, rn)
         return dict(k=k + L, y=y, R=R, U=U, rho0=rho0, alpha=alpha,
-                    omega=omega, rn=rn, brk=brk)
+                    omega=omega, rn=rn, brk=brk, hist=hist)
 
     st0 = dict(k=jnp.int32(0), y=jnp.zeros_like(b), R=Rb, U=Ub,
                rho0=jnp.asarray(1.0, dt), alpha=jnp.asarray(0.0, dt),
-               omega=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0)
+               omega=jnp.asarray(1.0, dt), rn=rnorm, brk=rnorm <= -1.0,
+               hist=hist0)
     st = lax.while_loop(cond, body, st0)
     x = x0 + M(st["y"])
     rn_true = pnorm(b - A(x))
     return (x, st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax))
+            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax),
+            st["hist"])
 
 
 KSP_KERNELS = {
@@ -1566,23 +1661,6 @@ NATURAL_TYPES = ("cg", "fcg", "cr")
 
 _PROGRAM_CACHE: dict = {}
 
-# Monitor dispatch: compiled programs with monitoring enabled call a stable
-# trampoline that reads this cell, so cached programs pick up whichever
-# monitor the *current* solve installed (programs are cached per mesh/type/
-# shape key and outlive any one KSP object). Set via set_current_monitor()
-# around a solve; solves are single-controller-sequential so a cell is safe.
-_CURRENT_MONITOR = [None]
-
-
-def set_current_monitor(cb):
-    _CURRENT_MONITOR[0] = cb
-
-
-def _monitor_trampoline(dev, k, rn):
-    cb = _CURRENT_MONITOR[0]
-    if cb is not None:
-        cb(dev, k, rn)
-
 
 # kernels supporting masked multi-step unrolling per while_loop iteration
 _UNROLLABLE = ("cg",)
@@ -1599,13 +1677,21 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
                       zero_guess: bool = False, nullspace_dim: int = 0,
                       aug: int = 2, ell: int = 2, unroll: int = 1,
-                      natural: bool = False):
+                      natural: bool = False, hist_cap: int = 0):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
 
-        x, iters, rnorm, reason = prog(op_arrays, pc_arrays, b, x0,
-                                       rtol, atol, dtol, maxit)
+        x, iters, rnorm, reason, hist = prog(op_arrays, pc_arrays, b, x0,
+                                             rtol, atol, dtol, maxit)
+
+    ``hist`` is the in-program residual history: a NaN-initialized
+    (_HIST_CAP,) buffer whose slot k holds the iteration-k monitored norm
+    (zero-size when ``monitored=False``). The caller fetches it once after
+    the solve and replays the non-NaN entries to user monitors — no host
+    callbacks exist in the program, so monitoring works on runtimes
+    without callback support (this TPU tunnel) and costs no in-loop
+    host round trips anywhere.
 
     With ``nullspace_dim > 0`` an extra leading argument carries the
     row-sharded (k, n_pad) orthonormal null-space basis::
@@ -1621,9 +1707,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     ``operator`` is anything implementing the linear-operator protocol (see
     core.mat.Mat and models.stencil): ``shape``, ``dtype``,
     ``device_arrays()``, ``local_spmv(comm)``, ``op_specs(axis)`` and
-    ``program_key()``. With ``monitored=True`` the program reports
-    per-iteration residuals to the monitor installed by
-    :func:`set_current_monitor`.
+    ``program_key()``.
     """
     axis = comm.axis
     n = operator.shape[0]
@@ -1640,9 +1724,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     unroll_k = (max(1, int(unroll))
                 if ksp_type in _UNROLLABLE and not monitored else 1)
     natural_k = bool(natural) and ksp_type in NATURAL_TYPES
+    cap_k = int(hist_cap) if monitored else 0
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
-           nullspace_dim, aug_k, ell_k, unroll_k, natural_k)
+           nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1692,14 +1777,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         spmv_t_local = operator.local_spmv_t(comm)
     op_specs = operator.op_specs(axis)
 
-    monitor = None
-    if monitored:
-        # unordered callbacks (ordered effects are single-device-only); the
-        # KSP solve buffers the (k, rn) reports and dispatches them sorted
-        # by k after the program completes, so delivery order is irrelevant
-        def monitor(k, rn):
-            jax.debug.callback(_monitor_trampoline, lax.axis_index(axis),
-                               k, rn)
+    # functional in-program recorder (no host callbacks — see _HistMonitor)
+    monitor = (_HistMonitor(dtype, cap_k or hist_capacity(10000, restart))
+               if monitored else None)
 
     def make_body(project):
         def body(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
@@ -1792,7 +1872,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
         in_specs = (op_specs, pc.in_specs(axis),
                     P(axis), P(axis), P(), P(), P(), P())
-    out_specs = (P(axis), P(), P(), P())
+    # the history buffer rides as a 5th (replicated) output — every device
+    # writes identical psum'd norms into it
+    out_specs = (P(axis), P(), P(), P(), P())
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
     _PROGRAM_CACHE[key] = prog
     return prog

@@ -29,8 +29,7 @@ from ..parallel.mesh import as_comm
 from ..utils.convergence import ConvergedReason, SolveResult
 from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
-from .krylov import (KSP_KERNELS, NATURAL_TYPES, build_ksp_program,
-                     set_current_monitor)
+from .krylov import KSP_KERNELS, NATURAL_TYPES, build_ksp_program
 from .pc import PC
 
 DEFAULT_RTOL = 1e-5   # PETSc's KSP default
@@ -342,10 +341,10 @@ class KSP:
         if norm_none:
             rtol, atol, divtol = 0.0, 0.0, 0.0
 
-        monitor_cb = None
-        monitor_buf = []
+        monitors = None
         history_on = hasattr(self, "_history")
-        if self._monitors or self._monitor_flag or history_on:
+        monitored = bool(self._monitors or self._monitor_flag or history_on)
+        if monitored:
             monitors = list(self._monitors)
             if self._monitor_flag and not self._monitors:
                 monitors.append(
@@ -357,27 +356,22 @@ class KSP:
                         self._history.append(float(rn))
                 monitors.append(record)
 
-            # the in-program reports arrive as UNORDERED debug callbacks
-            # (ordered effects are single-device-only); buffer them and
-            # dispatch sorted by iteration after the program completes, so
-            # async delivery can never hand history[0] a later residual
-            def monitor_cb(dev, k, rn):
-                if int(dev) == 0:
-                    monitor_buf.append((int(k), float(rn)))
-
         nullspace = getattr(mat, "nullspace", None)
         if nullspace is not None and nullspace.dim == 0:
             nullspace = None        # empty null space: nothing to project
+        from .krylov import hist_capacity
         prog = build_ksp_program(comm, self._type, pc, mat,
                                  restart=self.restart,
-                                 monitored=monitor_cb is not None,
+                                 monitored=monitored,
                                  zero_guess=not self._initial_guess_nonzero,
                                  nullspace_dim=(nullspace.dim if nullspace
                                                 else 0),
                                  aug=self.lgmres_augment,
                                  ell=self.bcgsl_ell,
                                  unroll=self.unroll,
-                                 natural=self._norm_type == "natural")
+                                 natural=self._norm_type == "natural",
+                                 hist_cap=hist_capacity(self.max_it,
+                                                        self.restart))
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the
@@ -386,26 +380,32 @@ class KSP:
         dt = np.dtype(op_dt.type(0).real.dtype)
         ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
-        set_current_monitor(monitor_cb)
         t0 = time.perf_counter()
-        try:
-            xd, iters, rnorm, reason = prog(
-                mat.device_arrays(), pc.device_arrays(), *ns_args,
-                b.data, x.data,
-                dt.type(rtol), dt.type(atol),
-                dt.type(divtol), np.int32(self.max_it))
-            # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
-            # int()/float() per scalar would pay it three times)
+        xd, iters, rnorm, reason, hist = prog(
+            mat.device_arrays(), pc.device_arrays(), *ns_args,
+            b.data, x.data,
+            dt.type(rtol), dt.type(atol),
+            dt.type(divtol), np.int32(self.max_it))
+        # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
+        # int()/float() per scalar would pay it three times). The residual
+        # history is an in-program buffer (no host callbacks — works on
+        # runtimes without callback support); fetch it in the same batch
+        # and replay the recorded entries, in order, to the user monitors.
+        if monitored:
+            iters, rnorm, reason, hist = jax.device_get(
+                (iters, rnorm, reason, hist))
+        else:
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
-            from ..utils.profiling import record_sync
-            record_sync("KSP result fetch/solve")
-            if monitor_cb is not None:
-                jax.effects_barrier()     # all callbacks delivered
-                for k_it, k_rn in sorted(monitor_buf, key=lambda t: t[0]):
-                    for m in monitors:
-                        m(self, k_it, k_rn)
-        finally:
-            set_current_monitor(None)
+        from ..utils.profiling import record_sync
+        record_sync("KSP result fetch/solve")
+        if monitored:
+            # -1 is the unwritten sentinel (norms are nonnegative); a
+            # recorded NaN residual passes `!= -1` and reaches the
+            # monitors, as the callback path used to deliver it
+            hist = np.asarray(hist)
+            for k_it in np.nonzero(hist != -1.0)[0]:
+                for m in monitors:
+                    m(self, int(k_it), float(hist[k_it]))
         wall = time.perf_counter() - t0
         x.data = xd
         # breakdown stays visible (PETSc's NORM_NONE does not mask it);

@@ -31,10 +31,10 @@ class DeviceExecutionError(RuntimeError):
                 "fp32/bf16, or the matrix-free stencil path")
         if "host send/recv callbacks" in msg or "debug.callback" in msg:
             hints.append(
-                "this runtime does not support in-program host callbacks — "
-                "-ksp_monitor and set_convergence_history need a "
-                "callback-capable runtime (the CPU mesh has one); run the "
-                "solve without monitors here")
+                "this runtime does not support in-program host callbacks "
+                "(jax.debug.callback/io_callback) — the framework's own "
+                "monitors use an in-program history buffer instead, so "
+                "this came from user code; remove the callback")
         if "LuDecomposition" in msg or "not implemented" in msg.lower():
             hints.append(
                 "an op is unsupported on this backend/dtype — direct "

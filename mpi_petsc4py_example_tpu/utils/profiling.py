@@ -4,8 +4,9 @@ The reference reaches PETSc's ``-log_view`` / ``-ksp_monitor`` machinery
 through the options DB [external]; equivalents here:
 
 * per-iteration residual monitors — ``KSP.set_monitor`` / ``-ksp_monitor``
-  (solvers/ksp.py), driven by ``jax.debug.callback`` from inside the
-  compiled loop;
+  (solvers/ksp.py), recorded into an in-program history buffer threaded
+  through the compiled loop (no host callbacks — works on every runtime)
+  and replayed to the user callbacks after the solve;
 * a solve-event log — every KSP/EPS solve records (solver, n, iterations,
   wall, reason); ``log_view()`` prints the PETSc-``-log_view``-style summary,
   automatically at exit when ``-log_view`` is set;
