@@ -27,6 +27,32 @@ from ..parallel.mesh import DeviceComm, as_comm
 from ..parallel.partition import RowLayout
 
 
+def make_plane_exchange(axis, ndev: int):
+    """Boundary z-plane halo exchange along the slab ring.
+
+    ``exchange(u (lz,ny,nx)) -> (halo_lo, halo_hi)``: one ``lax.ppermute``
+    ring shift each way, with zero planes at the global Dirichlet
+    boundaries (the reference's VecScatter ghost update [external,
+    PETSc MatMult] reduced to its structured-grid minimum). The single
+    definition of this boundary logic — used by the stencil SpMV, the
+    fused CG matvec+dot, and every level of the multigrid V-cycle
+    (solvers/mg.py)."""
+
+    def exchange(u):
+        up = lax.ppermute(u[-1], axis,
+                          perm=[(i, (i + 1) % ndev) for i in range(ndev)])
+        down = lax.ppermute(u[0], axis,
+                            perm=[(i, (i - 1) % ndev) for i in range(ndev)])
+        i = lax.axis_index(axis)
+        zero_plane = jnp.zeros_like(up)
+        # Dirichlet: the global boundary receives no wrap-around halo
+        halo_lo = jnp.where(i == 0, zero_plane, up)        # plane z-1
+        halo_hi = jnp.where(i == ndev - 1, zero_plane, down)  # plane z+lz
+        return halo_lo, halo_hi
+
+    return exchange
+
+
 class StencilPoisson3D:
     """7-point 3D Poisson (Dirichlet) as a matrix-free sharded operator.
 
@@ -65,26 +91,11 @@ class StencilPoisson3D:
         return ("stencil3d", self.nx, self.ny, self.nz, self.comm.size)
 
     def _halo_exchange(self, comm: DeviceComm):
-        """Local ``u (lz,ny,nx) -> (halo_lo, halo_hi)``: ring exchange of the
-        boundary z-planes (one ``lax.ppermute`` each way), with zero planes at
-        the global Dirichlet boundaries. Shared by the plain SpMV and the
-        fused CG matvec+dot so the boundary logic exists exactly once."""
-        axis = comm.axis
-        ndev = comm.size
-
-        def exchange(u):
-            up = lax.ppermute(u[-1], axis,
-                              perm=[(i, (i + 1) % ndev) for i in range(ndev)])
-            down = lax.ppermute(u[0], axis,
-                                perm=[(i, (i - 1) % ndev) for i in range(ndev)])
-            i = lax.axis_index(axis)
-            zero_plane = jnp.zeros_like(up)
-            # Dirichlet: the global boundary receives no wrap-around halo
-            halo_lo = jnp.where(i == 0, zero_plane, up)        # plane z-1
-            halo_hi = jnp.where(i == ndev - 1, zero_plane, down)  # plane z+lz
-            return halo_lo, halo_hi
-
-        return exchange
+        """Local ``u (lz,ny,nx) -> (halo_lo, halo_hi)`` — see
+        :func:`make_plane_exchange` (shared by the plain SpMV, the fused CG
+        matvec+dot and the multigrid V-cycle so the boundary logic exists
+        exactly once)."""
+        return make_plane_exchange(comm.axis, comm.size)
 
     @staticmethod
     def _stencil7_jnp(u, halo_lo, halo_hi):

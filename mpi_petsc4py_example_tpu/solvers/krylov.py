@@ -1685,10 +1685,12 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         x, iters, rnorm, reason, hist = prog(op_arrays, pc_arrays, b, x0,
                                              rtol, atol, dtol, maxit)
 
-    ``hist`` is the in-program residual history: a NaN-initialized
-    (_HIST_CAP,) buffer whose slot k holds the iteration-k monitored norm
-    (zero-size when ``monitored=False``). The caller fetches it once after
-    the solve and replays the non-NaN entries to user monitors — no host
+    ``hist`` is the in-program residual history: a (-1)-initialized
+    (hist_cap,) buffer whose slot k holds the iteration-k monitored norm
+    (zero-size when ``monitored=False``); -1 is the never-written sentinel
+    because norms are nonnegative while NaN (a blown-up residual) must be
+    recordable (see _HistMonitor). The caller fetches it once after the
+    solve and replays the ``hist != -1`` entries to user monitors — no host
     callbacks exist in the program, so monitoring works on runtimes
     without callback support (this TPU tunnel) and costs no in-loop
     host round trips anywhere.

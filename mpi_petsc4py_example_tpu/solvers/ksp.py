@@ -370,8 +370,11 @@ class KSP:
                                  ell=self.bcgsl_ell,
                                  unroll=self.unroll,
                                  natural=self._norm_type == "natural",
-                                 hist_cap=hist_capacity(self.max_it,
-                                                        self.restart))
+                                 hist_cap=hist_capacity(
+                                     self.max_it,
+                                     # bcgsl records at k+ell, so cover the
+                                     # larger of the cycle-granular strides
+                                     max(self.restart, self.bcgsl_ell)))
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the

@@ -1,48 +1,155 @@
 """Geometric multigrid V-cycle preconditioner for structured Poisson.
 
 Beyond-parity performance component (the reference's PETSc stack exposes
-PCMG/GAMG the same way): a matrix-free V-cycle on the 7-point 3D Poisson
-operator, used as a preconditioner inside CG. Damped-Jacobi smoothing
-(ω = 2/3), full-coarsening by 2× per level, trilinear prolongation /
-restriction via ``jax.image.resize``. All static shapes — one fused XLA
-program per cycle.
+PCMG/GAMG the same way behind ``setFromOptions`` — /root/reference/test.py:46
+[external]): a matrix-free V-cycle on the 7-point 3D Poisson operator, used
+as a preconditioner inside CG. Damped-Jacobi smoothing (ω = 2/3), full
+coarsening by 2× per level.
 
-v1 applies the cycle on the *gathered* residual (replicated work across
-devices, local slice returned): optimal on one chip, acceptable to ~8 chips
-where SpMV savings dominate; a slab-decomposed cycle is the planned
-follow-up.
+Transfer operators (round 4 — replaces the round-3 ``jax.image.resize``
+pair, measured 50 CG its at 32³ where this scheme needs 11):
+
+* prolongation ``P``: per-axis linear interpolation on the cell-pair grid
+  with ZERO ghosts at the global boundary (Dirichlet-consistent — the
+  eliminated-boundary unit stencil behaves as a grid with zero ghost
+  values);
+* restriction ``R = (1/2)·Pᵀ`` (per-axis scale ``(4)^{1/3}/2``, so the
+  3-axis product carries the h²-ratio factor 4 of the residual equation
+  under the level-independent unit stencil).
+
+Because R ∝ Pᵀ and the pre/post smoothers are equal-count damped Jacobi,
+the V-cycle is a SYMMETRIC linear operator — a valid CG preconditioner
+(measured: 11/12/14 its at 32³/64³/128³, rtol 1e-8, vs 50+ for any
+non-adjoint pairing).
+
+Distribution (round 4 — replaces the round-3 gather-and-replicate cycle):
+the cycle runs z-slab-decomposed inside the same shard_map program as the
+Krylov loop. Every level keeps the slab decomposition while its local
+plane count stays even; smoothing, restriction and prolongation each touch
+only the two neighbouring boundary planes, exchanged with one
+``lax.ppermute`` ring shift each way — the stencil-SpMV halo pattern
+(models/stencil.py). Once the slab thins below two planes the remaining
+tiny levels are ``all_gather``-ed (≤ a few thousand entries), cycled
+locally, and the local slab of the correction sliced back. Slab and
+replicated cycles compute the SAME arithmetic, so solves are
+device-count-independent (tests/test_mg_slab.py asserts this).
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
+from jax import lax
+
+_OMEGA = 2.0 / 3.0
+# one axis of R = (1/2)·Pᵀ: the 3-axis product must scale the restricted
+# residual by 4 (= h_c²/h_f² under the level-independent unit stencil) on
+# top of the weight-2-per-axis adjoint, i.e. (2s)³ = 4
+_RSCALE = 4.0 ** (1.0 / 3.0) / 2.0
 
 
-def _apply_poisson(u):
-    """7-point Dirichlet Laplacian on a (nz, ny, nx) grid."""
-    out = 6.0 * u
-    out = out.at[1:].add(-u[:-1]).at[:-1].add(-u[1:])
-    out = out.at[:, 1:].add(-u[:, :-1]).at[:, :-1].add(-u[:, 1:])
-    out = out.at[:, :, 1:].add(-u[:, :, :-1]).at[:, :, :-1].add(-u[:, :, 1:])
-    return out
+def _stencil7(u, halo_lo, halo_hi):
+    """7-point Dirichlet Laplacian on a z-slab with explicit z-halo planes
+    (single definition shared with the SpMV path)."""
+    from ..models.stencil import StencilPoisson3D
+    return StencilPoisson3D._stencil7_jnp(u, halo_lo, halo_hi)
 
 
-def _smooth(u, f, iters: int, omega: float = 2.0 / 3.0):
-    """Damped Jacobi sweeps for 6·u ≈ f + neighbors."""
-    def body(i, u):
-        r = f - _apply_poisson(u)
-        return u + (omega / 6.0) * r
-
-    return jax.lax.fori_loop(0, iters, body, u)
+def _zeros_plane(u):
+    return jnp.zeros_like(u[0])
 
 
-def _restrict(r, shape_c):
-    return jax.image.resize(r, shape_c, method="linear") * 4.0
+def _no_exchange(u):
+    """Replicated / single-device halo: zero planes (global Dirichlet)."""
+    z = _zeros_plane(u)
+    return z, z
 
 
-def _prolong(e, shape_f):
-    return jax.image.resize(e, shape_f, method="linear")
+def _mk_exchange(axis, ndev):
+    """Boundary-plane halo exchange along the z-slab ring — the single
+    shared definition (models/stencil.py), used here by smoothing,
+    restriction and prolongation at every level."""
+    if ndev == 1:
+        return _no_exchange
+    from ..models.stencil import make_plane_exchange
+    return make_plane_exchange(axis, ndev)
+
+
+def _smooth(u, f, iters: int, exchange, omega: float = _OMEGA):
+    """``iters`` damped-Jacobi sweeps for the unit 7-point stencil."""
+    if iters <= 0:
+        return u
+
+    def body(_, u):
+        lo, hi = exchange(u)
+        return u + (omega / 6.0) * (f - _stencil7(u, lo, hi))
+
+    return lax.fori_loop(0, iters, body, u)
+
+
+def _smooth0(f, iters: int, exchange, omega: float = _OMEGA):
+    """Sweeps from a ZERO initial guess: the first sweep is the closed form
+    ``u = (ω/6) f`` — no stencil apply, no halo exchange."""
+    if iters <= 0:
+        return jnp.zeros_like(f)
+    return _smooth((omega / 6.0) * f, f, iters - 1, exchange)
+
+
+def _r1d(f, ax: int, lo=None, hi=None):
+    """One axis of ``R = (1/2)·Pᵀ``::
+
+        coarse[i] = s·(0.75·(f[2i] + f[2i+1]) + 0.25·(f[2i-1] + f[2i+2]))
+
+    with zero ghosts; ``lo``/``hi`` (the neighbouring slabs' boundary
+    planes: f[-1] and f[2m]) override the ghosts in the sharded z pass."""
+    sh = f.shape
+    m = sh[ax] // 2
+    g = f.reshape(sh[:ax] + (m, 2) + sh[ax + 1:])
+    ev = jnp.take(g, 0, axis=ax + 1)          # f[2i]
+    od = jnp.take(g, 1, axis=ax + 1)          # f[2i+1]
+    if lo is None:
+        lo = jnp.zeros_like(jnp.take(od, 0, axis=ax))
+    if hi is None:
+        hi = jnp.zeros_like(lo)
+    odm = jnp.concatenate([jnp.expand_dims(lo, ax),
+                           lax.slice_in_dim(od, 0, m - 1, axis=ax)], axis=ax)
+    evp = jnp.concatenate([lax.slice_in_dim(ev, 1, m, axis=ax),
+                           jnp.expand_dims(hi, ax)], axis=ax)
+    return _RSCALE * (0.75 * (ev + od) + 0.25 * (odm + evp))
+
+
+def _p1d(c, ax: int, lo=None, hi=None):
+    """One axis of the linear prolongation ``P``::
+
+        fine[2i]   = 0.75·c[i] + 0.25·c[i-1]
+        fine[2i+1] = 0.75·c[i] + 0.25·c[i+1]
+
+    with zero ghosts; ``lo``/``hi`` are the neighbouring slabs' boundary
+    coarse planes in the sharded z pass."""
+    m = c.shape[ax]
+    if lo is None:
+        lo = jnp.zeros_like(jnp.take(c, 0, axis=ax))
+    if hi is None:
+        hi = jnp.zeros_like(lo)
+    cm = jnp.concatenate([jnp.expand_dims(lo, ax),
+                          lax.slice_in_dim(c, 0, m - 1, axis=ax)], axis=ax)
+    cp = jnp.concatenate([lax.slice_in_dim(c, 1, m, axis=ax),
+                          jnp.expand_dims(hi, ax)], axis=ax)
+    a = 0.75 * c + 0.25 * cm
+    b = 0.75 * c + 0.25 * cp
+    out = jnp.stack([a, b], axis=ax + 1)
+    sh = list(c.shape)
+    sh[ax] *= 2
+    return out.reshape(sh)
+
+
+def _restrict(r, lo=None, hi=None):
+    """Full 3-axis restriction; z first (the only axis needing halos)."""
+    return _r1d(_r1d(_r1d(r, 0, lo, hi), 1), 2)
+
+
+def _prolong(e, lo=None, hi=None):
+    """Full 3-axis prolongation; z first (the only axis needing halos)."""
+    return _p1d(_p1d(_p1d(e, 0, lo, hi), 1), 2)
 
 
 def mg_levels(nz: int, ny: int, nx: int, min_dim: int = 4):
@@ -54,27 +161,67 @@ def mg_levels(nz: int, ny: int, nx: int, min_dim: int = 4):
 
 
 def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
-                coarse_iters: int = 20):
-    """Return ``vcycle(r_flat) -> z_flat`` approximating A⁻¹ r.
+                coarse_iters: int = 20, axis=None, ndev: int = 1):
+    """Return ``vcycle(r_local_flat) -> z_local_flat`` approximating A⁻¹ r.
 
-    Pure jnp over static shapes; safe inside jit/shard_map.
+    Pure jnp over static shapes; safe inside jit/shard_map. With
+    ``ndev == 1`` the cycle is fully local; with ``ndev > 1`` it must run
+    inside shard_map over mesh axis ``axis`` and operates on the local
+    z-slab (``nz/ndev`` planes), slab-decomposed per the module docstring.
     """
     levels = mg_levels(nz, ny, nx)
 
-    def cycle(f, li: int):
-        shape = levels[li]
+    def local_cycle(f, li: int):
         if li == len(levels) - 1:
-            return _smooth(jnp.zeros(shape, f.dtype), f, coarse_iters)
-        u = _smooth(jnp.zeros(shape, f.dtype), f, pre)
-        r = f - _apply_poisson(u)
-        f_c = _restrict(r, levels[li + 1])
-        e_c = cycle(f_c, li + 1)
-        u = u + _prolong(e_c, shape)
-        return _smooth(u, f, post)
+            return _smooth0(f, coarse_iters, _no_exchange)
+        u = _smooth0(f, pre, _no_exchange)
+        lo, hi = _no_exchange(u)
+        r = f - _stencil7(u, lo, hi)
+        e_c = local_cycle(_restrict(r), li + 1)
+        u = u + _prolong(e_c)
+        return _smooth(u, f, post, _no_exchange)
+
+    if ndev == 1:
+        def vcycle(r_flat):
+            z = local_cycle(r_flat.reshape(nz, ny, nx), 0)
+            return z.reshape(-1)
+        return vcycle
+
+    if nz % ndev:
+        raise ValueError(f"slab V-cycle needs nz ({nz}) divisible by the "
+                         f"device count ({ndev})")
+    exchange = _mk_exchange(axis, ndev)
+
+    # slab-eligible prefix: levels whose local plane count is even, so the
+    # 2x z-coarsening never splits a plane pair across a device boundary;
+    # the first non-eligible level is the gather point for the tiny tail
+    split = 0
+    while (split < len(levels) - 1
+           and levels[split][0] % (2 * ndev) == 0):
+        split += 1
+
+    def slab_cycle(f, li: int):
+        if li == split:
+            # tail: gather the (tiny) coarse grid, cycle locally, slice the
+            # local slab of the correction back out
+            lzi = levels[li][0] // ndev
+            f_full = lax.all_gather(f, axis, tiled=True)
+            e_full = local_cycle(f_full, li)
+            i = lax.axis_index(axis)
+            return lax.dynamic_slice_in_dim(e_full, i * lzi, lzi, axis=0)
+        u = _smooth0(f, pre, exchange)
+        lo, hi = exchange(u)
+        r = f - _stencil7(u, lo, hi)
+        rlo, rhi = exchange(r)
+        e_c = slab_cycle(_restrict(r, rlo, rhi), li + 1)
+        elo, ehi = exchange(e_c)
+        u = u + _prolong(e_c, elo, ehi)
+        return _smooth(u, f, post, exchange)
+
+    lz = nz // ndev
 
     def vcycle(r_flat):
-        f = r_flat.reshape(nz, ny, nx)
-        z = cycle(f, 0)
+        z = slab_cycle(r_flat.reshape(lz, ny, nx), 0)
         return z.reshape(-1)
 
     return vcycle

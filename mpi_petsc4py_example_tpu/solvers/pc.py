@@ -511,16 +511,12 @@ class PC:
         if k == "mg":
             from .mg import make_vcycle
             op = self._mat
-            vcycle = make_vcycle(op.nz, op.ny, op.nx)
-
-            def apply(arrs, r):
-                # v1: cycle on the gathered residual (replicated), local slice
-                # back — stencil layouts have no padding (nz % ndev == 0)
-                r_full = lax.all_gather(r, axis, tiled=True)
-                z_full = vcycle(r_full)
-                i = lax.axis_index(axis)
-                return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
-            return apply
+            # z-slab-decomposed V-cycle: runs in the SAME shard_map program,
+            # halo planes ride ppermute rings (solvers/mg.py docstring);
+            # only the tiny coarse tail is gathered
+            vcycle = make_vcycle(op.nz, op.ny, op.nx, axis=axis,
+                                 ndev=comm.size)
+            return lambda arrs, r: vcycle(r)
         raise AssertionError(k)
 
     def local_apply_transpose(self, comm: DeviceComm, n: int):
@@ -533,7 +529,9 @@ class PC:
         their shipped explicit inverses ((B⁻¹)ᵀ = (Bᵀ)⁻¹ — one transposed
         batched matvec); composite-additive sums its children's transposes;
         shell uses the user's ``set_shell_apply_transpose`` function.
-        asm/mg/gamg/composite-multiplicative provide none, as does lu in
+        mg is symmetric by construction (R = (1/2)Pᵀ, equal pre/post
+        smoothing) so its forward apply is reused;
+        asm/gamg/composite-multiplicative provide none, as does lu in
         cyclic-reduction mode (the PCR sweeps factorize A, not Aᵀ; shipping
         a second factorization for the rare transpose user would double the
         replicated setup memory — recorded in PARITY.md).
@@ -543,6 +541,12 @@ class PC:
         lsize = comm.local_size(n)
         if k in ("none", "jacobi"):
             return self.local_apply(comm, n)      # diagonal: symmetric
+        if k == "mg":
+            # the V-cycle is a symmetric operator by construction
+            # (R = (1/2)Pᵀ + equal-count Jacobi smoothing, solvers/mg.py;
+            # tests/test_mg_slab.py::test_vcycle_is_symmetric) — the forward
+            # apply IS the transpose apply
+            return self.local_apply(comm, n)
         if k in ("crtri", "crband") and self._type == "cholesky":
             # cholesky's contract is a symmetric (complex: Hermitian)
             # operator. Real: M = M^T, the forward PCR apply IS the
@@ -587,7 +591,7 @@ class PC:
                     i += na
                 return z
             return apply_t
-        return None     # asm/mg/gamg/composite-multiplicative: no transpose
+        return None     # asm/gamg/composite-multiplicative: no transpose
 
     def __repr__(self):
         return f"PC(type={self._type!r}, factor={self._factor_solver_type!r})"
