@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Pass-level decomposition of the fused stencil-CG step (BASELINE.md).
+
+Methodology (round 3, now reproducible): each piece of the CG iteration is
+timed as an in-device ``fori_loop`` microbenchmark — the loop body is the
+piece under test, the program returns a scalar that depends on every carry
+(no DCE), and timing differences between two iteration counts isolate pure
+loop time (the delta method; D2H of the scalar forces completion, since
+``block_until_ready`` under-reports through the remote tunnel).
+
+Pieces:
+  adot     — the fused Pallas stencil+<p,Ap> kernel alone
+  chain    — the CG vector-update chain alone (x, r, ||r||², p)
+  composed — the full cg_stencil_kernel step (fixed-iteration KSP solve)
+
+Usage: python benchmarks/decompose_stencil.py [--n 512] [--iters 40]
+Prints one JSON line per piece with ms/iter and HBM passes/iter
+(one pass = n³·4 bytes at the 819 GB/s v5e roof).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+HBM_GBPS = 819.0
+
+
+def time_loop(prog, args, iters_lo, iters_hi, reps=3):
+    """Delta-method ms/iter of ``prog(*args, iters)``; D2H-forced sync."""
+    outs = []
+    for iters in (iters_lo, iters_hi):
+        prog(*args, iters)                    # warm/compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(prog(*args, iters))    # D2H forces completion
+            best = min(best, time.perf_counter() - t0)
+        outs.append(best)
+    return (outs[1] - outs[0]) / (iters_hi - iters_lo)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=40)
+    opts = ap.parse_args()
+    nx = opts.n
+    lo, hi = opts.iters // 4, opts.iters
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        _pick_chunk, pallas_supported, stencil3d_dot_pallas)
+
+    assert pallas_supported(nx, nx, jnp.float32), "needs the TPU kernel"
+    shape = (nx, nx, nx)
+    passes_bytes = nx ** 3 * 4
+    chunk, nchunks = _pick_chunk(nx, 4, nx, nx, None)
+    print(json.dumps({"n": nx, "chunk": chunk, "nchunks": nchunks}))
+
+    def report(name, per_s, note=""):
+        line = {"piece": name, "ms_per_iter": round(per_s * 1e3, 4),
+                "hbm_passes": round(per_s * HBM_GBPS * 1e9 / passes_bytes, 2)}
+        if note:
+            line["note"] = note
+        print(json.dumps(line))
+
+    z = jnp.zeros((1, nx, nx), jnp.float32)
+    u0 = jnp.full(shape, 1e-20, jnp.float32)
+
+    # ---- adot: the fused kernel alone (spectral radius < 12 keeps 1e-20
+    # seed finite for ~40 unscaled iterations) -----------------------------
+    @jax.jit
+    def adot_loop(u, iters):
+        def body(_, u):
+            y, d = stencil3d_dot_pallas(u, z, z, nx, nx, nx)
+            return y
+        u = jax.lax.fori_loop(0, iters, body, u)
+        return jnp.sum(u[0, 0, :8])
+
+    report("adot", time_loop(adot_loop, (u0,), lo, hi))
+
+    # ---- chain: the CG update chain alone (same arrays, fixed scalars;
+    # beta depends on rr so the reduction is live) -------------------------
+    @jax.jit
+    def chain_loop(x, r, p, y, iters):
+        def body(_, st):
+            x, r, p = st
+            alpha = jnp.float32(1e-3)
+            x = x + alpha * p
+            r = r - alpha * y
+            rr = jnp.sum(r * r)
+            beta = rr * jnp.float32(1e-30)
+            p = r * jnp.float32(1.0 / 6.0) + beta * p
+            return (x, r, p)
+        x, r, p = jax.lax.fori_loop(0, iters, body, (x, r, p))
+        return jnp.sum(x[0, 0, :8]) + jnp.sum(r[0, 0, :8]) + jnp.sum(p[0, 0, :8])
+
+    v = jnp.full(shape, 1e-6, jnp.float32)
+    report("chain", time_loop(chain_loop, (v, v, v, v), lo, hi))
+
+    # ---- composed: the production fixed-iteration CG solve ---------------
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+
+    import bench
+
+    comm = tps.DeviceComm()
+    op = StencilPoisson3D(comm, nx, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    b = rng.random(nx ** 3).astype(np.float32)
+
+    def make_fixed(max_it):
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_norm_type("none")
+        ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=max_it)
+        xv, bv = op.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, xv)
+        return ksp, xv, bv
+
+    pers = bench.delta_rate(make_fixed, reps=3, lo=lo, hi=hi,
+                            autoscale=False)
+    report("composed", float(np.median(pers)),
+           note="production cg_stencil_kernel via KSP")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,15 @@ Configs (BASELINE.json `configs`):
   3. KSPGMRES + PCJACOBI on 2D 5-point Poisson
   4. KSPBCGS + block-Jacobi on unsymmetric convection-diffusion
   5. 3D 7-point Poisson, row-sharded stencil across the device mesh
+     (CG+jacobi raced against CG+MG; the metric is time-to-rtol)
 
 CPU baselines use scipy (fp64) where a matching algorithm exists; scipy is
 the only CPU oracle available (SURVEY.md §4).
+
+Every iterative config runs with -ksp_true_residual_check on, so
+``rel_residual`` (the TRUE ||b - A x||/||b||, recomputed in fp64 on host)
+meets rtol and the per-config ``residual_parity`` field is a strict gate,
+not an eyeball (round-3 VERDICT item 5).
 """
 
 from __future__ import annotations
@@ -28,21 +34,22 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-import scipy.sparse.linalg as spla
-
 import mpi_petsc4py_example_tpu as tps
 from mpi_petsc4py_example_tpu.models import (
     StencilPoisson3D, convdiff2d, poisson2d_csr, poisson3d_csr,
-    poisson3d_ell, tridiag_family)
+    tridiag_family)
+
+RTOL = 1e-6
 
 
-def solve(comm, op, b, ksp_type, pc_type, rtol=1e-6, max_it=20000,
-          restart=30):
+def solve(comm, op, b, ksp_type, pc_type, rtol=RTOL, max_it=20000,
+          restart=30, true_check=True):
     ksp = tps.KSP().create(comm)
     ksp.set_operators(op)
     ksp.set_type(ksp_type)
     ksp.get_pc().set_type(pc_type)
     ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=max_it)
+    ksp.set_true_residual_check(true_check)
     ksp.restart = restart
     x, bv = op.get_vecs()
     bv.set_global(b)
@@ -52,6 +59,43 @@ def solve(comm, op, b, ksp_type, pc_type, rtol=1e-6, max_it=20000,
     res = ksp.solve(bv, x)
     wall = time.perf_counter() - t0
     return x.to_numpy(), res, wall
+
+
+def true_relres(A, x, b):
+    """fp64 host recomputation of ||b - A x|| / ||b||."""
+    b64 = np.asarray(b, dtype=np.float64)
+    r = b64 - A @ np.asarray(x, dtype=np.float64)
+    return float(np.linalg.norm(r) / np.linalg.norm(b64))
+
+
+def parity_fields(res, rres, cpu_iters=None, cpu_rres=None, rtol=RTOL):
+    """The per-config residual-parity block (round-3 VERDICT item 5).
+
+    ``residual_parity`` is strict: the TRUE relative residual meets rtol
+    (1.05 slack only for fp32 device-vs-fp64 host norm rounding), and the
+    CPU oracle — when one ran — met it too.
+    """
+    out = dict(iters=res.iterations,
+               rnorm_recurrence=float(res.residual_norm),
+               rel_residual=rres)
+    ok = rres <= rtol * 1.05
+    if cpu_iters is not None:
+        out["cpu_iters"] = int(cpu_iters)
+    if cpu_rres is not None:
+        out["cpu_rel_residual"] = float(cpu_rres)
+        ok = ok and cpu_rres <= rtol * 1.05
+    out["residual_parity"] = bool(ok and res.converged)
+    return out
+
+
+def _counting(fn, A, b, **kw):
+    """Run a scipy iterative solver with an iteration counter."""
+    iters = [0]
+    t0 = time.perf_counter()
+    x, info = fn(A, b.astype(np.float64), rtol=RTOL, atol=0.0,
+                 callback=lambda *_: iters.__setitem__(0, iters[0] + 1),
+                 **kw)
+    return x, iters[0], time.perf_counter() - t0
 
 
 def onchip_breakdown(comm, op, b, ksp_type, pc_type):
@@ -96,28 +140,40 @@ def manufactured(A, seed=0, dtype=np.float64):
 
 def config1(comm, quick):
     """AIJ Laplacian assembly + KSPCG, PCNONE."""
+    import scipy.sparse.linalg as spla
+
     nx = 24 if quick else 64
     t0 = time.perf_counter()
-    A = poisson3d_csr(nx)
+    A = poisson3d_csr(nx)                     # model build: scipy kron —
+    model_build = time.perf_counter() - t0    # not a framework cost
+    t0 = time.perf_counter()
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
-    assembly = time.perf_counter() - t0
+    assembly = time.perf_counter() - t0       # framework MatAssembly analog
     x_true, b = manufactured(A, dtype=np.float32)
     x, res, wall = solve(comm, M, b, "cg", "none")
-    t0 = time.perf_counter()
-    x_cpu, _ = spla.cg(A, b.astype(np.float64), rtol=1e-6, atol=0.0)
-    cpu = time.perf_counter() - t0
-    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
+    x_cpu, cpu_iters, cpu = _counting(spla.cg, A, b, maxiter=20000)
     out = dict(config="cfg1_aij_assembly_cg_none", n=nx ** 3,
-               assembly_s=round(assembly, 4), iters=res.iterations,
+               model_build_s=round(model_build, 4),
+               assembly_s=round(assembly, 4),
+               assembly_breakdown=M.assembly_breakdown,
                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
-               speedup=round(cpu / wall, 2), rel_residual=float(rres))
+               speedup=round(cpu / wall, 2),
+               speedup_incl_assembly=round(cpu / (wall + assembly), 2))
+    out.update(parity_fields(res, true_relres(A, x, b),
+                             cpu_iters, true_relres(A, x_cpu, b)))
     if not quick:
         out.update(onchip_breakdown(comm, M, b, "cg", "none"))
     return out
 
 
-def config2(quick):
-    """Multi-rank scatter + distributed solve: eigensolve driver, -n 4."""
+def config2(comm, quick):
+    """Multi-rank scatter + distributed solve: eigensolve driver, -n 4.
+
+    Reports both the fresh-subprocess end-to-end wall (dominated by the
+    measured ~4.6 s environment floor: interpreter+axon site, tunnel init,
+    compile-cache load — BASELINE.md cfg2 decomposition) and the
+    warm-process solver time ``warm_s`` (the flow the reference driver
+    repeats once interpreter+tunnel exist)."""
     env = dict(os.environ)
     cmd = [sys.executable, os.path.join(REPO, "tools", "tpurun.py"),
            "-n", "4", os.path.join(REPO, "examples", "eigensolve.py")]
@@ -126,47 +182,71 @@ def config2(quick):
                        timeout=900, cwd=REPO)
     wall = time.perf_counter() - t0
     ok = r.returncode == 0 and "Eigenvalue:" in r.stdout
+
+    # warm-process flow: the same tridiagonal HEP solve (largest magnitude,
+    # nev=1 — reference test2.py defaults), timed on its second run
+    CSR = tridiag_family(100)
+
+    def eig_once():
+        M = tps.Mat.from_scipy(comm, CSR)
+        eps = tps.EPS().create(comm)
+        eps.set_operators(M)
+        eps.set_problem_type("hep")
+        eps.solve()
+        assert eps.get_converged() >= 1
+        return float(eps.get_eigenvalue(0).real)
+
+    lam = eig_once()                          # warm-up / compile
+    t0 = time.perf_counter()
+    lam = eig_once()
+    warm = time.perf_counter() - t0
+    lam_np = np.linalg.eigvalsh(CSR.toarray())
+    lam_np = lam_np[np.argmax(np.abs(lam_np))]
+    eig_err = abs(lam - lam_np) / abs(lam_np)
     return dict(config="cfg2_multirank_scatter_eigensolve_n4", n=100,
-                wall_s=round(wall, 4), ok=bool(ok))
+                wall_s=round(wall, 4), warm_s=round(warm, 4),
+                eigenvalue_rel_err=float(eig_err),
+                residual_parity=bool(ok and eig_err <= 1e-8),
+                ok=bool(ok))
 
 
 def config3(comm, quick):
     """KSPGMRES + PCJACOBI on 2D 5-point Poisson."""
+    import scipy.sparse.linalg as spla
+
     nx = 48 if quick else 512
     A = poisson2d_csr(nx)
     x_true, b = manufactured(A, dtype=np.float32)
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
     x, res, wall = solve(comm, M, b, "gmres", "jacobi", max_it=40000)
-    t0 = time.perf_counter()
     Mj = spla.LinearOperator(A.shape, matvec=lambda v: v / A.diagonal())
-    x_cpu, _ = spla.gmres(A, b.astype(np.float64), rtol=1e-6, atol=0.0,
-                          restart=30, M=Mj)
-    cpu = time.perf_counter() - t0
-    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
-    return dict(config="cfg3_gmres_jacobi_poisson2d", n=nx * nx,
-                iters=res.iterations, wall_s=round(wall, 4),
-                cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
-                rel_residual=float(rres))
+    x_cpu, cpu_iters, cpu = _counting(spla.gmres, A, b, restart=30, M=Mj,
+                                      callback_type="pr_norm")
+    out = dict(config="cfg3_gmres_jacobi_poisson2d", n=nx * nx,
+               wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
+               speedup=round(cpu / wall, 2))
+    out.update(parity_fields(res, true_relres(A, x, b),
+                             cpu_iters, true_relres(A, x_cpu, b)))
+    return out
 
 
 def config4(comm, quick):
     """KSPBCGS + block-Jacobi on unsymmetric convection-diffusion."""
+    import scipy.sparse.linalg as spla
+
     nx = 40 if quick else 256
     A = convdiff2d(nx, beta=0.4)
     x_true, b = manufactured(A, dtype=np.float32)
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
     x, res, wall = solve(comm, M, b, "bcgs", "bjacobi")
-    t0 = time.perf_counter()
     ilu = spla.spilu(A.tocsc())
     Mi = spla.LinearOperator(A.shape, matvec=ilu.solve)
-    x_cpu, _ = spla.bicgstab(A, b.astype(np.float64), rtol=1e-6, atol=0.0,
-                             M=Mi)
-    cpu = time.perf_counter() - t0
-    rres = np.linalg.norm(b - A @ x.astype(np.float64)) / np.linalg.norm(b)
+    x_cpu, cpu_iters, cpu = _counting(spla.bicgstab, A, b, M=Mi)
     out = dict(config="cfg4_bcgs_bjacobi_convdiff", n=nx * nx,
-               iters=res.iterations, wall_s=round(wall, 4),
-               cpu_wall_s=round(cpu, 4), speedup=round(cpu / wall, 2),
-               rel_residual=float(rres))
+               wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
+               speedup=round(cpu / wall, 2))
+    out.update(parity_fields(res, true_relres(A, x, b),
+                             cpu_iters, true_relres(A, x_cpu, b)))
     if not quick:
         out.update(onchip_breakdown(comm, M, b, "bcgs", "bjacobi"))
     return out
@@ -177,13 +257,12 @@ def config5(comm, quick):
     stencil across the mesh.
 
     Default 512^3 = 134M DoF (>= the 100M target; a 128-multiple so the
-    fused Pallas stencil-CG fast path applies — 464^3 = 99.9M would fall
-    back to the jnp stencil). fp32 matrix-free: the CG state is ~6 vectors
-    x 537 MB ~= 3.2 GB HBM, well inside one v5e chip. Reports both the
-    end-to-end wall (includes the dev tunnel's fixed per-call latency) and
-    the on-chip per-iteration time via the delta method (two
-    fixed-iteration solves, same compiled program)."""
-    import jax
+    fused Pallas stencil-CG fast path applies). fp32 matrix-free. The
+    metric is time-to-rtol, so CG+jacobi is RACED against CG+MG (the slab
+    V-cycle, ~10 iterations) and the best wall is the config's number —
+    the round-3 VERDICT's top demand. Reports the end-to-end walls
+    (includes the dev tunnel's fixed per-call latency) and the on-chip
+    per-iteration time of the jacobi loop via the delta method."""
     import jax.numpy as jnp
 
     nx = 32 if quick else 512
@@ -195,10 +274,17 @@ def config5(comm, quick):
     rng = np.random.default_rng(5)
     x_true = rng.random(n).astype(np.float32)
     b = np.asarray(op.mult(tps.Vec.from_global(comm, x_true)).to_numpy())
-    x, res, wall = solve(comm, op, b, "cg", "jacobi")
-    # residual via the operator itself (no 134M-row scipy materialization)
-    r = b - np.asarray(op.mult(tps.Vec.from_global(comm, x)).to_numpy())
-    rres = float(np.linalg.norm(r) / np.linalg.norm(b))
+
+    def op_relres(x):
+        r = b - np.asarray(
+            op.mult(tps.Vec.from_global(comm, np.asarray(x))).to_numpy())
+        return float(np.linalg.norm(r) / np.linalg.norm(b))
+
+    x_j, res_j, wall_j = solve(comm, op, b, "cg", "jacobi")
+    rres_j = op_relres(x_j)
+    x_m, res_m, wall_m = solve(comm, op, b, "cg", "mg")
+    rres_m = op_relres(x_m)
+    best = min(wall_j, wall_m)
 
     # on-chip rate: the shared delta-method protocol (bench.delta_rate)
     from bench import delta_rate
@@ -218,12 +304,21 @@ def config5(comm, quick):
     pers = delta_rate(make_fixed, reps=3, lo=20,
                       hi=120 if quick else 320, autoscale=not quick)
     per = float(np.median(pers))
-    return dict(config="cfg5_poisson3d_sharded_stencil", n=n,
-                devices=ndev, iters=res.iterations, wall_s=round(wall, 4),
-                iters_per_s=round(res.iterations / wall, 1),
-                onchip_per_iter_ms=round(1e3 * per, 3),
-                onchip_iters_per_s=round(1.0 / per, 1) if per > 0 else 0.0,
-                rel_residual=rres)
+    res_best, rres_best = ((res_m, rres_m) if wall_m <= wall_j
+                           else (res_j, rres_j))
+    out = dict(config="cfg5_poisson3d_sharded_stencil", n=n,
+               devices=ndev, wall_s=round(best, 4),
+               e2e_jacobi_wall_s=round(wall_j, 4),
+               e2e_jacobi_iters=res_j.iterations,
+               rel_residual_jacobi=rres_j,
+               e2e_mg_wall_s=round(wall_m, 4),
+               e2e_mg_iters=res_m.iterations,
+               rel_residual_mg=rres_m,
+               iters_per_s=round(res_j.iterations / wall_j, 1),
+               onchip_per_iter_ms=round(1e3 * per, 3),
+               onchip_iters_per_s=round(1.0 / per, 1) if per > 0 else 0.0)
+    out.update(parity_fields(res_best, rres_best))
+    return out
 
 
 def main():
@@ -238,7 +333,7 @@ def main():
     results = {"platform": jax.devices()[0].platform,
                "devices": len(jax.devices()), "configs": []}
     for fn in (lambda: config1(comm, opts.quick),
-               lambda: config2(opts.quick),
+               lambda: config2(comm, opts.quick),
                lambda: config3(comm, opts.quick),
                lambda: config4(comm, opts.quick),
                lambda: config5(comm, opts.quick)):
@@ -248,6 +343,9 @@ def main():
             r = dict(config=fn.__name__, error=repr(e))
         results["configs"].append(r)
         print(json.dumps(r))
+    parities = [c.get("residual_parity") for c in results["configs"]]
+    results["residual_parity_all"] = bool(all(p is True for p in parities))
+    print(json.dumps({"residual_parity_all": results["residual_parity_all"]}))
     if opts.out:
         with open(opts.out, "w") as f:
             json.dump(results, f, indent=2)

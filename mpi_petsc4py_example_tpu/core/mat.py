@@ -78,9 +78,12 @@ class Mat:
         C++ toolkit (native/csrkit.cpp) when available — the role PETSc's C
         MatAssembly plays — with a vectorized-numpy fallback.
         """
+        import time as _time
+
         from ..utils import native
         comm = as_comm(comm)
         nrows, ncols = int(size[0]), int(size[1])
+        t0 = _time.perf_counter()
         indptr = np.asarray(csr[0], dtype=np.int64)
         indices = np.asarray(csr[1], dtype=np.int32)
         data = np.asarray(csr[2], dtype=dtype)
@@ -89,16 +92,20 @@ class Mat:
             reasons = {-1: "indptr[0] != 0", -2: "indptr not monotone",
                        -3: "indptr[-1] != nnz", -4: "column index out of range"}
             raise ValueError(f"malformed CSR: {reasons.get(err, err)}")
+        t1 = _time.perf_counter()
         if native.available() and len(data) > 1_000_000:
             cols, vals = native.csr_to_ell_native(indptr, indices, data)
             vals = vals.astype(dtype, copy=False)
         else:
             cols, vals = csr_to_ell(indptr, indices, data)
         K = cols.shape[1]
+        t2 = _time.perf_counter()
         m = cls(comm, (nrows, ncols), comm.put_rows(cols),
                 comm.put_rows(vals), host_csr=(indptr, indices, data))
+        t3 = _time.perf_counter()
         # auto-select the DIA layout for banded square matrices: same-order
         # storage as ELL but gather-free SpMV (shifted slices)
+        t_dia = 0.0
         if nrows == ncols:
             offsets = csr_find_diagonals(indptr, indices,
                                          max_diags=max(2 * K, 8))
@@ -108,7 +115,16 @@ class Mat:
                 dia = csr_to_dia(indptr, indices, data, nrows, offsets)
                 m.dia_vals = comm.put_rows(dia)
                 m.dia_offsets = tuple(int(o) for o in offsets)
+            t_dia = _time.perf_counter() - t3
         m._assembled = True
+        # where MatAssembly time goes (BASELINE cfg1 asks): validate /
+        # ELL conversion / ELL device placement / DIA detect+convert+place
+        m.assembly_breakdown = {
+            "validate_s": round(t1 - t0, 4),
+            "ell_convert_s": round(t2 - t1, 4),
+            "ell_device_put_s": round(t3 - t2, 4),
+            "dia_s": round(t_dia, 4),
+        }
         return m
 
     @classmethod

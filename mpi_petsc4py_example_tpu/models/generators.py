@@ -11,11 +11,15 @@
 from __future__ import annotations
 
 import numpy as np
-import scipy.sparse as sp
+
+# scipy.sparse is imported inside each builder: it costs ~0.5 s of driver
+# start-up (BASELINE.md cfg2 floor decomposition) and the drivers that never
+# touch a CSR oracle shouldn't pay it
 
 
 def random_system(n: int = 100, seed: int = 42, density: float = 0.1):
     """Seeded random CSR system with manufactured solution: A, X, B=A·X."""
+    import scipy.sparse as sp
     rng = np.random.default_rng(seed=seed)
     A = sp.random(n, n, density=density, format="csr", dtype=np.float64,
                   random_state=rng)
@@ -24,8 +28,9 @@ def random_system(n: int = 100, seed: int = 42, density: float = 0.1):
     return A, X, B
 
 
-def tridiag_family(n: int = 100) -> sp.csr_matrix:
+def tridiag_family(n: int = 100) -> "sp.csr_matrix":
     """Symmetric tridiagonal matrix with A[i,j] = i+j+1 on the band."""
+    import scipy.sparse as sp
     i = np.arange(n)
     main = 2.0 * i + 1.0
     off = i[:-1] + i[1:] + 1.0
@@ -33,12 +38,13 @@ def tridiag_family(n: int = 100) -> sp.csr_matrix:
 
 
 def convdiff2d(nx: int, ny: int | None = None,
-               beta: float = 0.3) -> sp.csr_matrix:
+               beta: float = 0.3) -> "sp.csr_matrix":
     """2D convection-diffusion: 5-point Laplacian + first-order convection.
 
     ``beta`` is the convection strength (cell Péclet/2); nonzero beta makes
     the operator unsymmetric, exercising GMRES/BiCGStab.
     """
+    import scipy.sparse as sp
     ny = ny or nx
     n = nx * ny
     idx = np.arange(n)

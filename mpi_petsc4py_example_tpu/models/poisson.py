@@ -14,18 +14,19 @@ stencil operator (models/stencil.py) shares.
 from __future__ import annotations
 
 import numpy as np
-import scipy.sparse as sp
 
 from ..core.mat import Mat
 from ..parallel.mesh import as_comm
 
 
-def poisson1d_csr(n: int) -> sp.csr_matrix:
+def poisson1d_csr(n: int) -> "sp.csr_matrix":
+    import scipy.sparse as sp   # deferred: ~0.5 s of driver start-up
     return sp.diags([-np.ones(n - 1), 2.0 * np.ones(n), -np.ones(n - 1)],
                     [-1, 0, 1], format="csr")
 
 
-def poisson2d_csr(nx: int, ny: int | None = None) -> sp.csr_matrix:
+def poisson2d_csr(nx: int, ny: int | None = None) -> "sp.csr_matrix":
+    import scipy.sparse as sp
     ny = ny or nx
     Tx, Ty = poisson1d_csr(nx), poisson1d_csr(ny)
     Ix, Iy = sp.eye(nx), sp.eye(ny)
@@ -33,7 +34,8 @@ def poisson2d_csr(nx: int, ny: int | None = None) -> sp.csr_matrix:
 
 
 def poisson3d_csr(nx: int, ny: int | None = None,
-                  nz: int | None = None) -> sp.csr_matrix:
+                  nz: int | None = None) -> "sp.csr_matrix":
+    import scipy.sparse as sp
     ny = ny or nx
     nz = nz or nx
     A2 = poisson2d_csr(nx, ny)

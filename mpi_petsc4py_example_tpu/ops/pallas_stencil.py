@@ -42,7 +42,7 @@ def _shift_y(u, step):
 
 
 def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
-                    dot_ref=None):
+                    dot_ref=None, f_ref=None, combine=None):
     """Grid-free kernel: double-buffered z-chunk pipeline, manual DMA.
 
     Per chunk ``c`` the scratch holds planes ``[z0-1, z0+chunk+1)`` of the
@@ -50,8 +50,15 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
     neighbouring chunks or from the halo arrays at the slab ends. All
     index/constant dtypes are pinned to i32/f32 explicitly: with x64 enabled,
     bare Python literals trace as i64/f64, which Mosaic cannot lower.
+
+    With ``f_ref`` a second array streams through its own banks (center
+    planes only — no neighbours needed) and ``combine(u, y, f) -> out``
+    post-processes the stencil product while everything is VMEM-resident:
+    one streamed pass for a whole damped-Jacobi sweep or residual, instead
+    of a stencil pass plus an XLA elementwise pass over 3 more arrays.
     """
-    def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out):
+    def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out, fsc=None,
+                sem_f=None):
         six = jnp.asarray(6.0, out_ref.dtype)
         one = jnp.int32(1)
 
@@ -85,9 +92,12 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                     u_ref.at[pl.ds(z0 + jnp.int32(chunk), 1)],
                     sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
                     sem_hi.at[slot]).start()
+            if f_ref is not None:
+                pltpu.make_async_copy(f_ref.at[pl.ds(z0, chunk)],
+                                      fsc.at[slot], sem_f.at[slot]).start()
 
         def wait_in(slot):
-            # matching waits for the three start_in copies (shapes must agree)
+            # matching waits for the start_in copies (shapes must agree)
             pltpu.make_async_copy(
                 u_ref.at[pl.ds(0, chunk)], sc.at[slot, pl.ds(one, chunk)],
                 sem_c.at[slot]).wait()
@@ -96,6 +106,9 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             pltpu.make_async_copy(
                 hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
                 sem_hi.at[slot]).wait()
+            if f_ref is not None:
+                pltpu.make_async_copy(f_ref.at[pl.ds(0, chunk)],
+                                      fsc.at[slot], sem_f.at[slot]).wait()
 
         start_in(jnp.int32(0), jnp.int32(0))
 
@@ -115,13 +128,15 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             y = (six * u - zm - zp
                  - _shift_y(u, -1) - _shift_y(u, +1)
                  - _shift_x(u, -1) - _shift_x(u, +1))
+            out = (y if combine is None
+                   else combine(u, y, None if f_ref is None else fsc[slot]))
             # wait for the output DMA that used this osc bank two chunks ago
             @pl.when(c >= 2)
             def _():
                 pltpu.make_async_copy(
                     osc.at[slot], out_ref.at[pl.ds(0, chunk)],
                     sem_out.at[slot]).wait()
-            osc[slot] = y
+            osc[slot] = out
             pltpu.make_async_copy(
                 osc.at[slot],
                 out_ref.at[pl.ds(c * jnp.int32(chunk), chunk)],
@@ -156,28 +171,41 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             sem_out.at[lax_rem(last)]).wait()
 
     ny, nx = out_ref.shape[1], out_ref.shape[2]
-    pl.run_scoped(
-        process,
+    scratch = [
         pltpu.VMEM((2, chunk + 2, ny, nx), out_ref.dtype),
         pltpu.VMEM((2, chunk, ny, nx), out_ref.dtype),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
-    )
+    ]
+    if f_ref is not None:
+        scratch += [pltpu.VMEM((2, chunk, ny, nx), out_ref.dtype),
+                    pltpu.SemaphoreType.DMA((2,))]
+    pl.run_scoped(process, *scratch)
+
+
+# Scoped-VMEM plan for the DMA pipeline. Mosaic's default per-kernel limit
+# (~16MB) forces chunk=1 on 1MB planes (512² fp32), where every plane is
+# DMA'd ~3x (as a center plane and as both neighbours' edge planes) —
+# measured 7.3 HBM passes per apply at 512³ vs ~2.4 with real chunk depth.
+# The kernel therefore asks Mosaic for a higher limit (v5e VMEM is 128MB)
+# and plans its scratch against _VMEM_BUDGET.
+_VMEM_LIMIT = 64 << 20     # per-kernel limit requested from Mosaic
+_VMEM_BUDGET = 48 << 20    # scratch plan: 2 in-banks (chunk+2 planes each)
+#                            + 2 out-banks (chunk planes) + shift temps
+# Measured at 512³ fp32 (1MB planes): chunk=1 (old 16MB default) 7.3 HBM
+# passes/apply; chunk=8 (this plan) 5.0-5.2; chunk=16 (96MB limit) 7.1 —
+# more VMEM pressure hurts past chunk 8, so 64/48 is the sweet spot.
 
 
 def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
-                max_chunk: int | None):
-    """z-chunk that divides ``lz`` and keeps ~<=2MB per VMEM bank — the one
-    pipeline geometry both kernel entry points share."""
+                max_chunk: int | None, banks: int = 4):
+    """z-chunk that divides ``lz`` and keeps the scratch banks
+    (= banks*chunk+4 planes; ``banks`` is 4, or 6 with an f-array) inside
+    ``_VMEM_BUDGET`` — the one pipeline geometry all entry points share."""
     plane = ny * nx * itemsize
-    budget = (2 << 20) // plane
-    # total scoped VMEM is 2 input banks of (chunk+2) planes + 2 output banks
-    # of chunk planes = (4*chunk+4) planes, plus shift temporaries — keep it
-    # ~<=10MB of the 16MB scoped limit (512-wide planes OOM'd at the 2MB
-    # budget alone: 16.39M > 16M)
-    budget = min(budget, int(((10 << 20) // plane - 4) // 4))
+    budget = int((_VMEM_BUDGET // plane - 4) // banks)
     if max_chunk is not None:
         budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
     chunk = max(1, min(lz, budget))
@@ -204,6 +232,8 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
 
@@ -233,9 +263,72 @@ def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
     return y, dot[0]
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))
+def stencil3d_smooth_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
+                            nx: int, omega6: float,
+                            interpret: bool = False,
+                            max_chunk: int | None = None):
+    """One damped-Jacobi sweep in ONE streamed pass:
+    ``u + omega6*(f - A u)``.
+
+    The multigrid smoother's hot op (solvers/mg.py): fusing the update into
+    the stencil pipeline reads u (+edges) and f once and writes the new u
+    once (~3.3 HBM passes), where stencil-apply + XLA update chain costs
+    ~5.5 + 4 passes."""
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 banks=6)
+    # the scalar is built INSIDE the kernel from the static float — a traced
+    # closure constant would be rejected by pallas_call
+    kernel = functools.partial(
+        _stencil_kernel, chunk=chunk, nchunks=nchunks,
+        combine=lambda uc, y, fc: uc + jnp.asarray(omega6,
+                                                   uc.dtype) * (fc - y))
+
+    def kern(u_ref, lo_ref, hi_ref, f_ref, out_ref):
+        kernel(u_ref, lo_ref, hi_ref, out_ref, f_ref=f_ref)
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(u, halo_lo, halo_hi, f)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+def stencil3d_residual_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
+                              nx: int, interpret: bool = False,
+                              max_chunk: int | None = None):
+    """Residual in ONE streamed pass: ``f - A u`` (the V-cycle's
+    pre-restriction residual; same fusion rationale as the smooth sweep)."""
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 banks=6)
+    kernel = functools.partial(
+        _stencil_kernel, chunk=chunk, nchunks=nchunks,
+        combine=lambda uc, y, fc: fc - y)
+
+    def kern(u_ref, lo_ref, hi_ref, f_ref, out_ref):
+        kernel(u_ref, lo_ref, hi_ref, out_ref, f_ref=f_ref)
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(u, halo_lo, halo_hi, f)
 
 
 def pallas_supported(ny: int, nx: int, dtype) -> bool:

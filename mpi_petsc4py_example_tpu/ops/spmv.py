@@ -93,8 +93,11 @@ def csr_to_dia(indptr, indices, data, n, offsets):
     counts = indptr[1:] - indptr[:-1]
     rows = np.repeat(np.arange(n), counts)
     offs = indices - rows
-    dmap = {int(o): d for d, o in enumerate(offsets)}
-    dcol = np.array([dmap[int(o)] for o in offs], dtype=np.int64)
+    # offsets is sorted (np.unique in csr_find_diagonals) and covers every
+    # entry's diagonal, so searchsorted IS the offset->slot map — a Python
+    # dict loop here cost ~0.4 s at 1.8M nnz (BASELINE cfg1 assembly)
+    offsets = np.asarray(offsets, dtype=np.int64)
+    dcol = np.searchsorted(offsets, offs)
     dia = np.zeros((n, len(offsets)), dtype=data.dtype)
     dia[rows, dcol] = data
     return dia

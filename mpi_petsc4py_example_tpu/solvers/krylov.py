@@ -210,11 +210,11 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
 
 def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
-                      monitor=None, dtol=None, grid3d=None):
+                      monitor=None, dtol=None, grid3d=None, M3=None):
     """CG fast path for uniform-diagonal stencil operators (the BASELINE
     cfg1/cfg5 hot loop, reference ``test.py:50``'s iterative analog).
 
-    Identical recurrence to :func:`cg_kernel` with PC none/jacobi, but
+    Identical recurrence to :func:`cg_kernel` with PC none/jacobi/mg, but
     restructured for minimum HBM traffic on the matrix-free stencil path:
 
     - the SpMV and the ``<p, Ap>`` reduction run in ONE fused Pallas pass
@@ -228,7 +228,11 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
       call inside the loop body materializes full-array copies (measured
       +9 HBM passes / 2.5x per-iteration at 256³); on 3D carries the whole
       step runs in ~6 passes (~0.51 ms at 256³ fp32 vs the 11-pass model's
-      0.90 — the model overcounted, XLA fuses the update chain).
+      0.90 — the model overcounted, XLA fuses the update chain);
+    - with ``M3`` (a 3D-native preconditioner apply, the slab V-cycle from
+      PC.local_apply_grid3d) the scalar Jacobi identities are replaced by
+      ``z = M3(r)``, ``rz = <r, z>`` — the general PCG recurrence, still on
+      grid-shaped carries with zero in-loop reshapes.
 
     Convergence, breakdown, and divergence semantics match ``cg_kernel`` at
     ``unroll=1`` exactly; iteration counts and the monitored norm
@@ -243,8 +247,13 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     r = b - Adot(x0)[0]
     rr = pdot(r, r)
     rnorm = jnp.sqrt(rr)
-    rz = rr * inv_diag
-    p = r * inv_diag
+    if M3 is None:
+        rz = rr * inv_diag
+        p = r * inv_diag
+    else:
+        z = M3(r)
+        rz = pdot(r, z)
+        p = z
     dmax = _dmax(rnorm, dtol)
     hist = _mon0(monitor, rnorm, b.dtype)
 
@@ -260,9 +269,14 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
         x = x + alpha * p
         r = r - alpha * Ap
         rr = pdot(r, r)
-        rz_new = rr * inv_diag
+        if M3 is None:
+            rz_new = rr * inv_diag
+            zn = r * inv_diag
+        else:
+            zn = M3(r)
+            rz_new = pdot(r, zn)
         beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = r * inv_diag + beta * p
+        p = zn + beta * p
         rn = jnp.sqrt(rr)
         k = k + 1
         if monitor is not None:
@@ -1751,14 +1765,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 "bcgs/gmres/gcr for general preconditioning")
     # CG fast path: matrix-free stencil operators with a uniform diagonal
     # and PC none/jacobi get the fused matvec+dot kernel and the scalar
-    # Jacobi identities (see cg_stencil_kernel). Dispatch is part of the
-    # cache key via pc.program_key() + operator.program_key().
+    # Jacobi identities; PC mg composes the slab V-cycle 3D-natively
+    # (see cg_stencil_kernel). Dispatch is part of the cache key via
+    # pc.program_key() + operator.program_key().
     stencil_cg = (ksp_type == "cg" and nullspace_dim == 0
                   and unroll_k == 1 and not natural_k
                   # the fused Pallas partial sums u*y without a conjugate and
                   # carries a real-typed rr — real operators only
                   and not is_complex(dtype)
-                  and pc.get_type() in ("none", "jacobi")
+                  and pc.get_type() in ("none", "jacobi", "mg")
                   and hasattr(operator, "local_matvec_dot")
                   and hasattr(operator, "grid3d")
                   and getattr(operator, "uniform_diagonal", None) is not None
@@ -1767,6 +1782,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                   and (pc.get_type() == "none"
                        or getattr(pc, "_mat", None) is operator))
     matvec_dot = operator.local_matvec_dot(comm) if stencil_cg else None
+    pc_apply3 = (pc.local_apply_grid3d(comm)
+                 if stencil_cg and pc.get_type() == "mg" else None)
 
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
@@ -1810,6 +1827,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # for why the grid shape is kept through the loop)
                 pdot3 = lambda u, v: lax.psum(jnp.sum(u * v), axis)
                 pnorm3 = lambda u: jnp.sqrt(lax.psum(jnp.sum(u * u), axis))
+                if pc_apply3 is not None:
+                    kw["M3"] = lambda r: pc_apply3(pc_arrays, r)
                 return cg_stencil_kernel(
                     lambda v: matvec_dot(op_arrays, v), inv_diag,
                     pdot3, pnorm3, b, x0, rtol, atol, maxit,

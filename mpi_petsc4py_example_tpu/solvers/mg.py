@@ -47,11 +47,45 @@ _OMEGA = 2.0 / 3.0
 _RSCALE = 4.0 ** (1.0 / 3.0) / 2.0
 
 
+# The smoother/residual bodies route through the fused Pallas pipeline when
+# the level's plane shape supports it (fp32, nx%128==0, ny%8==0 — true for
+# the fine levels of the production 512³/256³ grids): one streamed pass per
+# sweep (~3.3 HBM passes) instead of a 21-pass jnp stencil apply plus an
+# XLA update chain. The jnp body (single shared definition,
+# models/stencil.py) covers everything else — coarse levels, f64, CPU.
+
 def _stencil7(u, halo_lo, halo_hi):
     """7-point Dirichlet Laplacian on a z-slab with explicit z-halo planes
-    (single definition shared with the SpMV path)."""
+    (jnp body; the Pallas fast paths live in _sweep/_residual)."""
     from ..models.stencil import StencilPoisson3D
+    from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
+    lz, ny, nx = u.shape
+    if pallas_supported(ny, nx, u.dtype):
+        return stencil3d_apply_pallas(u, halo_lo[None], halo_hi[None],
+                                      lz, ny, nx)
     return StencilPoisson3D._stencil7_jnp(u, halo_lo, halo_hi)
+
+
+def _sweep(u, f, halo_lo, halo_hi, omega: float = _OMEGA):
+    """One damped-Jacobi sweep ``u + (ω/6)(f - A u)`` — fused Pallas pass
+    where supported."""
+    from ..ops.pallas_stencil import pallas_supported, stencil3d_smooth_pallas
+    lz, ny, nx = u.shape
+    if pallas_supported(ny, nx, u.dtype):
+        return stencil3d_smooth_pallas(u, f, halo_lo[None], halo_hi[None],
+                                       lz, ny, nx, omega / 6.0)
+    return u + (omega / 6.0) * (f - _stencil7(u, halo_lo, halo_hi))
+
+
+def _residual(u, f, halo_lo, halo_hi):
+    """Residual ``f - A u`` — fused Pallas pass where supported."""
+    from ..ops.pallas_stencil import (pallas_supported,
+                                      stencil3d_residual_pallas)
+    lz, ny, nx = u.shape
+    if pallas_supported(ny, nx, u.dtype):
+        return stencil3d_residual_pallas(u, f, halo_lo[None], halo_hi[None],
+                                         lz, ny, nx)
+    return f - _stencil7(u, halo_lo, halo_hi)
 
 
 def _zeros_plane(u):
@@ -81,7 +115,7 @@ def _smooth(u, f, iters: int, exchange, omega: float = _OMEGA):
 
     def body(_, u):
         lo, hi = exchange(u)
-        return u + (omega / 6.0) * (f - _stencil7(u, lo, hi))
+        return _sweep(u, f, lo, hi, omega)
 
     return lax.fori_loop(0, iters, body, u)
 
@@ -91,7 +125,7 @@ def _smooth0(f, iters: int, exchange, omega: float = _OMEGA):
     ``u = (ω/6) f`` — no stencil apply, no halo exchange."""
     if iters <= 0:
         return jnp.zeros_like(f)
-    return _smooth((omega / 6.0) * f, f, iters - 1, exchange)
+    return _smooth((omega / 6.0) * f, f, iters - 1, exchange, omega)
 
 
 def _r1d(f, ax: int, lo=None, hi=None):
@@ -160,9 +194,12 @@ def mg_levels(nz: int, ny: int, nx: int, min_dim: int = 4):
     return levels
 
 
-def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
-                coarse_iters: int = 20, axis=None, ndev: int = 1):
-    """Return ``vcycle(r_local_flat) -> z_local_flat`` approximating A⁻¹ r.
+def make_vcycle3d(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
+                  coarse_iters: int = 20, axis=None, ndev: int = 1):
+    """Return ``cycle(r_slab (lz,ny,nx)) -> z_slab`` approximating A⁻¹ r —
+    the 3D-native form the stencil-CG fast path composes with its
+    grid-shaped loop carries (no flat↔3D reshapes inside the Krylov loop;
+    see cg_stencil_kernel's traffic note).
 
     Pure jnp over static shapes; safe inside jit/shard_map. With
     ``ndev == 1`` the cycle is fully local; with ``ndev > 1`` it must run
@@ -176,16 +213,13 @@ def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
             return _smooth0(f, coarse_iters, _no_exchange)
         u = _smooth0(f, pre, _no_exchange)
         lo, hi = _no_exchange(u)
-        r = f - _stencil7(u, lo, hi)
+        r = _residual(u, f, lo, hi)
         e_c = local_cycle(_restrict(r), li + 1)
         u = u + _prolong(e_c)
         return _smooth(u, f, post, _no_exchange)
 
     if ndev == 1:
-        def vcycle(r_flat):
-            z = local_cycle(r_flat.reshape(nz, ny, nx), 0)
-            return z.reshape(-1)
-        return vcycle
+        return lambda f: local_cycle(f, 0)
 
     if nz % ndev:
         raise ValueError(f"slab V-cycle needs nz ({nz}) divisible by the "
@@ -211,17 +245,25 @@ def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
             return lax.dynamic_slice_in_dim(e_full, i * lzi, lzi, axis=0)
         u = _smooth0(f, pre, exchange)
         lo, hi = exchange(u)
-        r = f - _stencil7(u, lo, hi)
+        r = _residual(u, f, lo, hi)
         rlo, rhi = exchange(r)
         e_c = slab_cycle(_restrict(r, rlo, rhi), li + 1)
         elo, ehi = exchange(e_c)
         u = u + _prolong(e_c, elo, ehi)
         return _smooth(u, f, post, exchange)
 
+    return lambda f: slab_cycle(f, 0)
+
+
+def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
+                coarse_iters: int = 20, axis=None, ndev: int = 1):
+    """Flat-vector wrapper over :func:`make_vcycle3d`:
+    ``vcycle(r_local_flat) -> z_local_flat`` (the generic PC-apply shape)."""
+    cycle = make_vcycle3d(nz, ny, nx, pre=pre, post=post,
+                          coarse_iters=coarse_iters, axis=axis, ndev=ndev)
     lz = nz // ndev
 
     def vcycle(r_flat):
-        z = slab_cycle(r_flat.reshape(lz, ny, nx), 0)
-        return z.reshape(-1)
+        return cycle(r_flat.reshape(lz, ny, nx)).reshape(-1)
 
     return vcycle

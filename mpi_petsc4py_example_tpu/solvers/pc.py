@@ -519,6 +519,23 @@ class PC:
             return lambda arrs, r: vcycle(r)
         raise AssertionError(k)
 
+    def local_apply_grid3d(self, comm: DeviceComm):
+        """3D-native apply for the stencil-CG fast path, or None.
+
+        ``apply3(pc_arrays_local, r_slab (lz,ny,nx)) -> z_slab`` — lets the
+        fast path keep its loop state in the operator's grid shape (flat↔3D
+        reshapes inside a while_loop body materialize full-array copies;
+        see cg_stencil_kernel). Only 'mg' has a non-trivial 3D form; the
+        diagonal kinds collapse to scalars there instead.
+        """
+        if self.kind != "mg":
+            return None
+        from .mg import make_vcycle3d
+        op = self._mat
+        cycle = make_vcycle3d(op.nz, op.ny, op.nx, axis=comm.axis,
+                              ndev=comm.size)
+        return lambda arrs, r: cycle(r)
+
     def local_apply_transpose(self, comm: DeviceComm, n: int):
         """``apply_t(pc_arrays_local, r_local) -> z_local`` for ``Mᵀ``
         (PETSc's PCApplyTranspose slot — KSPBICG's shadow recurrence).

@@ -81,3 +81,52 @@ def test_zero_halos_dirichlet():
         jnp.asarray(u), jnp.asarray(z), jnp.asarray(z), lz, ny, nx, True))
     ref = reference_stencil(u.astype(np.float64), z, z)
     np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("lz,max_chunk", [
+    (4, None),   # single chunk
+    (6, 2),      # nchunks == 3
+    (8, 1),      # chunk == 1 plane
+])
+def test_fused_smooth_parity(lz, max_chunk):
+    """stencil3d_smooth_pallas == u + w*(f - A u) (the MG damped-Jacobi
+    sweep fused into one streamed pass, solvers/mg._sweep)."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_smooth_pallas)
+    ny, nx = 8, 128
+    rng = np.random.default_rng(200 + lz)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    f = rng.random((lz, ny, nx)).astype(np.float32)
+    lo = rng.random((1, ny, nx)).astype(np.float32)
+    hi = rng.random((1, ny, nx)).astype(np.float32)
+    w = 2.0 / 3.0 / 6.0
+    out = np.asarray(stencil3d_smooth_pallas(
+        jnp.asarray(u), jnp.asarray(f), jnp.asarray(lo), jnp.asarray(hi),
+        lz, ny, nx, w, True, max_chunk))
+    ref = u + w * (f - reference_stencil(
+        u.astype(np.float64), lo.astype(np.float64), hi.astype(np.float64)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("lz,max_chunk", [
+    (4, None),
+    (6, 2),
+    (8, 1),
+])
+def test_fused_residual_parity(lz, max_chunk):
+    """stencil3d_residual_pallas == f - A u (the V-cycle's fused
+    pre-restriction residual, solvers/mg._residual)."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_residual_pallas)
+    ny, nx = 8, 128
+    rng = np.random.default_rng(300 + lz)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    f = rng.random((lz, ny, nx)).astype(np.float32)
+    lo = rng.random((1, ny, nx)).astype(np.float32)
+    hi = rng.random((1, ny, nx)).astype(np.float32)
+    out = np.asarray(stencil3d_residual_pallas(
+        jnp.asarray(u), jnp.asarray(f), jnp.asarray(lo), jnp.asarray(hi),
+        lz, ny, nx, True, max_chunk))
+    ref = f - reference_stencil(
+        u.astype(np.float64), lo.astype(np.float64), hi.astype(np.float64))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
