@@ -144,16 +144,18 @@ class TestDirect:
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-10)
 
     def test_lu_rejects_huge(self, comm1):
-        """Past the dense cap, general (non-tridiagonal) operators are
-        rejected; banded ones take the cyclic-reduction path instead
-        (tests/test_tridiag.py)."""
+        """Past the dense cap, operators whose bandwidth exceeds the
+        block-CR memory model are rejected with the model spelled out and
+        a pointer to the PARITY.md cost table; reducible ones take the
+        (RCM+)cyclic-reduction path instead (tests/test_rcm_direct.py)."""
         pc = tps.PC()
         pc.set_type("lu")
         n = 30000
-        A = sp.diags([np.full(n, 4.0), np.full(n - 9000, 0.5)],
-                     [0, 9000], format="csr")
+        rng = np.random.default_rng(1)
+        R = sp.random(n, n, density=2e-4, format="csr", random_state=rng)
+        A = (R + R.T + sp.eye(n) * 50.0).tocsr()
         M = tps.Mat.from_scipy(comm1, A)
-        with pytest.raises(ValueError, match="too large"):
+        with pytest.raises(ValueError, match="PARITY.md"):
             pc.set_up(M)
 
 

@@ -1,0 +1,96 @@
+"""RCM-reordered block-cyclic-reduction direct solves (round 4).
+
+The reference's MUMPS slot (test.py:41-43 [external]) factorizes arbitrary
+sparsity, running a fill-reducing ordering first. The TPU analog: a
+reverse-Cuthill-McKee symmetric permutation at PC lu/cholesky setup routes
+reducible sparsity into the banded block-CR machinery
+(solvers/pc.py::_rcm_bandwidth/_build_banded_bcr), with a written-down
+memory model (_bcr_elements) gating what fits.
+
+Caps are monkeypatched small so the same dispatch logic is exercised at
+CI-friendly sizes; the production-scale 256² run (n=65536, b=257) is the
+PARITY.md 'Direct solves' table's TPU measurement.
+"""
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+import mpi_petsc4py_example_tpu.solvers.pc as pcmod
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+
+
+def _scrambled_poisson(nx, seed=0):
+    """2D Poisson under a random symmetric permutation: general-looking
+    sparsity whose band is RCM-recoverable."""
+    A = poisson2d_csr(nx).tocsr()
+    rng = np.random.default_rng(seed)
+    p = rng.permutation(A.shape[0])
+    return A[p][:, p].tocsr()
+
+
+def _direct_solve(comm, A, pc_type="lu"):
+    M = tps.Mat.from_scipy(comm, A, dtype=np.float64)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("preonly")
+    ksp.get_pc().set_type(pc_type)
+    x, bv = M.get_vecs()
+    x_true = np.random.default_rng(7).random(A.shape[0])
+    b = A @ x_true
+    bv.set_global(b)
+    res = ksp.solve(bv, x)
+    rres = np.linalg.norm(b - A @ x.to_numpy()) / np.linalg.norm(b)
+    return ksp, float(rres)
+
+
+class TestRCMDirect:
+    def test_scrambled_poisson_routes_through_rcm(self, comm8, monkeypatch):
+        monkeypatch.setattr(pcmod, "_DENSE_CAP", 256)
+        A = _scrambled_poisson(32)           # n=1024 > patched cap
+        ksp, rres = _direct_solve(comm8, A)
+        pc = ksp.get_pc()
+        assert pc._factor_mode == "crband"
+        assert len(pc._arrays) == 5          # perm + iperm shipped
+        assert rres <= 1e-8, rres
+
+    def test_cholesky_scrambled_spd(self, comm8, monkeypatch):
+        """RCM keeps symmetry, so cholesky accepts the reordered SPD
+        operator and its transpose apply reuses the forward closure."""
+        monkeypatch.setattr(pcmod, "_DENSE_CAP", 256)
+        A = _scrambled_poisson(32, seed=3)
+        ksp, rres = _direct_solve(comm8, A, "cholesky")
+        assert ksp.get_pc()._factor_mode == "crband"
+        assert rres <= 1e-8, rres
+
+    def test_natural_banded_wide_bw(self, comm8, monkeypatch):
+        """A naturally-banded operator past the (patched) dense cap with
+        bandwidth above the old b<=16 limit takes BPCR directly, no perm."""
+        monkeypatch.setattr(pcmod, "_DENSE_CAP", 256)
+        A = poisson2d_csr(24)                # n=576, band 24
+        ksp, rres = _direct_solve(comm8, A)
+        pc = ksp.get_pc()
+        assert pc._factor_mode == "crband"
+        assert len(pc._arrays) == 3          # no permutation needed
+        assert rres <= 1e-10, rres
+
+    def test_model_cap_error_points_to_parity(self, comm8, monkeypatch):
+        monkeypatch.setattr(pcmod, "_DENSE_CAP", 256)
+        monkeypatch.setattr(pcmod, "_BCR_ELEM_CAP", 1000)
+        A = _scrambled_poisson(32)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        x, bv = M.get_vecs()
+        bv.set_global(np.ones(A.shape[0]))
+        with pytest.raises(ValueError, match="PARITY.md"):
+            ksp.solve(bv, x)
+
+    def test_bcr_elements_model(self):
+        """The written-down model: (2S+1)·N·b² with S=ceil(log2 N)."""
+        assert pcmod._bcr_elements(65536, 257) == 17 * 256 * 257 * 257
+        assert pcmod._bcr_fits(65536, 257)       # the 256² Poisson target
+        assert not pcmod._bcr_fits(10 ** 7, 512)  # past the element cap
+        assert not pcmod._bcr_fits(65536, 1024)   # past the bw cap

@@ -271,9 +271,10 @@ class TestLuCyclicReduction:
         with pytest.raises(ValueError, match="probe|singular|broke"):
             bpcr_setup(Ab, Bb, Cb)
 
-    def test_large_nontridiagonal_still_raises(self, comm8):
-        """The dense cap still guards general operators; the error points at
-        the banded cyclic-reduction exception."""
+    def test_large_wide_band_reduces_via_rcm(self, comm8):
+        """A band too wide as stored (offsets ±5000 at n=20000) is no longer
+        rejected: dispatch is on REDUCIBILITY — RCM reorders the chain graph
+        to a tiny bandwidth and block CR solves it directly (round 4)."""
         n = 20000
         d0 = np.full(n, 4.0)
         d5 = np.full(n - 5000, 0.5)
@@ -284,6 +285,28 @@ class TestLuCyclicReduction:
         ksp.set_type("preonly")
         ksp.get_pc().set_type("lu")
         x, bv = M.get_vecs()
+        x_true = np.random.default_rng(11).random(n)
+        bv.set_global(A @ x_true)
+        res = ksp.solve(bv, x)
+        assert ksp.get_pc()._factor_mode == "crband"
+        assert len(ksp.get_pc()._arrays) == 5      # permuted factorization
+        rres = np.linalg.norm(A @ x_true - A @ x.to_numpy()) \
+            / np.linalg.norm(A @ x_true)
+        assert rres <= 1e-10, rres
+
+    def test_large_irreducible_still_raises(self, comm8):
+        """Genuinely irreducible sparsity past the dense cap raises with
+        the memory model and the PARITY.md cost-table pointer."""
+        n = 20000
+        rng = np.random.default_rng(0)
+        R = sp.random(n, n, density=2e-4, format="csr", random_state=rng)
+        A = (R + R.T + sp.eye(n) * 50.0).tocsr()
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        ksp.get_pc().set_type("lu")
+        x, bv = M.get_vecs()
         bv.set_global(np.ones(n))
-        with pytest.raises(ValueError, match="banded"):
+        with pytest.raises(ValueError, match="PARITY.md"):
             ksp.solve(bv, x)
