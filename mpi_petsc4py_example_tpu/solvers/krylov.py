@@ -17,6 +17,9 @@ stencil operators plug in unchanged.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
+import threading as _threading
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -100,6 +103,63 @@ class _HistMonitor:
 
     def __call__(self, hist, k, rn):
         return hist.at[k].set(rn.astype(self.dtype), mode="drop")
+
+
+# ---- live monitor streaming (callback-capable backends) --------------------
+# NOT thread-local: io_callback host functions run on the runtime's
+# callback threads, not the solving thread. The RLock is held for the WHOLE
+# sink scope, so concurrent live-monitored solves on other threads
+# serialize instead of cross-delivering records; a monitor that recursively
+# starts another monitored solve on the same thread re-enters fine (the
+# inner scope swaps the sink and restores it).
+_LIVE_LOCK = _threading.RLock()
+_LIVE_SINK_FN = None
+
+
+@_contextlib.contextmanager
+def live_monitor_sink(fn):
+    """Route in-program live monitor emissions (see :class:`_LiveMonitor`)
+    to ``fn(k, rn)`` for the duration of a solve."""
+    global _LIVE_SINK_FN
+    with _LIVE_LOCK:
+        prev = _LIVE_SINK_FN
+        _LIVE_SINK_FN = fn
+        try:
+            yield
+        finally:
+            _LIVE_SINK_FN = prev
+
+
+def _live_emit(k, rn):
+    fn = _LIVE_SINK_FN
+    if fn is not None:
+        fn(int(k), float(rn))
+
+
+def live_monitor_supported() -> bool:
+    """Whether the backend can stream monitor lines DURING the solve.
+
+    The axon TPU runtime rejects host callbacks entirely (the reason the
+    buffered replay exists); the CPU mesh supports ordered io_callback
+    inside shard_map (verified: one call per device per record, in order).
+    """
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+class _LiveMonitor(_HistMonitor):
+    """A :class:`_HistMonitor` that ALSO streams each record to the host
+    WHILE the program runs — PETSc's live ``-ksp_monitor`` semantics — via
+    ordered ``io_callback``. Only for callback-capable runtimes
+    (:func:`live_monitor_supported`). Inside shard_map the callback fires
+    once per device with identical (replicated) arguments; the host sink
+    dedupes on ``k`` (solvers/ksp.py). The history buffer is still
+    threaded and fetched, so history semantics are unchanged."""
+
+    def __call__(self, hist, k, rn):
+        from jax.experimental import io_callback
+        io_callback(_live_emit, None, k, jnp.real(rn), ordered=True)
+        return super().__call__(hist, k, rn)
 
 
 def _no_hist(dtype):
@@ -1691,7 +1751,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       restart: int = 30, monitored: bool = False,
                       zero_guess: bool = False, nullspace_dim: int = 0,
                       aug: int = 2, ell: int = 2, unroll: int = 1,
-                      natural: bool = False, hist_cap: int = 0):
+                      natural: bool = False, hist_cap: int = 0,
+                      live: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -1741,9 +1802,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 if ksp_type in _UNROLLABLE and not monitored else 1)
     natural_k = bool(natural) and ksp_type in NATURAL_TYPES
     cap_k = int(hist_cap) if monitored else 0
+    live_k = bool(live) and monitored
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
-           nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k)
+           nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k)
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1796,8 +1858,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         spmv_t_local = operator.local_spmv_t(comm)
     op_specs = operator.op_specs(axis)
 
-    # functional in-program recorder (no host callbacks — see _HistMonitor)
-    monitor = (_HistMonitor(dtype, cap_k or hist_capacity(10000, restart))
+    # functional in-program recorder (no host callbacks — see _HistMonitor);
+    # callback-capable backends get the live-streaming variant
+    mon_cls = _LiveMonitor if live_k else _HistMonitor
+    monitor = (mon_cls(dtype, cap_k or hist_capacity(10000, restart))
                if monitored else None)
 
     def make_body(project):

@@ -17,6 +17,7 @@ options DB: ``-ksp_type``, ``-ksp_rtol``, ``-ksp_atol``, ``-ksp_max_it``,
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
@@ -382,7 +383,14 @@ class KSP:
         nullspace = getattr(mat, "nullspace", None)
         if nullspace is not None and nullspace.dim == 0:
             nullspace = None        # empty null space: nothing to project
-        from .krylov import hist_capacity
+        from .krylov import (hist_capacity, live_monitor_sink,
+                             live_monitor_supported)
+        # live -ksp_monitor: stream each residual DURING the solve on
+        # callback-capable backends (PETSc's semantics); elsewhere the
+        # in-program buffer is replayed after the fetch
+        live = monitored and live_monitor_supported()
+        self._last_monitor_mode = ("live" if live else
+                                   "replay" if monitored else "off")
         prog = build_ksp_program(comm, self._type, pc, mat,
                                  restart=self.restart,
                                  monitored=monitored,
@@ -397,7 +405,8 @@ class KSP:
                                      self.max_it,
                                      # bcgsl records at k+ell, so cover the
                                      # larger of the cycle-granular strides
-                                     max(self.restart, self.bcgsl_ell)))
+                                     max(self.restart, self.bcgsl_ell)),
+                                 live=live)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the
@@ -406,12 +415,33 @@ class KSP:
         dt = np.dtype(op_dt.type(0).real.dtype)
         ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
+        # live mode: the in-program io_callback fires once per device per
+        # record (replicated args); dispatch each NEW k to the monitors as
+        # it arrives — k is monotone within a solve, so "k > max seen"
+        # dedupes device copies even if devices interleave
+        live_ctx = contextlib.nullcontext()
+        if live:
+            seen = [-1]
+
+            def _dispatch(k, rn):
+                if k > seen[0]:
+                    seen[0] = k
+                    for m in monitors:
+                        m(self, k, rn)
+            live_ctx = live_monitor_sink(_dispatch)
         t0 = time.perf_counter()
-        xd, iters, rnorm, reason, hist = prog(
-            mat.device_arrays(), pc.device_arrays(), *ns_args,
-            b.data, x.data,
-            dt.type(rtol), dt.type(atol),
-            dt.type(divtol), np.int32(self.max_it))
+        with live_ctx:
+            xd, iters, rnorm, reason, hist = prog(
+                mat.device_arrays(), pc.device_arrays(), *ns_args,
+                b.data, x.data,
+                dt.type(rtol), dt.type(atol),
+                dt.type(divtol), np.int32(self.max_it))
+            if live:
+                # drain pending io_callback effects INSIDE the sink scope —
+                # output-buffer readiness alone does not imply host-callback
+                # delivery (jax.effects_barrier is the documented drain)
+                jax.block_until_ready((iters, rnorm, reason))
+                jax.effects_barrier()
         # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
         # int()/float() per scalar would pay it three times). The residual
         # history is an in-program buffer (no host callbacks — works on
@@ -424,10 +454,11 @@ class KSP:
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
         from ..utils.profiling import record_sync
         record_sync("KSP result fetch/solve")
-        if monitored:
+        if monitored and not live:
             # -1 is the unwritten sentinel (norms are nonnegative); a
             # recorded NaN residual passes `!= -1` and reaches the
-            # monitors, as the callback path used to deliver it
+            # monitors, as the callback path used to deliver it. Live mode
+            # already delivered every record during the solve.
             hist = np.asarray(hist)
             for k_it in np.nonzero(hist != -1.0)[0]:
                 for m in monitors:
