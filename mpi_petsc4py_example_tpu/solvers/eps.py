@@ -149,10 +149,10 @@ def _build_seed_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
     if inner is not None:
-        b_apply = inner.local_spmv(comm)
+        b_apply = _operator_precision(inner.local_spmv(comm))
         b_specs = inner.op_specs(axis)
     else:
         b_apply = None
@@ -184,10 +184,10 @@ def _build_restart_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
     if inner is not None:
-        b_apply = inner.local_spmv(comm)
+        b_apply = _operator_precision(inner.local_spmv(comm))
         b_specs = inner.op_specs(axis)
     else:
         b_apply = None
@@ -222,10 +222,10 @@ def _build_arnoldi_restart_facto_program(comm: DeviceComm, op, ncv: int,
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
     if inner is not None:
-        b_apply = inner.local_spmv(comm)
+        b_apply = _operator_precision(inner.local_spmv(comm))
         b_specs = inner.op_specs(axis)
     else:
         b_apply = None
@@ -258,6 +258,21 @@ def _highest_precision(fn):
     def wrapped(*args):
         with jax.default_matmul_precision("highest"):
             return fn(*args)
+    return wrapped
+
+
+def _operator_precision(apply_fn):
+    """Re-enter DEFAULT matmul precision around an operator application:
+    _highest_precision protects the small Gram/projection matmuls, but the
+    O(n²)-scale operator applies inside the same program (e.g. sinvert's
+    dense inverse matvec) must not pay the ~3x multi-pass cost — their
+    accuracy is governed by the operator itself, not the subspace algebra."""
+    import functools
+
+    @functools.wraps(apply_fn)
+    def wrapped(*args):
+        with jax.default_matmul_precision("default"):
+            return apply_fn(*args)
     return wrapped
 
 
@@ -358,10 +373,10 @@ def _build_hep_loop_program(comm: DeviceComm, op, ncv: int, k_keep: int,
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
     if inner is not None:
-        b_apply = inner.local_spmv(comm)
+        b_apply = _operator_precision(inner.local_spmv(comm))
         b_specs = inner.op_specs(axis)
     else:
         b_apply = None
@@ -553,7 +568,7 @@ def _build_subspace_loop_program(comm: DeviceComm, op, ncv: int, nev: int,
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
 
     def local_fn(op_arrays, Y0, tol, sigma, tau, max_it):
@@ -609,6 +624,26 @@ def _build_subspace_loop_program(comm: DeviceComm, op, ncv: int, nev: int,
     return prog
 
 
+def _lobpcg_seed(op, n: int, m: int, dtype):
+    """Deterministic LOBPCG start block (orthonormal rows, fixed seed) and
+    Jacobi-diagonal inverse — the ONE definition both the fused and host
+    paths use, so their solves start identically."""
+    hdt = host_dtype(dtype)
+    rng = np.random.default_rng(20240901)
+    X0 = rng.standard_normal((m, n)).astype(hdt)
+    if is_complex(dtype):
+        X0 = X0 + 1j * rng.standard_normal((m, n))
+    X0 = np.linalg.qr(X0.T)[0].T
+    try:
+        diag = np.asarray(op.diagonal(), dtype=hdt)
+        dinv = np.where(np.abs(diag) > 0,
+                        1.0 / np.where(diag == 0, 1.0, diag),
+                        1.0).astype(hdt)
+    except (ValueError, AttributeError):
+        dinv = np.ones(n, dtype=hdt)
+    return X0, dinv
+
+
 def _build_lobpcg_loop_program(comm: DeviceComm, op, bop, m: int, nev: int,
                                largest: bool):
     """The ENTIRE LOBPCG solve as ONE compiled program.
@@ -632,10 +667,10 @@ def _build_lobpcg_loop_program(comm: DeviceComm, op, bop, m: int, nev: int,
     if cached is not None:
         return cached
 
-    spmv = op.local_spmv(comm)
+    spmv = _operator_precision(op.local_spmv(comm))
     op_specs = op.op_specs(axis)
     if bop is not None:
-        b_apply = bop.local_spmv(comm)
+        b_apply = _operator_precision(bop.local_spmv(comm))
         b_specs = bop.op_specs(axis)
     else:
         b_apply = None
@@ -1386,20 +1421,9 @@ class EPS:
         if (_want_fused(comm, n) and _device_eigh_trustworthy(comm, dtype_)
                 and _device_matmul_trustworthy(comm, dtype_)):
             npad_ = comm.padded_size(n)
-            hdt_ = host_dtype(dtype_)
-            rng = np.random.default_rng(20240901)
-            X0 = rng.standard_normal((m, n)).astype(hdt_)
-            if is_complex(dtype_):
-                X0 = X0 + 1j * rng.standard_normal((m, n))
-            X0 = np.linalg.qr(X0.T)[0].T
+            X0, dinv = _lobpcg_seed(op, n, m, dtype_)
             X0p = np.zeros((m, npad_), dtype=dtype_)
             X0p[:, :n] = X0
-            try:
-                diag = np.asarray(op.diagonal(), dtype=hdt_)
-                dinv = np.where(np.abs(diag) > 0, 1.0 / np.where(
-                    diag == 0, 1.0, diag), 1.0)
-            except (ValueError, AttributeError):
-                dinv = np.ones(n, dtype=hdt_)
             lprog = _build_lobpcg_loop_program(
                 comm, op, bop, m, self.nev,
                 largest=(self._which == EPSWhich.LARGEST_REAL))
@@ -1448,20 +1472,10 @@ class EPS:
         else:
             B_apply = lambda Mh: Mh
 
-        try:
-            diag = np.asarray(op.diagonal(), dtype=hdt)
-            diag = np.where(np.abs(diag) > 0, diag, 1.0)
-            T_apply = lambda Rh: Rh / diag[None, :]
-        except (ValueError, AttributeError):
-            T_apply = lambda Rh: Rh
+        X, dinv_h = _lobpcg_seed(op, n, m, dtype)
+        T_apply = lambda Rh: Rh * dinv_h[None, :]
 
         sign = -1.0 if self._which == EPSWhich.LARGEST_REAL else 1.0
-
-        rng = np.random.default_rng(20240901)
-        X = rng.standard_normal((m, n)).astype(hdt)
-        if is_complex(dtype):
-            X = X + 1j * rng.standard_normal((m, n))
-        X = np.linalg.qr(X.T)[0].T
         Pdir = np.zeros((0, n), dtype=hdt)
         theta = np.zeros(m)
         rel = np.full(m, np.inf)

@@ -107,27 +107,41 @@ class _HistMonitor:
 
 # ---- live monitor streaming (callback-capable backends) --------------------
 # NOT thread-local: io_callback host functions run on the runtime's
-# callback threads, not the solving thread. The RLock is held for the WHOLE
-# sink scope, so concurrent live-monitored solves on other threads
-# serialize instead of cross-delivering records; a monitor that recursively
-# starts another monitored solve on the same thread re-enters fine (the
-# inner scope swaps the sink and restores it).
+# callback threads, not the solving thread. One live solve owns the sink
+# at a time; claiming is NON-blocking (see acquire_live_monitor) — a
+# blocking claim would deadlock when a monitor itself launches a monitored
+# solve (the nested claim happens on the callback thread while the outer
+# solve's effects_barrier waits for that very callback to return).
 _LIVE_LOCK = _threading.RLock()
 _LIVE_SINK_FN = None
+
+
+def acquire_live_monitor() -> bool:
+    """Claim the live-monitor slot without blocking.
+
+    Returns False when another live-monitored solve owns it (including a
+    monitored solve launched FROM a monitor callback) — the caller must
+    then fall back to the buffered-replay delivery, which is always
+    correct. Pair with :func:`release_live_monitor`."""
+    return _LIVE_LOCK.acquire(blocking=False)
+
+
+def release_live_monitor():
+    _LIVE_LOCK.release()
 
 
 @_contextlib.contextmanager
 def live_monitor_sink(fn):
     """Route in-program live monitor emissions (see :class:`_LiveMonitor`)
-    to ``fn(k, rn)`` for the duration of a solve."""
+    to ``fn(k, rn)`` for the duration of a solve. The caller must hold the
+    live-monitor slot (:func:`acquire_live_monitor`)."""
     global _LIVE_SINK_FN
-    with _LIVE_LOCK:
-        prev = _LIVE_SINK_FN
-        _LIVE_SINK_FN = fn
-        try:
-            yield
-        finally:
-            _LIVE_SINK_FN = prev
+    prev = _LIVE_SINK_FN
+    _LIVE_SINK_FN = fn
+    try:
+        yield
+    finally:
+        _LIVE_SINK_FN = prev
 
 
 def _live_emit(k, rn):
@@ -136,13 +150,18 @@ def _live_emit(k, rn):
         fn(int(k), float(rn))
 
 
-def live_monitor_supported() -> bool:
-    """Whether the backend can stream monitor lines DURING the solve.
+def live_monitor_supported(comm=None) -> bool:
+    """Whether the mesh the solve runs on can stream monitor lines DURING
+    the solve.
 
     The axon TPU runtime rejects host callbacks entirely (the reason the
-    buffered replay exists); the CPU mesh supports ordered io_callback
-    inside shard_map (verified: one call per device per record, in order).
+    buffered replay exists); CPU meshes support ordered io_callback inside
+    shard_map (verified: one call per device per record, in order). Gates
+    on the SOLVE MESH's platform, not the process default backend — a
+    CPU-device mesh in a TPU-capable process still streams.
     """
+    if comm is not None:
+        return comm.devices[0].platform == "cpu"
     import jax
     return jax.default_backend() == "cpu"
 

@@ -383,14 +383,15 @@ class KSP:
         nullspace = getattr(mat, "nullspace", None)
         if nullspace is not None and nullspace.dim == 0:
             nullspace = None        # empty null space: nothing to project
-        from .krylov import (hist_capacity, live_monitor_sink,
-                             live_monitor_supported)
+        from .krylov import (acquire_live_monitor, hist_capacity,
+                             live_monitor_sink, live_monitor_supported,
+                             release_live_monitor)
         # live -ksp_monitor: stream each residual DURING the solve on
-        # callback-capable backends (PETSc's semantics); elsewhere the
-        # in-program buffer is replayed after the fetch
-        live = monitored and live_monitor_supported()
-        self._last_monitor_mode = ("live" if live else
-                                   "replay" if monitored else "off")
+        # callback-capable backends (PETSc's semantics); elsewhere — and
+        # for history-only monitoring, where per-record host callbacks buy
+        # nothing — the in-program buffer is replayed after the fetch
+        live = (bool(self._monitors or self._monitor_flag)
+                and live_monitor_supported(comm))
         prog = build_ksp_program(comm, self._type, pc, mat,
                                  restart=self.restart,
                                  monitored=monitored,
@@ -418,9 +419,16 @@ class KSP:
         # live mode: the in-program io_callback fires once per device per
         # record (replicated args); dispatch each NEW k to the monitors as
         # it arrives — k is monotone within a solve, so "k > max seen"
-        # dedupes device copies even if devices interleave
+        # dedupes device copies even if devices interleave. The slot claim
+        # is NON-blocking: a monitor that launches a monitored solve of its
+        # own runs on a callback thread, and a blocking claim there would
+        # deadlock against this solve's effects_barrier — the unclaimed
+        # solve falls back to the always-correct buffered replay (the
+        # history buffer is filled either way).
+        delivered_live = False
         live_ctx = contextlib.nullcontext()
-        if live:
+        if live and acquire_live_monitor():
+            delivered_live = True
             seen = [-1]
 
             def _dispatch(k, rn):
@@ -429,19 +437,26 @@ class KSP:
                     for m in monitors:
                         m(self, k, rn)
             live_ctx = live_monitor_sink(_dispatch)
+        self._last_monitor_mode = ("live" if delivered_live else
+                                   "replay" if monitored else "off")
         t0 = time.perf_counter()
-        with live_ctx:
-            xd, iters, rnorm, reason, hist = prog(
-                mat.device_arrays(), pc.device_arrays(), *ns_args,
-                b.data, x.data,
-                dt.type(rtol), dt.type(atol),
-                dt.type(divtol), np.int32(self.max_it))
-            if live:
-                # drain pending io_callback effects INSIDE the sink scope —
-                # output-buffer readiness alone does not imply host-callback
-                # delivery (jax.effects_barrier is the documented drain)
-                jax.block_until_ready((iters, rnorm, reason))
-                jax.effects_barrier()
+        try:
+            with live_ctx:
+                xd, iters, rnorm, reason, hist = prog(
+                    mat.device_arrays(), pc.device_arrays(), *ns_args,
+                    b.data, x.data,
+                    dt.type(rtol), dt.type(atol),
+                    dt.type(divtol), np.int32(self.max_it))
+                if delivered_live:
+                    # drain pending io_callback effects INSIDE the sink
+                    # scope — output-buffer readiness alone does not imply
+                    # host-callback delivery (jax.effects_barrier is the
+                    # documented drain)
+                    jax.block_until_ready((iters, rnorm, reason))
+                    jax.effects_barrier()
+        finally:
+            if delivered_live:
+                release_live_monitor()
         # one batched D2H fetch (a remote-TPU round trip costs ~100ms;
         # int()/float() per scalar would pay it three times). The residual
         # history is an in-program buffer (no host callbacks — works on
@@ -454,7 +469,7 @@ class KSP:
             iters, rnorm, reason = jax.device_get((iters, rnorm, reason))
         from ..utils.profiling import record_sync
         record_sync("KSP result fetch/solve")
-        if monitored and not live:
+        if monitored and not delivered_live:
             # -1 is the unwritten sentinel (norms are nonnegative); a
             # recorded NaN residual passes `!= -1` and reaches the
             # monitors, as the callback path used to deliver it. Live mode
