@@ -176,18 +176,104 @@ def _p1d(c, ax: int, lo=None, hi=None):
     return out.reshape(sh)
 
 
-def _restrict(r, lo=None, hi=None):
-    """Full 3-axis restriction; z first (the only axis needing halos).
+# per-axis-length banded transfer matrices for the einsum path (host f64,
+# converted to the requested dtype at each call)
+_TMAT_CACHE: dict = {}
 
-    Staged per-axis slicing beats convs here: a 3D conv hits a pathological
-    XLA:TPU 5-D layout (68 GB copy at 512³) and a 2D conv with the z-planes
-    as batch runs single-channel (MXU-degenerate — measured +0.3 s on the
-    512³ solve)."""
+
+def _tmat(n: int, dtype):
+    """(n, n/2) one-axis restriction matrix: column i carries the weights
+    _RSCALE·[1/4, 3/4, 3/4, 1/4] on rows [2i-1, 2i+2] (zero ghosts).
+    Its transpose is the one-axis prolongation (the R = (1/2)Pᵀ pair, per
+    axis). A 512-wide axis costs 512×256×4B = 512 KB as a constant."""
+    # cache HOST numpy, convert per call: caching a jnp array built inside
+    # a trace would leak that trace's tracer into every later program
+    Wn = _TMAT_CACHE.get(n)
+    if Wn is None:
+        import numpy as np
+        Wn = np.zeros((n, n // 2))
+        i = np.arange(n // 2)
+        Wn[2 * i, i] = 0.75
+        Wn[2 * i + 1, i] = 0.75
+        Wn[2 * i[1:] - 1, i[1:]] = 0.25
+        Wn[2 * i[:-1] + 2, i[:-1]] = 0.25
+        Wn = _RSCALE * Wn
+        _TMAT_CACHE[n] = Wn
+    return jnp.asarray(Wn, dtype)
+
+
+def _mm_ok(dtype) -> bool:
+    """The einsum transfer path needs matmuls at working precision: CPU
+    always; TPU for f32 (f64 matmuls there carry ~f32 accumulation)."""
+    import jax
+    return (jax.default_backend() == "cpu"
+            or jnp.dtype(dtype) == jnp.dtype(jnp.float32))
+
+
+def _hp(*args, **kw):
+    import jax
+    return jnp.einsum(*args, precision=jax.lax.Precision.HIGHEST, **kw)
+
+
+def _restrict_mm(r, lo, hi):
+    """R as three banded-matrix einsums riding the MXU (~2.6 HBM passes
+    total) — the staged slicing chains cost ~17 passes at 512³ (measured),
+    a 3D conv hits a pathological XLA:TPU 5-D layout (68 GB copy), and a
+    single-channel 2D conv is MXU-degenerate; small dense (n, n/2)
+    constants with 4 nonzeros per column are the shape XLA handles well."""
+    nz, ny, nx = r.shape
+    dt = r.dtype
+    out = _hp("zyx,zc->cyx", r, _tmat(nz, dt))
+    out = _hp("cyx,yd->cdx", out, _tmat(ny, dt))
+    out = _hp("cdx,xe->cde", out, _tmat(nx, dt))
+    # the z-halo planes touch only the first/last coarse plane, each with
+    # total z-weight _RSCALE/4; y/x still restrict
+    if lo is not None:
+        c = _hp("yx,yd->dx", lo, _tmat(ny, dt))
+        c = _hp("dx,xe->de", c, _tmat(nx, dt))
+        out = out.at[0].add(jnp.asarray(_RSCALE * 0.25, dt) * c)
+    if hi is not None:
+        c = _hp("yx,yd->dx", hi, _tmat(ny, dt))
+        c = _hp("dx,xe->de", c, _tmat(nx, dt))
+        out = out.at[-1].add(jnp.asarray(_RSCALE * 0.25, dt) * c)
+    return out
+
+
+def _prolong_mm(e, lo, hi):
+    """P as the transposed einsums — the exact adjoint of
+    :func:`_restrict_mm` up to the global 1/2: P = 2·Rᵀ, and since the
+    three W factors carry _RSCALE each, the rescale is
+    2/(_RSCALE³·_RSCALE³)·_RSCALE³ = 1/_RSCALE³ (= 2, as _RSCALE³ = 1/2)."""
+    nzc, nyc, nxc = e.shape
+    dt = e.dtype
+    out = _hp("cyx,zc->zyx", e, _tmat(2 * nzc, dt))
+    out = _hp("zyx,dy->zdx", out, _tmat(2 * nyc, dt))
+    out = _hp("zdx,ex->zde", out, _tmat(2 * nxc, dt))
+    out = out * (jnp.asarray(1.0, dt) / jnp.asarray(_RSCALE ** 3, dt))
+    # coarse z-halo planes contribute quarter-weight to the boundary fine
+    # planes; y/x still prolong (1/_RSCALE² removes their R scaling)
+    if lo is not None:
+        c = _hp("yx,yd->dx", lo, _tmat(2 * nyc, dt).T)
+        c = _hp("dx,xe->de", c, _tmat(2 * nxc, dt).T)
+        out = out.at[0].add(jnp.asarray(0.25 / _RSCALE ** 2, dt) * c)
+    if hi is not None:
+        c = _hp("yx,yd->dx", hi, _tmat(2 * nyc, dt).T)
+        c = _hp("dx,xe->de", c, _tmat(2 * nxc, dt).T)
+        out = out.at[-1].add(jnp.asarray(0.25 / _RSCALE ** 2, dt) * c)
+    return out
+
+
+def _restrict(r, lo=None, hi=None):
+    """Full 3-axis restriction; z first (the only axis needing halos)."""
+    if _mm_ok(r.dtype):
+        return _restrict_mm(r, lo, hi)
     return _r1d(_r1d(_r1d(r, 0, lo, hi), 1), 2)
 
 
 def _prolong(e, lo=None, hi=None):
     """Full 3-axis prolongation; z first (the only axis needing halos)."""
+    if _mm_ok(e.dtype):
+        return _prolong_mm(e, lo, hi)
     return _p1d(_p1d(_p1d(e, 0, lo, hi), 1), 2)
 
 
