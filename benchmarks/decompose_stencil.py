@@ -16,6 +16,10 @@ Pieces:
 Usage: python benchmarks/decompose_stencil.py [--n 512] [--iters 40]
 Prints one JSON line per piece with ms/iter and HBM passes/iter
 (one pass = n³·4 bytes at the 819 GB/s v5e roof).
+
+With ``--vcycle`` the MG V-cycle is decomposed instead (the BASELINE.md
+V-cycle ablation): full cycle, smoothing-ablated cycle, and the isolated
+restriction/prolongation costs.
 """
 
 from __future__ import annotations
@@ -48,12 +52,68 @@ def time_loop(prog, args, iters_lo, iters_hi, reps=3):
     return (outs[1] - outs[0]) / (iters_hi - iters_lo)
 
 
+def vcycle_decomposition(nx: int):
+    """MG V-cycle ablation (the BASELINE.md table): full cycle,
+    smoothing-ablated cycle, isolated transfers."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+
+    r0 = jnp.full((nx, nx, nx), 1e-6, jnp.float32)
+    e0 = jnp.full((nx // 2,) * 3, 1e-6, jnp.float32)
+    passes_bytes = nx ** 3 * 4
+
+    def report(name, per_s):
+        print(json.dumps({"piece": name, "ms": round(per_s * 1e3, 3),
+                          "fine_passes": round(
+                              per_s * HBM_GBPS * 1e9 / passes_bytes, 2)}))
+
+    def cycle_loop():
+        cycle = mg.make_vcycle3d(nx, nx, nx)
+
+        @jax.jit
+        def loop(r, iters):
+            def body(_, r):
+                return cycle(r) * jnp.float32(1e-3)
+            return jax.lax.fori_loop(0, iters, body, r)[0, 0, :8]
+        return loop
+
+    report("vcycle", time_loop(cycle_loop(), (r0,), 8, 24))
+    orig = mg._sweep
+    mg._sweep = lambda u, f, lo, hi, omega=mg._OMEGA: u
+    try:
+        report("vcycle_no_smoothing", time_loop(cycle_loop(), (r0,), 8, 24))
+    finally:
+        mg._sweep = orig
+
+    def xfer_loop(fn, x):
+        @jax.jit
+        def loop(v, iters):
+            def body(_, c):
+                out = fn(c)
+                return c * jnp.float32(0.999) + \
+                    0 * jnp.float32(jnp.sum(out[0, 0, :4]))
+            return jax.lax.fori_loop(0, iters, body, v)[0, 0, :8]
+        return loop
+
+    report("restrict", time_loop(
+        xfer_loop(lambda r: mg._restrict(r), r0), (r0,), 16, 64))
+    report("prolong", time_loop(
+        xfer_loop(lambda e: mg._prolong(e), e0), (e0,), 16, 64))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--vcycle", action="store_true",
+                    help="decompose the MG V-cycle instead of the CG step")
     opts = ap.parse_args()
     nx = opts.n
+    if opts.vcycle:
+        return vcycle_decomposition(nx)
     lo, hi = opts.iters // 4, opts.iters
 
     import jax
