@@ -81,7 +81,7 @@ def vcycle_decomposition(nx: int):
 
     report("vcycle", time_loop(cycle_loop(), (r0,), 8, 24))
     orig = mg._sweep
-    mg._sweep = lambda u, f, lo, hi, omega=mg._OMEGA: u
+    mg._sweep = lambda u, f, lo, hi, omega=mg._OMEGA, platform=None: u
     try:
         report("vcycle_no_smoothing", time_loop(cycle_loop(), (r0,), 8, 24))
     finally:

@@ -53,12 +53,17 @@ def solve(comm, op, b, ksp_type, pc_type, rtol=RTOL, max_it=20000,
     ksp.restart = restart
     x, bv = op.get_vecs()
     bv.set_global(b)
+    t0 = time.perf_counter()
+    ksp.set_up()              # PC build + device_put, measured separately
+    pc_setup = time.perf_counter() - t0
     ksp.solve(bv, x)          # warm-up / compile
     x.zero()
     t0 = time.perf_counter()
     res = ksp.solve(bv, x)
     wall = time.perf_counter() - t0
-    return x.to_numpy(), res, wall
+    return x.to_numpy(), res, wall, dict(
+        pc_setup_s=round(pc_setup, 4),
+        safeguard_reentries=int(getattr(ksp, "_last_reentries", 0)))
 
 
 def true_relres(A, x, b):
@@ -113,7 +118,12 @@ def onchip_breakdown(comm, op, b, ksp_type, pc_type):
         ksp.set_operators(op)
         ksp.set_type(ksp_type)
         ksp.get_pc().set_type(pc_type)
-        ksp.set_norm_type("none")
+        if ksp_type not in tps.KSP._CYCLE_GRANULAR:
+            ksp.set_norm_type("none")
+        # cycle-granular kernels (gmres) reject norm 'none' AT SOLVE TIME
+        # (fixed-iteration contract can't hold); rtol=atol=0 already runs
+        # a fixed max_it worth of cycles, and delta_rate divides by ACTUAL
+        # iterations so the cycle rounding cancels
         ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=max_it)
         x, bv = op.get_vecs()
         bv.set_global(b)
@@ -130,6 +140,50 @@ def onchip_breakdown(comm, op, b, ksp_type, pc_type):
         fixed.append(time.perf_counter() - t0)
     return dict(onchip_per_iter_us=round(per_iter * 1e6, 2),
                 fixed_latency_ms=round(min(fixed) * 1e3, 1))
+
+
+def floor_fields(out, iters):
+    """Reconcile the e2e wall against its own measured floor (round-5
+    VERDICT items 3/6): floor = fixed dispatch latency + iters x on-chip
+    per-iteration time; the remainder is what the artifact must explain."""
+    if "onchip_per_iter_us" in out and "fixed_latency_ms" in out:
+        floor = (out["fixed_latency_ms"] / 1e3
+                 + iters * out["onchip_per_iter_us"] / 1e6)
+        out["floor_s"] = round(floor, 4)
+        out["unaccounted_s"] = round(out["wall_s"] - floor, 4)
+    return out
+
+
+# every config must carry the shared floor-accounting schema so future
+# rounds can't silently regress the instrumentation (VERDICT r4 item 6);
+# checked in main() before the artifact is written
+_REQUIRED_FIELDS = {
+    "cfg1_aij_assembly_cg_none": (
+        "wall_s", "assembly_s", "assembly_breakdown", "onchip_per_iter_us",
+        "fixed_latency_ms", "floor_s", "unaccounted_s", "safeguard_reentries",
+        "residual_parity"),
+    "cfg2_multirank_scatter_eigensolve_n4": (
+        "wall_s", "warm_s", "phases_s", "residual_parity"),
+    "cfg3_gmres_jacobi_poisson2d": (
+        "wall_s", "onchip_per_iter_us", "fixed_latency_ms", "floor_s",
+        "unaccounted_s", "safeguard_reentries", "residual_parity"),
+    "cfg4_bcgs_bjacobi_convdiff": (
+        "wall_s", "assembly_s", "pc_setup_s", "onchip_per_iter_us",
+        "fixed_latency_ms", "floor_s", "unaccounted_s",
+        "safeguard_reentries", "residual_parity"),
+    "cfg5_poisson3d_sharded_stencil": (
+        "wall_s", "mg_solve_s", "mg_verify_s", "onchip_per_iter_ms",
+        "residual_parity"),
+}
+
+
+def check_schema(results, quick=False):
+    if quick:       # --quick skips the slow delta-method fields by design
+        return
+    for c in results["configs"]:
+        need = _REQUIRED_FIELDS.get(c.get("config"), ())
+        missing = [k for k in need if k not in c]
+        assert not missing, (c.get("config"), missing)
 
 
 def manufactured(A, seed=0, dtype=np.float64):
@@ -150,7 +204,7 @@ def config1(comm, quick):
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
     assembly = time.perf_counter() - t0       # framework MatAssembly analog
     x_true, b = manufactured(A, dtype=np.float32)
-    x, res, wall = solve(comm, M, b, "cg", "none")
+    x, res, wall, extra = solve(comm, M, b, "cg", "none")
     x_cpu, cpu_iters, cpu = _counting(spla.cg, A, b, maxiter=20000)
     out = dict(config="cfg1_aij_assembly_cg_none", n=nx ** 3,
                model_build_s=round(model_build, 4),
@@ -158,11 +212,42 @@ def config1(comm, quick):
                assembly_breakdown=M.assembly_breakdown,
                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
                speedup=round(cpu / wall, 2),
-               speedup_incl_assembly=round(cpu / (wall + assembly), 2))
+               speedup_incl_assembly=round(cpu / (wall + assembly), 2),
+               **extra)
     out.update(parity_fields(res, true_relres(A, x, b),
                              cpu_iters, true_relres(A, x_cpu, b)))
     if not quick:
         out.update(onchip_breakdown(comm, M, b, "cg", "none"))
+        floor_fields(out, res.iterations)
+    return out
+
+
+def _cfg2_phases(spawn: float, wall: float, stamps: dict):
+    """Itemize a fresh cfg2 subprocess wall from its phase stamps
+    (utils/phases.py): interpreter+site, tpurun setup, driver imports,
+    tunnel init, scatter+assembly, eigensolve, teardown. Values are
+    seconds; 'unstamped' covers anything a missing stamp leaves behind,
+    so the parts always sum to wall_s."""
+    if "tpurun_main" not in stamps:
+        return {"unstamped": round(wall, 4)}
+    out = {}
+    t_end = spawn + wall
+    marks = [("interp_site", spawn, stamps.get("tpurun_main")),
+             ("driver_imports_init", stamps.get("tpurun_main"),
+              stamps.get("tunnel_init_begin")),
+             ("tunnel_init", stamps.get("tunnel_init_begin"),
+              stamps.get("tunnel_init_end")),
+             ("scatter_assembly", stamps.get("tunnel_init_end"),
+              stamps.get("mat_assembled")),
+             ("eigensolve", stamps.get("mat_assembled"),
+              stamps.get("eps_solved")),
+             ("teardown", stamps.get("eps_solved"), t_end)]
+    acc = 0.0
+    for name, a, b in marks:
+        if a is not None and b is not None and b >= a:
+            out[name] = round(b - a, 4)
+            acc += b - a
+    out["unstamped"] = round(max(wall - acc, 0.0), 4)
     return out
 
 
@@ -184,15 +269,34 @@ def config2(comm, quick):
            "-n", "4", os.path.join(REPO, "examples", "eigensolve.py")]
     # fresh-subprocess wall varies ±2x with tunnel-init load (BASELINE.md
     # cfg2 decomposition: init alone spans 0.16-8.8 s) — report the median
-    # of 3 fresh runs plus the spread
-    walls, ok = [], True
+    # of 3 fresh runs plus the spread, and phase-stamp each run
+    # (utils/phases.py) so the artifact reconciles the wall to named parts
+    # (round-5 VERDICT item 3)
+    import tempfile
+    walls, phase_runs, ok = [], [], True
     for _ in range(1 if quick else 3):
-        t0 = time.perf_counter()
-        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                           timeout=900, cwd=REPO)
-        walls.append(time.perf_counter() - t0)
-        ok = ok and r.returncode == 0 and "Eigenvalue:" in r.stdout
-    wall = sorted(walls)[len(walls) // 2]
+        with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+            env["TPU_SOLVE_PHASE_LOG"] = tf.name
+            spawn = time.time()
+            t0 = time.perf_counter()
+            r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                               timeout=900, cwd=REPO)
+            wall_i = time.perf_counter() - t0
+            walls.append(wall_i)
+            ok = ok and r.returncode == 0 and "Eigenvalue:" in r.stdout
+            try:
+                # keep the FIRST occurrence of each stamp: the 4 virtual
+                # ranks re-stamp collective points, and only the first
+                # carries the real cost (e.g. tunnel init happens once)
+                stamps = {}
+                for name, ts in json.load(open(tf.name)):
+                    stamps.setdefault(name, ts)
+            except Exception:  # noqa: BLE001 — phases are best-effort
+                stamps = {}
+            phase_runs.append(_cfg2_phases(spawn, wall_i, stamps))
+    order = sorted(range(len(walls)), key=walls.__getitem__)
+    mid = order[len(walls) // 2]
+    wall, phases = walls[mid], phase_runs[mid]
 
     # warm-process flow: the same tridiagonal HEP solve (largest magnitude,
     # nev=1 — reference test2.py defaults), timed on its second run
@@ -217,6 +321,7 @@ def config2(comm, quick):
     return dict(config="cfg2_multirank_scatter_eigensolve_n4", n=100,
                 wall_s=round(wall, 4),
                 wall_spread_s=[round(min(walls), 4), round(max(walls), 4)],
+                phases_s=phases,
                 warm_s=round(warm, 4),
                 eigenvalue_rel_err=float(eig_err),
                 residual_parity=bool(ok and eig_err <= 1e-8),
@@ -231,15 +336,18 @@ def config3(comm, quick):
     A = poisson2d_csr(nx)
     x_true, b = manufactured(A, dtype=np.float32)
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
-    x, res, wall = solve(comm, M, b, "gmres", "jacobi", max_it=40000)
+    x, res, wall, extra = solve(comm, M, b, "gmres", "jacobi", max_it=40000)
     Mj = spla.LinearOperator(A.shape, matvec=lambda v: v / A.diagonal())
     x_cpu, cpu_iters, cpu = _counting(spla.gmres, A, b, restart=30, M=Mj,
                                       callback_type="pr_norm")
     out = dict(config="cfg3_gmres_jacobi_poisson2d", n=nx * nx,
                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
-               speedup=round(cpu / wall, 2))
+               speedup=round(cpu / wall, 2), **extra)
     out.update(parity_fields(res, true_relres(A, x, b),
                              cpu_iters, true_relres(A, x_cpu, b)))
+    if not quick:
+        out.update(onchip_breakdown(comm, M, b, "gmres", "jacobi"))
+        floor_fields(out, res.iterations)
     return out
 
 
@@ -250,18 +358,22 @@ def config4(comm, quick):
     nx = 40 if quick else 256
     A = convdiff2d(nx, beta=0.4)
     x_true, b = manufactured(A, dtype=np.float32)
+    t0 = time.perf_counter()
     M = tps.Mat.from_scipy(comm, A, dtype=np.float32)
-    x, res, wall = solve(comm, M, b, "bcgs", "bjacobi")
+    assembly = time.perf_counter() - t0
+    x, res, wall, extra = solve(comm, M, b, "bcgs", "bjacobi")
     ilu = spla.spilu(A.tocsc())
     Mi = spla.LinearOperator(A.shape, matvec=ilu.solve)
     x_cpu, cpu_iters, cpu = _counting(spla.bicgstab, A, b, M=Mi)
     out = dict(config="cfg4_bcgs_bjacobi_convdiff", n=nx * nx,
+               assembly_s=round(assembly, 4),
                wall_s=round(wall, 4), cpu_wall_s=round(cpu, 4),
-               speedup=round(cpu / wall, 2))
+               speedup=round(cpu / wall, 2), **extra)
     out.update(parity_fields(res, true_relres(A, x, b),
                              cpu_iters, true_relres(A, x_cpu, b)))
     if not quick:
         out.update(onchip_breakdown(comm, M, b, "bcgs", "bjacobi"))
+        floor_fields(out, res.iterations)
     return out
 
 
@@ -293,10 +405,25 @@ def config5(comm, quick):
             op.mult(tps.Vec.from_global(comm, np.asarray(x))).to_numpy())
         return float(np.linalg.norm(r) / np.linalg.norm(b))
 
-    x_j, res_j, wall_j = solve(comm, op, b, "cg", "jacobi")
+    x_j, res_j, wall_j, _ = solve(comm, op, b, "cg", "jacobi")
     rres_j = op_relres(x_j)
-    x_m, res_m, wall_m = solve(comm, op, b, "cg", "mg")
+    x_m, res_m, wall_m, extra_m = solve(comm, op, b, "cg", "mg")
     rres_m = op_relres(x_m)
+    # verification split (round-5 VERDICT item 6): the same MG solve
+    # without the true-residual epilogue isolates what the gate's fused
+    # verification mult adds. Dispatch noise on the tunnel exceeds the
+    # epilogue's one stencil pass, so BOTH sides are best-of-3 (min
+    # suppresses the noise; the difference can still read slightly
+    # negative within residual jitter — reported as measured)
+    def best_of(true_check, reps=3):
+        walls = [solve(comm, op, b, "cg", "mg", true_check=true_check)[2]
+                 for _ in range(reps)]
+        return min(walls)
+    if quick:            # quick mode discards the split (check_schema)
+        mg_gate_s = mg_solve_s = wall_m
+    else:
+        mg_gate_s = best_of(True)
+        mg_solve_s = best_of(False)
     best = min(wall_j, wall_m)
 
     # on-chip rate: the shared delta-method protocol (bench.delta_rate)
@@ -327,6 +454,9 @@ def config5(comm, quick):
                e2e_mg_wall_s=round(wall_m, 4),
                e2e_mg_iters=res_m.iterations,
                rel_residual_mg=rres_m,
+               mg_solve_s=round(mg_solve_s, 4),
+               mg_verify_s=round(mg_gate_s - mg_solve_s, 4),
+               safeguard_reentries=extra_m["safeguard_reentries"],
                iters_per_s=round(res_j.iterations / wall_j, 1),
                onchip_per_iter_ms=round(1e3 * per, 3),
                onchip_iters_per_s=round(1.0 / per, 1) if per > 0 else 0.0)
@@ -359,6 +489,7 @@ def main():
     parities = [c.get("residual_parity") for c in results["configs"]]
     results["residual_parity_all"] = bool(all(p is True for p in parities))
     print(json.dumps({"residual_parity_all": results["residual_parity_all"]}))
+    check_schema(results, quick=opts.quick)
     if opts.out:
         with open(opts.out, "w") as f:
             json.dump(results, f, indent=2)

@@ -112,7 +112,8 @@ class StencilPoisson3D:
     def local_spmv(self, comm: DeviceComm):
         nx, ny, lz = self.nx, self.ny, self.lz
         from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
-        use_pallas = pallas_supported(ny, nx, self._dtype)
+        use_pallas = pallas_supported(ny, nx, self._dtype,
+                                      comm.platform)
         exchange = self._halo_exchange(comm)
 
         def spmv(op_local, x_local):
@@ -155,7 +156,8 @@ class StencilPoisson3D:
         nx, ny, lz = self.nx, self.ny, self.lz
         from ..ops.pallas_stencil import (pallas_supported,
                                           stencil3d_dot_pallas)
-        use_pallas = pallas_supported(ny, nx, self._dtype)
+        use_pallas = pallas_supported(ny, nx, self._dtype,
+                                      comm.platform)
         exchange = self._halo_exchange(comm)
 
         def matvec_dot(op_local, u):

@@ -189,23 +189,72 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
 # (~16MB) forces chunk=1 on 1MB planes (512² fp32), where every plane is
 # DMA'd ~3x (as a center plane and as both neighbours' edge planes) —
 # measured 7.3 HBM passes per apply at 512³ vs ~2.4 with real chunk depth.
-# The kernel therefore asks Mosaic for a higher limit (v5e VMEM is 128MB)
-# and plans its scratch against _VMEM_BUDGET.
-_VMEM_LIMIT = 64 << 20     # per-kernel limit requested from Mosaic
-_VMEM_BUDGET = 48 << 20    # scratch plan: 2 in-banks (chunk+2 planes each)
-#                            + 2 out-banks (chunk planes) + shift temps
-# Measured at 512³ fp32 (1MB planes): chunk=1 (old 16MB default) 7.3 HBM
-# passes/apply; chunk=8 (this plan) 5.0-5.2; chunk=16 (96MB limit) 7.1 —
-# more VMEM pressure hurts past chunk 8, so 64/48 is the sweet spot.
+# The kernel therefore asks Mosaic for a higher limit and plans its scratch
+# against a budget — BOTH derived from the device generation's physical
+# VMEM (requesting 64MB unconditionally would fail to compile on 16MB-VMEM
+# generations; ADVICE r4).
+#
+# Measured at 512³ fp32 (1MB planes) on v5e (128MB VMEM): chunk=1 (old
+# 16MB default) 7.3 HBM passes/apply; chunk=8 (64MB limit / 48MB budget)
+# 5.0-5.2; chunk=16 (96MB limit) 7.1 — more VMEM pressure hurts past
+# chunk 8, so half-of-VMEM capped at 64MB is the sweet spot.
+
+# physical VMEM per TensorCore by generation prefix of device_kind
+# (v2/v3: 16MB; v4 onward: 128MB — public TPU system architecture docs)
+_VMEM_BY_KIND = (("v2", 16 << 20), ("v3", 16 << 20))
+_VMEM_DEFAULT = 128 << 20
+
+
+@functools.lru_cache(maxsize=None)
+def _vmem_plan(device_kind: str | None):
+    """(mosaic_limit_or_None, scratch_budget) for a device generation.
+
+    The limit is half the physical VMEM capped at 64MB (the measured sweet
+    spot on 128MB parts); the budget is 3/4 of the limit, leaving headroom
+    for Mosaic's own temporaries. On generations whose default limit
+    already equals the plan (16MB parts → 8MB request would only shrink
+    it) no explicit limit is requested and the chunk plan just adapts.
+    ``device_kind=None`` (interpret mode / CPU meshes) keeps the 128MB-part
+    plan so host-side tests exercise the production chunk geometry.
+    """
+    vmem = _VMEM_DEFAULT
+    if device_kind:
+        kl = device_kind.lower()
+        for tag, size in _VMEM_BY_KIND:
+            if tag in kl:
+                vmem = size
+                break
+    limit = min(64 << 20, vmem // 2)
+    budget = (limit * 3) // 4
+    # a limit at/below Mosaic's ~16MB default buys nothing — don't request
+    return (limit if limit > (16 << 20) else None), budget
+
+
+def _tpu_device_kind():
+    try:
+        d = jax.devices()[0]
+        return d.device_kind if d.platform == "tpu" else None
+    except Exception:       # noqa: BLE001 — uninitialized backend
+        return None
+
+
+def _vmem_limit_params(interpret: bool):
+    """compiler_params carrying the per-generation VMEM limit (or None)."""
+    if interpret:
+        return None
+    limit, _ = _vmem_plan(_tpu_device_kind())
+    return pltpu.CompilerParams(vmem_limit_bytes=limit) if limit else None
 
 
 def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
                 max_chunk: int | None, banks: int = 4):
     """z-chunk that divides ``lz`` and keeps the scratch banks
     (= banks*chunk+4 planes; ``banks`` is 4, or 6 with an f-array) inside
-    ``_VMEM_BUDGET`` — the one pipeline geometry all entry points share."""
+    the device generation's scratch budget — the one pipeline geometry all
+    entry points share."""
     plane = ny * nx * itemsize
-    budget = int((_VMEM_BUDGET // plane - 4) // banks)
+    vmem_budget = _vmem_plan(_tpu_device_kind())[1]
+    budget = int((vmem_budget // plane - 4) // banks)
     if max_chunk is not None:
         budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
     chunk = max(1, min(lz, budget))
@@ -232,8 +281,7 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
 
@@ -263,8 +311,7 @@ def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, halo_lo, halo_hi)
     return y, dot[0]
@@ -299,8 +346,7 @@ def stencil3d_smooth_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, halo_lo, halo_hi, f)
 
@@ -325,15 +371,20 @@ def stencil3d_residual_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, halo_lo, halo_hi, f)
 
 
-def pallas_supported(ny: int, nx: int, dtype) -> bool:
-    """The kernel wants full (8,128)-tileable planes and a TPU backend."""
-    if jax.default_backend() != "tpu":
+def pallas_supported(ny: int, nx: int, dtype, platform: str | None = None
+                     ) -> bool:
+    """The kernel wants full (8,128)-tileable planes and TPU devices.
+
+    ``platform`` is the platform of the mesh the op actually runs on
+    (``comm.devices[0].platform``) — a CPU-device mesh inside a
+    TPU-capable process must NOT take the Mosaic path (ADVICE r4); when
+    omitted, falls back to the process default backend."""
+    if (platform or jax.default_backend()) != "tpu":
         return False
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),):
         return False

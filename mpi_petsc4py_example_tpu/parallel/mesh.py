@@ -38,7 +38,10 @@ class DeviceComm:
                  devices=None, n_devices: int | None = None):
         if mesh is None:
             if devices is None:
-                devices = jax.devices()
+                from ..utils.phases import stamp
+                stamp("tunnel_init_begin")   # first jax.devices() initializes
+                devices = jax.devices()      # the backend (tunnel on axon)
+                stamp("tunnel_init_end")
                 if n_devices is not None:
                     devices = devices[:n_devices]
             mesh = Mesh(np.asarray(devices), (axis,))
@@ -54,6 +57,13 @@ class DeviceComm:
     @property
     def devices(self):
         return list(self.mesh.devices.ravel())
+
+    @property
+    def platform(self) -> str:
+        """Platform of the mesh's devices ('cpu'/'tpu') — kernel fast-path
+        gates key on THIS, not the process default backend: a CPU-device
+        mesh in a TPU-capable process must take the CPU paths (ADVICE r4)."""
+        return self.mesh.devices.ravel()[0].platform
 
     def __repr__(self):
         return f"DeviceComm(size={self.size}, axis={self.axis!r})"

@@ -577,8 +577,32 @@ def _build_subspace_loop_program(comm: DeviceComm, op, ncv: int, nev: int,
         def blockA(Q):
             return jnp.stack([spmv(op_arrays, Q[j]) for j in range(ncv)])
 
-        def rr(Y):
-            Q, _, _ = _sym_orth(Y, axis)
+        def reseed_masked(Q, good, it):
+            # a _sym_orth-masked row is a ZERO row and the power step of a
+            # zero row stays zero — a numerically rank-deficient block
+            # would stall at max_it (the host loop's Householder QR
+            # re-injects orthogonal-complement directions instead; ADVICE
+            # r4). Re-fill masked rows with a counter-based pseudo-random
+            # direction (fold_in on iteration + shard index: deterministic
+            # and trace-safe) orthogonalized against the kept rows, then
+            # re-orthonormalize the block once.
+            def fill(Q):
+                key = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.PRNGKey(7), it), lax.axis_index(axis))
+                Z = jax.random.normal(key, Q.shape, rdt).astype(Q.dtype)
+                G = lax.psum(Z @ Q.conj().T, axis)
+                Z = Z - G @ Q
+                zn = jnp.sqrt(jnp.real(lax.psum(
+                    jnp.sum(Z.conj() * Z, axis=1), axis)))
+                Z = Z * (1.0 / jnp.maximum(zn, jnp.finfo(rdt).tiny)
+                         )[:, None].astype(Q.dtype)
+                Q2 = jnp.where(good[:, None], Q, Z)
+                return _sym_orth(Q2, axis, passes=1)[0]
+            return lax.cond(jnp.any(~good), fill, lambda q: q, Q)
+
+        def rr(Y, it):
+            Q, good, _ = _sym_orth(Y, axis)
+            Q = reseed_masked(Q, good, it)
             W = blockA(Q)
             Hm = lax.psum(Q.conj() @ W.T, axis)
             Hm = (Hm + Hm.conj().T) / 2.0
@@ -604,7 +628,7 @@ def _build_subspace_loop_program(comm: DeviceComm, op, ncv: int, nev: int,
 
         def body(st):
             Y, _, _, _, it, _ = st
-            Q, W, X, lam_o, rel, nconv = rr(Y)
+            Q, W, X, lam_o, rel, nconv = rr(Y, it)
             # power step — the host loop's Y <- A Q (the real-dtype
             # imaginary-part drop there is a no-op on these real carries)
             return (W, X, lam_o, rel, it + 1, nconv)

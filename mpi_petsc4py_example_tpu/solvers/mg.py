@@ -54,38 +54,43 @@ _RSCALE = 4.0 ** (1.0 / 3.0) / 2.0
 # XLA update chain. The jnp body (single shared definition,
 # models/stencil.py) covers everything else — coarse levels, f64, CPU.
 
-def _stencil7(u, halo_lo, halo_hi):
+def _stencil7(u, halo_lo, halo_hi, platform=None):
     """7-point Dirichlet Laplacian on a z-slab with explicit z-halo planes
-    (jnp body; the Pallas fast paths live in _sweep/_residual)."""
+    (jnp body; the Pallas fast paths live in _sweep/_residual).
+
+    ``platform`` is the SOLVE MESH's platform (comm.platform) — the Mosaic
+    gate must not key on the process default backend (ADVICE r4: a
+    CPU-device mesh in a TPU-capable process would otherwise attempt
+    Mosaic kernels on CPU devices)."""
     from ..models.stencil import StencilPoisson3D
     from ..ops.pallas_stencil import pallas_supported, stencil3d_apply_pallas
     lz, ny, nx = u.shape
-    if pallas_supported(ny, nx, u.dtype):
+    if pallas_supported(ny, nx, u.dtype, platform):
         return stencil3d_apply_pallas(u, halo_lo[None], halo_hi[None],
                                       lz, ny, nx)
     return StencilPoisson3D._stencil7_jnp(u, halo_lo, halo_hi)
 
 
-def _sweep(u, f, halo_lo, halo_hi, omega: float = _OMEGA):
+def _sweep(u, f, halo_lo, halo_hi, omega: float = _OMEGA, platform=None):
     """One damped-Jacobi sweep ``u + (ω/6)(f - A u)`` — fused Pallas pass
     where supported."""
     from ..ops.pallas_stencil import pallas_supported, stencil3d_smooth_pallas
     lz, ny, nx = u.shape
-    if pallas_supported(ny, nx, u.dtype):
+    if pallas_supported(ny, nx, u.dtype, platform):
         return stencil3d_smooth_pallas(u, f, halo_lo[None], halo_hi[None],
                                        lz, ny, nx, omega / 6.0)
-    return u + (omega / 6.0) * (f - _stencil7(u, halo_lo, halo_hi))
+    return u + (omega / 6.0) * (f - _stencil7(u, halo_lo, halo_hi, platform))
 
 
-def _residual(u, f, halo_lo, halo_hi):
+def _residual(u, f, halo_lo, halo_hi, platform=None):
     """Residual ``f - A u`` — fused Pallas pass where supported."""
     from ..ops.pallas_stencil import (pallas_supported,
                                       stencil3d_residual_pallas)
     lz, ny, nx = u.shape
-    if pallas_supported(ny, nx, u.dtype):
+    if pallas_supported(ny, nx, u.dtype, platform):
         return stencil3d_residual_pallas(u, f, halo_lo[None], halo_hi[None],
                                          lz, ny, nx)
-    return f - _stencil7(u, halo_lo, halo_hi)
+    return f - _stencil7(u, halo_lo, halo_hi, platform)
 
 
 def _zeros_plane(u):
@@ -108,24 +113,67 @@ def _mk_exchange(axis, ndev):
     return make_plane_exchange(axis, ndev)
 
 
-def _smooth(u, f, iters: int, exchange, omega: float = _OMEGA):
-    """``iters`` damped-Jacobi sweeps for the unit 7-point stencil."""
+def cheby_omegas(degree: int, b: float = 2.0, a_frac: float = 0.25):
+    """Per-sweep damping factors realizing a degree-``degree`` Chebyshev
+    polynomial smoother as plain damped-Jacobi sweeps (round 5).
+
+    With the UNIFORM diagonal D = 6I, every sweep ``u + (ω/6)(f - A u)``
+    is a polynomial factor ``(I - ω·Ã)`` in ``Ã = A/6``; choosing the ω_j
+    as inverses of the Chebyshev-T_degree roots on ``[a_frac·b, b]``
+    (⊂ spectrum(Ã) ⊂ (0, 2)) makes the product the min-max-optimal
+    residual polynomial on that interval — the textbook Chebyshev smoother
+    at EXACTLY the cost of the same number of Jacobi sweeps: same fused
+    Pallas pass per sweep, no auxiliary carry vector, no reductions, no
+    setup eigenestimate (the stencil's λ_max(Ã) < 2 is analytic). The
+    factors commute (all polynomials in A), so pre/post applying the same
+    ω-set in any order keeps the V-cycle a symmetric operator (module
+    docstring) — a valid CG preconditioner.
+
+    Measured (CG+MG to rtol 1e-8, fp64 CPU mesh): 32³/64³/128³ take
+    9/11/12 iterations vs 11/12/14 with the fixed-ω Jacobi pair — same
+    cycle cost, ~10-18% fewer cycles.
+    """
+    import math
+    lo = a_frac * b
+    mid, half = (b + lo) / 2.0, (b - lo) / 2.0
+    roots = [mid + half * math.cos(math.pi * (2 * j - 1) / (2 * degree))
+             for j in range(1, degree + 1)]
+    return tuple(1.0 / r for r in roots)
+
+
+def _smooth(u, f, iters: int, exchange, omega=_OMEGA, platform=None):
+    """Damped-Jacobi sweeps for the unit 7-point stencil; ``omega`` may be
+    a scalar (``iters`` equal sweeps, fori_loop) or a tuple of per-sweep
+    factors (a Chebyshev-root schedule, unrolled — see cheby_omegas)."""
+    if isinstance(omega, (tuple, list)):
+        for w in omega:
+            lo, hi = exchange(u)
+            u = _sweep(u, f, lo, hi, w, platform)
+        return u
     if iters <= 0:
         return u
 
     def body(_, u):
         lo, hi = exchange(u)
-        return _sweep(u, f, lo, hi, omega)
+        return _sweep(u, f, lo, hi, omega, platform)
 
     return lax.fori_loop(0, iters, body, u)
 
 
-def _smooth0(f, iters: int, exchange, omega: float = _OMEGA):
+def _smooth0(f, iters: int, exchange, omega=_OMEGA, platform=None):
     """Sweeps from a ZERO initial guess: the first sweep is the closed form
-    ``u = (ω/6) f`` — no stencil apply, no halo exchange."""
+    ``u = (ω/6) f`` — no stencil apply, no halo exchange. A scalar ω keeps
+    the remaining sweeps in a fori_loop (the 20-sweep coarse solve must
+    not unroll); a Chebyshev ω tuple unrolls its (short) remainder."""
+    if isinstance(omega, (tuple, list)):
+        ws = tuple(float(w) for w in omega)
+        if not ws:
+            return jnp.zeros_like(f)
+        return _smooth((ws[0] / 6.0) * f, f, 0, exchange, ws[1:], platform)
     if iters <= 0:
         return jnp.zeros_like(f)
-    return _smooth((omega / 6.0) * f, f, iters - 1, exchange, omega)
+    return _smooth((omega / 6.0) * f, f, iters - 1, exchange, omega,
+                   platform)
 
 
 def _r1d(f, ax: int, lo=None, hi=None):
@@ -202,11 +250,13 @@ def _tmat(n: int, dtype):
     return jnp.asarray(Wn, dtype)
 
 
-def _mm_ok(dtype) -> bool:
+def _mm_ok(dtype, platform=None) -> bool:
     """The einsum transfer path needs matmuls at working precision: CPU
-    always; TPU for f32 (f64 matmuls there carry ~f32 accumulation)."""
+    always; TPU for f32 (f64 matmuls there carry ~f32 accumulation).
+    ``platform`` is the solve mesh's platform (ADVICE r4), defaulting to
+    the process backend."""
     import jax
-    return (jax.default_backend() == "cpu"
+    return ((platform or jax.default_backend()) == "cpu"
             or jnp.dtype(dtype) == jnp.dtype(jnp.float32))
 
 
@@ -263,16 +313,16 @@ def _prolong_mm(e, lo, hi):
     return out
 
 
-def _restrict(r, lo=None, hi=None):
+def _restrict(r, lo=None, hi=None, platform=None):
     """Full 3-axis restriction; z first (the only axis needing halos)."""
-    if _mm_ok(r.dtype):
+    if _mm_ok(r.dtype, platform):
         return _restrict_mm(r, lo, hi)
     return _r1d(_r1d(_r1d(r, 0, lo, hi), 1), 2)
 
 
-def _prolong(e, lo=None, hi=None):
+def _prolong(e, lo=None, hi=None, platform=None):
     """Full 3-axis prolongation; z first (the only axis needing halos)."""
-    if _mm_ok(e.dtype):
+    if _mm_ok(e.dtype, platform):
         return _prolong_mm(e, lo, hi)
     return _p1d(_p1d(_p1d(e, 0, lo, hi), 1), 2)
 
@@ -286,7 +336,9 @@ def mg_levels(nz: int, ny: int, nx: int, min_dim: int = 4):
 
 
 def make_vcycle3d(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
-                  coarse_iters: int = 20, axis=None, ndev: int = 1):
+                  coarse_iters: int = 20, axis=None, ndev: int = 1,
+                  platform: str | None = None,
+                  smoother: str = "chebyshev"):
     """Return ``cycle(r_slab (lz,ny,nx)) -> z_slab`` approximating A⁻¹ r —
     the 3D-native form the stencil-CG fast path composes with its
     grid-shaped loop carries (no flat↔3D reshapes inside the Krylov loop;
@@ -296,18 +348,36 @@ def make_vcycle3d(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
     ``ndev == 1`` the cycle is fully local; with ``ndev > 1`` it must run
     inside shard_map over mesh axis ``axis`` and operates on the local
     z-slab (``nz/ndev`` planes), slab-decomposed per the module docstring.
+    ``platform`` is the platform of the mesh the cycle runs on
+    (``comm.platform``) — it gates the Mosaic and einsum fast paths
+    (ADVICE r4: the process default backend is the wrong key for a
+    CPU-device mesh in a TPU-capable process).
+
+    ``smoother``: ``'chebyshev'`` (default, round 5) runs the pre/post
+    sweeps with the Chebyshev-root ω schedule (:func:`cheby_omegas` —
+    same per-sweep cost as Jacobi, better smoothing: 14 → 12 CG its at
+    128³); ``'jacobi'`` keeps the fixed ω = 2/3 pair.
     """
     levels = mg_levels(nz, ny, nx)
+    if smoother == "chebyshev":
+        pre_w, post_w = cheby_omegas(pre), cheby_omegas(post)
+    elif smoother == "jacobi":
+        pre_w, post_w = _OMEGA, _OMEGA
+    else:
+        raise ValueError(f"unknown MG smoother {smoother!r}; "
+                         "available: 'chebyshev', 'jacobi'")
 
     def local_cycle(f, li: int):
         if li == len(levels) - 1:
-            return _smooth0(f, coarse_iters, _no_exchange)
-        u = _smooth0(f, pre, _no_exchange)
+            return _smooth0(f, coarse_iters, _no_exchange,
+                            platform=platform)
+        u = _smooth0(f, pre, _no_exchange, omega=pre_w, platform=platform)
         lo, hi = _no_exchange(u)
-        r = _residual(u, f, lo, hi)
-        e_c = local_cycle(_restrict(r), li + 1)
-        u = u + _prolong(e_c)
-        return _smooth(u, f, post, _no_exchange)
+        r = _residual(u, f, lo, hi, platform)
+        e_c = local_cycle(_restrict(r, platform=platform), li + 1)
+        u = u + _prolong(e_c, platform=platform)
+        return _smooth(u, f, post, _no_exchange, omega=post_w,
+                       platform=platform)
 
     if ndev == 1:
         return lambda f: local_cycle(f, 0)
@@ -334,24 +404,27 @@ def make_vcycle3d(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
             e_full = local_cycle(f_full, li)
             i = lax.axis_index(axis)
             return lax.dynamic_slice_in_dim(e_full, i * lzi, lzi, axis=0)
-        u = _smooth0(f, pre, exchange)
+        u = _smooth0(f, pre, exchange, omega=pre_w, platform=platform)
         lo, hi = exchange(u)
-        r = _residual(u, f, lo, hi)
+        r = _residual(u, f, lo, hi, platform)
         rlo, rhi = exchange(r)
-        e_c = slab_cycle(_restrict(r, rlo, rhi), li + 1)
+        e_c = slab_cycle(_restrict(r, rlo, rhi, platform), li + 1)
         elo, ehi = exchange(e_c)
-        u = u + _prolong(e_c, elo, ehi)
-        return _smooth(u, f, post, exchange)
+        u = u + _prolong(e_c, elo, ehi, platform)
+        return _smooth(u, f, post, exchange, omega=post_w,
+                       platform=platform)
 
     return lambda f: slab_cycle(f, 0)
 
 
 def make_vcycle(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
-                coarse_iters: int = 20, axis=None, ndev: int = 1):
+                coarse_iters: int = 20, axis=None, ndev: int = 1,
+                platform: str | None = None, smoother: str = "chebyshev"):
     """Flat-vector wrapper over :func:`make_vcycle3d`:
     ``vcycle(r_local_flat) -> z_local_flat`` (the generic PC-apply shape)."""
     cycle = make_vcycle3d(nz, ny, nx, pre=pre, post=post,
-                          coarse_iters=coarse_iters, axis=axis, ndev=ndev)
+                          coarse_iters=coarse_iters, axis=axis, ndev=ndev,
+                          platform=platform, smoother=smoother)
     lz = nz // ndev
 
     def vcycle(r_flat):

@@ -67,17 +67,24 @@ class PC:
         self._mat: Mat | None = None
         self._arrays = ()
         self._built_for = None
-        self._factor_mode = "dense"  # 'dense' | 'crtri' | 'crband' (set in
-                                     # set_up for lu/cholesky: banded
-                                     # operators past the dense cap use
-                                     # scalar/block parallel cyclic
-                                     # reduction, solvers/tridiag.py)
+        self._factor_mode = "dense"  # 'dense' | 'crtri' | 'crband' |
+                                     # 'hostlu' (set in set_up for
+                                     # lu/cholesky: banded operators past
+                                     # the dense cap use scalar/block
+                                     # parallel cyclic reduction,
+                                     # solvers/tridiag.py; irreducible
+                                     # sparsity past every device cap
+                                     # factorizes on HOST, _build_host_splu)
+        self._hostlu = None          # (SuperLU factor, fp64 csr) in hostlu
         self.sor_omega = 1.0        # -pc_sor_omega (PETSc default 1)
         self.asm_overlap = 1        # -pc_asm_overlap (PETSc default 1)
         self.factor_fill = 10.0     # -pc_factor_fill (spilu fill_factor)
         self.gamg_threshold = 0.0   # -pc_gamg_threshold (PCGAMG default 0)
         self.gamg_coarse_size = 64  # -pc_gamg_coarse_eq_limit analog
         self.gamg_max_levels = 10   # -pc_mg_levels analog
+        self.mg_smoother = "chebyshev"  # -pc_mg_smooth_type: 'chebyshev'
+                                    # (Chebyshev-root omega schedule, round
+                                    # 5) | 'jacobi' (fixed omega = 2/3)
         self.bjacobi_blocks = 0     # -pc_bjacobi_blocks (0 = one per device,
                                     # auto-split past the dense cap)
         self._amg = None
@@ -195,7 +202,8 @@ class PC:
         return (self._type, self.sor_omega, self.asm_overlap,
                 self.factor_fill, self.gamg_threshold,
                 self.gamg_coarse_size, self.gamg_max_levels,
-                self.bjacobi_blocks, self._shell_uid, self.composite_type,
+                self.mg_smoother, self.bjacobi_blocks, self._shell_uid,
+                self.composite_type,
                 tuple(c._tunables_key() for c in self._sub_pcs))
 
     # ---- setup: build sharded device-side data ------------------------------
@@ -213,6 +221,10 @@ class PC:
             return self
         comm = mat.comm
         t = self._type
+        # a rebuild must not pin a previous hostlu factorization (SuperLU
+        # factor + fp64 CSR can be hundreds of MB) whatever mode it
+        # resolves to now
+        self._hostlu = None
         if t == "none":
             self._arrays = ()
         elif t == "jacobi":
@@ -269,8 +281,15 @@ class PC:
                         comm, mat, max(bw_rcm, 2), perm=perm, A_perm=A_perm)
                     self._factor_mode = "crband"
                 else:
-                    raise ValueError(_bcr_too_big_msg(t, n, bw_rcm,
-                                                      rcm=True))
+                    # irreducible sparsity past every device-direct cap:
+                    # factorize on HOST with scipy's SuperLU (no less
+                    # faithful than the reference, whose MUMPS is itself a
+                    # CPU library behind test.py:43 [external]); the solve
+                    # applies host-side under KSP 'preonly' (see
+                    # KSP._solve_hostlu and PARITY.md 'Direct solves')
+                    self._arrays = ()
+                    self._hostlu = _build_host_splu(mat, t)
+                    self._factor_mode = "hostlu"
             else:
                 self._arrays = _build_dense_lu(comm, mat)
                 self._factor_mode = "dense"
@@ -323,8 +342,8 @@ class PC:
     @property
     def kind(self) -> str:
         t = self._type
-        if t in ("lu", "cholesky") and self._factor_mode in ("crtri",
-                                                             "crband"):
+        if t in ("lu", "cholesky") and self._factor_mode in (
+                "crtri", "crband", "hostlu"):
             return self._factor_mode
         if t == "cholesky":
             return "lu"
@@ -354,6 +373,9 @@ class PC:
             # (S, N, b) and the perm presence are baked into the apply loop
             return ("crband", len(self._arrays)) + tuple(
                 int(s) for s in self._arrays[0].shape[:3])
+        if self.kind == "mg":
+            # the smoother's omega schedule is baked into the V-cycle
+            return ("mg", self.mg_smoother)
         if self.kind == "shell":
             return ("shell", self._shell_uid)
         if self.kind == "composite":
@@ -406,6 +428,14 @@ class PC:
         axis = comm.axis
         lsize = comm.local_size(n)
 
+        if k == "hostlu":
+            raise ValueError(
+                "PC 'lu'/'cholesky' fell back to the host sparse-LU mode "
+                "(irreducible sparsity past the dense/banded device caps); "
+                "the factor applies on HOST, which an in-program iterative "
+                "apply cannot call — use KSP 'preonly' (the reference's "
+                "MUMPS configuration, test.py:38-43), or an iterative KSP "
+                "with pc 'gamg'/'bjacobi' (PARITY.md 'Direct solves')")
         if k == "none":
             return lambda arrs, r: r
         if k == "jacobi":
@@ -529,7 +559,8 @@ class PC:
             # halo planes ride ppermute rings (solvers/mg.py docstring);
             # only the tiny coarse tail is gathered
             vcycle = make_vcycle(op.nz, op.ny, op.nx, axis=axis,
-                                 ndev=comm.size)
+                                 ndev=comm.size, platform=comm.platform,
+                                 smoother=self.mg_smoother)
             return lambda arrs, r: vcycle(r)
         raise AssertionError(k)
 
@@ -547,7 +578,8 @@ class PC:
         from .mg import make_vcycle3d
         op = self._mat
         cycle = make_vcycle3d(op.nz, op.ny, op.nx, axis=comm.axis,
-                              ndev=comm.size)
+                              ndev=comm.size, platform=comm.platform,
+                              smoother=self.mg_smoother)
         return lambda arrs, r: cycle(r)
 
     def local_apply_transpose(self, comm: DeviceComm, n: int):
@@ -859,17 +891,26 @@ def _bcr_fits(n: int, b: int) -> bool:
     return 1 < b <= _BCR_MAX_BW and _bcr_elements(n, b) <= _BCR_ELEM_CAP
 
 
-def _bcr_too_big_msg(t: str, n: int, bw: int, rcm: bool = False) -> str:
-    how = ("bandwidth (after RCM reordering) " if rcm else "bandwidth ")
-    limit = (f"needs {_bcr_elements(n, max(bw, 2)):.2e} elements "
-             f"> the {_BCR_ELEM_CAP:.0e} cap"
-             if bw <= _BCR_MAX_BW else
-             f"exceeds the b <= {_BCR_MAX_BW} block cap")
-    return (f"PC {t!r} (block cyclic reduction) replicates "
-            f"(2*ceil(log2(n/b))+1)*n*b sweep elements per device; "
-            f"n={n} at {how}{bw} {limit} (see PARITY.md 'Direct "
-            "solves' for where banded-direct stops paying) — use an "
-            "iterative KSP with pc 'jacobi'/'gamg' instead")
+def _build_host_splu(mat: Mat, pc_type: str):
+    """Host sparse LU — the MUMPS slot's irreducible-sparsity closing move.
+
+    The reference direct-solves ARBITRARY sparsity through MUMPS
+    (``test.py:43`` [external]) — a CPU library invoked from Python, so a
+    host factorization here is exactly as faithful. scipy's SuperLU
+    (COLAMD fill-reducing ordering + partial pivoting) factorizes in fp64
+    (complex128 for complex operators) regardless of the device dtype;
+    the apply happens host-side under KSP 'preonly' (KSP._solve_hostlu) —
+    one gather + one factor solve + one scatter, the same host round trip
+    MUMPS pays. Cost honestly measured in PARITY.md 'Direct solves'."""
+    from scipy.sparse.linalg import splu
+    _require_assembled(mat, pc_type)
+    A = mat.to_scipy()
+    dt = (np.complex128 if np.issubdtype(A.dtype, np.complexfloating)
+          else np.float64)
+    A64 = A.astype(dt).tocsc()
+    # hand back the SAME csc used for factorization (csc @ vector works) —
+    # a separate csr copy would double the persistent host footprint
+    return splu(A64), A64
 
 
 def _rcm_bandwidth(mat: Mat):

@@ -30,7 +30,44 @@ default; KSPPREONLY's iterative-refinement steps polish the rest (see
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+
+def _pmap_blocks(fn, *arrays):
+    """Apply ``fn`` over chunks of the leading (batch) axis on a host
+    thread pool — numpy/LAPACK release the GIL, so batched inversions /
+    solves / matmuls scale with cores (round-5 VERDICT item 5: the BPCR
+    setup's batched b×b work is embarrassingly parallel). Single-core
+    hosts (this dev box: ``nproc`` = 1, PARITY.md 'Direct solves') run
+    inline with zero overhead."""
+    ncpu = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    N = arrays[0].shape[0]
+    if ncpu <= 1 or N < 2 * ncpu:
+        return fn(*arrays)
+    import concurrent.futures as cf
+    bounds = np.linspace(0, N, 2 * ncpu + 1, dtype=int)
+    out = None
+    with cf.ThreadPoolExecutor(ncpu) as ex:
+        futs = {ex.submit(fn, *(a[s:e] for a in arrays)): (s, e)
+                for s, e in zip(bounds[:-1], bounds[1:]) if e > s}
+        for fut in cf.as_completed(futs):
+            s, e = futs[fut]
+            res = fut.result()
+            if out is None:
+                out = np.empty((N,) + res.shape[1:], res.dtype)
+            out[s:e] = res
+    return out
+
+
+def _neg_right_div(X, B):
+    """``-X @ B^{-1}`` via a batched LAPACK solve — ~30% fewer flops than
+    forming the inverse and multiplying (getrf+getrs vs getrf+getri+gemm),
+    the setup's inner-loop operation. Raises LinAlgError on singular B."""
+    Yt = np.linalg.solve(np.swapaxes(B, -1, -2), -np.swapaxes(X, -1, -2))
+    return np.ascontiguousarray(np.swapaxes(Yt, -1, -2))
 
 
 def pcr_setup(a: np.ndarray, b: np.ndarray, c: np.ndarray,
@@ -290,7 +327,7 @@ def bpcr_setup(Ab, Bb, Cb, apply_dtype=None):
 
     def binv_or_raise(M, what):
         try:
-            return np.linalg.inv(M)
+            return _pmap_blocks(np.linalg.inv, M)
         except np.linalg.LinAlgError:
             raise ValueError(
                 f"block PCR hit a singular {what} block — the pivotless "
@@ -300,16 +337,26 @@ def bpcr_setup(Ab, Bb, Cb, apply_dtype=None):
 
     for k in range(S):
         s = 1 << k
-        Bu_inv = binv_or_raise(shift(B, s, fill_identity=True), "shifted")
-        Bd_inv = binv_or_raise(shift(B, -s, fill_identity=True), "shifted")
-        alpha = -np.matmul(A, Bu_inv)
-        gamma = -np.matmul(C, Bd_inv)
+        # alpha = -A Bu^{-1}, gamma = -C Bd^{-1}: batched right-division
+        # (no explicit inverses — _neg_right_div), chunked across host
+        # cores (_pmap_blocks); both are the setup's dominant cost
+        try:
+            alpha = _pmap_blocks(_neg_right_div, A,
+                                 shift(B, s, fill_identity=True))
+            gamma = _pmap_blocks(_neg_right_div, C,
+                                 shift(B, -s, fill_identity=True))
+        except np.linalg.LinAlgError:
+            raise ValueError(
+                "block PCR hit a singular shifted block — the pivotless "
+                "cross-block reduction needs nonsingular (ideally "
+                "dominant) diagonal blocks; use an iterative KSP with pc "
+                "'jacobi'/'gamg' instead") from None
         alphas[k] = alpha
         gammas[k] = gamma
-        A_new = np.matmul(alpha, shift(A, s))
-        C_new = np.matmul(gamma, shift(C, -s))
-        B_new = (B + np.matmul(alpha, shift(C, s))
-                 + np.matmul(gamma, shift(A, -s)))
+        A_new = _pmap_blocks(np.matmul, alpha, shift(A, s))
+        C_new = _pmap_blocks(np.matmul, gamma, shift(C, -s))
+        B_new = (B + _pmap_blocks(np.matmul, alpha, shift(C, s))
+                 + _pmap_blocks(np.matmul, gamma, shift(A, -s)))
         if not np.all(np.isfinite(B_new)):
             raise ValueError(
                 "block PCR reduction broke down (non-finite reduced "

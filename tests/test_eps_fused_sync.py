@@ -43,6 +43,38 @@ class TestFusedSyncCounts:
         assert eps.result.iterations > 4, "trivial solve can't pin the claim"
         assert syncs <= 4, profiling.sync_counts()
 
+    def test_subspace_reseeds_rank_deficient_block(self, comm8):
+        """A start block with a repeated row is rank-deficient: _sym_orth
+        masks the dependent direction to a ZERO row, and without re-seeding
+        the power step keeps it zero forever (ADVICE r4 — the zero Ritz row
+        even has zero residual, i.e. a silently wrong 0-eigenvalue). The
+        fused loop must re-inject a fresh direction and converge to the
+        true spectrum."""
+        from jax.sharding import PartitionSpec as P
+        from mpi_petsc4py_example_tpu.solvers.eps import (
+            _build_subspace_loop_program)
+        n = 64
+        A = sp.diags([np.arange(1.0, float(n + 1))], [0]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        ncv, nev = 3, 3
+        npad = comm8.padded_size(n)
+        rng = np.random.default_rng(5)
+        Y = rng.standard_normal((ncv, npad))
+        # rank-2 block with nev=3: the masked third direction is NEEDED —
+        # without re-seeding the loop reports a spurious 0-eigenvalue
+        # (zero row → zero residual → "converged")
+        Y[1] = Y[0]
+        Y[:, n:] = 0.0
+        prog = _build_subspace_loop_program(
+            comm8, M, ncv, nev, which="largest_magnitude", st_type="shift")
+        X, lam, rel, it, nconv = prog(
+            M.device_arrays(), comm8.put_spec(Y, P(None, comm8.axis)),
+            np.float64(1e-9), np.float64(0.0), np.float64(0.0),
+            np.int32(2000))
+        assert int(nconv) >= nev, (int(nconv), np.asarray(rel))
+        lam = np.sort(np.asarray(lam)[:nev])[::-1]
+        assert np.allclose(lam, [n, n - 1, n - 2], atol=1e-6), lam
+
     def test_lobpcg_syncs_constant(self, comm8):
         A = _tridiag(80)
         M = tps.Mat.from_scipy(comm8, A)

@@ -143,20 +143,24 @@ class TestDirect:
         ksp.solve(bv, x)
         np.testing.assert_allclose(x.to_numpy(), x_true, rtol=1e-10)
 
-    def test_lu_rejects_huge(self, comm1):
-        """Past the dense cap, operators whose bandwidth exceeds the
-        block-CR memory model are rejected with the model spelled out and
-        a pointer to the PARITY.md cost table; reducible ones take the
-        (RCM+)cyclic-reduction path instead (tests/test_rcm_direct.py)."""
+    def test_lu_huge_irreducible_takes_hostlu(self, comm1, monkeypatch):
+        """Round 5: past the dense cap, irreducible sparsity the block-CR
+        model cannot hold no longer REJECTS — it routes into the host
+        sparse-LU fallback (the MUMPS slot's closing move; full coverage
+        in tests/test_rcm_direct.py). Caps are patched small so the test
+        factorizes a tiny system through the same dispatch."""
+        import mpi_petsc4py_example_tpu.solvers.pc as pcmod
+        monkeypatch.setattr(pcmod, "_DENSE_CAP", 128)
+        monkeypatch.setattr(pcmod, "_BCR_ELEM_CAP", 500)
         pc = tps.PC()
         pc.set_type("lu")
-        n = 30000
+        n = 400
         rng = np.random.default_rng(1)
-        R = sp.random(n, n, density=2e-4, format="csr", random_state=rng)
+        R = sp.random(n, n, density=0.02, format="csr", random_state=rng)
         A = (R + R.T + sp.eye(n) * 50.0).tocsr()
         M = tps.Mat.from_scipy(comm1, A)
-        with pytest.raises(ValueError, match="PARITY.md"):
-            pc.set_up(M)
+        pc.set_up(M)
+        assert pc._factor_mode == "hostlu"
 
 
 class TestKSPObject:

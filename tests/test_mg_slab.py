@@ -91,6 +91,54 @@ class TestSlabVcycle:
         np.testing.assert_allclose(x, x_true, rtol=1e-5, atol=1e-7)
 
 
+class TestSlabHaloVolume:
+    def test_slab_levels_have_no_full_gather(self, comm8):
+        """Round-5 VERDICT #7: the slab V-cycle's scaling claim rests on
+        O(plane) ppermute traffic per level. Pin it structurally: lower the
+        8-device cycle to StableHLO and assert the ONLY all-gather is the
+        tiny coarse tail (levels[split] — 8³ here), every slab level riding
+        collective_permute halo planes. A refactor that silently
+        reintroduces the round-3 gather-and-replicate cycle fails this."""
+        import re
+
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_petsc4py_example_tpu.solvers.mg import (make_vcycle3d,
+                                                         mg_levels)
+        comm = comm8
+        nz = ny = nx = 64
+        cycle = make_vcycle3d(nz, ny, nx, axis=comm.axis, ndev=comm.size,
+                              platform=comm.platform)
+        fn = jax.jit(comm.shard_map(lambda f: cycle(f),
+                                    (P(comm.axis),), P(comm.axis)))
+        txt = fn.lower(jax.ShapeDtypeStruct((nz, ny, nx),
+                                            jnp.float64)).as_text()
+        # slab-eligible prefix for nz=64 over 8 devices: 64/32/16 planes
+        # (each % 16 == 0); the tail gathers at (8, 8, 8) = 512 elements
+        levels = mg_levels(nz, ny, nx)
+        split = 0
+        while (split < len(levels) - 1
+               and levels[split][0] % (2 * comm.size) == 0):
+            split += 1
+        tail_elems = int(np.prod(levels[split]))
+        assert tail_elems == 512
+        gathers = []
+        for line in txt.splitlines():
+            if "all_gather" not in line:
+                continue
+            shapes = re.findall(r"tensor<([0-9x]+)x[a-z]", line)
+            assert shapes, f"unparseable all_gather line: {line}"
+            out_elems = int(np.prod([int(d) for d in
+                                     shapes[-1].split("x")]))
+            gathers.append(out_elems)
+        # exactly the one coarse-tail gather; nothing plane-sized or larger
+        assert gathers == [tail_elems], gathers
+        # the slab halos are there (2 exchanges/level-visit × 3 slab
+        # levels × smooth/residual/transfer sites)
+        assert txt.count("collective_permute") >= 6
+
+
 class TestEinsumTransfers:
     def test_einsum_matches_staged(self):
         """The banded-matrix einsum transfers equal the staged per-axis

@@ -130,3 +130,34 @@ def test_fused_residual_parity(lz, max_chunk):
     ref = f - reference_stencil(
         u.astype(np.float64), lo.astype(np.float64), hi.astype(np.float64))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fast_path_gates_key_on_mesh_platform(monkeypatch):
+    """ADVICE r4: the Mosaic / einsum fast-path gates must key on the
+    platform of the mesh the op runs on, NOT the process default backend —
+    a CPU-device mesh inside a TPU-capable process takes the CPU paths."""
+    import jax
+
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import pallas_supported
+    from mpi_petsc4py_example_tpu.solvers.mg import _mm_ok
+
+    # simulate a TPU-capable process hosting a CPU-device mesh
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert pallas_supported(8, 128, np.float32, platform="cpu") is False
+    assert pallas_supported(8, 128, np.float32, platform="tpu") is True
+    assert pallas_supported(8, 128, np.float32) is True      # legacy default
+    assert _mm_ok(np.float64, platform="cpu") is True
+    assert _mm_ok(np.float64, platform="tpu") is False
+
+
+def test_vmem_plan_per_generation():
+    """ADVICE r4: the Mosaic VMEM limit/budget derive from the device
+    generation — 16MB parts must not be asked for a 64MB limit."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import _vmem_plan
+
+    limit, budget = _vmem_plan("TPU v5e")
+    assert limit == 64 << 20 and budget == 48 << 20
+    limit, budget = _vmem_plan("TPU v3")
+    assert limit is None and budget == 6 << 20
+    limit, budget = _vmem_plan(None)        # CPU/interpret: production plan
+    assert limit == 64 << 20 and budget == 48 << 20

@@ -59,6 +59,85 @@ class TestTrueResidualCheck:
         assert res_on.iterations == res_off.iterations
         assert rtrue <= 1e-8
 
+    def test_honest_gate_zero_extra_dispatches(self, comm8, monkeypatch):
+        """Round-5 contract: the gate's honest case is decided by the solve
+        program's EPILOGUE scalars — no host-side mat.mult / b.norm
+        dispatches, exactly one result-fetch sync point (the round-4
+        re-dispatch tax was ~0.2-0.5 s/solve on the tunnel runtime)."""
+        from mpi_petsc4py_example_tpu.utils import profiling
+        A = poisson2d_csr(32)
+        b = A @ np.random.default_rng(2).random(A.shape[0])
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-8, atol=0.0, max_it=2000)
+        ksp.set_true_residual_check(True)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+
+        def _no_host_mult(*a, **k):
+            raise AssertionError(
+                "honest gate path dispatched a host-side mat.mult")
+        monkeypatch.setattr(type(M), "mult", _no_host_mult)
+        monkeypatch.setattr(type(bv), "norm", _no_host_mult)
+        profiling.clear_events()
+        res = ksp.solve(bv, x)
+        assert res.converged, res
+        assert profiling.sync_counts().get("KSP result fetch/solve") == 1
+        # the epilogue scalars match a host fp64 recomputation
+        trn, bn = ksp._last_true_res
+        xh = x.to_numpy().astype(np.float64)
+        assert np.isclose(trn, np.linalg.norm(b - A @ xh), rtol=1e-10)
+        assert np.isclose(bn, np.linalg.norm(b), rtol=1e-12)
+
+    def test_monitor_offset_plumbing(self, comm8):
+        """Re-entered sub-solves offset monitor iteration numbers by the
+        iterations already spent (ADVICE r4: numbering restarted at 0)."""
+        A = poisson2d_csr(16)
+        b = A @ np.random.default_rng(3).random(A.shape[0])
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-8, atol=0.0, max_it=2000)
+        seen = []
+        ksp.set_monitor(lambda _k, it, rn: seen.append(it))
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x, _mon_offset=7)
+        assert seen and seen[0] == 7 and seen == sorted(seen)
+
+    def test_reentry_does_not_mutate_instance_state(self, comm8):
+        """The gate's re-entry passes overrides through solve() parameters;
+        user-visible tolerances/flags are never touched (ADVICE r4)."""
+        A = poisson2d_csr(48)
+        b = (A @ np.random.default_rng(4).random(A.shape[0])).astype(
+            np.float32)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float32)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-6, atol=0.0, max_it=20000)
+        ksp.set_true_residual_check(True)
+        observed = []
+        ksp.set_monitor(lambda k, it, rn: observed.append(
+            (k.rtol, k.atol, k._initial_guess_nonzero,
+             k._true_residual_check)))
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged, res
+        # every monitor observation (including any re-entered sub-solve)
+        # saw the user's configuration
+        assert set(observed) == {(1e-6, 0.0, False, True)}
+        assert (ksp.rtol, ksp.atol) == (1e-6, 0.0)
+        assert ksp._initial_guess_nonzero is False
+        assert ksp._true_residual_check is True
+
     def test_option_db_wires_flag(self, comm8):
         tps.init(["prog", "-ksp_true_residual_check"])
         try:

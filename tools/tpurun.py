@@ -37,6 +37,8 @@ def main():
     for p in (repo, compat):
         if p not in sys.path:
             sys.path.insert(0, p)
+    from mpi_petsc4py_example_tpu.utils.phases import stamp
+    stamp("tpurun_main")         # interpreter + site imports are behind us
     # like ``python script.py`` (and mpirun): the script's own directory leads
     # sys.path, so a driver's sibling modules (e.g. the reference repo's
     # petsc_funcs.py, /root/reference/test2.py:4) shadow the compat copies
@@ -51,6 +53,7 @@ def main():
 
     with open(opts.script) as f:
         code = compile(f.read(), opts.script, "exec")
+    stamp("driver_exec")
 
     nprocs = opts.np
     errors: list = []
