@@ -80,12 +80,19 @@ def vcycle_decomposition(nx: int):
         return loop
 
     report("vcycle", time_loop(cycle_loop(), (r0,), 8, 24))
-    orig = mg._sweep
+    # smoothing ablation: neutralize BOTH the per-sweep path and the
+    # round-5 fused pair fast paths (_smooth/_smooth0 dispatch above
+    # _sweep now)
+    orig = (mg._sweep, mg._smooth, mg._smooth0)
     mg._sweep = lambda u, f, lo, hi, omega=mg._OMEGA, platform=None: u
+    mg._smooth = lambda u, f, iters, exchange, omega=mg._OMEGA, \
+        platform=None: u
+    mg._smooth0 = lambda f, iters, exchange, omega=mg._OMEGA, \
+        platform=None: (mg._OMEGA / 6.0) * f
     try:
         report("vcycle_no_smoothing", time_loop(cycle_loop(), (r0,), 8, 24))
     finally:
-        mg._sweep = orig
+        mg._sweep, mg._smooth, mg._smooth0 = orig
 
     def xfer_loop(fn, x):
         @jax.jit

@@ -389,3 +389,399 @@ def pallas_supported(ny: int, nx: int, dtype, platform: str | None = None
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),):
         return False
     return nx % 128 == 0 and ny % 8 == 0
+
+
+def _pick_chunk_zrestrict(lz: int, itemsize: int, ny: int, nx: int,
+                          max_chunk: int | None):
+    """Even z-chunk dividing ``lz`` for the fused residual+z-restrict
+    pipeline: scratch is 2 u-banks (chunk+4 planes), 2 f-banks (chunk+2)
+    and 2 half-size out-banks (chunk/2) = 5·chunk + 12 planes."""
+    plane = ny * nx * itemsize
+    budget_planes = int(_vmem_plan(_tpu_device_kind())[1] // plane)
+    chunk = max(2, min(lz, (budget_planes - 12) // 5))
+    if max_chunk is not None:
+        chunk = min(chunk, max_chunk)     # test hook: force multi-chunk
+    chunk -= chunk % 2
+    chunk = max(chunk, 2)
+    while chunk > 2 and lz % chunk:
+        chunk -= 2
+    if lz % chunk:
+        raise ValueError(f"fused z-restrict needs an even chunk dividing "
+                         f"lz={lz}")
+    return chunk, lz // chunk
+
+
+def _mk_halo2_io(u_ref, f_ref, usc, fsc, sem_u, sem_ul, sem_uh, sem_f,
+                 sem_fl, sem_fh, chunk, nchunks):
+    """start_in/wait_in pair for the 2-deep-u / 1-deep-f extended-chunk
+    DMA pipeline shared by :func:`_resid_zrestrict_kernel` and
+    :func:`_double_sweep_kernel`: per chunk c, u planes [z0-2, z0+chunk+2)
+    land in a (chunk+4)-plane bank and f planes [z0-1, z0+chunk+1) in a
+    (chunk+2)-plane bank, edge DMAs skipped beyond the global ends (the
+    callers mask the ghost planes on the VALUE). Requires chunk >= 2 so
+    every edge DMA stays in bounds."""
+    one = jnp.int32(1)
+    two = jnp.int32(2)
+
+    def start_in(c, slot):
+        z0 = c * jnp.int32(chunk)
+        pltpu.make_async_copy(
+            u_ref.at[pl.ds(z0, chunk)],
+            usc.at[slot, pl.ds(two, chunk)], sem_u.at[slot]).start()
+
+        @pl.when(c > 0)
+        def _():
+            pltpu.make_async_copy(
+                u_ref.at[pl.ds(z0 - two, 2)],
+                usc.at[slot, pl.ds(0, 2)], sem_ul.at[slot]).start()
+
+        @pl.when(c < nchunks - 1)
+        def _():
+            pltpu.make_async_copy(
+                u_ref.at[pl.ds(z0 + jnp.int32(chunk), 2)],
+                usc.at[slot, pl.ds(jnp.int32(chunk + 2), 2)],
+                sem_uh.at[slot]).start()
+        pltpu.make_async_copy(
+            f_ref.at[pl.ds(z0, chunk)],
+            fsc.at[slot, pl.ds(one, chunk)], sem_f.at[slot]).start()
+
+        @pl.when(c > 0)
+        def _():
+            pltpu.make_async_copy(
+                f_ref.at[pl.ds(z0 - one, 1)],
+                fsc.at[slot, pl.ds(0, 1)], sem_fl.at[slot]).start()
+
+        @pl.when(c < nchunks - 1)
+        def _():
+            pltpu.make_async_copy(
+                f_ref.at[pl.ds(z0 + jnp.int32(chunk), 1)],
+                fsc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                sem_fh.at[slot]).start()
+
+    def wait_in(c, slot):
+        pltpu.make_async_copy(u_ref.at[pl.ds(0, chunk)],
+                              usc.at[slot, pl.ds(two, chunk)],
+                              sem_u.at[slot]).wait()
+        pltpu.make_async_copy(f_ref.at[pl.ds(0, chunk)],
+                              fsc.at[slot, pl.ds(one, chunk)],
+                              sem_f.at[slot]).wait()
+
+        @pl.when(c > 0)
+        def _():
+            pltpu.make_async_copy(u_ref.at[pl.ds(0, 2)],
+                                  usc.at[slot, pl.ds(0, 2)],
+                                  sem_ul.at[slot]).wait()
+            pltpu.make_async_copy(f_ref.at[pl.ds(0, 1)],
+                                  fsc.at[slot, pl.ds(0, 1)],
+                                  sem_fl.at[slot]).wait()
+
+        @pl.when(c < nchunks - 1)
+        def _():
+            pltpu.make_async_copy(
+                u_ref.at[pl.ds(0, 2)],
+                usc.at[slot, pl.ds(jnp.int32(chunk + 2), 2)],
+                sem_uh.at[slot]).wait()
+            pltpu.make_async_copy(
+                f_ref.at[pl.ds(0, 1)],
+                fsc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                sem_fh.at[slot]).wait()
+
+    return start_in, wait_in
+
+
+def _halo2_scratch(chunk: int, out_planes: int, ny: int, nx: int, dtype):
+    """Scratch list for the 2-deep-halo pipeline kernels: u banks
+    (chunk+4), f banks (chunk+2), out banks (``out_planes``), and the
+    seven DMA semaphore pairs _mk_halo2_io + the output DMA consume."""
+    return [
+        pltpu.VMEM((2, chunk + 4, ny, nx), dtype),
+        pltpu.VMEM((2, chunk + 2, ny, nx), dtype),
+        pltpu.VMEM((2, out_planes, ny, nx), dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+
+
+def _resid_zrestrict_kernel(u_ref, f_ref, out_ref, chunk, nchunks, rscale):
+    """Fused ``r = f - A u`` + one-axis z-restriction, manual-DMA pipeline.
+
+    Round-5 V-cycle optimization: the fine residual never touches HBM —
+    each chunk computes r on (chunk+2) extended planes in VMEM and writes
+    only the (chunk/2) z-restricted coarse planes
+    ``coarse[i] = s·(0.75·(r[2i]+r[2i+1]) + 0.25·(r[2i-1]+r[2i+2]))``
+    (solvers/mg._r1d weights, zero ghosts), saving the r write + the
+    z-einsum's r read (~2 fine HBM passes per cycle). SINGLE-DEVICE slabs
+    only: the ghost planes are the global Dirichlet zeros; a sharded slab
+    would need 2-deep u halos (the separate residual+restrict passes keep
+    the 1-plane exchange there).
+    """
+    ny, nx = out_ref.shape[1], out_ref.shape[2]
+    cc = chunk // 2
+
+    def process(usc, fsc, osc, sem_u, sem_ul, sem_uh, sem_f, sem_fl,
+                sem_fh, sem_out):
+        six = jnp.asarray(6.0, out_ref.dtype)
+        start_in, wait_in = _mk_halo2_io(
+            u_ref, f_ref, usc, fsc, sem_u, sem_ul, sem_uh, sem_f,
+            sem_fl, sem_fh, chunk, nchunks)
+
+        def lax_rem(c):
+            return jax.lax.rem(c, jnp.int32(2))
+
+        start_in(jnp.int32(0), jnp.int32(0))
+
+        def body(c, carry):
+            slot = lax_rem(c)
+            nslot = lax_rem(c + 1)
+
+            @pl.when(c + 1 < nchunks)
+            def _():
+                start_in(c + 1, nslot)
+
+            wait_in(c, slot)
+            uext = usc[slot]                     # (chunk+4, ny, nx)
+            # the u planes just below/above the domain are Dirichlet zero
+            # ghosts feeding r at the first/last interior plane — stale
+            # scratch there is masked on the VALUE (Mosaic rejects
+            # compound-indexed scratch stores under cond); the outermost
+            # planes (0 / chunk+3) feed only the masked rext end planes
+            urow = jax.lax.broadcasted_iota(jnp.int32,
+                                            (chunk + 4, 1, 1), 0)
+            uext = jnp.where((urow <= 1) & (c == 0), 0.0, uext)
+            uext = jnp.where((urow >= jnp.int32(chunk + 2))
+                             & (c == nchunks - 1), 0.0, uext)
+            u = uext[1:-1]                       # planes [z0-1, z0+chunk]
+            y = (six * u - uext[:-2] - uext[2:]
+                 - _shift_y(u, -1) - _shift_y(u, +1)
+                 - _shift_x(u, -1) - _shift_x(u, +1))
+            rext = fsc[slot] - y                 # (chunk+2, ny, nx)
+            # r ghosts beyond the global domain are exactly zero
+            zrow = jax.lax.broadcasted_iota(jnp.int32,
+                                            (chunk + 2, 1, 1), 0)
+            rext = jnp.where((zrow == 0) & (c == 0), 0.0, rext)
+            rext = jnp.where((zrow == jnp.int32(chunk + 1))
+                             & (c == nchunks - 1), 0.0, rext)
+            # coarse[j] over rext indices (2j, 2j+1, 2j+2, 2j+3)
+            lowpair = rext[:-2].reshape(cc, 2, ny, nx)
+            highpair = rext[2:].reshape(cc, 2, ny, nx)
+            coarse = jnp.asarray(rscale, out_ref.dtype) * (
+                0.25 * (lowpair[:, 0] + highpair[:, 1])
+                + 0.75 * (lowpair[:, 1] + highpair[:, 0]))
+
+            @pl.when(c >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    osc.at[slot], out_ref.at[pl.ds(0, cc)],
+                    sem_out.at[slot]).wait()
+            osc[slot] = coarse
+            pltpu.make_async_copy(
+                osc.at[slot], out_ref.at[pl.ds(c * jnp.int32(cc), cc)],
+                sem_out.at[slot]).start()
+            return carry
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                          jnp.int32(0))
+        last = jnp.int32(nchunks - 1)
+
+        @pl.when(jnp.int32(nchunks) >= 2)
+        def _():
+            pltpu.make_async_copy(
+                osc.at[lax_rem(last + 1)], out_ref.at[pl.ds(0, cc)],
+                sem_out.at[lax_rem(last + 1)]).wait()
+
+        pltpu.make_async_copy(
+            osc.at[lax_rem(last)], out_ref.at[pl.ds(0, cc)],
+            sem_out.at[lax_rem(last)]).wait()
+
+    pl.run_scoped(process, *_halo2_scratch(chunk, cc, ny, nx,
+                                           out_ref.dtype))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def stencil3d_residual_zrestrict_pallas(u, f, lz: int, ny: int, nx: int,
+                                        rscale: float,
+                                        interpret: bool = False,
+                                        max_chunk: int | None = None):
+    """Fused residual + one-axis z-restriction for SINGLE-DEVICE slabs:
+    ``zrestrict(f - A u)`` with solvers/mg._r1d's weights and zero ghosts,
+    returning the (lz/2, ny, nx) coarse array without ever writing the
+    fine residual to HBM (see _resid_zrestrict_kernel)."""
+    chunk, nchunks = _pick_chunk_zrestrict(lz, u.dtype.itemsize, ny, nx,
+                                           max_chunk)
+    kernel = functools.partial(_resid_zrestrict_kernel, chunk=chunk,
+                               nchunks=nchunks, rscale=rscale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((lz // 2, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(u, f)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def stencil3d_smooth0_pair_pallas(f, lz: int, ny: int, nx: int,
+                                  w1: float, w2: float,
+                                  interpret: bool = False,
+                                  max_chunk: int | None = None):
+    """TWO damped-Jacobi sweeps from a ZERO initial guess in ONE streamed
+    pass (round 5; single-device slabs, zero Dirichlet ghosts):
+
+        u1 = w1 f;   u2 = u1 + w2 (f - A u1) = (w1 + w2) f - w1 w2 (A f)
+
+    — algebraically one stencil apply on ``f`` itself, so the existing
+    apply pipeline serves with a combine. Reads f (+edge planes) once,
+    writes u once (~2.3 HBM passes) where the separate path pays an XLA
+    elementwise pass for u1 plus a full fused sweep (~5+ passes).
+    ``w1``/``w2`` are the ω/6 factors of the two sweeps (mg.cheby_omegas
+    order; the factors commute so order doesn't matter).
+    """
+    chunk, nchunks = _pick_chunk(lz, f.dtype.itemsize, ny, nx, max_chunk)
+    kernel = functools.partial(
+        _stencil_kernel, chunk=chunk, nchunks=nchunks,
+        combine=lambda fc, y, _unused: (
+            jnp.asarray(w1 + w2, fc.dtype) * fc
+            - jnp.asarray(w1 * w2, fc.dtype) * y))
+    z = jnp.zeros((1, ny, nx), f.dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), f.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(f, z, z)
+
+
+def _double_sweep_kernel(u_ref, f_ref, out_ref, chunk, nchunks, w1, w2):
+    """TWO damped-Jacobi sweeps in one streamed pass (nonzero guess):
+    ``u2 = S_{w2}(S_{w1}(u))`` with ``S_w(v) = v + w (f - A v)``.
+
+    Same chunk+4/chunk+2 extended-plane geometry as
+    :func:`_resid_zrestrict_kernel` (shared _mk_halo2_io pipeline): u1 is
+    computed on (chunk+2) planes in VMEM, the second sweep then needs only
+    the center chunk. Ghost planes beyond the global domain stay EXACTLY
+    zero through both sweeps (Dirichlet), realized by masking u1's end
+    planes. SINGLE-DEVICE slabs only (2-deep halos otherwise).
+    Traffic: read u+f (+edges) once, write u2 once (~3.2 fine passes) vs
+    two separate fused sweeps (~6.6).
+    """
+    ny, nx = out_ref.shape[1], out_ref.shape[2]
+
+    def process(usc, fsc, osc, sem_u, sem_ul, sem_uh, sem_f, sem_fl,
+                sem_fh, sem_out):
+        six = jnp.asarray(6.0, out_ref.dtype)
+        start_in, wait_in = _mk_halo2_io(
+            u_ref, f_ref, usc, fsc, sem_u, sem_ul, sem_uh, sem_f,
+            sem_fl, sem_fh, chunk, nchunks)
+
+        def lax_rem(c):
+            return jax.lax.rem(c, jnp.int32(2))
+
+        def stencil(v):
+            """A v on the interior planes of an extended array (len-2)."""
+            vc = v[1:-1]
+            return (six * vc - v[:-2] - v[2:]
+                    - _shift_y(vc, -1) - _shift_y(vc, +1)
+                    - _shift_x(vc, -1) - _shift_x(vc, +1))
+
+        start_in(jnp.int32(0), jnp.int32(0))
+
+        def body(c, carry):
+            slot = lax_rem(c)
+            nslot = lax_rem(c + 1)
+
+            @pl.when(c + 1 < nchunks)
+            def _():
+                start_in(c + 1, nslot)
+
+            wait_in(c, slot)
+            uext = usc[slot]                     # (chunk+4, ny, nx)
+            urow = jax.lax.broadcasted_iota(jnp.int32,
+                                            (chunk + 4, 1, 1), 0)
+            uext = jnp.where((urow <= 1) & (c == 0), 0.0, uext)
+            uext = jnp.where((urow >= jnp.int32(chunk + 2))
+                             & (c == nchunks - 1), 0.0, uext)
+            fext = fsc[slot]                     # (chunk+2, ny, nx)
+            # sweep 1 on planes [z0-1, z0+chunk]
+            u1 = uext[1:-1] + jnp.asarray(w1, uext.dtype) * (
+                fext - stencil(uext))
+            # ghosts beyond the domain stay exactly zero through the sweep
+            zrow = jax.lax.broadcasted_iota(jnp.int32,
+                                            (chunk + 2, 1, 1), 0)
+            u1 = jnp.where((zrow == 0) & (c == 0), 0.0, u1)
+            u1 = jnp.where((zrow == jnp.int32(chunk + 1))
+                           & (c == nchunks - 1), 0.0, u1)
+            # sweep 2 on the center chunk
+            u2 = u1[1:-1] + jnp.asarray(w2, u1.dtype) * (
+                fext[1:-1] - stencil(u1))
+
+            @pl.when(c >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    osc.at[slot], out_ref.at[pl.ds(0, chunk)],
+                    sem_out.at[slot]).wait()
+            osc[slot] = u2
+            pltpu.make_async_copy(
+                osc.at[slot],
+                out_ref.at[pl.ds(c * jnp.int32(chunk), chunk)],
+                sem_out.at[slot]).start()
+            return carry
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                          jnp.int32(0))
+        last = jnp.int32(nchunks - 1)
+
+        @pl.when(jnp.int32(nchunks) >= 2)
+        def _():
+            pltpu.make_async_copy(
+                osc.at[lax_rem(last + 1)], out_ref.at[pl.ds(0, chunk)],
+                sem_out.at[lax_rem(last + 1)]).wait()
+
+        pltpu.make_async_copy(
+            osc.at[lax_rem(last)], out_ref.at[pl.ds(0, chunk)],
+            sem_out.at[lax_rem(last)]).wait()
+
+    pl.run_scoped(process, *_halo2_scratch(chunk, chunk, ny, nx,
+                                           out_ref.dtype))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def stencil3d_smooth_pair_pallas(u, f, lz: int, ny: int, nx: int,
+                                 w1: float, w2: float,
+                                 interpret: bool = False,
+                                 max_chunk: int | None = None):
+    """Two damped-Jacobi sweeps from a NONZERO guess in one streamed pass
+    (see _double_sweep_kernel). ``w1``/``w2`` are the sweeps' ω/6.
+
+    Raises ValueError when no z-chunk >= 2 divides ``lz`` within the VMEM
+    budget (chunk=1 would put the 2-deep edge DMAs out of bounds) — the
+    caller (mg._smooth) falls back to two separate fused sweeps."""
+    # scratch is 2·(chunk+4 + chunk+2 + chunk) = 6·chunk + 12 planes
+    plane = ny * nx * u.dtype.itemsize
+    budget_planes = int(_vmem_plan(_tpu_device_kind())[1] // plane)
+    chunk = min(lz, max((budget_planes - 12) // 6, 0))
+    if max_chunk is not None:
+        chunk = min(chunk, max_chunk)
+    while chunk >= 2 and lz % chunk:
+        chunk -= 1
+    if chunk < 2:
+        raise ValueError(
+            f"double-sweep kernel needs a z-chunk >= 2 dividing lz={lz} "
+            "within the VMEM budget (2-deep halo DMAs)")
+    kernel = functools.partial(_double_sweep_kernel, chunk=chunk,
+                               nchunks=lz // chunk, w1=w1, w2=w2)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(u, f)

@@ -144,8 +144,23 @@ def cheby_omegas(degree: int, b: float = 2.0, a_frac: float = 0.25):
 def _smooth(u, f, iters: int, exchange, omega=_OMEGA, platform=None):
     """Damped-Jacobi sweeps for the unit 7-point stencil; ``omega`` may be
     a scalar (``iters`` equal sweeps, fori_loop) or a tuple of per-sweep
-    factors (a Chebyshev-root schedule, unrolled — see cheby_omegas)."""
+    factors (a Chebyshev-root schedule, unrolled — see cheby_omegas).
+
+    A 2-sweep schedule on a SINGLE-DEVICE slab runs both sweeps in ONE
+    streamed Pallas pass (stencil3d_smooth_pair_pallas: ~3.2 HBM passes
+    vs ~6.6 for two separate fused sweeps — round 5)."""
     if isinstance(omega, (tuple, list)):
+        if len(omega) == 2 and exchange is _no_exchange:
+            from ..ops.pallas_stencil import (pallas_supported,
+                                              stencil3d_smooth_pair_pallas)
+            lz, ny, nx = u.shape
+            if pallas_supported(ny, nx, u.dtype, platform):
+                try:
+                    return stencil3d_smooth_pair_pallas(
+                        u, f, lz, ny, nx, float(omega[0]) / 6.0,
+                        float(omega[1]) / 6.0)
+                except ValueError:
+                    pass    # no feasible >=2 z-chunk: two separate sweeps
         for w in omega:
             lo, hi = exchange(u)
             u = _sweep(u, f, lo, hi, w, platform)
@@ -169,6 +184,15 @@ def _smooth0(f, iters: int, exchange, omega=_OMEGA, platform=None):
         ws = tuple(float(w) for w in omega)
         if not ws:
             return jnp.zeros_like(f)
+        if len(ws) == 2 and exchange is _no_exchange:
+            # both sweeps collapse to ONE stencil apply on f itself:
+            # u = (w1+w2) f - w1 w2 (A f), one streamed pass (round 5)
+            from ..ops.pallas_stencil import (pallas_supported,
+                                              stencil3d_smooth0_pair_pallas)
+            lz, ny, nx = f.shape
+            if pallas_supported(ny, nx, f.dtype, platform):
+                return stencil3d_smooth0_pair_pallas(
+                    f, lz, ny, nx, ws[0] / 6.0, ws[1] / 6.0)
         return _smooth((ws[0] / 6.0) * f, f, 0, exchange, ws[1:], platform)
     if iters <= 0:
         return jnp.zeros_like(f)
@@ -320,6 +344,34 @@ def _restrict(r, lo=None, hi=None, platform=None):
     return _r1d(_r1d(_r1d(r, 0, lo, hi), 1), 2)
 
 
+def _residual_restrict_fused(u, f, platform=None):
+    """Fine residual + full restriction with the Z-AXIS restriction fused
+    INTO the residual kernel (round 5): the fine residual never touches
+    HBM — the kernel writes only z-restricted coarse planes
+    (ops/pallas_stencil.stencil3d_residual_zrestrict_pallas), and the y/x
+    einsum stages then run on HALF the data. Saves the r write + the
+    z-einsum's r read (~2 fine HBM passes per cycle at 512³).
+
+    SINGLE-DEVICE slabs only (zero Dirichlet ghosts are built into the
+    kernel; a sharded slab would need 2-deep u halos — the slab cycle
+    keeps the separate residual/restrict passes with 1-plane exchanges).
+    Identical weights to the staged/einsum paths (pinned in
+    tests/test_pallas.py); falls back to them when unsupported.
+    """
+    from ..ops.pallas_stencil import (pallas_supported,
+                                      stencil3d_residual_zrestrict_pallas)
+    lz, ny, nx = u.shape
+    if (lz % 2 == 0 and pallas_supported(ny, nx, u.dtype, platform)
+            and _mm_ok(u.dtype, platform)):
+        rz = stencil3d_residual_zrestrict_pallas(u, f, lz, ny, nx, _RSCALE)
+        dt = rz.dtype
+        out = _hp("cyx,yd->cdx", rz, _tmat(ny, dt))
+        return _hp("cdx,xe->cde", out, _tmat(nx, dt))
+    lo, hi = _no_exchange(u)
+    r = _residual(u, f, lo, hi, platform)
+    return _restrict(r, platform=platform)
+
+
 def _prolong(e, lo=None, hi=None, platform=None):
     """Full 3-axis prolongation; z first (the only axis needing halos)."""
     if _mm_ok(e.dtype, platform):
@@ -372,9 +424,7 @@ def make_vcycle3d(nz: int, ny: int, nx: int, pre: int = 2, post: int = 2,
             return _smooth0(f, coarse_iters, _no_exchange,
                             platform=platform)
         u = _smooth0(f, pre, _no_exchange, omega=pre_w, platform=platform)
-        lo, hi = _no_exchange(u)
-        r = _residual(u, f, lo, hi, platform)
-        e_c = local_cycle(_restrict(r, platform=platform), li + 1)
+        e_c = local_cycle(_residual_restrict_fused(u, f, platform), li + 1)
         u = u + _prolong(e_c, platform=platform)
         return _smooth(u, f, post, _no_exchange, omega=post_w,
                        platform=platform)

@@ -161,3 +161,72 @@ def test_vmem_plan_per_generation():
     assert limit is None and budget == 6 << 20
     limit, budget = _vmem_plan(None)        # CPU/interpret: production plan
     assert limit == 64 << 20 and budget == 48 << 20
+
+
+@pytest.mark.parametrize("lz,ny,nx,max_chunk", [
+    (4, 8, 128, None),          # single chunk (both edge masks in one)
+    (8, 8, 128, 2),             # multi-chunk: cross-chunk coarse planes
+    (12, 16, 128, 4),
+    (6, 8, 128, 2),
+])
+def test_fused_residual_zrestrict_parity(lz, ny, nx, max_chunk):
+    """stencil3d_residual_zrestrict_pallas == mg._r1d(f - A u, axis=0)
+    with zero Dirichlet ghosts — the round-5 V-cycle fusion that keeps the
+    fine residual out of HBM (solvers/mg._residual_restrict_fused)."""
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+    from mpi_petsc4py_example_tpu.models.stencil import StencilPoisson3D
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_residual_zrestrict_pallas)
+    rng = np.random.default_rng(500 + lz)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    f = rng.random((lz, ny, nx)).astype(np.float32)
+    z = jnp.zeros((ny, nx), jnp.float64)
+    r = f - StencilPoisson3D._stencil7_jnp(jnp.asarray(u, jnp.float64),
+                                           z, z)
+    ref = np.asarray(mg._r1d(r, 0))
+    out = np.asarray(stencil3d_residual_zrestrict_pallas(
+        jnp.asarray(u), jnp.asarray(f), lz, ny, nx, mg._RSCALE,
+        True, max_chunk))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_residual_restrict_matches_separate_passes():
+    """mg._residual_restrict_fused's fallback == fused arithmetic: on CPU
+    the helper takes the separate-pass path; pin that both compose to the
+    same full 3-axis restriction of the residual."""
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+    rng = np.random.default_rng(7)
+    u = jnp.asarray(rng.random((8, 8, 8)))
+    f = jnp.asarray(rng.random((8, 8, 8)))
+    lo, hi = mg._no_exchange(u)
+    r = mg._residual(u, f, lo, hi)
+    expect = mg._restrict(r)
+    got = mg._residual_restrict_fused(u, f)
+    np.testing.assert_allclose(got, expect, atol=1e-13)
+
+
+@pytest.mark.parametrize("lz,mc", [(4, None), (8, 2), (6, 3)])
+def test_fused_smooth_pairs_parity(lz, mc):
+    """stencil3d_smooth_pair_pallas == two staged sweeps, and
+    stencil3d_smooth0_pair_pallas == (w1+w2)f − w1w2·Af (two sweeps from a
+    zero guess) — the round-5 single-pass smoothing fusions
+    (mg._smooth/_smooth0's 2-sweep single-device fast paths)."""
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_smooth0_pair_pallas, stencil3d_smooth_pair_pallas)
+    ny, nx = 8, 128
+    rng = np.random.default_rng(600 + lz)
+    u = jnp.asarray(rng.random((lz, ny, nx)).astype(np.float32))
+    f = jnp.asarray(rng.random((lz, ny, nx)).astype(np.float32))
+    w1, w2 = mg.cheby_omegas(2)
+    lo, hi = mg._no_exchange(u)
+    u1 = u + (w1 / 6.0) * (f - mg._stencil7(u, lo, hi))
+    ref = u1 + (w2 / 6.0) * (f - mg._stencil7(u1, lo, hi))
+    out = stencil3d_smooth_pair_pallas(u, f, lz, ny, nx, w1 / 6.0,
+                                       w2 / 6.0, True, mc)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    v1 = (w1 / 6.0) * f
+    ref0 = v1 + (w2 / 6.0) * (f - mg._stencil7(v1, lo, hi))
+    out0 = stencil3d_smooth0_pair_pallas(f, lz, ny, nx, w1 / 6.0,
+                                         w2 / 6.0, True, mc)
+    np.testing.assert_allclose(out0, ref0, rtol=1e-5, atol=1e-5)

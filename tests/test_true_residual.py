@@ -138,6 +138,86 @@ class TestTrueResidualCheck:
         assert ksp._initial_guess_nonzero is False
         assert ksp._true_residual_check is True
 
+    def test_margin_tightens_program_target(self, comm8):
+        """-ksp_true_residual_margin < 1: the COMPILED program converges to
+        margin*rtol (a drift guard band — extra microsecond iterations
+        instead of ~100 ms re-entry dispatches) while the gate still
+        verifies the true residual against rtol itself."""
+        A = poisson2d_csr(48)
+        b = A @ np.random.default_rng(6).random(A.shape[0])
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        rtol = 1e-6
+        ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=20000)
+        ksp.set_true_residual_check(True)
+        ksp.true_residual_margin = 0.5
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.converged and ksp._last_reentries == 0
+        # the recurrence met the TIGHTENED in-program target
+        assert res.residual_norm <= 0.5 * rtol * np.linalg.norm(b) * 1.01
+        rtrue = np.linalg.norm(b - A @ x.to_numpy()) / np.linalg.norm(b)
+        assert rtrue <= rtol
+
+    def test_margin_validation(self, comm8):
+        """Margins outside (0, 1] are rejected (0 makes every gated target
+        unreachable; >1 would stop looser than rtol)."""
+        A = poisson2d_csr(16)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float64)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_true_residual_check(True)
+        x, bv = M.get_vecs()
+        bv.set_global(A @ np.ones(A.shape[0]))
+        for bad in (0.0, -1.0, 1.5):
+            ksp.true_residual_margin = bad
+            with pytest.raises(ValueError, match="margin"):
+                ksp.solve(bv, x)
+
+    def test_margin_stall_rescued_by_true_residual(self, comm8):
+        """A margin-tightened program that stalls between margin*rtol and
+        rtol must still report CONVERGED when the epilogue's TRUE residual
+        meets the un-margined target — tightening can only ever make
+        semantics stricter, never turn a converged solve into a failure."""
+        A = poisson2d_csr(48)
+        b = (A @ np.random.default_rng(8).random(A.shape[0])).astype(
+            np.float32)
+        M = tps.Mat.from_scipy(comm8, A, dtype=np.float32)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        rtol = 1e-6
+        # the un-margined solve converges around ~100 its; the 1e-3-margin
+        # target needs ~150 (measured) — max_it between the two forces a
+        # DIVERGED_MAX_IT exit whose TRUE residual already meets rtol
+        max_it = 120
+        ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=max_it)
+        ksp.set_true_residual_check(True)
+        ksp.true_residual_margin = 1e-3
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)
+        assert res.iterations == max_it
+        assert res.converged, res
+        rtrue = np.linalg.norm(b - A @ x.to_numpy().astype(np.float64)) \
+            / np.linalg.norm(b)
+        assert rtrue <= rtol * 1.05, rtrue
+
+    def test_margin_option_db(self, comm8):
+        tps.init(["prog", "-ksp_true_residual_margin", "0.7"])
+        try:
+            ksp = tps.KSP().create(comm8)
+            ksp.set_from_options()
+            assert ksp.true_residual_margin == 0.7
+        finally:
+            global_options().clear()
+
     def test_option_db_wires_flag(self, comm8):
         tps.init(["prog", "-ksp_true_residual_check"])
         try:
