@@ -180,3 +180,50 @@ class TestEinsumTransfers:
         lhs = float(jnp.vdot(mg._restrict_mm(r, None, None), e))
         rhs = 0.5 * float(jnp.vdot(r, mg._prolong_mm(e, None, None)))
         assert abs(lhs - rhs) <= 1e-12 * max(abs(lhs), 1.0), (lhs, rhs)
+
+
+class TestChebyshevSmoother:
+    def test_cheby_omegas_are_inverse_chebyshev_roots(self):
+        """The ω schedule inverts the T₂ roots on [0.5, 2] — and the
+        product polynomial's max over the interval beats the fixed-ω
+        Jacobi pair's (the min-max optimality that buys the measured
+        iteration cut)."""
+        import numpy as np
+
+        from mpi_petsc4py_example_tpu.solvers.mg import _OMEGA, cheby_omegas
+        ws = cheby_omegas(2)
+        roots = sorted(1.0 / w for w in ws)
+        lo, b = 0.5, 2.0
+        mid, half = (b + lo) / 2, (b - lo) / 2
+        expect = sorted([mid + half * np.cos(np.pi / 4),
+                         mid + half * np.cos(3 * np.pi / 4)])
+        np.testing.assert_allclose(roots, expect, rtol=1e-12)
+        t = np.linspace(lo, b, 2001)
+        p_cheb = np.prod([1 - w * t for w in ws], axis=0)
+        p_jac = (1 - _OMEGA * t) ** 2
+        assert np.abs(p_cheb).max() < np.abs(p_jac).max()
+
+    def test_mg_smooth_type_option(self, comm8):
+        """-pc_mg_smooth_type wires through set_from_options and is part
+        of the compiled-program key (a change must recompile)."""
+        import mpi_petsc4py_example_tpu as tps
+        from mpi_petsc4py_example_tpu.utils.options import global_options
+        tps.init(["prog", "-pc_mg_smooth_type", "jacobi"])
+        try:
+            ksp = tps.KSP().create(comm8)
+            ksp.set_from_options()
+            pc = ksp.get_pc()
+            assert pc.mg_smoother == "jacobi"
+            pc.set_type("mg")
+            assert pc.program_key() == ("mg", "jacobi")
+            pc.mg_smoother = "chebyshev"
+            assert pc.program_key() == ("mg", "chebyshev")
+        finally:
+            global_options().clear()
+
+    def test_unknown_smoother_raises(self):
+        import pytest as _pytest
+
+        from mpi_petsc4py_example_tpu.solvers.mg import make_vcycle3d
+        with _pytest.raises(ValueError, match="smoother"):
+            make_vcycle3d(8, 8, 8, smoother="nosuch")
