@@ -28,6 +28,9 @@ Solver types (``set_type`` / ``-eps_type``):
 * ``lobpcg``   — same fusion: the 3m×3m projected pencil is whitened and
   solved on device inside one while_loop program
   (_build_lobpcg_loop_program), host fetch only at extraction.
+* ``lapack``   — SLEPc's EPSLAPACK: the FULL dense problem solved on host
+  (eigh/eig/generalized eigh), every pair exact; the small-n oracle as a
+  first-class type (round 5).
 
 Spectral transformations (``ST``; ``-st_type sinvert -st_shift s``) and
 generalized Hermitian problems ``A x = lambda B x`` are supported: the solver
@@ -63,7 +66,7 @@ from .st import ST
 DEFAULT_TOL = 1e-8        # SLEPc's EPS default
 DEFAULT_MAX_RESTARTS = 100
 
-EPS_TYPES = ("krylovschur", "arnoldi", "lanczos", "power", "subspace",
+EPS_TYPES = ("lapack", "krylovschur", "arnoldi", "lanczos", "power", "subspace",
              "lobpcg")
 
 
@@ -996,7 +999,9 @@ class EPS:
                 and self.st.sigma == 0.0):
             self.st.set_shift(self._target)
         t0 = time.perf_counter()
-        if self._type == "power":
+        if self._type == "lapack":
+            self._solve_lapack()
+        elif self._type == "power":
             self._solve_power()
         elif self._type == "subspace":
             self._solve_subspace()
@@ -1013,12 +1018,71 @@ class EPS:
         wall = time.perf_counter() - t0
         self.result = SolveResult(
             self._its, float(self._residuals[0]) if len(self._residuals)
-            else 0.0, 2 if self._nconv >= self.nev else -3, wall)
+            else 0.0,
+            # nev > n cannot "diverge": min(nev, n) pairs exist at all
+            2 if self._nconv >= min(self.nev, mat.shape[0]) else -3, wall)
         from ..utils.profiling import record_event
         record_event(
             f"EPSSolve({self._type},{self._problem_type},nev={self.nev})",
             mat.shape[0], self._its, wall, self.result.reason)
         return self
+
+    # ---- lapack (dense host solve — SLEPc's EPSLAPACK) ----------------------
+    _LAPACK_CAP = 16384   # O(n^2) dense storage + O(n^3) host factorization
+
+    def _solve_lapack(self):
+        """SLEPc's ``EPSLAPACK`` equivalent: solve the FULL dense problem
+        on host (LAPACK eigh/eig; [external] behind ``-eps_type lapack``
+        through the reference's ``setFromOptions``, petsc_funcs.py:17) and
+        select ``nev`` pairs by ``which``/``target``. Every reported pair
+        is exact to machine precision — the small-n oracle the iterative
+        types are tested against, now a first-class type. Host O(n^3);
+        capped like the dense direct paths."""
+        import scipy.linalg as sla
+        mat = self._mat
+        n = mat.shape[0]
+        if n > self._LAPACK_CAP:
+            raise ValueError(
+                f"EPS 'lapack' solves the full dense problem on host "
+                f"(O(n^3)); n={n} exceeds the {self._LAPACK_CAP} cap — "
+                "use krylovschur/lobpcg")
+        if not hasattr(mat, "to_scipy") or (
+                self._problem_type == EPSProblemType.GHEP
+                and not hasattr(self._bmat, "to_scipy")):
+            raise ValueError("EPS 'lapack' needs assembled matrices (Mat)")
+        A = mat.to_scipy().toarray()
+        hermitian = self._problem_type in (EPSProblemType.HEP,
+                                           EPSProblemType.GHEP)
+        if self._problem_type == EPSProblemType.GHEP:
+            B = self._bmat.to_scipy().toarray()
+            lam, V = sla.eigh(A, B)
+        elif hermitian:
+            lam, V = np.linalg.eigh((A + A.conj().T) / 2.0)
+        else:
+            lam, V = np.linalg.eig(A)
+        if self.st.get_type() == "sinvert":
+            # the iterative types' sinvert Krylov space contains the pairs
+            # CLOSEST TO sigma (largest |theta| = |1/(lam-sigma)|); the
+            # dense solve has every pair, so reproduce that selection
+            # explicitly — otherwise '-eps_type lapack -st_type sinvert'
+            # would silently return globally-extremal pairs instead
+            order = np.argsort(np.abs(lam - self.st.sigma), kind="stable")
+        else:
+            order = self._select(lam)
+        count = min(self.nev, n)
+        take = order[:count]
+        vecs = V[:, take].T
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        vecs = vecs / nrm
+        # exact dense residuals (machine-precision by construction)
+        if self._problem_type == EPSProblemType.GHEP:
+            R = A @ vecs.T - B @ vecs.T * lam[take][None, :]
+        else:
+            R = A @ vecs.T - vecs.T * lam[take][None, :]
+        rel = (np.linalg.norm(R, axis=0)
+               / np.maximum(np.abs(lam[take]), np.finfo(float).tiny))
+        self._store(lam[take], vecs, rel, count, 1)
 
     # ---- shared pieces ------------------------------------------------------
     def _setup_operator(self):

@@ -483,3 +483,125 @@ class TestLOBPCG:
         eps.set_which_eigenpairs("smallest_real")
         with pytest.raises(ValueError, match="Hermitian problem"):
             eps.solve()
+
+
+class TestEPSLapack:
+    """EPS 'lapack' (SLEPc's EPSLAPACK): full dense host solve, exact
+    pairs, selection by which/target — round 5."""
+
+    def test_hep_matches_eigh(self, comm8):
+        A = reference_tridiag(80)
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.set_dimensions(nev=3)
+        E.solve()
+        assert E.get_converged() == 3
+        want = lam_exact[np.argsort(-np.abs(lam_exact))][:3]
+        got = np.sort([E.get_eigenvalue(i).real for i in range(3)])
+        np.testing.assert_allclose(got, np.sort(want), rtol=1e-12)
+        # exact residuals by construction
+        assert float(E.result.residual_norm) < 1e-12
+
+    def test_nhep_complex_pair(self, comm8):
+        rng = np.random.default_rng(3)
+        A = sp.csr_matrix(rng.standard_normal((40, 40)))
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("nhep")
+        E.set_type("lapack")
+        E.set_dimensions(nev=2)
+        E.solve()
+        lam_exact = np.linalg.eigvals(A.toarray())
+        want = lam_exact[np.argsort(-np.abs(lam_exact))][:2]
+        got = [E.get_eigenvalue(i) for i in range(2)]
+        np.testing.assert_allclose(sorted(np.abs(got)),
+                                   sorted(np.abs(want)), rtol=1e-10)
+
+    def test_ghep(self, comm8):
+        import scipy.linalg as sla
+        n = 40
+        A = reference_tridiag(n)
+        Bm = sp.diags([np.linspace(1.0, 2.0, n)], [0]).tocsr()
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, Bm)
+        E = EPS().create(comm8)
+        E.set_operators(MA, MB)
+        E.set_problem_type("ghep")
+        E.set_type("lapack")
+        E.set_dimensions(nev=2)
+        E.solve()
+        lam_exact = sla.eigh(A.toarray(), Bm.toarray(),
+                             eigvals_only=True)
+        want = lam_exact[np.argsort(-np.abs(lam_exact))][:2]
+        got = [E.get_eigenvalue(i).real for i in range(2)]
+        np.testing.assert_allclose(sorted(got), sorted(want), rtol=1e-10)
+
+    def test_which_smallest_real(self, comm8):
+        A = reference_tridiag(60)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.set_which_eigenpairs("smallest_real")
+        E.set_dimensions(nev=1)
+        E.solve()
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, lam_exact[0],
+                                   rtol=1e-10)
+
+    def test_cap_error(self, comm8, monkeypatch):
+        A = reference_tridiag(50)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_type("lapack")
+        monkeypatch.setattr(EPS, "_LAPACK_CAP", 10)
+        with pytest.raises(ValueError, match="lapack"):
+            E.solve()
+
+    def test_option_db(self, comm8):
+        tps.init(["prog", "-eps_type", "lapack"])
+        try:
+            E = EPS().create(comm8)
+            A = reference_tridiag(30)
+            E.set_operators(tps.Mat.from_scipy(comm8, A))
+            E.set_from_options()
+            assert E._type == "lapack"
+        finally:
+            from mpi_petsc4py_example_tpu.utils.options import global_options
+            global_options().clear()
+
+    def test_sinvert_selects_pairs_nearest_shift(self, comm8):
+        A = reference_tridiag(60)
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        sigma = float(np.median(lam_exact))
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.get_st().set_type("sinvert")
+        E.get_st().set_shift(sigma)
+        E.set_dimensions(nev=2)
+        E.solve()
+        got = sorted(E.get_eigenvalue(i).real for i in range(2))
+        want = sorted(lam_exact[np.argsort(np.abs(lam_exact - sigma))][:2])
+        np.testing.assert_allclose(got, want, rtol=1e-10)
+
+    def test_nev_exceeding_n_still_converged(self, comm8):
+        A = reference_tridiag(20)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.set_dimensions(nev=50)        # > n: all 20 pairs exist
+        E.solve()
+        assert E.get_converged() == 20
+        assert E.result.reason == 2     # a complete spectrum is a success
