@@ -92,6 +92,7 @@ class EPSType:
     POWER = "power"
     SUBSPACE = "subspace"
     LOBPCG = "lobpcg"
+    LAPACK = "lapack"
 
 
 _PROGRAM_CACHE: dict = {}
