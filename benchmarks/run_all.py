@@ -70,9 +70,13 @@ def solve(comm, op, b, ksp_type, pc_type, rtol=RTOL, max_it=20000,
     t0 = time.perf_counter()
     res = ksp.solve(bv, x)
     wall = time.perf_counter() - t0
-    return x.to_numpy(), res, wall, dict(
+    extra = dict(
         pc_setup_s=round(pc_setup, 4),
         safeguard_reentries=int(getattr(ksp, "_last_reentries", 0)))
+    mode = getattr(ksp.get_pc(), "setup_mode", None)
+    if mode is not None:      # where block inversions ran (-pc_setup_device)
+        extra["pc_setup_mode"] = mode
+    return x.to_numpy(), res, wall, extra
 
 
 def true_relres(A, x, b):

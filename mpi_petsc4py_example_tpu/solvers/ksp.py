@@ -342,6 +342,9 @@ class KSP:
             pc.mg_smoother = mst
         pc.bjacobi_blocks = opt.get_int(p + "pc_bjacobi_blocks",
                                         pc.bjacobi_blocks)
+        sd = opt.get_string(p + "pc_setup_device")
+        if sd:
+            pc.setup_device = sd
         ct = opt.get_string(p + "pc_composite_type")
         if ct:
             pc.set_composite_type(ct)

@@ -23,9 +23,14 @@ pc.setFactorSolverType('mumps')`` (``test.py:40-43``). Types provided:
   (``-pc_asm_overlap``, default 1), per-device window solves.
 * ``mg``  — geometric multigrid V-cycle for structured stencil operators.
 
-Note: device-side LU is deliberately avoided — XLA:TPU implements
-LuDecomposition only for F32/C64 (observed on v5e), so factorizations happen
-on host and the device applies triangular-solve-free dense products.
+Note on factorization placement: XLA:TPU implements LuDecomposition only
+for F32/C64 (observed on v5e), so fp64/complex factorizations happen on
+host and the device applies triangular-solve-free dense products. fp32
+operators on TPU take a *device* setup path for ``bjacobi``
+(``-pc_setup_device``, default auto): the dense diagonal blocks ship as-is
+and a batched MXU LU + Newton polish builds the inverses on chip —
+orders of magnitude faster than the single-core host LAPACK sweep, same
+shipped bytes, quality-gated with automatic host fallback.
 
 Each PC exposes (a) sharded device arrays and (b) a *local* apply closure
 used inside the jit-compiled shard_map solver bodies, so preconditioning
@@ -87,6 +92,12 @@ class PC:
                                     # 5) | 'jacobi' (fixed omega = 2/3)
         self.bjacobi_blocks = 0     # -pc_bjacobi_blocks (0 = one per device,
                                     # auto-split past the dense cap)
+        self.setup_device = "auto"  # -pc_setup_device: 'auto' | '1' | '0' —
+                                    # where block inversions run ('auto' =
+                                    # device for fp32 on TPU, host LAPACK
+                                    # otherwise; see _want_device_setup)
+        self.setup_mode = None      # observability: 'device' | 'host' once
+                                    # a placement-capable kind is set up
         self._amg = None
         # PCSHELL: user apply (full-vector jax-traceable callable) + a uid so
         # compiled-program caches distinguish different shell functions
@@ -202,7 +213,8 @@ class PC:
         return (self._type, self.sor_omega, self.asm_overlap,
                 self.factor_fill, self.gamg_threshold,
                 self.gamg_coarse_size, self.gamg_max_levels,
-                self.mg_smoother, self.bjacobi_blocks, self._shell_uid,
+                self.mg_smoother, self.bjacobi_blocks, self.setup_device,
+                self._shell_uid,
                 self.composite_type,
                 tuple(c._tunables_key() for c in self._sub_pcs))
 
@@ -223,8 +235,9 @@ class PC:
         t = self._type
         # a rebuild must not pin a previous hostlu factorization (SuperLU
         # factor + fp64 CSR can be hundreds of MB) whatever mode it
-        # resolves to now
+        # resolves to now; setup_mode likewise reflects only THIS build
         self._hostlu = None
+        self.setup_mode = None
         if t == "none":
             self._arrays = ()
         elif t == "jacobi":
@@ -232,7 +245,8 @@ class PC:
             inv = np.where(diag != 0, 1.0 / np.where(diag == 0, 1.0, diag), 0.0)
             self._arrays = (comm.put_rows(inv.astype(mat.dtype)),)
         elif t == "bjacobi":
-            self._arrays = _build_bjacobi(comm, mat, self.bjacobi_blocks)
+            self._arrays = _build_bjacobi(comm, mat, self.bjacobi_blocks,
+                                          self.setup_device, owner=self)
         elif t in ("sor", "ssor"):
             self._arrays = _build_block_ssor(comm, mat, self.sor_omega)
         elif t in ("ilu", "icc"):
@@ -721,13 +735,24 @@ def _bjacobi_block_count(lsize: int, ndev: int, blocks: int) -> int:
     return nb
 
 
-def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
+def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
+                   setup_device: str = "auto", owner: "PC | None" = None):
     """Per-device inverses of the local diagonal block(s).
 
-    Factorized on host in fp64 (LAPACK), shipped as explicit inverses so the
-    device-side apply is one batched dense matvec on the MXU. With
-    ``-pc_bjacobi_blocks`` (or past the dense cap) each device holds several
-    smaller blocks instead of one ``lsize`` × ``lsize`` one.
+    Shipped as explicit inverses so the device-side apply is one batched
+    dense matvec on the MXU. With ``-pc_bjacobi_blocks`` (or past the dense
+    cap) each device holds several smaller blocks instead of one
+    ``lsize`` × ``lsize`` one.
+
+    Where the inversion itself runs is ``-pc_setup_device``-controlled
+    (:func:`_want_device_setup`): the device path ships the raw dense
+    blocks (the same bytes the host path ships as inverses) and inverts
+    them as one batched MXU LU + two Newton polish steps (:func:
+    `_device_inverse_blocks`) — on the round-4 cfg4 benchmark this replaces
+    a 17.5 s single-core host LAPACK sweep with ~1.5 s of device work
+    (plus the dev tunnel's per-process program-load cost, measured in
+    BASELINE.md). The host fp64 LAPACK sweep remains both the fallback (the
+    device result is quality-gated) and the fp64/complex path.
     """
     import scipy.linalg
     _require_assembled(mat, "bjacobi")
@@ -741,12 +766,106 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0):
             "'jacobi'/'gamg' (SURVEY.md §7.4)")
     A = mat.to_scipy().tocsr()
     bs = lsize // nb
+    if _want_device_setup(comm, mat.dtype, setup_device):
+        dense = _dense_diag_blocks(A, n, bs, comm.size * nb,
+                                   np.dtype(mat.dtype))
+        shipped = _device_inverse_blocks(comm, dense)
+        if shipped is not None:
+            if owner is not None:
+                owner.setup_mode = "device"   # observability (view/bench)
+            return (shipped,)
+    if owner is not None:
+        owner.setup_mode = "host"
     host_dt = host_dtype(mat.dtype)
     inv = _per_device_inverse(
         A, n, bs, comm.size * nb,
         lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
         host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
+
+
+def _want_device_setup(comm: DeviceComm, dtype, setup_device) -> bool:
+    """Resolve ``-pc_setup_device`` ('auto'/'1'/'0').
+
+    auto = device only for fp32 operators on a TPU mesh: there the batched
+    MXU LU beats the single-core host LAPACK sweep by orders of magnitude
+    and the shipped bytes are identical either way. fp64/complex stay on
+    host (XLA:TPU has no F64/C128 LuDecomposition — module docstring), and
+    on CPU meshes the "device" inversion IS host LAPACK, so there is
+    nothing to win.
+    """
+    s = str(setup_device).lower()
+    if s in ("0", "false", "host", "no"):
+        return False
+    if s in ("1", "true", "device", "yes"):
+        return True
+    if s != "auto":
+        raise ValueError(
+            f"-pc_setup_device {setup_device!r}: expected 'auto', '0' or '1'")
+    return comm.platform == "tpu" and np.dtype(dtype) == np.float32
+
+
+def _dense_diag_blocks(A, n: int, bs: int, nblocks: int, dt) -> np.ndarray:
+    """(nblocks, bs, bs) dense diagonal-block stack of the host CSR ``A``;
+    out-of-range / padding rows get identity (inverts to identity, so
+    padded vector slots pass through unchanged)."""
+    return _per_device_inverse(A, n, bs, nblocks,
+                               lambda B: B.toarray(), host_dt=dt)
+
+
+_DEVICE_INV_GATE = 1e-2  # post-polish ||I - B X||_max acceptance bound
+
+
+@jax.jit
+def _inv_polish(B):
+    """Batched inverse + two Newton polish steps + NaN-proof quality scalar
+    (module-level jit: compiled once per (shape, dtype), not per PC
+    setup)."""
+    eye = jnp.eye(B.shape[-1], dtype=B.dtype)
+    X = jnp.linalg.inv(B)
+    # two Newton polish steps X ← X + X(I − BX): each squares the LU
+    # roundoff residual (an fp32 LU of a cond~1e6 block starts near ~1e-1;
+    # the second step puts q well inside the gate); 2 batched MXU matmuls
+    # per step
+    X = X + X @ (eye - B @ X)
+    X = X + X @ (eye - B @ X)
+    # NaN-proof gate: XLA's max-reduce DROPS NaNs (NaN comparisons are
+    # false, so the accumulator survives) — a singular block's all-NaN
+    # inverse would otherwise report q = 0
+    q = jnp.where(jnp.all(jnp.isfinite(X)),
+                  jnp.max(jnp.abs(eye - B @ X)), jnp.inf)
+    return X, q
+
+
+def _device_inverse_blocks(comm: DeviceComm, blocks: np.ndarray):
+    """Batched block inversion ON the mesh devices.
+
+    ``blocks``: (M, bs, bs) host stack in the operator dtype, M divisible
+    by the device count. Ships the stack axis-0-sharded and runs
+    :func:`_inv_polish` (batched LU + two Newton polish steps), so the
+    polished fp32 inverse lands at the same ~eps32 quantization quality
+    the host path reaches by fp64-factorizing and casting. Returns the
+    sharded (M, bs, bs) inverse stack, or ``None`` when the post-polish
+    gate ``max|I − BX| ≤ 1e-2`` fails (singular or too ill-conditioned
+    for the apply dtype) or the device path errors (unsupported-dtype
+    compile from a forced ``-pc_setup_device 1``, transient remote-compile
+    failures) — callers then fall back to the pivot-quality host fp64
+    path, which raises the proper error for genuinely singular blocks.
+    """
+    try:
+        B = comm.put_axis0(blocks)
+        X, q = _inv_polish(B)
+        q = float(q)   # sync: setup-time only, one scalar
+    except Exception as e:  # noqa: BLE001
+        import warnings
+        warnings.warn(
+            f"device-side block inversion failed ({type(e).__name__}); "
+            "falling back to host LAPACK setup", RuntimeWarning,
+            stacklevel=2)
+        return None
+    if not np.isfinite(q) or q > _DEVICE_INV_GATE:
+        return None
+    return X
 
 
 def _require_assembled(mat, pc_name: str):
