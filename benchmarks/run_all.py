@@ -76,6 +76,9 @@ def solve(comm, op, b, ksp_type, pc_type, rtol=RTOL, max_it=20000,
     mode = getattr(ksp.get_pc(), "setup_mode", None)
     if mode is not None:      # where block inversions ran (-pc_setup_device)
         extra["pc_setup_mode"] = mode
+    brk = getattr(ksp.get_pc(), "setup_breakdown", None)
+    if brk is not None:
+        extra["pc_setup_breakdown"] = brk
     return x.to_numpy(), res, wall, extra
 
 
@@ -482,6 +485,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated subset, e.g. 'cfg1,cfg4' "
+                         "(iteration aid; schema checks apply only to "
+                         "full sweeps)")
     opts = ap.parse_args()
 
     import jax
@@ -489,13 +496,20 @@ def main():
     comm = tps.DeviceComm()
     results = {"platform": jax.devices()[0].platform,
                "devices": len(jax.devices()), "configs": []}
-    for fn in (lambda: config1(comm, opts.quick),
-               lambda: config2(comm, opts.quick),
-               lambda: config3(comm, opts.quick),
-               lambda: config4(comm, opts.quick),
-               lambda: config5(comm, opts.quick)):
+    all_cfgs = {"cfg1": config1, "cfg2": config2, "cfg3": config3,
+                "cfg4": config4, "cfg5": config5}
+    if opts.configs:
+        names = [s.strip() for s in opts.configs.split(",") if s.strip()]
+        bad = [s for s in names if s not in all_cfgs]
+        if bad:
+            ap.error(f"unknown configs {bad}; choose from {list(all_cfgs)}")
+        run_cfgs = {k: all_cfgs[k] for k in names}
+    else:
+        run_cfgs = all_cfgs
+    full_sweep = set(run_cfgs) == set(all_cfgs)
+    for fn in run_cfgs.values():
         try:
-            r = fn()
+            r = fn(comm, opts.quick)
         except Exception as e:  # noqa: BLE001 — record per-config failures
             r = dict(config=fn.__name__, error=repr(e))
         results["configs"].append(r)
@@ -503,7 +517,8 @@ def main():
     parities = [c.get("residual_parity") for c in results["configs"]]
     results["residual_parity_all"] = bool(all(p is True for p in parities))
     print(json.dumps({"residual_parity_all": results["residual_parity_all"]}))
-    check_schema(results, quick=opts.quick)
+    if full_sweep:
+        check_schema(results, quick=opts.quick)
     if opts.out:
         with open(opts.out, "w") as f:
             json.dump(results, f, indent=2)

@@ -98,6 +98,8 @@ class PC:
                                     # otherwise; see _want_device_setup)
         self.setup_mode = None      # observability: 'device' | 'host' once
                                     # a placement-capable kind is set up
+        self.setup_breakdown = None  # device-mode phase split (extract_s /
+                                     # invert_s), for the benchmark artifact
         self._amg = None
         # PCSHELL: user apply (full-vector jax-traceable callable) + a uid so
         # compiled-program caches distinguish different shell functions
@@ -238,6 +240,7 @@ class PC:
         # resolves to now; setup_mode likewise reflects only THIS build
         self._hostlu = None
         self.setup_mode = None
+        self.setup_breakdown = None
         if t == "none":
             self._arrays = ()
         elif t == "jacobi":
@@ -766,21 +769,39 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
             "'jacobi'/'gamg' (SURVEY.md §7.4)")
     A = mat.to_scipy().tocsr()
     bs = lsize // nb
+    dense = None
     if _want_device_setup(comm, mat.dtype, setup_device):
+        import time
+        t0 = time.perf_counter()
         dense = _dense_diag_blocks(A, n, bs, comm.size * nb,
                                    np.dtype(mat.dtype))
+        t1 = time.perf_counter()
         shipped = _device_inverse_blocks(comm, dense)
         if shipped is not None:
             if owner is not None:
                 owner.setup_mode = "device"   # observability (view/bench)
+                # extract = host dense-block assembly; invert = ship +
+                # program load (the dev tunnel's per-process tax) + the
+                # batched MXU inversion itself
+                owner.setup_breakdown = {
+                    "extract_s": round(t1 - t0, 4),
+                    "invert_s": round(time.perf_counter() - t1, 4)}
             return (shipped,)
     if owner is not None:
         owner.setup_mode = "host"
+        owner.setup_breakdown = None
     host_dt = host_dtype(mat.dtype)
-    inv = _per_device_inverse(
-        A, n, bs, comm.size * nb,
-        lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
-        host_dt=host_dt)
+    if dense is not None:
+        # gate/device failure fallback: reuse the extracted stack (its
+        # values ARE the operator-dtype CSR values — casting up loses
+        # nothing) instead of re-walking the CSR
+        inv = np.stack([scipy.linalg.inv(blk.astype(host_dt))
+                        for blk in dense])
+    else:
+        inv = _per_device_inverse(
+            A, n, bs, comm.size * nb,
+            lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
+            host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
 
 

@@ -113,6 +113,31 @@ class TestEndToEnd:
                                    rtol=1e-12, atol=1e-12)
 
 
+class TestGateFallback:
+    def test_gate_failure_reuses_extracted_stack(self, comm8, monkeypatch):
+        """A rejected device inversion falls back to host LAPACK over the
+        already-extracted dense stack — same numbers as the pure host
+        path, setup_mode 'host'."""
+        monkeypatch.setattr(pcmod, "_device_inverse_blocks",
+                            lambda comm, blocks: None)
+        A = convdiff2d(16)
+        ph = _built_bjacobi(comm8, A, np.float64, "0")
+        pf = _built_bjacobi(comm8, A, np.float64, "1")   # forced, rejected
+        assert pf.setup_mode == "host"
+        np.testing.assert_allclose(_blocks_of(pf), _blocks_of(ph),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_singular_block_raises_proper_error(self, comm8):
+        """End-to-end: device gate rejects a singular block and the host
+        fallback raises LAPACK's singular-matrix error (not a silent bad
+        inverse)."""
+        d = np.ones(64)
+        d[10] = 0.0
+        A = sp.diags(d).tocsr()
+        with pytest.raises(Exception, match="[Ss]ingular"):
+            _built_bjacobi(comm8, A, np.float64, "1")
+
+
 class TestPlacementRule:
     def test_auto_is_host_on_cpu_mesh(self, comm8):
         assert not pcmod._want_device_setup(comm8, np.float32, "auto")
