@@ -632,18 +632,38 @@ def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     """Apply the preconditioner exactly once (KSPPREONLY equivalent).
 
     With PC 'lu' this is the reference's direct-solve path
-    (``test.py:38-43``: preonly + PCLU + MUMPS). Two steps of iterative
-    refinement recover accuracy lost to reduced-precision application of the
-    factorization (the fp32-on-TPU story, SURVEY.md §7.3) — they are exact
-    no-ops when M is the exact inverse.
+    (``test.py:38-43``: preonly + PCLU + MUMPS). Iterative refinement
+    recovers accuracy lost to reduced-precision application of the
+    factorization (the fp32-on-TPU story, SURVEY.md §7.3): steps repeat
+    while the true residual keeps halving, so an exact inverse exits after
+    the same two applies as the old fixed-two-step scheme, while a
+    reduced-precision factorization (fp32 device BPCR, dense-cast factors)
+    polishes on at ~one SpMV + apply per step until its factor-limited
+    accuracy floor (cap 20). A non-improving step is discarded, so the
+    returned iterate is never worse than the plain single apply.
     """
     x = M(b)
+    r = b - A(x)
+    rn = pnorm(r)
 
-    def refine(_, x):
-        return x + M(b - A(x))
+    def cond(st):
+        k, x, r, rn, go = st
+        return go
 
-    x = lax.fori_loop(0, 2, refine, x)
-    rnorm = pnorm(b - A(x))
+    def body(st):
+        k, x, r, rn, _ = st
+        x2 = x + M(r)
+        r2 = b - A(x2)
+        rn2 = pnorm(r2)
+        better = rn2 < rn
+        x2 = jnp.where(better, x2, x)
+        r2 = jnp.where(better, r2, r)
+        rn_keep = jnp.where(better, rn2, rn)
+        go = (rn2 < 0.5 * rn) & (k + 1 < 20)
+        return (k + 1, x2, r2, rn_keep, go)
+
+    _, x, _, rnorm, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), x, r, rn, rn > 0))
     return (x, jnp.int32(1), rnorm,
             jnp.full((), CR.CONVERGED_ITS, jnp.int32),
             _hist0(monitor, b.dtype))

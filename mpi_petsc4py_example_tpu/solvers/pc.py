@@ -283,7 +283,9 @@ class PC:
                     and _bcr_fits(n, bw)):
                 # banded in its given ordering: block cyclic reduction —
                 # bw x bw blocks cover every offset in [-bw..bw]
-                self._arrays = _build_banded_bcr(comm, mat, bw)
+                self._arrays = _build_banded_bcr(
+                    comm, mat, bw, setup_device=self.setup_device,
+                    owner=self)
                 self._factor_mode = "crband"
             elif n > _DENSE_CAP and hasattr(mat, "to_scipy"):
                 # everything else past the dense cap — general sparsity OR
@@ -295,7 +297,8 @@ class PC:
                 perm, bw_rcm, A_perm = _rcm_bandwidth(mat)
                 if _bcr_fits(n, max(bw_rcm, 2)):
                     self._arrays = _build_banded_bcr(
-                        comm, mat, max(bw_rcm, 2), perm=perm, A_perm=A_perm)
+                        comm, mat, max(bw_rcm, 2), perm=perm, A_perm=A_perm,
+                        setup_device=self.setup_device, owner=self)
                     self._factor_mode = "crband"
                 else:
                     # irreducible sparsity past every device-direct cap:
@@ -805,15 +808,19 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
     return _ship_blocks(comm, inv, mat.dtype)
 
 
-def _want_device_setup(comm: DeviceComm, dtype, setup_device) -> bool:
+def _want_device_setup(comm: DeviceComm, dtype, setup_device,
+                       f64_ok: bool = False) -> bool:
     """Resolve ``-pc_setup_device`` ('auto'/'1'/'0').
 
-    auto = device only for fp32 operators on a TPU mesh: there the batched
-    MXU LU beats the single-core host LAPACK sweep by orders of magnitude
-    and the shipped bytes are identical either way. fp64/complex stay on
-    host (XLA:TPU has no F64/C128 LuDecomposition — module docstring), and
-    on CPU meshes the "device" inversion IS host LAPACK, so there is
-    nothing to win.
+    auto = device only on a TPU mesh, where the batched MXU work beats the
+    single-core host LAPACK sweep by orders of magnitude. bjacobi is
+    fp32-only there (its inversion is a direct ``jnp.linalg.inv`` and
+    XLA:TPU has no F64/C128 LuDecomposition — module docstring); the
+    block-PCR path passes ``f64_ok`` because it seeds every inverse from
+    an F32 LU and polishes in emulated f64, so real fp64 operators work
+    too. Complex stays on host (this TPU runtime has no complex support,
+    PARITY.md). On CPU meshes the "device" inversion IS host LAPACK, so
+    there is nothing to win.
     """
     s = str(setup_device).lower()
     if s in ("0", "false", "host", "no"):
@@ -823,7 +830,10 @@ def _want_device_setup(comm: DeviceComm, dtype, setup_device) -> bool:
     if s != "auto":
         raise ValueError(
             f"-pc_setup_device {setup_device!r}: expected 'auto', '0' or '1'")
-    return comm.platform == "tpu" and np.dtype(dtype) == np.float32
+    if comm.platform != "tpu":
+        return False
+    d = np.dtype(dtype)
+    return d == np.float32 or (f64_ok and d == np.float64)
 
 
 def _dense_diag_blocks(A, n: int, bs: int, nblocks: int, dt) -> np.ndarray:
@@ -1073,7 +1083,8 @@ def _rcm_bandwidth(mat: Mat):
 
 
 def _build_banded_bcr(comm: DeviceComm, mat: Mat, bw: int, perm=None,
-                      A_perm=None):
+                      A_perm=None, setup_device: str = "auto",
+                      owner: "PC | None" = None):
     """Block-cyclic-reduction factorization of a banded operator with
     bandwidth ``1 < bw`` fitting :func:`_bcr_fits` — the MUMPS-slot direct
     path past the dense cap (pentadiagonal Poisson lines, coupled
@@ -1090,19 +1101,32 @@ def _build_banded_bcr(comm: DeviceComm, mat: Mat, bw: int, perm=None,
     permutation; the returned array tuple then carries the permutation
     and its inverse as trailing int32 arrays.
     """
-    from .tridiag import banded_to_blocks, bpcr_setup
+    from .tridiag import banded_to_blocks, bpcr_setup, bpcr_setup_device_csr
     _require_assembled(mat, "lu")
     if perm is not None:
         A = (A_perm if A_perm is not None
              else mat.to_scipy().tocsr()[perm][:, perm].tocsr())
     else:
         A = mat.to_scipy().tocsr()
-    Ab, Bb, Cb = banded_to_blocks(A, bw)
-    alphas, gammas, binv = bpcr_setup(Ab, Bb, Cb, apply_dtype=mat.dtype)
     dt = mat.dtype
-    out = (comm.put_replicated(alphas.astype(dt)),
-           comm.put_replicated(gammas.astype(dt)),
-           comm.put_replicated(binv.astype(dt)))
+    out = None
+    if _want_device_setup(comm, dt, setup_device, f64_ok=True):
+        timings: dict = {}
+        dev = bpcr_setup_device_csr(A, bw, comm, dt, timings=timings)
+        if dev is not None:
+            out = dev
+            if owner is not None:
+                owner.setup_mode = "device"
+                owner.setup_breakdown = timings
+    if out is None:
+        if owner is not None:
+            owner.setup_mode = "host"
+            owner.setup_breakdown = None
+        Ab, Bb, Cb = banded_to_blocks(A, bw)
+        alphas, gammas, binv = bpcr_setup(Ab, Bb, Cb, apply_dtype=dt)
+        out = (comm.put_replicated(alphas.astype(dt)),
+               comm.put_replicated(gammas.astype(dt)),
+               comm.put_replicated(binv.astype(dt)))
     if perm is not None:
         iperm = np.argsort(perm)
         out += (comm.put_replicated(perm.astype(np.int32)),

@@ -389,6 +389,204 @@ def bpcr_setup(Ab, Bb, Cb, apply_dtype=None):
     return alphas, gammas, binv
 
 
+_BPCR_SETUP_PROGRAMS: dict = {}   # (N, b, S, nnz, dt, cdt, mesh) -> jit fn
+
+
+def bpcr_setup_device_csr(A_csr, b: int, comm, dtype, timings=None):
+    """Device-side block-PCR factorization from the banded CSR itself —
+    the production route (:func:`bpcr_setup_device` wraps dense stacks
+    for tests/parity).
+
+    Ships only the COO triplets (~16 bytes/nnz — a 256² RCM-Poisson is
+    ~6 MB) and scatter-builds the (3, N, b, b) block stacks IN-PROGRAM:
+    shipping the dense stacks was measured at ~3 s per 67 MB through the
+    dev tunnel, dominating the whole setup, and this also skips the host
+    ``banded_to_blocks`` densification entirely.
+
+    ``timings``: optional dict filled with ``extract_s`` (host triplet
+    prep) and ``invert_s`` (ship + program load + device factorization) —
+    the same split PC bjacobi's ``setup_breakdown`` records.
+    """
+    import time
+    t0 = time.perf_counter()
+    n = A_csr.shape[0]
+    N = -(-n // b)
+    dt = np.dtype(dtype)
+    coo = A_csr.tocoo()
+    bi = (coo.row // b).astype(np.int64)
+    bj = (coo.col // b).astype(np.int64)
+    delta = bj - bi
+    if delta.size and (delta.min() < -1 or delta.max() > 1):
+        raise ValueError(
+            f"bpcr_setup_device_csr: operator bandwidth exceeds the block "
+            f"size {b}")
+    npad = N * b - n                   # identity diagonal for tail padding
+    pad_r = np.arange(n, N * b)
+    idx = np.stack([
+        np.concatenate([delta + 1, np.ones(npad, np.int64)]),
+        np.concatenate([bi, pad_r // b]),
+        np.concatenate([coo.row - bi * b, pad_r % b]),
+        np.concatenate([coo.col - bj * b, pad_r % b]),
+    ], axis=1).astype(np.int32)
+    vals = np.concatenate([np.asarray(coo.data, dt), np.ones(npad, dt)])
+    t1 = time.perf_counter()
+    out = _bpcr_device_factor(comm, dt, N, b, vals, idx)
+    if timings is not None:
+        timings["extract_s"] = round(t1 - t0, 4)
+        timings["invert_s"] = round(time.perf_counter() - t1, 4)
+    return out
+
+
+def bpcr_setup_device(Ab, Bb, Cb, comm, dtype):
+    """Device-side block-PCR factorization from dense (N, b, b) stacks
+    (``banded_to_blocks`` layout) — triplet-izes the nonzeros and defers
+    to the shared :func:`_bpcr_device_factor`."""
+    dt = np.dtype(dtype)
+    A0 = np.asarray(Ab, dt).copy()
+    B0 = np.asarray(Bb, dt)
+    C0 = np.asarray(Cb, dt).copy()
+    if B0.shape[0] == 0:
+        raise ValueError("bpcr_setup_device: empty system")
+    A0[0] = 0.0
+    C0[-1] = 0.0
+    T = np.stack([A0, B0, C0])
+    d, bi, rr, cc = np.nonzero(T)
+    idx = np.stack([d, bi, rr, cc], axis=1).astype(np.int32)
+    return _bpcr_device_factor(comm, dt, B0.shape[0], B0.shape[1],
+                               T[d, bi, rr, cc].astype(dt), idx)
+
+
+def _bpcr_device_factor(comm, dt, N: int, b: int, vals, idx):
+    """The round-5 device block-PCR factorization (the VERDICT's 'invert
+    on device with refinement' alternative to the host-serial LAPACK
+    batch).
+
+    Same reduction as :func:`bpcr_setup`, but the ``S = ceil(log2 N)``
+    sweeps run as ONE compiled program of batched (N, b, b) MXU work
+    (``lax.fori_loop`` with roll+mask dynamic shifts — a statically
+    unrolled version's 9 LU expansions made a ~40 MB executable whose
+    per-process load through the dev tunnel cost more than the host sweep
+    it replaced). Precision discipline matches the host path: the
+    reduction arithmetic runs in fp64 (complex128) — on TPU, XLA emulates
+    f64 dots at near-f32 MXU throughput — and only the final factors are
+    cast to the apply dtype. A pure apply-dtype reduction was measured
+    and rejected: fp32 intermediate arithmetic explodes the pivotless
+    reduction of the RCM-Poisson family (probe ~4e4) even though the CAST
+    fp64 factors apply fine in fp32. XLA:TPU has no F64 LuDecomposition,
+    so each block inverse seeds from an F32 (C64) LU and two f64 Newton
+    polish steps restore ~1e-9 inverse quality (measured).
+
+    Gating mirrors :func:`bpcr_setup`: the ``A·ones`` probe solve runs on
+    device with the fp64 factors (gate 1e-3) AND with the cast factors
+    (gate 0.1 — KSPPREONLY's stall-detecting refinement recovers
+    reduced-precision roundoff); NaN-proof (XLA's max-reduce drops NaNs).
+    Returns ``(alphas, gammas, binv)`` as replicated DEVICE arrays of
+    ``dt`` — never fetched to host — or ``None`` when a probe or the
+    device path fails (the caller falls back to the host fp64 setup).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from ..utils.dtypes import host_dtype, is_complex
+
+    cdt = np.dtype(host_dtype(dt))            # f64 / c128 compute dtype
+    ldt = np.dtype(np.complex64 if is_complex(dt) else np.float32)  # LU seed
+    S = max(1, int(np.ceil(np.log2(N)))) if N > 1 else 1
+    eye = np.eye(b, dtype=cdt)
+    nidx = np.arange(N)
+
+    def shift_dyn(M, s, fill):
+        """out[i] = M[i-s] in-range, else ``fill`` (s traced, ±)."""
+        rolled = jnp.roll(M, s, axis=0)
+        ok = (nidx >= s) & (nidx < N + s)
+        return jnp.where(ok.reshape((N,) + (1,) * (M.ndim - 1)),
+                         rolled, fill)
+
+    def binv_polished(B):
+        X = jnp.linalg.inv(B.astype(ldt)).astype(cdt)
+        X = X + X @ (eye - B @ X)
+        X = X + X @ (eye - B @ X)
+        return X
+
+    def probe(al, ga, binv, D):
+        def sweep(k, D):
+            s = jnp.left_shift(jnp.int32(1), k)
+            Du = shift_dyn(D, s, jnp.zeros((), D.dtype))
+            Dd = shift_dyn(D, -s, jnp.zeros((), D.dtype))
+            return (D + jnp.einsum("nij,nj->ni", al[k], Du)
+                    + jnp.einsum("nij,nj->ni", ga[k], Dd))
+        D = lax.fori_loop(0, S, sweep, D)
+        x1 = jnp.einsum("nij,nj->ni", binv, D)
+        return jnp.where(jnp.all(jnp.isfinite(x1)),
+                         jnp.max(jnp.abs(x1 - 1.0)), jnp.inf)
+
+    def setup(vals, idx):
+        # scatter-build the blocks, upcast, probe rhs: all in-program
+        T = jnp.zeros((3, N, b, b), cdt).at[
+            idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]].add(
+                vals.astype(cdt))
+        A, B, C = T[0], T[1], T[2]
+        d1 = jnp.einsum("nij,j->ni", A + B + C, jnp.ones(b, cdt))
+        al0 = jnp.zeros((S, N, b, b), cdt)
+
+        def sweep(k, st):
+            A, B, C, al, ga = st
+            s = jnp.left_shift(jnp.int32(1), k)
+            invB = binv_polished(B)
+            alpha = -(A @ shift_dyn(invB, s, eye))
+            gamma = -(C @ shift_dyn(invB, -s, eye))
+            al = al.at[k].set(alpha)
+            ga = ga.at[k].set(gamma)
+            zero = jnp.zeros((), cdt)
+            A2 = alpha @ shift_dyn(A, s, zero)
+            C2 = gamma @ shift_dyn(C, -s, zero)
+            B2 = (B + alpha @ shift_dyn(C, s, zero)
+                  + gamma @ shift_dyn(A, -s, zero))
+            return (A2, B2, C2, al, ga)
+
+        A, B, C, al, ga = lax.fori_loop(0, S, sweep, (A, B, C, al0, al0))
+        binv = binv_polished(B)
+        q64 = probe(al, ga, binv, d1)
+        al_c, ga_c, binv_c = (al.astype(dt), ga.astype(dt),
+                              binv.astype(dt))
+        qc = probe(al_c, ga_c, binv_c, d1.astype(dt)) \
+            if dt != cdt else q64
+        finite = (jnp.all(jnp.isfinite(al)) & jnp.all(jnp.isfinite(ga))
+                  & jnp.all(jnp.isfinite(binv)))
+        q64 = jnp.where(finite, q64, jnp.inf)
+        return al_c, ga_c, binv_c, q64, qc
+
+    rep = comm.replicated_sharding
+    key = (N, b, S, len(vals), dt.str, cdt.str, comm.mesh)
+    fn = _BPCR_SETUP_PROGRAMS.get(key)
+    if fn is None:
+        # cache the jitted program: a fresh jax.jit per call would retrace
+        # every time (same lesson as pc.py's module-level _inv_polish)
+        fn = jax.jit(setup, out_shardings=(rep, rep, rep, rep, rep))
+        _BPCR_SETUP_PROGRAMS[key] = fn
+    try:
+        al, ga, binv, q64, qc = fn(comm.put_replicated(vals),
+                                   comm.put_replicated(idx))
+        q64 = float(q64)   # sync: setup-time only, two scalars
+        qc = float(qc)
+    except Exception as e:  # noqa: BLE001 — unsupported-dtype compiles,
+        # transient remote-compile failures: host fp64 path is the answer
+        import warnings
+        warnings.warn(
+            f"device-side block-PCR setup failed ({type(e).__name__}); "
+            "falling back to host fp64 setup", RuntimeWarning, stacklevel=2)
+        return None
+    if not (np.isfinite(q64) and np.isfinite(qc)) \
+            or q64 > 1e-3 or qc > 0.1:
+        import warnings
+        warnings.warn(
+            f"device block-PCR factorization failed its probe solve "
+            f"(max|x-1| = {q64:.2e} in {cdt}, {qc:.2e} cast to {dt}); "
+            "using the host fp64 setup", RuntimeWarning, stacklevel=2)
+        return None
+    return al, ga, binv
+
+
 def bpcr_apply_np(D, alphas, gammas, binv):
     """Host-numpy mirror of :func:`bpcr_apply` (probe + test oracle).
     ``D``: (N, b) rhs blocks."""

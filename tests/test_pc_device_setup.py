@@ -143,6 +143,14 @@ class TestPlacementRule:
         assert not pcmod._want_device_setup(comm8, np.float32, "auto")
         assert not pcmod._want_device_setup(comm8, np.float64, "auto")
 
+    def test_f64_ok_widens_auto_only_with_flag(self, comm8):
+        # the BPCR path passes f64_ok=True (f32-LU seed + emulated-f64
+        # polish); bjacobi does not — but neither engages on a CPU mesh
+        assert not pcmod._want_device_setup(comm8, np.float64, "auto",
+                                            f64_ok=True)
+        assert not pcmod._want_device_setup(comm8, np.complex128, "auto",
+                                            f64_ok=True)
+
     def test_forced_values(self, comm8):
         assert pcmod._want_device_setup(comm8, np.float64, "1")
         assert pcmod._want_device_setup(comm8, np.float64, "device")
