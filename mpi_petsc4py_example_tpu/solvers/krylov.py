@@ -628,23 +628,31 @@ def gmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
 
 def preonly_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-                   dtol=None):
+                   dtol=None, refine=False):
     """Apply the preconditioner exactly once (KSPPREONLY equivalent).
 
     With PC 'lu' this is the reference's direct-solve path
-    (``test.py:38-43``: preonly + PCLU + MUMPS). Iterative refinement
-    recovers accuracy lost to reduced-precision application of the
-    factorization (the fp32-on-TPU story, SURVEY.md §7.3): steps repeat
-    while the true residual keeps halving, so an exact inverse exits after
-    the same two applies as the old fixed-two-step scheme, while a
-    reduced-precision factorization (fp32 device BPCR, dense-cast factors)
-    polishes on at ~one SpMV + apply per step until its factor-limited
-    accuracy floor (cap 20). A non-improving step is discarded, so the
-    returned iterate is never worse than the plain single apply.
+    (``test.py:38-43``: preonly + PCLU + MUMPS). ``refine`` is set by the
+    program builder ONLY for direct-factorization PC kinds (dense lu /
+    cyclic-reduction modes): there, iterative refinement recovers
+    accuracy lost to reduced-precision application of the factorization
+    (the fp32-on-TPU story, SURVEY.md §7.3) — steps repeat while the true
+    residual keeps halving, so an exact inverse exits after two applies,
+    while a reduced-precision factorization (fp32 device BPCR, dense-cast
+    factors) polishes on at ~one SpMV + apply per step until its
+    factor-limited accuracy floor (cap 20). A non-improving step is
+    discarded, so the returned iterate is never worse than the plain
+    single apply. Non-direct PCs keep PETSc's literal KSPPREONLY
+    semantics — exactly one application, no refinement (a contracting
+    PC like gamg would otherwise silently run a 20-step Richardson).
     """
     x = M(b)
     r = b - A(x)
     rn = pnorm(r)
+    if not refine:
+        return (x, jnp.int32(1), rn,
+                jnp.full((), CR.CONVERGED_ITS, jnp.int32),
+                _hist0(monitor, b.dtype))
 
     def cond(st):
         k, x, r, rn, go = st
@@ -1962,6 +1970,11 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     kw["aug"] = aug
             elif ksp_type == "bcgsl":
                 kw["ell"] = ell
+            elif ksp_type == "preonly":
+                # refinement is for direct factorizations only (PETSc's
+                # KSPPREONLY is literally one PC apply); pc.program_key()
+                # is in the cache key, so this bool can't go stale
+                kw["refine"] = pc.kind in ("lu", "crtri", "crband")
             elif ksp_type in ("pipecg", "fbcgsr"):
                 # the whole point: all per-iteration dots in ONE fused psum
                 kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),

@@ -311,7 +311,8 @@ class PC:
                     self._hostlu = _build_host_splu(mat, t)
                     self._factor_mode = "hostlu"
             else:
-                self._arrays = _build_dense_lu(comm, mat)
+                self._arrays = _build_dense_lu(
+                    comm, mat, setup_device=self.setup_device, owner=self)
                 self._factor_mode = "dense"
         elif t in ("gamg", "amg"):
             from .amg import AMGHierarchy
@@ -773,7 +774,7 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
     A = mat.to_scipy().tocsr()
     bs = lsize // nb
     dense = None
-    if _want_device_setup(comm, mat.dtype, setup_device):
+    if _want_device_setup(comm, mat.dtype, setup_device, f64_ok=True):
         import time
         t0 = time.perf_counter()
         dense = _dense_diag_blocks(A, n, bs, comm.size * nb,
@@ -813,14 +814,14 @@ def _want_device_setup(comm: DeviceComm, dtype, setup_device,
     """Resolve ``-pc_setup_device`` ('auto'/'1'/'0').
 
     auto = device only on a TPU mesh, where the batched MXU work beats the
-    single-core host LAPACK sweep by orders of magnitude. bjacobi is
-    fp32-only there (its inversion is a direct ``jnp.linalg.inv`` and
-    XLA:TPU has no F64/C128 LuDecomposition — module docstring); the
-    block-PCR path passes ``f64_ok`` because it seeds every inverse from
-    an F32 LU and polishes in emulated f64, so real fp64 operators work
-    too. Complex stays on host (this TPU runtime has no complex support,
-    PARITY.md). On CPU meshes the "device" inversion IS host LAPACK, so
-    there is nothing to win.
+    single-core host LAPACK sweep by orders of magnitude. Callers pass
+    ``f64_ok`` when they have an fp64-capable device program — XLA:TPU
+    has no F64/C128 LuDecomposition (module docstring), so fp64 paths
+    seed each inverse from an F32 LU and Newton-polish in emulated f64
+    (``_inv_polish_seeded``, ``tridiag._bpcr_device_factor``); bjacobi,
+    dense-lu, and block-PCR all do. Complex stays off auto (this TPU
+    runtime has no complex support, PARITY.md). On CPU meshes the
+    "device" inversion IS host LAPACK, so there is nothing to win.
     """
     s = str(setup_device).lower()
     if s in ("0", "false", "host", "no"):
@@ -847,17 +848,9 @@ def _dense_diag_blocks(A, n: int, bs: int, nblocks: int, dt) -> np.ndarray:
 _DEVICE_INV_GATE = 1e-2  # post-polish ||I - B X||_max acceptance bound
 
 
-@jax.jit
-def _inv_polish(B):
-    """Batched inverse + two Newton polish steps + NaN-proof quality scalar
-    (module-level jit: compiled once per (shape, dtype), not per PC
-    setup)."""
-    eye = jnp.eye(B.shape[-1], dtype=B.dtype)
-    X = jnp.linalg.inv(B)
-    # two Newton polish steps X ← X + X(I − BX): each squares the LU
-    # roundoff residual (an fp32 LU of a cond~1e6 block starts near ~1e-1;
-    # the second step puts q well inside the gate); 2 batched MXU matmuls
-    # per step
+def _polish_and_gate(B, X, eye):
+    # two Newton polish steps X ← X + X(I − BX): each squares the LU/seed
+    # roundoff residual; 2 batched MXU matmuls per step
     X = X + X @ (eye - B @ X)
     X = X + X @ (eye - B @ X)
     # NaN-proof gate: XLA's max-reduce DROPS NaNs (NaN comparisons are
@@ -866,6 +859,34 @@ def _inv_polish(B):
     q = jnp.where(jnp.all(jnp.isfinite(X)),
                   jnp.max(jnp.abs(eye - B @ X)), jnp.inf)
     return X, q
+
+
+@jax.jit
+def _inv_polish(B):
+    """Batched native-dtype inverse + Newton polish + NaN-proof quality
+    scalar (module-level jit: compiled once per (shape, dtype), not per
+    PC setup). Used for dtypes whose LU the backend implements natively
+    (fp32/c64 on TPU; everything on CPU)."""
+    eye = jnp.eye(B.shape[-1], dtype=B.dtype)
+    return _polish_and_gate(B, jnp.linalg.inv(B), eye)
+
+
+@jax.jit
+def _inv_polish_seeded(B):
+    """Batched inverse for f64/c128 on TPU, where XLA implements no
+    F64/C128 LuDecomposition: seed each inverse from an F32 (C64) LU and
+    Newton-polish in the full dtype — XLA:TPU emulates f64 dots at
+    near-f32 MXU throughput, and each polish step squares the ~1e-2 seed
+    residual toward the f64 rounding floor (same trick as
+    tridiag._bpcr_device_factor, where it measures ~1e-9 quality)."""
+    seed_dt = jnp.complex64 if jnp.iscomplexobj(B) else jnp.float32
+    eye = jnp.eye(B.shape[-1], dtype=B.dtype)
+    X = jnp.linalg.inv(B.astype(seed_dt)).astype(B.dtype)
+    # one extra polish pair vs the native path: the seed starts ~5 digits
+    # worse, and two more cheap matmul pairs buy the rest of the floor
+    X = X + X @ (eye - B @ X)
+    X = X + X @ (eye - B @ X)
+    return _polish_and_gate(B, X, eye)
 
 
 def _device_inverse_blocks(comm: DeviceComm, blocks: np.ndarray):
@@ -883,9 +904,12 @@ def _device_inverse_blocks(comm: DeviceComm, blocks: np.ndarray):
     failures) — callers then fall back to the pivot-quality host fp64
     path, which raises the proper error for genuinely singular blocks.
     """
+    wide = np.dtype(blocks.dtype) in (np.float64, np.complex128)
+    inv_fn = (_inv_polish_seeded
+              if wide and comm.platform == "tpu" else _inv_polish)
     try:
         B = comm.put_axis0(blocks)
-        X, q = _inv_polish(B)
+        X, q = inv_fn(B)
         q = float(q)   # sync: setup-time only, one scalar
     except Exception as e:  # noqa: BLE001
         import warnings
@@ -1163,12 +1187,18 @@ def _build_tridiag_cr(comm: DeviceComm, mat: Mat):
             comm.put_replicated(bfin.astype(dt)))
 
 
-def _build_dense_lu(comm: DeviceComm, mat: Mat):
+def _build_dense_lu(comm: DeviceComm, mat: Mat,
+                    setup_device: str = "auto", owner: "PC | None" = None):
     """Replicated dense inverse of the full operator (the MUMPS-slot path).
 
-    XLA:TPU has no f64 LuDecomposition, so the factorization runs on host
-    LAPACK in fp64; the device applies the (padded) inverse as one matmul.
-    Accuracy is recovered by iterative refinement in KSPPREONLY.
+    By default the factorization runs on host LAPACK in fp64 (XLA:TPU has
+    no f64 LuDecomposition) and the device applies the (padded) inverse
+    as one matmul; accuracy is recovered by iterative refinement in
+    KSPPREONLY. On TPU meshes ``-pc_setup_device`` (auto for real
+    fp32/fp64) inverts ON the chip instead — fp64 via the F32-LU-seeded
+    f64-Newton-polish program (:func:`_inv_polish_seeded`), turning an
+    O(n³) single-core host factorization into seconds of MXU work —
+    quality-gated with automatic host fallback.
     """
     import scipy.linalg
     _require_assembled(mat, "lu")
@@ -1182,10 +1212,91 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat):
             f"and b <= {_BCR_MAX_BW} (PARITY.md 'Direct solves'); "
             "otherwise use an iterative KSP with pc 'bjacobi'/'jacobi' "
             "instead (SURVEY.md §7.4)")
+    n_pad = comm.padded_size(n)
+    if (_want_device_setup(comm, mat.dtype, setup_device, f64_ok=True)
+            and getattr(mat, "ell_cols", None) is not None
+            and mat.ell_cols.shape[0] == n_pad):
+        import time
+        t0 = time.perf_counter()
+        try:
+            # densify FROM the device-resident ELL arrays: zero new bytes
+            # ship (a dense fp64 operator through the dev tunnel measured
+            # ~22 MB/s — slower than just factorizing on the host)
+            Ad = _densify_ell(mat.ell_cols, mat.ell_vals, n)
+        except Exception as e:  # noqa: BLE001
+            import warnings
+            warnings.warn(
+                f"device-side densification failed ({type(e).__name__}); "
+                "falling back to host LAPACK setup", RuntimeWarning,
+                stacklevel=2)
+            Ad = None
+        if Ad is not None:
+            t1 = time.perf_counter()
+            X = _device_inverse_dense(comm, Ad, n)
+            if X is not None:
+                if owner is not None:
+                    owner.setup_mode = "device"
+                    owner.setup_breakdown = {
+                        "extract_s": round(t1 - t0, 4),
+                        "invert_s": round(time.perf_counter() - t1, 4)}
+                return (X,)
+    if owner is not None:
+        owner.setup_mode = "host"
+        owner.setup_breakdown = None
     host_dt = host_dtype(mat.dtype)
     A = mat.to_scipy().toarray().astype(host_dt)
     inv = scipy.linalg.inv(A)
-    n_pad = comm.padded_size(n)
     inv_pad = np.zeros((n_pad, n_pad), dtype=host_dt)
     inv_pad[:n, :n] = inv
     return (comm.put_replicated(inv_pad.astype(mat.dtype)),)
+
+
+@jax.jit
+def _densify_ell(cols, vals, n):
+    """(n_pad, K) ELL → (n_pad, n_pad) dense with identity pad rows —
+    device-side densification for the dense-lu setup. ELL padding slots
+    carry value 0, so their scatter-adds are no-ops wherever they point."""
+    n_pad = cols.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(n_pad)[:, None], cols.shape)
+    X = jnp.zeros((n_pad, n_pad), vals.dtype).at[rows, cols].add(vals)
+    i = jnp.arange(n_pad)
+    return X.at[i, i].add(
+        jnp.where(i >= n, jnp.ones((), vals.dtype), jnp.zeros((), vals.dtype)))
+
+
+@jax.jit
+def _mask_pad(X, n):
+    """Zero the pad block of the inverse (host dense-lu convention: padded
+    slots must not feed back into real rows). ``n`` traced — one program
+    per shape/dtype."""
+    i = jnp.arange(X.shape[-1])
+    keep = i < n
+    return jnp.where(keep[:, None] & keep[None, :], X,
+                     jnp.zeros((), X.dtype))
+
+
+def _device_inverse_dense(comm: DeviceComm, Ad, n: int):
+    """Full dense inverse on the mesh devices (replicated, like the host
+    path's shipped inverse). ``Ad`` may be a host array (shipped) or an
+    already-on-device array (resharded in place — the `_densify_ell`
+    route). Same gating/fallback contract as
+    :func:`_device_inverse_blocks`."""
+    wide = np.dtype(Ad.dtype) in (np.float64, np.complex128)
+    inv_fn = (_inv_polish_seeded
+              if wide and comm.platform == "tpu" else _inv_polish)
+    try:
+        B = (comm.put_replicated(Ad) if isinstance(Ad, np.ndarray)
+             else jax.device_put(Ad, comm.replicated_sharding))
+        X, q = inv_fn(B)
+        q = float(q)   # sync: setup-time only, one scalar
+        X = _mask_pad(X, n)
+    except Exception as e:  # noqa: BLE001
+        import warnings
+        warnings.warn(
+            f"device-side dense inversion failed ({type(e).__name__}); "
+            "falling back to host LAPACK setup", RuntimeWarning,
+            stacklevel=2)
+        return None
+    if not np.isfinite(q) or q > _DEVICE_INV_GATE:
+        return None
+    return X

@@ -502,8 +502,16 @@ def _bpcr_device_factor(comm, dt, N: int, b: int, vals, idx):
         return jnp.where(ok.reshape((N,) + (1,) * (M.ndim - 1)),
                          rolled, fill)
 
+    # f32 seeding is a TPU workaround (no F64 LuDecomposition there);
+    # backends with a native f64/c128 LU use it directly — better factors
+    # for free. mesh is in the program-cache key, so this can't go stale.
+    seed_low = comm.platform == "tpu" and cdt != ldt
+
     def binv_polished(B):
-        X = jnp.linalg.inv(B.astype(ldt)).astype(cdt)
+        if seed_low:
+            X = jnp.linalg.inv(B.astype(ldt)).astype(cdt)
+        else:
+            X = jnp.linalg.inv(B)
         X = X + X @ (eye - B @ X)
         X = X + X @ (eye - B @ X)
         return X

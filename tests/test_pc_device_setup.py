@@ -113,6 +113,62 @@ class TestEndToEnd:
                                    rtol=1e-12, atol=1e-12)
 
 
+class TestDenseLU:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_dense_lu_device_matches_host(self, comm8, dtype):
+        """The MUMPS-slot dense path: device-built padded inverse equals
+        the host LAPACK one (including the zeroed pad block)."""
+        A = sp.csr_matrix(convdiff2d(7), dtype=dtype)     # n=49, pads to 56
+        M = tps.Mat.from_scipy(comm8, A, dtype=dtype)
+        invs = {}
+        for sd in ("0", "1"):
+            p = tps.PC(comm8)
+            p.set_type("lu")
+            p.setup_device = sd
+            p.set_up(M)
+            assert p._factor_mode == "dense"
+            invs[sd] = np.asarray(p._arrays[0])
+        assert invs["1"].shape == invs["0"].shape
+        n = A.shape[0]
+        # pad block must be exactly zero (host convention)
+        assert not invs["1"][n:, :].any() and not invs["1"][:, n:].any()
+        tol = 2e-5 if dtype == np.float32 else 1e-10
+        np.testing.assert_allclose(invs["1"], invs["0"], rtol=tol, atol=tol)
+
+    def test_preonly_solve_through_device_dense_lu(self, comm8):
+        A = sp.csr_matrix(convdiff2d(7), dtype=np.float64)
+        rng = np.random.default_rng(3)
+        x_true = rng.random(A.shape[0])
+        b = A @ x_true
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("preonly")
+        pc = ksp.get_pc()
+        pc.set_type("lu")
+        pc.setup_device = "1"
+        ksp.set_up()
+        assert pc.setup_mode == "device"
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)
+        rr = np.linalg.norm(b - A @ x.to_numpy()) / np.linalg.norm(b)
+        assert rr <= 1e-12, rr
+
+
+class TestSeededPolish:
+    def test_seeded_matches_native_to_f64_floor(self, comm8):
+        """The F32-LU-seeded f64 polish reaches the same quality band as
+        a native f64 LU for moderately conditioned blocks."""
+        rng = np.random.default_rng(0)
+        B = rng.random((8, 32, 32)) + 4 * np.eye(32)
+        Xn, qn = pcmod._inv_polish(B)
+        Xs, qs = pcmod._inv_polish_seeded(B)
+        assert float(qn) < 1e-12 and float(qs) < 1e-11
+        np.testing.assert_allclose(np.asarray(Xs), np.asarray(Xn),
+                                   rtol=1e-9, atol=1e-9)
+
+
 class TestGateFallback:
     def test_gate_failure_reuses_extracted_stack(self, comm8, monkeypatch):
         """A rejected device inversion falls back to host LAPACK over the
