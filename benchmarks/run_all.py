@@ -184,9 +184,9 @@ _REQUIRED_FIELDS = {
         "wall_s", "onchip_per_iter_us", "fixed_latency_ms", "floor_s",
         "unaccounted_s", "safeguard_reentries", "residual_parity"),
     "cfg4_bcgs_bjacobi_convdiff": (
-        "wall_s", "assembly_s", "pc_setup_s", "onchip_per_iter_us",
-        "fixed_latency_ms", "floor_s", "unaccounted_s",
-        "safeguard_reentries", "residual_parity"),
+        "wall_s", "assembly_s", "pc_setup_s", "pc_setup_mode",
+        "onchip_per_iter_us", "fixed_latency_ms", "floor_s",
+        "unaccounted_s", "safeguard_reentries", "residual_parity"),
     "cfg5_poisson3d_sharded_stencil": (
         "wall_s", "mg_solve_s", "mg_verify_s", "onchip_per_iter_ms",
         "residual_parity"),
@@ -289,17 +289,30 @@ def config2(comm, quick):
     # (utils/phases.py) so the artifact reconciles the wall to named parts
     # (round-5 VERDICT item 3)
     import tempfile
-    walls, phase_runs, ok = [], [], True
-    for _ in range(1 if quick else 3):
+    walls, phase_runs, failed = [], [], 0
+    want = 1 if quick else 3
+    # a fresh subprocess can die on transient tunnel saturation — that is
+    # an environment fault, not a solver wall: retry (bounded), count the
+    # failures in the artifact, and never let a failed run's (short) wall
+    # into the median
+    for _ in range(2 * want):
+        if len(walls) >= want:
+            break
         with tempfile.NamedTemporaryFile(suffix=".json") as tf:
             env["TPU_SOLVE_PHASE_LOG"] = tf.name
             spawn = time.time()
             t0 = time.perf_counter()
-            r = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                               timeout=900, cwd=REPO)
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   env=env, timeout=900, cwd=REPO)
+            except subprocess.TimeoutExpired:
+                failed += 1     # a hang is the same environment fault as
+                continue        # a crash — retry, don't abort the config
             wall_i = time.perf_counter() - t0
+            if r.returncode != 0 or "Eigenvalue:" not in r.stdout:
+                failed += 1
+                continue
             walls.append(wall_i)
-            ok = ok and r.returncode == 0 and "Eigenvalue:" in r.stdout
             try:
                 # keep the FIRST occurrence of each stamp: the 4 virtual
                 # ranks re-stamp collective points, and only the first
@@ -310,9 +323,15 @@ def config2(comm, quick):
             except Exception:  # noqa: BLE001 — phases are best-effort
                 stamps = {}
             phase_runs.append(_cfg2_phases(spawn, wall_i, stamps))
-    order = sorted(range(len(walls)), key=walls.__getitem__)
-    mid = order[len(walls) // 2]
-    wall, phases = walls[mid], phase_runs[mid]
+    ok = len(walls) >= want
+    if walls:
+        order = sorted(range(len(walls)), key=walls.__getitem__)
+        mid = order[len(walls) // 2]
+        wall, phases = walls[mid], phase_runs[mid]
+    else:
+        # every attempt failed: null fields (NOT NaN — json.dump would
+        # emit a literal NaN token and break strict parsers downstream)
+        wall, phases = None, {}
 
     # warm-process flow: the same tridiagonal HEP solve (largest magnitude,
     # nev=1 — reference test2.py defaults), timed on its second run
@@ -335,9 +354,11 @@ def config2(comm, quick):
     lam_np = lam_np[np.argmax(np.abs(lam_np))]
     eig_err = abs(lam - lam_np) / abs(lam_np)
     return dict(config="cfg2_multirank_scatter_eigensolve_n4", n=100,
-                wall_s=round(wall, 4),
-                wall_spread_s=[round(min(walls), 4), round(max(walls), 4)],
+                wall_s=None if wall is None else round(wall, 4),
+                wall_spread_s=([round(min(walls), 4), round(max(walls), 4)]
+                               if walls else []),
                 phases_s=phases,
+                subprocess_failures=failed,
                 warm_s=round(warm, 4),
                 eigenvalue_rel_err=float(eig_err),
                 residual_parity=bool(ok and eig_err <= 1e-8),

@@ -40,6 +40,7 @@ fuses into the same XLA program as the Krylov iteration.
 from __future__ import annotations
 
 import itertools
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -771,22 +772,35 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
             f"PC 'bjacobi' blocks are dense ({lsize // nb}x{lsize // nb}); "
             "too large — raise -pc_bjacobi_blocks, use more devices, or pc "
             "'jacobi'/'gamg' (SURVEY.md §7.4)")
-    A = mat.to_scipy().tocsr()
     bs = lsize // nb
     dense = None
     if _want_device_setup(comm, mat.dtype, setup_device, f64_ok=True):
         import time
         t0 = time.perf_counter()
-        dense = _dense_diag_blocks(A, n, bs, comm.size * nb,
-                                   np.dtype(mat.dtype))
+        blocks = None
+        if (getattr(mat, "ell_cols", None) is not None
+                and mat.ell_cols.shape[0] == bs * comm.size * nb):
+            # extract the diagonal blocks FROM the device-resident ELL —
+            # zero new bytes ship (the dense stack is ~0.5 GB at cfg4
+            # scale, for data the device already holds); note no
+            # to_scipy() either, which would host-fetch the whole ELL
+            try:
+                blocks = _ell_diag_blocks(mat.ell_cols, mat.ell_vals, bs, n)
+            except Exception:  # noqa: BLE001 — host extraction still works
+                blocks = None
+        if blocks is None:
+            blocks = _dense_diag_blocks(mat.to_scipy().tocsr(), n, bs,
+                                        comm.size * nb,
+                                        np.dtype(mat.dtype))
+            dense = blocks
         t1 = time.perf_counter()
-        shipped = _device_inverse_blocks(comm, dense)
+        shipped = _device_inverse_blocks(comm, blocks)
         if shipped is not None:
             if owner is not None:
                 owner.setup_mode = "device"   # observability (view/bench)
-                # extract = host dense-block assembly; invert = ship +
-                # program load (the dev tunnel's per-process tax) + the
-                # batched MXU inversion itself
+                # extract = block assembly (on device via _ell_diag_blocks,
+                # or host+ship); invert = program load (the dev tunnel's
+                # per-process tax) + the batched MXU inversion itself
                 owner.setup_breakdown = {
                     "extract_s": round(t1 - t0, 4),
                     "invert_s": round(time.perf_counter() - t1, 4)}
@@ -803,7 +817,7 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
                         for blk in dense])
     else:
         inv = _per_device_inverse(
-            A, n, bs, comm.size * nb,
+            mat.to_scipy().tocsr(), n, bs, comm.size * nb,
             lambda B: scipy.linalg.inv(B.toarray().astype(host_dt)),
             host_dt=host_dt)
     return _ship_blocks(comm, inv, mat.dtype)
@@ -904,19 +918,33 @@ def _device_inverse_blocks(comm: DeviceComm, blocks: np.ndarray):
     failures) — callers then fall back to the pivot-quality host fp64
     path, which raises the proper error for genuinely singular blocks.
     """
-    wide = np.dtype(blocks.dtype) in (np.float64, np.complex128)
-    inv_fn = (_inv_polish_seeded
-              if wide and comm.platform == "tpu" else _inv_polish)
+    return _run_device_inverse(
+        comm, lambda: (comm.put_axis0(blocks)
+                       if isinstance(blocks, np.ndarray)
+                       else jax.device_put(blocks, comm.row_sharding)),
+        "block")
+
+
+def _run_device_inverse(comm: DeviceComm, place, what: str):
+    """Shared device-inversion driver: place the operand (``place`` is a
+    thunk so placement failures fall back too), pick the native vs
+    F32-seeded program (:func:`_inv_polish` / :func:`_inv_polish_seeded`),
+    run, and apply the NaN-proof quality gate. Returns the inverse or
+    ``None`` (callers fall back to host LAPACK). One place to change the
+    gate/selection rule for BOTH the bjacobi and dense-lu paths."""
     try:
-        B = comm.put_axis0(blocks)
+        B = place()
+        wide = np.dtype(B.dtype) in (np.float64, np.complex128)
+        inv_fn = (_inv_polish_seeded
+                  if wide and comm.platform == "tpu" else _inv_polish)
         X, q = inv_fn(B)
         q = float(q)   # sync: setup-time only, one scalar
     except Exception as e:  # noqa: BLE001
         import warnings
         warnings.warn(
-            f"device-side block inversion failed ({type(e).__name__}); "
+            f"device-side {what} inversion failed ({type(e).__name__}); "
             "falling back to host LAPACK setup", RuntimeWarning,
-            stacklevel=2)
+            stacklevel=3)
         return None
     if not np.isfinite(q) or q > _DEVICE_INV_GATE:
         return None
@@ -1264,6 +1292,33 @@ def _densify_ell(cols, vals, n):
         jnp.where(i >= n, jnp.ones((), vals.dtype), jnp.zeros((), vals.dtype)))
 
 
+@partial(jax.jit, static_argnums=(2,))
+def _ell_diag_blocks(cols, vals, bs, n):
+    """(n_pad, K) ELL → (n_pad/bs, bs, bs) dense diagonal-block stack, on
+    device — the bjacobi analog of :func:`_densify_ell` (the host path
+    extracts the same blocks from CSR and ships them; at cfg4 scale that
+    is ~0.5 GB through the dev tunnel for data the device already holds).
+    Off-block entries mask to a scatter dump row; padding/out-of-range
+    rows get identity diagonals (pass-through, as everywhere else)."""
+    n_pad = cols.shape[0]
+    M = n_pad // bs
+    r = jnp.broadcast_to(jnp.arange(n_pad)[:, None], cols.shape)
+    blk = r // bs
+    cc = cols - blk * bs
+    inside = (cc >= 0) & (cc < bs) & (r < n)
+    # masked entries scatter into an extra dump block (index M)
+    blk_s = jnp.where(inside, blk, M)
+    rr = r % bs
+    cc_s = jnp.where(inside, cc, 0)
+    X = jnp.zeros((M + 1, bs, bs), vals.dtype).at[blk_s, rr, cc_s].add(
+        jnp.where(inside, vals, jnp.zeros((), vals.dtype)))[:M]
+    # identity diagonal for padding rows (r >= n)
+    i = jnp.arange(n_pad)
+    pad = jnp.where(i >= n, jnp.ones((), vals.dtype),
+                    jnp.zeros((), vals.dtype))
+    return X.at[i // bs, i % bs, i % bs].add(pad)
+
+
 @jax.jit
 def _mask_pad(X, n):
     """Zero the pad block of the inverse (host dense-lu convention: padded
@@ -1281,22 +1336,9 @@ def _device_inverse_dense(comm: DeviceComm, Ad, n: int):
     already-on-device array (resharded in place — the `_densify_ell`
     route). Same gating/fallback contract as
     :func:`_device_inverse_blocks`."""
-    wide = np.dtype(Ad.dtype) in (np.float64, np.complex128)
-    inv_fn = (_inv_polish_seeded
-              if wide and comm.platform == "tpu" else _inv_polish)
-    try:
-        B = (comm.put_replicated(Ad) if isinstance(Ad, np.ndarray)
-             else jax.device_put(Ad, comm.replicated_sharding))
-        X, q = inv_fn(B)
-        q = float(q)   # sync: setup-time only, one scalar
-        X = _mask_pad(X, n)
-    except Exception as e:  # noqa: BLE001
-        import warnings
-        warnings.warn(
-            f"device-side dense inversion failed ({type(e).__name__}); "
-            "falling back to host LAPACK setup", RuntimeWarning,
-            stacklevel=2)
-        return None
-    if not np.isfinite(q) or q > _DEVICE_INV_GATE:
-        return None
-    return X
+    X = _run_device_inverse(
+        comm, lambda: (comm.put_replicated(Ad)
+                       if isinstance(Ad, np.ndarray)
+                       else jax.device_put(Ad, comm.replicated_sharding)),
+        "dense")
+    return None if X is None else _mask_pad(X, n)

@@ -48,6 +48,18 @@ class TestDeviceInverseBlocks:
         tol = 2e-5 if dtype == np.float32 else 1e-12
         np.testing.assert_allclose(idv, ih, rtol=tol, atol=tol)
 
+    def test_ell_diag_blocks_matches_host_extraction(self, comm8):
+        """Device ELL block extraction == host CSR block extraction
+        (including off-block masking and identity padding)."""
+        A = convdiff2d(16)
+        M = tps.Mat.from_scipy(comm8, A)
+        n = A.shape[0]
+        bs = M.ell_cols.shape[0] // 8
+        dev = np.asarray(pcmod._ell_diag_blocks(M.ell_cols, M.ell_vals,
+                                                bs, n))
+        host = pcmod._dense_diag_blocks(A.tocsr(), n, bs, 8, np.float64)
+        np.testing.assert_allclose(dev, host, rtol=0, atol=0)
+
     def test_identity_padding_rows(self, comm8):
         # n=60 over 8 devices -> lsize 8, last device half padding: the
         # padded slots must invert to identity exactly (pass-through)
@@ -171,14 +183,33 @@ class TestSeededPolish:
 
 class TestGateFallback:
     def test_gate_failure_reuses_extracted_stack(self, comm8, monkeypatch):
-        """A rejected device inversion falls back to host LAPACK over the
-        already-extracted dense stack — same numbers as the pure host
-        path, setup_mode 'host'."""
+        """A rejected device inversion after HOST block extraction falls
+        back to LAPACK over the already-extracted dense stack — same
+        numbers as the pure host path, setup_mode 'host'. (ELL extraction
+        is disabled so the host-extract + dense-reuse branch is the one
+        under test.)"""
+        monkeypatch.setattr(pcmod, "_device_inverse_blocks",
+                            lambda comm, blocks: None)
+
+        def boom(*a, **k):
+            raise RuntimeError("forced: no device extraction")
+
+        monkeypatch.setattr(pcmod, "_ell_diag_blocks", boom)
+        A = convdiff2d(16)
+        ph = _built_bjacobi(comm8, A, np.float64, "0")
+        pf = _built_bjacobi(comm8, A, np.float64, "1")   # forced, rejected
+        assert pf.setup_mode == "host"
+        np.testing.assert_allclose(_blocks_of(pf), _blocks_of(ph),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_gate_failure_after_ell_extraction(self, comm8, monkeypatch):
+        """Same rejection with the ELL extraction route: falls back to the
+        host CSR path and still matches."""
         monkeypatch.setattr(pcmod, "_device_inverse_blocks",
                             lambda comm, blocks: None)
         A = convdiff2d(16)
         ph = _built_bjacobi(comm8, A, np.float64, "0")
-        pf = _built_bjacobi(comm8, A, np.float64, "1")   # forced, rejected
+        pf = _built_bjacobi(comm8, A, np.float64, "1")
         assert pf.setup_mode == "host"
         np.testing.assert_allclose(_blocks_of(pf), _blocks_of(ph),
                                    rtol=1e-12, atol=1e-12)
