@@ -67,7 +67,7 @@ DEFAULT_TOL = 1e-8        # SLEPc's EPS default
 DEFAULT_MAX_RESTARTS = 100
 
 EPS_TYPES = ("lapack", "krylovschur", "arnoldi", "lanczos", "power", "subspace",
-             "lobpcg")
+             "lobpcg", "gd")
 
 
 class EPSProblemType:
@@ -93,6 +93,7 @@ class EPSType:
     SUBSPACE = "subspace"
     LOBPCG = "lobpcg"
     LAPACK = "lapack"
+    GD = "gd"
 
 
 _PROGRAM_CACHE: dict = {}
@@ -1008,6 +1009,8 @@ class EPS:
             self._solve_subspace()
         elif self._type == "lobpcg":
             self._solve_lobpcg()
+        elif self._type == "gd":
+            self._solve_gd()
         elif self._type == "arnoldi":
             self._solve_arnoldi_explicit()
         else:  # krylovschur / lanczos
@@ -1630,6 +1633,144 @@ class EPS:
         nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
         nrm[nrm == 0] = 1.0
         self._store(theta[take], vecs / nrm, rel[take], nconv, it)
+
+    # ---- gd (block generalized Davidson — SLEPc's EPSGD) ---------------------
+    def _solve_gd(self):
+        """Block generalized Davidson (EPSGD analog), Hermitian problems.
+
+        Outer iteration: Rayleigh-Ritz over the growing subspace V, then
+        expand V with the Jacobi-preconditioned residuals of the ``m``
+        current Ritz pairs (SLEPc's default STPRECOND diagonal
+        preconditioner [external, behind ``-eps_type gd`` through
+        petsc_funcs.py:17]), restarting to the best Ritz vectors when the
+        basis reaches ``ncv``. Block operator applications run on the mesh
+        (the 'subspace'/'lobpcg' block-mult program — one device call per
+        outer iteration); the k×k projected problem is host LAPACK.
+        Rank-deficient expansion rows are reseeded (the round-4 ADVICE
+        discipline) so a degenerated block cannot stall.
+
+        Extreme ``which`` only, like EPSLOBPCG; no spectral transform
+        (use krylovschur + ST 'sinvert' for interior pairs).
+        """
+        import scipy.linalg
+        if self._problem_type != EPSProblemType.HEP:
+            raise ValueError("EPS 'gd' supports problem type 'hep' — use "
+                             "lobpcg for GHEP, krylovschur for NHEP")
+        if self._which not in (EPSWhich.SMALLEST_REAL, EPSWhich.LARGEST_REAL):
+            raise ValueError(
+                "EPS 'gd' computes extreme eigenvalues — set "
+                "which='smallest_real' or 'largest_real' (got "
+                f"{self._which!r}); krylovschur supports all selections")
+        if not self.st.is_identity():
+            raise ValueError("EPS 'gd' supports no spectral transform — "
+                             "use krylovschur with ST 'sinvert'")
+        comm = self._mat.comm
+        op = self._mat
+        n = op.shape[0]
+        _GD_BS_CAP = 16
+        m = min(max(self.nev, 1), _GD_BS_CAP, n)
+        if self.nev > _GD_BS_CAP:
+            raise ValueError(
+                f"EPS 'gd' caps the block size at {_GD_BS_CAP} — use "
+                "krylovschur for more pairs")
+        dtype = np.dtype(str(op.dtype))
+        hdt = host_dtype(dtype)
+        npad = comm.padded_size(n)
+        # the restart bound honors a user ncv exactly (docstring contract);
+        # m+1 is the minimum that still leaves room for one new direction
+        mmax = min(n, max(self._effective_ncv(n), m + 1))
+        sign = -1.0 if self._which == EPSWhich.LARGEST_REAL else 1.0
+
+        prog = _build_block_mult_program(comm, op, m)
+        op_arrays = op.device_arrays()
+
+        def A_apply(Mh):
+            """(t, n) host block, t <= m -> A @ rows; the device program is
+            built for m rows, so short blocks pad with zero rows."""
+            t = Mh.shape[0]
+            Mp = np.zeros((m, npad), dtype=dtype)
+            Mp[:t, :n] = Mh
+            out = comm.host_fetch(
+                prog(op_arrays, comm.put_spec(Mp, P(None, comm.axis))))
+            record_sync("EPS gd fetch/block-mult")
+            return out[:t, :n].astype(hdt)
+
+        rng = np.random.default_rng(20240901)
+        X0, _ = _lobpcg_seed(op, n, m, dtype)
+        try:
+            diag = np.asarray(op.diagonal(), dtype=hdt)
+        except (ValueError, AttributeError):
+            diag = np.zeros(n, dtype=hdt)
+        V = X0.astype(hdt)                 # (k, n) orthonormal rows
+        W = A_apply(V)                     # A V, maintained incrementally
+        theta = np.zeros(m)
+        rel = np.full(m, np.inf)
+        X = V[:m]
+        nconv, it = 0, 0
+        for it in range(1, self.max_it + 1):
+            H = np.conj(V) @ W.T           # V^H A V (rows are vectors)
+            H = (H + H.conj().T) / 2.0
+            mu, S = scipy.linalg.eigh(sign * H)
+            # first m of eigh(sign·H) ascending = the m most-wanted pairs
+            # in the wanted direction for either sign
+            theta = np.real(sign * mu[:m])
+            S = S[:, :m]
+            X = S.T @ V                    # Ritz vectors (m, n)
+            AX = S.T @ W
+            R = AX - theta[:, None] * X
+            rnorm = np.linalg.norm(R, axis=1)
+            # relative residual with the siblings' tiny-eigenvalue floor
+            # (max(|theta|, 1) would quietly turn it absolute for
+            # |lambda| < 1)
+            rel = rnorm / np.maximum(np.abs(theta), 1e-300)
+            # contiguous count: slepc4py semantics — the FIRST nconv
+            # stored pairs are the converged ones
+            nconv = 0
+            while nconv < min(self.nev, m) and rel[nconv] <= self.tol:
+                nconv += 1
+            if nconv >= min(self.nev, m) or it == self.max_it:
+                break                      # no discarded final expansion
+            if V.shape[0] + 1 > mmax:
+                # thick restart: keep the current Ritz block (already
+                # orthonormal — S has orthonormal columns)
+                V, W = X.copy(), AX.copy()
+            # expansion: up to m preconditioned residuals, bounded by the
+            # ncv window AND the space dimension (a basis cannot exceed n
+            # orthonormal rows)
+            t_rows = min(m, mmax - V.shape[0], n - V.shape[0])
+            if t_rows <= 0:
+                break                      # basis spans the whole space
+            # Davidson's diagonal correction t_i = (D − θ_i I)⁻¹ r_i —
+            # dramatically better than plain D⁻¹ for extreme pairs (the
+            # correction SLEPc's GD applies through its shifted STPRECOND
+            # [external]); near-zero denominators clamp to a floor so a
+            # Ritz value sitting ON a diagonal entry cannot blow up
+            denom = diag[None, :] - theta[:, None]
+            floor = 1e-3 * np.maximum(np.abs(theta[:, None]), 1.0)
+            denom = np.where(np.abs(denom) < floor,
+                             np.where(denom >= 0, floor, -floor), denom)
+            T = (R / denom)[:t_rows]
+            for _ in range(2):             # two-pass MGS vs V's rows
+                T = T - (T @ V.conj().T) @ V
+            good = np.linalg.norm(T, axis=1) > 1e-10
+            if not np.all(good):
+                # reseed degenerated rows instead of letting them vanish
+                reseed = rng.standard_normal((int(np.sum(~good)), n))
+                if is_complex(dtype):
+                    reseed = reseed + 1j * rng.standard_normal(reseed.shape)
+                T[~good] = reseed
+                for _ in range(2):
+                    T = T - (T @ V.conj().T) @ V
+            T = np.linalg.qr(T.T)[0].T.astype(hdt)
+            V = np.vstack([V, T])
+            W = np.vstack([W, A_apply(T)])
+        count = max(min(self.nev, m), 1)
+        # theta is already most-wanted-first by construction (mu ascending
+        # from eigh, sign applied) — no reorder needed
+        vecs = X[:count]
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        self._store(theta[:count], vecs / nrm, rel[:count], nconv, it)
 
     # ---- results (slepc4py-shaped, collective-safe) --------------------------
     def get_converged(self) -> int:

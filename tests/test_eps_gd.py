@@ -1,0 +1,156 @@
+"""EPS 'gd' (block generalized Davidson — SLEPc's EPSGD analog).
+
+Spectrum parity against ``numpy.linalg.eigh`` (the oracle the reference's
+smoke-test test2.py lacks, SURVEY.md §4), both extreme ends, real and
+complex Hermitian operators, plus the type's declared restrictions.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.solvers.eps import EPS
+
+from test_eps import reference_tridiag
+
+
+def poisson2d(nx):
+    T = sp.diags([-np.ones(nx - 1), 2 * np.ones(nx), -np.ones(nx - 1)],
+                 [-1, 0, 1])
+    I = sp.eye(nx)
+    return (sp.kron(I, T) + sp.kron(T, I)).tocsr()
+
+
+def _gd(comm, A, which, nev, tol=1e-7, max_it=500, ncv=None):
+    M = tps.Mat.from_scipy(comm, A,
+                           dtype=np.complex128 if np.iscomplexobj(A.toarray()
+                                                                  [:1, :1])
+                           else np.float64)
+    E = EPS().create(comm)
+    E.set_operators(M)
+    E.set_problem_type("hep")
+    E.set_type("gd")
+    E.set_which_eigenpairs(which)
+    E.set_dimensions(nev=nev, ncv=ncv)
+    E.set_tolerances(tol=tol, max_it=max_it)
+    E.solve()
+    return E
+
+
+class TestGDHermitian:
+    def test_largest_reference_family(self, comm8):
+        A = reference_tridiag(100)
+        lam = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "largest_real", nev=2)
+        assert E.get_converged() >= 2
+        got = np.array([E.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam[::-1][:2], rtol=1e-6)
+
+    def test_smallest_poisson(self, comm8):
+        A = poisson2d(12)
+        lam = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "smallest_real", nev=3)
+        assert E.get_converged() >= 3
+        got = np.sort([E.get_eigenvalue(i).real for i in range(3)])
+        np.testing.assert_allclose(got, lam[:3], rtol=1e-5)
+
+    def test_eigenvector_residual(self, comm):
+        A = reference_tridiag(64)
+        E = _gd(comm, A, "largest_real", nev=1)
+        assert E.get_converged() >= 1
+        lam = E.get_eigenvalue(0).real
+        x, _ = tps.Mat.from_scipy(comm, A).get_vecs()
+        vr, _ = tps.Mat.from_scipy(comm, A).get_vecs()
+        E.get_eigenpair(0, vr)
+        v = vr.to_numpy()
+        r = np.linalg.norm(A @ v - lam * v) / abs(lam)
+        assert r <= 1e-6, r
+
+    def test_complex_hermitian(self, comm8):
+        rng = np.random.default_rng(5)
+        B = rng.random((60, 60)) + 1j * rng.random((60, 60))
+        A = sp.csr_matrix((B + B.conj().T) / 2)
+        lam = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "largest_real", nev=2)
+        assert E.get_converged() >= 2
+        got = np.array([E.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam[::-1][:2], rtol=1e-6)
+
+    def test_restart_path(self, comm8):
+        """Small ncv forces thick restarts; convergence must survive."""
+        A = poisson2d(10)
+        lam = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "smallest_real", nev=2, ncv=6, max_it=800)
+        assert E.get_converged() >= 2
+        got = np.sort([E.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam[:2], rtol=1e-5)
+
+
+class TestGDEdges:
+    def test_block_larger_than_half_space(self, comm8):
+        """n < 2m: the basis caps at n orthonormal rows (Rayleigh-Ritz
+        over the full space = exact) instead of growing a bogus basis."""
+        A = reference_tridiag(24)
+        lam = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "largest_real", nev=12, max_it=200)
+        assert E.get_converged() >= 12
+        got = np.array([E.get_eigenvalue(i).real for i in range(12)])
+        np.testing.assert_allclose(got, lam[::-1][:12], rtol=1e-6)
+
+    def test_small_eigenvalue_relative_residual(self, comm8):
+        """|lambda| << 1 must still converge on the RELATIVE residual
+        (a max(|theta|, 1) denominator would quietly go absolute)."""
+        A = (poisson2d(10) * 1e-3).tocsr()     # lambda_min ~ 1.6e-4
+        lam_exact = np.linalg.eigvalsh(A.toarray())
+        E = _gd(comm8, A, "smallest_real", nev=1, tol=1e-8)
+        assert E.get_converged() >= 1
+        lam = E.get_eigenvalue(0).real
+        np.testing.assert_allclose(lam, lam_exact[0], rtol=1e-6)
+        # the stored residual is relative to |lambda|, and tight
+        assert E._residuals[0] <= 1e-8
+
+
+class TestGDRestrictions:
+    def test_rejects_non_extreme_which(self, comm8):
+        A = reference_tridiag(30)
+        with pytest.raises(ValueError, match="extreme"):
+            _gd(comm8, A, "largest_magnitude", nev=1)
+
+    def test_rejects_nhep(self, comm8):
+        A = reference_tridiag(30)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("nhep")
+        E.set_type("gd")
+        E.set_which_eigenpairs("largest_real")
+        with pytest.raises(ValueError, match="hep"):
+            E.solve()
+
+    def test_rejects_sinvert(self, comm8):
+        A = reference_tridiag(30)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("gd")
+        E.set_which_eigenpairs("smallest_real")
+        E.st.set_type("sinvert")
+        with pytest.raises(ValueError, match="spectral transform"):
+            E.solve()
+
+    def test_facade_type_constant(self):
+        import sys
+        sys.path.insert(0, "compat")
+        try:
+            from slepc4py import SLEPc
+            assert SLEPc.EPS.Type.GD == "gd"
+        finally:
+            sys.path.remove("compat")
+
+    def test_option_selects_gd(self, comm8):
+        tps.global_options().set("eps_type", "gd")
+        E = EPS().create(comm8)
+        E.set_from_options()
+        assert E.get_type() == "gd"
