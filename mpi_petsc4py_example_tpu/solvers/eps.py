@@ -287,6 +287,11 @@ def _bt_dev(lam, sigma, st_type: str):
     if st_type == "sinvert":
         safe = jnp.where(lam == 0, 1.0, lam)
         return jnp.where(lam == 0, jnp.inf, sigma + 1.0 / safe)
+    if st_type != "shift":
+        # cayley (two runtime parameters) runs the HOST loops — a fused
+        # path reaching here is a gating bug; fail at trace time instead
+        # of silently applying the wrong transform
+        raise ValueError(f"_bt_dev: unhandled ST type {st_type!r}")
     return lam + sigma                     # 'shift' (identity at 0)
 
 
@@ -996,8 +1001,9 @@ class EPS:
                              "'ghep' (B must be SPD)")
         if self._problem_type == EPSProblemType.GHEP and self._bmat is None:
             raise ValueError("problem type 'ghep' needs operators (A, B)")
-        # SLEPc convention: a target with sinvert supplies the shift.
-        if (self._target is not None and self.st.get_type() == "sinvert"
+        # SLEPc convention: a target with sinvert/cayley supplies the shift.
+        if (self._target is not None
+                and self.st.get_type() in ("sinvert", "cayley")
                 and self.st.sigma == 0.0):
             self.st.set_shift(self._target)
         t0 = time.perf_counter()
@@ -1071,6 +1077,17 @@ class EPS:
             # explicitly — otherwise '-eps_type lapack -st_type sinvert'
             # would silently return globally-extremal pairs instead
             order = np.argsort(np.abs(lam - self.st.sigma), kind="stable")
+        elif self.st.get_type() == "cayley":
+            # cayley's magnification is |theta| = |lam+nu|/|lam-sigma| —
+            # NOT plain distance to sigma (a pair at lam = -nu has theta=0:
+            # the LEAST magnified of the whole spectrum); order by the
+            # actual transformed magnitude, descending
+            nu = self.st.get_antishift()
+            dist = np.abs(lam - self.st.sigma)
+            theta_mag = np.where(dist == 0, np.inf,
+                                 np.abs(lam + nu) / np.where(dist == 0, 1.0,
+                                                             dist))
+            order = np.argsort(-theta_mag, kind="stable")
         else:
             order = self._select(lam)
         count = min(self.nev, n)
@@ -1098,7 +1115,8 @@ class EPS:
         # (A, B, st) would repeat that and re-ship the replicated inverse.
         key = (self._mat, getattr(self._mat, "_state", 0), self._bmat,
                getattr(self._bmat, "_state", 0), self.st.get_type(),
-               self.st.sigma)
+               self.st.sigma, self.st.get_antishift()
+               if self.st.get_type() == "cayley" else None)
         cached = getattr(self, "_op_cache", None)
         if cached is not None and cached[0] == key:
             return comm, cached[1], cached[2], hermitian
@@ -1198,7 +1216,10 @@ class EPS:
         # from the compile cache than the two small host-loop programs, so
         # tiny problems — where the per-restart H fetch it eliminates is
         # cheap — default to the host loop (override: TPU_SOLVE_EPS_FUSED).
-        want_fused = _want_fused(comm, n)
+        # cayley back-transforms with TWO runtime parameters (sigma, nu);
+        # the fused program's static _bt_dev carries only sigma, so cayley
+        # runs the host loop (generic st.back_transform)
+        want_fused = _want_fused(comm, n) and self.st.get_type() != "cayley"
         if (want_fused and hermitian and ncv < n and k_keep >= 1
                 and self._which in (
                     EPSWhich.LARGEST_MAGNITUDE, EPSWhich.SMALLEST_MAGNITUDE,

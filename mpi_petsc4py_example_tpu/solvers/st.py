@@ -8,6 +8,14 @@ Types:
 * ``shift``   — operate on ``A - sigma*I``    (theta = lambda - sigma).
 * ``sinvert`` — operate on ``(A - sigma*I)^-1`` (theta = 1/(lambda - sigma));
   shift-and-invert, the standard route to eigenvalues nearest a target.
+* ``cayley``  — operate on ``(A - sigma*B)^-1 (A + nu*B)``
+  (theta = (lambda + nu)/(lambda - sigma)); SLEPc's STCAYLEY, the
+  generalized Cayley transform (antishift ``nu`` defaults to sigma,
+  ``-st_cayley_antishift`` overrides). Same factorization cost as
+  sinvert, same nearest-to-sigma magnification, but the transform maps
+  the real line onto a bounded set away from sigma — the classical
+  choice for interior Hermitian problems where sinvert's unbounded tail
+  hurts the outer iteration.
 
 With a generalized problem ``A x = lambda B x`` (B SPD) the transformed
 operators become ``B^-1 (A - sigma*B)`` and ``(A - sigma*B)^-1 B``; both are
@@ -29,7 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-ST_TYPES = ("shift", "sinvert")
+ST_TYPES = ("shift", "sinvert", "cayley")
 
 _DENSE_CAP = 16384  # same host-factorization bound as solvers/pc.py
 
@@ -37,6 +45,7 @@ _DENSE_CAP = 16384  # same host-factorization bound as solvers/pc.py
 class STType:
     SHIFT = "shift"
     SINVERT = "sinvert"
+    CAYLEY = "cayley"
 
 
 class ST:
@@ -47,6 +56,7 @@ class ST:
     def __init__(self):
         self._type = "shift"
         self.sigma = 0.0
+        self.nu = None      # cayley antishift (None -> sigma, SLEPc default)
 
     def set_type(self, st_type: str):
         st_type = str(st_type).lower()
@@ -74,6 +84,18 @@ class ST:
 
     getShift = get_shift
 
+    def set_antishift(self, nu: float):
+        """Cayley antishift ``nu`` (STCayleySetAntishift)."""
+        self.nu = float(nu)
+        return self
+
+    setCayleyAntishift = set_antishift
+
+    def get_antishift(self) -> float:
+        return self.sigma if self.nu is None else self.nu
+
+    getCayleyAntishift = get_antishift
+
     def set_from_options(self):
         from ..utils.options import global_options
         opt = global_options()
@@ -81,6 +103,9 @@ class ST:
         if st_type:
             self.set_type(st_type)
         self.sigma = opt.get_real("st_shift", self.sigma)
+        nu = opt.get_real("st_cayley_antishift", None)
+        if nu is not None:
+            self.nu = float(nu)
         return self
 
     setFromOptions = set_from_options
@@ -91,6 +116,13 @@ class ST:
         theta = np.asarray(theta)
         if self._type == "shift":
             return theta + self.sigma
+        if self._type == "cayley":
+            # theta = (lambda + nu)/(lambda - sigma)
+            #   -> lambda = (sigma*theta + nu)/(theta - 1)
+            nu = self.get_antishift()
+            safe = np.where(theta == 1, 2.0, theta)
+            lam = (self.sigma * safe + nu) / (safe - 1.0)
+            return np.where(theta == 1, np.inf, lam)
         # sinvert: theta = 1/(lambda - sigma)
         safe = np.where(theta == 0, 1.0, theta)
         lam = self.sigma + 1.0 / safe
@@ -110,8 +142,9 @@ class ST:
         """
         if B is None and self.is_identity():
             return A, None
-        return STOperator(A, B, self._type, self.sigma), (B if B is not None
-                                                          else None)
+        return STOperator(A, B, self._type, self.sigma,
+                          nu=self.get_antishift()), (B if B is not None
+                                                     else None)
 
     def __repr__(self):
         return f"ST(type={self._type!r}, shift={self.sigma})"
@@ -151,20 +184,30 @@ class STOperator:
     nothing (the inverse is just a different array).
     """
 
-    def __init__(self, A, B, st_type: str, sigma: float):
-        if st_type == "sinvert" and not hasattr(A, "to_scipy"):
+    def __init__(self, A, B, st_type: str, sigma: float, nu: float = 0.0):
+        if st_type in ("sinvert", "cayley") and not hasattr(A, "to_scipy"):
             raise ValueError(
-                "ST 'sinvert' needs an assembled matrix (Mat) — "
+                f"ST {st_type!r} needs an assembled matrix (Mat) — "
                 "matrix-free operators expose no entries to factorize")
+        if st_type == "cayley" and nu == -sigma:
+            # (A-sB)^-1(A+nB) with n = -s is the IDENTITY: every theta is
+            # 1, nothing converges, and the O(n^3) factorization is wasted
+            # (SLEPc's STCAYLEY rejects sigma = nu = 0 the same way)
+            raise ValueError(
+                "ST 'cayley' with antishift nu == -sigma (including the "
+                "sigma=0 default with no target) is the identity "
+                "transform — set a target/shift, or a different "
+                "-st_cayley_antishift")
         self.A = A
         self.B = B
         self.st_type = st_type
         self.sigma = float(sigma)
+        self.nu = float(nu)
         self.shape = A.shape
         self.dtype = A.dtype
         self.comm = A.comm
         n = A.shape[0]
-        if st_type == "sinvert":
+        if st_type in ("sinvert", "cayley"):
             M = A.to_scipy()
             if B is not None:
                 M = M - sigma * B.to_scipy()
@@ -183,6 +226,8 @@ class STOperator:
                 self._binv = None
         self._sigma_arr = self.comm.put_replicated(
             np.asarray(sigma, dtype=self.dtype))
+        self._scale_arr = self.comm.put_replicated(
+            np.asarray(sigma + nu, dtype=self.dtype))
 
     # ---- linear-operator protocol ------------------------------------------
     def program_key(self):
@@ -191,6 +236,12 @@ class STOperator:
                 self.B.program_key() if self.B is not None else None)
 
     def device_arrays(self):
+        if self.st_type == "cayley":
+            # identity form T = I + (sigma+nu)(A-sigma B)^-1 B: only the
+            # inverse, B's arrays (standard: none) and one scalar — A's
+            # own product never runs
+            inner = self.B.device_arrays() if self.B is not None else ()
+            return (self._inv,) + tuple(inner) + (self._scale_arr,)
         if self.st_type == "sinvert":
             inner = self.B.device_arrays() if self.B is not None else ()
             return (self._inv,) + tuple(inner)
@@ -200,6 +251,9 @@ class STOperator:
         return arrs
 
     def op_specs(self, axis):
+        if self.st_type == "cayley":
+            inner = self.B.op_specs(axis) if self.B is not None else ()
+            return (P(),) + tuple(inner) + (P(),)
         if self.st_type == "sinvert":
             inner = self.B.op_specs(axis) if self.B is not None else ()
             return (P(),) + tuple(inner)
@@ -218,6 +272,25 @@ class STOperator:
             z_full = minv @ r_full
             i = lax.axis_index(axis)
             return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
+
+        if self.st_type == "cayley":
+            # identity form: (A-sB)^-1(A+nB) = I + (s+n)(A-sB)^-1 B —
+            # algebraically exact, and one full sharded A-product cheaper
+            # per application than the literal two-product form
+            if self.B is None:
+                def spmv(op_arrays, x):
+                    minv, scale = op_arrays
+                    return x + scale * matinv_apply(minv, x)
+                return spmv
+            nb = len(self.B.device_arrays())
+            b_spmv = self.B.local_spmv(comm)
+
+            def spmv(op_arrays, x):
+                minv = op_arrays[0]
+                b_arrays = op_arrays[1:1 + nb]
+                scale = op_arrays[1 + nb]
+                return x + scale * matinv_apply(minv, b_spmv(b_arrays, x))
+            return spmv
 
         if self.st_type == "sinvert":
             if self.B is None:

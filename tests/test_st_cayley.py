@@ -1,0 +1,196 @@
+"""ST 'cayley' (SLEPc's STCAYLEY) — generalized Cayley transform.
+
+theta = (lambda + nu)/(lambda - sigma), operating on
+``(A - sigma B)^-1 (A + nu B)``; antishift nu defaults to sigma
+(``-st_cayley_antishift`` overrides). Interior-pair parity against
+``numpy.linalg.eigh`` oracles, standard + generalized problems, the
+back-transform identity, and option plumbing.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.solvers.eps import EPS
+from mpi_petsc4py_example_tpu.solvers.st import ST
+
+from test_eps import reference_tridiag
+
+
+class TestBackTransform:
+    def test_roundtrip_identity(self):
+        st = ST()
+        st.set_type("cayley")
+        st.set_shift(3.0)
+        st.set_antishift(1.5)
+        lam = np.array([-7.0, 0.4, 2.2, 9.9])
+        theta = (lam + 1.5) / (lam - 3.0)
+        np.testing.assert_allclose(st.back_transform(theta), lam,
+                                   rtol=1e-13)
+
+    def test_antishift_defaults_to_sigma(self):
+        st = ST()
+        st.set_type("cayley")
+        st.set_shift(2.0)
+        assert st.get_antishift() == 2.0
+        st.set_antishift(5.0)
+        assert st.get_antishift() == 5.0
+
+    def test_theta_one_maps_to_inf(self):
+        st = ST()
+        st.set_type("cayley")
+        st.set_shift(1.0)
+        out = st.back_transform(np.array([1.0]))
+        assert np.isinf(out[0])
+
+
+class TestCayleySolve:
+    def test_interior_target_diagonal(self, comm8):
+        A = sp.diags(np.arange(1.0, 61.0)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("cayley")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(33.4)               # nearest eigenvalue is 33
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 33.0,
+                                   rtol=1e-8)
+
+    def test_smallest_poisson_via_cayley(self, comm8):
+        n = 120
+        A = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                     [-1, 0, 1]).tocsr()
+        lam_min = np.linalg.eigvalsh(A.toarray())[0]
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("cayley")
+        E.get_st().set_shift(0.0)
+        E.get_st().set_antishift(1.0)    # nu != sigma exercises the pair
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(0.0)
+        E.set_tolerances(tol=1e-10)
+        E.solve()
+        assert E.get_converged() >= 1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, lam_min,
+                                   rtol=1e-8)
+
+    def test_eigenvector_true_residual(self, comm8):
+        A = reference_tridiag(80)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("cayley")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(50.0)
+        E.solve()
+        assert E.get_converged() >= 1
+        lam = E.get_eigenvalue(0).real
+        vr, _ = M.get_vecs()
+        E.get_eigenpair(0, vr)
+        v = vr.to_numpy()
+        r = np.linalg.norm(A @ v - lam * v) / abs(lam)
+        assert r <= 1e-8, r
+
+    def test_lapack_cayley_selection_parity(self, comm8):
+        """'-eps_type lapack -st_type cayley' selects nearest-sigma pairs
+        like the iterative types do."""
+        A = sp.diags(np.arange(1.0, 41.0)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.get_st().set_type("cayley")
+        E.get_st().set_shift(17.2)
+        E.set_dimensions(nev=2)
+        E.solve()
+        got = sorted(E.get_eigenvalue(i).real for i in range(2))
+        np.testing.assert_allclose(got, [17.0, 18.0], rtol=1e-12)
+
+    def test_ghep_cayley(self, comm8):
+        import scipy.linalg
+        rng = np.random.default_rng(0)
+        n = 50
+        Q = rng.random((n, n))
+        A = sp.csr_matrix((Q + Q.T) / 2 + n * np.eye(n))
+        Bd = sp.diags(1.0 + rng.random(n)).tocsr()
+        lam = scipy.linalg.eigh(A.toarray(), Bd.toarray(),
+                                eigvals_only=True)
+        target = float(lam[n // 2] + 0.01)
+        MA = tps.Mat.from_scipy(comm8, A)
+        MB = tps.Mat.from_scipy(comm8, Bd)
+        E = EPS().create(comm8)
+        E.set_operators(MA, MB)
+        E.set_problem_type("ghep")
+        E.get_st().set_type("cayley")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(target)
+        E.solve()
+        assert E.get_converged() >= 1
+        nearest = lam[np.argmin(np.abs(lam - target))]
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, nearest,
+                                   rtol=1e-7)
+
+    def test_antishift_change_rebuilds_operator(self, comm8):
+        """set_antishift between solves must not reuse a stale cached
+        STOperator (the op cache keys on nu for cayley)."""
+        A = sp.diags(np.arange(1.0, 41.0)).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("cayley")
+        E.set_which_eigenpairs("target_magnitude")
+        E.set_target(17.2)
+        E.solve()
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 17.0,
+                                   rtol=1e-8)
+        E.get_st().set_antishift(500.0)   # different transform, same pairs
+        E.solve()
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 17.0,
+                                   rtol=1e-7)
+
+    def test_lapack_orders_by_theta_magnitude(self, comm8):
+        """A pair at lam = -nu has theta = 0 (LEAST magnified) — plain
+        distance-to-sigma ordering would wrongly pick it first."""
+        A = sp.diags([-1.0, 3.5, 5.0, 9.0, 20.0, -14.0, 30.0, -25.0]
+                     ).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("lapack")
+        E.get_st().set_type("cayley")
+        E.get_st().set_shift(1.0)         # nu defaults to 1: theta(-1) = 0
+        E.set_dimensions(nev=1)
+        E.solve()
+        # largest |theta| = (lam+1)/(lam-1) maximized at lam closest to 1
+        # from the remaining spectrum: lam=3.5 -> theta=1.8; NOT lam=-1
+        np.testing.assert_allclose(E.get_eigenvalue(0).real, 3.5,
+                                   rtol=1e-12)
+
+    def test_degenerate_antishift_rejected(self, comm8):
+        A = reference_tridiag(20)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.get_st().set_type("cayley")     # sigma=0, nu->0: identity
+        with pytest.raises(ValueError, match="identity"):
+            E.solve()
+
+    def test_option_plumbing(self, comm8):
+        tps.global_options().parse_argv(
+            ["prog", "-st_type", "cayley", "-st_shift", "2.5",
+             "-st_cayley_antishift", "0.5"])
+        st = ST().set_from_options()
+        assert st.get_type() == "cayley"
+        assert st.get_shift() == 2.5
+        assert st.get_antishift() == 0.5
