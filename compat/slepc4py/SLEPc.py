@@ -33,6 +33,12 @@ class ST:
     def getShift(self):
         return self._core.get_shift()
 
+    def setCayleyAntishift(self, nu):
+        self._core.set_antishift(nu)
+
+    def getCayleyAntishift(self):
+        return self._core.get_antishift()
+
     def setFromOptions(self):
         self._core.set_from_options()
 
