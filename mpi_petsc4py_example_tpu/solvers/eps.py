@@ -844,6 +844,7 @@ class EPS:
         self.ncv: int | None = None   # auto: max(2*nev, nev+15), capped at n
         self.tol = DEFAULT_TOL
         self.max_it = DEFAULT_MAX_RESTARTS
+        self.gd_blocksize = 0     # -eps_gd_blocksize (0 = auto: nev)
         self.result = SolveResult()
         self._eigenvalues = np.zeros(0)
         self._eigenvectors = np.zeros((0, 0))
@@ -956,6 +957,8 @@ class EPS:
         target = opt.get_real("eps_target", None)
         if target is not None:
             self.set_target(target)
+        self.gd_blocksize = opt.get_int("eps_gd_blocksize",
+                                        self.gd_blocksize)
         self.st.set_from_options()
         return self
 
@@ -1689,11 +1692,18 @@ class EPS:
         op = self._mat
         n = op.shape[0]
         _GD_BS_CAP = 16
-        m = min(max(self.nev, 1), _GD_BS_CAP, n)
         if self.nev > _GD_BS_CAP:
             raise ValueError(
                 f"EPS 'gd' caps the block size at {_GD_BS_CAP} — use "
                 "krylovschur for more pairs")
+        if self.gd_blocksize > _GD_BS_CAP:
+            # same limit, same signal as nev — never a silent clamp
+            raise ValueError(
+                f"-eps_gd_blocksize {self.gd_blocksize} exceeds the "
+                f"{_GD_BS_CAP} cap (block spmvs are statically unrolled)")
+        # -eps_gd_blocksize widens the expansion block past nev (never
+        # below it: the first nev Ritz pairs are the convergence targets)
+        m = min(max(self.gd_blocksize, self.nev, 1), n)
         dtype = np.dtype(str(op.dtype))
         hdt = host_dtype(dtype)
         npad = comm.padded_size(n)

@@ -154,3 +154,23 @@ class TestGDRestrictions:
         E = EPS().create(comm8)
         E.set_from_options()
         assert E.get_type() == "gd"
+
+    def test_gd_blocksize_option(self, comm8):
+        """-eps_gd_blocksize widens the expansion block past nev."""
+        tps.global_options().set("eps_gd_blocksize", 6)
+        A = poisson2d(10)
+        lam = np.linalg.eigvalsh(A.toarray())
+        M = tps.Mat.from_scipy(comm8, A)
+        E = EPS().create(comm8)
+        E.set_operators(M)
+        E.set_problem_type("hep")
+        E.set_type("gd")
+        E.set_which_eigenpairs("smallest_real")
+        E.set_dimensions(nev=2)
+        E.set_from_options()
+        assert E.gd_blocksize == 6
+        E.set_tolerances(tol=1e-7, max_it=300)
+        E.solve()
+        assert E.get_converged() >= 2
+        got = np.sort([E.get_eigenvalue(i).real for i in range(2)])
+        np.testing.assert_allclose(got, lam[:2], rtol=1e-5)
