@@ -89,6 +89,12 @@ class EPS:
     def setWhichEigenpairs(self, which):
         self._core.set_which_eigenpairs(which)
 
+    def setMonitor(self, fn):
+        self._core.set_monitor(fn)
+
+    def cancelMonitor(self):
+        self._core.cancel_monitor()
+
     def setType(self, eps_type):
         self._core.set_type(eps_type)
 

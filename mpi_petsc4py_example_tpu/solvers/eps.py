@@ -845,6 +845,8 @@ class EPS:
         self.tol = DEFAULT_TOL
         self.max_it = DEFAULT_MAX_RESTARTS
         self.gd_blocksize = 0     # -eps_gd_blocksize (0 = auto: nev)
+        self._monitors: list = []      # EPSMonitorSet callbacks
+        self._monitor_flag = False     # -eps_monitor default printer
         self.result = SolveResult()
         self._eigenvalues = np.zeros(0)
         self._eigenvectors = np.zeros((0, 0))
@@ -959,8 +961,57 @@ class EPS:
             self.set_target(target)
         self.gd_blocksize = opt.get_int("eps_gd_blocksize",
                                         self.gd_blocksize)
+        self._monitor_flag = opt.get_bool("eps_monitor",
+                                          self._monitor_flag)
         self.st.set_from_options()
         return self
+
+    # ---- monitors (EPSMonitorSet / -eps_monitor) -----------------------------
+    def set_monitor(self, fn):
+        """Register ``fn(eps, its, nconv, eig, errest)`` — slepc4py's
+        ``EPS.setMonitor`` signature: back-transformed eigenvalue
+        approximations and relative error estimates, most-wanted-first,
+        once per outer iteration/restart. Monitored solves run the
+        host-orchestrated loops (a fused whole-solve program has no
+        per-restart host point to report from — same philosophy as KSP's
+        monitored-programs-stay-unrolled rule)."""
+        if fn is not None:          # setMonitor(None) is a no-op (slepc4py)
+            self._monitors.append(fn)
+        return self
+
+    setMonitor = set_monitor
+
+    def cancel_monitor(self):
+        """EPSMonitorCancel: removes ALL monitors — including the
+        ``-eps_monitor`` printer — and un-pins the fused solve paths."""
+        self._monitors = []
+        self._monitor_flag = False
+        return self
+
+    cancelMonitor = cancel_monitor
+
+    def _monitored(self) -> bool:
+        return bool(self._monitors) or self._monitor_flag
+
+    def _emit_monitor(self, its, nconv, lam, errest):
+        """One monitoring event. ``lam``/``errest`` ordered
+        most-wanted-first; prints SLEPc's ``-eps_monitor`` line when the
+        flag is set, then runs user callbacks."""
+        if not self._monitored():
+            return
+        lam = np.atleast_1d(np.asarray(lam))
+        errest = np.atleast_1d(np.asarray(errest))
+        if self._monitor_flag:
+            if int(nconv) < len(lam):
+                j = int(nconv)
+                err = float(errest[j]) if j < len(errest) else 0.0
+                print(f"{int(its):3d} EPS nconv={int(nconv)} first "
+                      f"unconverged value (error) {lam[j]} ({err:.8e})")
+            else:   # every reported pair converged — no mislabeled value
+                print(f"{int(its):3d} EPS nconv={int(nconv)} "
+                      "(all requested pairs converged)")
+        for fn in self._monitors:
+            fn(self, int(its), int(nconv), lam, errest)
 
     setFromOptions = set_from_options
 
@@ -1221,8 +1272,11 @@ class EPS:
         # cheap — default to the host loop (override: TPU_SOLVE_EPS_FUSED).
         # cayley back-transforms with TWO runtime parameters (sigma, nu);
         # the fused program's static _bt_dev carries only sigma, so cayley
-        # runs the host loop (generic st.back_transform)
-        want_fused = _want_fused(comm, n) and self.st.get_type() != "cayley"
+        # runs the host loop (generic st.back_transform). Monitored solves
+        # also run it — the fused program has no per-restart host point.
+        want_fused = (_want_fused(comm, n)
+                      and self.st.get_type() != "cayley"
+                      and not self._monitored())
         if (want_fused and hermitian and ncv < n and k_keep >= 1
                 and self._which in (
                     EPSWhich.LARGEST_MAGNITUDE, EPSWhich.SMALLEST_MAGNITUDE,
@@ -1278,6 +1332,10 @@ class EPS:
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
+            if self._monitored():   # guard: args cost O(ncv) per restart
+                self._emit_monitor(restarts, nconv,
+                                   self.st.back_transform(lam_t[order]),
+                                   rel)
             if nconv >= nev or ncv >= n or restarts == self.max_it:
                 break
 
@@ -1348,6 +1406,10 @@ class EPS:
             record_sync("EPS H fetch/restart")
             beta, lam_t, S, order, rel, nconv = self._rayleigh_ritz(
                 Hh, ncv, nev, hermitian)
+            if self._monitored():   # guard: args cost O(ncv) per restart
+                self._emit_monitor(restarts, nconv,
+                                   self.st.back_transform(lam_t[order]),
+                                   rel)
             if nconv >= nev or ncv >= n or restarts == self.max_it:
                 break
             # restart vector: combination of wanted, not-yet-converged Ritz
@@ -1389,6 +1451,10 @@ class EPS:
             record_sync("EPS power fetch/chunk", 2)
             rel = res / max(abs(theta), 1e-300)
             its = chunk * steps
+            if self._monitored():
+                self._emit_monitor(
+                    its, 1 if rel <= self.tol else 0,
+                    self.st.back_transform(np.asarray([theta])), [rel])
             if rel <= self.tol:
                 break
 
@@ -1428,6 +1494,7 @@ class EPS:
         # and ncv×ncv projected eigh run ON DEVICE inside one while_loop
         # program — O(1) sync points/solve (same gating as krylovschur)
         if (hermitian and _want_fused(comm, n)
+                and not self._monitored()
                 and _device_eigh_trustworthy(comm, dtype)
                 and _device_matmul_trustworthy(comm, dtype)):
             sprog = _build_subspace_loop_program(
@@ -1473,6 +1540,10 @@ class EPS:
             nconv = 0
             while nconv < nev and rel[nconv] <= self.tol:
                 nconv += 1
+            if self._monitored():
+                self._emit_monitor(it, nconv,
+                                   self.st.back_transform(lam_t[order]),
+                                   rel)
             if nconv >= nev or it == self.max_it:
                 break
             Y = np.zeros((ncv, npad), dtype=dtype)
@@ -1534,7 +1605,8 @@ class EPS:
         # orthonormalization and the 3m×3m projected pencil (whitened,
         # eigh) run ON DEVICE inside one while_loop program — O(1) sync
         # points/solve (same gating as the other fused loops)
-        if (_want_fused(comm, n) and _device_eigh_trustworthy(comm, dtype_)
+        if (_want_fused(comm, n) and not self._monitored()
+                and _device_eigh_trustworthy(comm, dtype_)
                 and _device_matmul_trustworthy(comm, dtype_)):
             npad_ = comm.padded_size(n)
             X0, dinv = _lobpcg_seed(op, n, m, dtype_)
@@ -1621,6 +1693,7 @@ class EPS:
             nconv = 0
             while nconv < min(self.nev, m) and rel[order0[nconv]] <= self.tol:
                 nconv += 1
+            self._emit_monitor(it, nconv, theta[order0], rel[order0])
             if nconv >= min(self.nev, m) or it == self.max_it:
                 break
             W = T_apply(R)
@@ -1759,6 +1832,7 @@ class EPS:
             nconv = 0
             while nconv < min(self.nev, m) and rel[nconv] <= self.tol:
                 nconv += 1
+            self._emit_monitor(it, nconv, theta, rel)
             if nconv >= min(self.nev, m) or it == self.max_it:
                 break                      # no discarded final expansion
             if V.shape[0] + 1 > mmax:

@@ -1,0 +1,153 @@
+"""EPS monitoring (EPSMonitorSet / ``-eps_monitor``).
+
+SLEPc emits one line per outer iteration with nconv and the first
+unconverged approximation [external, behind ``-eps_monitor`` through the
+reference's ``setFromOptions``, petsc_funcs.py:17]. Here: user callbacks
+get ``(eps, its, nconv, eig, errest)`` most-wanted-first; the flag prints
+the SLEPc-shaped line; monitored solves run the host-orchestrated loops
+(the fused whole-solve programs have no per-restart host point).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.solvers.eps import EPS
+
+from test_eps import reference_tridiag
+
+
+def _solve(comm, eps_type="krylovschur", monitor=None, flag=False,
+           which=None, nev=1, n=80, A=None, max_it=None):
+    if A is None:
+        A = reference_tridiag(n)
+    M = tps.Mat.from_scipy(comm, A)
+    E = EPS().create(comm)
+    E.set_operators(M)
+    E.set_problem_type("hep")
+    E.set_type(eps_type)
+    if which:
+        E.set_which_eigenpairs(which)
+    E.set_dimensions(nev=nev)
+    if max_it is not None:
+        E.set_tolerances(max_it=max_it)
+    if monitor is not None:
+        E.set_monitor(monitor)
+    E._monitor_flag = flag
+    E.solve()
+    return E
+
+
+@pytest.mark.parametrize("eps_type,which", [
+    ("krylovschur", None),
+    ("arnoldi", None),
+    ("power", None),
+    ("subspace", None),
+    ("lobpcg", "largest_real"),
+    ("gd", "largest_real"),
+])
+def test_monitor_fires_each_type(comm8, eps_type, which):
+    events = []
+
+    def mon(eps, its, nconv, eig, errest):
+        events.append((its, nconv, np.asarray(eig).copy(),
+                       np.asarray(errest).copy()))
+
+    # lobpcg's host loop converges to extreme pairs of well-separated
+    # spectra; give it one (the tridiagonal family's tail clusters)
+    A = (sp.diags(np.arange(1.0, 61.0)).tocsr()
+         if eps_type == "lobpcg" else None)
+    E = _solve(comm8, eps_type, monitor=mon, which=which, A=A, max_it=500)
+    assert E.get_converged() >= 1
+    assert events, f"{eps_type}: monitor never fired"
+    its_seq = [e[0] for e in events]
+    assert its_seq == sorted(its_seq)
+    # the final event's leading approximation matches the stored pair
+    eig_last = events[-1][2]
+    np.testing.assert_allclose(eig_last[0].real,
+                               E.get_eigenvalue(0).real, rtol=1e-5)
+    # errest arrays are finite and nonnegative
+    assert np.all(np.asarray(events[-1][3]) >= 0)
+
+
+def test_monitor_forces_host_loop(comm8):
+    """A monitored krylovschur must take the host loop (events per
+    restart) even where the fused program would otherwise engage."""
+    import mpi_petsc4py_example_tpu.solvers.eps as eps_mod
+    events = []
+    orig = eps_mod._want_fused
+    eps_mod._want_fused = lambda comm, n: True    # force the fused gate on
+    try:
+        E = _solve(comm8, "krylovschur",
+                   monitor=lambda *a: events.append(a[1]))
+        assert E.get_converged() >= 1
+        assert events                              # host loop ran, monitored
+    finally:
+        eps_mod._want_fused = orig
+
+
+def test_flag_prints_slepc_line(comm8, capsys):
+    E = _solve(comm8, "krylovschur", flag=True)
+    out = capsys.readouterr().out
+    assert "EPS nconv=" in out
+    assert "first unconverged value" in out
+
+
+def test_option_plumbing(comm8):
+    tps.global_options().set("eps_monitor", True)
+    E = EPS().create(comm8)
+    E.set_from_options()
+    assert E._monitor_flag
+
+
+def test_cancel_monitor(comm8):
+    events = []
+    A = reference_tridiag(40)
+    M = tps.Mat.from_scipy(comm8, A)
+    E = EPS().create(comm8)
+    E.set_operators(M)
+    E.set_problem_type("hep")
+    E.set_monitor(lambda *a: events.append(a))
+    E._monitor_flag = True
+    E.cancel_monitor()        # EPSMonitorCancel removes ALL monitors
+    assert not E._monitored() and not E._monitor_flag
+    E.solve()
+    assert not events
+
+
+def test_set_monitor_none_is_noop(comm8):
+    E = EPS().create(comm8)
+    E.set_monitor(None)       # slepc4py convention
+    assert not E._monitored()
+
+
+def test_flag_all_converged_line(comm8, capsys):
+    """The final event where every pair converged must not label a
+    converged value as 'first unconverged'."""
+    E = _solve(comm8, "power", flag=True, n=40,
+               A=sp.diags(np.arange(1.0, 41.0)).tocsr(), max_it=400)
+    out = capsys.readouterr().out
+    assert E.get_converged() >= 1
+    assert "all requested pairs converged" in out
+
+
+def test_facade_set_monitor(comm8):
+    import sys
+    sys.path.insert(0, "compat")
+    try:
+        from slepc4py import SLEPc
+        from petsc4py import PETSc  # noqa: F401 — facade import order
+        events = []
+        A = reference_tridiag(30)
+        M = tps.Mat.from_scipy(comm8, A)
+        E = SLEPc.EPS()
+        E.create()
+        E._core.create(comm8)
+        E._core.set_operators(M)
+        E.setProblemType(SLEPc.EPS.ProblemType.HEP)
+        E.setMonitor(lambda *a: events.append(a))
+        E._core.solve()
+        assert events
+    finally:
+        sys.path.remove("compat")
