@@ -48,7 +48,7 @@ if _cache and _cache != "0":
         _jax.config.update("jax_compilation_cache_dir", _cache)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:  # noqa: BLE001 — older jax without the knobs
+    except AttributeError:  # older jax without the knobs
         pass
 
 from .parallel.mesh import (DeviceComm, get_default_comm, set_default_comm,

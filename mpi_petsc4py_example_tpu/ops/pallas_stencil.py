@@ -234,7 +234,7 @@ def _tpu_device_kind():
     try:
         d = jax.devices()[0]
         return d.device_kind if d.platform == "tpu" else None
-    except Exception:       # noqa: BLE001 — uninitialized backend
+    except RuntimeError:    # uninitialized/absent backend
         return None
 
 

@@ -496,7 +496,10 @@ class KSP:
                     try:
                         for m in monitors:
                             m(self, k + _mon_offset, rn)
-                    except Exception as exc:  # noqa: BLE001 — user code
+                    # tpslint: disable=TPS005 — user monitor callbacks can
+                    # raise anything; it must not reach the XLA io_callback
+                    # machinery, so record and re-raise after the barrier
+                    except Exception as exc:  # noqa: BLE001
                         if not monitor_errors:
                             monitor_errors.append(exc)
             live_ctx = live_monitor_sink(_dispatch)

@@ -786,7 +786,8 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
             # to_scipy() either, which would host-fetch the whole ELL
             try:
                 blocks = _ell_diag_blocks(mat.ell_cols, mat.ell_vals, bs, n)
-            except Exception:  # noqa: BLE001 — host extraction still works
+            except (RuntimeError, ValueError, TypeError):
+                # device gather/compile failed — host extraction still works
                 blocks = None
         if blocks is None:
             blocks = _dense_diag_blocks(mat.to_scipy().tocsr(), n, bs,
@@ -939,7 +940,9 @@ def _run_device_inverse(comm: DeviceComm, place, what: str):
                   if wide and comm.platform == "tpu" else _inv_polish)
         X, q = inv_fn(B)
         q = float(q)   # sync: setup-time only, one scalar
-    except Exception as e:  # noqa: BLE001
+    except (RuntimeError, ValueError, TypeError, NotImplementedError) as e:
+        # JaxRuntimeError/XlaRuntimeError subclass RuntimeError (compile and
+        # run failures); trace-time dtype/shape problems raise the rest
         import warnings
         warnings.warn(
             f"device-side {what} inversion failed ({type(e).__name__}); "
@@ -1251,7 +1254,7 @@ def _build_dense_lu(comm: DeviceComm, mat: Mat,
             # ship (a dense fp64 operator through the dev tunnel measured
             # ~22 MB/s — slower than just factorizing on the host)
             Ad = _densify_ell(mat.ell_cols, mat.ell_vals, n)
-        except Exception as e:  # noqa: BLE001
+        except (RuntimeError, ValueError, TypeError) as e:
             import warnings
             warnings.warn(
                 f"device-side densification failed ({type(e).__name__}); "

@@ -577,8 +577,10 @@ def _bpcr_device_factor(comm, dt, N: int, b: int, vals, idx):
                                    comm.put_replicated(idx))
         q64 = float(q64)   # sync: setup-time only, two scalars
         qc = float(qc)
-    except Exception as e:  # noqa: BLE001 — unsupported-dtype compiles,
-        # transient remote-compile failures: host fp64 path is the answer
+    except (RuntimeError, ValueError, TypeError, NotImplementedError) as e:
+        # unsupported-dtype compiles (trace-time TypeError/ValueError) and
+        # transient remote-compile failures (XlaRuntimeError subclasses
+        # RuntimeError): host fp64 path is the answer either way
         import warnings
         warnings.warn(
             f"device-side block-PCR setup failed ({type(e).__name__}); "

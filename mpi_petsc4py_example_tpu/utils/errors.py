@@ -52,7 +52,9 @@ def wrap_device_errors(what: str):
         def inner(*args, **kwargs):
             try:
                 return fn(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001 — classify then re-raise
+            # tpslint: disable=TPS005 — classify-and-re-raise wrapper: every
+            # exception escapes this handler, nothing is swallowed
+            except Exception as e:  # noqa: BLE001
                 name = type(e).__name__
                 if "JaxRuntimeError" in name or "XlaRuntimeError" in name:
                     raise DeviceExecutionError(what, e) from e

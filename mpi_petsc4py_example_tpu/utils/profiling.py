@@ -104,12 +104,12 @@ def program_count() -> int:
     try:
         from ..solvers.krylov import _PROGRAM_CACHE as kc
         n += len(kc)
-    except Exception:       # noqa: BLE001 — introspection only
+    except (ImportError, AttributeError):   # introspection only
         pass
     try:
         from ..solvers.eps import _PROGRAM_CACHE as ec
         n += len(ec)
-    except Exception:       # noqa: BLE001
+    except (ImportError, AttributeError):
         pass
     return n
 

@@ -1,0 +1,28 @@
+"""TPS001 fixture — host syncs on traced values; every `# BAD:` line fires."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def jitted_residual(r):
+    rn = jnp.linalg.norm(r)
+    return float(rn)  # BAD: TPS001
+
+
+def loop_body(state):
+    x, k = state
+    host = x.item()  # BAD: TPS001
+    arr = np.asarray(x)  # BAD: TPS001
+    return x + host + arr, k + 1
+
+
+def run(x0):
+    return lax.while_loop(lambda s: s[1] < 3, loop_body, (x0, 0))
+
+
+@jax.jit
+def blocks(v):
+    v.block_until_ready()  # BAD: TPS001
+    return v

@@ -1,0 +1,30 @@
+"""TPS001 fixture — the repo's idiomatic host/static patterns; zero findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def scaled(x, alpha=2.0):
+    lsize = int(x.shape[0])          # shape is static under tracing: fine
+    return x * alpha * lsize
+
+
+def body(state):
+    x, k = state
+    return x * 2.0, k + 1
+
+
+def run(x0, max_it):
+    n_steps = int(max_it)            # host config scalar: fine
+    out = lax.while_loop(lambda s: s[1] < n_steps, body, (x0, 0))
+    x, _ = out
+    return x
+
+
+def host_driver(prog, b):
+    """One sync per solve AFTER the compiled program returns — the repo's
+    contract (README 'One XLA program per solve')."""
+    x = prog(b)
+    return float(np.asarray(x)[0])

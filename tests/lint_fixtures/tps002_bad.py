@@ -1,0 +1,36 @@
+"""TPS002 fixture — recompile/trace-break hazards; every `# BAD:` line fires."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchy(x):
+    if x > 0:  # BAD: TPS002
+        return x
+    return -x
+
+
+@jax.jit
+def loopy(x):
+    while x < 10:  # BAD: TPS002
+        x = x + 1
+    return x
+
+
+@jax.jit
+def checked(x):
+    assert x.sum() > 0  # BAD: TPS002
+    return x
+
+
+@jax.jit
+def shapey(x):
+    label = f"rn={x}"  # BAD: TPS002
+    return x, label
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def configured(x, opts=[]):  # BAD: TPS002
+    return x

@@ -1,0 +1,23 @@
+"""TPS002 fixture — static branching/unrolling idiom; zero findings."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchless(x):
+    return jnp.where(x > 0, x, -x)
+
+
+@partial(jax.jit, static_argnames=("unroll",))
+def unrolled(x, unroll=2):
+    for _ in range(unroll):          # static Python unroll: fine
+        x = x * 2.0
+    if unroll > 1:                   # branch on a static arg: fine
+        x = x + 1.0
+    return x
+
+
+def host_report(rnorm):
+    return f"rn={rnorm:.3e}"         # host-side formatting: fine

@@ -1,0 +1,19 @@
+"""TPS003 fixture — hard-coded collective axis names; every `# BAD:` fires."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def bad_dot(x_local):
+    return lax.psum(jnp.vdot(x_local, x_local), "rows")  # BAD: TPS003
+
+
+def bad_gather(x_local):
+    return lax.all_gather(x_local, axis_name="rows", tiled=True)  # BAD: TPS003
+
+
+def bad_rank():
+    return lax.axis_index("rows")  # BAD: TPS003
+
+
+def bad_shift(x, perm):
+    return lax.ppermute(x, "rows", perm)  # BAD: TPS003

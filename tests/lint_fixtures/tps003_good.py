@@ -1,0 +1,17 @@
+"""TPS003 fixture — axis names threaded from DeviceComm; zero findings."""
+import jax.numpy as jnp
+from jax import lax
+
+ROW_AXIS = "rows"
+
+
+def pdot(x_local, axis):
+    return lax.psum(jnp.vdot(x_local, x_local), axis)
+
+
+def gather(x_local, comm):
+    return lax.all_gather(x_local, comm.axis, tiled=True)
+
+
+def rank(axis=ROW_AXIS):
+    return lax.axis_index(axis)

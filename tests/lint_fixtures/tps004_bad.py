@@ -1,0 +1,31 @@
+"""TPS004 fixture — dtype drift on device paths; every `# BAD:` line fires."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def drifted(x):
+    shift = np.float64(1e-8)  # BAD: TPS004
+    return x + shift
+
+
+@jax.jit
+def pinned(x):
+    w = jnp.zeros(x.shape, dtype=jnp.float64)  # BAD: TPS004
+    return x + w
+
+
+@jax.jit
+def stringly(x):
+    return jnp.asarray(x, dtype="float64")  # BAD: TPS004
+
+
+@jax.jit
+def cast(x):
+    return x.astype(np.float64)  # BAD: TPS004
+
+
+@jax.jit
+def positional(x):
+    return jnp.zeros(x.shape, jnp.float64)  # BAD: TPS004

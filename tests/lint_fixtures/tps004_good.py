@@ -1,0 +1,26 @@
+"""TPS004 fixture — dtype threaded from operands, host f64; zero findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_dtype(dtype):
+    """Host-side fp64 is idiomatic (utils/dtypes.py) — never flagged."""
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        return np.complex128
+    return np.float64
+
+
+@jax.jit
+def threaded(x):
+    w = jnp.zeros(x.shape, dtype=x.dtype)     # dtype from the operand: fine
+    return x + w
+
+
+@jax.jit
+def recast(x, y):
+    return x.astype(y.dtype)                  # dtype from an operand: fine
+
+
+def host_setup(vals):
+    return np.asarray(vals, dtype=np.float64)  # host path: fine

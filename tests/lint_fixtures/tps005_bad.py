@@ -1,0 +1,22 @@
+"""TPS005 fixture — broad exception swallowing; every `# BAD:` line fires."""
+
+
+def swallow_all(fn):
+    try:
+        return fn()
+    except Exception:  # BAD: TPS005
+        return None
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # BAD: TPS005
+        return None
+
+
+def swallow_base(fn):
+    try:
+        return fn()
+    except (ValueError, BaseException):  # BAD: TPS005
+        return None

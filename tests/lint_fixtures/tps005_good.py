@@ -1,0 +1,16 @@
+"""TPS005 fixture — narrow handlers and one justified suppression; clean."""
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (RuntimeError, ValueError):   # device/compile failures
+        return None
+
+
+def justified(fn):
+    try:
+        return fn()
+    # tpslint: disable=TPS005 — fixture demonstrating a justified suppression
+    except Exception:
+        return None

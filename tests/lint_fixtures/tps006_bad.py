@@ -1,0 +1,21 @@
+"""TPS006 fixture — Pallas sanity violations; every `# BAD:` line fires."""
+import jax
+from jax.experimental import pallas as pl
+
+
+def debug_kernel(kernel, x):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,  # BAD: TPS006
+    )(x)
+
+
+def mismatched(kernel, x, bz):
+    return pl.pallas_call(
+        kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((bz, 128), lambda i: (i, 0))],  # BAD: TPS006
+        out_specs=pl.BlockSpec((bz, 128), lambda i, j: (i,)),  # BAD: TPS006
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,305 @@
+"""Tests for tools.tpslint — the JAX/TPU-aware static analyzer.
+
+Three layers:
+
+* per-rule fixture tests: each ``tests/lint_fixtures/tpsNNN_bad.py`` file
+  marks every line that must fire with ``# BAD: TPSNNN``; the test asserts
+  the finding set equals the marker set EXACTLY (rule ids and line
+  numbers — nothing missing, nothing extra), and the sibling
+  ``tpsNNN_good.py`` (the repo's idiomatic patterns) stays silent;
+* suppression semantics: justified suppressions silence findings,
+  unjustified ones are themselves errors, stale ones fail ``--strict``;
+* the meta-test: tpslint runs clean over the repo's own packages — the
+  merge requirement CONTRIBUTING.md states.
+
+Pure-AST: none of the fixture modules are imported or executed.
+"""
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.tpslint import analyze_paths, analyze_source, all_rules
+from tools.tpslint.cli import main as tpslint_main
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006")
+
+_MARKER_RE = re.compile(r"#\s*BAD:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+
+def _expected(path: Path):
+    exp = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _MARKER_RE.search(line)
+        if m:
+            for rid in m.group(1).split(","):
+                exp.add((rid.strip(), lineno))
+    return exp
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_has_all_rules():
+    assert tuple(all_rules()) == RULE_IDS
+
+
+def test_rules_carry_descriptions():
+    for rule in all_rules().values():
+        assert rule.description, rule.id
+        assert rule.name != "unnamed", rule.id
+
+
+# ------------------------------------------------------------ rule fixtures
+@pytest.mark.parametrize("rid", RULE_IDS)
+def test_rule_fires_on_bad_fixture(rid):
+    path = FIXTURES / f"{rid.lower()}_bad.py"
+    expected = _expected(path)
+    assert expected, f"fixture {path} has no # BAD markers"
+    result = analyze_source(path.read_text(), path=str(path))
+    got = {(f.rule, f.line) for f in result.findings}
+    assert got == expected
+    assert not result.errors
+
+
+@pytest.mark.parametrize("rid", RULE_IDS)
+def test_rule_silent_on_good_fixture(rid):
+    path = FIXTURES / f"{rid.lower()}_good.py"
+    result = analyze_source(path.read_text(), path=str(path))
+    assert result.findings == []
+    assert result.bad_suppressions == []
+    assert not result.errors
+
+
+def test_select_restricts_rules():
+    path = FIXTURES / "tps005_bad.py"
+    result = analyze_source(path.read_text(), select=["TPS003"])
+    assert result.findings == []
+
+
+# ------------------------------------------------------------- suppressions
+JITTED_SYNC = (
+    "import jax\n"
+    "\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    return float(x){comment}\n"
+)
+
+
+def test_justified_suppression_silences():
+    src = JITTED_SYNC.format(
+        comment="  # tpslint: disable=TPS001 — setup-time scalar, one sync")
+    result = analyze_source(src)
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0][1].justification.startswith("setup-time")
+
+
+def test_unjustified_suppression_is_error_and_does_not_silence():
+    src = JITTED_SYNC.format(comment="  # tpslint: disable=TPS001")
+    result = analyze_source(src)
+    assert [f.rule for f in result.findings] == ["TPS001"]
+    assert [f.rule for f in result.bad_suppressions] == ["TPS000"]
+    assert result.exit_code() == 1
+
+
+def test_standalone_suppression_guards_next_code_line():
+    src = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # tpslint: disable=TPS001 — justification wrapping over\n"
+        "    # several comment lines still guards the next code line\n"
+        "    return float(x)\n"
+    )
+    result = analyze_source(src)
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+def test_wrong_rule_suppression_does_not_silence():
+    src = JITTED_SYNC.format(
+        comment="  # tpslint: disable=TPS005 — wrong rule id")
+    result = analyze_source(src)
+    assert [f.rule for f in result.findings] == ["TPS001"]
+    # and the suppression is stale
+    assert len(result.unused_suppressions) == 1
+    assert result.exit_code(strict=True) == 1
+
+
+def test_unused_suppression_only_fails_strict():
+    src = "x = 1  # tpslint: disable=TPS001 — nothing ever fires here\n"
+    result = analyze_source(src)
+    assert result.exit_code(strict=False) == 0
+    assert result.exit_code(strict=True) == 1
+
+
+def test_syntax_error_is_reported_not_raised():
+    result = analyze_source("def broken(:\n")
+    assert [f.rule for f in result.errors] == ["TPS-PARSE"]
+    assert result.exit_code() == 1
+
+
+def test_suppression_inside_string_literal_is_inert():
+    """Docstrings documenting the syntax must not register suppressions."""
+    src = (
+        'DOC = """\n'
+        "use  # tpslint: disable=TPS001 — like this\n"
+        '"""\n'
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+    )
+    result = analyze_source(src)
+    assert [f.rule for f in result.findings] == ["TPS001"]
+    assert result.unused_suppressions == []
+
+
+def test_select_does_not_mark_other_rules_suppressions_stale():
+    src = (
+        "def f(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:  # tpslint: disable=TPS005 — fixture reason\n"
+        "        return None\n"
+    )
+    result = analyze_source(src, select=["TPS001"])
+    assert result.unused_suppressions == []
+    assert result.exit_code(strict=True) == 0
+    # …but with TPS005 actually running it is used, not stale
+    result = analyze_source(src, select=["TPS005"])
+    assert len(result.suppressed) == 1
+
+
+# ------------------------------------------------- analysis-precision pins
+def test_taint_propagates_through_long_assignment_chains():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    b = x * 2\n"
+        "    c = b + 1\n"
+        "    d = c\n"
+        "    return float(d)\n"
+    )
+    assert [(f.rule, f.line) for f in analyze_source(src).findings] \
+        == [("TPS001", 7)]
+
+
+def test_numpy_submodule_calls_are_host_syncs():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.linalg.norm(x)\n"
+    )
+    assert [(f.rule, f.line) for f in analyze_source(src).findings] \
+        == [("TPS001", 5)]
+
+
+def test_call_form_jit_static_argnums_not_tainted():
+    src = (
+        "import jax\n"
+        "def solve(A, b, maxiter):\n"
+        "    return A @ b * float(maxiter)\n"
+        "g = jax.jit(solve, static_argnums=(2,))\n"
+    )
+    assert analyze_source(src).findings == []
+
+
+def test_trailing_suppression_on_continuation_line_guards_statement():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(\n"
+        "        x)  # tpslint: disable=TPS001 — setup-time scalar\n"
+    )
+    result = analyze_source(src)
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+    assert result.unused_suppressions == []
+
+
+def test_unaliased_jax_numpy_wide_dtype_detected():
+    src = (
+        "import jax\n"
+        "import jax.numpy\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.astype(jax.numpy.float64)\n"
+    )
+    assert [(f.rule, f.line) for f in analyze_source(src).findings] \
+        == [("TPS004", 5)]
+
+
+# ---------------------------------------------------------------- meta-test
+def test_repo_lints_clean():
+    """The merge requirement: zero unsuppressed findings over the repo's own
+    packages, and every suppression justified."""
+    dirs = [str(REPO / d)
+            for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
+                      "examples")]
+    for d in dirs:
+        # guard against a vacuous pass: each linted tree must exist and
+        # contribute files (a rename must break THIS test, not silently
+        # shrink coverage)
+        assert analyze_paths([d]).files_linted > 0, d
+    result = analyze_paths(dirs)
+    msgs = [f.format() for f in
+            result.findings + result.bad_suppressions + result.errors]
+    assert msgs == []
+
+
+def test_repo_has_no_stale_suppressions():
+    dirs = [str(REPO / d)
+            for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
+                      "examples")]
+    result = analyze_paths(dirs)
+    stale = [(s.path, s.line) for s in result.unused_suppressions]
+    assert stale == []
+
+
+# ----------------------------------------------------------------- the CLI
+def test_cli_list_rules(capsys):
+    assert tpslint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_IDS:
+        assert rid in out
+
+
+def test_cli_exit_codes(capsys):
+    bad = str(FIXTURES / "tps001_bad.py")
+    good = str(FIXTURES / "tps001_good.py")
+    assert tpslint_main([bad]) == 1
+    assert tpslint_main([good]) == 0
+    assert tpslint_main([]) == 2
+    assert tpslint_main(["--select", "TPS999", good]) == 2
+    assert tpslint_main(["no/such/dir"]) == 2   # typo'd path must not pass
+    capsys.readouterr()
+
+
+def test_cli_reports_rule_and_line(capsys):
+    bad = FIXTURES / "tps003_bad.py"
+    assert tpslint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    for rid, line in _expected(bad):
+        assert f"{bad}:{line}:" in out
+        assert rid in out
+
+
+def test_console_script_runs_as_module():
+    """`python -m tools.tpslint.cli` mirrors the installed entry point."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpslint.cli", "--list-rules"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0
+    assert "TPS001" in proc.stdout

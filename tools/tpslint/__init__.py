@@ -1,0 +1,39 @@
+"""tpslint — JAX/TPU-aware static analysis for this repo's solver stack.
+
+The performance story of the TPU sparse-solve reproduction rests on
+invariants the Python type system cannot see:
+
+* solves compile to ONE XLA program with O(1) host syncs — a stray
+  ``float(traced)`` inside a ``while_loop`` body silently re-introduces a
+  per-iteration device->host round trip (README "One XLA program per
+  solve");
+* collectives must name the mesh axis ``DeviceComm`` actually created
+  (``parallel/mesh.py``), never a hard-coded string;
+* dtype discipline decides whether the MXU fast path or the emulated-f64
+  path runs (``TPU_SOLVE_NO_X64``).
+
+tpslint walks the AST (no imports, no execution — safe on files that need
+a TPU to even import), detects *traced contexts* (jit-compiled functions,
+``lax`` control-flow bodies, ``shard_map`` bodies, Pallas kernels) plus a
+per-context traced-value taint set, and checks the rule registry in
+:mod:`tools.tpslint.rules` against them.
+
+Run ``tpslint --list-rules`` for the rule table, or see README
+"Static analysis".
+"""
+
+from .engine import AnalysisResult, analyze_paths, analyze_source
+from .rules import all_rules
+from .findings import Finding, Suppression
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AnalysisResult",
+    "Finding",
+    "Suppression",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "__version__",
+]

@@ -1,0 +1,105 @@
+"""``tpslint`` console entry point.
+
+Usage::
+
+    tpslint mpi_petsc4py_example_tpu/ compat/ tools/ examples/
+    tpslint --strict ...          # CI mode: also fail on unused suppressions
+    tpslint --list-rules
+    tpslint --select TPS001,TPS005 path/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .engine import analyze_paths
+from .rules import all_rules
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpslint",
+        description=("JAX/TPU-aware static analysis guarding the "
+                     "jit/shard_map/Pallas invariants of the TPU "
+                     "sparse-solve stack"))
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on unused (stale) suppressions")
+    p.add_argument("--select", default=None, metavar="TPS001,TPS002",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by justified "
+                        "suppressions")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in all_rules().items():
+            print(f"{rid}  {rule.name}")
+            print(f"        {rule.description}")
+        return 0
+
+    if not args.paths:
+        print("tpslint: error: no paths given (try --list-rules, or pass "
+              "package directories)", file=sys.stderr)
+        return 2
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not lint zero files and report "clean"
+        print(f"tpslint: error: no such file or directory: "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = set(select) - set(all_rules())
+        if unknown:
+            print(f"tpslint: error: unknown rule(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    result = analyze_paths(args.paths, select=select)
+
+    for f in result.errors:
+        print(f.format())
+    for f in result.findings:
+        print(f.format())
+    for f in result.bad_suppressions:
+        print(f.format())
+    if args.show_suppressed:
+        for f, s in result.suppressed:
+            print(f"{f.format()}  [suppressed: {s.justification}]")
+    if args.strict:
+        for s in result.unused_suppressions:
+            print(f"{s.path}:{s.line}:0: TPS000 unused suppression of "
+                  f"{', '.join(s.rules)} (nothing fires on the guarded "
+                  "line)")
+
+    n = len(result.findings) + len(result.bad_suppressions) + \
+        len(result.errors)
+    code = result.exit_code(strict=args.strict)
+    if n or (args.strict and result.unused_suppressions):
+        extra = (f", {len(result.unused_suppressions)} unused "
+                 "suppression(s)" if args.strict
+                 and result.unused_suppressions else "")
+        print(f"tpslint: {n} finding(s){extra}", file=sys.stderr)
+    elif result.suppressed:
+        print(f"tpslint: clean ({len(result.suppressed)} justified "
+              "suppression(s))", file=sys.stderr)
+    else:
+        print("tpslint: clean", file=sys.stderr)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,526 @@
+"""Traced-context discovery and traced-value taint analysis.
+
+A *traced context* is a function body that JAX executes with tracer values:
+anything jit-compiled, a ``lax`` control-flow body (``while_loop`` / ``scan``
+/ ``cond`` / ``fori_loop`` / ``switch`` / ``map``), a ``shard_map`` body, a
+Pallas kernel, or a function nested inside one of those (it runs during the
+enclosing trace).  Host-callback targets (``io_callback`` / ``pure_callback``
+/ ``jax.debug.callback``) are the explicit exception — they run on the host
+even though they are *called from* traced code.
+
+Within each traced context we compute a conservative set of *tainted* names:
+the context's parameters (minus jit static args) plus anything assigned from
+them, minus expressions that are static under tracing (``.shape`` / ``.dtype``
+/ ``.ndim`` / ``len()`` — those concretize at trace time, not run time).
+Rules use the taint set to tell ``float(rnorm)`` (a host sync on a traced
+value) from ``float(rtol)`` (a host-side config scalar captured by closure).
+
+Everything is per-module and purely syntactic: no imports are executed, so
+the linter runs on files that need a TPU backend to even import.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+# Callables whose function-valued argument(s) are traced.  Maps the terminal
+# name (last attribute segment) to the positional indices of the traced
+# function arguments.  Ambiguous terminals (AMBIGUOUS below) additionally
+# require a ``lax``/``jax`` qualifier so that builtin ``map(f, xs)`` or an
+# unrelated ``obj.cond(...)`` does not match.
+TRACING_CALLERS = {
+    "jit": (0,),
+    "pjit": (0,),
+    "shard_map": (0,),
+    "pmap": (0,),
+    "vmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": (1,),
+    "map": (0,),
+    "associative_scan": (0,),
+    "pallas_call": (0,),
+}
+
+#: Terminal names that only count when qualified by a jax/lax module alias.
+AMBIGUOUS = {"map", "cond", "scan", "switch", "grad", "checkpoint"}
+
+#: Decorator terminals that make the decorated function a traced context.
+TRACING_DECORATORS = {"jit", "pjit", "shard_map", "pmap", "vmap", "grad",
+                      "value_and_grad", "checkpoint", "remat"}
+
+#: Callables whose function argument runs ON THE HOST (never traced).
+HOST_CALLBACK_CALLERS = {"io_callback", "pure_callback", "callback",
+                         "debug_callback"}
+
+#: Attribute accesses that are static under tracing — reading them off a
+#: tracer yields a concrete Python value at trace time, not a device value.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                "itemsize", "weak_type"}
+
+#: Calls that concretize to static values at trace time.
+STATIC_CALLS = {"len", "isinstance", "type"}
+
+
+def terminal_name(func: ast.expr):
+    """``jax.lax.psum`` -> ``psum``; ``psum`` -> ``psum``; else None."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def qualifier_chain(func: ast.expr):
+    """Dotted prefix of an Attribute as a list: ``jax.lax.psum`` ->
+    ``["jax", "lax"]``; bare names and non-name bases -> ``[]``."""
+    chain = []
+    cur = func.value if isinstance(func, ast.Attribute) else None
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        chain.append(cur.id)
+    chain.reverse()
+    return chain
+
+
+@dataclass
+class ModuleInfo:
+    """Import aliases gathered from the module header."""
+
+    numpy_aliases: set = field(default_factory=set)   # np, numpy
+    jnp_aliases: set = field(default_factory=set)     # jnp, jax.numpy
+    jax_aliases: set = field(default_factory=set)     # jax
+    lax_aliases: set = field(default_factory=set)     # lax
+    # names from-imported out of jax.* modules: name -> source module
+    jax_from_imports: dict = field(default_factory=dict)
+
+    def collect(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name in ("numpy",):
+                        self.numpy_aliases.add(name)
+                    elif a.name in ("jax.numpy",):
+                        if a.asname:
+                            self.jnp_aliases.add(a.asname)
+                        else:
+                            # ``import jax.numpy`` binds the name "jax";
+                            # jax.numpy.* is matched via the dotted chain
+                            self.jax_aliases.add("jax")
+                    elif a.name == "jax":
+                        self.jax_aliases.add(name)
+                    elif a.name in ("jax.lax",):
+                        if a.asname:
+                            self.lax_aliases.add(a.asname)
+                        else:
+                            self.jax_aliases.add("jax")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    name = a.asname or a.name
+                    if mod == "jax" and a.name == "numpy":
+                        self.jnp_aliases.add(name)
+                    elif mod == "jax" and a.name == "lax":
+                        self.lax_aliases.add(name)
+                    elif mod.startswith("jax"):
+                        self.jax_from_imports[name] = mod
+                    elif mod == "numpy":
+                        # from numpy import float64 — track via from-imports
+                        self.jax_from_imports.setdefault(name, mod)
+        return self
+
+    def is_lax_qualified(self, func: ast.expr) -> bool:
+        """True when ``func`` plausibly refers to a jax/lax callable: a
+        ``lax.x`` / ``jax.lax.x`` / ``jax.x`` attribute, or a bare name that
+        was from-imported out of a jax module."""
+        if isinstance(func, ast.Attribute):
+            chain = qualifier_chain(func)
+            if not chain:
+                return False
+            return (chain[-1] in self.lax_aliases or chain[-1] == "lax"
+                    or chain[0] in self.jax_aliases)
+        if isinstance(func, ast.Name):
+            return func.id in self.jax_from_imports
+        return False
+
+    def is_numpy_attr(self, node: ast.expr) -> bool:
+        """True for any attribute rooted at a numpy alias — ``np.asarray``
+        but also submodule spellings like ``np.linalg.norm``."""
+        if not isinstance(node, ast.Attribute):
+            return False
+        chain = qualifier_chain(node)
+        return bool(chain) and chain[0] in self.numpy_aliases
+
+    def is_jnp_attr(self, node: ast.expr) -> bool:
+        """True for attributes rooted at a jax.numpy alias (``jnp.zeros``,
+        ``jnp.linalg.norm``) or spelled ``jax.numpy.*`` directly."""
+        if not isinstance(node, ast.Attribute):
+            return False
+        chain = qualifier_chain(node)
+        if not chain:
+            return False
+        if chain[0] in self.jnp_aliases:
+            return True
+        return (len(chain) >= 2 and chain[0] in self.jax_aliases
+                and chain[1] == "numpy")
+
+
+@dataclass
+class TracedContext:
+    """One traced function body plus its tainted-name set."""
+
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef / Lambda
+    reason: str                   # how it became traced ("jit", "while_loop" …)
+    tainted: set = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleAnalysis:
+    """Parsed module plus everything the rules need: import aliases, parent
+    links, traced contexts with taint sets, and per-context node iteration."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str = "<string>"):
+        self.tree = tree
+        self.source = source
+        self.path = path
+        self.info = ModuleInfo().collect(tree)
+        self.parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._host_marked = set()     # function nodes passed to host callbacks
+        self._trace_reasons = {}      # function node -> reason string
+        self._call_statics = {}       # function node -> static names from
+                                      # call-form jax.jit(fn, static_arg...)
+        self._find_marked_functions()
+        self.contexts = self._build_contexts()
+
+    # ------------------------------------------------------------------ marks
+    def _resolve_func_arg(self, call: ast.Call, index: int):
+        """The function node an argument refers to: a Lambda literal, or a
+        Name resolved to a def in an enclosing scope of the call site."""
+        if index >= len(call.args):
+            return None
+        arg = call.args[index]
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Call):
+            # jax.jit(comm.shard_map(local_fn, ...)) — the inner call is
+            # itself a tracing caller; it gets handled on its own visit.
+            return None
+        if isinstance(arg, ast.Name):
+            return self._resolve_name_to_def(arg)
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            # lax.switch branch lists — handled by caller
+            return None
+        return None
+
+    def _resolve_name_to_def(self, name: ast.Name):
+        """Nearest def of ``name`` walking up the scope chain.
+
+        Follows Python scoping: class bodies are NOT enclosing scopes for
+        names used inside methods, and a function parameter shadows any
+        outer def of the same name (in which case the reference is not
+        statically resolvable — return None rather than mis-binding)."""
+        scope = self.parents.get(name)
+        crossed_function = False
+        while scope is not None:
+            if isinstance(scope, FUNCTION_NODES):
+                args = scope.args
+                params = {a.arg for a in (args.posonlyargs + args.args
+                                          + args.kwonlyargs)}
+                if args.vararg:
+                    params.add(args.vararg.arg)
+                if args.kwarg:
+                    params.add(args.kwarg.arg)
+                if name.id in params:
+                    return None          # bound to a parameter, not a def
+                body = scope.body if isinstance(scope.body, list) else []
+                for stmt in body:
+                    if (isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and stmt.name == name.id):
+                        return stmt
+                crossed_function = True
+            elif isinstance(scope, ast.ClassDef):
+                if not crossed_function:   # reference directly in class body
+                    for stmt in scope.body:
+                        if (isinstance(stmt, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                                and stmt.name == name.id):
+                            return stmt
+            elif isinstance(scope, ast.Module):
+                for stmt in scope.body:
+                    if (isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and stmt.name == name.id):
+                        return stmt
+            scope = self.parents.get(scope)
+        return None
+
+    def _mark(self, fn_node, reason: str):
+        if fn_node is not None and isinstance(fn_node, FUNCTION_NODES):
+            self._trace_reasons.setdefault(fn_node, reason)
+
+    def _find_marked_functions(self):
+        """Single pass marking functions traced (or host) by decorator and
+        by being passed to tracing/host-callback callers."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    name = self._decorator_terminal(dec)
+                    if name in TRACING_DECORATORS:
+                        if name in AMBIGUOUS and not self._dec_qualified(dec):
+                            continue
+                        self._mark(node, name)
+            elif isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name in HOST_CALLBACK_CALLERS:
+                    fn = self._resolve_func_arg(node, 0)
+                    if fn is not None:
+                        self._host_marked.add(fn)
+                    elif node.args and isinstance(node.args[0], ast.Lambda):
+                        self._host_marked.add(node.args[0])
+                    continue
+                if name not in TRACING_CALLERS:
+                    continue
+                if name in AMBIGUOUS and not self.info.is_lax_qualified(
+                        node.func):
+                    continue
+                for idx in TRACING_CALLERS[name]:
+                    fn = self._resolve_func_arg(node, idx)
+                    self._mark(fn, name)
+                    if (fn is not None and name in ("jit", "pjit")
+                            and isinstance(fn, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))):
+                        statics = self._statics_from_keywords(node.keywords,
+                                                              fn)
+                        if statics:
+                            self._call_statics.setdefault(
+                                fn, set()).update(statics)
+                    if (name == "switch" and idx < len(node.args)
+                            and isinstance(node.args[idx],
+                                           (ast.List, ast.Tuple))):
+                        for elt in node.args[idx].elts:
+                            if isinstance(elt, ast.Lambda):
+                                self._mark(elt, name)
+                            elif isinstance(elt, ast.Name):
+                                self._mark(self._resolve_name_to_def(elt),
+                                           name)
+
+    def _decorator_terminal(self, dec: ast.expr):
+        """Terminal name of a decorator, looking through ``partial(...)``."""
+        if isinstance(dec, ast.Call):
+            inner = terminal_name(dec.func)
+            if inner == "partial" and dec.args:
+                return terminal_name(dec.args[0])
+            return inner
+        return terminal_name(dec)
+
+    def _dec_qualified(self, dec: ast.expr) -> bool:
+        if isinstance(dec, ast.Call):
+            if terminal_name(dec.func) == "partial" and dec.args:
+                return self.info.is_lax_qualified(dec.args[0])
+            return self.info.is_lax_qualified(dec.func)
+        return self.info.is_lax_qualified(dec)
+
+    # -------------------------------------------------------------- contexts
+    def _build_contexts(self):
+        """Traced contexts in source order, taint sets computed with
+        enclosing-context taint inherited by closures."""
+        contexts = []
+        index = {}
+
+        def visit(node, enclosing_tainted, enclosing_traced):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, FUNCTION_NODES):
+                    if child in self._host_marked:
+                        # host-callback target: nothing inside it is traced
+                        visit(child, set(), False)
+                        continue
+                    traced = (child in self._trace_reasons
+                              or enclosing_traced)
+                    if traced:
+                        reason = self._trace_reasons.get(child, "enclosing")
+                        tainted = self._seed_taint(child)
+                        tainted |= self._free_tainted(child,
+                                                      enclosing_tainted)
+                        self._propagate(child, tainted)
+                        ctx = TracedContext(child, reason, tainted)
+                        contexts.append(ctx)
+                        index[child] = ctx
+                        visit(child, tainted, True)
+                    else:
+                        visit(child, set(), False)
+                else:
+                    visit(child, enclosing_tainted, enclosing_traced)
+
+        visit(self.tree, set(), False)
+        self._ctx_index = index
+        return contexts
+
+    def _seed_taint(self, fn) -> set:
+        """Parameters of a traced function are tracers — minus jit static
+        args declared in the decorator."""
+        args = fn.args
+        names = {a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        return names - self._static_argnames(fn)
+
+    @staticmethod
+    def _statics_from_keywords(keywords, fn) -> set:
+        """Parameter names made static by static_argnames/static_argnums
+        keywords (of a jit decorator or a call-form ``jax.jit(fn, ...)``)."""
+        static = set()
+        pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str):
+                        static.add(c.value)
+            elif kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                            c.value, int):
+                        if 0 <= c.value < len(pos_params):
+                            static.add(pos_params[c.value])
+        return static
+
+    def _static_argnames(self, fn) -> set:
+        """static_argnames/static_argnums declared on a jit decorator or
+        recorded from a call-form ``jax.jit(fn, static_argnums=...)``."""
+        static = set(self._call_statics.get(fn, ()))
+        for dec in getattr(fn, "decorator_list", []):
+            if not isinstance(dec, ast.Call):
+                continue
+            if terminal_name(dec.func) == "partial" and dec.args:
+                if terminal_name(dec.args[0]) not in ("jit", "pjit"):
+                    continue
+            elif terminal_name(dec.func) not in ("jit", "pjit"):
+                continue
+            # partial(jax.jit, ...) shifts nothing: the decorated fn's own
+            # positional order applies
+            static |= self._statics_from_keywords(dec.keywords, fn)
+        return static
+
+    def _free_tainted(self, fn, enclosing_tainted) -> set:
+        """Enclosing tainted names the closure actually references."""
+        if not enclosing_tainted:
+            return set()
+        used = {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+        return enclosing_tainted & used
+
+    def _propagate(self, fn, tainted: set):
+        """Forward passes over the context's own statements in SOURCE order
+        (iter_own_nodes yields DFS-stack order), adding assignment targets
+        whose RHS is tainted, iterated to a fixpoint so arbitrarily long
+        assignment chains (`b = x; c = b; d = c; float(d)`) taint fully."""
+        stmts = sorted(self.iter_own_nodes(fn),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        for _ in range(len(stmts) + 1):
+            before = len(tainted)
+            for node in stmts:
+                if isinstance(node, ast.Assign):
+                    if self.expr_tainted(node.value, tainted):
+                        for t in node.targets:
+                            self._add_targets(t, tainted)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    if self.expr_tainted(node.value, tainted):
+                        self._add_targets(node.target, tainted)
+                elif isinstance(node, ast.AugAssign):
+                    if self.expr_tainted(node.value, tainted):
+                        self._add_targets(node.target, tainted)
+                elif isinstance(node, ast.NamedExpr):
+                    if self.expr_tainted(node.value, tainted):
+                        self._add_targets(node.target, tainted)
+                elif isinstance(node, ast.For):
+                    if self.expr_tainted(node.iter, tainted):
+                        self._add_targets(node.target, tainted)
+            if len(tainted) == before:
+                break
+
+    @staticmethod
+    def _add_targets(target, tainted: set):
+        """Taint the names an assignment target binds.  For subscript /
+        attribute targets only the base is tainted — ``tau[i][j] = x`` says
+        nothing about the index variables ``i``/``j``."""
+        if isinstance(target, ast.Name):
+            tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                ModuleAnalysis._add_targets(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            ModuleAnalysis._add_targets(target.value, tainted)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            ModuleAnalysis._add_targets(target.value, tainted)
+
+    # ------------------------------------------------------------- utilities
+    def expr_tainted(self, expr: ast.expr, tainted: set) -> bool:
+        """Does ``expr`` carry a traced value?  Static-under-tracing
+        subtrees (``x.shape[0]``, ``len(x)``, ``x.dtype``) do not count."""
+        if expr is None or not tainted:
+            return False
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Attribute):
+                if node.attr in STATIC_ATTRS:
+                    continue                      # static subtree: skip whole
+                stack.append(node.value)
+                continue
+            if isinstance(node, ast.Call):
+                tname = terminal_name(node.func)
+                if tname in STATIC_CALLS:
+                    continue
+                stack.extend(ast.iter_child_nodes(node))
+                continue
+            if isinstance(node, ast.Name):
+                if node.id in tainted:
+                    return True
+                continue
+            if isinstance(node, ast.Lambda):
+                continue                          # deferred body
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def iter_own_nodes(self, fn):
+        """All nodes of a function body EXCLUDING nested function bodies —
+        nested defs are their own (traced or host) contexts."""
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, FUNCTION_NODES):
+                    # still yield the def node itself (rules may inspect
+                    # decorators) but do not descend into its body
+                    yield child
+                    continue
+                stack.append(child)
+
+    def context_for(self, fn_node):
+        return self._ctx_index.get(fn_node)

@@ -1,0 +1,147 @@
+"""Analysis driver: parse, run rules, apply suppressions."""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .context import ModuleAnalysis
+from .findings import (BAD_SUPPRESSION, Finding, Suppression,
+                       parse_suppressions)
+from .rules import all_rules
+
+
+@dataclass
+class AnalysisResult:
+    """Outcome of linting one or more files."""
+
+    findings: list = field(default_factory=list)       # unsuppressed
+    suppressed: list = field(default_factory=list)     # (Finding, Suppression)
+    bad_suppressions: list = field(default_factory=list)   # Finding (TPS000)
+    unused_suppressions: list = field(default_factory=list)  # Suppression
+    errors: list = field(default_factory=list)         # Finding (parse)
+    files_linted: int = 0
+
+    def merge(self, other: "AnalysisResult"):
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.bad_suppressions.extend(other.bad_suppressions)
+        self.unused_suppressions.extend(other.unused_suppressions)
+        self.errors.extend(other.errors)
+        self.files_linted += other.files_linted
+
+    def exit_code(self, strict: bool = False) -> int:
+        if self.findings or self.bad_suppressions or self.errors:
+            return 1
+        if strict and self.unused_suppressions:
+            return 1
+        return 0
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select=None) -> AnalysisResult:
+    """Lint one module's source.  ``select`` optionally restricts to an
+    iterable of rule ids."""
+    result = AnalysisResult()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        result.errors.append(Finding(
+            rule="TPS-PARSE", message=f"syntax error: {e.msg}",
+            line=e.lineno or 1, col=(e.offset or 1) - 1, path=path))
+        return result
+
+    module = ModuleAnalysis(tree, source, path)
+    rules = all_rules()
+    if select is not None:
+        wanted = set(select)
+        rules = {rid: r for rid, r in rules.items() if rid in wanted}
+
+    raw = []
+    for rule in rules.values():
+        for f in rule.check(module):
+            raw.append(Finding(rule=f.rule, message=f.message,
+                               line=f.line, col=f.col, path=path))
+    raw.sort(key=lambda f: (f.line, f.col, f.rule))
+
+    suppressions = parse_suppressions(source)
+    for s in suppressions:
+        s.path = path
+
+    # findings anchor at a statement's FIRST line; a trailing suppression on
+    # a continuation line of a multi-line statement must still guard it
+    stmt_spans = [(n.lineno, n.end_lineno) for n in ast.walk(tree)
+                  if isinstance(n, ast.stmt) and n.end_lineno is not None]
+
+    def _statement_start(line: int):
+        spans = [s0 for s0, s1 in stmt_spans if s0 <= line <= s1]
+        return max(spans) if spans else None
+
+    guard = {}      # line -> [Suppression]
+    for s in suppressions:
+        if not s.standalone:
+            start = _statement_start(s.line)
+            if start is not None and start not in s.guarded_lines:
+                s.guarded_lines = s.guarded_lines + (start,)
+    for s in suppressions:
+        if not s.justification:
+            result.bad_suppressions.append(Finding(
+                rule=BAD_SUPPRESSION,
+                message=(f"suppression of {', '.join(s.rules)} carries no "
+                         "justification — `# tpslint: disable=TPSxxx — "
+                         "why the code is right` is required"),
+                line=s.line, col=0, path=path))
+            # an unjustified suppression still suppresses nothing
+            continue
+        for line in s.guarded_lines:
+            guard.setdefault(line, []).append(s)
+
+    for f in raw:
+        sup = next((s for s in guard.get(f.line, ()) if f.rule in s.rules),
+                   None)
+        if sup is not None:
+            sup.used = True
+            result.suppressed.append((f, sup))
+        else:
+            result.findings.append(f)
+
+    # a suppression can only be "unused" with respect to rules that actually
+    # ran — under --select, suppressions of deselected rules are not stale
+    active = set(rules)
+    result.unused_suppressions.extend(
+        s for s in suppressions
+        if s.justification and not s.used and active.intersection(s.rules))
+    return result
+
+
+def iter_python_files(paths):
+    """Expand files/directories into .py files, skipping hidden dirs and
+    __pycache__."""
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if not d.startswith(".") and d != "__pycache__")
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def analyze_paths(paths, select=None) -> AnalysisResult:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    total = AnalysisResult()
+    for fname in iter_python_files(paths):
+        try:
+            with open(fname, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            total.errors.append(Finding(
+                rule="TPS-READ", message=f"cannot read: {e}", line=1, col=0,
+                path=fname))
+            continue
+        total.merge(analyze_source(source, path=fname, select=select))
+        total.files_linted += 1
+    return total

@@ -1,0 +1,93 @@
+"""Finding and suppression primitives shared by the engine and the rules."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a rule fired at a source location."""
+
+    rule: str          # "TPS001"
+    message: str       # human-readable, one line
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    path: str = ""     # filled in by the engine
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ``tpslint: disable=TPSnnn`` or ``tpslint: disable=TPSnnn,TPSmmm — why``.
+# The justification is REQUIRED: a suppression is a claim that a human looked
+# at the finding and decided the code is right — the claim must say why, or
+# the next reader cannot audit it.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpslint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)(.*)$")
+# Leading separators between the rule list and the justification text.
+_SEP_RE = re.compile(r"^[\s—–:,-]+")
+
+#: Pseudo-rule id for malformed suppressions (never suppressible itself).
+BAD_SUPPRESSION = "TPS000"
+
+
+@dataclass
+class Suppression:
+    """A parsed ``# tpslint: disable=`` comment."""
+
+    line: int                 # line the comment sits on (1-based)
+    rules: tuple              # ("TPS001", "TPS005")
+    justification: str        # may be "" — that is an error
+    standalone: bool          # comment is the whole line -> guards next code
+    guarded_lines: tuple = () # source lines this suppression applies to
+    used: bool = field(default=False, compare=False)
+    path: str = field(default="", compare=False)
+
+
+def _comment_tokens(source: str):
+    """(lineno, col, text) for every real COMMENT token — tokenizing (not
+    line-regexing) so a docstring that *documents* the suppression syntax
+    is never parsed as a live suppression."""
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # the engine reports the parse error separately; no comments here
+        return
+
+
+def parse_suppressions(source: str):
+    """Extract all suppression comments from ``source``.
+
+    A trailing comment guards its own line; a standalone comment line (or
+    block of comment lines — justifications often wrap) guards the next
+    non-blank, non-comment line below it.
+    """
+    lines = source.splitlines()
+    out = []
+    for lineno, col, text in _comment_tokens(source):
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        justification = _SEP_RE.sub("", m.group(2).strip()).strip()
+        standalone = lines[lineno - 1][:col].strip() == ""
+        if standalone:
+            guarded = ()
+            for nxt in range(lineno, len(lines)):
+                stripped = lines[nxt].strip()
+                if stripped and not stripped.startswith("#"):
+                    guarded = (nxt + 1,)
+                    break
+        else:
+            guarded = (lineno,)
+        out.append(Suppression(line=lineno, rules=rules,
+                               justification=justification,
+                               standalone=standalone,
+                               guarded_lines=guarded))
+    return out

@@ -1,0 +1,13 @@
+"""Rule registry — importing this package registers all built-in rules."""
+
+from .base import Rule, register, registry
+from . import (tps001_host_sync, tps002_recompile, tps003_axis_name,
+               tps004_dtype_drift, tps005_broad_except, tps006_pallas)
+
+
+def all_rules() -> dict:
+    """Rule-id -> rule instance, sorted by id."""
+    return dict(sorted(registry().items()))
+
+
+__all__ = ["Rule", "register", "registry", "all_rules"]

@@ -1,0 +1,39 @@
+"""Rule base class and registry."""
+
+from __future__ import annotations
+
+from ..findings import Finding
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator adding a Rule to the global registry."""
+    inst = cls()
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def registry() -> dict:
+    return dict(_REGISTRY)
+
+
+class Rule:
+    """One check.  Subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check` yielding :class:`Finding` objects."""
+
+    id = "TPS999"
+    name = "unnamed"
+    #: One-line rationale shown by ``tpslint --list-rules``.
+    description = ""
+
+    def check(self, module):
+        """Yield findings for a :class:`~tools.tpslint.context.ModuleAnalysis`."""
+        raise NotImplementedError
+
+    def finding(self, node, message: str) -> Finding:
+        return Finding(rule=self.id, message=message,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0))

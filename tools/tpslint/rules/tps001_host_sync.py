@@ -1,0 +1,73 @@
+"""TPS001 — host sync inside a traced program.
+
+``float()`` / ``int()`` / ``.item()`` / ``np.*()`` / ``.block_until_ready()``
+applied to a traced value inside a jit/``lax`` control-flow/``shard_map``
+context forces device->host materialization.  Inside ``jax.jit`` that is a
+trace-time concretization error at best; inside a ``while_loop``/``scan``
+body it is the exact bug class that silently breaks the repo's
+one-XLA-program-per-solve guarantee (README "One XLA program per solve") and
+shows up only as a mysterious per-iteration sync on an 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Rule, register
+
+_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "TPS001"
+    name = "host-sync-in-program"
+    description = ("float()/int()/.item()/np.*/.block_until_ready() on a "
+                   "traced value inside jit, lax control-flow bodies, or "
+                   "shard_map — breaks the one-XLA-program-per-solve "
+                   "guarantee")
+
+    def check(self, module):
+        for ctx in module.contexts:
+            for node in module.iter_own_nodes(ctx.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                yield from self._check_call(module, ctx, node)
+
+    def _check_call(self, module, ctx, call: ast.Call):
+        func = call.func
+        # float(x) / int(x) / bool(x) / complex(x) on a traced value
+        if (isinstance(func, ast.Name) and func.id in _SCALAR_CASTS
+                and call.args
+                and module.expr_tainted(call.args[0], ctx.tainted)):
+            yield self.finding(
+                call,
+                f"`{func.id}()` of a traced value inside "
+                f"{self._where(ctx)} forces a device->host sync; return "
+                "the array and materialize outside the compiled program")
+            return
+        # x.item() / x.tolist() / x.block_until_ready()
+        if (isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS
+                and module.expr_tainted(func.value, ctx.tainted)):
+            yield self.finding(
+                call,
+                f"`.{func.attr}()` on a traced value inside "
+                f"{self._where(ctx)} forces a device->host sync; hoist it "
+                "out of the traced scope")
+            return
+        # np.anything(traced) — numpy concretizes its inputs
+        if (module.info.is_numpy_attr(func)
+                and any(module.expr_tainted(a, ctx.tainted)
+                        for a in call.args)):
+            yield self.finding(
+                call,
+                f"`{ast.unparse(func)}()` on a traced value inside "
+                f"{self._where(ctx)} concretizes through host numpy; use "
+                "the jnp equivalent so the op stays in the XLA program")
+
+    @staticmethod
+    def _where(ctx) -> str:
+        if ctx.reason == "enclosing":
+            return f"a function nested in a traced context (`{ctx.name}`)"
+        return f"a `{ctx.reason}` context (`{ctx.name}`)"

@@ -1,0 +1,99 @@
+"""TPS002 — recompile / trace-break hazards.
+
+Python-level control flow on traced values (``if``/``while``/``assert``/
+``for``), string formatting of traced values, and unhashable jit static
+arguments.  Each either raises ``TracerBoolConversionError`` at trace time
+or — worse — silently retraces per call, turning the repo's cached
+one-compile-per-shape solver programs into a compile-per-solve treadmill
+(see ``solvers/krylov.py`` ``_PROGRAM_CACHE``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Rule, register
+
+_UNHASHABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set)
+
+
+@register
+class RecompileRule(Rule):
+    id = "TPS002"
+    name = "recompile-hazard"
+    description = ("Python branching/iteration on traced values, f-strings "
+                   "of traced values in jitted code, and unhashable jit "
+                   "static args — trace errors or silent per-call retraces")
+
+    def check(self, module):
+        for ctx in module.contexts:
+            for node in module.iter_own_nodes(ctx.node):
+                yield from self._check_node(module, ctx, node)
+        yield from self._check_static_args(module)
+
+    def _check_node(self, module, ctx, node):
+        if isinstance(node, (ast.If, ast.While)):
+            if module.expr_tainted(node.test, ctx.tainted):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                yield self.finding(
+                    node,
+                    f"Python `{kw}` on a traced value in `{ctx.name}` — "
+                    "tracers have no concrete truth value; use `lax.cond`/"
+                    "`jnp.where` (or `lax.while_loop` for loops)")
+        elif isinstance(node, ast.Assert):
+            if module.expr_tainted(node.test, ctx.tainted):
+                yield self.finding(
+                    node,
+                    f"`assert` on a traced value in `{ctx.name}` — runs at "
+                    "trace time only (or errors); use `checkify` or debug "
+                    "callbacks for runtime checks")
+        elif isinstance(node, ast.For):
+            if module.expr_tainted(node.iter, ctx.tainted):
+                yield self.finding(
+                    node,
+                    f"Python `for` over a traced value in `{ctx.name}` — "
+                    "unrolls at trace time or errors; use `lax.scan`/"
+                    "`lax.fori_loop`")
+        elif isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if (isinstance(part, ast.FormattedValue)
+                        and module.expr_tainted(part.value, ctx.tainted)):
+                    yield self.finding(
+                        node,
+                        f"f-string formats a traced value in `{ctx.name}` — "
+                        "concretizes at trace time; use `jax.debug.print` "
+                        "with deferred formatting")
+                    break
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Name) and func.id in ("str", "format",
+                                                           "repr")
+                    and node.args
+                    and module.expr_tainted(node.args[0], ctx.tainted)):
+                yield self.finding(
+                    node,
+                    f"`{func.id}()` of a traced value in `{ctx.name}` — "
+                    "concretizes at trace time; use `jax.debug.print`")
+
+    def _check_static_args(self, module):
+        """jit static_argnames naming a parameter whose default is an
+        unhashable literal — every call raises (or, with a dict-keyed cache
+        workaround, retraces)."""
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            static = module._static_argnames(node)
+            if not static:
+                continue
+            params = node.args.posonlyargs + node.args.args
+            defaults = node.args.defaults
+            offset = len(params) - len(defaults)
+            for i, default in enumerate(defaults):
+                pname = params[offset + i].arg
+                if pname in static and isinstance(default,
+                                                  _UNHASHABLE_DEFAULTS):
+                    yield self.finding(
+                        default,
+                        f"static arg `{pname}` of `{node.name}` defaults to "
+                        "an unhashable literal — jit static args must be "
+                        "hashable; use a tuple/frozenset or None sentinel")

@@ -1,0 +1,71 @@
+"""TPS003 — hard-coded collective axis names.
+
+Every collective must name the mesh axis the ``DeviceComm`` actually
+created (``parallel/mesh.py``, ``ROW_AXIS``).  A string literal at a
+``lax.psum``/``all_gather``/``ppermute`` call site works until someone
+builds a mesh with a different axis name (2-D meshes, tests with private
+meshes) and then fails at runtime on an 8-device mesh with an unbound-axis
+error — or, worse, silently reduces over the wrong axis of a 2-D mesh.
+Thread the name from ``DeviceComm.axis`` (or a parameter fed from it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import terminal_name
+from .base import Rule, register
+
+#: collective terminal name -> positional index of the axis-name argument
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "pmean": 1,
+    "all_gather": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "pswapaxes": 1,
+    "all_to_all": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+
+def _is_string_literal(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_string_literal(e) for e in node.elts)
+    return False
+
+
+@register
+class AxisNameRule(Rule):
+    id = "TPS003"
+    name = "hard-coded-axis-name"
+    description = ("lax.psum/all_gather/ppermute/axis_index axis names must "
+                   "be threaded from DeviceComm.axis, never string literals")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name not in COLLECTIVE_AXIS_ARG:
+                continue
+            axis_arg = None
+            idx = COLLECTIVE_AXIS_ARG[name]
+            if idx < len(node.args):
+                axis_arg = node.args[idx]
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+            if axis_arg is not None and _is_string_literal(axis_arg):
+                yield self.finding(
+                    node,
+                    f"`{name}` called with a hard-coded axis name "
+                    f"{ast.unparse(axis_arg)!s} — thread the axis from "
+                    "`DeviceComm.axis` (parallel/mesh.py) so private/2-D "
+                    "meshes keep working")

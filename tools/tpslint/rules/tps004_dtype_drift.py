@@ -1,0 +1,79 @@
+"""TPS004 — dtype drift on device paths.
+
+Device code must respect the ``TPU_SOLVE_NO_X64`` discipline: the working
+dtype is threaded from the operator (``utils/dtypes.py``), and whether the
+MXU fast path or the emulated-f64 path runs is decided by it.  A hard-coded
+``np.float64`` scalar or ``dtype="float64"`` inside a traced context pins
+the wide path (or errors when x64 is disabled) regardless of what the
+solver was configured to do.  Host-side f64 (``host_dtype``) is idiomatic
+and not flagged — the rule only fires inside traced contexts.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Rule, register
+
+_WIDE = {"float64", "complex128"}
+
+
+@register
+class DtypeDriftRule(Rule):
+    id = "TPS004"
+    name = "dtype-drift"
+    description = ("hard-coded float64/complex128 constants or dtype= "
+                   "literals inside traced contexts — thread the dtype from "
+                   "the operator so TPU_SOLVE_NO_X64 stays in charge")
+
+    def check(self, module):
+        for ctx in module.contexts:
+            for node in module.iter_own_nodes(ctx.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                yield from self._check_call(module, ctx, node)
+
+    def _wide_dtype_expr(self, module, node: ast.expr) -> bool:
+        """``np.float64`` / ``jnp.complex128`` attribute or a "float64"
+        string — the spellings of a hard-coded wide dtype."""
+        if (isinstance(node, ast.Attribute) and node.attr in _WIDE
+                and (module.info.is_numpy_attr(node)
+                     or module.info.is_jnp_attr(node))):
+            return True
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, str) and node.value in _WIDE)
+
+    def _check_call(self, module, ctx, call: ast.Call):
+        func = call.func
+        # np.float64(x) / jnp.complex128(x) scalar constructors
+        if ((module.info.is_numpy_attr(func) or module.info.is_jnp_attr(func))
+                and func.attr in _WIDE):
+            yield self.finding(
+                call,
+                f"`{ast.unparse(func)}()` constant inside traced context "
+                f"`{ctx.name}` pins the wide-dtype path — thread the dtype "
+                "from the operand (utils/dtypes.py) instead")
+            return
+        # x.astype(np.float64) / x.astype("float64")
+        if (isinstance(func, ast.Attribute) and func.attr == "astype"
+                and call.args
+                and self._wide_dtype_expr(module, call.args[0])):
+            yield self.finding(
+                call,
+                f"`.astype({ast.unparse(call.args[0])})` inside traced "
+                f"context `{ctx.name}` pins the wide-dtype path — must "
+                "respect TPU_SOLVE_NO_X64; derive the dtype from the input")
+            return
+        # dtype=np.float64 keyword, or np.float64 passed positionally
+        # (jnp.zeros(shape, jnp.float64) — the dtype slot of creation calls)
+        hits = [kw.value for kw in call.keywords if kw.arg == "dtype"]
+        hits.extend(a for a in call.args
+                    if isinstance(a, ast.Attribute))
+        for v in hits:
+            if self._wide_dtype_expr(module, v):
+                yield self.finding(
+                    call,
+                    f"`{ast.unparse(v)}` dtype hard-coded inside traced "
+                    f"context `{ctx.name}` — must respect TPU_SOLVE_NO_X64; "
+                    "derive the dtype from the input array")
+                return

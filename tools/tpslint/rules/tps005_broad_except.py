@@ -1,0 +1,56 @@
+"""TPS005 — broad exception swallowing around device/compile code.
+
+``except Exception:`` (or bare ``except:``/``except BaseException:``)
+around device placement, compilation, or collective code hides the
+difference between "this dtype can't compile on this backend, fall back"
+(expected, recoverable) and a genuine bug (shape mismatch, wrong axis
+name) that should surface immediately.  Catch the narrow set a site can
+actually raise — device/compile failures are ``RuntimeError`` (JAX's
+``JaxRuntimeError``/``XlaRuntimeError`` both subclass it), trace-time
+failures are ``TypeError``/``ValueError`` — or suppress with a
+justification when catching everything is genuinely the contract
+(classify-and-re-raise wrappers, user-callback isolation).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import terminal_name
+from .base import Rule, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(node: ast.expr) -> bool:
+    if node is None:
+        return True                      # bare except:
+    name = terminal_name(node)
+    if name in _BROAD:
+        return True
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad(e) for e in node.elts)
+    return False
+
+
+@register
+class BroadExceptRule(Rule):
+    id = "TPS005"
+    name = "broad-except"
+    description = ("`except Exception:`/bare `except:` — catch the specific "
+                   "exceptions the site can raise (device failures are "
+                   "RuntimeError subclasses) or justify the suppression")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type):
+                what = ("bare `except:`" if node.type is None
+                        else f"`except {ast.unparse(node.type)}:`")
+                yield self.finding(
+                    node,
+                    f"{what} swallows unrelated bugs along with the "
+                    "expected failure — narrow it (JAX device/compile "
+                    "errors subclass RuntimeError; trace errors are "
+                    "TypeError/ValueError) or suppress with justification")

@@ -1,0 +1,100 @@
+"""TPS006 — Pallas kernel sanity.
+
+Statically checkable invariants of ``pl.pallas_call`` sites:
+
+* ``interpret=True`` left enabled — the interpreter escape hatch is for
+  debugging; shipped call sites must thread it from a parameter (the
+  repo's ``ops/pallas_stencil.py`` idiom) so production runs compile to
+  Mosaic;
+* grid/BlockSpec rank consistency — a ``BlockSpec`` index_map lambda must
+  take exactly one index per grid dimension, and when its body is a tuple
+  literal it must return one block coordinate per block-shape dimension.
+  Rank mismatches otherwise surface as opaque Mosaic lowering errors.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import terminal_name
+from .base import Rule, register
+
+
+def _grid_rank(node: ast.expr):
+    """Statically-known grid rank, or None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return 1
+    return None
+
+
+def _iter_blockspecs(call: ast.Call):
+    """All pl.BlockSpec(...) Call nodes in in_specs/out_specs kwargs."""
+    for kw in call.keywords:
+        if kw.arg not in ("in_specs", "out_specs"):
+            continue
+        for node in ast.walk(kw.value):
+            if (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "BlockSpec"):
+                yield node
+
+
+@register
+class PallasRule(Rule):
+    id = "TPS006"
+    name = "pallas-sanity"
+    description = ("pallas_call with interpret=True left enabled, or "
+                   "BlockSpec index_map arity/rank inconsistent with the "
+                   "declared grid")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "pallas_call"):
+                continue
+            yield from self._check_interpret(node)
+            yield from self._check_ranks(node)
+
+    def _check_interpret(self, call: ast.Call):
+        for kw in call.keywords:
+            if (kw.arg == "interpret" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                yield self.finding(
+                    kw.value,
+                    "`interpret=True` left enabled on a pallas_call — the "
+                    "interpreter escape hatch must be threaded from a "
+                    "parameter (default False) so shipped kernels compile "
+                    "to Mosaic")
+
+    def _check_ranks(self, call: ast.Call):
+        grid = None
+        for kw in call.keywords:
+            if kw.arg == "grid":
+                grid = _grid_rank(kw.value)
+        for spec in _iter_blockspecs(call):
+            block_shape = spec.args[0] if spec.args else None
+            index_map = spec.args[1] if len(spec.args) > 1 else None
+            for kw in spec.keywords:
+                if kw.arg == "index_map":
+                    index_map = kw.value
+                elif kw.arg == "block_shape":
+                    block_shape = kw.value
+            if not isinstance(index_map, ast.Lambda):
+                continue
+            arity = len(index_map.args.args)
+            if grid is not None and arity != grid:
+                yield self.finding(
+                    index_map,
+                    f"BlockSpec index_map takes {arity} grid indices but "
+                    f"the pallas_call grid has rank {grid} — one index per "
+                    "grid dimension")
+            if (isinstance(block_shape, (ast.Tuple, ast.List))
+                    and isinstance(index_map.body, ast.Tuple)
+                    and len(index_map.body.elts) != len(block_shape.elts)):
+                yield self.finding(
+                    index_map,
+                    f"BlockSpec index_map returns "
+                    f"{len(index_map.body.elts)} block coordinates for a "
+                    f"rank-{len(block_shape.elts)} block_shape — ranks "
+                    "must match")

@@ -74,7 +74,10 @@ def main():
              "__builtins__": __builtins__}
         try:
             exec(code, g)
-        except BaseException as e:  # noqa: BLE001 — report any rank failure
+        # tpslint: disable=TPS005 — rank thread runs an arbitrary user
+        # script: even SystemExit/KeyboardInterrupt must be reported and
+        # must release peers blocked on collectives
+        except BaseException as e:  # noqa: BLE001
             errors.append((rank, e, traceback.format_exc()))
             # release peers blocked on collectives so the job aborts
             ctx.barrier.abort()
