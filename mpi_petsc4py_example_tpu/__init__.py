@@ -62,9 +62,11 @@ from .core.shell import ShellMat
 from .core.nullspace import NullSpace
 from .solvers.pc import PC
 from .solvers.ksp import KSP
-from .utils.convergence import ConvergedReason, SolveResult
+from .utils.convergence import ConvergedReason, RecoveryEvent, SolveResult
 from .utils.options import Options, global_options, init, backend
 from .utils import petsc_io
+from . import resilience
+from .resilience.faults import inject_faults
 
 __version__ = "0.1.0"
 
@@ -74,13 +76,16 @@ __all__ = [
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
     "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST", "SVD",
-    "ConvergedReason", "SolveResult",
+    "ConvergedReason", "RecoveryEvent", "SolveResult",
     "Options", "global_options", "init", "backend", "petsc_io",
+    "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
+    "KSPFallbackChain",
 ]
 
 
 def __getattr__(name):
-    # EPS/ST/SVD imported lazily to keep base import light
+    # EPS/ST/SVD + resilience solver wrappers imported lazily to keep base
+    # import light
     if name == "EPS":
         from .solvers.eps import EPS
         return EPS
@@ -90,4 +95,6 @@ def __getattr__(name):
     if name == "SVD":
         from .solvers.svd import SVD
         return SVD
+    if name in ("RetryPolicy", "resilient_solve", "KSPFallbackChain"):
+        return getattr(resilience, name)
     raise AttributeError(name)

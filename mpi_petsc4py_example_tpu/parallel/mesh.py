@@ -23,7 +23,41 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..resilience import faults as _faults
+
+# jax.shard_map stabilized at top level (with ``check_vma``) in newer jax;
+# older versions only ship jax.experimental.shard_map.shard_map (with
+# ``check_rep``). Resolve once at import so solver program construction is
+# version-agnostic.
+jax_shard_map_stable = getattr(jax, "shard_map", None)
+if jax_shard_map_stable is not None:
+    _SHARD_MAP = jax_shard_map_stable
+else:
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP
+
 ROW_AXIS = "rows"
+
+
+def faulted_psum(x, axis: str):
+    """``lax.psum`` with the ``comm.psum`` fault point applied at TRACE
+    time (resilience/faults.py): 'drop' elides the reduction — every shard
+    keeps its local partial, a lost allreduce — and 'corrupt' poisons the
+    reduced value (NaN for inexact dtypes, bit-flip for integers). With no
+    fault plan armed this IS ``lax.psum``; programs traced while a psum
+    fault could fire are cache-isolated via ``faults.trace_key()`` in the
+    solver program cache key (solvers/krylov.py). The one injectable-psum
+    implementation — DeviceComm.psum and the solver-loop reductions both
+    route through it.
+    """
+    fault = _faults.triggered("comm.psum")
+    if fault is None:
+        return lax.psum(x, axis)
+    if fault.kind == "drop":
+        return x
+    y = lax.psum(x, axis)
+    if jnp.issubdtype(jnp.result_type(y), jnp.inexact):
+        return y * jnp.asarray(jnp.nan, jnp.result_type(y))
+    return ~y
 
 
 class DeviceComm:
@@ -113,6 +147,7 @@ class DeviceComm:
         reference's replicated-driver model); single-process uses one
         ``device_put``, multi-process builds the global array from the
         per-process addressable pieces."""
+        _faults.check("comm.put")     # injectable placement failure
         if not self.multiprocess:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_callback(arr.shape, sharding,
@@ -150,14 +185,29 @@ class DeviceComm:
         counts-correct ``Gatherv``+``bcast``). Single-process is one D2H
         copy; multi-process gathers the remote shards over DCN."""
         if not self.multiprocess or getattr(x, "is_fully_addressable", True):
-            return np.asarray(x)
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            out = np.asarray(x)
+        else:
+            from jax.experimental import multihost_utils
+            out = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        fault = _faults.triggered("comm.fetch")
+        if fault is not None:
+            if fault.kind == "unavailable":
+                raise fault.error()
+            out = out.copy()
+            if fault.kind == "drop":      # a lost gather contribution
+                out[...] = 0
+            elif out.size:                # 'corrupt': poison one element
+                flat = out.reshape(-1)
+                flat[0] = (np.nan if np.issubdtype(out.dtype, np.inexact)
+                           else ~flat[0])
+        return out
 
     # ---- collective helpers (usable INSIDE shard_map) ----------------------
     def psum(self, x):
-        """Sum across the mesh — the analog of ``MPI_Allreduce(SUM)``."""
-        return lax.psum(x, self.axis)
+        """Sum across the mesh — the analog of ``MPI_Allreduce(SUM)``.
+        Injectable via the ``comm.psum`` fault point (:func:`faulted_psum`).
+        """
+        return faulted_psum(x, self.axis)
 
     def pmax(self, x):
         return lax.pmax(x, self.axis)
@@ -179,8 +229,13 @@ class DeviceComm:
     # ---- SPMD program construction -----------------------------------------
     def shard_map(self, fn, in_specs, out_specs, check_vma: bool = False):
         """Wrap ``fn`` (written over *local* shards) as an SPMD program."""
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_vma)
+        if _SHARD_MAP is jax_shard_map_stable:
+            return _SHARD_MAP(fn, mesh=self.mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+        # pre-0.6 jax: the experimental entry point spells the replication
+        # check ``check_rep``
+        return _SHARD_MAP(fn, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
 
 
 def full_vector_local_apply(fn, comm: DeviceComm, n: int):

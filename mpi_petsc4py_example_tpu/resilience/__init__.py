@@ -1,0 +1,36 @@
+"""Resilient solves: fault injection, retry/backoff, checkpoint-resume,
+and graceful solver fallback (README "Resilience").
+
+Submodules:
+
+* :mod:`.faults` — deterministic fault-injection harness (named fault
+  points at the solve/communication boundaries; ``TPU_SOLVE_FAULTS`` env
+  spec or :func:`inject_faults` context manager);
+* :mod:`.retry` — :class:`RetryPolicy` + :func:`resilient_solve`
+  (checkpoint → backoff → rebuild → resume on retriable device failures);
+* :mod:`.fallback` — :class:`KSPFallbackChain` (method escalation on
+  breakdown/NaN, reduced-precision retry on device OOM).
+
+``faults`` is stdlib-only and imported eagerly (``parallel/mesh.py``
+depends on it); ``retry``/``fallback`` import solver machinery and load
+lazily to keep this package importable from anywhere in the framework.
+"""
+
+from . import faults
+from .faults import FaultSpecError, inject_faults
+
+__all__ = [
+    "faults", "inject_faults", "FaultSpecError",
+    "RetryPolicy", "resilient_solve", "default_checkpoint_path",
+    "KSPFallbackChain", "reduced_dtype",
+]
+
+
+def __getattr__(name):
+    if name in ("RetryPolicy", "resilient_solve", "default_checkpoint_path"):
+        from . import retry
+        return getattr(retry, name)
+    if name in ("KSPFallbackChain", "reduced_dtype"):
+        from . import fallback
+        return getattr(fallback, name)
+    raise AttributeError(name)

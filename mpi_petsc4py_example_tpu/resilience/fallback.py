@@ -1,0 +1,207 @@
+"""Graceful-degradation chains for Krylov solves.
+
+A Krylov breakdown (``DIVERGED_BREAKDOWN``) or a blown-up residual
+(``DIVERGED_NANORINF``) is not the end of the solve — it is a signal that
+the METHOD, not the problem, failed: CG on a matrix that turned out
+indefinite, BiCG hitting a serendipitous zero inner product. The
+:class:`KSPFallbackChain` escalates through progressively more robust
+methods (default ``cg → bcgs → gmres → preonly+lu``, the last being the
+direct path — device-dense or host-SuperLU via ``KSP._solve_hostlu`` —
+that cannot break down), restoring the pristine initial guess before each
+stage so a NaN-poisoned iterate never seeds the next method.
+
+``RESOURCE_EXHAUSTED`` device failures (``failure_class='oom'``) get the
+orthogonal degradation: retry the SAME method at reduced precision
+(float64→float32, complex128→complex64 — utils/dtypes.py), halving
+device-memory pressure at the cost of achievable tolerance.
+
+Every escalation is a :class:`utils.convergence.RecoveryEvent` on the
+returned result — the full trail of what was tried, in order, with the
+reason each stage failed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.convergence import ConvergedReason, RecoveryEvent, SolveResult
+from ..utils.errors import DeviceExecutionError
+
+# escalation order: each entry is (ksp_type, pc_type-or-None). None keeps
+# the chain owner's preconditioner.
+DEFAULT_ESCALATION = (("bcgs", None), ("gmres", None), ("preonly", "lu"))
+
+# reason codes that mean "the method broke, a stronger one may not"
+DEFAULT_ESCALATE_ON = (ConvergedReason.DIVERGED_BREAKDOWN,
+                       ConvergedReason.DIVERGED_NANORINF)
+
+_REDUCED = {np.dtype(np.float64): np.float32,
+            np.dtype(np.complex128): np.complex64}
+
+
+def reduced_dtype(dtype):
+    """The reduced-precision retry dtype, or None when already minimal."""
+    return _REDUCED.get(np.dtype(dtype))
+
+
+class KSPFallbackChain:
+    """Escalate a KSP solve through more robust methods on breakdown/NaN.
+
+    ``methods`` overrides the escalation stages (sequence of ``ksp_type``
+    strings or ``(ksp_type, pc_type)`` pairs, tried after the KSP's own
+    configuration); ``direct=False`` drops the terminal direct stage;
+    ``reduced_precision=False`` disables the oom→lower-precision retry;
+    ``escalate_on`` overrides the escalating reason codes.
+
+    The chain leaves the LAST WORKING configuration on the KSP
+    (``keep_working_config=True``, the default): staying degraded is the
+    point of graceful degradation — subsequent solves skip the broken
+    method. Set it False to restore the original type/pc after each call.
+    A reduced-precision recovery is the exception: it runs on a scratch
+    solver (the owner KSP's operators stay full-precision), so it is
+    per-solve, not sticky — but the converted operator and scratch KSP are
+    cached on the chain, so repeated oom recoveries pay the conversion
+    once.
+    """
+
+    def __init__(self, ksp, methods=None, *, direct: bool = True,
+                 reduced_precision: bool = True,
+                 escalate_on: tuple = DEFAULT_ESCALATE_ON,
+                 keep_working_config: bool = True):
+        self.ksp = ksp
+        self.reduced_precision = reduced_precision
+        self.escalate_on = tuple(escalate_on)
+        self.keep_working_config = keep_working_config
+        self._lo_cache = None          # (mat, ksp_type) -> scratch solver
+        if methods is None:
+            stages = [s for s in DEFAULT_ESCALATION
+                      if direct or s[0] != "preonly"]
+        else:
+            stages = [(m, None) if isinstance(m, str) else tuple(m)
+                      for m in methods]
+            if direct and all(t != "preonly" for t, _ in stages):
+                stages.append(("preonly", "lu"))
+        self.stages = tuple(stages)
+
+    # ---- internals ---------------------------------------------------------
+    def _solve_reduced(self, b, x, events, attempt):
+        """Retry the CURRENT configuration at reduced precision (the
+        RESOURCE_EXHAUSTED degradation). Returns a SolveResult or None
+        when no lower precision exists / operators are matrix-free. The
+        scratch solver runs on the chain, never on the owner KSP — its
+        converted operator is cached so repeated recoveries pay the
+        matrix conversion once."""
+        from ..core.mat import Mat
+        from ..core.vec import Vec
+        from ..solvers.ksp import KSP
+        ksp = self.ksp
+        mat = ksp.get_operators()[0]
+        rdt = reduced_dtype(mat.dtype)
+        if rdt is None or not hasattr(mat, "to_scipy"):
+            return None
+        comm = mat.comm
+        rdt = np.dtype(rdt)
+        events.append(RecoveryEvent(
+            kind="precision", attempt=attempt,
+            detail=f"{np.dtype(mat.dtype)}->{rdt}", error_class="oom"))
+        cache_token = (mat, ksp.get_type(), ksp.get_pc().get_type())
+        if self._lo_cache is not None and self._lo_cache[0] == cache_token:
+            sub = self._lo_cache[1]
+        else:
+            mat_lo = Mat.from_scipy(comm, mat.to_scipy().astype(rdt),
+                                    dtype=rdt)
+            sub = KSP().create(comm)
+            sub.set_operators(mat_lo)
+            sub.set_type(ksp.get_type())
+            sub.get_pc().set_type(ksp.get_pc().get_type())
+            self._lo_cache = (cache_token, sub)
+        # float32 cannot reach float64 tolerances: floor rtol at sqrt(eps)
+        rtol = max(ksp.rtol, float(np.sqrt(np.finfo(rdt).eps)))
+        sub.set_tolerances(rtol=rtol, atol=ksp.atol, divtol=ksp.divtol,
+                           max_it=ksp.max_it)
+        b_lo = Vec.from_global(comm, b.to_numpy().astype(rdt), dtype=rdt)
+        x_lo = Vec.from_global(comm, x.to_numpy().astype(rdt), dtype=rdt)
+        result = sub.solve(b_lo, x_lo)
+        x.set_global(x_lo.to_numpy().astype(
+            np.dtype(str(mat.dtype)), copy=False))
+        return result
+
+    # ---- solve -------------------------------------------------------------
+    def solve(self, b, x) -> SolveResult:
+        """Solve ``A x = b``, escalating until a method converges or the
+        chain is exhausted. The last stage's result is returned either
+        way, carrying the full ``recovery_events`` trail."""
+        ksp = self.ksp
+        config0 = (ksp.get_type(), ksp.get_pc().get_type())
+        # pristine initial guess: restored before every escalation so a
+        # poisoned iterate never seeds the next method
+        x0_data = x.data
+        events: list[RecoveryEvent] = []
+        # stage dedup happens at SOLVE time against the KSP's current type:
+        # after a kept escalation (say cg->bcgs), the next call must not
+        # try bcgs twice
+        plan = ((config0[0], None),) + tuple(
+            s for s in self.stages if s[0] != config0[0])
+        attempt = 0
+        result = None
+        tried_precision = False
+        precision_success = False
+        last_config = config0 + (None,)
+        try:
+            for ksp_type, pc_type in plan:
+                attempt += 1
+                if attempt > 1:
+                    x.data = x0_data
+                ksp.set_type(ksp_type)
+                if pc_type is not None:
+                    ksp.get_pc().set_type(pc_type)
+                last_config = (ksp_type, pc_type or config0[1], None)
+                try:
+                    result = ksp.solve(b, x)
+                except DeviceExecutionError as exc:
+                    if (exc.failure_class == "oom" and self.reduced_precision
+                            and not tried_precision):
+                        tried_precision = True
+                        result = self._solve_reduced(b, x, events, attempt)
+                        if result is not None and result.converged:
+                            precision_success = True
+                            last_config = (ksp_type, last_config[1],
+                                           "reduced-precision")
+                            break
+                        if result is not None:
+                            continue
+                    if attempt >= len(plan):
+                        raise
+                    events.append(RecoveryEvent(
+                        kind="fallback", attempt=attempt,
+                        detail=f"{ksp_type}: {exc.failure_class} "
+                               "device failure",
+                        error_class=exc.failure_class))
+                    continue
+                if result.reason not in self.escalate_on:
+                    break
+                if attempt < len(plan):
+                    events.append(RecoveryEvent(
+                        kind="fallback", attempt=attempt,
+                        detail=f"{ksp_type}->{plan[attempt][0]}",
+                        error_class=ConvergedReason.name(result.reason),
+                        iterations=result.iterations))
+        finally:
+            # restore the owner's configuration on EVERY exit that did not
+            # end on a genuinely working config — including a raising last
+            # stage (the caller's KSP must never stay pinned to a stage
+            # that failed). A reduced-precision success lives on the
+            # scratch solver, not the owner, so it restores too.
+            if not self.keep_working_config or precision_success or (
+                    result is None or not result.converged):
+                ksp.set_type(config0[0])
+                ksp.get_pc().set_type(config0[1])
+        if result is None:      # every stage raised; unreachable normally
+            raise DeviceExecutionError(
+                "KSPFallbackChain", RuntimeError("all stages failed"))
+        result.attempts = attempt
+        result.recovery_events = events
+        # (type, pc, note): the configuration that produced the returned
+        # result; note='reduced-precision' marks the scratch-solver path
+        self.last_config = last_config
+        return result

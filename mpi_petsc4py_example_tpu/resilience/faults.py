@@ -1,0 +1,292 @@
+"""Deterministic fault injection at the framework's solve boundaries.
+
+The resilience layer (retry/backoff, checkpoint-resume, fallback chains)
+is only trustworthy if its recovery paths can be EXERCISED — so the
+framework carries named fault points at its solve and communication
+boundaries that synthetic, reproducible faults can be attached to:
+
+* raise ``XlaRuntimeError``-shaped device failures (``unavailable``: the
+  worker-crash signature; ``oom``: RESOURCE_EXHAUSTED) exactly where real
+  ones surface, so :func:`utils.errors.wrap_device_errors` classifies them
+  identically;
+* poison a solve's residual with NaN/Inf "at iteration k" (``nan``/``inf``
+  at ``ksp.result`` — the DIVERGED_NANORINF / fallback-chain trigger);
+* drop or corrupt a collective (``comm.psum`` at trace time, ``comm.fetch``
+  / ``comm.put`` at the host boundary).
+
+Activation — spec string via either route::
+
+    with inject_faults("ksp.program=unavailable:iter=5"):
+        resilient_solve(ksp, b, x)                 # context manager
+
+    TPU_SOLVE_FAULTS="ksp.solve=oom" python driver.py   # environment
+
+Spec grammar (comma-separated clauses)::
+
+    clause := point '=' kind (':' param '=' value)*
+    point  := one of FAULT_POINTS
+    kind   := unavailable | oom | nan | inf | drop | corrupt
+    params := at=N      trigger on the Nth hit of the point (default 1)
+              times=M   stay armed for M consecutive hits ('*' = forever)
+              iter=K    simulated crash/poison iteration (ksp.program /
+                        ksp.result: the partial iterate of K real device
+                        iterations survives, as after a worker crash)
+              seed=S    seeded schedule: instead of at/times, each hit
+              prob=P    fires independently with probability P drawn from
+                        random.Random(S) — reproducible across runs
+
+Every fault is deterministic: hit counters and seeded RNG streams are
+per-clause, so a test that injects ``at=2:times=1`` sees exactly the
+second hit fail and nothing else, every run. With no spec active every
+fault point is a near-no-op (one module attribute check — zero device
+work, zero extra XLA programs).
+
+This module is stdlib-only and imported by ``parallel/mesh.py`` — it must
+never import jax or other framework modules.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+
+# Registry of named fault points and the fault kinds each supports.
+# tpslint TPS012 (ROADMAP, deferred) will check call sites against this.
+FAULT_POINTS = {
+    "ksp.solve":   ("unavailable", "oom"),   # KSP.solve entry (all paths)
+    "ksp.program": ("unavailable", "oom"),   # around the compiled solve
+    "ksp.result":  ("nan", "inf"),           # poison the fetched residual
+    "eps.solve":   ("unavailable", "oom"),   # EPS.solve entry
+    "comm.put":    ("unavailable", "oom"),   # device_put data placement
+    "comm.fetch":  ("unavailable", "drop", "corrupt"),  # host gather
+    "comm.psum":   ("drop", "corrupt"),      # traced in-program collective
+}
+
+RAISING_KINDS = ("unavailable", "oom")
+
+_KIND_MESSAGES = {
+    "unavailable": ("UNAVAILABLE: TPU worker process crashed (injected "
+                    "fault at {point!r})"),
+    "oom": ("RESOURCE_EXHAUSTED: Out of memory while running program "
+            "(injected fault at {point!r})"),
+}
+
+
+class XlaRuntimeError(RuntimeError):
+    """Synthetic device failure. Deliberately NAMED like the real jaxlib
+    error so :func:`utils.errors.wrap_device_errors` — which classifies by
+    type NAME, never by type identity — wraps injected faults through the
+    exact code path real device failures take."""
+
+
+class FaultSpecError(ValueError):
+    """A malformed ``TPU_SOLVE_FAULTS`` / ``inject_faults`` spec."""
+
+
+class Fault:
+    """One parsed fault clause with its own deterministic trigger state."""
+
+    def __init__(self, point: str, kind: str, at: int = 1, times: int = 1,
+                 forever: bool = False, iter_k: int | None = None,
+                 seed: int | None = None, prob: float = 1.0):
+        self.point = point
+        self.kind = kind
+        self.at = at
+        self.times = times
+        self.forever = forever
+        self.iter_k = iter_k
+        self.prob = prob
+        self._rng = random.Random(seed) if seed is not None else None
+        self.hits = 0      # times the point was reached
+        self.fired = 0     # times this fault actually triggered
+
+    def check(self) -> bool:
+        """Count one hit of the point; True when the fault triggers."""
+        self.hits += 1
+        if self._rng is not None:
+            fire = self._rng.random() < self.prob
+        else:
+            fire = (self.hits >= self.at
+                    and (self.forever or self.hits < self.at + self.times))
+        if fire:
+            self.fired += 1
+        return fire
+
+    def spent(self) -> bool:
+        """True when no FUTURE hit can fire (counter window passed).
+        Seeded and ``times=*`` schedules are never spent."""
+        return (self._rng is None and not self.forever
+                and self.hits >= self.at + self.times - 1)
+
+    def error(self) -> XlaRuntimeError:
+        return XlaRuntimeError(
+            _KIND_MESSAGES[self.kind].format(point=self.point))
+
+    def __repr__(self):
+        sched = (f"seed prob={self.prob}" if self._rng is not None else
+                 f"at={self.at} times={'*' if self.forever else self.times}")
+        return (f"Fault({self.point}={self.kind}, {sched}, "
+                f"hits={self.hits}, fired={self.fired})")
+
+
+def _parse_clause(clause: str) -> Fault:
+    head, _, tail = clause.partition(":")
+    point, eq, kind = head.partition("=")
+    point, kind = point.strip(), kind.strip()
+    if not eq or not point or not kind:
+        raise FaultSpecError(
+            f"fault clause {clause!r}: expected '<point>=<kind>[:k=v...]'")
+    if point not in FAULT_POINTS:
+        raise FaultSpecError(
+            f"unknown fault point {point!r}; known: {sorted(FAULT_POINTS)}")
+    if kind not in FAULT_POINTS[point]:
+        raise FaultSpecError(
+            f"fault point {point!r} supports kinds {FAULT_POINTS[point]}, "
+            f"not {kind!r}")
+    kw = {}
+    for param in filter(None, (p.strip() for p in tail.split(":"))):
+        key, eq, value = param.partition("=")
+        if not eq:
+            raise FaultSpecError(
+                f"fault clause {clause!r}: parameter {param!r} is not "
+                "'key=value'")
+        try:
+            if key == "at":
+                kw["at"] = int(value)
+            elif key == "times":
+                if value == "*":
+                    kw["forever"] = True
+                else:
+                    kw["times"] = int(value)
+            elif key == "iter":
+                kw["iter_k"] = int(value)
+            elif key == "seed":
+                kw["seed"] = int(value)
+            elif key == "prob":
+                kw["prob"] = float(value)
+            else:
+                raise FaultSpecError(
+                    f"fault clause {clause!r}: unknown parameter {key!r} "
+                    "(have: at, times, iter, seed, prob)")
+        except ValueError as e:
+            if isinstance(e, FaultSpecError):
+                raise
+            raise FaultSpecError(
+                f"fault clause {clause!r}: bad value for {key!r}: {e}") from e
+    if "prob" in kw and "seed" not in kw:
+        raise FaultSpecError(
+            f"fault clause {clause!r}: prob= needs seed= (schedules must "
+            "be reproducible)")
+    return Fault(point, kind, **kw)
+
+
+def parse_spec(spec: str) -> list[Fault]:
+    """Parse a full fault spec into armed :class:`Fault` clauses."""
+    faults = [_parse_clause(c.strip())
+              for c in spec.split(",") if c.strip()]
+    if not faults:
+        raise FaultSpecError(f"empty fault spec {spec!r}")
+    return faults
+
+
+# ---- active plan ----------------------------------------------------------
+# _UNSET: the env var has not been consulted yet. None: no faults active.
+_UNSET = object()
+_PLAN = _UNSET
+_LOCK = threading.Lock()
+_TRACE_NONCE = 0
+
+
+def _active_plan():
+    global _PLAN
+    if _PLAN is _UNSET:
+        with _LOCK:
+            if _PLAN is _UNSET:
+                spec = os.environ.get("TPU_SOLVE_FAULTS", "").strip()
+                _PLAN = parse_spec(spec) if spec else None
+    return _PLAN
+
+
+def active() -> bool:
+    """Whether any fault plan is armed (env var or context manager)."""
+    return _active_plan() is not None
+
+
+def reset():
+    """Forget the cached env-var plan (re-read on next fault-point hit)."""
+    global _PLAN
+    with _LOCK:
+        _PLAN = _UNSET
+
+
+@contextlib.contextmanager
+def inject_faults(spec: str):
+    """Arm a fault plan for the duration of the block (replaces any
+    env-var plan; restores it after). Yields the parsed fault list so
+    tests can assert on ``hits``/``fired`` counters."""
+    global _PLAN
+    plan = parse_spec(spec)
+    with _LOCK:
+        saved, _PLAN = _PLAN, plan
+    try:
+        yield plan
+    finally:
+        with _LOCK:
+            _PLAN = saved
+
+
+def triggered(point: str):
+    """Hot-path hook: count a hit of ``point`` against the active plan.
+
+    Returns the :class:`Fault` that fired (the call site applies its
+    effect — raise, poison, drop) or None. Near-no-op when no plan is
+    armed.
+    """
+    plan = _active_plan()
+    if plan is None:
+        return None
+    with _LOCK:
+        for fault in plan:
+            if fault.point == point and fault.check():
+                return fault
+    return None
+
+
+def check(point: str):
+    """Raising-kind fault points: raise the synthetic device error if a
+    fault fires at ``point`` (no-op otherwise)."""
+    fault = triggered(point)
+    if fault is not None and fault.kind in RAISING_KINDS:
+        raise fault.error()
+
+
+# fault points whose effect applies while a program is being TRACED (and
+# therefore bakes into the compiled artifact, demanding cache isolation)
+TRACE_TIME_POINTS = ("comm.psum",)
+
+
+def trace_key():
+    """Cache-key token for compiled-program caches (krylov._PROGRAM_CACHE).
+
+    None when no plan is armed — keys, and therefore program reuse, are
+    byte-identical to a fault-free build. None ALSO when the armed plan
+    has no live trace-time fault (host-boundary kinds like ``ksp.result``,
+    or a ``comm.psum`` clause whose trigger window has passed): those
+    cannot bake into a jaxpr, so a long-running driver under
+    ``TPU_SOLVE_FAULTS`` keeps normal program caching. Otherwise a fresh
+    nonce per call: a program traced while a collective fault could fire
+    must never be cached-shared with — or survive into — fault-free
+    solves.
+    """
+    global _TRACE_NONCE
+    plan = _active_plan()
+    if plan is None:
+        return None
+    with _LOCK:
+        if not any(f.point in TRACE_TIME_POINTS and not f.spent()
+                   for f in plan):
+            return None
+        _TRACE_NONCE += 1
+        return _TRACE_NONCE

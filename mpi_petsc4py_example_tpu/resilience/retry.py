@@ -1,0 +1,149 @@
+"""Retry/backoff with checkpoint-resume around the KSP solve boundary.
+
+The reference's failure story is an opaque ``MPI_Abort``; here a retriable
+device failure (a TPU worker crash/restart — ``DeviceExecutionError`` with
+``failure_class='unavailable'``) mid-solve is recovered instead of fatal:
+
+1. the best iterate reached so far (the solve boundary restores partial
+   state, see ``ksp.program`` in resilience/faults.py) is CHECKPOINTED with
+   :func:`utils.checkpoint.save_solve_state` — atomic, elastic across mesh
+   sizes;
+2. the policy's deterministic exponential backoff waits out the worker
+   restart (sleeps run on HOST, outside any traced program — tpslint
+   TPS001 stays clean by construction);
+3. operators are REBUILT from the checkpoint (fresh device buffers — stale
+   buffers on a restarted worker are exactly what must not be trusted) and
+   the solve RESUMES from the restored iterate via
+   ``set_initial_guess_nonzero(True)``, converging in the iterations the
+   crash left over rather than starting cold.
+
+Every action is recorded as a :class:`utils.convergence.RecoveryEvent` on
+the returned result's ``recovery_events`` trail.
+
+With no failure, :func:`resilient_solve` is exactly one ``ksp.solve`` —
+same compiled program, zero extra XLA programs, zero device round trips.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+
+from ..utils.checkpoint import load_solve_state, save_solve_state
+from ..utils.convergence import RecoveryEvent, SolveResult
+from ..utils.errors import DeviceExecutionError
+
+
+@dataclass
+class RetryPolicy:
+    """When and how to retry a failed solve.
+
+    Delays are exponential (``base_delay * backoff_factor**retry``) capped
+    at ``max_delay`` — and DETERMINISTIC by default (``jitter=0``): tests
+    assert exact backoff sequences. Production fleets that need
+    thundering-herd protection set ``jitter`` (a fraction of the delay,
+    drawn reproducibly from ``jitter_seed``).
+
+    ``retriable_classes`` keys off ``DeviceExecutionError.failure_class``
+    (utils/errors.FAILURE_CLASSES): only 'unavailable' is retriable as-is;
+    'oom' needs a cheaper configuration (the fallback chain's
+    reduced-precision move, resilience/fallback.py), and 'callback' /
+    'unsupported' cannot succeed on retry at all.
+    """
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    backoff_factor: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.0
+    jitter_seed: int = 0
+    retriable_classes: tuple = ("unavailable",)
+    sleep: object = time.sleep     # injectable for tests (recorded delays)
+
+    def delay(self, retry_index: int) -> float:
+        """Backoff before retry ``retry_index`` (0-based)."""
+        d = min(self.base_delay * self.backoff_factor ** retry_index,
+                self.max_delay)
+        if self.jitter:
+            import random
+            rng = random.Random((self.jitter_seed, retry_index))
+            d *= 1.0 + self.jitter * rng.random()
+        return d
+
+    def should_retry(self, exc: Exception) -> bool:
+        return (isinstance(exc, DeviceExecutionError)
+                and exc.failure_class in self.retriable_classes)
+
+
+def default_checkpoint_path(ksp=None) -> str:
+    """Default solve-state checkpoint path, unique per process AND per
+    solver object — concurrent resilient solves in one process must never
+    restore each other's operators from a shared file."""
+    tag = f"_{id(ksp):x}" if ksp is not None else ""
+    return os.path.join(tempfile.gettempdir(),
+                        f"tpu_solve_ckpt_{os.getpid()}{tag}.npz")
+
+
+def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
+                    checkpoint_path: str | None = None) -> SolveResult:
+    """``ksp.solve(b, x)`` that survives retriable device failures.
+
+    On a retriable ``DeviceExecutionError`` (per ``policy``), checkpoints
+    the best iterate, backs off, rebuilds the operators from the
+    checkpoint, and resumes from the restored iterate — up to
+    ``policy.max_attempts`` total attempts. Non-retriable failures and
+    exhausted policies re-raise the original error.
+
+    ``checkpoint_path`` defaults to :func:`default_checkpoint_path`.
+    Matrix-free operators (no ``to_scipy``) skip persistence — the retry
+    still resumes from the in-memory iterate.
+
+    Returns the converged attempt's :class:`SolveResult` with ``attempts``
+    and the ``recovery_events`` trail filled in.
+    """
+    policy = policy or RetryPolicy()
+    path = checkpoint_path or default_checkpoint_path(ksp)
+    events: list[RecoveryEvent] = []
+    guess_flag0 = ksp._initial_guess_nonzero
+    attempt = 1
+    try:
+        while True:
+            try:
+                result = ksp.solve(b, x)
+                break
+            except DeviceExecutionError as exc:
+                if (attempt >= policy.max_attempts
+                        or not policy.should_retry(exc)):
+                    raise
+                events.append(RecoveryEvent(
+                    kind="fault", attempt=attempt, detail=str(exc),
+                    error_class=exc.failure_class))
+                mat = ksp.get_operators()[0]
+                persisted = hasattr(mat, "to_scipy")
+                if persisted:
+                    save_solve_state(path, mat, x, b, iteration=0)
+                    events.append(RecoveryEvent(
+                        kind="checkpoint", attempt=attempt, detail=path))
+                delay = policy.delay(attempt - 1)
+                events.append(RecoveryEvent(
+                    kind="backoff", attempt=attempt, delay=delay,
+                    error_class=exc.failure_class))
+                policy.sleep(delay)
+                if persisted:
+                    # rebuild from the checkpoint: fresh device buffers
+                    # (nothing from before the failure is trusted), iterate
+                    # restored onto the CALLER's vector so x stays live
+                    mat2, x2, _b2, _it = load_solve_state(path, mat.comm)
+                    ksp.set_operators(mat2)
+                    x.data = x2.data
+                ksp.set_initial_guess_nonzero(True)
+                attempt += 1
+                events.append(RecoveryEvent(
+                    kind="resume", attempt=attempt,
+                    detail="initial_guess_nonzero from restored iterate"))
+    finally:
+        ksp.set_initial_guess_nonzero(guess_flag0)
+    result.attempts = attempt
+    result.recovery_events = events
+    return result

@@ -57,7 +57,9 @@ from jax.sharding import PartitionSpec as P
 from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
+from ..resilience import faults as _faults
 from ..utils.convergence import SolveResult
+from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
 from ..utils.dtypes import host_dtype, is_complex
 from ..utils.profiling import record_sync
@@ -1045,10 +1047,12 @@ class EPS:
         return np.argsort(-finite, kind="stable")
 
     # ---- solve --------------------------------------------------------------
+    @wrap_device_errors("EPSSolve")
     def solve(self):
         mat = self._mat
         if mat is None:
             raise RuntimeError("EPS.solve: no operators set")
+        _faults.check("eps.solve")    # injectable pre-solve device failure
         if self._bmat is not None and \
                 self._problem_type != EPSProblemType.GHEP:
             raise ValueError("two operators were set; problem type must be "

@@ -26,8 +26,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.spmv import ell_spmv_local
+from ..resilience import faults as _faults
 from ..utils.dtypes import is_complex
-from ..parallel.mesh import DeviceComm
+from ..parallel.mesh import DeviceComm, faulted_psum
 from ..utils.convergence import ConvergedReason as CR
 
 
@@ -35,6 +36,13 @@ from ..utils.convergence import ConvergedReason as CR
 # kernel bodies: (A, M, pdot, pnorm, b, x0, rtol, atol, maxit) ->
 #                (x, iters, rnorm, reason)
 # ---------------------------------------------------------------------------
+
+# The solver-loop reductions route through the injectable psum (the
+# ``comm.psum`` fault point, parallel/mesh.faulted_psum). The
+# true-residual verification epilogue stays on plain lax.psum on purpose —
+# a corrupted verifier would make the gate lie about recovery.
+_psum = faulted_psum
+
 
 def _dmax(rnorm0, dtol):
     """Divergence ceiling: ``dtol * rnorm0`` — the INITIAL residual norm, as
@@ -159,7 +167,16 @@ def live_monitor_supported(comm=None) -> bool:
     shard_map (verified: one call per device per record, in order). Gates
     on the SOLVE MESH's platform, not the process default backend — a
     CPU-device mesh in a TPU-capable process still streams.
+
+    On pre-stable-shard_map jax (no ``jax.shard_map``), an ``io_callback``
+    inside the experimental shard_map trips an XLA sharding-propagation
+    CHECK failure — a HARD PROCESS ABORT, not a catchable error — so the
+    capability cannot be probed and is version-gated off; those runtimes
+    get the always-correct buffered replay.
     """
+    from ..parallel.mesh import jax_shard_map_stable
+    if jax_shard_map_stable is None:
+        return False
     if comm is not None:
         return comm.devices[0].platform == "cpu"
     import jax
@@ -1863,10 +1880,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     cap_k = int(hist_cap) if monitored else 0
     live_k = bool(live) and monitored
     true_res_k = bool(true_res)
+    # fault-injection isolation: _faults.trace_key() is None with no plan
+    # armed (keys identical to a fault-free build, full reuse); with a plan
+    # armed it is a fresh nonce, so a program traced under injection (e.g.
+    # a corrupted comm.psum baked into the jaxpr) is never cached into —
+    # or served from — the fault-free program set.
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k,
-           true_res_k)
+           true_res_k, _faults.trace_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1936,9 +1958,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             # product; norms take the real part (vdot(u,u) carries a ~0
             # imaginary component for complex dtypes) so every kernel's
             # convergence scalar stays real-typed
-            pdot = lambda u, v: lax.psum(jnp.vdot(u, v), axis)
-            pnorm = lambda u: jnp.sqrt(jnp.real(lax.psum(jnp.vdot(u, u),
-                                                         axis)))
+            pdot = lambda u, v: _psum(jnp.vdot(u, v), axis)
+            pnorm = lambda u: jnp.sqrt(jnp.real(_psum(jnp.vdot(u, u),
+                                                      axis)))
             kw = {"monitor": monitor} if monitor is not None else {}
             kw["dtol"] = dtol
             if natural_k:
@@ -1950,8 +1972,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # 3D-carry variant: the stencil path is real-dtype, so the
                 # reductions are plain sums (see cg_stencil_kernel docstring
                 # for why the grid shape is kept through the loop)
-                pdot3 = lambda u, v: lax.psum(jnp.sum(u * v), axis)
-                pnorm3 = lambda u: jnp.sqrt(lax.psum(jnp.sum(u * u), axis))
+                pdot3 = lambda u, v: _psum(jnp.sum(u * v), axis)
+                pnorm3 = lambda u: jnp.sqrt(_psum(jnp.sum(u * u), axis))
                 if pc_apply3 is not None:
                     kw["M3"] = lambda r: pc_apply3(pc_arrays, r)
                 return cg_stencil_kernel(
@@ -1964,8 +1986,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 kw["restart"] = restart
                 # conj for complex-correct basis projections (identity on
                 # real dtypes, where XLA elides it)
-                kw["pmatdot"] = lambda Vb, w: lax.psum(jnp.conj(Vb) @ w,
-                                                       axis)
+                kw["pmatdot"] = lambda Vb, w: _psum(jnp.conj(Vb) @ w,
+                                                    axis)
                 if ksp_type == "lgmres":
                     kw["aug"] = aug
             elif ksp_type == "bcgsl":
@@ -1977,8 +1999,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 kw["refine"] = pc.kind in ("lu", "crtri", "crband")
             elif ksp_type in ("pipecg", "fbcgsr"):
                 # the whole point: all per-iteration dots in ONE fused psum
-                kw["preduce"] = lambda *parts: lax.psum(jnp.stack(parts),
-                                                        axis)
+                kw["preduce"] = lambda *parts: _psum(jnp.stack(parts),
+                                                     axis)
             elif ksp_type in _NEEDS_TRANSPOSE:
                 # the adjoint of the projected operator v -> P(Av) is
                 # w -> A^T(Pw): project BEFORE the transpose product (P is

@@ -27,6 +27,7 @@ import numpy as np
 from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import as_comm
+from ..resilience import faults as _faults
 from ..utils.convergence import ConvergedReason, SolveResult
 from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
@@ -378,6 +379,7 @@ class KSP:
         mat = self._mat
         if mat is None:
             raise RuntimeError("KSP.solve: no operators set")
+        _faults.check("ksp.solve")    # injectable pre-solve device failure
         self._check_norm_type()
         self.set_up()
         comm = mat.comm
@@ -469,6 +471,22 @@ class KSP:
         dt = np.dtype(op_dt.type(0).real.dtype)
         ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
+        # fault point 'ksp.program': a simulated worker crash DURING the
+        # compiled solve. With iter=K the crash leaves real partial state —
+        # the same cached program truncated to K iterations (max_it is a
+        # runtime scalar, so no recompile) writes the iteration-K iterate
+        # into x before the synthetic failure, exactly what a checkpoint
+        # after a real mid-solve crash would hold (resilience/retry.py
+        # resumes from it).
+        fault = _faults.triggered("ksp.program")
+        if fault is not None:
+            if fault.iter_k:
+                part = prog(mat.device_arrays(), pc.device_arrays(),
+                            *ns_args, b.data, x.data,
+                            dt.type(0.0), dt.type(0.0), dt.type(divtol),
+                            np.int32(min(int(fault.iter_k), self.max_it)))
+                x.data = part[0]
+            raise fault.error()
         # live mode: the in-program io_callback fires once per device per
         # record (replicated args); dispatch each NEW k to the monitors as
         # it arrives — k is monotone within a solve, so "k > max seen"
@@ -559,6 +577,23 @@ class KSP:
                     m(self, int(k_it) + _mon_offset, float(hist[k_it]))
         wall = time.perf_counter() - t0
         x.data = xd
+        # fault point 'ksp.result': poison the fetched residual norm — the
+        # deterministic stand-in for a recurrence blowing up at iteration
+        # iter=K (real blow-ups reach this same fetch carrying their NaN)
+        fault = _faults.triggered("ksp.result")
+        if fault is not None:
+            rnorm = float("nan") if fault.kind == "nan" else float("inf")
+            if fault.iter_k is not None:
+                iters = fault.iter_k
+        # a NaN/Inf residual must never slip past the convergence
+        # bookkeeping as a plausible exit code: NaN fails every `<= tol`
+        # comparison, so the kernel reports DIVERGED_MAX_IT — map it to
+        # PETSc's DIVERGED_NANORINF (-9) so callers (and the fallback
+        # chain, resilience/fallback.py) see the blow-up for what it is.
+        # KSP_NORM_NONE keeps PETSc semantics: no norm is monitored, so
+        # there is nothing to classify.
+        if not norm_none and not np.isfinite(rnorm):
+            reason = ConvergedReason.DIVERGED_NANORINF
         # breakdown stays visible (PETSc's NORM_NONE does not mask it);
         # every other exit is the fixed-iteration contract. An exactly-zero
         # residual (b = 0) still exits immediately — running further steps

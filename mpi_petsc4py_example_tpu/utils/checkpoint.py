@@ -6,9 +6,20 @@ save/load of Mat/Vec state. Shard layout is reconstructed from the target
 communicator at load time, so a checkpoint written on one mesh size restores
 cleanly onto another (the elastic-restart story: deterministic restart from
 persisted operator + best iterate).
+
+Crash-safety contract (the resilience layer depends on it,
+resilience/retry.py): every save writes to ``path + ".tmp"`` and
+``os.replace``\\ s it into place — a crash mid-checkpoint can never leave a
+truncated file at the final path — and every load VALIDATES structure,
+dtype, and shape consistency, raising :class:`ValueError` (never a bare
+``assert``, which vanishes under ``python -O``) on anything malformed.
 """
 
 from __future__ import annotations
+
+import contextlib
+import os
+import zipfile
 
 import numpy as np
 
@@ -17,55 +28,143 @@ from ..core.vec import Vec
 from ..parallel.mesh import as_comm
 
 
+def _npz_path(path) -> str:
+    """Normalize to the ``.npz`` name ``np.savez`` would have written."""
+    path = os.fspath(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _atomic_savez(path, **payload):
+    """Compressed savez through a temp file + atomic ``os.replace``."""
+    final = _npz_path(path)
+    tmp = final + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            # a file OBJECT suppresses numpy's implicit '.npz' suffixing,
+            # so the temp name stays exactly final + '.tmp'
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _check(cond: bool, path, what: str):
+    if not cond:
+        raise ValueError(f"invalid checkpoint {path!r}: {what}")
+
+
+@contextlib.contextmanager
+def _open_npz(path, want_kind: str):
+    """``np.load`` with truncation/corruption surfaced as ValueError."""
+    p = _npz_path(path)
+    try:
+        z = np.load(p)
+    except FileNotFoundError:
+        # a missing checkpoint is NOT corruption: callers' natural
+        # resume-if-exists pattern relies on telling the two apart
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError) as e:
+        raise ValueError(
+            f"invalid checkpoint {p!r}: unreadable or truncated ({e})") from e
+    try:
+        _check("kind" in z.files, p, "no 'kind' field — not a "
+               "checkpoint written by utils.checkpoint")
+        kind = str(z["kind"])
+        _check(kind == want_kind, p,
+               f"a {kind!r} checkpoint, expected {want_kind!r}")
+        yield z
+    finally:
+        z.close()
+
+
+def _checked_dtype(z, path) -> np.dtype:
+    _check("dtype" in z.files, path, "missing 'dtype'")
+    name = str(z["dtype"])
+    try:
+        return np.dtype(name)
+    except TypeError as e:
+        raise ValueError(
+            f"invalid checkpoint {path!r}: unknown dtype {name!r}") from e
+
+
+def _checked_csr(z, path):
+    """Validate the CSR triplet against the stored shape (a truncated or
+    tampered file fails HERE, loudly, instead of poisoning a resume)."""
+    for key in ("shape", "indptr", "indices", "data"):
+        _check(key in z.files, path, f"missing {key!r}")
+    shape = tuple(int(s) for s in z["shape"])
+    _check(len(shape) == 2 and shape[0] > 0 and shape[1] > 0, path,
+           f"bad matrix shape {shape}")
+    indptr, indices, data = z["indptr"], z["indices"], z["data"]
+    _check(indptr.ndim == 1 and indptr.shape[0] == shape[0] + 1, path,
+           f"indptr length {indptr.shape} does not match {shape[0]} rows")
+    _check(int(indptr[0]) == 0 and int(indptr[-1]) == indices.shape[0],
+           path, "indptr does not span the index array — truncated?")
+    _check(data.shape == indices.shape, path,
+           f"data/indices length mismatch ({data.shape} vs {indices.shape})")
+    _check(indices.size == 0
+           or (0 <= int(indices.min()) and int(indices.max()) < shape[1]),
+           path, "column indices out of range")
+    return shape, (indptr, indices, data)
+
+
 def save_vec(path: str, vec: Vec):
-    np.savez_compressed(path, kind="vec", n=vec.n,
-                        data=vec.to_numpy())
+    _atomic_savez(path, kind="vec", n=vec.n, data=vec.to_numpy())
 
 
 def load_vec(path: str, comm=None) -> Vec:
     comm = as_comm(comm)
-    with np.load(path) as z:
-        assert str(z["kind"]) == "vec", "not a Vec checkpoint"
-        return Vec.from_global(comm, z["data"])
+    with _open_npz(path, "vec") as z:
+        _check("data" in z.files and "n" in z.files, path, "missing data/n")
+        data = z["data"]
+        _check(data.ndim == 1 and data.shape[0] == int(z["n"]), path,
+               f"vector length {data.shape} does not match n={int(z['n'])}")
+        return Vec.from_global(comm, data)
 
 
 def save_mat(path: str, mat: Mat):
     """Persist as CSR (portable, layout-independent)."""
     A = mat.to_scipy().tocsr()
-    np.savez_compressed(path, kind="mat", shape=np.asarray(mat.shape),
-                        indptr=A.indptr, indices=A.indices, data=A.data,
-                        dtype=str(np.dtype(mat.dtype)))
+    _atomic_savez(path, kind="mat", shape=np.asarray(mat.shape),
+                  indptr=A.indptr, indices=A.indices, data=A.data,
+                  dtype=str(np.dtype(mat.dtype)))
 
 
 def load_mat(path: str, comm=None) -> Mat:
     comm = as_comm(comm)
-    with np.load(path) as z:
-        assert str(z["kind"]) == "mat", "not a Mat checkpoint"
-        shape = tuple(int(s) for s in z["shape"])
-        return Mat.from_csr(comm, shape,
-                            (z["indptr"], z["indices"], z["data"]),
-                            dtype=np.dtype(str(z["dtype"])))
+    with _open_npz(path, "mat") as z:
+        dtype = _checked_dtype(z, path)
+        shape, csr = _checked_csr(z, path)
+        return Mat.from_csr(comm, shape, csr, dtype=dtype)
 
 
 def save_solve_state(path: str, mat: Mat, x: Vec, b: Vec, iteration: int = 0):
     """One-file checkpoint of an in-progress solve (operator, iterate, rhs)."""
     A = mat.to_scipy().tocsr()
-    np.savez_compressed(path, kind="solve_state",
-                        shape=np.asarray(mat.shape), indptr=A.indptr,
-                        indices=A.indices, data=A.data,
-                        dtype=str(np.dtype(mat.dtype)),
-                        x=x.to_numpy(), b=b.to_numpy(),
-                        iteration=iteration)
+    _atomic_savez(path, kind="solve_state",
+                  shape=np.asarray(mat.shape), indptr=A.indptr,
+                  indices=A.indices, data=A.data,
+                  dtype=str(np.dtype(mat.dtype)),
+                  x=x.to_numpy(), b=b.to_numpy(),
+                  iteration=int(iteration))
 
 
 def load_solve_state(path: str, comm=None):
     comm = as_comm(comm)
-    with np.load(path) as z:
-        assert str(z["kind"]) == "solve_state", "not a solve-state checkpoint"
-        shape = tuple(int(s) for s in z["shape"])
-        mat = Mat.from_csr(comm, shape,
-                           (z["indptr"], z["indices"], z["data"]),
-                           dtype=np.dtype(str(z["dtype"])))
-        x = Vec.from_global(comm, z["x"], dtype=mat.dtype)
-        b = Vec.from_global(comm, z["b"], dtype=mat.dtype)
+    with _open_npz(path, "solve_state") as z:
+        dtype = _checked_dtype(z, path)
+        shape, csr = _checked_csr(z, path)
+        for key in ("x", "b", "iteration"):
+            _check(key in z.files, path, f"missing {key!r}")
+        xh, bh = z["x"], z["b"]
+        _check(xh.ndim == 1 and xh.shape[0] == shape[0], path,
+               f"iterate length {xh.shape} does not match n={shape[0]}")
+        _check(bh.ndim == 1 and bh.shape[0] == shape[0], path,
+               f"rhs length {bh.shape} does not match n={shape[0]}")
+        mat = Mat.from_csr(comm, shape, csr, dtype=dtype)
+        x = Vec.from_global(comm, xh, dtype=mat.dtype)
+        b = Vec.from_global(comm, bh, dtype=mat.dtype)
         return mat, x, b, int(z["iteration"])

@@ -21,11 +21,13 @@ class ConvergedReason:
     DIVERGED_MAX_IT = -3
     DIVERGED_DTOL = -4
     DIVERGED_BREAKDOWN = -5
+    DIVERGED_NANORINF = -9
 
     _NAMES = {
         2: "CONVERGED_RTOL", 3: "CONVERGED_ATOL", 4: "CONVERGED_ITS",
         0: "ITERATING", -2: "DIVERGED_NULL", -3: "DIVERGED_MAX_IT",
         -4: "DIVERGED_DTOL", -5: "DIVERGED_BREAKDOWN",
+        -9: "DIVERGED_NANORINF",
     }
 
     @classmethod
@@ -34,13 +36,45 @@ class ConvergedReason:
 
 
 @dataclass
+class RecoveryEvent:
+    """One entry in a resilient solve's recovery trail (resilience/).
+
+    The retry wrapper and the fallback chain record exactly what they did —
+    checkpoint written, backoff slept, solve resumed, method escalated,
+    precision reduced — so drivers and tests can assert on the recovery
+    path instead of inferring it from logs.
+    """
+    kind: str            # 'fault' | 'checkpoint' | 'backoff' | 'resume'
+                         # | 'fallback' | 'precision'
+    attempt: int         # 1-based attempt number the event belongs to
+    detail: str = ""     # specifics: checkpoint path, 'cg->bcgs', dtypes, …
+    error_class: str = ""  # DeviceExecutionError.failure_class or reason name
+    delay: float = 0.0   # seconds slept ('backoff' events)
+    iterations: int = 0  # iterations completed when the event fired
+
+    def __repr__(self):
+        extra = f", delay={self.delay:g}s" if self.kind == "backoff" else ""
+        return (f"RecoveryEvent({self.kind}, attempt={self.attempt}, "
+                f"{self.detail or self.error_class}{extra})")
+
+
+@dataclass
 class SolveResult:
-    """What a KSP/EPS solve reports (iterations, residual, reason, timing)."""
+    """What a KSP/EPS solve reports (iterations, residual, reason, timing).
+
+    ``attempts``/``recovery_events`` form the structured resilience trail:
+    a plain solve reports ``attempts=1`` with an empty trail; solves driven
+    through :func:`resilience.resilient_solve` or a
+    :class:`resilience.KSPFallbackChain` carry one :class:`RecoveryEvent`
+    per recovery action taken.
+    """
     iterations: int = 0
     residual_norm: float = 0.0
     reason: int = ConvergedReason.ITERATING
     wall_time: float = 0.0
     history: list = field(default_factory=list)
+    attempts: int = 1
+    recovery_events: list = field(default_factory=list)
 
     @property
     def converged(self) -> bool:
@@ -51,6 +85,10 @@ class SolveResult:
         return ConvergedReason.name(self.reason)
 
     def __repr__(self):
+        recov = ""
+        if self.attempts > 1 or self.recovery_events:
+            recov = (f", attempts={self.attempts}, "
+                     f"{len(self.recovery_events)} recovery events")
         return (f"SolveResult(iters={self.iterations}, "
                 f"rnorm={self.residual_norm:.3e}, {self.reason_name}, "
-                f"{self.wall_time*1e3:.1f} ms)")
+                f"{self.wall_time*1e3:.1f} ms{recov})")

@@ -3,43 +3,87 @@
 In the reference, any rank failure kills the mpirun job with an opaque MPI
 abort. Here device-side failures (XLA compile errors, TPU worker crashes,
 ICI faults) are caught at the solve boundary and re-raised as
-:class:`DeviceExecutionError` with actionable context — including whether
-the error signature matches a known environment failure mode (remote TPU
-worker crash/restart), so callers can checkpoint and retry deterministically
-(utils/checkpoint.py).
+:class:`DeviceExecutionError` with actionable context — including a
+structured ``failure_class`` and ``retriable`` flag, so the resilience
+layer (resilience/retry.py) can decide per class whether to checkpoint and
+retry (``unavailable``: the worker comes back), degrade (``oom``: retry at
+reduced precision — resilience/fallback.py), or surface the error
+(``callback``/``unsupported``: retrying cannot help).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FailureClass:
+    """One recognized device-failure signature and its recovery contract.
+
+    Markers match case-sensitively, except all-lowercase markers which
+    match against the lowercased message (so 'not implemented' catches
+    'Not Implemented' while 'LuDecomposition' stays exact)."""
+    name: str
+    markers: tuple          # substrings of the runtime error that match it
+    hint: str               # actionable guidance, included in the message
+    retriable: bool         # a plain retry (same config) can succeed
+
+    def matches(self, message: str, lowered: str) -> bool:
+        return any(m in (lowered if m == m.lower() else message)
+                   for m in self.markers)
+
+
+# Ordered: the first matching class is the PRIMARY classification
+# (DeviceExecutionError.failure_class); every matching class contributes
+# its hint. The README "Resilience" table is generated from this registry.
+FAILURE_CLASSES = (
+    FailureClass(
+        "unavailable", ("worker process crashed", "UNAVAILABLE"),
+        "the TPU worker crashed or restarted — the device may be "
+        "unavailable for a while; checkpoint state "
+        "(utils.checkpoint.save_solve_state) and retry, or fall "
+        "back to the CPU mesh", retriable=True),
+    FailureClass(
+        "oom", ("RESOURCE_EXHAUSTED", "Out of memory"),
+        "device memory exhausted — shard over more devices, use "
+        "fp32/bf16, or the matrix-free stencil path", retriable=False),
+    FailureClass(
+        "callback", ("host send/recv callbacks", "debug.callback"),
+        "this runtime does not support in-program host callbacks "
+        "(jax.debug.callback/io_callback) — the framework's own "
+        "monitors use an in-program history buffer instead, so "
+        "this came from user code; remove the callback", retriable=False),
+    FailureClass(
+        "unsupported", ("LuDecomposition", "not implemented"),
+        "an op is unsupported on this backend/dtype — direct "
+        "factorizations must stay on host (see solvers/pc.py)",
+        retriable=False),
+)
+
+
+def classify_failure(message: str) -> list[FailureClass]:
+    """Every :data:`FAILURE_CLASSES` entry whose signature matches."""
+    lowered = message.lower()
+    return [fc for fc in FAILURE_CLASSES if fc.matches(message, lowered)]
+
 
 class DeviceExecutionError(RuntimeError):
-    """A device-side failure during a solve, with recovery guidance."""
+    """A device-side failure during a solve, with recovery guidance.
+
+    ``failure_class`` is the primary classification name ('unavailable',
+    'oom', 'callback', 'unsupported', or 'unknown') and ``retriable``
+    whether a plain same-configuration retry can succeed — the knobs
+    :class:`resilience.RetryPolicy` keys off.
+    """
 
     def __init__(self, what: str, original: Exception):
         self.original = original
         msg = str(original)
-        hints = []
-        if "worker process crashed" in msg or "UNAVAILABLE" in msg:
-            hints.append(
-                "the TPU worker crashed or restarted — the device may be "
-                "unavailable for a while; checkpoint state "
-                "(utils.checkpoint.save_solve_state) and retry, or fall "
-                "back to the CPU mesh")
-        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
-            hints.append(
-                "device memory exhausted — shard over more devices, use "
-                "fp32/bf16, or the matrix-free stencil path")
-        if "host send/recv callbacks" in msg or "debug.callback" in msg:
-            hints.append(
-                "this runtime does not support in-program host callbacks "
-                "(jax.debug.callback/io_callback) — the framework's own "
-                "monitors use an in-program history buffer instead, so "
-                "this came from user code; remove the callback")
-        if "LuDecomposition" in msg or "not implemented" in msg.lower():
-            hints.append(
-                "an op is unsupported on this backend/dtype — direct "
-                "factorizations must stay on host (see solvers/pc.py)")
-        hint = ("; ".join(hints)) or "see the chained exception for details"
+        matches = classify_failure(msg)
+        self.failure_class = matches[0].name if matches else "unknown"
+        self.retriable = matches[0].retriable if matches else False
+        hint = ("; ".join(fc.hint for fc in matches)
+                or "see the chained exception for details")
         super().__init__(f"{what} failed on device: {hint}")
 
 

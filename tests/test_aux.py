@@ -56,6 +56,157 @@ class TestCheckpoint:
         np.testing.assert_allclose(x2.to_numpy(), x_true, rtol=1e-7,
                                    atol=1e-9)
 
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64,
+                                       np.complex128],
+                             ids=["f32", "f64", "c128"])
+    def test_solve_state_elastic_roundtrip(self, comm8, comm1, comm,
+                                           tmp_path, dtype):
+        """save_solve_state on one mesh size restores bit-identically on
+        1/3/8-device meshes, across dtypes (the elastic-restart story)."""
+        A = poisson2d_csr(7).astype(dtype)
+        n = A.shape[0]
+        rng = np.random.default_rng(3)
+        xh = rng.random(n).astype(dtype)
+        bh = rng.random(n).astype(dtype)
+        if np.issubdtype(np.dtype(dtype), np.complexfloating):
+            xh = xh + 1j * rng.random(n)
+            bh = bh + 1j * rng.random(n)
+        M = tps.Mat.from_scipy(comm8, A, dtype=dtype)
+        x = tps.Vec.from_global(comm8, xh, dtype=dtype)
+        b = tps.Vec.from_global(comm8, bh, dtype=dtype)
+        p = str(tmp_path / "es.npz")
+        checkpoint.save_solve_state(p, M, x, b, iteration=11)
+        for target in (comm1, comm, comm8):
+            M2, x2, b2, it0 = checkpoint.load_solve_state(p, target)
+            assert it0 == 11
+            assert np.dtype(str(M2.dtype)) == np.dtype(dtype)
+            assert (M2.to_scipy() != A).nnz == 0
+            np.testing.assert_array_equal(x2.to_numpy(), xh)
+            np.testing.assert_array_equal(b2.to_numpy(), bh)
+
+    def test_resume_converges_in_fewer_iterations(self, comm8, tmp_path):
+        """A restored solve finishes in fewer iterations than a cold
+        start — the checkpoint actually carries the crashed progress."""
+        A = poisson2d_csr(16)
+        n = A.shape[0]
+        M = tps.Mat.from_scipy(comm8, A)
+        x, bv = M.get_vecs()
+        bv.set_global(A @ np.ones(n))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-9)
+        cold = ksp.solve(bv, x).iterations
+        # redo, interrupted at 3/4 of the cold iteration count
+        x.zero()
+        ksp.set_tolerances(max_it=max(2, cold * 3 // 4))
+        ksp.solve(bv, x)
+        p = str(tmp_path / "partial.npz")
+        checkpoint.save_solve_state(p, M, x, bv)
+        M2, x2, b2, _ = checkpoint.load_solve_state(p, comm8)
+        ksp2 = tps.KSP().create(comm8)
+        ksp2.set_operators(M2)
+        ksp2.set_type("cg")
+        ksp2.set_tolerances(rtol=1e-9)
+        ksp2.set_initial_guess_nonzero(True)
+        res = ksp2.solve(b2, x2)
+        assert res.converged
+        assert res.iterations < cold
+
+
+class TestCheckpointHardening:
+    """Atomic writes + validated loads (a crash mid-checkpoint can never
+    leave a truncated file a later resume trusts)."""
+
+    def test_no_tmp_file_left_behind(self, comm8, tmp_path):
+        v = tps.Vec.from_global(comm8, np.arange(9.0))
+        p = str(tmp_path / "v.npz")
+        checkpoint.save_vec(p, v)
+        assert [f.name for f in tmp_path.iterdir()] == ["v.npz"]
+
+    def test_npz_suffix_normalized(self, comm8, tmp_path):
+        """A path without .npz saves and loads through the same
+        normalization numpy's savez applies."""
+        v = tps.Vec.from_global(comm8, np.arange(5.0))
+        p = str(tmp_path / "bare")
+        checkpoint.save_vec(p, v)
+        assert (tmp_path / "bare.npz").exists()
+        np.testing.assert_array_equal(
+            checkpoint.load_vec(p, comm8).to_numpy(), v.to_numpy())
+
+    def test_truncated_file_rejected(self, comm8, tmp_path):
+        """The torn write a non-atomic checkpoint could have produced."""
+        v = tps.Vec.from_global(comm8, np.arange(64.0))
+        p = tmp_path / "t.npz"
+        checkpoint.save_vec(str(p), v)
+        p.write_bytes(p.read_bytes()[:40])       # tear it
+        with pytest.raises(ValueError, match="unreadable or truncated"):
+            checkpoint.load_vec(str(p), comm8)
+
+    def test_wrong_kind_rejected(self, comm8, tmp_path):
+        v = tps.Vec.from_global(comm8, np.arange(4.0))
+        p = str(tmp_path / "v.npz")
+        checkpoint.save_vec(p, v)
+        with pytest.raises(ValueError, match="expected 'mat'"):
+            checkpoint.load_mat(p, comm8)
+
+    def test_not_a_checkpoint_rejected(self, comm8, tmp_path):
+        p = str(tmp_path / "other.npz")
+        np.savez(p, something=np.ones(3))
+        with pytest.raises(ValueError, match="no 'kind'"):
+            checkpoint.load_vec(p, comm8)
+
+    def test_inconsistent_csr_rejected(self, comm8, tmp_path):
+        """Tampered/corrupted structure fails validation, not a resume."""
+        A = poisson2d_csr(5).tocsr()
+        p = str(tmp_path / "bad.npz")
+        np.savez(p, kind="mat", shape=np.asarray([25, 25]),
+                 indptr=A.indptr[:-3],           # truncated
+                 indices=A.indices, data=A.data, dtype="float64")
+        with pytest.raises(ValueError, match="indptr"):
+            checkpoint.load_mat(p, comm8)
+
+    def test_bad_dtype_rejected(self, comm8, tmp_path):
+        A = poisson2d_csr(5).tocsr()
+        p = str(tmp_path / "baddt.npz")
+        np.savez(p, kind="mat", shape=np.asarray([25, 25]),
+                 indptr=A.indptr, indices=A.indices, data=A.data,
+                 dtype="not-a-dtype")
+        with pytest.raises(ValueError, match="unknown dtype"):
+            checkpoint.load_mat(p, comm8)
+
+    def test_solve_state_shape_mismatch_rejected(self, comm8, tmp_path):
+        A = poisson2d_csr(5).tocsr()
+        p = str(tmp_path / "badx.npz")
+        np.savez(p, kind="solve_state", shape=np.asarray([25, 25]),
+                 indptr=A.indptr, indices=A.indices, data=A.data,
+                 dtype="float64", x=np.ones(7), b=np.ones(25),
+                 iteration=0)
+        with pytest.raises(ValueError, match="iterate length"):
+            checkpoint.load_solve_state(p, comm8)
+
+    def test_validation_survives_optimized_mode(self, comm8, tmp_path):
+        """The loaders raise ValueError, never bare assert (asserts
+        vanish under python -O)."""
+        import subprocess
+        import sys
+        v = tps.Vec.from_global(comm8, np.arange(4.0))
+        p = str(tmp_path / "v.npz")
+        checkpoint.save_vec(p, v)
+        code = (
+            "import numpy as np\n"
+            "from mpi_petsc4py_example_tpu.utils import checkpoint\n"
+            "import mpi_petsc4py_example_tpu as tps\n"
+            "try:\n"
+            f"    checkpoint.load_mat({p!r}, tps.DeviceComm())\n"
+            "except ValueError:\n"
+            "    print('VALUEERROR')\n")
+        out = subprocess.run(
+            [sys.executable, "-O", "-c", code], capture_output=True,
+            text=True, check=True,
+            env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"})
+        assert "VALUEERROR" in out.stdout
+
 
 class TestLogView:
     def test_events_recorded_and_printed(self, comm8):

@@ -1,0 +1,466 @@
+"""Resilient solves (resilience/): fault injection, retry/backoff with
+checkpoint-resume, NaN/Inf residual classification, and fallback chains.
+
+Everything here is deterministic: faults fire on exact hit counts (or
+seeded schedules), backoff delays are jitter-free and recorded through an
+injected sleep, and every recovery action is asserted via the structured
+``recovery_events`` trail on the returned SolveResult.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.resilience import faults
+from mpi_petsc4py_example_tpu.resilience.fallback import (KSPFallbackChain,
+                                                          reduced_dtype)
+from mpi_petsc4py_example_tpu.resilience.retry import (RetryPolicy,
+                                                       resilient_solve)
+from mpi_petsc4py_example_tpu.solvers import krylov
+from mpi_petsc4py_example_tpu.utils.errors import DeviceExecutionError
+
+CR = tps.ConvergedReason
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """No fault plan may leak across tests (env cache reset both sides)."""
+    faults.reset()
+    yield
+    assert not faults.active(), "a test left a fault plan armed"
+    faults.reset()
+
+
+def _setup(comm, n_side=10, rtol=1e-10, ksp_type="cg"):
+    A = poisson2d_csr(n_side)
+    n = A.shape[0]
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type(ksp_type)
+    ksp.set_tolerances(rtol=rtol)
+    x, b = M.get_vecs()
+    b.set_global(A @ np.ones(n))
+    return ksp, M, x, b
+
+
+class TestFaultSpec:
+    def test_parse_clause_full(self):
+        (f,) = faults.parse_spec("ksp.program=unavailable:at=2:times=3:iter=7")
+        assert (f.point, f.kind, f.at, f.times, f.iter_k) == (
+            "ksp.program", "unavailable", 2, 3, 7)
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(faults.FaultSpecError, match="unknown fault point"):
+            faults.parse_spec("ksp.typo=unavailable")
+
+    def test_kind_point_mismatch_rejected(self):
+        with pytest.raises(faults.FaultSpecError, match="supports kinds"):
+            faults.parse_spec("ksp.result=unavailable")
+
+    def test_malformed_clause_rejected(self):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec("ksp.solve")
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec("ksp.solve=oom:at")
+        with pytest.raises(faults.FaultSpecError, match="bad value"):
+            faults.parse_spec("ksp.solve=oom:at=x")
+        with pytest.raises(faults.FaultSpecError, match="needs seed"):
+            faults.parse_spec("ksp.solve=oom:prob=0.5")
+
+    def test_hit_count_trigger(self):
+        with faults.inject_faults("ksp.solve=oom:at=2:times=2"):
+            fired = [faults.triggered("ksp.solve") is not None
+                     for _ in range(5)]
+        assert fired == [False, True, True, False, False]
+
+    def test_times_forever(self):
+        with faults.inject_faults("ksp.solve=oom:times=*"):
+            assert all(faults.triggered("ksp.solve") is not None
+                       for _ in range(4))
+
+    def test_seeded_schedule_reproducible(self):
+        def run():
+            with faults.inject_faults("ksp.solve=oom:seed=7:prob=0.5"):
+                return [faults.triggered("ksp.solve") is not None
+                        for _ in range(20)]
+        first, second = run(), run()
+        assert first == second
+        assert any(first) and not all(first)
+
+    def test_env_var_activation(self, monkeypatch):
+        monkeypatch.setenv("TPU_SOLVE_FAULTS", "ksp.solve=unavailable")
+        faults.reset()
+        assert faults.active()
+        assert faults.triggered("ksp.solve").kind == "unavailable"
+        monkeypatch.delenv("TPU_SOLVE_FAULTS")
+        faults.reset()
+        assert not faults.active()
+
+    def test_synthetic_error_is_xla_shaped(self):
+        (f,) = faults.parse_spec("ksp.solve=unavailable")
+        err = f.error()
+        assert type(err).__name__ == "XlaRuntimeError"
+        assert "UNAVAILABLE" in str(err)
+
+
+class TestInjectedDeviceFaults:
+    def test_ksp_solve_fault_classified_retriable(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        with tps.inject_faults("ksp.solve=unavailable"):
+            with pytest.raises(DeviceExecutionError) as ei:
+                ksp.solve(b, x)
+            assert ei.value.failure_class == "unavailable"
+            assert ei.value.retriable
+            # fired once; the next solve inside the plan is clean
+            assert ksp.solve(b, x).converged
+
+    def test_oom_not_retriable(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        with tps.inject_faults("ksp.solve=oom"):
+            with pytest.raises(DeviceExecutionError) as ei:
+                ksp.solve(b, x)
+        assert ei.value.failure_class == "oom"
+        assert not ei.value.retriable
+
+    def test_eps_solve_fault(self, comm8):
+        A = poisson2d_csr(6)
+        eps = tps.EPS().create(comm8)
+        eps.set_operators(tps.Mat.from_scipy(comm8, A))
+        eps.set_problem_type("hep")
+        with tps.inject_faults("eps.solve=unavailable"):
+            with pytest.raises(DeviceExecutionError) as ei:
+                eps.solve()
+        assert ei.value.failure_class == "unavailable"
+
+    def test_comm_put_fault(self, comm8):
+        with tps.inject_faults("comm.put=unavailable"):
+            with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+                tps.Vec.from_global(comm8, np.ones(16))
+
+    def test_comm_fetch_corrupt_and_drop(self, comm8):
+        v = tps.Vec.from_global(comm8, np.arange(8.0))
+        with tps.inject_faults("comm.fetch=corrupt"):
+            assert np.isnan(v.to_numpy()).any()
+        assert not np.isnan(v.to_numpy()).any()
+        with tps.inject_faults("comm.fetch=drop"):
+            assert (v.to_numpy() == 0).all()
+
+
+class TestNanInfResidual:
+    def test_injected_nan_maps_to_nanorinf(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        with tps.inject_faults("ksp.result=nan:iter=3"):
+            res = ksp.solve(b, x)
+        assert res.reason == CR.DIVERGED_NANORINF == -9
+        assert res.reason_name == "DIVERGED_NANORINF"
+        assert not res.converged
+        assert res.iterations == 3
+        assert np.isnan(res.residual_norm)
+
+    def test_injected_inf_maps_to_nanorinf(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        with tps.inject_faults("ksp.result=inf"):
+            res = ksp.solve(b, x)
+        assert res.reason == CR.DIVERGED_NANORINF
+        assert np.isinf(res.residual_norm)
+
+    def test_genuine_nan_rhs_maps_to_nanorinf(self, comm8):
+        """No injection: a NaN that really flows through the compiled
+        recurrence must classify identically."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.set_tolerances(max_it=8)
+        arr = b.to_numpy()
+        arr[0] = np.nan
+        b.set_global(arr)
+        res = ksp.solve(b, x)
+        assert res.reason == CR.DIVERGED_NANORINF
+
+    def test_corrupted_collective_surfaces_as_nanorinf(self, comm8):
+        """A corrupted in-program psum (trace-time injection) poisons the
+        recurrence; the solve boundary classifies the blow-up."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.set_tolerances(max_it=50)
+        with tps.inject_faults("comm.psum=corrupt:times=*"):
+            res = ksp.solve(b, x)
+        assert res.reason == CR.DIVERGED_NANORINF
+        # plan gone: the fault-free cached program must be untouched
+        x.zero()
+        assert ksp.solve(b, x).converged
+
+    def test_dropped_collective_breaks_convergence(self, comm8):
+        """Dropping the reductions (each shard keeps its local partial)
+        must not fake convergence on a multi-shard mesh."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.set_tolerances(max_it=30)
+        with tps.inject_faults("comm.psum=drop:times=*"):
+            res = ksp.solve(b, x)
+        assert not res.converged
+
+
+class TestResilientSolve:
+    def test_recovers_midsolve_crash_end_to_end(self, comm8, tmp_path):
+        """The acceptance path: crash at iteration 6 -> checkpoint ->
+        deterministic backoff -> rebuild -> resume -> CONVERGED_RTOL."""
+        ksp, M, x, b = _setup(comm8, n_side=16)
+        ckpt = str(tmp_path / "state.npz")
+        delays = []
+        policy = RetryPolicy(max_attempts=3, base_delay=0.125,
+                             sleep=delays.append)
+        with tps.inject_faults("ksp.program=unavailable:iter=6"):
+            res = resilient_solve(ksp, b, x, policy, checkpoint_path=ckpt)
+        assert res.reason == CR.CONVERGED_RTOL
+        assert res.attempts == 2
+        assert delays == [0.125]            # jitter-free, exactly one retry
+        assert os.path.exists(ckpt)         # the checkpoint was persisted
+        kinds = [e.kind for e in res.recovery_events]
+        assert kinds == ["fault", "checkpoint", "backoff", "resume"]
+        assert res.recovery_events[0].error_class == "unavailable"
+        assert res.recovery_events[1].detail == ckpt
+        assert res.recovery_events[2].delay == 0.125
+        np.testing.assert_allclose(x.to_numpy(), np.ones(256), atol=1e-7)
+        # the caller's guess flag was restored
+        assert ksp._initial_guess_nonzero is False
+
+    def test_resume_converges_faster_than_cold(self, comm8, tmp_path):
+        """The restored iterate carries the crashed attempt's progress."""
+        ksp, M, x, b = _setup(comm8, n_side=16, rtol=1e-8)
+        cold = ksp.solve(b, x.duplicate()).iterations
+        policy = RetryPolicy(max_attempts=2, sleep=lambda _d: None)
+        with tps.inject_faults(
+                f"ksp.program=unavailable:iter={max(2, cold * 3 // 4)}"):
+            res = resilient_solve(ksp, b, x, policy,
+                                  checkpoint_path=str(tmp_path / "s.npz"))
+        assert res.converged
+        assert res.iterations < cold
+
+    def test_nonretriable_class_raises(self, comm8, tmp_path):
+        ksp, M, x, b = _setup(comm8)
+        policy = RetryPolicy(max_attempts=3, sleep=lambda _d: None)
+        with tps.inject_faults("ksp.solve=oom"):
+            with pytest.raises(DeviceExecutionError) as ei:
+                resilient_solve(ksp, b, x, policy,
+                                checkpoint_path=str(tmp_path / "s.npz"))
+        assert ei.value.failure_class == "oom"
+
+    def test_attempts_exhausted_reraises(self, comm8, tmp_path):
+        ksp, M, x, b = _setup(comm8)
+        delays = []
+        policy = RetryPolicy(max_attempts=3, base_delay=1.0,
+                             sleep=delays.append)
+        with tps.inject_faults("ksp.solve=unavailable:times=*"):
+            with pytest.raises(DeviceExecutionError):
+                resilient_solve(ksp, b, x, policy,
+                                checkpoint_path=str(tmp_path / "s.npz"))
+        assert delays == [1.0, 2.0]         # exponential, then give up
+
+    def test_backoff_sequence_deterministic(self):
+        policy = RetryPolicy(base_delay=0.5, backoff_factor=2.0,
+                             max_delay=3.0)
+        assert [policy.delay(i) for i in range(4)] == [0.5, 1.0, 2.0, 3.0]
+
+    def test_no_fault_zero_overhead(self, comm8, tmp_path):
+        """With no faults armed, the wrapper is exactly one ksp.solve:
+        same compiled program (no new XLA programs), no checkpoint file,
+        attempts=1, empty trail."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.solve(b, x)                     # warm the program cache
+        n_programs = len(krylov._PROGRAM_CACHE)
+        x.zero()
+        ckpt = str(tmp_path / "never.npz")
+        res = resilient_solve(ksp, b, x, checkpoint_path=ckpt)
+        assert res.converged
+        assert res.attempts == 1 and res.recovery_events == []
+        assert len(krylov._PROGRAM_CACHE) == n_programs
+        assert not os.path.exists(ckpt)
+
+
+class TestFallbackChain:
+    def test_nan_escalates_to_converging_method(self, comm8):
+        """Acceptance: NaN-poisoned residual -> fallback to bcgs with the
+        full trail asserted and a correct solution."""
+        ksp, M, x, b = _setup(comm8)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.result=nan:at=1:iter=2"):
+            res = chain.solve(b, x)
+        assert res.reason == CR.CONVERGED_RTOL
+        assert res.attempts == 2
+        (ev,) = res.recovery_events
+        assert (ev.kind, ev.detail, ev.error_class, ev.iterations) == (
+            "fallback", "cg->bcgs", "DIVERGED_NANORINF", 2)
+        assert ksp.get_type() == "bcgs"     # stays degraded (documented)
+        np.testing.assert_allclose(x.to_numpy(), np.ones(100), atol=1e-6)
+
+    def test_poisoned_iterate_never_seeds_next_stage(self, comm8):
+        """x is restored to the pristine initial guess between stages."""
+        ksp, M, x, b = _setup(comm8)
+        x.set_global(np.full(100, 0.5))     # a recognizable initial guess
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.result=nan:at=1"):
+            res = chain.solve(b, x)
+        assert res.converged
+        assert np.isfinite(x.to_numpy()).all()
+
+    def test_chain_exhausts_to_direct_stage(self, comm8):
+        """Three poisoned iterative stages fall through to preonly+lu."""
+        ksp, M, x, b = _setup(comm8)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.result=nan:at=1:times=3"):
+            res = chain.solve(b, x)
+        assert res.converged
+        assert res.attempts == 4
+        assert [e.detail for e in res.recovery_events] == [
+            "cg->bcgs", "bcgs->gmres", "gmres->preonly"]
+        assert (ksp.get_type(), ksp.get_pc().get_type()) == ("preonly", "lu")
+        np.testing.assert_allclose(x.to_numpy(), np.ones(100), atol=1e-8)
+
+    def test_oom_retries_at_reduced_precision(self, comm8):
+        ksp, M, x, b = _setup(comm8, rtol=1e-5)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.solve=oom:at=1"):
+            res = chain.solve(b, x)
+        assert res.converged
+        events = [e for e in res.recovery_events if e.kind == "precision"]
+        assert len(events) == 1
+        assert events[0].detail == "float64->float32"
+        # solution came back at the operator's dtype, correct to fp32
+        assert x.to_numpy().dtype == np.float64
+        np.testing.assert_allclose(x.to_numpy(), np.ones(100), atol=1e-3)
+
+    def test_reduced_dtype_table(self):
+        assert reduced_dtype(np.float64) == np.float32
+        assert reduced_dtype(np.complex128) == np.complex64
+        assert reduced_dtype(np.float32) is None
+
+    def test_breakdown_escalates(self, comm8):
+        """A genuine CG breakdown (indefinite operator: p·Ap = 0) walks
+        the chain instead of surfacing DIVERGED_BREAKDOWN."""
+        import scipy.sparse as sp
+        A = sp.diags([1.0, -1.0, 2.0, -2.0, 3.0, -3.0, 4.0, -4.0]).tocsr()
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.set_tolerances(rtol=1e-10)
+        x, b = M.get_vecs()
+        b.set_global(np.ones(8))
+        assert ksp.solve(b, x).reason == CR.DIVERGED_BREAKDOWN
+        x.zero()
+        chain = KSPFallbackChain(ksp)
+        res = chain.solve(b, x)
+        assert res.converged
+        assert res.recovery_events[0].error_class == "DIVERGED_BREAKDOWN"
+        np.testing.assert_allclose(
+            x.to_numpy(), np.linalg.solve(A.toarray(), np.ones(8)),
+            atol=1e-6)
+
+    def test_custom_methods_and_no_direct(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        chain = KSPFallbackChain(ksp, methods=["gmres"], direct=False)
+        assert chain.stages == (("gmres", None),)
+        with tps.inject_faults("ksp.result=nan:at=1:times=*"):
+            res = chain.solve(b, x)
+        # every stage poisoned and no direct stage: the failure surfaces
+        assert res.reason == CR.DIVERGED_NANORINF
+        assert res.attempts == 2
+        # non-converged exit restores the original configuration
+        assert ksp.get_type() == "cg"
+
+
+class TestReviewRegressions:
+    def test_mixed_case_marker_still_classifies(self):
+        """'LuDecomposition' must match case-sensitively (it used to be
+        checked against the raw message, and must not be lost to the
+        lowercase comparison)."""
+        from mpi_petsc4py_example_tpu.utils.errors import classify_failure
+        (fc,) = classify_failure("Singular matrix in LuDecomposition")
+        assert fc.name == "unsupported"
+        (fc2,) = classify_failure("op is Not Implemented here")
+        assert fc2.name == "unsupported"
+
+    def test_host_only_plan_keeps_program_cache(self, comm8):
+        """An armed plan with no live trace-time fault (ksp.result is a
+        host-boundary kind) must not bust the compiled-program cache on
+        every solve — long-running drivers under TPU_SOLVE_FAULTS keep
+        normal caching."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.solve(b, x)
+        n_programs = len(krylov._PROGRAM_CACHE)
+        with tps.inject_faults("ksp.result=nan:at=1"):
+            ksp.solve(b, x)          # fault fires
+            x.zero()
+            ksp.solve(b, x)          # spent plan, cached program reused
+        assert len(krylov._PROGRAM_CACHE) == n_programs
+
+    def test_spent_psum_fault_restores_caching(self, comm8):
+        """Once a comm.psum clause's window has passed, trace_key goes
+        back to None."""
+        with tps.inject_faults("comm.psum=corrupt:at=1:times=1") as plan:
+            assert faults.trace_key() is not None
+            plan[0].check()          # consume the window
+            assert plan[0].spent()
+            assert faults.trace_key() is None
+
+    def test_kept_escalation_not_retried_twice(self, comm8):
+        """After a kept cg->bcgs escalation, the next chain.solve must
+        start at bcgs without listing it twice in the plan."""
+        ksp, M, x, b = _setup(comm8)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.result=nan:at=1"):
+            assert chain.solve(b, x).converged
+        assert ksp.get_type() == "bcgs"
+        x.zero()
+        # poison bcgs once now: the escalation must go straight to gmres
+        with tps.inject_faults("ksp.result=nan:at=1"):
+            res = chain.solve(b, x)
+        assert res.converged
+        assert res.attempts == 2
+        assert res.recovery_events[0].detail == "bcgs->gmres"
+
+    def test_raising_last_stage_restores_config(self, comm8):
+        """A chain whose every stage raises must not leave the owner KSP
+        pinned to the last failed stage."""
+        ksp, M, x, b = _setup(comm8)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.solve=unavailable:times=*"):
+            with pytest.raises(DeviceExecutionError):
+                chain.solve(b, x)
+        assert (ksp.get_type(), ksp.get_pc().get_type()) == ("cg", "none")
+
+    def test_missing_checkpoint_is_filenotfound(self, comm8, tmp_path):
+        """A missing file is 'no checkpoint yet', never 'corruption' —
+        the resume-if-exists pattern depends on the distinction."""
+        from mpi_petsc4py_example_tpu.utils import checkpoint
+        with pytest.raises(FileNotFoundError):
+            checkpoint.load_solve_state(str(tmp_path / "absent.npz"), comm8)
+
+    def test_default_checkpoint_path_unique_per_solver(self, comm8):
+        from mpi_petsc4py_example_tpu.resilience.retry import (
+            default_checkpoint_path)
+        k1, k2 = tps.KSP().create(comm8), tps.KSP().create(comm8)
+        assert default_checkpoint_path(k1) != default_checkpoint_path(k2)
+
+    def test_precision_success_not_pinned_on_owner(self, comm8):
+        """A reduced-precision recovery runs on the scratch solver; the
+        owner KSP keeps (and chain reports) honest configuration."""
+        ksp, M, x, b = _setup(comm8, rtol=1e-5)
+        chain = KSPFallbackChain(ksp)
+        with tps.inject_faults("ksp.solve=oom:at=1"):
+            res = chain.solve(b, x)
+        assert res.converged
+        assert ksp.get_type() == "cg"            # owner config restored
+        assert chain.last_config == ("cg", "none", "reduced-precision")
+        # the scratch solver (and its converted operator) is cached
+        assert chain._lo_cache is not None
+
+
+class TestResilienceExports:
+    def test_package_surface(self):
+        assert tps.RetryPolicy is RetryPolicy
+        assert tps.resilient_solve is resilient_solve
+        assert tps.KSPFallbackChain is KSPFallbackChain
+        assert tps.inject_faults is faults.inject_faults
+        assert tps.RecoveryEvent.__name__ == "RecoveryEvent"
