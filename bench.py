@@ -179,6 +179,9 @@ def main():
     ap.add_argument("--rtol", type=float, default=1e-6)
     ap.add_argument("--reps", type=int, default=3,
                     help="timed repetitions (median + spread reported)")
+    ap.add_argument("--log-view", action="store_true",
+                    help="print the -log_view solve/kernel-traffic "
+                         "summary after the JSON line")
     opts = ap.parse_args()
     nx = opts.n or (32 if opts.quick else 128)
 
@@ -211,6 +214,13 @@ def main():
     per = statistics.median(pers)
     onchip = 1.0 / per if per > 0 else 0.0
     gbps = PASSES_PER_ITER * n * 4 / per / 1e9 if per > 0 else 0.0
+    # per-kernel achieved-GB/s recording (utils/profiling): the composed
+    # CG step's model traffic over its measured delta-method time — shows
+    # up in the -log_view kernel-traffic table alongside the
+    # decompose_stencil pieces
+    from mpi_petsc4py_example_tpu.utils.profiling import (
+        record_kernel_traffic)
+    record_kernel_traffic(f"cg_step[{nx}^3]", PASSES_PER_ITER * n * 4, per)
     # headline: best time-to-rtol config (CG+MG) vs the CPU oracle
     best_wall = min(wall, mg_wall)
     line = {
@@ -249,6 +259,9 @@ def main():
         },
     }
     print(json.dumps(line))
+    if opts.log_view:
+        from mpi_petsc4py_example_tpu.utils import profiling
+        profiling.log_view()
     return 0
 
 

@@ -54,20 +54,33 @@ def time_loop(prog, args, iters_lo, iters_hi, reps=3):
 
 def vcycle_decomposition(nx: int):
     """MG V-cycle ablation (the BASELINE.md table): full cycle,
-    smoothing-ablated cycle, isolated transfers."""
+    smoothing-ablated cycle, isolated transfers, and the round-6
+    fused-restriction delta (residual_restrict_fused vs the separate
+    residual+restrict passes it replaces)."""
     import jax
     import jax.numpy as jnp
 
     import mpi_petsc4py_example_tpu.solvers.mg as mg
+    from mpi_petsc4py_example_tpu.utils.profiling import (
+        record_kernel_traffic)
 
     r0 = jnp.full((nx, nx, nx), 1e-6, jnp.float32)
     e0 = jnp.full((nx // 2,) * 3, 1e-6, jnp.float32)
     passes_bytes = nx ** 3 * 4
 
-    def report(name, per_s):
-        print(json.dumps({"piece": name, "ms": round(per_s * 1e3, 3),
-                          "fine_passes": round(
-                              per_s * HBM_GBPS * 1e9 / passes_bytes, 2)}))
+    def report(name, per_s, model_passes=None):
+        line = {"piece": name, "ms": round(per_s * 1e3, 3),
+                "fine_passes": round(
+                    per_s * HBM_GBPS * 1e9 / passes_bytes, 2)}
+        if model_passes is not None:
+            # achieved effective bandwidth over the piece's own traffic
+            # model — the -log_view per-kernel GB/s line (utils/profiling)
+            record_kernel_traffic(f"{name}[{nx}^3]",
+                                  model_passes * passes_bytes, per_s)
+            line["model_passes"] = model_passes
+            line["achieved_gbps"] = round(
+                model_passes * passes_bytes / per_s / 1e9, 1)
+        print(json.dumps(line))
 
     def cycle_loop():
         cycle = mg.make_vcycle3d(nx, nx, nx)
@@ -105,9 +118,24 @@ def vcycle_decomposition(nx: int):
         return loop
 
     report("restrict", time_loop(
-        xfer_loop(lambda r: mg._restrict(r), r0), (r0,), 16, 64))
+        xfer_loop(lambda r: mg._restrict(r), r0), (r0,), 16, 64),
+        model_passes=1.125)                    # read r + write coarse/8
     report("prolong", time_loop(
-        xfer_loop(lambda e: mg._prolong(e), e0), (e0,), 16, 64))
+        xfer_loop(lambda e: mg._prolong(e), e0), (e0,), 16, 64),
+        model_passes=1.125)                    # read coarse/8 + write fine
+    # the round-6 fused-restriction lever, itemized: the fully-fused
+    # kernel (residual + 3-axis restriction from VMEM-resident chunks)
+    # vs the separate residual pass + restrict pass it replaces
+    f0 = jnp.full((nx, nx, nx), 2e-6, jnp.float32)
+    report("residual_restrict_fused", time_loop(
+        xfer_loop(lambda u: mg._residual_restrict_fused(u, f0), r0),
+        (r0,), 16, 64),
+        model_passes=2.125)                    # read u + f, write coarse/8
+    report("residual_then_restrict", time_loop(
+        xfer_loop(lambda u: mg._restrict(
+            mg._residual(u, f0, *mg._no_exchange(u))), r0),
+        (r0,), 16, 64),
+        model_passes=4.125)   # read u,f / write r / read r / write coarse
     return 0
 
 
@@ -117,10 +145,17 @@ def main():
     ap.add_argument("--iters", type=int, default=40)
     ap.add_argument("--vcycle", action="store_true",
                     help="decompose the MG V-cycle instead of the CG step")
+    ap.add_argument("--log-view", action="store_true",
+                    help="print the per-kernel achieved-GB/s -log_view "
+                         "table after the decomposition")
     opts = ap.parse_args()
     nx = opts.n
+    from mpi_petsc4py_example_tpu.utils import profiling
     if opts.vcycle:
-        return vcycle_decomposition(nx)
+        rc = vcycle_decomposition(nx)
+        if opts.log_view:
+            profiling.log_view()
+        return rc
     lo, hi = opts.iters // 4, opts.iters
 
     import jax
@@ -135,9 +170,20 @@ def main():
     chunk, nchunks = _pick_chunk(nx, 4, nx, nx, None)
     print(json.dumps({"n": nx, "chunk": chunk, "nchunks": nchunks}))
 
+    # the per-piece traffic models (read+write vector passes) backing the
+    # achieved-GB/s recording: adot reads p and writes Ap (+edge planes),
+    # the chain's structural count is 9 passes, the composed CG step 11.25
+    _MODEL_PASSES = {"adot": 2.25, "chain": 9.0, "composed": 11.25}
+
     def report(name, per_s, note=""):
         line = {"piece": name, "ms_per_iter": round(per_s * 1e3, 4),
                 "hbm_passes": round(per_s * HBM_GBPS * 1e9 / passes_bytes, 2)}
+        model = _MODEL_PASSES.get(name)
+        if model is not None:
+            profiling.record_kernel_traffic(f"{name}[{nx}^3]",
+                                            model * passes_bytes, per_s)
+            line["achieved_gbps"] = round(
+                model * passes_bytes / per_s / 1e9, 1)
         if note:
             line["note"] = note
         print(json.dumps(line))
@@ -203,6 +249,8 @@ def main():
                             autoscale=False)
     report("composed", float(np.median(pers)),
            note="production cg_stencil_kernel via KSP")
+    if opts.log_view:
+        profiling.log_view()
     return 0
 
 

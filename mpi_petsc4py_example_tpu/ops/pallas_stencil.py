@@ -21,11 +21,26 @@ Falls back to the pure-jnp path on non-TPU backends (models/stencil.py).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+def _pipeline_depth() -> int:
+    """Static DMA pipeline depth (banks per stream) for the z-chunk
+    kernels. Depth 2 (double buffering) is the measured default; the
+    ``TPU_SOLVE_STENCIL_NBUF`` env knob exposes deeper pipelines (3-4) for
+    the DMA-plateau retuning sweeps (BASELINE.md 512³ table: the block-DMA
+    geometry, not compute, pins the stencil kernel at ~330 GB/s — a deeper
+    pipeline trades VMEM chunk depth for more DMAs in flight)."""
+    try:
+        depth = int(os.environ.get("TPU_SOLVE_STENCIL_NBUF", "2"))
+    except ValueError:
+        return 2
+    return min(max(depth, 2), 4)
 
 
 def _shift_x(u, step):
@@ -42,39 +57,66 @@ def _shift_y(u, step):
 
 
 def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
-                    dot_ref=None, f_ref=None, combine=None):
-    """Grid-free kernel: double-buffered z-chunk pipeline, manual DMA.
+                    dot_ref=None, f_ref=None, combine=None, nbuf=2):
+    """Grid-free kernel: ``nbuf``-deep z-chunk pipeline, manual DMA.
 
     Per chunk ``c`` the scratch holds planes ``[z0-1, z0+chunk+1)`` of the
-    extended slab: the center comes from ``u``, the edge planes from ``u``'s
-    neighbouring chunks or from the halo arrays at the slab ends. All
-    index/constant dtypes are pinned to i32/f32 explicitly: with x64 enabled,
-    bare Python literals trace as i64/f64, which Mosaic cannot lower.
+    extended slab. INTERIOR chunks (``0 < c < nchunks-1``) fill their bank
+    with ONE wide contiguous HBM→VMEM copy of all ``chunk+2`` planes —
+    round-6 DMA re-geometry: the 3-way split (center + two 1-plane edge
+    copies) issued 3× the DMA descriptors for the same bytes, and the
+    1-plane edge copies are exactly the narrow transfers the measured
+    ~330 GB/s block-DMA plateau punishes (BASELINE.md 512³ table). Only the
+    two boundary chunks still split, because their edge plane lives in a
+    different array (the halo) than the center. All index/constant dtypes
+    are pinned to i32/f32 explicitly: with x64 enabled, bare Python
+    literals trace as i64/f64, which Mosaic cannot lower.
 
     With ``f_ref`` a second array streams through its own banks (center
     planes only — no neighbours needed) and ``combine(u, y, f) -> out``
     post-processes the stencil product while everything is VMEM-resident:
     one streamed pass for a whole damped-Jacobi sweep or residual, instead
     of a stencil pass plus an XLA elementwise pass over 3 more arrays.
+
+    ``nbuf`` is the pipeline depth (banks per stream): 2 = classic double
+    buffering; 3-4 keep more DMAs in flight at the cost of shallower
+    chunks (the ``TPU_SOLVE_STENCIL_NBUF`` retuning knob).
     """
     def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out, fsc=None,
                 sem_f=None):
         six = jnp.asarray(6.0, out_ref.dtype)
         one = jnp.int32(1)
 
+        # an interior chunk exists only at nchunks >= 3 — the wide-copy
+        # code must not be EMITTED otherwise (its (chunk+2)-plane slice
+        # would exceed the u array statically)
+        has_interior = nchunks >= 3
+
         def start_in(c, slot):
-            """Kick off the three input DMAs for chunk ``c`` into bank ``slot``."""
+            """Kick off the input DMA(s) for chunk ``c`` into bank ``slot``."""
             z0 = c * jnp.int32(chunk)
-            pltpu.make_async_copy(
-                u_ref.at[pl.ds(z0, chunk)], sc.at[slot, pl.ds(one, chunk)],
-                sem_c.at[slot]).start()
+            edge = (c == 0) | (c == nchunks - 1)
+
+            if has_interior:
+                # interior: one contiguous (chunk+2)-plane window of u
+                @pl.when(~edge)
+                def _():
+                    pltpu.make_async_copy(
+                        u_ref.at[pl.ds(z0 - one, chunk + 2)], sc.at[slot],
+                        sem_c.at[slot]).start()
+
+            @pl.when(edge)
+            def _():
+                pltpu.make_async_copy(
+                    u_ref.at[pl.ds(z0, chunk)],
+                    sc.at[slot, pl.ds(one, chunk)], sem_c.at[slot]).start()
             # lower edge plane: u[z0-1], or halo_lo for the first chunk
             @pl.when(c == 0)
             def _():
                 pltpu.make_async_copy(lo_ref, sc.at[slot, pl.ds(0, 1)],
                                       sem_lo.at[slot]).start()
 
-            @pl.when(c > 0)
+            @pl.when(edge & (c > 0))
             def _():
                 pltpu.make_async_copy(u_ref.at[pl.ds(z0 - one, 1)],
                                       sc.at[slot, pl.ds(0, 1)],
@@ -86,7 +128,7 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                     hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
                     sem_hi.at[slot]).start()
 
-            @pl.when(c < nchunks - 1)
+            @pl.when(edge & (c < nchunks - 1))
             def _():
                 pltpu.make_async_copy(
                     u_ref.at[pl.ds(z0 + jnp.int32(chunk), 1)],
@@ -96,31 +138,52 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                 pltpu.make_async_copy(f_ref.at[pl.ds(z0, chunk)],
                                       fsc.at[slot], sem_f.at[slot]).start()
 
-        def wait_in(slot):
-            # matching waits for the start_in copies (shapes must agree)
-            pltpu.make_async_copy(
-                u_ref.at[pl.ds(0, chunk)], sc.at[slot, pl.ds(one, chunk)],
-                sem_c.at[slot]).wait()
-            pltpu.make_async_copy(lo_ref, sc.at[slot, pl.ds(0, 1)],
-                                  sem_lo.at[slot]).wait()
-            pltpu.make_async_copy(
-                hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
-                sem_hi.at[slot]).wait()
+        def wait_in(c, slot):
+            # matching waits for the start_in copies (shapes must agree
+            # with the started transfer on each semaphore)
+            edge = (c == 0) | (c == nchunks - 1)
+
+            if has_interior:
+                @pl.when(~edge)
+                def _():
+                    pltpu.make_async_copy(
+                        u_ref.at[pl.ds(0, chunk + 2)], sc.at[slot],
+                        sem_c.at[slot]).wait()
+
+            @pl.when(edge)
+            def _():
+                pltpu.make_async_copy(
+                    u_ref.at[pl.ds(0, chunk)],
+                    sc.at[slot, pl.ds(one, chunk)], sem_c.at[slot]).wait()
+                pltpu.make_async_copy(lo_ref, sc.at[slot, pl.ds(0, 1)],
+                                      sem_lo.at[slot]).wait()
+                pltpu.make_async_copy(
+                    hi_ref, sc.at[slot, pl.ds(jnp.int32(chunk + 1), 1)],
+                    sem_hi.at[slot]).wait()
             if f_ref is not None:
                 pltpu.make_async_copy(f_ref.at[pl.ds(0, chunk)],
                                       fsc.at[slot], sem_f.at[slot]).wait()
 
-        start_in(jnp.int32(0), jnp.int32(0))
+        # prologue: fill the first nbuf-1 input banks so the steady state
+        # keeps nbuf-1 input DMAs in flight (depth 2 = the classic
+        # one-ahead double buffer; deeper depths are the whole point of
+        # the nbuf knob — without this the extra banks would never be
+        # in flight and only shrink the chunk)
+        for k in range(min(nbuf - 1, nchunks)):
+            start_in(jnp.int32(k), jnp.int32(k))
 
         def body(c, carry):
             slot = lax_rem(c)
-            nslot = lax_rem(c + 1)
 
-            @pl.when(c + 1 < nchunks)
+            # steady state: chunk c+nbuf-1 into the bank chunk c-1 just
+            # freed (fori_loop bodies are sequential, so its compute is
+            # complete)
+            @pl.when(c + jnp.int32(nbuf - 1) < nchunks)
             def _():
-                start_in(c + 1, nslot)
+                start_in(c + jnp.int32(nbuf - 1),
+                         lax_rem(c + jnp.int32(nbuf - 1)))
 
-            wait_in(slot)
+            wait_in(c, slot)
             buf = sc[slot]
             u = buf[1:-1]          # (chunk, ny, nx) center planes
             zm = buf[:-2]
@@ -130,8 +193,8 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                  - _shift_x(u, -1) - _shift_x(u, +1))
             out = (y if combine is None
                    else combine(u, y, None if f_ref is None else fsc[slot]))
-            # wait for the output DMA that used this osc bank two chunks ago
-            @pl.when(c >= 2)
+            # wait for the output DMA that used this osc bank nbuf chunks ago
+            @pl.when(c >= nbuf)
             def _():
                 pltpu.make_async_copy(
                     osc.at[slot], out_ref.at[pl.ds(0, chunk)],
@@ -149,7 +212,7 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             return carry + jnp.sum(u * y)
 
         def lax_rem(c):
-            return jax.lax.rem(c, jnp.int32(2))
+            return jax.lax.rem(c, jnp.int32(nbuf))
 
         carry0 = (jnp.int32(0) if dot_ref is None
                   else jnp.asarray(0.0, out_ref.dtype))
@@ -157,14 +220,16 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                                 carry0)
         if dot_ref is not None:
             dot_ref[0] = acc
-        # drain the last (up to) two in-flight output DMAs
+        # drain the in-flight output DMAs of the last (up to) nbuf chunks,
+        # oldest first — chunk last-d exists only when nchunks > d
         last = jnp.int32(nchunks - 1)
-
-        @pl.when(jnp.int32(nchunks) >= 2)
-        def _():
-            pltpu.make_async_copy(
-                osc.at[lax_rem(last + 1)], out_ref.at[pl.ds(0, chunk)],
-                sem_out.at[lax_rem(last + 1)]).wait()
+        for d in range(nbuf - 1, 0, -1):
+            @pl.when(jnp.int32(nchunks) >= d + 1)
+            def _(d=d):
+                pltpu.make_async_copy(
+                    osc.at[lax_rem(last - jnp.int32(d))],
+                    out_ref.at[pl.ds(0, chunk)],
+                    sem_out.at[lax_rem(last - jnp.int32(d))]).wait()
 
         pltpu.make_async_copy(
             osc.at[lax_rem(last)], out_ref.at[pl.ds(0, chunk)],
@@ -172,16 +237,16 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
 
     ny, nx = out_ref.shape[1], out_ref.shape[2]
     scratch = [
-        pltpu.VMEM((2, chunk + 2, ny, nx), out_ref.dtype),
-        pltpu.VMEM((2, chunk, ny, nx), out_ref.dtype),
-        pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((nbuf, chunk + 2, ny, nx), out_ref.dtype),
+        pltpu.VMEM((nbuf, chunk, ny, nx), out_ref.dtype),
+        pltpu.SemaphoreType.DMA((nbuf,)),
+        pltpu.SemaphoreType.DMA((nbuf,)),
+        pltpu.SemaphoreType.DMA((nbuf,)),
+        pltpu.SemaphoreType.DMA((nbuf,)),
     ]
     if f_ref is not None:
-        scratch += [pltpu.VMEM((2, chunk, ny, nx), out_ref.dtype),
-                    pltpu.SemaphoreType.DMA((2,))]
+        scratch += [pltpu.VMEM((nbuf, chunk, ny, nx), out_ref.dtype),
+                    pltpu.SemaphoreType.DMA((nbuf,))]
     pl.run_scoped(process, *scratch)
 
 
@@ -247,14 +312,16 @@ def _vmem_limit_params(interpret: bool):
 
 
 def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
-                max_chunk: int | None, banks: int = 4):
+                max_chunk: int | None, streams: int = 2,
+                nbuf: int = 2):
     """z-chunk that divides ``lz`` and keeps the scratch banks
-    (= banks*chunk+4 planes; ``banks`` is 4, or 6 with an f-array) inside
-    the device generation's scratch budget — the one pipeline geometry all
-    entry points share."""
+    (= streams*nbuf*chunk + 2*nbuf planes; ``streams`` is 2 for u+out, or
+    3 with an f-array; ``nbuf`` the pipeline depth) inside the device
+    generation's scratch budget — the one pipeline geometry all entry
+    points share."""
     plane = ny * nx * itemsize
     vmem_budget = _vmem_plan(_tpu_device_kind())[1]
-    budget = int((vmem_budget // plane - 4) // banks)
+    budget = int((vmem_budget // plane - 2 * nbuf) // (streams * nbuf))
     if max_chunk is not None:
         budget = min(budget, max_chunk)   # test hook: force multi-chunk paths
     chunk = max(1, min(lz, budget))
@@ -263,19 +330,25 @@ def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
     return chunk, lz // chunk
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
 def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
                            interpret: bool = False,
-                           max_chunk: int | None = None):
+                           max_chunk: int | None = None,
+                           nbuf: int | None = None):
     """Apply the 7-point stencil to the local slab ``u`` of shape
     ``(lz, ny, nx)`` with neighbour planes ``halo_lo``/``halo_hi`` of shape
     ``(1, ny, nx)``. Returns the (lz, ny, nx) result.
 
     ``interpret=True`` runs the kernel through the Pallas interpreter on any
     backend — used by CI to pin the DMA pipeline's correctness off-TPU.
+    ``nbuf`` overrides the pipeline depth (default: the
+    ``TPU_SOLVE_STENCIL_NBUF`` plan, see :func:`_pipeline_depth`).
     """
-    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk)
-    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
+    nbuf = nbuf or _pipeline_depth()
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 nbuf=nbuf)
+    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks,
+                               nbuf=nbuf)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
@@ -286,20 +359,24 @@ def stencil3d_apply_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
     )(u, halo_lo, halo_hi)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
 def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
                          interpret: bool = False,
-                         max_chunk: int | None = None):
+                         max_chunk: int | None = None,
+                         nbuf: int | None = None):
     """Fused stencil apply + local dot: returns ``(A u, <u, A u>_local)``.
 
-    Same double-buffered DMA pipeline as :func:`stencil3d_apply_pallas`; the
+    Same ``nbuf``-deep DMA pipeline as :func:`stencil3d_apply_pallas`; the
     ``<p, Ap>`` reduction CG needs every iteration is accumulated chunk by
     chunk while both operands are VMEM-resident, saving the two extra HBM
     read passes of a separate dot (the hot-loop fusion SURVEY.md §3.5 calls
     for). The partial is local to the shard — psum it over the mesh axis.
     """
-    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk)
-    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks)
+    nbuf = nbuf or _pipeline_depth()
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 nbuf=nbuf)
+    kernel = functools.partial(_stencil_kernel, chunk=chunk, nchunks=nchunks,
+                               nbuf=nbuf)
 
     def kern(u_ref, lo_ref, hi_ref, out_ref, dot_ref):
         kernel(u_ref, lo_ref, hi_ref, out_ref, dot_ref=dot_ref)
@@ -330,7 +407,7 @@ def stencil3d_smooth_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
     once (~3.3 HBM passes), where stencil-apply + XLA update chain costs
     ~5.5 + 4 passes."""
     chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
-                                 banks=6)
+                                 streams=3)
     # the scalar is built INSIDE the kernel from the static float — a traced
     # closure constant would be rejected by pallas_call
     kernel = functools.partial(
@@ -358,7 +435,7 @@ def stencil3d_residual_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
     """Residual in ONE streamed pass: ``f - A u`` (the V-cycle's
     pre-restriction residual; same fusion rationale as the smooth sweep)."""
     chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
-                                 banks=6)
+                                 streams=3)
     kernel = functools.partial(
         _stencil_kernel, chunk=chunk, nchunks=nchunks,
         combine=lambda uc, y, fc: fc - y)
@@ -374,6 +451,17 @@ def stencil3d_residual_pallas(u, f, halo_lo, halo_hi, lz: int, ny: int,
         compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, halo_lo, halo_hi, f)
+
+
+def fullrestrict_supported(ny: int, nx: int, dtype,
+                           platform: str | None = None) -> bool:
+    """Gate for :func:`stencil3d_residual_restrict_pallas`: on top of the
+    base kernel support the COARSE planes must stay (8, 128)-tileable —
+    ``ny % 16 == 0`` and ``nx % 256 == 0`` (true for the fine levels of
+    the production 512³/256³ grids; smaller levels fall back to the
+    z-only fusion + y/x einsums)."""
+    return (pallas_supported(ny, nx, dtype, platform)
+            and ny % 16 == 0 and nx % 256 == 0)
 
 
 def pallas_supported(ny: int, nx: int, dtype, platform: str | None = None
@@ -507,25 +595,61 @@ def _halo2_scratch(chunk: int, out_planes: int, ny: int, nx: int, dtype):
     ]
 
 
+def _chunk_coarse_z(uext, fext, c, chunk, nchunks, rscale, dtype):
+    """z-restricted residual of one extended chunk, shared by the fused
+    restriction kernels: from the (chunk+4)-plane u bank and the
+    (chunk+2)-plane f bank of chunk ``c``, compute ``r = f - A u`` on the
+    (chunk+2) extended planes in VMEM and return the (chunk/2, ny, nx)
+    z-restricted coarse planes
+    ``coarse[i] = s·(0.75·(r[2i]+r[2i+1]) + 0.25·(r[2i-1]+r[2i+2]))``
+    (solvers/mg._r1d weights, zero ghosts)."""
+    cc = chunk // 2
+    ny, nx = uext.shape[1], uext.shape[2]
+    six = jnp.asarray(6.0, dtype)
+    # the u planes just below/above the domain are Dirichlet zero
+    # ghosts feeding r at the first/last interior plane — stale
+    # scratch there is masked on the VALUE (Mosaic rejects
+    # compound-indexed scratch stores under cond); the outermost
+    # planes (0 / chunk+3) feed only the masked rext end planes
+    urow = jax.lax.broadcasted_iota(jnp.int32, (chunk + 4, 1, 1), 0)
+    uext = jnp.where((urow <= 1) & (c == 0), 0.0, uext)
+    uext = jnp.where((urow >= jnp.int32(chunk + 2))
+                     & (c == nchunks - 1), 0.0, uext)
+    u = uext[1:-1]                       # planes [z0-1, z0+chunk]
+    y = (six * u - uext[:-2] - uext[2:]
+         - _shift_y(u, -1) - _shift_y(u, +1)
+         - _shift_x(u, -1) - _shift_x(u, +1))
+    rext = fext - y                      # (chunk+2, ny, nx)
+    # r ghosts beyond the global domain are exactly zero
+    zrow = jax.lax.broadcasted_iota(jnp.int32, (chunk + 2, 1, 1), 0)
+    rext = jnp.where((zrow == 0) & (c == 0), 0.0, rext)
+    rext = jnp.where((zrow == jnp.int32(chunk + 1))
+                     & (c == nchunks - 1), 0.0, rext)
+    # coarse[j] over rext indices (2j, 2j+1, 2j+2, 2j+3)
+    lowpair = rext[:-2].reshape(cc, 2, ny, nx)
+    highpair = rext[2:].reshape(cc, 2, ny, nx)
+    return jnp.asarray(rscale, dtype) * (
+        0.25 * (lowpair[:, 0] + highpair[:, 1])
+        + 0.75 * (lowpair[:, 1] + highpair[:, 0]))
+
+
 def _resid_zrestrict_kernel(u_ref, f_ref, out_ref, chunk, nchunks, rscale):
     """Fused ``r = f - A u`` + one-axis z-restriction, manual-DMA pipeline.
 
     Round-5 V-cycle optimization: the fine residual never touches HBM —
     each chunk computes r on (chunk+2) extended planes in VMEM and writes
-    only the (chunk/2) z-restricted coarse planes
-    ``coarse[i] = s·(0.75·(r[2i]+r[2i+1]) + 0.25·(r[2i-1]+r[2i+2]))``
-    (solvers/mg._r1d weights, zero ghosts), saving the r write + the
-    z-einsum's r read (~2 fine HBM passes per cycle). SINGLE-DEVICE slabs
-    only: the ghost planes are the global Dirichlet zeros; a sharded slab
-    would need 2-deep u halos (the separate residual+restrict passes keep
-    the 1-plane exchange there).
+    only the (chunk/2) z-restricted coarse planes (see
+    :func:`_chunk_coarse_z`), saving the r write + the z-einsum's r read
+    (~2 fine HBM passes per cycle). SINGLE-DEVICE slabs only: the ghost
+    planes are the global Dirichlet zeros; a sharded slab would need
+    2-deep u halos (the separate residual+restrict passes keep the
+    1-plane exchange there).
     """
     ny, nx = out_ref.shape[1], out_ref.shape[2]
     cc = chunk // 2
 
     def process(usc, fsc, osc, sem_u, sem_ul, sem_uh, sem_f, sem_fl,
                 sem_fh, sem_out):
-        six = jnp.asarray(6.0, out_ref.dtype)
         start_in, wait_in = _mk_halo2_io(
             u_ref, f_ref, usc, fsc, sem_u, sem_ul, sem_uh, sem_f,
             sem_fl, sem_fh, chunk, nchunks)
@@ -544,34 +668,8 @@ def _resid_zrestrict_kernel(u_ref, f_ref, out_ref, chunk, nchunks, rscale):
                 start_in(c + 1, nslot)
 
             wait_in(c, slot)
-            uext = usc[slot]                     # (chunk+4, ny, nx)
-            # the u planes just below/above the domain are Dirichlet zero
-            # ghosts feeding r at the first/last interior plane — stale
-            # scratch there is masked on the VALUE (Mosaic rejects
-            # compound-indexed scratch stores under cond); the outermost
-            # planes (0 / chunk+3) feed only the masked rext end planes
-            urow = jax.lax.broadcasted_iota(jnp.int32,
-                                            (chunk + 4, 1, 1), 0)
-            uext = jnp.where((urow <= 1) & (c == 0), 0.0, uext)
-            uext = jnp.where((urow >= jnp.int32(chunk + 2))
-                             & (c == nchunks - 1), 0.0, uext)
-            u = uext[1:-1]                       # planes [z0-1, z0+chunk]
-            y = (six * u - uext[:-2] - uext[2:]
-                 - _shift_y(u, -1) - _shift_y(u, +1)
-                 - _shift_x(u, -1) - _shift_x(u, +1))
-            rext = fsc[slot] - y                 # (chunk+2, ny, nx)
-            # r ghosts beyond the global domain are exactly zero
-            zrow = jax.lax.broadcasted_iota(jnp.int32,
-                                            (chunk + 2, 1, 1), 0)
-            rext = jnp.where((zrow == 0) & (c == 0), 0.0, rext)
-            rext = jnp.where((zrow == jnp.int32(chunk + 1))
-                             & (c == nchunks - 1), 0.0, rext)
-            # coarse[j] over rext indices (2j, 2j+1, 2j+2, 2j+3)
-            lowpair = rext[:-2].reshape(cc, 2, ny, nx)
-            highpair = rext[2:].reshape(cc, 2, ny, nx)
-            coarse = jnp.asarray(rscale, out_ref.dtype) * (
-                0.25 * (lowpair[:, 0] + highpair[:, 1])
-                + 0.75 * (lowpair[:, 1] + highpair[:, 0]))
+            coarse = _chunk_coarse_z(usc[slot], fsc[slot], c, chunk,
+                                     nchunks, rscale, out_ref.dtype)
 
             @pl.when(c >= 2)
             def _():
@@ -623,6 +721,142 @@ def stencil3d_residual_zrestrict_pallas(u, f, lz: int, ny: int, nx: int,
         compiler_params=_vmem_limit_params(interpret),
         interpret=interpret,
     )(u, f)
+
+
+def _resid_restrict3_kernel(u_ref, f_ref, wyt_ref, wx_ref, out_ref, chunk,
+                            nchunks, rscale):
+    """Fused ``r = f - A u`` + FULL 3-axis restriction (round 6): the
+    coarse RHS is produced from the same VMEM-resident fine chunks as the
+    residual itself — neither the fine residual NOR any intermediate
+    (half-restricted) array ever touches HBM.
+
+    Per chunk: the z-restricted coarse planes come from
+    :func:`_chunk_coarse_z`; the y/x restrictions are then two MXU matmuls
+    per coarse plane with the banded transfer matrices (``wyt`` is the
+    (ny/2, ny) TRANSPOSED one-axis restriction matrix, ``wx`` the
+    (nx, nx/2) one — solvers/mg._tmat, weights identical to the einsum
+    path), statically unrolled over the chunk's coarse planes while the
+    z-restricted values are still in VMEM. The kernel writes only
+    (chunk/2, ny/2, nx/2) — 1/8 of a fine pass — where the round-5 z-only
+    fusion still wrote and re-read the (lz/2, ny, nx) intermediate
+    (~1 fine pass of extra traffic per V-cycle at 512³).
+
+    SINGLE-DEVICE slabs only, like the z-only variant (the zero Dirichlet
+    ghosts are built in).
+    """
+    ny, nx = u_ref.shape[1], u_ref.shape[2]
+    cc = chunk // 2
+    nyc, nxc = out_ref.shape[1], out_ref.shape[2]
+
+    def process(usc, fsc, osc, sem_u, sem_ul, sem_uh, sem_f, sem_fl,
+                sem_fh, sem_out):
+        start_in, wait_in = _mk_halo2_io(
+            u_ref, f_ref, usc, fsc, sem_u, sem_ul, sem_uh, sem_f,
+            sem_fl, sem_fh, chunk, nchunks)
+
+        def lax_rem(c):
+            return jax.lax.rem(c, jnp.int32(2))
+
+        start_in(jnp.int32(0), jnp.int32(0))
+
+        def body(c, carry):
+            slot = lax_rem(c)
+            nslot = lax_rem(c + 1)
+
+            @pl.when(c + 1 < nchunks)
+            def _():
+                start_in(c + 1, nslot)
+
+            wait_in(c, slot)
+            dt = out_ref.dtype
+            coarse_z = _chunk_coarse_z(usc[slot], fsc[slot], c, chunk,
+                                       nchunks, rscale, dt)
+            wyt = wyt_ref[...]               # (nyc, ny)
+            wx = wx_ref[...]                 # (nx, nxc)
+            # per-plane (nyc,ny)@(ny,nx)@(nx,nxc) — static unroll keeps
+            # every operand a clean rank-2 MXU shape (a batched 3-D
+            # contraction would need relayout transposes Mosaic handles
+            # poorly on the minor dims)
+            planes = []
+            for j in range(cc):
+                t = jax.lax.dot(wyt, coarse_z[j],
+                                preferred_element_type=dt)
+                planes.append(jax.lax.dot(t, wx,
+                                          preferred_element_type=dt))
+            out = jnp.stack(planes)
+
+            @pl.when(c >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    osc.at[slot], out_ref.at[pl.ds(0, cc)],
+                    sem_out.at[slot]).wait()
+            osc[slot] = out
+            pltpu.make_async_copy(
+                osc.at[slot], out_ref.at[pl.ds(c * jnp.int32(cc), cc)],
+                sem_out.at[slot]).start()
+            return carry
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                          jnp.int32(0))
+        last = jnp.int32(nchunks - 1)
+
+        @pl.when(jnp.int32(nchunks) >= 2)
+        def _():
+            pltpu.make_async_copy(
+                osc.at[lax_rem(last + 1)], out_ref.at[pl.ds(0, cc)],
+                sem_out.at[lax_rem(last + 1)]).wait()
+
+        pltpu.make_async_copy(
+            osc.at[lax_rem(last)], out_ref.at[pl.ds(0, cc)],
+            sem_out.at[lax_rem(last)]).wait()
+
+    scratch = [
+        pltpu.VMEM((2, chunk + 4, ny, nx), out_ref.dtype),
+        pltpu.VMEM((2, chunk + 2, ny, nx), out_ref.dtype),
+        pltpu.VMEM((2, cc, nyc, nxc), out_ref.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    pl.run_scoped(process, *scratch)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))
+def stencil3d_residual_restrict_pallas(u, f, wyt, wx, lz: int, ny: int,
+                                       nx: int, rscale: float,
+                                       interpret: bool = False,
+                                       max_chunk: int | None = None):
+    """Fused residual + FULL 3-axis restriction for SINGLE-DEVICE slabs:
+    ``restrict(f - A u)`` with solvers/mg's transfer weights and zero
+    ghosts, returning the (lz/2, ny/2, nx/2) coarse RHS without the fine
+    residual or any intermediate ever touching HBM (see
+    :func:`_resid_restrict3_kernel`). ``wyt``/``wx`` are the transposed-y
+    and x one-axis restriction matrices (mg._tmat(ny).T / mg._tmat(nx))."""
+    if lz % 2 or ny % 2 or nx % 2:
+        raise ValueError(f"fused 3-axis restriction needs even dims, got "
+                         f"({lz}, {ny}, {nx})")
+    chunk, nchunks = _pick_chunk_zrestrict(lz, u.dtype.itemsize, ny, nx,
+                                           max_chunk)
+    kernel = functools.partial(_resid_restrict3_kernel, chunk=chunk,
+                               nchunks=nchunks, rscale=rscale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((lz // 2, ny // 2, nx // 2),
+                                       u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  # the two small transfer matrices ride the automatic
+                  # VMEM staging (≤ ~0.5 MB each at 512³)
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(u, f, wyt, wx)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))

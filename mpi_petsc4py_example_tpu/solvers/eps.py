@@ -58,6 +58,7 @@ from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
 from ..resilience import faults as _faults
+from ..utils import aot as _aot
 from ..utils.convergence import SolveResult
 from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
@@ -105,6 +106,19 @@ def _op_key(op):
     return (op.shape[0], str(op.dtype), op.program_key())
 
 
+def _aot_operand_shapes(op, inner=None):
+    """Shape/dtype fingerprint of the device operand arrays — part of the
+    AOT blob key. ``_op_key`` pins the logical operator (n, dtype, layout
+    kind) but NOT the operand geometry an exported program is specialized
+    to (e.g. the ELL width K, the DIA diagonal count): two same-n
+    operators with different sparsity would otherwise collide on one blob
+    and the load-time program would reject the other's arrays."""
+    leaves = list(jax.tree_util.tree_leaves(op.device_arrays()))
+    if inner is not None:
+        leaves += jax.tree_util.tree_leaves(inner.device_arrays())
+    return tuple((tuple(a.shape), str(a.dtype)) for a in leaves)
+
+
 def _facto_steps(spmv, b_apply, axis, ncv):
     """The shared CGS2 Arnoldi/Lanczos continuation body: run steps
     ``k..ncv-1`` on (V, H). Used by every fused program variant."""
@@ -148,7 +162,11 @@ def _build_seed_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
     (V, H)`` — builds the (ncv+1, n_pad) basis on device from the flat
     start vector and runs all ncv steps in the same program (one
     compile-cache entry + one dispatch instead of two; the remote-runtime
-    round trip is ~100 ms each)."""
+    round trip is ~100 ms each).
+
+    AOT-cached (utils/aot): this and the restart-facto program are the two
+    fixed-shape programs a fresh cfg2-style driver process pays tracing +
+    lowering for — a prior process's export loads in their place."""
     axis = comm.axis
     key = ("seedfacto", comm.mesh, axis, ncv, _op_key(op),
            _op_key(inner) if inner is not None else None)
@@ -175,6 +193,9 @@ def _build_seed_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
         local_fn,
         in_specs=(op_specs, b_specs, P(axis)),
         out_specs=(P(None, axis), P())))
+    prog = _aot.wrap("seedfacto", comm,
+                     key[3:] + (_aot_operand_shapes(op, inner),), prog,
+                     code=_aot.source_fingerprint(__file__))
     _PROGRAM_CACHE[key] = prog
     return prog
 
@@ -213,6 +234,9 @@ def _build_restart_facto_program(comm: DeviceComm, op, ncv: int, inner=None):
         local_fn,
         in_specs=(op_specs, b_specs, P(None, axis), P(), P(), P()),
         out_specs=(P(None, axis), P())))
+    prog = _aot.wrap("restartfacto", comm,
+                     key[3:] + (_aot_operand_shapes(op, inner),), prog,
+                     code=_aot.source_fingerprint(__file__))
     _PROGRAM_CACHE[key] = prog
     return prog
 
@@ -1697,7 +1721,11 @@ class EPS:
             nconv = 0
             while nconv < min(self.nev, m) and rel[order0[nconv]] <= self.tol:
                 nconv += 1
-            self._emit_monitor(it, nconv, theta[order0], rel[order0])
+            if self._monitored():
+                # guarded like the krylovschur/arnoldi/subspace sites: the
+                # fancy-indexed args are O(m) work per iteration that an
+                # unmonitored solve must not pay (ADVICE r5)
+                self._emit_monitor(it, nconv, theta[order0], rel[order0])
             if nconv >= min(self.nev, m) or it == self.max_it:
                 break
             W = T_apply(R)
@@ -1784,8 +1812,15 @@ class EPS:
         dtype = np.dtype(str(op.dtype))
         hdt = host_dtype(dtype)
         npad = comm.padded_size(n)
-        # the restart bound honors a user ncv exactly (docstring contract);
-        # m+1 is the minimum that still leaves room for one new direction
+        # the restart bound honors a user ncv exactly (docstring contract):
+        # an explicit ncv that leaves no room for even one new direction
+        # past the block is an ERROR, not a silent raise to m+1 — the
+        # _GD_BS_CAP discipline (ADVICE r5)
+        if self.ncv is not None and min(self.ncv, n) <= m < n:
+            raise ValueError(
+                f"EPS 'gd': ncv ({self.ncv}) must exceed the expansion "
+                f"block size ({m}) — raise -eps_ncv or shrink "
+                "-eps_gd_blocksize/nev")
         mmax = min(n, max(self._effective_ncv(n), m + 1))
         sign = -1.0 if self._which == EPSWhich.LARGEST_REAL else 1.0
 
@@ -1836,7 +1871,8 @@ class EPS:
             nconv = 0
             while nconv < min(self.nev, m) and rel[nconv] <= self.tol:
                 nconv += 1
-            self._emit_monitor(it, nconv, theta, rel)
+            if self._monitored():          # same guard as the sibling sites
+                self._emit_monitor(it, nconv, theta, rel)
             if nconv >= min(self.nev, m) or it == self.max_it:
                 break                      # no discarded final expansion
             if V.shape[0] + 1 > mmax:

@@ -345,22 +345,36 @@ def _restrict(r, lo=None, hi=None, platform=None):
 
 
 def _residual_restrict_fused(u, f, platform=None):
-    """Fine residual + full restriction with the Z-AXIS restriction fused
-    INTO the residual kernel (round 5): the fine residual never touches
-    HBM — the kernel writes only z-restricted coarse planes
-    (ops/pallas_stencil.stencil3d_residual_zrestrict_pallas), and the y/x
-    einsum stages then run on HALF the data. Saves the r write + the
-    z-einsum's r read (~2 fine HBM passes per cycle at 512³).
+    """Fine residual + full restriction fused INTO the residual kernel.
+
+    Round 6: where the level shape allows it
+    (ops/pallas_stencil.fullrestrict_supported) the ENTIRE 3-axis
+    restriction runs inside the residual kernel's VMEM-resident chunks
+    (stencil3d_residual_restrict_pallas — in-kernel MXU matmuls with the
+    same _tmat weights): the kernel reads u and f once and writes only the
+    (lz/2, ny/2, nx/2) coarse RHS, so neither the fine residual nor the
+    half-restricted intermediate ever touches HBM (~3 fine passes saved
+    vs separate residual+restrict, ~1 vs the round-5 z-only fusion).
+
+    Round-5 fallback tier: z-axis restriction fused into the kernel
+    (stencil3d_residual_zrestrict_pallas) with the y/x einsum stages on
+    HALF the data. Final tier: separate residual + restrict passes.
 
     SINGLE-DEVICE slabs only (zero Dirichlet ghosts are built into the
-    kernel; a sharded slab would need 2-deep u halos — the slab cycle
+    kernels; a sharded slab would need 2-deep u halos — the slab cycle
     keeps the separate residual/restrict passes with 1-plane exchanges).
-    Identical weights to the staged/einsum paths (pinned in
-    tests/test_pallas.py); falls back to them when unsupported.
+    Identical weights across all tiers (pinned in tests/test_pallas.py).
     """
-    from ..ops.pallas_stencil import (pallas_supported,
+    from ..ops.pallas_stencil import (fullrestrict_supported,
+                                      pallas_supported,
+                                      stencil3d_residual_restrict_pallas,
                                       stencil3d_residual_zrestrict_pallas)
     lz, ny, nx = u.shape
+    if (lz % 2 == 0 and _mm_ok(u.dtype, platform)
+            and fullrestrict_supported(ny, nx, u.dtype, platform)):
+        dt = u.dtype
+        return stencil3d_residual_restrict_pallas(
+            u, f, _tmat(ny, dt).T, _tmat(nx, dt), lz, ny, nx, _RSCALE)
     if (lz % 2 == 0 and pallas_supported(ny, nx, u.dtype, platform)
             and _mm_ok(u.dtype, platform)):
         rz = stencil3d_residual_zrestrict_pallas(u, f, lz, ny, nx, _RSCALE)

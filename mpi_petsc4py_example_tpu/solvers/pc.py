@@ -777,7 +777,10 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
     if _want_device_setup(comm, mat.dtype, setup_device, f64_ok=True):
         import time
         t0 = time.perf_counter()
-        blocks = None
+        # NOT named `blocks`: that is the int option parameter above, and
+        # shadowing it with the (M, bs, bs) stack invited confusing the
+        # two on any reorder (ADVICE r5)
+        blk_stack = None
         if (getattr(mat, "ell_cols", None) is not None
                 and mat.ell_cols.shape[0] == bs * comm.size * nb):
             # extract the diagonal blocks FROM the device-resident ELL —
@@ -785,17 +788,18 @@ def _build_bjacobi(comm: DeviceComm, mat: Mat, blocks: int = 0,
             # scale, for data the device already holds); note no
             # to_scipy() either, which would host-fetch the whole ELL
             try:
-                blocks = _ell_diag_blocks(mat.ell_cols, mat.ell_vals, bs, n)
+                blk_stack = _ell_diag_blocks(mat.ell_cols, mat.ell_vals,
+                                             bs, n)
             except (RuntimeError, ValueError, TypeError):
                 # device gather/compile failed — host extraction still works
-                blocks = None
-        if blocks is None:
-            blocks = _dense_diag_blocks(mat.to_scipy().tocsr(), n, bs,
-                                        comm.size * nb,
-                                        np.dtype(mat.dtype))
-            dense = blocks
+                blk_stack = None
+        if blk_stack is None:
+            blk_stack = _dense_diag_blocks(mat.to_scipy().tocsr(), n, bs,
+                                           comm.size * nb,
+                                           np.dtype(mat.dtype))
+            dense = blk_stack
         t1 = time.perf_counter()
-        shipped = _device_inverse_blocks(comm, blocks)
+        shipped = _device_inverse_blocks(comm, blk_stack)
         if shipped is not None:
             if owner is not None:
                 owner.setup_mode = "device"   # observability (view/bench)

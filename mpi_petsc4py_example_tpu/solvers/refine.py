@@ -92,6 +92,7 @@ class RefinedKSP:
         total_inner = 0
         rnorm = bnorm
         reason = ConvergedReason.DIVERGED_MAX_IT
+        it = 0
         for it in range(1, self.max_refine + 1):
             r = b - A @ x                       # exact fp64 residual
             rnorm = np.linalg.norm(r)
@@ -113,6 +114,9 @@ class RefinedKSP:
                           else ConvergedReason.DIVERGED_BREAKDOWN)
                 break
         wall = time.perf_counter() - t0
+        # observability for the bench artifact (cfg6): how many fp64 outer
+        # corrections the inner-iteration total splits across
+        self.refine_steps = it
         self.result = SolveResult(total_inner, float(rnorm), int(reason),
                                   wall)
         return x, self.result

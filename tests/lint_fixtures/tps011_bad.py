@@ -1,0 +1,19 @@
+"""Fixture: independent adjacent psums that could stack (TPS011 fires)."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def two_statements(x, y, axis):
+    a = lax.psum(x * x, axis)        # ok (first)
+    b = lax.psum(y * y, axis)        # BAD: TPS011
+    return a + b
+
+
+def one_statement(x, y, axis):
+    return lax.psum(x, axis) + lax.psum(y, axis)   # BAD: TPS011
+
+
+def mixed_reductions(x, y, axis):
+    hi = lax.pmax(x, axis)
+    lo = lax.pmin(y, axis)           # BAD: TPS011
+    return hi - lo

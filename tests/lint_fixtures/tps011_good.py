@@ -1,0 +1,33 @@
+"""Fixture: psum patterns TPS011 must NOT flag."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def stacked(x, y, axis):
+    # the idiom the rule recommends
+    s = lax.psum(jnp.stack([x, y]), axis)
+    return s[0] + s[1]
+
+
+def dependent(x, axis):
+    # the second reduction consumes the first — cannot fuse
+    nrm = lax.psum(x * x, axis)
+    return lax.psum(x / nrm, axis)
+
+
+def nested_dependent(x, y, axis):
+    # same dependence in one expression (the normalization idiom)
+    return lax.psum(x / lax.psum(y, axis), axis)
+
+
+def different_axes(x, y, ax_rows, ax_cols):
+    a = lax.psum(x, ax_rows)
+    b = lax.psum(y, ax_cols)
+    return a + b
+
+
+def separated(x, y, axis):
+    a = lax.psum(x, axis)
+    y = y * 2.0
+    b = lax.psum(y + 0.0, axis)      # not adjacent: a statement between
+    return a + b

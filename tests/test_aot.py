@@ -1,0 +1,165 @@
+"""AOT export/deserialize of the fixed-shape EPS programs (utils/aot).
+
+Round-6 cold-start lever: a fresh cfg2-style process pays tracing +
+lowering for the seed+facto and compress+facto programs; utils/aot
+serializes each program's StableHLO once (jax.export) and later processes
+deserialize it instead of re-tracing. These tests pin the disk round trip
+(bit-identical results), the key discipline (mesh/code fingerprints), the
+silent fallback on corrupt blobs, and the TPU_SOLVE_AOT=0 kill switch.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import tridiag_family
+from mpi_petsc4py_example_tpu.solvers import eps as eps_mod
+from mpi_petsc4py_example_tpu.utils import aot
+
+
+@pytest.fixture()
+def aot_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "aot")
+    monkeypatch.setenv("TPU_SOLVE_AOT_DIR", d)
+    monkeypatch.setenv("TPU_SOLVE_AOT", "1")
+    # the facto programs are cached per (mesh, ncv, op) — drop them so
+    # every test goes through the aot.wrap build path
+    eps_mod._PROGRAM_CACHE.clear()
+    yield d
+    eps_mod._PROGRAM_CACHE.clear()
+
+
+def _blobs(d):
+    return sorted(f for f in os.listdir(d)) if os.path.isdir(d) else []
+
+
+def _build_and_run(comm, ncv=16, seed=3):
+    M = tps.Mat.from_scipy(comm, tridiag_family(100))
+    prog = eps_mod._build_seed_facto_program(comm, M, ncv)
+    v0 = comm.put_rows(np.random.default_rng(seed).random(100))
+    V, H = prog(M.device_arrays(), (), v0)
+    return np.asarray(V), np.asarray(H)
+
+
+class TestAotRoundTrip:
+    def test_export_then_load(self, comm8, aot_dir, monkeypatch):
+        V1, H1 = _build_and_run(comm8)
+        blobs = _blobs(aot_dir)
+        assert len(blobs) == 1 and blobs[0].endswith(".jaxexport")
+
+        # a second process (simulated: fresh program cache) must LOAD the
+        # blob — an AOT-loaded program never re-exports, so exporting
+        # again is the retrace we are eliminating
+        eps_mod._PROGRAM_CACHE.clear()
+        import jax
+
+        def no_export(*a, **k):
+            raise AssertionError("AOT cache hit must not re-export")
+        monkeypatch.setattr(jax.export, "export", no_export)
+        loads = []
+        real_load = aot._load
+        monkeypatch.setattr(aot, "_load",
+                            lambda p: loads.append(p) or real_load(p))
+        V2, H2 = _build_and_run(comm8)
+        assert len(loads) == 1
+        np.testing.assert_array_equal(H1, H2)
+        np.testing.assert_array_equal(V1, V2)
+
+    def test_full_eigensolve_parity(self, comm8, aot_dir, monkeypatch):
+        """End-to-end krylovschur via the HOST-loop flow (the cfg2/TPU
+        small-n path AOT targets — the CPU mesh would default to the
+        fused whole-solve program) populates the facto blobs; a
+        fresh-cache solve from the blobs returns the identical
+        eigenvalue."""
+        monkeypatch.setenv("TPU_SOLVE_EPS_FUSED", "0")
+        CSR = tridiag_family(100)
+
+        def eig_once():
+            M = tps.Mat.from_scipy(comm8, CSR)
+            e = tps.EPS().create(comm8)
+            e.set_operators(M)
+            e.set_problem_type("hep")
+            e.solve()
+            assert e.get_converged() >= 1
+            return float(e.get_eigenvalue(0).real)
+
+        lam1 = eig_once()
+        assert len(_blobs(aot_dir)) >= 1      # seed-facto at minimum
+        eps_mod._PROGRAM_CACHE.clear()
+        lam2 = eig_once()
+        assert lam1 == lam2
+        lam_np = np.linalg.eigvalsh(CSR.toarray())
+        lam_np = lam_np[np.argmax(np.abs(lam_np))]
+        assert abs(lam1 - lam_np) / abs(lam_np) <= 1e-10
+
+    def test_corrupt_blob_falls_back(self, comm8, aot_dir):
+        V1, H1 = _build_and_run(comm8)
+        (blob,) = _blobs(aot_dir)
+        with open(os.path.join(aot_dir, blob), "wb") as fh:
+            fh.write(b"not a jax export")
+        eps_mod._PROGRAM_CACHE.clear()
+        V2, H2 = _build_and_run(comm8)        # silent re-trace
+        np.testing.assert_array_equal(H1, H2)
+
+    def test_stale_blob_shape_mismatch_falls_back(self, comm8, aot_dir):
+        """A blob whose key_parts failed to pin some operand geometry must
+        never crash the caller: the loaded program's shape rejection falls
+        back to the traced program (and re-exports this geometry)."""
+        import jax
+        import jax.numpy as jnp
+        f1 = jax.jit(lambda x: x * 2.0)
+        w1 = aot.wrap("collide", comm8, ("unpinned",), f1)
+        w1(jnp.arange(8.0))                   # export specialized to (8,)
+        assert len(_blobs(aot_dir)) == 1
+        f2 = jax.jit(lambda x: x * 2.0)
+        w2 = aot.wrap("collide", comm8, ("unpinned",), f2)  # loads blob
+        out = w2(jnp.arange(4.0))             # (4,) != (8,): must not raise
+        np.testing.assert_allclose(np.asarray(out), np.arange(4.0) * 2.0)
+
+    def test_key_pins_operand_geometry(self, comm8, aot_dir):
+        """Two same-n, same-layout-kind operators with different ELL
+        widths must key to DIFFERENT blobs (the exported program is
+        shape-specialized, unlike the shape-polymorphic jitted builder)."""
+        import scipy.sparse as sp
+        rng = np.random.default_rng(0)
+        for density in (0.03, 0.2):
+            A = sp.random(100, 100, density=density, random_state=rng,
+                          format="csr") + sp.eye(100) * 10
+            M = tps.Mat.from_scipy(comm8, A.tocsr())
+            assert M.dia_vals is None
+            prog = eps_mod._build_seed_facto_program(comm8, M, 16)
+            v0 = comm8.put_rows(np.random.default_rng(1).random(100))
+            prog(M.device_arrays(), (), v0)
+            eps_mod._PROGRAM_CACHE.clear()
+        assert len(_blobs(aot_dir)) == 2
+
+    def test_key_pins_ncv_and_code(self, comm8, aot_dir):
+        _build_and_run(comm8, ncv=16)
+        _build_and_run(comm8, ncv=12)
+        assert len(_blobs(aot_dir)) == 2      # distinct program keys
+        d1 = aot._digest("seedfacto", comm8, (16,), code="a")
+        d2 = aot._digest("seedfacto", comm8, (16,), code="b")
+        assert d1 != d2                       # code fingerprint in the key
+
+
+class TestAotGates:
+    def test_disabled_env(self, comm8, aot_dir, monkeypatch):
+        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+        sentinel = object()
+        assert aot.wrap("k", comm8, (), sentinel) is sentinel
+        _build_and_run(comm8)
+        assert _blobs(aot_dir) == []          # nothing written
+
+    def test_atomic_store_layout(self, comm8, aot_dir):
+        _build_and_run(comm8)
+        # no .tmp residue from the atomic publish
+        assert all(not f.endswith(".tmp") for f in _blobs(aot_dir))
+
+    def test_source_fingerprint(self):
+        fp = aot.source_fingerprint(eps_mod.__file__)
+        assert len(fp) == 64
+        assert fp == aot.source_fingerprint(eps_mod.__file__)  # cached
+        assert aot.source_fingerprint("/nonexistent/mod.py") == \
+            "/nonexistent/mod.py"

@@ -69,8 +69,51 @@ class TestSetupDeviceFactors:
         Ab = np.random.default_rng(0).random((n, b, b))
         Bb = np.zeros((n, b, b))
         Cb = np.zeros((n, b, b))
-        out = tridiag.bpcr_setup_device(Ab, Bb, Cb, comm8, np.float64)
+        with pytest.warns(RuntimeWarning, match="probe"):
+            out = tridiag.bpcr_setup_device(Ab, Bb, Cb, comm8, np.float64)
         assert out is None
+
+    @staticmethod
+    def _sign_indefinite(n, b, eps, seed=0):
+        """Second adversarial family (round 6, VERDICT weak #7):
+        SIGN-INDEFINITE diagonal blocks diag(±eps, ∓eps) under O(1)
+        off-diagonal coupling. Every intermediate stays finite — unlike
+        the zero-diagonal family, whose probe error is inf — but the
+        pivotless cross-block Schur complements suffer catastrophic
+        element growth as eps shrinks."""
+        rng = np.random.default_rng(seed)
+        Bb = np.zeros((n, b, b))
+        for i in range(n):
+            s = 1.0 if i % 2 == 0 else -1.0
+            Bb[i] = np.diag([eps * s, -eps * s])
+        Ab = rng.standard_normal((n, b, b))
+        Cb = rng.standard_normal((n, b, b))
+        Ab[0] = 0.0
+        Cb[-1] = 0.0
+        return Ab, Bb, Cb
+
+    def test_probe_rejects_sign_indefinite_growth(self, comm8):
+        """The probe gate must also catch FINITE-valued catastrophic
+        growth: at eps=1e-4 the factorization completes with every
+        intermediate finite, yet the probe solve misses A·1 by ~20 —
+        factors that would silently return garbage. None, never that."""
+        Ab, Bb, Cb = self._sign_indefinite(64, 2, 1e-4)
+        with pytest.warns(RuntimeWarning, match="probe"):
+            out = tridiag.bpcr_setup_device(Ab, Bb, Cb, comm8, np.float64)
+        assert out is None
+
+    def test_sign_indefinite_stable_member_passes_with_parity(self, comm8):
+        """The gate is a quality gate, not a symmetry test: the stable end
+        of the same family (eps=1e-2) must factor on device AND match the
+        host factors — rejection of the whole class would silently cost
+        the device speedup on every indefinite operator."""
+        Ab, Bb, Cb = self._sign_indefinite(64, 2, 1e-2)
+        host = tridiag.bpcr_setup(Ab, Bb, Cb, apply_dtype=np.float64)
+        dev = tridiag.bpcr_setup_device(Ab, Bb, Cb, comm8, np.float64)
+        assert dev is not None
+        for h, d in zip(host, dev):
+            np.testing.assert_allclose(np.asarray(d), h, rtol=1e-8,
+                                       atol=1e-8)
 
 
 class TestEndToEnd:

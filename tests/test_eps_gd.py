@@ -117,6 +117,19 @@ class TestGDRestrictions:
         with pytest.raises(ValueError, match="extreme"):
             _gd(comm8, A, "largest_magnitude", nev=1)
 
+    def test_explicit_ncv_at_or_below_block_raises(self, comm8):
+        """ADVICE r5: an explicit user ncv <= the expansion block size
+        cannot be honored — it must raise (the _GD_BS_CAP no-silent-clamp
+        discipline), never be silently lifted to m+1."""
+        A = reference_tridiag(30)
+        with pytest.raises(ValueError, match="ncv"):
+            _gd(comm8, A, "largest_real", nev=4, ncv=4)
+        with pytest.raises(ValueError, match="ncv"):
+            _gd(comm8, A, "largest_real", nev=4, ncv=3)
+        # ncv above the block stays honored exactly
+        E = _gd(comm8, A, "largest_real", nev=4, ncv=9)
+        assert E.get_converged() >= 4
+
     def test_rejects_nhep(self, comm8):
         A = reference_tridiag(30)
         M = tps.Mat.from_scipy(comm8, A)

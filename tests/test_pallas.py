@@ -132,6 +132,53 @@ def test_fused_residual_parity(lz, max_chunk):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("nbuf,lz,max_chunk", [
+    (3, 6, 2),     # depth 3, 3 chunks: one interior (wide-DMA) chunk
+    (3, 8, 1),     # depth 3, 8 single-plane chunks
+    (4, 8, 2),     # depth 4, 4 chunks
+    (4, 4, 4),     # depth deeper than nchunks: drain guards must hold
+])
+def test_pipeline_depth_parity(nbuf, lz, max_chunk):
+    """The nbuf-deep pipeline (TPU_SOLVE_STENCIL_NBUF retuning knob) and
+    the wide contiguous interior DMAs compute exactly what the classic
+    double-buffered 3-way-split pipeline computed."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_apply_pallas, stencil3d_dot_pallas)
+    ny, nx = 8, 128
+    rng = np.random.default_rng(900 + nbuf * 10 + lz)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    lo = rng.random((1, ny, nx)).astype(np.float32)
+    hi = rng.random((1, ny, nx)).astype(np.float32)
+    ref = reference_stencil(u.astype(np.float64), lo.astype(np.float64),
+                            hi.astype(np.float64))
+    y = np.asarray(stencil3d_apply_pallas(
+        jnp.asarray(u), jnp.asarray(lo), jnp.asarray(hi),
+        lz, ny, nx, True, max_chunk, nbuf))
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+    y2, d = stencil3d_dot_pallas(jnp.asarray(u), jnp.asarray(lo),
+                                 jnp.asarray(hi), lz, ny, nx, True,
+                                 max_chunk, nbuf)
+    np.testing.assert_allclose(np.asarray(y2), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(d), float((u.astype(np.float64)
+                                                * ref).sum()),
+                               rtol=1e-4)
+
+
+def test_pipeline_depth_env(monkeypatch):
+    """TPU_SOLVE_STENCIL_NBUF parses defensively and clamps to [2, 4]."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import _pipeline_depth
+    monkeypatch.delenv("TPU_SOLVE_STENCIL_NBUF", raising=False)
+    assert _pipeline_depth() == 2
+    monkeypatch.setenv("TPU_SOLVE_STENCIL_NBUF", "3")
+    assert _pipeline_depth() == 3
+    monkeypatch.setenv("TPU_SOLVE_STENCIL_NBUF", "9")
+    assert _pipeline_depth() == 4
+    monkeypatch.setenv("TPU_SOLVE_STENCIL_NBUF", "1")
+    assert _pipeline_depth() == 2
+    monkeypatch.setenv("TPU_SOLVE_STENCIL_NBUF", "bogus")
+    assert _pipeline_depth() == 2
+
+
 def test_fast_path_gates_key_on_mesh_platform(monkeypatch):
     """ADVICE r4: the Mosaic / einsum fast-path gates must key on the
     platform of the mesh the op runs on, NOT the process default backend —
@@ -188,6 +235,64 @@ def test_fused_residual_zrestrict_parity(lz, ny, nx, max_chunk):
         jnp.asarray(u), jnp.asarray(f), lz, ny, nx, mg._RSCALE,
         True, max_chunk))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("lz,ny,nx,max_chunk", [
+    (4, 8, 128, None),          # single chunk (both edge masks in one)
+    (8, 8, 128, 2),             # multi-chunk: cross-chunk coarse planes
+    (12, 16, 128, 4),
+    (6, 16, 256, 2),            # the production tileable-coarse shape class
+])
+def test_fused_residual_restrict3_parity(lz, ny, nx, max_chunk):
+    """stencil3d_residual_restrict_pallas == mg._restrict(f - A u) with
+    zero Dirichlet ghosts — the round-6 FULL fusion that produces the
+    coarse RHS from the kernel's VMEM-resident fine chunks (neither the
+    residual nor any intermediate hits HBM)."""
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+    from mpi_petsc4py_example_tpu.models.stencil import StencilPoisson3D
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_residual_restrict_pallas)
+    rng = np.random.default_rng(700 + lz + nx)
+    u = rng.random((lz, ny, nx)).astype(np.float32)
+    f = rng.random((lz, ny, nx)).astype(np.float32)
+    z = jnp.zeros((ny, nx), jnp.float64)
+    r = f - StencilPoisson3D._stencil7_jnp(jnp.asarray(u, jnp.float64),
+                                           z, z)
+    ref = np.asarray(mg._restrict(r))
+    dt = jnp.float32
+    out = np.asarray(stencil3d_residual_restrict_pallas(
+        jnp.asarray(u), jnp.asarray(f), mg._tmat(ny, dt).T,
+        mg._tmat(nx, dt), lz, ny, nx, mg._RSCALE, True, max_chunk))
+    assert out.shape == (lz // 2, ny // 2, nx // 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_residual_restrict3_rejects_odd_dims():
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        stencil3d_residual_restrict_pallas)
+    import mpi_petsc4py_example_tpu.solvers.mg as mg
+    u = jnp.zeros((4, 7, 128), jnp.float32)
+    with pytest.raises(ValueError, match="even dims"):
+        stencil3d_residual_restrict_pallas(
+            u, u, mg._tmat(8, jnp.float32).T, mg._tmat(128, jnp.float32),
+            4, 7, 128, mg._RSCALE, True, None)
+
+
+def test_fullrestrict_gate():
+    """The 3-axis fusion additionally needs (8,128)-tileable COARSE
+    planes; shapes that fail it still take the z-only fusion tier."""
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+        fullrestrict_supported, pallas_supported)
+    import jax
+    if jax.default_backend() != "tpu":
+        # gates are platform-keyed; force the TPU branch via the argument
+        assert fullrestrict_supported(16, 256, np.float32,
+                                      platform="tpu") is True
+        assert fullrestrict_supported(8, 128, np.float32,
+                                      platform="tpu") is False
+        assert pallas_supported(8, 128, np.float32, platform="tpu") is True
+    assert fullrestrict_supported(16, 256, np.float32,
+                                  platform="cpu") is False
 
 
 def test_fused_residual_restrict_matches_separate_passes():

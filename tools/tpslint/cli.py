@@ -28,6 +28,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="files or directories to lint")
     p.add_argument("--strict", action="store_true",
                    help="also fail on unused (stale) suppressions")
+    p.add_argument("--warn-budget", type=int, default=None,
+                   metavar="N",
+                   help="fail when warn-tier (advisory) findings exceed N "
+                        "(default: warnings never fail — the CI passes the "
+                        "current count so advisories cannot silently "
+                        "accumulate)")
     p.add_argument("--select", default=None, metavar="TPS001,TPS002",
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
@@ -74,6 +80,8 @@ def main(argv=None) -> int:
         print(f.format())
     for f in result.findings:
         print(f.format())
+    for f in result.warnings:
+        print(f.format())
     for f in result.bad_suppressions:
         print(f.format())
     if args.show_suppressed:
@@ -87,12 +95,19 @@ def main(argv=None) -> int:
 
     n = len(result.findings) + len(result.bad_suppressions) + \
         len(result.errors)
-    code = result.exit_code(strict=args.strict)
-    if n or (args.strict and result.unused_suppressions):
+    nw = len(result.warnings)
+    code = result.exit_code(strict=args.strict,
+                            warn_budget=args.warn_budget)
+    if n or nw or (args.strict and result.unused_suppressions):
         extra = (f", {len(result.unused_suppressions)} unused "
                  "suppression(s)" if args.strict
                  and result.unused_suppressions else "")
-        print(f"tpslint: {n} finding(s){extra}", file=sys.stderr)
+        warn = ""
+        if nw:
+            budget = ("no budget" if args.warn_budget is None
+                      else f"budget {args.warn_budget}")
+            warn = f", {nw} warning(s) ({budget})"
+        print(f"tpslint: {n} finding(s){warn}{extra}", file=sys.stderr)
     elif result.suppressed:
         print(f"tpslint: clean ({len(result.suppressed)} justified "
               "suppression(s))", file=sys.stderr)

@@ -16,7 +16,8 @@ from .rules import all_rules
 class AnalysisResult:
     """Outcome of linting one or more files."""
 
-    findings: list = field(default_factory=list)       # unsuppressed
+    findings: list = field(default_factory=list)       # unsuppressed errors
+    warnings: list = field(default_factory=list)       # unsuppressed warn-tier
     suppressed: list = field(default_factory=list)     # (Finding, Suppression)
     bad_suppressions: list = field(default_factory=list)   # Finding (TPS000)
     unused_suppressions: list = field(default_factory=list)  # Suppression
@@ -25,16 +26,24 @@ class AnalysisResult:
 
     def merge(self, other: "AnalysisResult"):
         self.findings.extend(other.findings)
+        self.warnings.extend(other.warnings)
         self.suppressed.extend(other.suppressed)
         self.bad_suppressions.extend(other.bad_suppressions)
         self.unused_suppressions.extend(other.unused_suppressions)
         self.errors.extend(other.errors)
         self.files_linted += other.files_linted
 
-    def exit_code(self, strict: bool = False) -> int:
+    def exit_code(self, strict: bool = False,
+                  warn_budget: int | None = None) -> int:
+        """Errors always fail; warn-tier findings fail only past an
+        explicit ``--warn-budget`` (None = advisory only, never fails) —
+        the CI shape for rules like TPS011 where existing call sites are
+        acceptable but silent accumulation is not."""
         if self.findings or self.bad_suppressions or self.errors:
             return 1
         if strict and self.unused_suppressions:
+            return 1
+        if warn_budget is not None and len(self.warnings) > warn_budget:
             return 1
         return 0
 
@@ -62,7 +71,8 @@ def analyze_source(source: str, path: str = "<string>",
     for rule in rules.values():
         for f in rule.check(module):
             raw.append(Finding(rule=f.rule, message=f.message,
-                               line=f.line, col=f.col, path=path))
+                               line=f.line, col=f.col, path=path,
+                               severity=f.severity))
     raw.sort(key=lambda f: (f.line, f.col, f.rule))
 
     suppressions = parse_suppressions(source)
@@ -103,6 +113,8 @@ def analyze_source(source: str, path: str = "<string>",
         if sup is not None:
             sup.used = True
             result.suppressed.append((f, sup))
+        elif f.severity == "warn":
+            result.warnings.append(f)
         else:
             result.findings.append(f)
 

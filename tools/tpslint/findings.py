@@ -8,16 +8,24 @@ from dataclasses import dataclass, field
 
 @dataclass(frozen=True)
 class Finding:
-    """One diagnostic: a rule fired at a source location."""
+    """One diagnostic: a rule fired at a source location.
+
+    ``severity``: ``"error"`` (the default — fails the lint) or ``"warn"``
+    (advisory tier, round 6: counted against the CI ``--warn-budget`` but
+    never a failure by itself — the tier advisory rules like TPS011 need,
+    ROADMAP deferred item)."""
 
     rule: str          # "TPS001"
     message: str       # human-readable, one line
     line: int          # 1-based
     col: int           # 0-based (ast convention)
     path: str = ""     # filled in by the engine
+    severity: str = "error"
 
     def format(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        tag = " warning:" if self.severity == "warn" else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} "
+                f"{self.message}")
 
 
 # ``tpslint: disable=TPSnnn`` or ``tpslint: disable=TPSnnn,TPSmmm — why``.

@@ -2,7 +2,8 @@
 
 from .base import Rule, register, registry
 from . import (tps001_host_sync, tps002_recompile, tps003_axis_name,
-               tps004_dtype_drift, tps005_broad_except, tps006_pallas)
+               tps004_dtype_drift, tps005_broad_except, tps006_pallas,
+               tps011_psum_fusion)
 
 
 def all_rules() -> dict:

@@ -28,6 +28,9 @@ class Rule:
     name = "unnamed"
     #: One-line rationale shown by ``tpslint --list-rules``.
     description = ""
+    #: "error" fails the lint; "warn" is the advisory tier (counted
+    #: against the CI --warn-budget, never a failure by itself).
+    severity = "error"
 
     def check(self, module):
         """Yield findings for a :class:`~tools.tpslint.context.ModuleAnalysis`."""
@@ -36,4 +39,5 @@ class Rule:
     def finding(self, node, message: str) -> Finding:
         return Finding(rule=self.id, message=message,
                        line=getattr(node, "lineno", 1),
-                       col=getattr(node, "col_offset", 0))
+                       col=getattr(node, "col_offset", 0),
+                       severity=self.severity)

@@ -1,0 +1,144 @@
+"""TPS011 — adjacent-``lax.psum`` fusion advisory (warn tier).
+
+Every ``lax.psum`` is a cross-device reduction barrier; two independent
+psums over the same axis in adjacent statements cost two collective round
+trips where ONE stacked reduction (``lax.psum(jnp.stack([a, b]), axis)``
+— the krylov.py single-psum idiom, SURVEY.md §3.5) costs one.  The lint
+analog of the round-6 fused-reduction kernel discipline.
+
+Advisory only (``severity = "warn"``): a separate psum is sometimes the
+clearer code and the latency can be negligible off the hot path — the CI
+``--warn-budget`` keeps the *count* from growing silently without
+blocking existing, considered call sites.
+
+The check is deliberately conservative about dependence: when the second
+psum's operand mentions any name the first psum's statement assigns, the
+reductions are sequentially dependent and cannot fuse — no finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES, terminal_name
+from .base import Rule, register
+
+_PSUM_NAMES = {"psum", "pmax", "pmin", "pmean"}
+
+
+def _psum_calls(stmt: ast.stmt):
+    """(call, axis_repr) for every reduction-collective call in ``stmt``,
+    not descending into nested function definitions (their bodies are
+    separate traced scopes)."""
+    out = []
+    if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+        return out      # a def/class STATEMENT executes no reductions
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNCTION_NODES):
+                continue
+            stack.append(child)
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if name not in _PSUM_NAMES:
+            continue
+        axis = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis = kw.value
+        if axis is not None:
+            out.append((node, name, ast.unparse(axis)))
+    return out
+
+
+def _assigned_names(stmt: ast.stmt):
+    names = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.NamedExpr)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+    return names
+
+
+def _used_names(expr: ast.expr):
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _nested(c1: ast.Call, c2: ast.Call) -> bool:
+    """One reduction sits inside the other's argument tree."""
+    return any(n is c2 for n in ast.walk(c1)) or \
+        any(n is c1 for n in ast.walk(c2))
+
+
+@register
+class PsumFusionRule(Rule):
+    id = "TPS011"
+    name = "adjacent-psum-fusion"
+    description = ("independent lax.psum/pmax/pmin calls on the same axis "
+                   "in adjacent statements could fuse into one stacked "
+                   "reduction (advisory — warn tier)")
+    severity = "warn"
+
+    def check(self, module):
+        flagged = set()      # call-node ids: one advisory per call site
+        for body in self._statement_lists(module.tree):
+            prev = None      # (stmt_index, stmt, calls)
+            for i, stmt in enumerate(body):
+                calls = _psum_calls(stmt)
+                if not calls:
+                    continue
+                # several independent psums INSIDE one statement — nested
+                # calls (`psum(x / psum(y, ax), ax)`: the normalization
+                # idiom) are sequentially dependent, never fusible
+                for (c1, n1, ax1), (c2, n2, ax2) in zip(calls, calls[1:]):
+                    if (ax1 == ax2 and id(c2) not in flagged
+                            and not _nested(c1, c2)):
+                        flagged.add(id(c2))
+                        yield self._advise(c2, n1, n2, ax2)
+                if (prev is not None and i - prev[0] == 1
+                        and self._independent(prev[1], stmt, calls)):
+                    for c2, n2, ax2 in calls:
+                        match = [n1 for _, n1, ax1 in prev[2]
+                                 if ax1 == ax2]
+                        if match and id(c2) not in flagged:
+                            flagged.add(id(c2))
+                            yield self._advise(c2, match[0], n2, ax2)
+                            break
+                prev = (i, stmt, calls)
+
+    @staticmethod
+    def _statement_lists(tree):
+        for node in ast.walk(tree):
+            for fieldname in ("body", "orelse", "finalbody"):
+                body = getattr(node, fieldname, None)
+                if isinstance(body, list) and body:
+                    yield body
+
+    @staticmethod
+    def _independent(stmt_a, stmt_b, calls_b) -> bool:
+        """The later psums don't consume names the earlier statement
+        binds — a data dependence makes the pair unfusible."""
+        assigned = _assigned_names(stmt_a)
+        if not assigned:
+            return True
+        for call, _, _ in calls_b:
+            if call.args and _used_names(call.args[0]) & assigned:
+                return False
+        return True
+
+    def _advise(self, node, name1, name2, axis_repr):
+        return self.finding(
+            node,
+            f"adjacent `{name1}`/`{name2}` on axis {axis_repr} — "
+            "independent reductions can stack into ONE collective "
+            "(`lax.psum(jnp.stack([...]), axis)`, the krylov.py "
+            "single-psum idiom): each extra psum is a device-sync round "
+            "trip in the hot loop")
