@@ -98,31 +98,25 @@ def _fixed_iter_solver(nx, max_it):
     return ksp, x, bv
 
 
-def delta_rate(make_solver, reps=3, lo=20, hi=520, autoscale=True):
-    """Delta-method on-chip per-iteration time (see module docstring);
-    returns a per_iter_seconds list.
-
-    ``make_solver(max_it) -> (ksp, x, bv)`` builds a warmed fixed-iteration
-    solver (norm type 'none'). The iteration delta is auto-scaled so the
-    measured loop time is well above the run-to-run launch-latency noise
-    (~tens of ms): a pilot delta estimates the rate, then ``hi`` is
-    re-chosen for ~0.75 s of loop work. The one measurement protocol shared
-    by bench.py and benchmarks/run_all.py (config 5).
+def _delta_protocol(make_solver, run_one, reps, lo, hi, autoscale):
+    """The ONE delta-method measurement protocol (single- and multi-RHS
+    callers share it): two fixed-iteration solves whose wall difference
+    isolates pure loop time, with the iteration delta auto-scaled so the
+    measured loop time sits well above the run-to-run launch-latency
+    noise (~tens of ms) — a pilot delta estimates the rate, then ``hi``
+    is re-chosen for ~0.75 s of loop work, backing off under early
+    recurrence blow-up. ``run_one(solver) -> (wall_s, iterations)`` is
+    the only thing that differs between callers.
     """
     solvers = {m: make_solver(m) for m in (lo, hi)}
 
     def one_delta(a, b_):
         ws, its = {}, {}
         for max_it in (a, b_):
-            ksp, x, bv = solvers[max_it]
-            x.zero()
-            t0 = time.perf_counter()
-            r = ksp.solve(bv, x)
-            ws[max_it] = time.perf_counter() - t0
             # actual iterations, not max_it: a tol=0 fp32 run eventually
-            # overflows its recurrence to inf and exits early — dividing by
-            # the requested count would fake an arbitrarily fast rate
-            its[max_it] = r.iterations
+            # overflows its recurrence to inf and exits early — dividing
+            # by the requested count would fake an arbitrarily fast rate
+            ws[max_it], its[max_it] = run_one(solvers[max_it])
         return (ws[b_] - ws[a]) / max(its[b_] - its[a], 1), its[b_]
 
     pilot, _ = one_delta(lo, hi)
@@ -142,10 +136,73 @@ def delta_rate(make_solver, reps=3, lo=20, hi=520, autoscale=True):
     return [one_delta(lo, hi)[0] for _ in range(reps)]
 
 
+def delta_rate(make_solver, reps=3, lo=20, hi=520, autoscale=True):
+    """Delta-method on-chip per-iteration time (see module docstring);
+    returns a per_iter_seconds list.
+
+    ``make_solver(max_it) -> (ksp, x, bv)`` builds a warmed fixed-iteration
+    solver (norm type 'none'). The one measurement protocol shared by
+    bench.py and benchmarks/run_all.py (configs 5 and 7) lives in
+    :func:`_delta_protocol`.
+    """
+    def run_one(solver):
+        ksp, x, bv = solver
+        x.zero()
+        t0 = time.perf_counter()
+        r = ksp.solve(bv, x)
+        return time.perf_counter() - t0, r.iterations
+
+    return _delta_protocol(make_solver, run_one, reps, lo, hi, autoscale)
+
+
 def on_chip_rate(nx, reps=3, lo=20, hi=520):
     """Delta-method per-iteration time for CG+Jacobi at nx^3."""
     return delta_rate(lambda m: _fixed_iter_solver(nx, m),
                       reps=reps, lo=lo, hi=hi)
+
+
+def delta_rate_many(make_solver, B, reps=3, lo=20, hi=220,
+                    autoscale=True):
+    """Delta-method per-iteration time for a BATCHED fixed-iteration
+    solver: the :func:`_delta_protocol` discipline over
+    ``KSP.solve_many`` launches (one iteration advances ALL k columns;
+    a launch's iteration count is its slowest column's). Shared by
+    bench.py and benchmarks/run_all.py (config 7).
+
+    ``make_solver(max_it) -> ksp`` builds a warmed fixed-iteration
+    (norm 'none') solver.
+    """
+    def run_one(kf):
+        t0 = time.perf_counter()
+        r = kf.solve_many(B.copy())
+        return time.perf_counter() - t0, max(r.iterations)
+
+    return _delta_protocol(make_solver, run_one, reps, lo, hi, autoscale)
+
+
+def batched_delta(nx, k=8, reps=3, lo=20, hi=220):
+    """Delta-method per-iteration time of the BATCHED (k-RHS) stencil CG
+    kernel (the multi-RHS Pallas pipeline + one-psum-per-phase fused
+    reductions) on the headline problem."""
+    comm, op, ksp, b = make_problem(nx, "jacobi")
+    n = nx ** 3
+    rng = np.random.default_rng(11)
+    B = np.stack([b] + [np.asarray(
+        op.mult(mpi_petsc4py_example_tpu.Vec.from_global(
+            comm, rng.random(n).astype(np.float32))).to_numpy())
+        for _ in range(k - 1)], axis=1)
+
+    def fixed(max_it):
+        kf = mpi_petsc4py_example_tpu.KSP().create(comm)
+        kf.set_operators(op)
+        kf.set_type("cg")
+        kf.get_pc().set_type("jacobi")
+        kf.set_norm_type("none")
+        kf.set_tolerances(rtol=0.0, atol=0.0, max_it=max_it)
+        kf.solve_many(B.copy())            # warm-up / compile
+        return kf
+
+    return delta_rate_many(fixed, B, reps=reps, lo=lo, hi=hi)
 
 
 def cpu_baseline(nx, b: np.ndarray, rtol: float):
@@ -200,6 +257,13 @@ def main():
     hi = 520 if not opts.quick else 220
     pers = on_chip_rate(nx, reps=opts.reps, hi=hi)
 
+    # batched multi-RHS kernel: k=8 delta-method episode — one iteration
+    # serves 8 columns, so per-RHS-iteration cost should undercut k=1
+    k_batch = 8
+    pers_b = batched_delta(nx, k=k_batch, reps=opts.reps,
+                           hi=220 if opts.quick else 320)
+    per_b = statistics.median(pers_b)
+
     cpu_iters, cpu_wall, x_cpu, A = cpu_baseline(nx, b, opts.rtol)
 
     # residual parity check in fp64 on host
@@ -221,6 +285,13 @@ def main():
     from mpi_petsc4py_example_tpu.utils.profiling import (
         record_kernel_traffic)
     record_kernel_traffic(f"cg_step[{nx}^3]", PASSES_PER_ITER * n * 4, per)
+    # the batched kernel's achieved-GB/s row: same 11-pass model per
+    # column, k columns per batched iteration — this is the line the
+    # -log_view kernel-traffic table shows for the multi-RHS pipeline
+    gbps_b = (PASSES_PER_ITER * n * 4 * k_batch / per_b / 1e9
+              if per_b > 0 else 0.0)
+    record_kernel_traffic(f"cg_many_step[k={k_batch},{nx}^3]",
+                          PASSES_PER_ITER * n * 4 * k_batch, per_b)
     # headline: best time-to-rtol config (CG+MG) vs the CPU oracle
     best_wall = min(wall, mg_wall)
     line = {
@@ -242,6 +313,9 @@ def main():
             # VMEM-resident across loop iterations (possible up to ~16 MB
             # vectors) — the 11-pass HBM model doesn't apply at that size
             "vmem_resident": bool(gbps > HBM_ROOF_GBPS),
+            "batched_k8_onchip_per_iter_us": round(1e6 * per_b, 1),
+            "batched_k8_per_rhs_iter_us": round(1e6 * per_b / k_batch, 1),
+            "batched_k8_achieved_gbps": round(gbps_b, 1),
             "e2e_jacobi_wall_s": round(wall, 4),
             "e2e_jacobi_spread_s": [round(min(walls), 4),
                                     round(max(walls), 4)],

@@ -28,6 +28,31 @@ DECIDE = -1
 DEFAULT = -2
 
 
+class InsertMode:
+    """petsc4py's InsertMode enum slice the facade honors: INSERT_VALUES
+    (later writes to a slot win) and ADD_VALUES (duplicates sum)."""
+    NOT_SET_VALUES = 0
+    INSERT_VALUES = 1
+    ADD_VALUES = 2
+    INSERT = INSERT_VALUES
+    ADD = ADD_VALUES
+
+
+def _insert_mode(addv) -> str:
+    """Normalize petsc4py's ``addv`` argument (None/bool/InsertMode) to
+    'insert' | 'add' (core.mat.coo_to_csr's mode vocabulary). Booleans
+    (Python AND numpy — ``np.any(mask)`` is a common driver spelling)
+    are tested FIRST: ``True == InsertMode.INSERT_VALUES`` under int/bool
+    equality, and petsc4py's ``addv=True`` means ADD."""
+    if isinstance(addv, (bool, np.bool_)):
+        return "add" if bool(addv) else "insert"
+    if addv in (None, InsertMode.INSERT_VALUES, "insert"):
+        return "insert"
+    if addv in (InsertMode.ADD_VALUES, "add"):
+        return "add"
+    raise ValueError(f"unsupported InsertMode {addv!r}")
+
+
 def _mpi_comm(comm):
     """Coerce the facade's comm argument (None / MPI.Comm / DeviceComm)."""
     if comm is None or isinstance(comm, _tps.DeviceComm):
@@ -186,6 +211,90 @@ class Mat:
         self._core: _tps.Mat | None = None
         self._layout = None
         self._comm = None
+        # setValues ingestion state (petsc4py's MatStash analog): COO
+        # triplets accumulated host-side until assemblyEnd builds the CSR
+        self._size = None
+        self._stash = None            # [rows list, cols list, vals list]
+        self._stash_mode = None       # 'insert' | 'add' | None
+
+    def create(self, comm=None):
+        """``Mat().create(comm)`` — start the petsc4py setValues assembly
+        flow (the ``csr=`` constructor fast path bypasses the stash)."""
+        self._comm = _mpi_comm(comm)
+        self._stash = [[], [], []]
+        self._stash_mode = None
+        return self
+
+    def setSizes(self, size, bsize=None):
+        """Global matrix shape. Accepts ``n``, ``(m, n)``, or petsc4py's
+        ``((m_local, m_global), (n_local, n_global))`` nesting (the local
+        sizes are PETSc_DECIDE-style hints the uniform device layout
+        ignores)."""
+        if np.isscalar(size):
+            size = (int(size), int(size))
+        m, n = size
+        if not np.isscalar(m):
+            m = m[1] if m[1] not in (DECIDE, DEFAULT, None) else m[0]
+        if not np.isscalar(n):
+            n = n[1] if n[1] not in (DECIDE, DEFAULT, None) else n[0]
+        self._size = (int(m), int(n))
+        return self
+
+    def setType(self, mat_type):
+        t = str(mat_type).lower()
+        if t not in ("aij", "mpiaij", "seqaij"):
+            raise ValueError(
+                f"facade Mat supports AIJ types, got {mat_type!r}")
+        return self
+
+    def setFromOptions(self):
+        return self
+
+    def setPreallocationNNZ(self, nnz):
+        """Preallocation is a no-op here (the stash is host-side and the
+        device layout is rebuilt at assembly) — accepted for driver
+        compatibility."""
+        return self
+
+    def setValues(self, rows, cols, values, addv=None):
+        """MatSetValues: insert/add the dense logical block
+        ``values[i, j] -> A[rows[i], cols[j]]``.
+
+        INSERT_VALUES (default): the last write to a slot wins;
+        ADD_VALUES: contributions sum. Mixing the two without an
+        intervening ``assemble()`` raises, as PETSc does. Values are
+        stashed host-side; ``assemblyEnd`` builds the global CSR once
+        (core.mat.coo_to_csr) and ships the device layout in one
+        placement — per-entry device traffic would be absurd on a mesh.
+        """
+        if self._stash is None:
+            raise RuntimeError(
+                "Mat.setValues needs the create()/setSizes() flow (the "
+                "createAIJ csr= constructor assembles directly)")
+        if self._core is not None:
+            raise RuntimeError(
+                "Mat.setValues after assemblyEnd is not supported by the "
+                "facade — build a new Mat (PARITY.md 'Batched solves & "
+                "assembly')")
+        mode = _insert_mode(addv)
+        if self._stash_mode is not None and mode != self._stash_mode:
+            raise RuntimeError(
+                "cannot mix ADD_VALUES and INSERT_VALUES without an "
+                "intervening assemble() (PETSc MatSetValues semantics)")
+        self._stash_mode = mode
+        rows = np.atleast_1d(np.asarray(rows, dtype=np.int64))
+        cols = np.atleast_1d(np.asarray(cols, dtype=np.int64))
+        values = np.asarray(values, dtype=np.float64).reshape(
+            len(rows), len(cols))
+        rr = np.repeat(rows, len(cols))
+        cc = np.tile(cols, len(rows))
+        self._stash[0].append(rr)
+        self._stash[1].append(cc)
+        self._stash[2].append(values.ravel())
+        return self
+
+    def setValue(self, row, col, value, addv=None):
+        return self.setValues([row], [col], [value], addv=addv)
 
     def createAIJ(self, size=None, bsize=None, nnz=None, csr=None,
                   comm=None):
@@ -232,7 +341,9 @@ class Mat:
                                                     build)
         return self
 
-    # ---- assembly (no-ops: assembly happened at construction) ---------------
+    # ---- assembly -----------------------------------------------------------
+    # csr= constructors assemble at creation (these are then no-ops); the
+    # setValues flow builds the global CSR from the stash at assemblyEnd.
     def setUp(self):
         return self
 
@@ -240,10 +351,48 @@ class Mat:
         return self
 
     def assemblyEnd(self):
+        if self._stash is None or self._core is not None:
+            return self               # csr= fast path: already assembled
+        if self._size is None:
+            raise RuntimeError(
+                "Mat.assemblyEnd: setSizes was never called")
+        rank = self._comm.Get_rank()
+        size = self._size
+        mode = self._stash_mode or "insert"
+        payload = (np.concatenate(self._stash[0])
+                   if self._stash[0] else np.zeros(0, np.int64),
+                   np.concatenate(self._stash[1])
+                   if self._stash[1] else np.zeros(0, np.int64),
+                   np.concatenate(self._stash[2])
+                   if self._stash[2] else np.zeros(0, np.float64),
+                   mode)
+
+        def build(blocks):
+            from mpi_petsc4py_example_tpu.core.mat import coo_to_csr
+            blocks = [b for _, b in sorted(blocks, key=lambda t: t[0])]
+            modes = {b[3] for b in blocks if len(b[0])}
+            if len(modes) > 1:
+                raise RuntimeError(
+                    "ranks disagree on InsertMode (ADD vs INSERT) — "
+                    "PETSc MatAssembly rejects this too")
+            rows = np.concatenate([b[0] for b in blocks])
+            cols = np.concatenate([b[1] for b in blocks])
+            vals = np.concatenate([b[2] for b in blocks])
+            csr = coo_to_csr(size, rows, cols, vals,
+                             mode=next(iter(modes), "insert"))
+            dc = self._comm.device_comm
+            core = _tps.Mat.from_csr(dc, size, csr)
+            return core, _UnevenLayout(
+                RowLayout(size[0], self._comm.Get_size()).count)
+
+        self._core, self._layout = self._comm._collective(
+            "mat_assembly_setvalues", (rank, payload), build)
+        self._stash = [[], [], []]
+        self._stash_mode = None
         return self
 
     def assemble(self):
-        return self
+        return self.assemblyBegin().assemblyEnd()
 
     def isAssembled(self):
         return self._core is not None and self._core.assembled

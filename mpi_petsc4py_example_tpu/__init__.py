@@ -62,7 +62,8 @@ from .core.shell import ShellMat
 from .core.nullspace import NullSpace
 from .solvers.pc import PC
 from .solvers.ksp import KSP
-from .utils.convergence import ConvergedReason, RecoveryEvent, SolveResult
+from .utils.convergence import (BatchedSolveResult, ConvergedReason,
+                                RecoveryEvent, SolveResult)
 from .utils.options import Options, global_options, init, backend
 from .utils import petsc_io
 from . import resilience
@@ -77,8 +78,10 @@ __all__ = [
     "partition_csr", "concat_csr_blocks",
     "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST", "SVD",
     "ConvergedReason", "RecoveryEvent", "SolveResult",
+    "BatchedSolveResult",
     "Options", "global_options", "init", "backend", "petsc_io",
     "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
+    "resilient_solve_many",
     "KSPFallbackChain",
 ]
 
@@ -95,6 +98,7 @@ def __getattr__(name):
     if name == "SVD":
         from .solvers.svd import SVD
         return SVD
-    if name in ("RetryPolicy", "resilient_solve", "KSPFallbackChain"):
+    if name in ("RetryPolicy", "resilient_solve",
+                "resilient_solve_many", "KSPFallbackChain"):
         return getattr(resilience, name)
     raise AttributeError(name)

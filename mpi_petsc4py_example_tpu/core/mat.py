@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.spmv import (csr_diag, csr_find_diagonals, csr_to_dia,
-                        csr_to_ell, dia_spmv_local, ell_spmv_local)
+                        csr_to_ell, dia_spmv_local, dia_spmv_local_many,
+                        ell_spmv_local, ell_spmv_local_many)
 from ..parallel.mesh import DeviceComm, as_comm
 from ..parallel.partition import RowLayout, concat_csr_blocks
 from .vec import Vec
@@ -455,6 +456,59 @@ class Mat:
 
         return spmv
 
+    def local_spmv_many(self, comm: DeviceComm):
+        """Multi-RHS local SpMV closure: ``spmv(op_local, X_local)`` with
+        ``X_local`` the device's ``(lsize, nrhs)`` block of an
+        ``(n_pad, nrhs)`` row-sharded RHS block.
+
+        The communication structure mirrors :meth:`local_spmv` exactly —
+        ONE collective per apply whatever ``nrhs`` is (the whole point of
+        the batched solve path): the ELL/general-DIA paths all_gather the
+        entire block in one op (bytes scale with k, op count does not) and
+        the banded-DIA path ships the two ``(halo, nrhs)`` boundary blocks
+        over the same open-chain ppermutes.
+        """
+        from jax import lax
+        axis = comm.axis
+        if self.dia_vals is not None:
+            offsets = self.dia_offsets
+            halo = max(abs(o) for o in offsets) if offsets else 0
+            lsize = comm.local_size(self.shape[0])
+            ndev = comm.size
+
+            if ndev > 1 and 0 < halo <= lsize:
+                fwd = [(i, i + 1) for i in range(ndev - 1)]
+                bwd = [(i, i - 1) for i in range(1, ndev)]
+
+                def spmv(op_local, x_local):
+                    (dia,) = op_local
+                    left = lax.ppermute(x_local[-halo:], axis, fwd)
+                    right = lax.ppermute(x_local[:halo], axis, bwd)
+                    ext = jnp.concatenate([left, x_local, right])
+                    y = jnp.zeros((lsize, x_local.shape[1]), dia.dtype)
+                    for d, off in enumerate(offsets):
+                        seg = lax.slice_in_dim(ext, halo + int(off),
+                                               halo + int(off) + lsize)
+                        y = y + dia[:, d:d + 1] * seg
+                    return y
+
+                return spmv
+
+            def spmv(op_local, x_local):
+                (dia,) = op_local
+                x_full = lax.all_gather(x_local, axis, tiled=True)
+                row0 = lax.axis_index(axis) * lsize
+                return dia_spmv_local_many(dia, offsets, x_full, row0, halo)
+
+            return spmv
+
+        def spmv(op_local, x_local):
+            cols, vals = op_local
+            x_full = lax.all_gather(x_local, axis, tiled=True)
+            return ell_spmv_local_many(cols, vals, x_full)
+
+        return spmv
+
     def local_spmv_t(self, comm: DeviceComm):
         """Local transpose-SpMV closure (``y = Aᵀ x``) for shard_map bodies.
 
@@ -555,6 +609,45 @@ class Mat:
     def __repr__(self):
         return (f"Mat(shape={self.shape}, K={self.K}, "
                 f"devices={self.comm.size}, dtype={self.dtype})")
+
+
+def coo_to_csr(shape, rows, cols, vals, mode: str = "insert"):
+    """Accumulate COO triplets into a host CSR triple with PETSc's
+    MatSetValues duplicate semantics.
+
+    ``mode='insert'`` (INSERT_VALUES): the LAST write to an (i, j) slot
+    wins; ``mode='add'`` (ADD_VALUES): duplicates sum. Out-of-range
+    indices raise (PETSc errors on them too, absent MAT_IGNORE entries).
+    Used by the facade's ``Mat.setValues`` assembly path (compat/petsc4py)
+    — the ``csr=`` constructor fast path bypasses this entirely.
+    """
+    import scipy.sparse as sp
+    nrows, ncols = int(shape[0]), int(shape[1])
+    rows = np.asarray(rows, dtype=np.int64).ravel()
+    cols = np.asarray(cols, dtype=np.int64).ravel()
+    vals = np.asarray(vals).ravel()
+    if not (rows.shape == cols.shape == vals.shape):
+        raise ValueError(
+            f"coo_to_csr: rows/cols/vals lengths differ "
+            f"({rows.shape}, {cols.shape}, {vals.shape})")
+    if len(rows) and (rows.min() < 0 or rows.max() >= nrows
+                      or cols.min() < 0 or cols.max() >= ncols):
+        raise ValueError(
+            f"coo_to_csr: index out of range for shape {(nrows, ncols)}")
+    if mode == "add":
+        A = sp.coo_matrix((vals, (rows, cols)), shape=(nrows, ncols)).tocsr()
+        return A.indptr, A.indices, A.data
+    if mode != "insert":
+        raise ValueError(f"coo_to_csr: unknown mode {mode!r}")
+    # INSERT: keep the last occurrence of each (i, j). np.unique on the
+    # REVERSED flat keys returns the first occurrence in reversed order —
+    # i.e. the last in insertion order.
+    flat = rows * np.int64(ncols) + cols
+    _, first_rev = np.unique(flat[::-1], return_index=True)
+    keep = len(flat) - 1 - first_rev
+    A = sp.coo_matrix((vals[keep], (rows[keep], cols[keep])),
+                      shape=(nrows, ncols)).tocsr()
+    return A.indptr, A.indices, A.data
 
 
 _MULT_T_CACHE: dict = {}

@@ -53,6 +53,22 @@ def make_plane_exchange(axis, ndev: int):
     return exchange
 
 
+def _exchange_many(u, axis, ndev: int):
+    """Halo exchange for a BATCH of slabs ``u (nrhs, lz, ny, nx)``:
+    one ``ppermute`` each way moving the ``(nrhs, ny, nx)`` boundary-plane
+    blocks (the :func:`make_plane_exchange` logic with a leading RHS axis —
+    op count per apply stays two, bytes scale with nrhs)."""
+    up = lax.ppermute(u[:, -1], axis,
+                      perm=[(i, (i + 1) % ndev) for i in range(ndev)])
+    down = lax.ppermute(u[:, 0], axis,
+                        perm=[(i, (i - 1) % ndev) for i in range(ndev)])
+    i = lax.axis_index(axis)
+    zero = jnp.zeros_like(up)
+    halo_lo = jnp.where(i == 0, zero, up)
+    halo_hi = jnp.where(i == ndev - 1, zero, down)
+    return halo_lo, halo_hi
+
+
 class StencilPoisson3D:
     """7-point 3D Poisson (Dirichlet) as a matrix-free sharded operator.
 
@@ -129,6 +145,60 @@ class StencilPoisson3D:
             return y.reshape(lz * ny * nx)
 
         return spmv
+
+    def local_spmv_many(self, comm: DeviceComm):
+        """Multi-RHS stencil SpMV: ``X_local (lsize, nrhs) -> (lsize, nrhs)``.
+
+        The halo exchange ships the two boundary-plane BLOCKS
+        ``(nrhs, ny, nx)`` over the same one-ppermute-each-way ring as the
+        single-RHS path — collective op count independent of k, bytes
+        scaling with k (the batched-solve comm contract).
+        """
+        nx, ny, lz = self.nx, self.ny, self.lz
+        axis = comm.axis
+        ndev = comm.size
+        from ..ops.pallas_stencil import (pallas_supported,
+                                          stencil3d_apply_many_pallas)
+        use_pallas = pallas_supported(ny, nx, self._dtype, comm.platform)
+
+        def spmv(op_local, x_local):
+            nrhs = x_local.shape[1]
+            # (lsize, nrhs) -> (nrhs, lz, ny, nx) column-major grids
+            u = x_local.T.reshape(nrhs, lz, ny, nx)
+            halo_lo, halo_hi = _exchange_many(u, axis, ndev)
+            if use_pallas:
+                y = stencil3d_apply_many_pallas(
+                    u, halo_lo[:, None], halo_hi[:, None], lz, ny, nx, nrhs)
+            else:
+                y = jax.vmap(self._stencil7_jnp)(u, halo_lo, halo_hi)
+            return y.reshape(nrhs, lz * ny * nx).T
+
+        return spmv
+
+    def local_matvec_dot_many(self, comm: DeviceComm):
+        """Fused multi-RHS ``U (nrhs,lz,ny,nx) -> (A U, psum <u_j, A u_j>)``
+        for the batched stencil-CG fast path — per-column ``<p, Ap>``
+        partials accumulated while both operands are VMEM-resident
+        (Pallas) and reduced in ONE stacked psum."""
+        axis = comm.axis
+        ndev = comm.size
+        nx, ny, lz = self.nx, self.ny, self.lz
+        from ..ops.pallas_stencil import (pallas_supported,
+                                          stencil3d_dot_many_pallas)
+        use_pallas = pallas_supported(ny, nx, self._dtype, comm.platform)
+
+        def matvec_dot(op_local, u):
+            nrhs = u.shape[0]
+            halo_lo, halo_hi = _exchange_many(u, axis, ndev)
+            if use_pallas:
+                y, part = stencil3d_dot_many_pallas(
+                    u, halo_lo[:, None], halo_hi[:, None], lz, ny, nx, nrhs)
+            else:
+                y = jax.vmap(self._stencil7_jnp)(u, halo_lo, halo_hi)
+                part = jnp.sum(u * y, axis=(1, 2, 3))
+            return y, lax.psum(part, axis)
+
+        return matvec_dot
 
     # uniform diagonal value — lets CG's Jacobi apply collapse to a scalar
     # multiply (z = r/6) and its rz dot collapse to ||r||^2/6, eliminating

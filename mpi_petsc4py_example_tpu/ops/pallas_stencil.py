@@ -313,13 +313,19 @@ def _vmem_limit_params(interpret: bool):
 
 def _pick_chunk(lz: int, itemsize: int, ny: int, nx: int,
                 max_chunk: int | None, streams: int = 2,
-                nbuf: int = 2):
+                nbuf: int = 2, ncols: int = 1):
     """z-chunk that divides ``lz`` and keeps the scratch banks
-    (= streams*nbuf*chunk + 2*nbuf planes; ``streams`` is 2 for u+out, or
-    3 with an f-array; ``nbuf`` the pipeline depth) inside the device
-    generation's scratch budget — the one pipeline geometry all entry
-    points share."""
-    plane = ny * nx * itemsize
+    (= streams*nbuf*chunk + 2*nbuf planes, each ``ncols`` columns wide;
+    ``streams`` is 2 for u+out, or 3 with an f-array; ``nbuf`` the
+    pipeline depth) inside the device generation's scratch budget — the
+    one pipeline geometry all entry points share.
+
+    ``ncols`` is the multi-RHS width: the batched kernels keep all k
+    columns of each plane VMEM-resident, so the chunk plan shrinks the
+    z-depth by the same factor (a k=8 batch at 512² planes plans chunks
+    8x shallower, same total scratch).
+    """
+    plane = ny * nx * itemsize * ncols
     vmem_budget = _vmem_plan(_tpu_device_kind())[1]
     budget = int((vmem_budget // plane - 2 * nbuf) // (streams * nbuf))
     if max_chunk is not None:
@@ -392,6 +398,226 @@ def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
         interpret=interpret,
     )(u, halo_lo, halo_hi)
     return y, dot[0]
+
+
+def _stencil_many_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
+                         nrhs, dot_ref=None, nbuf=2):
+    """Multi-RHS z-chunk pipeline: the :func:`_stencil_kernel` DMA
+    geometry applied to ``nrhs`` slabs at once.
+
+    ``u_ref``/``out_ref`` are ``(nrhs, lz, ny, nx)``; per chunk the
+    scratch banks hold ALL k columns' extended planes, the per-column
+    input DMAs are issued back to back (k wide copies per interior chunk
+    — each still the full contiguous (chunk+2)-plane window the round-6
+    re-geometry established), and the stencil + optional fused per-column
+    ``<u_j, A u_j>`` partials run while every column is VMEM-resident.
+    The chunk plan must be built with ``_pick_chunk(..., ncols=nrhs)``.
+    """
+    def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out):
+        six = jnp.asarray(6.0, out_ref.dtype)
+        one = jnp.int32(1)
+        has_interior = nchunks >= 3
+
+        def start_in(c, slot):
+            z0 = c * jnp.int32(chunk)
+            edge = (c == 0) | (c == nchunks - 1)
+            for j in range(nrhs):
+                if has_interior:
+                    @pl.when(~edge)
+                    def _(j=j):
+                        pltpu.make_async_copy(
+                            u_ref.at[j, pl.ds(z0 - one, chunk + 2)],
+                            sc.at[slot, j], sem_c.at[slot, j]).start()
+
+                @pl.when(edge)
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        u_ref.at[j, pl.ds(z0, chunk)],
+                        sc.at[slot, j, pl.ds(one, chunk)],
+                        sem_c.at[slot, j]).start()
+
+                @pl.when(c == 0)
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        lo_ref.at[j], sc.at[slot, j, pl.ds(0, 1)],
+                        sem_lo.at[slot, j]).start()
+
+                @pl.when(edge & (c > 0))
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        u_ref.at[j, pl.ds(z0 - one, 1)],
+                        sc.at[slot, j, pl.ds(0, 1)],
+                        sem_lo.at[slot, j]).start()
+
+                @pl.when(c == nchunks - 1)
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        hi_ref.at[j],
+                        sc.at[slot, j, pl.ds(jnp.int32(chunk + 1), 1)],
+                        sem_hi.at[slot, j]).start()
+
+                @pl.when(edge & (c < nchunks - 1))
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        u_ref.at[j, pl.ds(z0 + jnp.int32(chunk), 1)],
+                        sc.at[slot, j, pl.ds(jnp.int32(chunk + 1), 1)],
+                        sem_hi.at[slot, j]).start()
+
+        def wait_in(c, slot):
+            edge = (c == 0) | (c == nchunks - 1)
+            for j in range(nrhs):
+                if has_interior:
+                    @pl.when(~edge)
+                    def _(j=j):
+                        pltpu.make_async_copy(
+                            u_ref.at[0, pl.ds(0, chunk + 2)], sc.at[slot, j],
+                            sem_c.at[slot, j]).wait()
+
+                @pl.when(edge)
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        u_ref.at[0, pl.ds(0, chunk)],
+                        sc.at[slot, j, pl.ds(one, chunk)],
+                        sem_c.at[slot, j]).wait()
+                    pltpu.make_async_copy(
+                        lo_ref.at[0], sc.at[slot, j, pl.ds(0, 1)],
+                        sem_lo.at[slot, j]).wait()
+                    pltpu.make_async_copy(
+                        hi_ref.at[0],
+                        sc.at[slot, j, pl.ds(jnp.int32(chunk + 1), 1)],
+                        sem_hi.at[slot, j]).wait()
+
+        def lax_rem(c):
+            return jax.lax.rem(c, jnp.int32(nbuf))
+
+        for k in range(min(nbuf - 1, nchunks)):
+            start_in(jnp.int32(k), jnp.int32(k))
+
+        def body(c, carry):
+            slot = lax_rem(c)
+
+            @pl.when(c + jnp.int32(nbuf - 1) < nchunks)
+            def _():
+                start_in(c + jnp.int32(nbuf - 1),
+                         lax_rem(c + jnp.int32(nbuf - 1)))
+
+            wait_in(c, slot)
+            parts = []
+            for j in range(nrhs):
+                buf = sc[slot, j]
+                u = buf[1:-1]
+                y = (six * u - buf[:-2] - buf[2:]
+                     - _shift_y(u, -1) - _shift_y(u, +1)
+                     - _shift_x(u, -1) - _shift_x(u, +1))
+
+                @pl.when(c >= nbuf)
+                def _(j=j):
+                    pltpu.make_async_copy(
+                        osc.at[slot, j], out_ref.at[j, pl.ds(0, chunk)],
+                        sem_out.at[slot, j]).wait()
+                osc[slot, j] = y
+                pltpu.make_async_copy(
+                    osc.at[slot, j],
+                    out_ref.at[j, pl.ds(c * jnp.int32(chunk), chunk)],
+                    sem_out.at[slot, j]).start()
+                if dot_ref is not None:
+                    parts.append(jnp.sum(u * y))
+            if dot_ref is None:
+                return carry
+            return carry + jnp.stack(parts)
+
+        carry0 = (jnp.int32(0) if dot_ref is None
+                  else jnp.zeros((nrhs,), out_ref.dtype))
+        acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
+                                carry0)
+        if dot_ref is not None:
+            for j in range(nrhs):
+                dot_ref[j] = acc[j]
+        last = jnp.int32(nchunks - 1)
+        for d in range(nbuf - 1, 0, -1):
+            for j in range(nrhs):
+                @pl.when(jnp.int32(nchunks) >= d + 1)
+                def _(d=d, j=j):
+                    pltpu.make_async_copy(
+                        osc.at[lax_rem(last - jnp.int32(d)), j],
+                        out_ref.at[j, pl.ds(0, chunk)],
+                        sem_out.at[lax_rem(last - jnp.int32(d)), j]).wait()
+        for j in range(nrhs):
+            pltpu.make_async_copy(
+                osc.at[lax_rem(last), j], out_ref.at[j, pl.ds(0, chunk)],
+                sem_out.at[lax_rem(last), j]).wait()
+
+    ny, nx = out_ref.shape[2], out_ref.shape[3]
+    scratch = [
+        pltpu.VMEM((nbuf, nrhs, chunk + 2, ny, nx), out_ref.dtype),
+        pltpu.VMEM((nbuf, nrhs, chunk, ny, nx), out_ref.dtype),
+        pltpu.SemaphoreType.DMA((nbuf, nrhs)),
+        pltpu.SemaphoreType.DMA((nbuf, nrhs)),
+        pltpu.SemaphoreType.DMA((nbuf, nrhs)),
+        pltpu.SemaphoreType.DMA((nbuf, nrhs)),
+    ]
+    pl.run_scoped(process, *scratch)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
+def stencil3d_apply_many_pallas(u, halo_lo, halo_hi, lz: int, ny: int,
+                                nx: int, nrhs: int,
+                                interpret: bool = False,
+                                max_chunk: int | None = None,
+                                nbuf: int | None = None):
+    """Apply the 7-point stencil to ``nrhs`` local slabs at once.
+
+    ``u`` is ``(nrhs, lz, ny, nx)``; ``halo_lo``/``halo_hi`` are the
+    neighbour plane blocks ``(nrhs, 1, ny, nx)``. The VMEM chunk plan
+    accounts for the k resident columns (``_pick_chunk(..., ncols=nrhs)``)
+    and the wide-DMA pipeline geometry is shared with the single-RHS
+    kernel (see :func:`_stencil_many_kernel`).
+    """
+    nbuf = nbuf or _pipeline_depth()
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 nbuf=nbuf, ncols=nrhs)
+    kernel = functools.partial(_stencil_many_kernel, chunk=chunk,
+                               nchunks=nchunks, nrhs=nrhs, nbuf=nbuf)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((nrhs, lz, ny, nx), u.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(u, halo_lo, halo_hi)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
+def stencil3d_dot_many_pallas(u, halo_lo, halo_hi, lz: int, ny: int,
+                              nx: int, nrhs: int,
+                              interpret: bool = False,
+                              max_chunk: int | None = None,
+                              nbuf: int | None = None):
+    """Fused multi-RHS stencil apply + per-column local dots: returns
+    ``(A U, partials)`` with ``partials[j] = <u_j, A u_j>`` accumulated
+    chunk by chunk while each column is VMEM-resident — the batched CG
+    kernel psums the whole (nrhs,) vector in ONE collective."""
+    nbuf = nbuf or _pipeline_depth()
+    chunk, nchunks = _pick_chunk(lz, u.dtype.itemsize, ny, nx, max_chunk,
+                                 nbuf=nbuf, ncols=nrhs)
+    kernel = functools.partial(_stencil_many_kernel, chunk=chunk,
+                               nchunks=nchunks, nrhs=nrhs, nbuf=nbuf)
+
+    def kern(u_ref, lo_ref, hi_ref, out_ref, dot_ref):
+        kernel(u_ref, lo_ref, hi_ref, out_ref, dot_ref=dot_ref)
+
+    y, dot = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((nrhs, lz, ny, nx), u.dtype),
+                   jax.ShapeDtypeStruct((nrhs,), u.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        compiler_params=_vmem_limit_params(interpret),
+        interpret=interpret,
+    )(u, halo_lo, halo_hi)
+    return y, dot
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))

@@ -56,6 +56,35 @@ def ell_spmv_local(cols, vals, x_full):
     return jnp.einsum("rk,rk->r", vals, x_full[cols])
 
 
+def ell_spmv_local_many(cols, vals, x_full_many):
+    """Multi-RHS local ELL SpMV: ``Y[i, j] = sum_k vals[i,k] * X[cols[i,k], j]``.
+
+    ``x_full_many`` is the full (gathered) ``(n, nrhs)`` RHS block. The
+    inner contraction is an MXU-shaped matmul over the ``nrhs`` columns —
+    the gather of X amortizes over every column (one ``all_gather`` of the
+    whole block replaces ``nrhs`` per-vector gathers; the reason batched
+    Krylov pays one collective per SpMV phase regardless of k).
+    """
+    # X[cols] is (lrows, K, nrhs); contract the ELL slot axis against vals
+    return jnp.einsum("rk,rkj->rj", vals, x_full_many[cols])
+
+
+def dia_spmv_local_many(dia, offsets, x_full_many, row_offset, halo):
+    """Multi-RHS local DIA SpMV on an ``(n, nrhs)`` gathered block.
+
+    Identical static-shifted-slice structure to :func:`dia_spmv_local`
+    (no gather at all); every slice simply carries the trailing RHS axis.
+    """
+    lrows = dia.shape[0]
+    xp = jnp.pad(x_full_many, ((halo, halo), (0, 0)))
+    y = jnp.zeros((lrows, x_full_many.shape[1]), dia.dtype)
+    for d, off in enumerate(offsets):
+        seg = jax.lax.dynamic_slice_in_dim(
+            xp, row_offset + int(off) + halo, lrows)
+        y = y + dia[:, d:d + 1] * seg
+    return y
+
+
 def ell_diag_local(cols, vals, row_offset, lrows):
     """Extract the local diagonal from ELL shards (device-side).
 

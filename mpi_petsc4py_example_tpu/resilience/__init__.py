@@ -21,13 +21,15 @@ from .faults import FaultSpecError, inject_faults
 
 __all__ = [
     "faults", "inject_faults", "FaultSpecError",
-    "RetryPolicy", "resilient_solve", "default_checkpoint_path",
+    "RetryPolicy", "resilient_solve", "resilient_solve_many",
+    "default_checkpoint_path",
     "KSPFallbackChain", "reduced_dtype",
 ]
 
 
 def __getattr__(name):
-    if name in ("RetryPolicy", "resilient_solve", "default_checkpoint_path"):
+    if name in ("RetryPolicy", "resilient_solve", "resilient_solve_many",
+                "default_checkpoint_path"):
         from . import retry
         return getattr(retry, name)
     if name in ("KSPFallbackChain", "reduced_dtype"):

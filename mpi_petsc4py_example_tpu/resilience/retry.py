@@ -31,8 +31,10 @@ import tempfile
 import time
 from dataclasses import dataclass
 
-from ..utils.checkpoint import load_solve_state, save_solve_state
-from ..utils.convergence import RecoveryEvent, SolveResult
+from ..utils.checkpoint import (load_solve_state, load_solve_state_many,
+                                save_solve_state, save_solve_state_many)
+from ..utils.convergence import (BatchedSolveResult, RecoveryEvent,
+                                 SolveResult)
 from ..utils.errors import DeviceExecutionError
 
 
@@ -142,6 +144,86 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                 events.append(RecoveryEvent(
                     kind="resume", attempt=attempt,
                     detail="initial_guess_nonzero from restored iterate"))
+    finally:
+        ksp.set_initial_guess_nonzero(guess_flag0)
+    result.attempts = attempt
+    result.recovery_events = events
+    return result
+
+
+def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
+                         *, checkpoint_path: str | None = None
+                         ) -> BatchedSolveResult:
+    """``ksp.solve_many(B, X)`` that survives retriable device failures —
+    the batched twin of :func:`resilient_solve`.
+
+    The checkpoint carries the whole ``(n, nrhs)`` iterate/RHS blocks
+    (:func:`utils.checkpoint.save_solve_state_many`): a mid-batch crash
+    leaves the partial iterate BLOCK in ``X`` (the ``ksp.program`` fault
+    boundary in KSP.solve_many writes it before raising), the rebuilt
+    solve resumes every column from where it froze, and already-converged
+    columns re-converge in O(1) iterations under the masked-convergence
+    kernel. Same zero-overhead contract: no failure means exactly one
+    ``ksp.solve_many``.
+    """
+    import numpy as np
+    policy = policy or RetryPolicy()
+    path = checkpoint_path or default_checkpoint_path(ksp)
+    events: list[RecoveryEvent] = []
+    guess_flag0 = ksp._initial_guess_nonzero
+    mat = ksp.get_operators()[0]
+    if isinstance(B, (list, tuple)):
+        # the same Vec-stacking normalization KSP.solve_many accepts —
+        # a bare asarray would mangle a list of Vecs into an object array
+        B = np.stack([b.to_numpy() if hasattr(b, "to_numpy")
+                      else np.asarray(b) for b in B], axis=1)
+    B = np.asarray(B)
+    if X is None:
+        X = np.zeros(B.shape, dtype=np.dtype(mat.dtype))
+    else:
+        # the wrapper's resume contract needs a WRITABLE host ndarray the
+        # fault boundary writes the partial iterate into — a jax array
+        # (asarray of one is a read-only view) or nested list would make
+        # solve_many checkpoint the stale guess or die on the in-place
+        # restore below
+        X = np.asarray(X)
+        if not X.flags.writeable:
+            X = X.copy()
+    attempt = 1
+    try:
+        while True:
+            try:
+                result = ksp.solve_many(B, X)
+                break
+            except DeviceExecutionError as exc:
+                if (attempt >= policy.max_attempts
+                        or not policy.should_retry(exc)):
+                    raise
+                events.append(RecoveryEvent(
+                    kind="fault", attempt=attempt, detail=str(exc),
+                    error_class=exc.failure_class))
+                mat = ksp.get_operators()[0]
+                persisted = hasattr(mat, "to_scipy")
+                if persisted:
+                    save_solve_state_many(path, mat, X, B, iteration=0)
+                    events.append(RecoveryEvent(
+                        kind="checkpoint", attempt=attempt, detail=path))
+                delay = policy.delay(attempt - 1)
+                events.append(RecoveryEvent(
+                    kind="backoff", attempt=attempt, delay=delay,
+                    error_class=exc.failure_class))
+                policy.sleep(delay)
+                if persisted:
+                    mat2, X2, _B2, _it = load_solve_state_many(path,
+                                                               mat.comm)
+                    ksp.set_operators(mat2)
+                    X[...] = X2.astype(X.dtype, copy=False)
+                ksp.set_initial_guess_nonzero(True)
+                attempt += 1
+                events.append(RecoveryEvent(
+                    kind="resume", attempt=attempt,
+                    detail="initial_guess_nonzero from restored "
+                           "iterate block"))
     finally:
         ksp.set_initial_guess_nonzero(guess_flag0)
     result.attempts = attempt

@@ -2069,3 +2069,275 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
     _PROGRAM_CACHE[key] = prog
     return prog
+
+
+# ---------------------------------------------------------------------------
+# batched multi-RHS solves: k independent CG recurrences in ONE program
+# ---------------------------------------------------------------------------
+
+class _HistMonitorMany(_HistMonitor):
+    """Per-column residual recorder for the batched kernels: a
+    ``(cap, nrhs)`` buffer where slot ``(i, j)`` holds column j's
+    iteration-i monitored norm. Frozen columns re-write their last slot
+    with an unchanged value — harmless, and the replay (KSP.solve_many)
+    walks each column independently."""
+
+    def __init__(self, dtype, cap, nrhs):
+        super().__init__(dtype, cap)
+        self.nrhs = int(nrhs)
+
+    def init(self):
+        return jnp.full((self.cap, self.nrhs), -1.0, self.dtype)
+
+    def __call__(self, hist, k, rn):
+        return hist.at[k, jnp.arange(self.nrhs)].set(
+            rn.astype(self.dtype), mode="drop")
+
+
+def cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol, atol, maxit,
+                   monitor=None, dtol=None):
+    """Batched preconditioned CG: ``nrhs`` INDEPENDENT recurrences in
+    lockstep over an ``(lsize, nrhs)`` RHS block (KSPMatSolve's hot-loop
+    analog).
+
+    Per column the arithmetic is exactly :func:`cg_kernel` at unroll=1 —
+    per-RHS results, iteration counts, and breakdown behavior match
+    sequential solves — but one batched operator apply (ONE all_gather
+    for the whole block) and one fused per-phase reduction serve all k
+    columns: ``pdotc``/``pnormc`` reduce (nrhs,) vectors in a single
+    psum, and ``pduo(R, Z) -> (<R,Z>, <R,R>)`` stacks both end-of-step
+    dots into ONE collective, so the per-iteration collective COUNT is
+    independent of k (2 reduction phases; bytes scale with k).
+
+    Per-RHS masked convergence: a column whose residual meets its own
+    ``max(rtol*||b_j||, atol)`` (or that breaks down / diverges) freezes
+    — its state is carried unchanged via masked selects — while the loop
+    runs until the last active column exits. Returns per-column
+    ``(X, iters, rnorm, reason, hist)`` with shapes (nrhs,)-batched.
+    """
+    R = B - A(X0)
+    Z = M(R)
+    P = Z
+    rz = pdotc(R, Z)
+    bnorm = pnormc(B)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    rnorm = pnormc(R)
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, B.dtype)
+    brk0 = jnp.zeros(rnorm.shape, bool)
+
+    def active(st):
+        it, X, R, Z, P, rz, rn, brk, hist = st
+        return (rn > tol) & (rn < dmax) & (it < maxit) & ~brk
+
+    def cond(st):
+        return jnp.any(active(st))
+
+    def body(st):
+        it, X, R, Z, P, rz, rn, brk, hist = st
+        cont = active(st)
+        cm = cont[None, :]
+        AP = A(P)
+        pAp = pdotc(P, AP)                     # reduction phase 1
+        brk_new = cont & (pAp == 0)
+        alpha = jnp.where(pAp == 0, 0.0,
+                          rz / jnp.where(pAp == 0, 1.0, pAp))
+        # frozen columns SELECT their old state (the cg_kernel unroll
+        # discipline: a diverged column's inf/NaN must not leak through a
+        # zero-gate multiply into the preserved iterate)
+        X = jnp.where(cm, X + alpha[None, :] * P, X)
+        R = jnp.where(cm, R - alpha[None, :] * AP, R)
+        Z = jnp.where(cm, M(R), Z)
+        rz_new, rr = pduo(R, Z)                # reduction phase 2 (fused)
+        beta = jnp.where(rz == 0, 0.0,
+                         rz_new / jnp.where(rz == 0, 1.0, rz))
+        P = jnp.where(cm, Z + beta[None, :] * P, P)
+        rz = jnp.where(cont, rz_new, rz)
+        rn = jnp.where(cont, jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0)), rn)
+        it = it + cont.astype(jnp.int32)
+        if monitor is not None:
+            hist = monitor(hist, it, rn)
+        return (it, X, R, Z, P, rz, rn, brk | brk_new, hist)
+
+    st0 = (jnp.zeros(rnorm.shape, jnp.int32), X0, R, Z, P, rz, rnorm,
+           brk0, hist)
+    it, X, R, Z, P, rz, rnorm, brk, hist = lax.while_loop(cond, body, st0)
+    return (X, it, rnorm, _reason(rnorm, tol, atol, it, maxit, brk, dmax),
+            hist)
+
+
+def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
+                           maxit, monitor=None, dtol=None, grid3d=None):
+    """Batched twin of :func:`cg_stencil_kernel` for uniform-diagonal
+    stencil operators: state lives in ``(nrhs,) + grid3d`` slabs, the
+    SpMV + per-column ``<p_j, A p_j>`` partials run in one fused pass
+    (``Adot`` — the multi-RHS Pallas kernel on TPU), the Jacobi apply
+    collapses to the scalar ``inv_diag`` multiply, and
+    ``rz_j = inv_diag * ||r_j||^2`` reuses the residual-norm reduction.
+    Per-column masked convergence as in :func:`cg_kernel_many`; per-column
+    arithmetic identical to the single-RHS fast path.
+    """
+    nrhs = B.shape[1]
+    flat = B.shape
+    B3 = B.T.reshape((nrhs,) + grid3d)
+    X3 = X0.T.reshape((nrhs,) + grid3d)
+    bnorm = jnp.sqrt(pdotc3(B3, B3))
+    tol = jnp.maximum(rtol * bnorm, atol)
+    R = B3 - Adot(X3)[0]
+    rr = pdotc3(R, R)
+    rnorm = jnp.sqrt(rr)
+    rz = rr * inv_diag
+    P = R * inv_diag
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, B.dtype)
+    brk0 = jnp.zeros(rnorm.shape, bool)
+
+    def active(st):
+        it, X, R, P, rz, rn, brk, hist = st
+        return (rn > tol) & (rn < dmax) & (it < maxit) & ~brk
+
+    def cond(st):
+        return jnp.any(active(st))
+
+    def body(st):
+        it, X, R, P, rz, rn, brk, hist = st
+        cont = active(st)
+        cm = cont[:, None, None, None]
+        AP, pAp = Adot(P)                      # fused phase-1 reduction
+        brk_new = cont & (pAp == 0)
+        alpha = jnp.where(pAp == 0, 0.0,
+                          rz / jnp.where(pAp == 0, 1.0, pAp))
+        al = alpha[:, None, None, None]
+        X = jnp.where(cm, X + al * P, X)
+        R = jnp.where(cm, R - al * AP, R)
+        rr = pdotc3(R, R)                      # phase-2 reduction
+        rz_new = rr * inv_diag
+        beta = jnp.where(rz == 0, 0.0,
+                         rz_new / jnp.where(rz == 0, 1.0, rz))
+        P = jnp.where(cm, R * inv_diag + beta[:, None, None, None] * P, P)
+        rz = jnp.where(cont, rz_new, rz)
+        rn = jnp.where(cont, jnp.sqrt(rr), rn)
+        it = it + cont.astype(jnp.int32)
+        if monitor is not None:
+            hist = monitor(hist, it, rn)
+        return (it, X, R, P, rz, rn, brk | brk_new, hist)
+
+    st0 = (jnp.zeros(rnorm.shape, jnp.int32), X3, R, P, rz, rnorm, brk0,
+           hist)
+    it, X, R, P, rz, rnorm, brk, hist = lax.while_loop(cond, body, st0)
+    X = X.reshape(nrhs, -1).T.reshape(flat)
+    return (X, it, rnorm, _reason(rnorm, tol, atol, it, maxit, brk, dmax),
+            hist)
+
+
+_PROGRAM_CACHE_MANY: dict = {}
+
+
+def batched_pc_supported(pc) -> bool:
+    """Whether this PC kind has a batched (trailing-RHS-axis) apply —
+    the KSP.solve_many routing test (unsupported kinds fall back to
+    per-column sequential solves)."""
+    return pc.kind in ("none", "jacobi", "bjacobi", "lu")
+
+
+def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
+                           nrhs: int, monitored: bool = False,
+                           zero_guess: bool = False, hist_cap: int = 0):
+    """Build (or fetch cached) the batched multi-RHS solve program.
+
+    Signature of the returned callable::
+
+        X, iters, rnorm, reason, hist = prog(op_arrays, pc_arrays, B, X0,
+                                             rtol, atol, dtol, maxit)
+
+    with ``B``/``X0``/``X`` row-sharded ``(n_pad, nrhs)`` blocks and
+    ``iters``/``rnorm``/``reason`` per-column ``(nrhs,)`` vectors
+    (``hist`` is ``(hist_cap, nrhs)`` when monitored, zero-size
+    otherwise). Only CG is batched (the block-Krylov workhorse); other
+    KSP types route through the sequential fallback in KSP.solve_many.
+
+    The jitted program is additionally AOT-export-cached
+    (utils/aot.wrap) with ``nrhs`` in the key — a fresh process loads
+    the StableHLO for its exact batch width instead of re-tracing —
+    except while a fault plan with live trace-time faults is armed
+    (a program traced under injection must never be persisted).
+    """
+    if ksp_type != "cg":
+        raise ValueError(
+            f"batched multi-RHS programs support KSP 'cg' (the block-CG "
+            f"kernel); {ksp_type!r} solves route through the sequential "
+            "fallback (KSP.solve_many)")
+    from ..utils import aot
+    axis = comm.axis
+    n = operator.shape[0]
+    dtype = operator.dtype
+    cap_k = int(hist_cap) if monitored else 0
+    trace_nonce = _faults.trace_key()
+    aot_on = aot.aot_enabled() and trace_nonce is None
+    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
+           int(nrhs), monitored, zero_guess, operator.program_key(),
+           cap_k, trace_nonce, aot_on)
+    cached = _PROGRAM_CACHE_MANY.get(key)
+    if cached is not None:
+        return cached
+
+    pc_apply = pc.local_apply_many(comm, n)
+    if pc_apply is None:
+        raise ValueError(
+            f"pc {pc.get_type()!r} has no batched apply "
+            "(krylov.batched_pc_supported); KSP.solve_many falls back to "
+            "sequential per-column solves for it")
+    stencil_cg = (not is_complex(dtype)
+                  and pc.get_type() in ("none", "jacobi")
+                  and hasattr(operator, "local_matvec_dot_many")
+                  and hasattr(operator, "grid3d")
+                  and getattr(operator, "uniform_diagonal", None) is not None
+                  and (pc.get_type() == "none"
+                       or getattr(pc, "_mat", None) is operator))
+    matvec_dot = operator.local_matvec_dot_many(comm) if stencil_cg else None
+    spmv_many = None if stencil_cg else operator.local_spmv_many(comm)
+    op_specs = operator.op_specs(axis)
+    monitor = (_HistMonitorMany(dtype, cap_k or hist_capacity(10000, 0),
+                                nrhs) if monitored else None)
+
+    def local_fn(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit):
+        if zero_guess:
+            X0 = jnp.zeros_like(B)
+        cdot = lambda U, V: jnp.sum(jnp.conj(U) * V, axis=0)
+        pdotc = lambda U, V: _psum(cdot(U, V), axis)
+        pnormc = lambda U: jnp.sqrt(jnp.real(_psum(cdot(U, U), axis)))
+
+        def pduo(R, Z):
+            # BOTH end-of-step dots of every column in ONE stacked psum —
+            # the pipecg/fbcgsr fused-reduction discipline, batched
+            s = _psum(jnp.stack([cdot(R, Z), cdot(R, R)]), axis)
+            return s[0], s[1]
+
+        kw = {"monitor": monitor} if monitor is not None else {}
+        kw["dtol"] = dtol
+        if stencil_cg:
+            inv_diag = (jnp.asarray(1.0, B.dtype) if pc.get_type() == "none"
+                        else jnp.asarray(1.0 / operator.uniform_diagonal,
+                                         B.dtype))
+            pdotc3 = lambda U, V: _psum(jnp.sum(U * V, axis=(1, 2, 3)),
+                                        axis)
+            return cg_stencil_kernel_many(
+                lambda U: matvec_dot(op_arrays, U), inv_diag, pdotc3,
+                B, X0, rtol, atol, maxit, grid3d=operator.grid3d, **kw)
+        A = lambda V: spmv_many(op_arrays, V)
+        M = lambda R: pc_apply(pc_arrays, R)
+        return cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol,
+                              atol, maxit, **kw)
+
+    in_specs = (op_specs, pc.in_specs(axis), P(axis, None), P(axis, None),
+                P(), P(), P(), P())
+    out_specs = (P(axis, None), P(), P(), P(), P())
+    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
+    if aot_on:
+        # key_parts: the full program identity minus the mesh (the wrap
+        # appends its own mesh/jax-version/x64 fingerprint) — nrhs is in
+        # there, so each batch width gets its own shape-specialized blob
+        prog = aot.wrap("ksp_many", comm, key[1:],
+                        prog, code=aot.source_fingerprint(__file__))
+    _PROGRAM_CACHE_MANY[key] = prog
+    return prog

@@ -28,7 +28,8 @@ from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import as_comm
 from ..resilience import faults as _faults
-from ..utils.convergence import ConvergedReason, SolveResult
+from ..utils.convergence import (BatchedSolveResult, ConvergedReason,
+                                 SolveResult)
 from ..utils.errors import wrap_device_errors
 from ..utils.options import global_options
 from .krylov import KSP_KERNELS, NATURAL_TYPES, build_ksp_program
@@ -64,6 +65,12 @@ class KSP:
                                       # which unrolling cannot amortize; >1
                                       # also disables the fused stencil-CG
                                       # fast path (krylov.cg_stencil_kernel)
+        self.batch_limit = 0          # -ksp_batch_limit: max RHS columns per
+                                      # batched solve_many program; 0 = all k
+                                      # in one launch. Set it when k resident
+                                      # columns overflow the stencil kernel's
+                                      # VMEM chunk plan (ops/pallas_stencil
+                                      # _pick_chunk ncols) or HBM
         self._norm_type = "default"   # -ksp_norm_type (KSPSetNormType)
         self._monitors = []
         self._monitor_flag = False
@@ -312,6 +319,8 @@ class KSP:
                                           self.lgmres_augment)
         self.bcgsl_ell = opt.get_int(p + "ksp_bcgsl_ell", self.bcgsl_ell)
         self.unroll = opt.get_int(p + "ksp_unroll", self.unroll)
+        self.batch_limit = opt.get_int(p + "ksp_batch_limit",
+                                       self.batch_limit)
         nt = opt.get_string(p + "ksp_norm_type")
         if nt:
             self.set_norm_type(nt)
@@ -715,6 +724,221 @@ class KSP:
             print(f"Linear solve converged due to "
                   f"{ConvergedReason.name(self.result.reason)} iterations 1")
         return self.result
+
+    # ---- batched multi-RHS solve (PETSc KSPMatSolve analog) -----------------
+    @wrap_device_errors("KSPSolveMany")
+    def solve_many(self, B, X=None) -> BatchedSolveResult:
+        """Solve ``A X = B`` for a block of ``nrhs`` right-hand sides in
+        ONE compiled program launch (the PETSc ``KSPMatSolve`` analog —
+        PARITY.md "Batched solves").
+
+        ``B`` is an ``(n, nrhs)`` host array (or a list of Vecs, stacked
+        column-wise); ``X`` an optional ``(n, nrhs)`` array receiving the
+        solution in place (used as the initial guess block when
+        ``set_initial_guess_nonzero(True)``). Returns a
+        :class:`BatchedSolveResult` with PER-COLUMN iterations, residual
+        norms, reasons, and (when monitoring is on) histories — a column
+        that converges early freezes while the rest keep iterating
+        (masked convergence, krylov.cg_kernel_many).
+
+        Routing: KSP 'cg' with a batched-apply PC (none/jacobi/bjacobi/
+        lu — krylov.batched_pc_supported) and no null space runs the
+        batched block-CG kernel: one all_gather and one fused reduction
+        per phase serve every column, and the stencil fast path keeps
+        all k slabs in the fused Pallas pipeline. Everything else —
+        other KSP types, PCs without a batched apply, the true-residual
+        gate, natural norm — falls back to ``nrhs`` sequential solves
+        (same per-column results, none of the amortization).
+
+        ``-ksp_batch_limit`` (``self.batch_limit``) chunks a batch whose
+        k columns overflow the VMEM plan into ceil(k/limit) launches.
+        """
+        mat = self._mat
+        if mat is None:
+            raise RuntimeError("KSP.solve_many: no operators set")
+        if isinstance(B, (list, tuple)):
+            B = np.stack(
+                [b.to_numpy() if isinstance(b, Vec) else np.asarray(b)
+                 for b in B], axis=1)
+        B = np.asarray(B)
+        if B.ndim != 2 or B.shape[0] != mat.shape[0]:
+            raise ValueError(
+                f"KSP.solve_many: B must be ({mat.shape[0]}, nrhs), got "
+                f"{B.shape}")
+        k = int(B.shape[1])
+        if k == 0:
+            raise ValueError("KSP.solve_many: empty RHS block (nrhs=0)")
+        op_dt = np.dtype(mat.dtype)
+        if X is None:
+            X = np.zeros((mat.shape[0], k), dtype=op_dt)
+        else:
+            X = np.asarray(X)
+            if X.shape != B.shape:
+                raise ValueError(
+                    f"KSP.solve_many: X shape {X.shape} != B shape {B.shape}")
+            if not X.flags.writeable:
+                # asarray of a jax array is a READ-ONLY view; the solution
+                # block is written in place, so take a writable host copy
+                # (the caller reads it back from result.X)
+                X = X.copy()
+        limit = int(self.batch_limit)
+        if limit > 0 and k > limit:
+            # -ksp_batch_limit chunking: ceil(k/limit) batched launches
+            res = BatchedSolveResult(X=X)
+            t0 = time.perf_counter()
+            for s in range(0, k, limit):
+                sl = slice(s, min(s + limit, k))
+                sub = self.solve_many(B[:, sl], X[:, sl])
+                X[:, sl] = sub.X
+                res.iterations += sub.iterations
+                res.residual_norms += sub.residual_norms
+                res.reasons += sub.reasons
+                res.histories += sub.histories
+            res.wall_time = time.perf_counter() - t0
+            self.result_many = res
+            return res
+
+        _faults.check("ksp.solve")    # the one pre-solve fault point
+        self._check_norm_type()
+        self.set_up()
+        pc = self.get_pc()
+        comm = mat.comm
+        from .krylov import (batched_pc_supported, build_ksp_program_many,
+                             hist_capacity)
+        nullspace = getattr(mat, "nullspace", None)
+        batched = (self._type == "cg"
+                   and batched_pc_supported(pc)
+                   and (nullspace is None or nullspace.dim == 0)
+                   and self._norm_type in ("default", "none")
+                   and not self._true_residual_check)
+        if not batched:
+            return self._solve_many_sequential(B, X)
+
+        norm_none = self._norm_type == "none"
+        rtol, atol, divtol = self.rtol, self.atol, self.divtol
+        if norm_none:
+            rtol = atol = divtol = 0.0
+        guess_nonzero = self._initial_guess_nonzero
+        monitored = bool(self._monitors or self._monitor_flag
+                         or hasattr(self, "_history"))
+        prog = build_ksp_program_many(
+            comm, "cg", pc, mat, nrhs=k, monitored=monitored,
+            zero_guess=not guess_nonzero,
+            hist_cap=hist_capacity(self.max_it, 0))
+        dt = np.dtype(op_dt.type(0).real.dtype)
+        # ONE batched placement for both blocks (the PR-3 put_rows_many
+        # discipline: sequential put_rows would pay the runtime's fixed
+        # dispatch twice and fire the comm.put fault point twice)
+        Bd, Xd0 = comm.put_rows_many([B.astype(op_dt, copy=False),
+                                      X.astype(op_dt, copy=False)])
+        # fault point 'ksp.program': a worker crash mid-batched-solve —
+        # the truncated re-run leaves the iteration-K iterate BLOCK in X,
+        # exactly what resilient_solve_many checkpoints and resumes from
+        fault = _faults.triggered("ksp.program")
+        if fault is not None:
+            if fault.iter_k:
+                part = prog(mat.device_arrays(), pc.device_arrays(), Bd,
+                            Xd0, dt.type(0.0), dt.type(0.0),
+                            dt.type(divtol),
+                            np.int32(min(int(fault.iter_k), self.max_it)))
+                X[...] = np.asarray(
+                    jax.device_get(part[0]))[: mat.shape[0]].astype(
+                        X.dtype, copy=False)
+            raise fault.error()
+        t0 = time.perf_counter()
+        out = prog(mat.device_arrays(), pc.device_arrays(), Bd, Xd0,
+                   dt.type(rtol), dt.type(atol), dt.type(divtol),
+                   np.int32(self.max_it))
+        Xd, iters, rnorm, reason, hist = out
+        # one batched D2H fetch for the block and every per-column scalar
+        fetch = jax.device_get((Xd, iters, rnorm, reason)
+                               + ((hist,) if monitored else ()))
+        wall = time.perf_counter() - t0
+        from ..utils.profiling import record_event, record_sync
+        record_sync("KSP solve_many result fetch")
+        Xh = np.asarray(fetch[0])[: mat.shape[0]]
+        X[...] = Xh.astype(X.dtype, copy=False)
+        iters = [int(i) for i in np.asarray(fetch[1])]
+        rnorms = [float(r) for r in np.asarray(fetch[2])]
+        reasons = [int(r) for r in np.asarray(fetch[3])]
+        # always k per-column entries (empty without monitoring) so the
+        # result shape never depends on which path routed the solve
+        histories = [[] for _ in range(k)]
+        if monitored:
+            # replay the recorded per-column entries to the user monitors
+            # and the KSP history, column-major (the same delivery the
+            # sequential fallback gives, so monitoring doesn't silently
+            # flip off with the internal routing); slot index IS the
+            # iteration number (-1 = never written, _HistMonitorMany)
+            hh = np.asarray(fetch[4])
+            monitors = list(self._monitors)
+            if self._monitor_flag and not self._monitors:
+                monitors.append(
+                    lambda ksp, kk, rn:
+                    print(f"  {int(kk):4d} KSP Residual norm "
+                          f"{float(rn):.12e}"))
+            if getattr(self, "_history_reset", False):
+                self._history.clear()
+            for j in range(k):
+                recorded = np.nonzero(hh[:, j] != -1.0)[0]
+                histories[j] = [float(hh[i, j]) for i in recorded]
+                for i in recorded:
+                    for m in monitors:
+                        m(self, int(i), float(hh[i, j]))
+                    if (hasattr(self, "_history")
+                            and len(self._history) < self._history_length):
+                        self._history.append(float(hh[i, j]))
+        for j in range(k):
+            # NaN/Inf residuals must surface as DIVERGED_NANORINF, and
+            # KSP_NORM_NONE reports CONVERGED_ITS (breakdown stays
+            # visible) — the same per-solve bookkeeping as KSP.solve
+            if not norm_none and not np.isfinite(rnorms[j]):
+                reasons[j] = ConvergedReason.DIVERGED_NANORINF
+            elif (norm_none
+                  and reasons[j] != ConvergedReason.DIVERGED_BREAKDOWN):
+                reasons[j] = ConvergedReason.CONVERGED_ITS
+        res = BatchedSolveResult(iterations=iters, residual_norms=rnorms,
+                                 reasons=reasons, wall_time=wall, X=X,
+                                 histories=histories)
+        self.result_many = res
+        record_event(f"KSPSolveMany(cg+{pc.get_type()},k={k})",
+                     mat.shape[0], max(iters) if iters else 0, wall,
+                     max(reasons) if res.converged else min(reasons))
+        return res
+
+    def _solve_many_sequential(self, B, X) -> BatchedSolveResult:
+        """Per-column fallback for configurations without a batched
+        kernel (non-CG types, PCs without a batched apply, the gate):
+        ``nrhs`` ordinary solves, same per-column results, assembled into
+        one :class:`BatchedSolveResult`."""
+        mat = self._mat
+        k = B.shape[1]
+        res = BatchedSolveResult(X=X)
+        t0 = time.perf_counter()
+        for j in range(k):
+            xv = Vec.from_global(mat.comm, X[:, j], dtype=mat.dtype,
+                                 layout=mat.layout)
+            bv = Vec.from_global(mat.comm, B[:, j], dtype=mat.dtype,
+                                 layout=mat.layout)
+            # with reset=False (the petsc4py default) the KSP history
+            # accumulates across solves — slice off only THIS column's
+            # entries so per-column histories stay per-column
+            prev = len(getattr(self, "_history", ()))
+            sub = self.solve(bv, xv)
+            X[:, j] = xv.to_numpy().astype(X.dtype, copy=False)
+            res.iterations.append(sub.iterations)
+            res.residual_norms.append(sub.residual_norm)
+            res.reasons.append(sub.reason)
+            if hasattr(self, "_history"):
+                hist = self.get_convergence_history()
+                res.histories.append([float(v) for v in
+                                      hist[0 if self._history_reset
+                                           else prev:]])
+            else:
+                res.histories.append([])
+        res.wall_time = time.perf_counter() - t0
+        self.result_many = res
+        return res
 
     # ---- introspection (petsc4py-shaped) ------------------------------------
     def get_iteration_number(self) -> int:

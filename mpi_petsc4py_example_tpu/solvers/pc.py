@@ -586,6 +586,44 @@ class PC:
             return lambda arrs, r: vcycle(r)
         raise AssertionError(k)
 
+    def local_apply_many(self, comm: DeviceComm, n: int):
+        """Batched apply ``apply(pc_arrays_local, R_local (lsize, nrhs))
+        -> Z_local`` for the multi-RHS solve path, or None when this PC
+        kind has no batched form (the caller then falls back to
+        per-column sequential solves — solvers/ksp.KSP.solve_many).
+
+        The diagonal kinds broadcast over the trailing RHS axis; the MXU
+        block kinds (bjacobi and the sor/ssor/ilu/icc family that shares
+        its kernel shape) take the trailing axis straight through the
+        batched matmul; dense lu gathers the whole RHS block in ONE
+        collective. Per-apply collective count never grows with k.
+        """
+        k = self.kind
+        axis = comm.axis
+        lsize = comm.local_size(n)
+        if k == "none":
+            return lambda arrs, R: R
+        if k == "jacobi":
+            return lambda arrs, R: arrs[0][:, None] * R
+        if k == "bjacobi":
+            def apply(arrs, R):
+                binv = arrs[0]   # (nb, bs, bs) block inverses
+                nb, bs = binv.shape[0], binv.shape[1]
+                # one batched MXU matmul per apply, k columns at a time
+                return jnp.einsum(
+                    "bij,bjc->bic", binv,
+                    R.reshape(nb, bs, R.shape[1])).reshape(-1, R.shape[1])
+            return apply
+        if k == "lu":
+            def apply(arrs, R):
+                minv = arrs[0]   # replicated (n_pad, n_pad) inverse
+                R_full = lax.all_gather(R, axis, tiled=True)
+                Z_full = minv @ R_full
+                i = lax.axis_index(axis)
+                return lax.dynamic_slice_in_dim(Z_full, i * lsize, lsize)
+            return apply
+        return None
+
     def local_apply_grid3d(self, comm: DeviceComm):
         """3D-native apply for the stencil-CG fast path, or None.
 

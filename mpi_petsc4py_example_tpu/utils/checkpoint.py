@@ -141,6 +141,44 @@ def load_mat(path: str, comm=None) -> Mat:
         return Mat.from_csr(comm, shape, csr, dtype=dtype)
 
 
+def save_solve_state_many(path: str, mat: Mat, X, B, iteration: int = 0):
+    """One-file checkpoint of an in-progress BATCHED solve: operator plus
+    the ``(n, nrhs)`` iterate and RHS blocks (resilience.resilient_solve_many
+    writes one after a retriable mid-batch failure)."""
+    A = mat.to_scipy().tocsr()
+    X = np.asarray(X)
+    B = np.asarray(B)
+    if X.ndim != 2 or B.shape != X.shape:
+        raise ValueError(
+            f"save_solve_state_many: X/B must be matching (n, nrhs) "
+            f"blocks, got {X.shape} and {B.shape}")
+    _atomic_savez(path, kind="solve_state_many",
+                  shape=np.asarray(mat.shape), indptr=A.indptr,
+                  indices=A.indices, data=A.data,
+                  dtype=str(np.dtype(mat.dtype)),
+                  x=X, b=B, iteration=int(iteration))
+
+
+def load_solve_state_many(path: str, comm=None):
+    """Restore ``(mat, X, B, iteration)`` from a batched-solve checkpoint
+    — X/B come back as host ``(n, nrhs)`` arrays, the operator rebuilt on
+    ``comm`` (elastic across mesh sizes, like the single-RHS form)."""
+    comm = as_comm(comm)
+    with _open_npz(path, "solve_state_many") as z:
+        dtype = _checked_dtype(z, path)
+        shape, csr = _checked_csr(z, path)
+        for key in ("x", "b", "iteration"):
+            _check(key in z.files, path, f"missing {key!r}")
+        Xh, Bh = z["x"], z["b"]
+        _check(Xh.ndim == 2 and Xh.shape[0] == shape[0], path,
+               f"iterate block {Xh.shape} does not match n={shape[0]}")
+        _check(Bh.shape == Xh.shape, path,
+               f"rhs block {Bh.shape} does not match iterate {Xh.shape}")
+        mat = Mat.from_csr(comm, shape, csr, dtype=dtype)
+        return (mat, Xh.astype(dtype, copy=False),
+                Bh.astype(dtype, copy=False), int(z["iteration"]))
+
+
 def save_solve_state(path: str, mat: Mat, x: Vec, b: Vec, iteration: int = 0):
     """One-file checkpoint of an in-progress solve (operator, iterate, rhs)."""
     A = mat.to_scipy().tocsr()

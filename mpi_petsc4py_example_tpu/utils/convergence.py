@@ -92,3 +92,61 @@ class SolveResult:
         return (f"SolveResult(iters={self.iterations}, "
                 f"rnorm={self.residual_norm:.3e}, {self.reason_name}, "
                 f"{self.wall_time*1e3:.1f} ms{recov})")
+
+
+@dataclass
+class BatchedSolveResult:
+    """What ``KSP.solve_many`` reports: one entry per RHS column.
+
+    ``iterations``/``residual_norms``/``reasons`` are per-column lists
+    (a frozen easy column keeps its own, smaller iteration count while a
+    hard column in the same batch runs on — the masked-convergence
+    contract); ``histories`` holds each column's recorded residual norms
+    when monitoring was on (empty lists otherwise). ``X`` is the
+    ``(n, nrhs)`` host solution block. ``wall_time`` covers the whole
+    batched solve; ``attempts``/``recovery_events`` mirror SolveResult's
+    resilience trail (filled by resilience.resilient_solve_many).
+    """
+    iterations: list = field(default_factory=list)
+    residual_norms: list = field(default_factory=list)
+    reasons: list = field(default_factory=list)
+    wall_time: float = 0.0
+    X: object = None
+    histories: list = field(default_factory=list)
+    attempts: int = 1
+    recovery_events: list = field(default_factory=list)
+
+    @property
+    def nrhs(self) -> int:
+        return len(self.reasons)
+
+    @property
+    def converged(self) -> bool:
+        """True when EVERY column converged (KSPMatSolve semantics)."""
+        return bool(self.reasons) and all(r > 0 for r in self.reasons)
+
+    @property
+    def reason_names(self):
+        return [ConvergedReason.name(r) for r in self.reasons]
+
+    def per_rhs(self):
+        """Per-column :class:`SolveResult` views (shared wall time)."""
+        return [SolveResult(int(it), float(rn), int(rs), self.wall_time,
+                            history=list(h) if h is not None else [])
+                for it, rn, rs, h in zip(
+                    self.iterations, self.residual_norms, self.reasons,
+                    self.histories or [None] * len(self.reasons))]
+
+    def __repr__(self):
+        if not self.reasons:
+            return "BatchedSolveResult(empty)"
+        recov = ""
+        if self.attempts > 1 or self.recovery_events:
+            recov = (f", attempts={self.attempts}, "
+                     f"{len(self.recovery_events)} recovery events")
+        rmax = max(self.residual_norms)
+        return (f"BatchedSolveResult(nrhs={self.nrhs}, "
+                f"iters={min(self.iterations)}-{max(self.iterations)}, "
+                f"max rnorm={rmax:.3e}, "
+                f"{'all converged' if self.converged else 'NOT converged'}, "
+                f"{self.wall_time*1e3:.1f} ms{recov})")

@@ -1,0 +1,25 @@
+"""TPS012 bad fixture: typo'd / unregistered fault-point names.
+
+Each marked call names a point absent from
+``resilience/faults.FAULT_POINTS`` — it would parse, run, and silently
+never fire, which is exactly the hazard the rule exists for.
+"""
+
+from mpi_petsc4py_example_tpu.resilience import faults as _faults
+from mpi_petsc4py_example_tpu.resilience import faults
+
+
+def solve_entry():
+    _faults.check("ksp.slove")  # BAD: TPS012
+    return True
+
+
+def fetch_result():
+    fault = faults.triggered("comm.psumm")  # BAD: TPS012
+    if fault is not None:
+        raise fault.error()
+
+
+def unregistered_new_point():
+    _faults.check("solver.batched")  # BAD: TPS012
+    return None

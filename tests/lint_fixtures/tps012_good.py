@@ -1,0 +1,32 @@
+"""TPS012 good fixture: registered fault points and dynamic arguments.
+
+Literal points that exist in ``resilience/faults.FAULT_POINTS`` pass;
+a dynamic (non-literal) point argument is not statically checkable and
+stays silent.
+"""
+
+from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+
+def solve_entry():
+    _faults.check("ksp.solve")
+    _faults.check("comm.put")
+    return True
+
+
+def fetch_result():
+    fault = _faults.triggered("ksp.result")
+    if fault is not None:
+        raise fault.error()
+    return _faults.triggered("comm.psum")
+
+
+def dynamic_point(point):
+    # not a string literal: the rule cannot verify it (the coverage
+    # meta-test pins the registry from the literal sites instead)
+    _faults.check(point)
+
+
+def unrelated_check(validator):
+    # .check on a non-faults object is not a fault-point hook
+    validator.check("anything.goes")

@@ -30,7 +30,8 @@ import scipy.sparse as sp
 
 import mpi_petsc4py_example_tpu as tps
 from mpi_petsc4py_example_tpu.models import tridiag_family
-from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+from mpi_petsc4py_example_tpu.solvers.krylov import (build_ksp_program,
+                                                     build_ksp_program_many)
 
 
 def all_gather_volumes(stablehlo_text: str):
@@ -142,6 +143,115 @@ class TestFusedEpsVolume:
         # — the whole point of the O(1)-sync fused loop)
         assert all(v <= n_pad for v in vols), (vols, n_pad)
         assert len(vols) <= 3, vols
+
+
+def _lower_cg_many(comm, M, k, monkeypatch):
+    """Lower the batched multi-RHS CG program (AOT wrap disabled so the
+    raw traced program's .lower is reachable)."""
+    import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+    monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+    krylov_mod._PROGRAM_CACHE_MANY.clear()
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("none")
+    ksp.set_up()
+    pc = ksp.get_pc()
+    prog = build_ksp_program_many(comm, "cg", pc, M, nrhs=k)
+    n = M.shape[0]
+    Bp = comm.put_rows(np.zeros((n, k)))
+    X0 = comm.put_rows(np.zeros((n, k)))
+    dt = np.dtype(np.float64)
+    return prog.lower(
+        M.device_arrays(), pc.device_arrays(), Bp, X0,
+        dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+        np.int32(50)).as_text()
+
+
+class TestBatchedProgramVolume:
+    """The batched-solve comm contract (ISSUE 4 acceptance): the k=8
+    block-CG program contains the SAME NUMBER of all-gather ops as the
+    k=1 program — the per-iteration gather ships the whole RHS block in
+    ONE collective whose BYTES scale with k while the op count does not."""
+
+    def test_k8_gather_op_count_equals_k1(self, comm8, monkeypatch):
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        assert M.dia_vals is None, "test needs the general ELL path"
+        vols_1 = all_gather_volumes(_lower_cg(comm8, M))
+        vols_k = all_gather_volumes(_lower_cg_many(comm8, M, k,
+                                                   monkeypatch))
+        n_pad = comm8.padded_size(n)
+        # op COUNT equal; each batched gather is exactly the k-wide block
+        assert len(vols_k) == len(vols_1), (vols_k, vols_1)
+        assert all(v == n_pad * k for v in vols_k), (vols_k, n_pad, k)
+
+    def test_k8_dia_still_gather_free(self, comm8, monkeypatch):
+        """Banded operators keep the zero-gather ppermute VecScatter in
+        the batched program too."""
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, tridiag_family(n))
+        assert M.dia_vals is not None
+        txt = _lower_cg_many(comm8, M, k, monkeypatch)
+        assert all_gather_volumes(txt) == []
+        assert txt.count("collective_permute") >= 2
+
+    def test_per_column_gather_regression_fails_gate(self, comm8,
+                                                     monkeypatch):
+        """Teeth: an operator whose batched SpMV gathers each column
+        SEPARATELY multiplies the all-gather op count by k — exactly the
+        regression the op-count gate must catch."""
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        vols_1 = all_gather_volumes(_lower_cg(comm8, M))
+        txt = _lower_cg_many(comm8, _PerColumnGatherEll(M), k, monkeypatch)
+        vols_bad = all_gather_volumes(txt)
+        # the regression emits k vector-sized gathers per SpMV site
+        assert len(vols_bad) > len(vols_1), (vols_bad, vols_1)
+        with pytest.raises(AssertionError):
+            assert len(vols_bad) == len(vols_1)
+
+
+class _PerColumnGatherEll:
+    """A Mat shim whose MULTI-RHS SpMV all-gathers column by column —
+    the injected per-column-gather regression (op count grows with k)."""
+
+    def __init__(self, M):
+        self._M = M
+        self.shape = M.shape
+        self.dtype = M.dtype
+        self.layout = M.layout
+        self.comm = M.comm
+
+    def device_arrays(self):
+        return self._M.device_arrays()
+
+    def op_specs(self, axis):
+        return self._M.op_specs(axis)
+
+    def program_key(self):
+        return ("ell-per-column-gather-regression",)
+
+    def get_vecs(self):
+        return self._M.get_vecs()
+
+    def local_spmv(self, comm):
+        return self._M.local_spmv(comm)
+
+    def local_spmv_many(self, comm):
+        from mpi_petsc4py_example_tpu.ops.spmv import ell_spmv_local
+        axis = comm.axis
+
+        def spmv_many(op_arrays, X_local):
+            cols, vals = op_arrays
+            outs = []
+            for j in range(X_local.shape[1]):
+                xj_full = jax.lax.all_gather(X_local[:, j], axis,
+                                             tiled=True)
+                outs.append(ell_spmv_local(cols, vals, xj_full))
+            return jnp.stack(outs, axis=1)
+
+        return spmv_many
 
 
 class _RegressedEll:

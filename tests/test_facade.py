@@ -116,6 +116,122 @@ class TestPETScFacade:
         assert np.allclose(x.array, X_actual, atol=1e-6)
 
 
+# petsc4py-style nested setSizes hint: the local size slot is DECIDE
+DECIDE_LOCAL = PETSc.DECIDE
+
+
+class TestMatSetValues:
+    """petsc4py-style entry-by-entry assembly: create/setSizes/setValues
+    + INSERT/ADD with assemblyBegin/End building the CSR host-side
+    (VERDICT missing #2). The ``csr=`` constructor fast path stays."""
+
+    def test_tridiagonal_matches_csr_fast_path(self):
+        """The test2.py tridiagonal, assembled entry-by-entry, is
+        bit-identical to the csr= constructor's matrix."""
+        N = 100
+        A = PETSc.Mat().create(MPI.COMM_WORLD)
+        A.setSizes((N, N))
+        A.setType("aij")
+        A.setFromOptions()
+        for i in range(N):
+            cols = [j for j in (i - 1, i, i + 1) if 0 <= j < N]
+            A.setValues([i], cols, [float(i + j + 1) for j in cols],
+                        addv=PETSc.InsertMode.INSERT_VALUES)
+        A.assemblyBegin()
+        A.assemblyEnd()
+        assert A.isAssembled()
+        CSR = tridiag_family(N)
+        B = PETSc.Mat().createAIJ(size=CSR.shape,
+                                  csr=(CSR.indptr, CSR.indices, CSR.data))
+        assert abs(A.core.to_scipy() - B.core.to_scipy()).max() == 0.0
+
+    def test_setvalues_solve_matches_reference_flow(self):
+        """A KSP solve through the setValues-assembled operator gives the
+        same answer as the csr= path (the matrix IS the same object
+        shape-wise — this pins the end-to-end flow)."""
+        N = 100
+        CSR = tridiag_family(N)
+        A = PETSc.Mat().create(MPI.COMM_WORLD)
+        A.setSizes(((DECIDE_LOCAL, N), (DECIDE_LOCAL, N)))
+        A.setType("aij")
+        for i in range(N):
+            cols = [j for j in (i - 1, i, i + 1) if 0 <= j < N]
+            A.setValues([i], cols, [float(i + j + 1) for j in cols])
+        A.assemble()
+        x, b = A.getVecs()
+        rhs = np.asarray(CSR @ np.ones(N))
+        b.setArray(rhs)
+        ksp = PETSc.KSP().create(MPI.COMM_WORLD)
+        ksp.setType("gmres")
+        ksp.getPC().setType("jacobi")
+        ksp.setOperators(A)
+        ksp.core.set_tolerances(rtol=1e-10)
+        ksp.setUp()
+        ksp.solve(b, x)
+        assert np.abs(x.array - 1.0).max() < 1e-6
+
+    def test_add_values_sums_duplicates(self):
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(4)
+        M.setType("aij")
+        M.setValues([0], [0], [1.0], addv=PETSc.InsertMode.ADD_VALUES)
+        M.setValues([0], [0], [2.0], addv=True)      # petsc4py bool form
+        for i in range(1, 4):
+            M.setValue(i, i, float(i), addv=True)
+        M.assemble()
+        S = M.core.to_scipy()
+        assert S[0, 0] == 3.0
+        assert S[2, 2] == 2.0
+
+    def test_insert_last_write_wins(self):
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(3)
+        M.setType("aij")
+        M.setValues([0, 1, 2], [0, 1, 2], np.diag([1.0, 2.0, 3.0]))
+        M.setValue(1, 1, 9.0)                        # overrides the 2.0
+        M.assemble()
+        assert M.core.to_scipy()[1, 1] == 9.0
+
+    def test_numpy_bool_addv_means_add(self):
+        """np.True_ (e.g. ``addv=np.any(mask)``) must mean ADD like the
+        Python bool — under int equality np.True_ == INSERT_VALUES, the
+        trap the bool-first normalization exists for."""
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(2)
+        M.setType("aij")
+        M.setValue(0, 0, 1.0, addv=np.True_)
+        M.setValue(0, 0, 2.0, addv=np.True_)
+        M.setValue(1, 1, 1.0, addv=np.True_)
+        M.assemble()
+        assert M.core.to_scipy()[0, 0] == 3.0
+
+    def test_mixing_modes_without_assembly_raises(self):
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(3)
+        M.setType("aij")
+        M.setValue(0, 0, 1.0)
+        with pytest.raises(RuntimeError, match="mix"):
+            M.setValue(0, 0, 1.0, addv=True)
+
+    def test_out_of_range_index_raises(self):
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(3)
+        M.setType("aij")
+        M.setValue(0, 7, 1.0)
+        with pytest.raises(ValueError, match="out of range"):
+            M.assemble()
+
+    def test_setvalues_after_assembly_rejected(self):
+        M = PETSc.Mat().create(MPI.COMM_WORLD)
+        M.setSizes(2)
+        M.setType("aij")
+        M.setValue(0, 0, 1.0)
+        M.setValue(1, 1, 1.0)
+        M.assemble()
+        with pytest.raises(RuntimeError, match="assemblyEnd"):
+            M.setValue(0, 0, 2.0)
+
+
 class TestSLEPcFacade:
     def test_reference_test2_flow(self):
         """The test2.py call sequence: wrapper API + HEP eigensolve."""
@@ -165,6 +281,14 @@ class TestDriversUnderTpurun:
     def test_eigensolve(self, nranks):
         r = run_driver("examples/eigensolve.py", nranks)
         assert r.returncode == 0, r.stderr
+        assert "Eigenvalue:" in r.stdout
+
+    def test_assemble_setvalues(self, nranks):
+        """The setValues assembly driver: per-rank MatSetValues of owned
+        rows == the csr= fast path, then the test2.py eigensolve."""
+        r = run_driver("examples/assemble_setvalues.py", nranks)
+        assert r.returncode == 0, r.stderr
+        assert "max |diff|: 0.000e+00" in r.stdout
         assert "Eigenvalue:" in r.stdout
 
 

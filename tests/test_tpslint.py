@@ -28,7 +28,7 @@ from tools.tpslint.cli import main as tpslint_main
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006",
-            "TPS011")
+            "TPS011", "TPS012")
 #: current advisory (warn-tier) count over the repo's own packages — the
 #: CI --warn-budget. Raising it requires looking at the new advisory and
 #: deciding it is acceptable; that is the tier's whole contract.
@@ -278,6 +278,38 @@ def test_repo_warn_budget():
     assert len(warn_sites) <= REPO_WARN_BUDGET, warn_sites
     assert result.exit_code(strict=True,
                             warn_budget=REPO_WARN_BUDGET) == 0
+
+
+def test_fault_registry_parses():
+    """TPS012 reads FAULT_POINTS from resilience/faults.py by AST — the
+    registry must parse non-empty or the rule is silently toothless."""
+    from tools.tpslint.rules.tps012_fault_registry import (
+        registered_fault_points)
+    pts = registered_fault_points()
+    assert "ksp.solve" in pts and "comm.psum" in pts, pts
+
+
+def test_fault_registry_coverage():
+    """The reverse direction of TPS012 (ROADMAP's registry contract):
+    every point registered in FAULT_POINTS has at least one literal call
+    site in the framework — a registered-but-never-hooked point is dead
+    configuration surface."""
+    import ast as _ast
+
+    from tools.tpslint.engine import iter_python_files
+    from tools.tpslint.rules.tps012_fault_registry import (
+        fault_point_sites, registered_fault_points)
+    pts = registered_fault_points()
+    assert pts
+    seen = set()
+    for fname in iter_python_files([str(REPO / "mpi_petsc4py_example_tpu")]):
+        tree = _ast.parse(Path(fname).read_text())
+        for point, _node in fault_point_sites(tree):
+            if point is not None:
+                seen.add(point)
+    missing = set(pts) - seen
+    assert not missing, (
+        f"FAULT_POINTS entries with no call site: {sorted(missing)}")
 
 
 # ------------------------------------------------------- severity tiers

@@ -1,0 +1,101 @@
+"""TPS012 — fault-point registry check.
+
+Every ``faults.check("...")`` / ``faults.triggered("...")`` call site must
+name a point registered in ``resilience/faults.FAULT_POINTS``: a typo'd
+point name parses, runs, and simply NEVER FIRES — the injected-fault test
+that was supposed to exercise a recovery path silently exercises nothing
+(the fault-injection analog of TPS007's options-flag registry check,
+ROADMAP).  The reverse direction — every registered point has at least one
+call site — is a repo-level property and is enforced by the meta-test
+``tests/test_tpslint.py::test_fault_registry_coverage`` built on this
+module's :func:`fault_point_sites` helper.
+
+The registry is read from ``resilience/faults.py`` by PARSING its AST (the
+``FAULT_POINTS`` dict literal's string keys) — tpslint stays stdlib-only
+and never imports framework packages (the package ``__init__`` pulls in
+jax).  Dynamic point arguments (``faults.check(point)``) are not
+checkable and stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+from pathlib import Path
+
+from ..context import terminal_name
+from .base import Rule, register
+
+#: attribute names that count as fault-point hooks on a faults module
+_HOOKS = ("check", "triggered")
+#: module aliases the repo binds resilience.faults to
+_MODULE_NAMES = ("faults", "_faults")
+
+_FAULTS_REL = Path("mpi_petsc4py_example_tpu") / "resilience" / "faults.py"
+
+
+@functools.lru_cache(maxsize=1)
+def registered_fault_points() -> frozenset:
+    """String keys of ``resilience/faults.FAULT_POINTS``, parsed from the
+    module's AST.  Empty when the file (or the dict) cannot be found —
+    the rule then has nothing to check against and stays silent (the
+    coverage meta-test fails loudly on an empty registry instead)."""
+    path = Path(__file__).resolve().parents[3] / _FAULTS_REL
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return frozenset()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "FAULT_POINTS" not in targets:
+            continue
+        if isinstance(node.value, ast.Dict):
+            return frozenset(
+                key.value for key in node.value.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value,
+                                                                str))
+    return frozenset()
+
+
+def fault_point_sites(tree):
+    """Yield ``(point_or_None, call_node)`` for every fault-point hook
+    call in ``tree`` — ``point`` is the literal string argument, or None
+    when the argument is dynamic (not statically checkable)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOOKS):
+            continue
+        if terminal_name(node.func.value) not in _MODULE_NAMES:
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield arg.value, node
+        else:
+            yield None, node
+
+
+@register
+class FaultRegistryRule(Rule):
+    id = "TPS012"
+    name = "fault-point-registry"
+    description = ("faults.check()/faults.triggered() call sites must name "
+                   "a point registered in resilience/faults.FAULT_POINTS — "
+                   "a typo'd point silently never fires")
+
+    def check(self, module):
+        known = registered_fault_points()
+        if not known:
+            return
+        for point, node in fault_point_sites(module.tree):
+            if point is not None and point not in known:
+                yield self.finding(
+                    node,
+                    f"fault point {point!r} is not registered in "
+                    "resilience/faults.FAULT_POINTS — the hook will never "
+                    f"fire (known: {', '.join(sorted(known))}); register "
+                    "the point or fix the name")
