@@ -64,6 +64,7 @@ from .solvers.pc import PC
 from .solvers.ksp import KSP
 from .utils.convergence import (BatchedSolveResult, ConvergedReason,
                                 RecoveryEvent, SolveResult)
+from .utils.errors import DeviceExecutionError, SilentCorruptionError
 from .utils.options import Options, global_options, init, backend
 from .utils import petsc_io
 from . import resilience
@@ -79,6 +80,7 @@ __all__ = [
     "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST", "SVD",
     "ConvergedReason", "RecoveryEvent", "SolveResult",
     "BatchedSolveResult",
+    "DeviceExecutionError", "SilentCorruptionError",
     "Options", "global_options", "init", "backend", "petsc_io",
     "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
     "resilient_solve_many",

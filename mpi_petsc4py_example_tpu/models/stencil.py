@@ -251,6 +251,19 @@ class StencilPoisson3D:
     def diagonal(self) -> np.ndarray:
         return np.full(self.shape[0], 6.0)
 
+    def column_checksum_host(self) -> np.ndarray:
+        """ABFT column checksum ``c = Aᵀ·1`` of the 7-point operator,
+        analytically on host (resilience/abft.py): the stencil is
+        symmetric, so ``c = A·1`` — ``6 - (#neighbours present)`` per
+        node, i.e. zero in the interior with positive entries along the
+        Dirichlet boundary shells."""
+        nx, ny, nz = self.nx, self.ny, self.nz
+        z, y, x = np.meshgrid(np.arange(nz), np.arange(ny), np.arange(nx),
+                              indexing="ij")
+        nbrs = ((z > 0).astype(np.float64) + (z < nz - 1)
+                + (y > 0) + (y < ny - 1) + (x > 0) + (x < nx - 1))
+        return (6.0 - nbrs).reshape(-1)
+
     def mult(self, x: Vec, y: Vec | None = None) -> Vec:
         """Standalone SpMV (jit + shard_map over the mesh)."""
         prog = _stencil_mult_program(self)

@@ -9,7 +9,9 @@ Submodules:
 * :mod:`.retry` — :class:`RetryPolicy` + :func:`resilient_solve`
   (checkpoint → backoff → rebuild → resume on retriable device failures);
 * :mod:`.fallback` — :class:`KSPFallbackChain` (method escalation on
-  breakdown/NaN, reduced-precision retry on device OOM).
+  breakdown/NaN, reduced-precision retry on device OOM);
+* :mod:`.abft` — ABFT column checksums + trace-time silent-corruption
+  applicator (README "Silent-error detection").
 
 ``faults`` is stdlib-only and imported eagerly (``parallel/mesh.py``
 depends on it); ``retry``/``fallback`` import solver machinery and load
@@ -17,10 +19,11 @@ lazily to keep this package importable from anywhere in the framework.
 """
 
 from . import faults
+from . import abft
 from .faults import FaultSpecError, inject_faults
 
 __all__ = [
-    "faults", "inject_faults", "FaultSpecError",
+    "faults", "abft", "inject_faults", "FaultSpecError",
     "RetryPolicy", "resilient_solve", "resilient_solve_many",
     "default_checkpoint_path",
     "KSPFallbackChain", "reduced_dtype",

@@ -1,0 +1,150 @@
+"""Algorithm-based fault tolerance (ABFT) for the SpMV/PC apply path.
+
+Silent data corruption — a flipped bit in an SpMV result, a corrupted
+psum, a mis-scaled preconditioner apply — produces no crash and no NaN:
+without a detector the Krylov recurrence happily reports CONVERGED over a
+wrong iterate (the ``faults.py`` silent kinds ``spmv.result``/``pc.apply``
+reproduce this deterministically). The classic Krylov answer (Huang &
+Abraham's checksum ABFT, plus periodic residual replacement) maps onto
+this framework's fused-reduction structure with ZERO extra collectives:
+
+* **column checksum**: precompute ``c = Aᵀ·1`` per operator format
+  (ELL/DIA host CSR, device-only ELL shards, analytic for the matrix-free
+  stencil) ONCE on the host, independently of the device apply — the
+  identity ``⟨1, A x⟩ = ⟨c, x⟩`` then verifies every in-program apply.
+  The two sides are local partial sums folded into the SAME stacked
+  ``psum`` that already reduces ``⟨p, A p⟩`` (solvers/krylov.py guarded
+  kernels), so the per-iteration collective COUNT does not grow;
+* **PC checksum**: the same identity for preconditioner applies,
+  ``c_M = Mᵀ·1``, available for the kinds whose operator form is known at
+  setup (none/jacobi — :func:`pc_checksum` returns None otherwise and the
+  M-channel check is skipped);
+* **dtype-aware tolerance**: both checksum sums are tree reductions, so
+  their benign rounding is O(log2(n) · eps) relative to the ABSOLUTE sums
+  ``Σ|y|`` / ``Σ|c⊙x|`` (folded into the same psum); the detector fires on
+  ``|⟨1,y⟩ - ⟨c,x⟩| > tol_factor · eps · scale`` with ``tol_factor``
+  runtime-tunable (``-ksp_abft_tol``, default 256 — comfortably above
+  tree-reduction rounding at any practical n, far below any corruption
+  worth the name).
+
+This module also owns the TRACE-TIME corruption applicator for the silent
+fault kinds (``faults.py`` stays stdlib-only and cannot touch jnp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import faults as _faults
+
+#: default ``-ksp_abft_tol`` multiplier: threshold = tol * eps * scale
+DEFAULT_ABFT_TOL = 256.0
+
+
+# ---------------------------------------------------------------------------
+# trace-time silent corruption (the spmv.result / pc.apply fault kinds)
+# ---------------------------------------------------------------------------
+
+def _bitflip(y):
+    """Flip a high exponent bit of element 0 — one localized, huge error
+    (the single-event-upset model). Bitcast for real floats; complex
+    dtypes corrupt by sign+magnitude instead (no complex bitcast)."""
+    import jax.numpy as jnp
+    from jax import lax
+    flat = y.ravel()
+    if jnp.issubdtype(y.dtype, jnp.complexfloating):
+        flat = flat.at[0].multiply(-3.0)
+    else:
+        ibits = {2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[y.dtype.itemsize]
+        bit = {2: 1 << 13, 4: 1 << 29, 8: 1 << 61}[y.dtype.itemsize]
+        as_int = lax.bitcast_convert_type(flat, ibits)
+        as_int = as_int.at[0].set(as_int[0] ^ bit)
+        flat = lax.bitcast_convert_type(as_int, y.dtype)
+    return flat.reshape(y.shape)
+
+
+def apply_silent_fault(point: str, y):
+    """Consult the armed fault plan at TRACE time; if a silent fault fires
+    at ``point``, return the corrupted array (the corruption bakes into
+    the jaxpr — every execution of the traced program carries it).
+    Program caches are isolated via ``faults.trace_key()`` exactly like
+    ``comm.psum`` (solvers/krylov.py cache keys)."""
+    fault = _faults.triggered(point)
+    if fault is None:
+        return y
+    if fault.kind == "bitflip":
+        return _bitflip(y)
+    if fault.kind == "scale":
+        return y * (1.0 + fault.mag)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# column checksums, per operator format
+# ---------------------------------------------------------------------------
+
+def column_checksum(operator) -> np.ndarray:
+    """The ABFT column-checksum vector ``c = Aᵀ·1`` (global, host-side).
+
+    Computed INDEPENDENTLY of the device apply — from the host CSR when
+    retained, from the fetched ELL shards otherwise, analytically for the
+    matrix-free stencil — so a corrupted device channel can never produce
+    a self-consistently corrupted checksum. Cached on the operator keyed
+    by its mutation counter (``Mat._state``).
+    """
+    state = getattr(operator, "_state", 0)
+    cached = getattr(operator, "_abft_checksum", None)
+    if cached is not None and cached[0] == state:
+        return cached[1]
+    c = _compute_checksum(operator)
+    try:
+        operator._abft_checksum = (state, c)
+    except AttributeError:    # operators with __slots__: skip the cache
+        pass
+    return c
+
+
+def _compute_checksum(operator) -> np.ndarray:
+    own = getattr(operator, "column_checksum_host", None)
+    if own is not None:                    # operator-provided (stencil)
+        return np.asarray(own())
+    n = operator.shape[1]
+    host_csr = getattr(operator, "host_csr", None)
+    if host_csr is not None:
+        indptr, indices, data = host_csr
+        c = np.zeros(n, dtype=np.asarray(data).dtype)
+        np.add.at(c, np.asarray(indices), np.asarray(data))
+        return c
+    # device-only ELL shards: fetch once (setup-time, host-side)
+    cols = operator.comm.host_fetch(operator.ell_cols)[: operator.shape[0]]
+    vals = operator.comm.host_fetch(operator.ell_vals)[: operator.shape[0]]
+    c = np.zeros(n, dtype=vals.dtype)
+    # padding slots are (col 0, val 0.0) — they contribute exactly zero
+    np.add.at(c, cols.ravel(), vals.ravel())
+    return c
+
+
+def pc_checksum(pc, mat) -> np.ndarray | None:
+    """``c_M = Mᵀ·1`` for preconditioner kinds whose operator form is
+    known host-side at setup; None when unavailable (the M-channel ABFT
+    check is then skipped and pc.apply corruption is left to the drift
+    gate / sentinels)."""
+    n = mat.shape[0]
+    kind = getattr(pc, "kind", None)
+    if kind == "none":
+        return np.ones(n)
+    if kind == "jacobi":
+        # M = diag(1/d) is symmetric: c_M = M·1 = 1/d, from the same
+        # host-side diagonal the PC setup itself uses
+        pmat = getattr(pc, "_mat", None) or mat
+        d = np.asarray(pmat.diagonal())
+        with np.errstate(divide="ignore", invalid="ignore"):
+            c = np.where(d != 0, 1.0 / d, 0.0)
+        return c
+    return None
+
+
+def checksum_tolerance_dtype(dtype) -> float:
+    """Machine epsilon of the REAL scalar of ``dtype`` — the unit the
+    ``-ksp_abft_tol`` multiplier scales."""
+    return float(np.finfo(np.dtype(dtype).type(0).real.dtype).eps)

@@ -12,7 +12,11 @@ boundaries that synthetic, reproducible faults can be attached to:
 * poison a solve's residual with NaN/Inf "at iteration k" (``nan``/``inf``
   at ``ksp.result`` — the DIVERGED_NANORINF / fallback-chain trigger);
 * drop or corrupt a collective (``comm.psum`` at trace time, ``comm.fetch``
-  / ``comm.put`` at the host boundary).
+  / ``comm.put`` at the host boundary);
+* SILENTLY corrupt an in-program operator or preconditioner apply
+  (``spmv.result`` / ``pc.apply``, trace time: ``bitflip``/``scale`` —
+  no crash, no NaN; the corruption the ABFT checksums and invariant
+  monitors in resilience/abft.py + solvers/krylov.py must detect).
 
 Activation — spec string via either route::
 
@@ -26,7 +30,9 @@ Spec grammar (comma-separated clauses)::
     clause := point '=' kind (':' param '=' value)*
     point  := one of FAULT_POINTS
     kind   := unavailable | oom | nan | inf | drop | corrupt
+            | bitflip | scale                  (silent corruption)
     params := at=N      trigger on the Nth hit of the point (default 1)
+              mag=M     relative error of 'scale' corruption (default 1e-3)
               times=M   stay armed for M consecutive hits ('*' = forever)
               iter=K    simulated crash/poison iteration (ksp.program /
                         ksp.result: the partial iterate of K real device
@@ -53,7 +59,7 @@ import random
 import threading
 
 # Registry of named fault points and the fault kinds each supports.
-# tpslint TPS012 (ROADMAP, deferred) will check call sites against this.
+# tpslint TPS012 checks call sites against this.
 FAULT_POINTS = {
     "ksp.solve":   ("unavailable", "oom"),   # KSP.solve entry (all paths)
     "ksp.program": ("unavailable", "oom"),   # around the compiled solve
@@ -62,6 +68,19 @@ FAULT_POINTS = {
     "comm.put":    ("unavailable", "oom"),   # device_put data placement
     "comm.fetch":  ("unavailable", "drop", "corrupt"),  # host gather
     "comm.psum":   ("drop", "corrupt"),      # traced in-program collective
+    # SILENT data corruption (no crash, no NaN): applied at TRACE time to
+    # the operator/preconditioner apply inside the compiled solve, so the
+    # corruption bakes into every execution of that program — the SDC
+    # model the ABFT/monitor layer (resilience/abft.py) must catch.
+    # 'bitflip' flips a high exponent bit of one element (a localized,
+    # huge error); 'scale' multiplies the whole result by (1 + mag) (a
+    # systematic small relative error — mag= spec param, default 1e-3).
+    # Hit counters advance once per TRACED apply site (init residual,
+    # loop body, replacement branch, ...), so at=N selects WHICH site of
+    # the program is corrupted; a clause that is spent no longer forces
+    # cache isolation and retries get a clean program (trace_key()).
+    "spmv.result": ("bitflip", "scale"),     # operator apply, in-program
+    "pc.apply":    ("bitflip", "scale"),     # PC apply, in-program
 }
 
 RAISING_KINDS = ("unavailable", "oom")
@@ -90,7 +109,8 @@ class Fault:
 
     def __init__(self, point: str, kind: str, at: int = 1, times: int = 1,
                  forever: bool = False, iter_k: int | None = None,
-                 seed: int | None = None, prob: float = 1.0):
+                 seed: int | None = None, prob: float = 1.0,
+                 mag: float = 1e-3):
         self.point = point
         self.kind = kind
         self.at = at
@@ -98,6 +118,7 @@ class Fault:
         self.forever = forever
         self.iter_k = iter_k
         self.prob = prob
+        self.mag = mag       # relative magnitude of 'scale' corruption
         self._rng = random.Random(seed) if seed is not None else None
         self.hits = 0      # times the point was reached
         self.fired = 0     # times this fault actually triggered
@@ -166,10 +187,12 @@ def _parse_clause(clause: str) -> Fault:
                 kw["seed"] = int(value)
             elif key == "prob":
                 kw["prob"] = float(value)
+            elif key == "mag":
+                kw["mag"] = float(value)
             else:
                 raise FaultSpecError(
                     f"fault clause {clause!r}: unknown parameter {key!r} "
-                    "(have: at, times, iter, seed, prob)")
+                    "(have: at, times, iter, seed, prob, mag)")
         except ValueError as e:
             if isinstance(e, FaultSpecError):
                 raise
@@ -264,7 +287,7 @@ def check(point: str):
 
 # fault points whose effect applies while a program is being TRACED (and
 # therefore bakes into the compiled artifact, demanding cache isolation)
-TRACE_TIME_POINTS = ("comm.psum",)
+TRACE_TIME_POINTS = ("comm.psum", "spmv.result", "pc.apply")
 
 
 def trace_key():

@@ -35,7 +35,7 @@ from ..utils.checkpoint import (load_solve_state, load_solve_state_many,
                                 save_solve_state, save_solve_state_many)
 from ..utils.convergence import (BatchedSolveResult, RecoveryEvent,
                                  SolveResult)
-from ..utils.errors import DeviceExecutionError
+from ..utils.errors import DeviceExecutionError, SilentCorruptionError
 
 
 @dataclass
@@ -49,10 +49,13 @@ class RetryPolicy:
     drawn reproducibly from ``jitter_seed``).
 
     ``retriable_classes`` keys off ``DeviceExecutionError.failure_class``
-    (utils/errors.FAILURE_CLASSES): only 'unavailable' is retriable as-is;
-    'oom' needs a cheaper configuration (the fallback chain's
-    reduced-precision move, resilience/fallback.py), and 'callback' /
-    'unsupported' cannot succeed on retry at all.
+    (utils/errors.FAILURE_CLASSES): 'unavailable' is retriable as-is;
+    'detected_sdc' (a silent corruption caught by the ABFT/monitor
+    guard) retries WITHOUT backoff — there is no crashed worker to wait
+    out, the solve re-enters immediately from the verified iterate the
+    solve boundary rolled back to; 'oom' needs a cheaper configuration
+    (the fallback chain's reduced-precision move, resilience/fallback.py),
+    and 'callback' / 'unsupported' cannot succeed on retry at all.
     """
     max_attempts: int = 3
     base_delay: float = 0.5
@@ -60,7 +63,7 @@ class RetryPolicy:
     max_delay: float = 30.0
     jitter: float = 0.0
     jitter_seed: int = 0
-    retriable_classes: tuple = ("unavailable",)
+    retriable_classes: tuple = ("unavailable", "detected_sdc")
     sleep: object = time.sleep     # injectable for tests (recorded delays)
 
     def delay(self, retry_index: int) -> float:
@@ -76,6 +79,52 @@ class RetryPolicy:
     def should_retry(self, exc: Exception) -> bool:
         return (isinstance(exc, DeviceExecutionError)
                 and exc.failure_class in self.retriable_classes)
+
+
+def _verify_true_residual(ksp, b, x):
+    """Host-checked TRUE residual of the recovered iterate against the
+    KSP's own tolerance target: ``(ok, rel_residual)``. The verification
+    channel is independent of the (possibly corrupted) solve program —
+    one plain operator apply plus host norms. A zero target (norm-none /
+    fixed-iteration solves set rtol=atol=0 — there is no convergence
+    contract to hold the answer to) passes with the residual reported
+    informationally."""
+    import numpy as np
+    mat = ksp.get_operators()[0]
+    bh = np.asarray(b.to_numpy())
+    ax = np.asarray(mat.mult(x).to_numpy())
+    rn = float(np.linalg.norm(bh - ax))
+    bn = float(np.linalg.norm(bh))
+    target = max(ksp.rtol * bn, ksp.atol)
+    # 1.05: device-vs-host norm rounding slack (the repo-wide convention)
+    ok = target <= 0 or rn <= target * 1.05
+    return ok, rn / bn if bn > 0 else rn
+
+
+def _verify_true_residual_many(ksp, B, X):
+    """Per-column host-checked TRUE residuals of the recovered block:
+    ``(all_ok, worst_rel_residual)``. Zero-target columns (rtol=atol=0,
+    the fixed-iteration contract) pass — see _verify_true_residual."""
+    import numpy as np
+    mat = ksp.get_operators()[0]
+    B = np.asarray(B)
+    X = np.asarray(X)
+    if hasattr(mat, "to_scipy"):
+        R = B - mat.to_scipy() @ X
+    else:
+        from ..core.vec import Vec
+        cols = []
+        for j in range(X.shape[1]):
+            xv = Vec.from_global(mat.comm, X[:, j], dtype=mat.dtype,
+                                 layout=mat.layout)
+            cols.append(np.asarray(mat.mult(xv).to_numpy()))
+        R = B - np.stack(cols, axis=1)
+    rn = np.linalg.norm(R, axis=0)
+    bn = np.linalg.norm(B, axis=0)
+    targets = np.maximum(ksp.rtol * bn, ksp.atol)
+    ok = bool(np.all((targets <= 0) | (rn <= targets * 1.05)))
+    rres = float(np.max(rn / np.where(bn > 0, bn, 1.0)))
+    return ok, rres
 
 
 def default_checkpoint_path(ksp=None) -> str:
@@ -118,27 +167,44 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                 if (attempt >= policy.max_attempts
                         or not policy.should_retry(exc)):
                     raise
+                detector = getattr(exc, "detector", "")
+                sdc = exc.failure_class == "detected_sdc"
                 events.append(RecoveryEvent(
                     kind="fault", attempt=attempt, detail=str(exc),
-                    error_class=exc.failure_class))
+                    error_class=exc.failure_class, detector=detector))
                 mat = ksp.get_operators()[0]
                 persisted = hasattr(mat, "to_scipy")
                 if persisted:
+                    # for DETECTED_SDC the solve boundary already rolled
+                    # x back to the last VERIFIED iterate — the
+                    # checkpoint persists exactly that rollback target
                     save_solve_state(path, mat, x, b, iteration=0)
                     events.append(RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
-                delay = policy.delay(attempt - 1)
-                events.append(RecoveryEvent(
-                    kind="backoff", attempt=attempt, delay=delay,
-                    error_class=exc.failure_class))
-                policy.sleep(delay)
-                if persisted:
-                    # rebuild from the checkpoint: fresh device buffers
-                    # (nothing from before the failure is trusted), iterate
-                    # restored onto the CALLER's vector so x stays live
-                    mat2, x2, _b2, _it = load_solve_state(path, mat.comm)
-                    ksp.set_operators(mat2)
-                    x.data = x2.data
+                if sdc:
+                    # no crashed worker to wait out: re-enter immediately
+                    # from the verified iterate (retry.py's DETECTED_SDC
+                    # escalation — the final answer is re-verified against
+                    # the TRUE residual below before it is returned)
+                    events.append(RecoveryEvent(
+                        kind="rollback", attempt=attempt,
+                        detail="re-entering from verified iterate",
+                        detector=detector))
+                else:
+                    delay = policy.delay(attempt - 1)
+                    events.append(RecoveryEvent(
+                        kind="backoff", attempt=attempt, delay=delay,
+                        error_class=exc.failure_class))
+                    policy.sleep(delay)
+                    if persisted:
+                        # rebuild from the checkpoint: fresh device
+                        # buffers (nothing from before the failure is
+                        # trusted), iterate restored onto the CALLER's
+                        # vector so x stays live
+                        mat2, x2, _b2, _it = load_solve_state(path,
+                                                              mat.comm)
+                        ksp.set_operators(mat2)
+                        x.data = x2.data
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
                 events.append(RecoveryEvent(
@@ -148,6 +214,22 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
         ksp.set_initial_guess_nonzero(guess_flag0)
     result.attempts = attempt
     result.recovery_events = events
+    sdc_faults = [e for e in events if e.kind == "fault" and e.detector]
+    if sdc_faults:
+        # a silent corruption was recovered from: the answer must not be
+        # taken on the recurrence's word — verify the TRUE residual
+        # through an independent host-checked apply
+        ok, rres = _verify_true_residual(ksp, b, x)
+        if not ok:
+            raise SilentCorruptionError(
+                "resilient_solve", "verify", result.iterations,
+                detail=f"recovered solve's true relative residual "
+                       f"{rres:.3e} misses the tolerance target")
+        events.append(RecoveryEvent(
+            kind="verify", attempt=attempt,
+            detail=f"true relative residual {rres:.3e} meets target",
+            detector="verify"))
+        result.sdc_detections = len(sdc_faults)
     return result
 
 
@@ -199,25 +281,35 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
                 if (attempt >= policy.max_attempts
                         or not policy.should_retry(exc)):
                     raise
+                detector = getattr(exc, "detector", "")
+                sdc = exc.failure_class == "detected_sdc"
                 events.append(RecoveryEvent(
                     kind="fault", attempt=attempt, detail=str(exc),
-                    error_class=exc.failure_class))
+                    error_class=exc.failure_class, detector=detector))
                 mat = ksp.get_operators()[0]
                 persisted = hasattr(mat, "to_scipy")
                 if persisted:
+                    # on DETECTED_SDC, X already holds the per-column
+                    # verified iterate block the solve boundary restored
                     save_solve_state_many(path, mat, X, B, iteration=0)
                     events.append(RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
-                delay = policy.delay(attempt - 1)
-                events.append(RecoveryEvent(
-                    kind="backoff", attempt=attempt, delay=delay,
-                    error_class=exc.failure_class))
-                policy.sleep(delay)
-                if persisted:
-                    mat2, X2, _B2, _it = load_solve_state_many(path,
-                                                               mat.comm)
-                    ksp.set_operators(mat2)
-                    X[...] = X2.astype(X.dtype, copy=False)
+                if sdc:
+                    events.append(RecoveryEvent(
+                        kind="rollback", attempt=attempt,
+                        detail="re-entering from verified iterate block",
+                        detector=detector))
+                else:
+                    delay = policy.delay(attempt - 1)
+                    events.append(RecoveryEvent(
+                        kind="backoff", attempt=attempt, delay=delay,
+                        error_class=exc.failure_class))
+                    policy.sleep(delay)
+                    if persisted:
+                        mat2, X2, _B2, _it = load_solve_state_many(
+                            path, mat.comm)
+                        ksp.set_operators(mat2)
+                        X[...] = X2.astype(X.dtype, copy=False)
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
                 events.append(RecoveryEvent(
@@ -228,4 +320,19 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
         ksp.set_initial_guess_nonzero(guess_flag0)
     result.attempts = attempt
     result.recovery_events = events
+    sdc_faults = [e for e in events if e.kind == "fault" and e.detector]
+    if sdc_faults:
+        ok, rres = _verify_true_residual_many(ksp, B, result.X)
+        if not ok:
+            raise SilentCorruptionError(
+                "resilient_solve_many", "verify",
+                max(result.iterations, default=0),
+                detail=f"recovered batch's worst true relative residual "
+                       f"{rres:.3e} misses the tolerance target")
+        events.append(RecoveryEvent(
+            kind="verify", attempt=attempt,
+            detail=f"worst per-column true relative residual {rres:.3e} "
+                   "meets target",
+            detector="verify"))
+        result.sdc_detections = len(sdc_faults)
     return result

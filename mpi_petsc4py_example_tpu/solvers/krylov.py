@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib as _contextlib
 import threading as _threading
+import types as _types
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.spmv import ell_spmv_local
 from ..resilience import faults as _faults
+from ..resilience import abft as _abft
 from ..utils.dtypes import is_complex
 from ..parallel.mesh import DeviceComm, faulted_psum
 from ..utils.convergence import ConvergedReason as CR
@@ -234,9 +236,11 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
 
     ``unroll`` packs that many CG steps into each ``while_loop`` body with
     per-step continuation masking: active steps run arithmetic identical to
-    unroll=1 and a frozen step re-derives its own state, so results and
-    iteration counts match exactly — but the loop-iteration count drops by
-    the unroll factor. On runtimes with per-loop-iteration dispatch overhead
+    unroll=1 and a frozen step re-derives its own state, so iteration
+    counts and reasons match exactly and iterates agree to compiler
+    scheduling noise (XLA fuses/contracts the differently-shaped bodies
+    differently — ulp-level only) — while the loop-iteration count drops
+    by the unroll factor. On runtimes with per-loop-iteration dispatch overhead
     (measured ~100-300 µs through the remote-TPU tunnel — more than the
     whole compute of a mid-sized step) this overhead, not FLOPs or HBM, is
     the iteration-rate ceiling.
@@ -385,6 +389,316 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
         x = x.reshape(flat)
     return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
             hist)
+
+
+# ---------------------------------------------------------------------------
+# silent-data-corruption guard: ABFT-checksummed CG kernels + invariant
+# monitors (README "Silent-error detection", resilience/abft.py)
+# ---------------------------------------------------------------------------
+
+# in-program detector codes carried in the guarded kernels' `det` output
+SDC_NONE, SDC_ABFT, SDC_ABFT_PC, SDC_DRIFT, SDC_NAN, SDC_MONO = range(6)
+SDC_DETECTOR_NAMES = {SDC_ABFT: "abft", SDC_ABFT_PC: "abft_pc",
+                      SDC_DRIFT: "drift", SDC_NAN: "nan",
+                      SDC_MONO: "monotonic"}
+
+# monotonicity sentinel: a residual norm this far above the best seen so
+# far is beyond any healthy CG transient (bounded by sqrt(cond(A)))
+_SDC_MONO_FACTOR = 1e4
+# drift gate: recurrence-vs-true relative mismatch beyond this fraction
+# (plus a rounding floor of _SDC_DRIFT_FLOOR_EPS * eps * ||b||) flags SDC
+_SDC_DRIFT_REL = 0.25
+_SDC_DRIFT_FLOOR_EPS = 1024.0
+
+# KSP types with a guarded (ABFT + invariant-monitor) kernel variant
+GUARDED_TYPES = ("cg",)
+
+
+def _det4(badA, badM, badnan, badmono):
+    """First-detector-wins detection code (elementwise for batched)."""
+    return jnp.where(
+        badA, SDC_ABFT,
+        jnp.where(badM, SDC_ABFT_PC,
+                  jnp.where(badnan, SDC_NAN,
+                            jnp.where(badmono, SDC_MONO,
+                                      SDC_NONE)))).astype(jnp.int32)
+
+
+def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
+                tasum, cmul, no_bad, pdot, pnorm):
+    """The guard closure bundle shared by the single-RHS and batched
+    guarded kernels — ONE definition of the ABFT check algebra.
+
+    The two callers differ only in reduction shape: single-RHS reduces
+    vectors to scalars (``dot=jnp.vdot``, ``tsum=jnp.sum``), the batched
+    path reduces ``(lsize, nrhs)`` blocks to per-column ``(nrhs,)``
+    vectors. ``cmul`` broadcasts the checksum vector against an operand
+    of that shape, ``no_bad`` builds the shape-matched "nothing fired"
+    verdict, and ``pdot``/``pnorm`` are the plain solver reductions the
+    checksum-less fallbacks use. All checksum partials fold into ONE
+    stacked (possibly faulted) psum per phase; ``vpair`` — the
+    replacement VERIFIER — uses plain ``lax.psum`` on purpose (a
+    corrupted verifier would lie about recovery).
+    """
+    eps = _abft.checksum_tolerance_dtype(dtype)
+
+    def _stack_psum(parts):
+        return _psum(jnp.stack([jnp.asarray(q, dtype) for q in parts]),
+                     axis)
+
+    thr = lambda scale: abft_tol * eps * scale
+
+    if cs_l is not None:
+        def init_g(b_, r_, x0_):
+            # verifies the INITIAL apply r = b - A x0:
+            # Σr - (Σb - ⟨c, x0⟩) ≈ 0, folded into the ‖b‖ reduction
+            # (complex: plain transpose checksum, no conjugation —
+            # Σ(Ax) = (Aᵀ1)ᵀx)
+            cx = cmul(cs_l, x0_)
+            s = _stack_psum([dot(b_, b_), tsum(r_), tsum(b_), tsum(cx),
+                             tasum(r_), tasum(b_), tasum(cx)])
+            bad = (jnp.abs(s[1] - s[2] + s[3])
+                   > thr(jnp.real(s[4]) + jnp.real(s[5])
+                         + jnp.real(s[6])))
+            return jnp.sqrt(jnp.maximum(jnp.real(s[0]), 0.0)), bad
+
+        def p1_g(p_, Ap_):
+            cp = cmul(cs_l, p_)
+            s = _stack_psum([dot(p_, Ap_), tsum(Ap_), tsum(cp),
+                             tasum(Ap_), tasum(cp)])
+            bad = (jnp.abs(s[1] - s[2])
+                   > thr(jnp.real(s[3]) + jnp.real(s[4])))
+            return s[0], bad
+    else:
+        def init_g(b_, r_, x0_):
+            return pnorm(b_), no_bad(b_)
+
+        def p1_g(p_, Ap_):
+            return pdot(p_, Ap_), no_bad(p_)
+
+    if csM_l is not None:
+        def p2_g(r_, z_):
+            cr = cmul(csM_l, r_)
+            s = _stack_psum([dot(r_, z_), dot(r_, r_), tsum(z_),
+                             tsum(cr), tasum(z_), tasum(cr)])
+            bad = (jnp.abs(s[2] - s[3])
+                   > thr(jnp.real(s[4]) + jnp.real(s[5])))
+            return s[0], jnp.real(s[1]), bad
+    else:
+        def p2_g(r_, z_):
+            s = _stack_psum([dot(r_, z_), dot(r_, r_)])
+            return s[0], jnp.real(s[1]), no_bad(r_)
+
+    def vpair(rt, zt):
+        s = lax.psum(jnp.stack([jnp.asarray(dot(rt, rt), dtype),
+                                jnp.asarray(dot(rt, zt), dtype)]), axis)
+        return jnp.real(s[0]), s[1]
+
+    return _types.SimpleNamespace(init=init_g, p1=p1_g, p2=p2_g,
+                                  vpair=vpair, rr_n=rr_n, eps=eps)
+
+
+def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
+                      monitor=None, dtol=None):
+    """Preconditioned CG with the in-program silent-corruption guard.
+
+    Per-iteration arithmetic matches :func:`cg_kernel` at unroll=1; the
+    guard adds, at ZERO extra collectives per iteration:
+
+    * ABFT checks on the operator apply (``⟨1, Ap⟩ ≈ ⟨c, p⟩`` folded into
+      the phase-1 ``⟨p, Ap⟩`` psum — ``g.p1``) and, when the PC checksum
+      exists, on the preconditioner apply (folded into the phase-2 psum
+      that also carries ``⟨r, z⟩`` and ``‖r‖²`` — ``g.p2``; the guarded
+      program actually has FEWER reduction sites than the plain kernel,
+      which psums rz and ‖r‖ separately);
+    * NaN and monotonicity sentinels on the monitored norm;
+    * every ``g.rr_n`` iterations (``-ksp_residual_replacement``), a
+      TRUE-residual replacement: ``r ← b - A x`` with a direction restart
+      (``p ← z``), a recurrence-vs-true drift gate, and promotion of the
+      current iterate to the VERIFIED iterate ``xv`` the host rolls back
+      to on detection. The replacement's reductions use plain
+      ``lax.psum`` (``g.vpair``) — a corrupted verifier would lie.
+
+    Returns ``(x, k, rnorm, reason, hist, det, rrc, xv)``: ``det`` is the
+    first detector code that fired (0 = clean), ``rrc`` the replacement
+    count, ``xv`` the last verified iterate (``x0`` until a replacement
+    passes).
+    """
+    r = b - A(x0)
+    bnorm, badA0 = g.init(b, r, x0)
+    z = M(r)
+    rz, rn2, badM0 = g.p2(r, z)
+    rnorm = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+    p = z
+    tol = jnp.maximum(rtol * bnorm, atol)
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, b.dtype)
+    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
+    det0 = _det4(badA0, badM0, ~jnp.isfinite(rnorm), False)
+
+    def active(st):
+        k, x, r, z, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
+        return ((rn > tol) & (rn < dmax) & (k < maxit) & ~brk
+                & (det == SDC_NONE))
+
+    def body(st):
+        k, x, r, z, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
+        Ap = A(p)
+        pAp, badA = g.p1(p, Ap)                # reduction phase 1 (fused)
+        brk_new = pAp == 0
+        alpha = jnp.where(pAp == 0, 0.0,
+                          rz / jnp.where(pAp == 0, 1.0, pAp))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        z = M(r)
+        rz_new, rn2, badM = g.p2(r, z)         # reduction phase 2 (fused)
+        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
+        p = z + beta * p
+        rz = rz_new
+        rn = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+        k = k + 1
+        badnan = ~jnp.isfinite(rn)
+        badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR * rnb)
+        rnb = jnp.where(jnp.isfinite(rn), jnp.minimum(rnb, rn), rnb)
+        det = _det4(badA, badM, badnan, badmono)
+
+        # periodic true-residual replacement + drift gate + verification
+        do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
+                 & (k % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
+
+        def replace(args):
+            x, r, z, p, rz, rn, rrc, xv = args
+            rt = b - A(x)
+            zt = M(rt)
+            rtn2, rzt = g.vpair(rt, zt)        # plain-psum verifier
+            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
+                     + drift_floor)
+            ok = ~drift
+            # replacement restarts the direction from the true residual
+            # (p ← z), bounding recurrence drift; the passing iterate is
+            # promoted to the rollback target xv
+            r = jnp.where(ok, rt, r)
+            z = jnp.where(ok, zt, z)
+            p = jnp.where(ok, zt, p)
+            rz = jnp.where(ok, rzt, rz)
+            rn = jnp.where(ok, rtn, rn)
+            xv = jnp.where(ok, x, xv)
+            rrc = rrc + ok.astype(jnp.int32)
+            det_rr = jnp.where(drift, SDC_DRIFT,
+                               SDC_NONE).astype(jnp.int32)
+            return (x, r, z, p, rz, rn, rrc, xv, det_rr)
+
+        def keep(args):
+            x, r, z, p, rz, rn, rrc, xv = args
+            return (x, r, z, p, rz, rn, rrc, xv, jnp.int32(SDC_NONE))
+
+        x, r, z, p, rz, rn, rrc, xv, det_rr = lax.cond(
+            do_rr, replace, keep, (x, r, z, p, rz, rn, rrc, xv))
+        det = jnp.where(det == SDC_NONE, det_rr, det)
+        if monitor is not None:
+            hist = monitor(hist, k, rn)
+        return (k, x, r, z, p, rz, rn, brk | brk_new, hist, det, rrc, xv,
+                rnb)
+
+    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, rnorm <= -1.0, hist,
+           det0, jnp.int32(0), x0, rnorm)
+    st = lax.while_loop(active, body, st0)
+    k, x, r, z, p, rz, rnorm, brk, hist, det, rrc, xv = st[:12]
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist, det, rrc, xv)
+
+
+def cg_stencil_kernel_guarded(Adot, inv_diag, pdot3, pnorm3, b, x0, rtol,
+                              atol, maxit, g, monitor=None, dtol=None,
+                              grid3d=None):
+    """Guarded twin of :func:`cg_stencil_kernel` (uniform-diagonal stencil
+    fast path, PC none/jacobi — the scalar Jacobi identities mean there is
+    no in-program PC apply, so only the operator ABFT channel exists).
+
+    The fused ``Adot`` already psums ``⟨p, Ap⟩`` internally, so the ABFT
+    partials fold into the PHASE-2 reduction (``‖r‖²``) instead — the
+    per-iteration collective count still does not grow. Checksum ``cs``
+    rides grid-shaped through ``g``.
+    """
+    flat = b.shape
+    if grid3d is not None:
+        b = b.reshape(grid3d)
+        x0 = x0.reshape(grid3d)
+    r = b - Adot(x0)[0]
+    bnorm, rnorm, badA0 = g.init(b, r, x0)
+    rz = rnorm * rnorm * inv_diag
+    p = r * inv_diag
+    tol = jnp.maximum(rtol * bnorm, atol)
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, b.dtype)
+    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
+    det0 = _det4(badA0, False, ~jnp.isfinite(rnorm), False)
+
+    def active(st):
+        k, x, r, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
+        return ((rn > tol) & (rn < dmax) & (k < maxit) & ~brk
+                & (det == SDC_NONE))
+
+    def body(st):
+        k, x, r, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
+        Ap, pAp = Adot(p)
+        brk_new = pAp == 0
+        alpha = jnp.where(brk_new, 0.0, rz / jnp.where(brk_new, 1.0, pAp))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rr, badA = g.p2_stencil(r, p, Ap)      # fused phase-2 + A-ABFT
+        rz_new = rr * inv_diag
+        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
+        p = r * inv_diag + beta * p
+        rz = rz_new
+        rn = jnp.sqrt(rr)
+        k = k + 1
+        badnan = ~jnp.isfinite(rn)
+        badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR * rnb)
+        rnb = jnp.where(jnp.isfinite(rn), jnp.minimum(rnb, rn), rnb)
+        det = _det4(badA, False, badnan, badmono)
+
+        do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
+                 & (k % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
+
+        def replace(args):
+            x, r, p, rz, rn, rrc, xv = args
+            rt = b - Adot(x)[0]
+            rtn2 = g.vnorm2(rt)                # plain-psum verifier
+            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
+                     + drift_floor)
+            ok = ~drift
+            r = jnp.where(ok, rt, r)
+            p = jnp.where(ok, rt * inv_diag, p)
+            rz = jnp.where(ok, rtn2 * inv_diag, rz)
+            rn = jnp.where(ok, rtn, rn)
+            xv = jnp.where(ok, x, xv)
+            rrc = rrc + ok.astype(jnp.int32)
+            return (x, r, p, rz, rn, rrc, xv,
+                    jnp.where(drift, SDC_DRIFT, SDC_NONE).astype(jnp.int32))
+
+        def keep(args):
+            x, r, p, rz, rn, rrc, xv = args
+            return (x, r, p, rz, rn, rrc, xv, jnp.int32(SDC_NONE))
+
+        x, r, p, rz, rn, rrc, xv, det_rr = lax.cond(
+            do_rr, replace, keep, (x, r, p, rz, rn, rrc, xv))
+        det = jnp.where(det == SDC_NONE, det_rr, det)
+        if monitor is not None:
+            hist = monitor(hist, k, rn)
+        return (k, x, r, p, rz, rn, brk | brk_new, hist, det, rrc, xv, rnb)
+
+    st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0, hist, det0,
+           jnp.int32(0), x0, rnorm)
+    st = lax.while_loop(active, body, st0)
+    k, x, r, p, rz, rnorm, brk, hist, det, rrc, xv = st[:11]
+    if grid3d is not None:
+        x = x.reshape(flat)
+        xv = xv.reshape(flat)
+    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
+            hist, det, rrc, xv)
 
 
 def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1816,7 +2130,9 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       zero_guess: bool = False, nullspace_dim: int = 0,
                       aug: int = 2, ell: int = 2, unroll: int = 1,
                       natural: bool = False, hist_cap: int = 0,
-                      live: bool = False, true_res: bool = False):
+                      live: bool = False, true_res: bool = False,
+                      abft: bool = False, abft_pc: bool = False,
+                      rr: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -1861,10 +2177,44 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     core.mat.Mat and models.stencil): ``shape``, ``dtype``,
     ``device_arrays()``, ``local_spmv(comm)``, ``op_specs(axis)`` and
     ``program_key()``.
+
+    With the silent-corruption guard on (``abft``/``rr`` — CG only), the
+    program grows extra leading checksum-vector arguments and trailing
+    guard scalars, plus three extra outputs::
+
+        x, iters, rnorm, reason, hist, det, rrc, xv [, true_rnorm, bnorm]
+            = prog(op_arrays, pc_arrays, [cs,] [csM,] b, x0,
+                   rtol, atol, dtol, maxit, abft_tol, rr_n)
+
+    ``det`` is the first in-program detector that fired
+    (:data:`SDC_DETECTOR_NAMES`; 0 = clean), ``rrc`` the residual
+    replacements performed, ``xv`` the last VERIFIED iterate the caller
+    rolls back to on detection. See :func:`cg_kernel_guarded`.
     """
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    guard_k = bool(abft or rr)
+    abft_k = bool(abft)
+    abft_pc_k = bool(abft and abft_pc)
+    if guard_k:
+        if ksp_type not in GUARDED_TYPES:
+            raise ValueError(
+                f"the silent-corruption guard (-ksp_abft / "
+                f"-ksp_residual_replacement) supports KSP "
+                f"{sorted(GUARDED_TYPES)}; {ksp_type!r} has no guarded "
+                "kernel — disable the guard or use cg")
+        if nullspace_dim:
+            raise ValueError(
+                "the silent-corruption guard does not compose with a "
+                "null-space projection (the projected operator's column "
+                "checksum differs from the assembled one); disable "
+                "-ksp_abft/-ksp_residual_replacement for singular solves")
+        if natural:
+            raise ValueError(
+                "the silent-corruption guard monitors the unpreconditioned "
+                "residual norm; it does not compose with "
+                "-ksp_norm_type natural")
     # normalize knobs a solver type doesn't consume, so changing e.g.
     # bcgsl_ell never recompiles an unrelated CG program
     restart_k = restart if ksp_type in ("gmres", "fgmres", "gcr", "fcg",
@@ -1875,7 +2225,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # monitor attached every sub-step would re-fire the callback, so
     # monitored programs stay at 1
     unroll_k = (max(1, int(unroll))
-                if ksp_type in _UNROLLABLE and not monitored else 1)
+                if ksp_type in _UNROLLABLE and not monitored
+                and not guard_k else 1)
     natural_k = bool(natural) and ksp_type in NATURAL_TYPES
     cap_k = int(hist_cap) if monitored else 0
     live_k = bool(live) and monitored
@@ -1888,7 +2239,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k,
-           true_res_k, _faults.trace_key())
+           true_res_k, abft_k, abft_pc_k, bool(rr), _faults.trace_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -1919,6 +2270,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                   # carries a real-typed rr — real operators only
                   and not is_complex(dtype)
                   and pc.get_type() in ("none", "jacobi", "mg")
+                  # the guarded stencil kernel keeps the scalar-Jacobi
+                  # identities only; guard+mg routes through the general
+                  # kernel (pc.local_apply serves the V-cycle there)
+                  and not (guard_k and pc.get_type() == "mg")
                   and hasattr(operator, "local_matvec_dot")
                   and hasattr(operator, "grid3d")
                   and getattr(operator, "uniform_diagonal", None) is not None
@@ -1948,12 +2303,21 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                if monitored else None)
 
     def make_body(project):
-        def body(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
+        def body(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit,
+                 guard_args=None):
             if zero_guess:
                 x0 = jnp.zeros_like(b)
             b, x0 = project(b), project(x0)
-            A = lambda v: project(spmv_local(op_arrays, v))
-            M = lambda r: project(pc_apply(pc_arrays, r))
+            # the spmv.result / pc.apply SILENT fault points apply at
+            # trace time (resilience/abft.py): the solver-loop operator
+            # and PC applies are injectable, the true-residual epilogue
+            # (_true_res_tail) and the guard's replacement verifier stay
+            # on the raw closures/plain psums — a corrupted verifier
+            # would lie about recovery
+            A = lambda v: project(_abft.apply_silent_fault(
+                "spmv.result", spmv_local(op_arrays, v)))
+            M = lambda r: project(_abft.apply_silent_fault(
+                "pc.apply", pc_apply(pc_arrays, r)))
             # vdot conjugates its first argument — the complex-correct inner
             # product; norms take the real part (vdot(u,u) carries a ~0
             # imaginary component for complex dtypes) so every kernel's
@@ -1965,6 +2329,16 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             kw["dtol"] = dtol
             if natural_k:
                 kw["natural"] = True
+
+            def _stack_psum(parts):
+                # ONE fused (possibly faulted) psum for a whole phase's
+                # scalars — the pipecg/fbcgsr discipline the ABFT
+                # partials ride on (zero extra collectives)
+                return _psum(jnp.stack([jnp.asarray(q, dtype)
+                                        for q in parts]), axis)
+
+            eps = _abft.checksum_tolerance_dtype(dtype)
+
             if stencil_cg:
                 inv_diag = (jnp.asarray(1.0, b.dtype) if pc.get_type() == "none"
                             else jnp.asarray(1.0 / operator.uniform_diagonal,
@@ -1974,12 +2348,71 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # for why the grid shape is kept through the loop)
                 pdot3 = lambda u, v: _psum(jnp.sum(u * v), axis)
                 pnorm3 = lambda u: jnp.sqrt(_psum(jnp.sum(u * u), axis))
+
+                def Adot(v):
+                    y, d = matvec_dot(op_arrays, v)
+                    return _abft.apply_silent_fault("spmv.result", y), d
+
+                if guard_args is not None:
+                    cs_l, _csM_l, abft_tol, rr_n = guard_args
+                    cs3 = (cs_l.reshape(operator.grid3d)
+                           if cs_l is not None else None)
+                    thr = lambda scale: abft_tol * eps * scale
+
+                    if cs3 is not None:
+                        def init3(b3, r3, x3):
+                            cx = cs3 * x3
+                            s = _stack_psum([
+                                jnp.sum(b3 * b3), jnp.sum(r3 * r3),
+                                jnp.sum(r3), jnp.sum(b3), jnp.sum(cx),
+                                jnp.sum(jnp.abs(r3)), jnp.sum(jnp.abs(b3)),
+                                jnp.sum(jnp.abs(cx))])
+                            bad = (jnp.abs(s[2] - s[3] + s[4])
+                                   > thr(s[5] + s[6] + s[7]))
+                            return (jnp.sqrt(jnp.maximum(s[0], 0.0)),
+                                    jnp.sqrt(jnp.maximum(s[1], 0.0)), bad)
+
+                        def p2_stencil(r3, p3, Ap3):
+                            cp = cs3 * p3
+                            s = _stack_psum([
+                                jnp.sum(r3 * r3), jnp.sum(Ap3),
+                                jnp.sum(cp), jnp.sum(jnp.abs(Ap3)),
+                                jnp.sum(jnp.abs(cp))])
+                            bad = jnp.abs(s[1] - s[2]) > thr(s[3] + s[4])
+                            return jnp.maximum(s[0], 0.0), bad
+                    else:
+                        def init3(b3, r3, x3):
+                            return pnorm3(b3), pnorm3(r3), False
+
+                        def p2_stencil(r3, p3, Ap3):
+                            return jnp.maximum(pdot3(r3, r3), 0.0), False
+
+                    g3 = _types.SimpleNamespace(
+                        init=init3, p2_stencil=p2_stencil,
+                        vnorm2=lambda rt: lax.psum(jnp.sum(rt * rt), axis),
+                        rr_n=rr_n, eps=eps)
+                    return cg_stencil_kernel_guarded(
+                        Adot, inv_diag, pdot3, pnorm3, b, x0, rtol, atol,
+                        maxit, g3, grid3d=operator.grid3d, **kw)
+
                 if pc_apply3 is not None:
-                    kw["M3"] = lambda r: pc_apply3(pc_arrays, r)
+                    kw["M3"] = lambda r: _abft.apply_silent_fault(
+                        "pc.apply", pc_apply3(pc_arrays, r))
                 return cg_stencil_kernel(
-                    lambda v: matvec_dot(op_arrays, v), inv_diag,
+                    Adot, inv_diag,
                     pdot3, pnorm3, b, x0, rtol, atol, maxit,
                     grid3d=operator.grid3d, **kw)
+
+            if guard_args is not None:
+                cs_l, csM_l, abft_tol, rr_n = guard_args
+                g = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+                                dot=jnp.vdot, tsum=jnp.sum,
+                                tasum=lambda u: jnp.sum(jnp.abs(u)),
+                                cmul=lambda c, v: c * v,
+                                no_bad=lambda v: False,
+                                pdot=pdot, pnorm=pnorm)
+                return cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol,
+                                         atol, maxit, g, **kw)
             if unroll_k > 1:
                 kw["unroll"] = unroll_k
             if ksp_type in ("gmres", "fgmres", "gcr", "fcg", "lgmres"):
@@ -2050,6 +2483,30 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
         in_specs = (op_specs, pc.in_specs(axis), P(None, axis),
                     P(axis), P(axis), P(), P(), P(), P())
+    elif guard_k:
+        # guard signature: leading checksum vectors (present per flag),
+        # trailing runtime guard scalars (tolerance factor + replacement
+        # interval — runtime, so tuning them never recompiles)
+        def local_fn(op_arrays, pc_arrays, *args):
+            i = 0
+            cs = csM = None
+            if abft_k:
+                cs = args[i]
+                i += 1
+            if abft_pc_k:
+                csM = args[i]
+                i += 1
+            b, x0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+            out = make_body(lambda v: v)(
+                op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit,
+                guard_args=(cs, csM, abft_tol, rr_n))
+            if true_res_k:
+                out = out + _true_res_tail(op_arrays, b, out[0])
+            return out
+
+        in_specs = (op_specs, pc.in_specs(axis)) \
+            + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
+            + (P(axis), P(axis), P(), P(), P(), P(), P(), P())
     else:
         def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
             out = make_body(lambda v: v)(op_arrays, pc_arrays, b, x0,
@@ -2062,8 +2519,11 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     P(axis), P(axis), P(), P(), P(), P())
     # the history buffer rides as a 5th (replicated) output — every device
     # writes identical psum'd norms into it; with true_res the epilogue's
-    # two scalars follow as replicated 6th/7th outputs
+    # two scalars follow as replicated trailing outputs; the guard appends
+    # (det, rrc, xv) before them
     out_specs = (P(axis), P(), P(), P(), P())
+    if guard_k:
+        out_specs = out_specs + (P(), P(), P(axis))
     if true_res_k:
         out_specs = out_specs + (P(), P())
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
@@ -2230,6 +2690,132 @@ def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
             hist)
 
 
+def cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
+                           g, monitor=None, dtol=None):
+    """Batched guarded CG: :func:`cg_kernel_many`'s masked lockstep
+    recurrences with PER-COLUMN silent-corruption detection.
+
+    Mask-aware guard semantics: the ABFT checksums, the NaN/monotonicity
+    sentinels, and the drift gate all evaluate per column — a detected
+    column freezes (its ``det`` code set, state preserved) while clean
+    columns keep iterating; the periodic replacement recomputes the whole
+    residual BLOCK in one batched apply and replaces/verifies only the
+    still-active columns. All guard partials fold into the two existing
+    stacked per-phase psums, so the per-iteration collective count stays
+    independent of both nrhs and the guard.
+
+    Returns ``(X, iters, rnorm, reason, hist, det, rrc, Xv)`` with
+    ``det``/``rrc`` per-column ``(nrhs,)`` vectors and ``Xv`` the
+    per-column last-verified iterate block.
+    """
+    R = B - A(X0)
+    bnorm, badA0 = g.init(B, R, X0)
+    Z = M(R)
+    rz, rn2, badM0 = g.p2(R, Z)
+    rnorm = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+    P = Z
+    tol = jnp.maximum(rtol * bnorm, atol)
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, B.dtype)
+    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
+    det0 = _det4(badA0, badM0, ~jnp.isfinite(rnorm),
+                 jnp.zeros(rnorm.shape, bool))
+    brk0 = jnp.zeros(rnorm.shape, bool)
+
+    def active(st):
+        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["it"] < maxit)
+                & ~st["brk"] & (st["det"] == SDC_NONE))
+
+    def cond(st):
+        return jnp.any(active(st))
+
+    def body(st):
+        cont = active(st)
+        cm = cont[None, :]
+        it, X, R, Z, P, rz, rn = (st["it"], st["X"], st["R"], st["Z"],
+                                  st["P"], st["rz"], st["rn"])
+        AP = A(P)
+        pAp, badA = g.p1(P, AP)                # fused phase-1 (per column)
+        brk_new = cont & (pAp == 0)
+        alpha = jnp.where(pAp == 0, 0.0,
+                          rz / jnp.where(pAp == 0, 1.0, pAp))
+        X = jnp.where(cm, X + alpha[None, :] * P, X)
+        R = jnp.where(cm, R - alpha[None, :] * AP, R)
+        Z = jnp.where(cm, M(R), Z)
+        rz_new, rn2, badM = g.p2(R, Z)         # fused phase-2 (per column)
+        beta = jnp.where(rz == 0, 0.0,
+                         rz_new / jnp.where(rz == 0, 1.0, rz))
+        P = jnp.where(cm, Z + beta[None, :] * P, P)
+        rz = jnp.where(cont, rz_new, rz)
+        rn_new = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+        rn = jnp.where(cont, rn_new, rn)
+        it = it + cont.astype(jnp.int32)
+        ks = st["ks"] + 1
+        badnan = cont & ~jnp.isfinite(rn)
+        badmono = cont & jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
+                                             * st["rnb"])
+        rnb = jnp.where(cont & jnp.isfinite(rn),
+                        jnp.minimum(st["rnb"], rn), st["rnb"])
+        # STICKY per-column detection: a frozen column's code must
+        # survive later passes (cont masks its checks off once frozen)
+        det = jnp.where(st["det"] == SDC_NONE,
+                        _det4(cont & badA, cont & badM, badnan, badmono),
+                        st["det"])
+
+        # replacement on the lockstep STEP counter (per-column iteration
+        # counts diverge once columns freeze); applies to active, clean
+        # columns only — mask-aware per-column drift verdicts
+        clean = det == SDC_NONE
+        do_rr = jnp.any(cont & clean) & (g.rr_n > 0) \
+            & (ks % jnp.maximum(g.rr_n, 1) == 0)
+
+        def replace(args):
+            X, R, Z, P, rz, rn, rrc, Xv = args
+            RT = B - A(X)
+            ZT = M(RT)
+            rtn2, rzt = g.vpair(RT, ZT)        # plain-psum verifier
+            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
+                     + drift_floor)
+            ok = cont & clean & ~drift
+            okm = ok[None, :]
+            R = jnp.where(okm, RT, R)
+            Z = jnp.where(okm, ZT, Z)
+            P = jnp.where(okm, ZT, P)
+            rz = jnp.where(ok, rzt, rz)
+            rn = jnp.where(ok, rtn, rn)
+            Xv = jnp.where(okm, X, Xv)
+            rrc = rrc + ok.astype(jnp.int32)
+            det_rr = jnp.where(cont & clean & drift, SDC_DRIFT,
+                               SDC_NONE).astype(jnp.int32)
+            return (X, R, Z, P, rz, rn, rrc, Xv, det_rr)
+
+        def keep(args):
+            X, R, Z, P, rz, rn, rrc, Xv = args
+            return (X, R, Z, P, rz, rn, rrc, Xv,
+                    jnp.zeros(rn.shape, jnp.int32))
+
+        X, R, Z, P, rz, rn, rrc, Xv, det_rr = lax.cond(
+            do_rr, replace, keep,
+            (X, R, Z, P, rz, rn, st["rrc"], st["Xv"]))
+        det = jnp.where(det == SDC_NONE, det_rr, det)
+        hist = st["hist"]
+        if monitor is not None:
+            hist = monitor(hist, it, rn)
+        return dict(it=it, ks=ks, X=X, R=R, Z=Z, P=P, rz=rz, rn=rn,
+                    brk=st["brk"] | brk_new, hist=hist, det=det, rrc=rrc,
+                    Xv=Xv, rnb=rnb)
+
+    st0 = dict(it=jnp.zeros(rnorm.shape, jnp.int32), ks=jnp.int32(0),
+               X=X0, R=R, Z=Z, P=P, rz=rz, rn=rnorm, brk=brk0, hist=hist,
+               det=det0, rrc=jnp.zeros(rnorm.shape, jnp.int32), Xv=X0,
+               rnb=rnorm)
+    st = lax.while_loop(cond, body, st0)
+    return (st["X"], st["it"], st["rn"],
+            _reason(st["rn"], tol, atol, st["it"], maxit, st["brk"], dmax),
+            st["hist"], st["det"], st["rrc"], st["Xv"])
+
+
 _PROGRAM_CACHE_MANY: dict = {}
 
 
@@ -2242,7 +2828,9 @@ def batched_pc_supported(pc) -> bool:
 
 def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                            nrhs: int, monitored: bool = False,
-                           zero_guess: bool = False, hist_cap: int = 0):
+                           zero_guess: bool = False, hist_cap: int = 0,
+                           abft: bool = False, abft_pc: bool = False,
+                           rr: bool = False, true_res: bool = False):
     """Build (or fetch cached) the batched multi-RHS solve program.
 
     Signature of the returned callable::
@@ -2255,6 +2843,17 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     (``hist`` is ``(hist_cap, nrhs)`` when monitored, zero-size
     otherwise). Only CG is batched (the block-Krylov workhorse); other
     KSP types route through the sequential fallback in KSP.solve_many.
+
+    ``true_res=True`` appends the batched true-residual epilogue — two
+    extra per-column outputs ``(true_rnorm, bnorm)``, each ``(nrhs,)`` —
+    the zero-extra-dispatch data the per-column ``-ksp_true_residual_check``
+    gate reads. With the silent-corruption guard on (``abft``/``rr``) the
+    program grows the checksum arguments/guard scalars and the
+    ``(det, rrc, Xv)`` per-column outputs exactly like the single-RHS
+    program (:func:`build_ksp_program`), with mask-aware per-column
+    detection (:func:`cg_kernel_many_guarded`); the stencil fast path
+    routes through the general batched kernel under the guard or the
+    epilogue (both need the flat-block spmv).
 
     The jitted program is additionally AOT-export-cached
     (utils/aot.wrap) with ``nrhs`` in the key — a fresh process loads
@@ -2272,11 +2871,16 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     n = operator.shape[0]
     dtype = operator.dtype
     cap_k = int(hist_cap) if monitored else 0
+    guard_k = bool(abft or rr)
+    abft_k = bool(abft)
+    abft_pc_k = bool(abft and abft_pc)
+    true_res_k = bool(true_res)
     trace_nonce = _faults.trace_key()
     aot_on = aot.aot_enabled() and trace_nonce is None
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            int(nrhs), monitored, zero_guess, operator.program_key(),
-           cap_k, trace_nonce, aot_on)
+           cap_k, abft_k, abft_pc_k, bool(rr), true_res_k, trace_nonce,
+           aot_on)
     cached = _PROGRAM_CACHE_MANY.get(key)
     if cached is not None:
         return cached
@@ -2288,6 +2892,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
             "(krylov.batched_pc_supported); KSP.solve_many falls back to "
             "sequential per-column solves for it")
     stencil_cg = (not is_complex(dtype)
+                  and not guard_k and not true_res_k
                   and pc.get_type() in ("none", "jacobi")
                   and hasattr(operator, "local_matvec_dot_many")
                   and hasattr(operator, "grid3d")
@@ -2300,7 +2905,18 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     monitor = (_HistMonitorMany(dtype, cap_k or hist_capacity(10000, 0),
                                 nrhs) if monitored else None)
 
-    def local_fn(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit):
+    def _tail_many(op_arrays, B, X):
+        # batched true-residual epilogue (raw spmv + plain psum — the
+        # verifier channel, exactly like the single-RHS _true_res_tail;
+        # both per-column norm rows ride ONE stacked psum)
+        R = B - spmv_many(op_arrays, X)
+        s = lax.psum(jnp.stack([jnp.real(jnp.sum(jnp.conj(R) * R, axis=0)),
+                                jnp.real(jnp.sum(jnp.conj(B) * B,
+                                                 axis=0))]), axis)
+        return jnp.sqrt(s[0]), jnp.sqrt(s[1])
+
+    def body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit,
+             guard_args=None):
         if zero_guess:
             X0 = jnp.zeros_like(B)
         cdot = lambda U, V: jnp.sum(jnp.conj(U) * V, axis=0)
@@ -2321,17 +2937,67 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                                          B.dtype))
             pdotc3 = lambda U, V: _psum(jnp.sum(U * V, axis=(1, 2, 3)),
                                         axis)
+
+            def Adot3(U):
+                Y, d = matvec_dot(op_arrays, U)
+                return _abft.apply_silent_fault("spmv.result", Y), d
+
             return cg_stencil_kernel_many(
-                lambda U: matvec_dot(op_arrays, U), inv_diag, pdotc3,
+                Adot3, inv_diag, pdotc3,
                 B, X0, rtol, atol, maxit, grid3d=operator.grid3d, **kw)
-        A = lambda V: spmv_many(op_arrays, V)
-        M = lambda R: pc_apply(pc_arrays, R)
+        A = lambda V: _abft.apply_silent_fault(
+            "spmv.result", spmv_many(op_arrays, V))
+        M = lambda R: _abft.apply_silent_fault(
+            "pc.apply", pc_apply(pc_arrays, R))
+        if guard_args is not None:
+            cs_l, csM_l, abft_tol, rr_n = guard_args
+            g = _make_guard(
+                dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+                dot=cdot, tsum=lambda U: jnp.sum(U, axis=0),
+                tasum=lambda U: jnp.sum(jnp.abs(U), axis=0),
+                cmul=lambda c, V: c[:, None] * V,
+                no_bad=lambda V: jnp.zeros(V.shape[1], bool),
+                pdot=pdotc, pnorm=pnormc)
+            return cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0,
+                                          rtol, atol, maxit, g, **kw)
         return cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol,
                               atol, maxit, **kw)
 
-    in_specs = (op_specs, pc.in_specs(axis), P(axis, None), P(axis, None),
-                P(), P(), P(), P())
+    if guard_k:
+        def local_fn(op_arrays, pc_arrays, *args):
+            i = 0
+            cs = csM = None
+            if abft_k:
+                cs = args[i]
+                i += 1
+            if abft_pc_k:
+                csM = args[i]
+                i += 1
+            B, X0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+            out = body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol,
+                       maxit, guard_args=(cs, csM, abft_tol, rr_n))
+            if true_res_k:
+                out = out + _tail_many(op_arrays, B, out[0])
+            return out
+
+        in_specs = (op_specs, pc.in_specs(axis)) \
+            + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
+            + (P(axis, None), P(axis, None), P(), P(), P(), P(), P(), P())
+    else:
+        def local_fn(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit):
+            out = body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol,
+                       maxit)
+            if true_res_k:
+                out = out + _tail_many(op_arrays, B, out[0])
+            return out
+
+        in_specs = (op_specs, pc.in_specs(axis), P(axis, None),
+                    P(axis, None), P(), P(), P(), P())
     out_specs = (P(axis, None), P(), P(), P(), P())
+    if guard_k:
+        out_specs = out_specs + (P(), P(), P(axis, None))
+    if true_res_k:
+        out_specs = out_specs + (P(), P())
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
     if aot_on:
         # key_parts: the full program identity minus the mesh (the wrap

@@ -45,15 +45,21 @@ class RecoveryEvent:
     path instead of inferring it from logs.
     """
     kind: str            # 'fault' | 'checkpoint' | 'backoff' | 'resume'
-                         # | 'fallback' | 'precision'
+                         # | 'fallback' | 'precision' | 'rollback'
+                         # | 'verify'
     attempt: int         # 1-based attempt number the event belongs to
     detail: str = ""     # specifics: checkpoint path, 'cg->bcgs', dtypes, …
     error_class: str = ""  # DeviceExecutionError.failure_class or reason name
     delay: float = 0.0   # seconds slept ('backoff' events)
     iterations: int = 0  # iterations completed when the event fired
+    detector: str = ""   # what detected a silent corruption ('abft' |
+                         # 'abft_pc' | 'drift' | 'nan' | 'monotonic' |
+                         # 'verify') — empty for fail-stop faults
 
     def __repr__(self):
         extra = f", delay={self.delay:g}s" if self.kind == "backoff" else ""
+        if self.detector:
+            extra += f", detector={self.detector}"
         return (f"RecoveryEvent({self.kind}, attempt={self.attempt}, "
                 f"{self.detail or self.error_class}{extra})")
 
@@ -75,6 +81,14 @@ class SolveResult:
     history: list = field(default_factory=list)
     attempts: int = 1
     recovery_events: list = field(default_factory=list)
+    # silent-error detection counters (README "Silent-error detection"):
+    # in-program ABFT checksum checks performed, detections that fired
+    # (across the whole resilient solve when recovery ran), and
+    # true-residual replacements executed — also surfaced as a -log_view
+    # row (utils/profiling.record_sdc)
+    abft_checks: int = 0
+    sdc_detections: int = 0
+    residual_replacements: int = 0
 
     @property
     def converged(self) -> bool:
@@ -115,6 +129,10 @@ class BatchedSolveResult:
     histories: list = field(default_factory=list)
     attempts: int = 1
     recovery_events: list = field(default_factory=list)
+    # silent-error detection counters, summed over columns (SolveResult)
+    abft_checks: int = 0
+    sdc_detections: int = 0
+    residual_replacements: int = 0
 
     @property
     def nrhs(self) -> int:

@@ -58,6 +58,14 @@ FAILURE_CLASSES = (
         "an op is unsupported on this backend/dtype — direct "
         "factorizations must stay on host (see solvers/pc.py)",
         retriable=False),
+    FailureClass(
+        "detected_sdc", ("SILENT_DATA_CORRUPTION",),
+        "an ABFT checksum or invariant monitor detected silent data "
+        "corruption mid-solve — the iterate cannot be trusted; roll "
+        "back to the last checkpoint or re-enter from the verified "
+        "iterate the solve boundary restored (resilience.resilient_solve "
+        "does both and re-verifies the final true residual)",
+        retriable=True),
 )
 
 
@@ -85,6 +93,33 @@ class DeviceExecutionError(RuntimeError):
         hint = ("; ".join(fc.hint for fc in matches)
                 or "see the chained exception for details")
         super().__init__(f"{what} failed on device: {hint}")
+
+
+class SilentCorruptionError(DeviceExecutionError):
+    """Silent data corruption DETECTED during a solve (the DETECTED_SDC
+    failure class).
+
+    Raised by the solve boundary when an in-program detector fires: an
+    ABFT checksum mismatch on the operator or preconditioner apply, the
+    recurrence-vs-true-residual drift gate, or a NaN/monotonicity
+    sentinel (solvers/krylov.py guarded kernels). Before raising, the
+    solve writes the last VERIFIED iterate back into the caller's
+    solution vector, so ``resilience.resilient_solve`` can re-enter from
+    it (or roll back to an earlier checkpoint).
+
+    ``detector`` names what fired ('abft' | 'abft_pc' | 'drift' | 'nan'
+    | 'monotonic' | 'verify'); ``iteration`` is where it fired.
+    """
+
+    def __init__(self, what: str, detector: str, iteration: int = 0,
+                 detail: str = ""):
+        extra = f" ({detail})" if detail else ""
+        original = RuntimeError(
+            f"SILENT_DATA_CORRUPTION: {detector} detector fired at "
+            f"iteration {iteration}{extra}")
+        super().__init__(what, original)
+        self.detector = detector
+        self.iteration = int(iteration)
 
 
 def wrap_device_errors(what: str):

@@ -19,6 +19,78 @@ import os
 
 _ENV_PREFIX = "TPU_SOLVE_"
 
+# ---------------------------------------------------------------------------
+# Documented registry of every solver flag (-ksp_*/-eps_*/-pc_*/-svd_*/-st_*)
+# the framework reads from this options database. tpslint rule TPS007 parses
+# this dict from the module AST and flags any getter call whose flag literal
+# is missing here — a typo'd flag name (read side OR this side) otherwise
+# parses, runs, and silently changes nothing. Keep entries alphabetical per
+# prefix; the value is a one-line description (the -help analog).
+# ---------------------------------------------------------------------------
+KNOWN_FLAGS = {
+    # ---- KSP (solvers/ksp.py) ----
+    "ksp_abft": "enable in-program ABFT checksum verification of operator/"
+                "PC applies (silent-data-corruption detection; CG only)",
+    "ksp_abft_tol": "ABFT detection threshold multiplier (x eps x scale)",
+    "ksp_atol": "absolute convergence tolerance",
+    "ksp_batch_limit": "max RHS columns per batched solve_many launch",
+    "ksp_bcgsl_ell": "BiCGStab(l) polynomial degree",
+    "ksp_converged_reason": "print the converged reason after each solve",
+    "ksp_divtol": "divergence tolerance (DIVERGED_DTOL trigger)",
+    "ksp_gmres_restart": "restart length for gmres/fgmres/gcr/fcg/lgmres",
+    "ksp_lgmres_augment": "LGMRES augmentation subspace size",
+    "ksp_max_it": "maximum iterations",
+    "ksp_monitor": "print the residual norm each iteration",
+    "ksp_norm_type": "monitored norm (default/none/preconditioned/"
+                     "unpreconditioned/natural)",
+    "ksp_residual_replacement": "recompute/replace the true residual every "
+                                "N iterations with a drift gate (silent-"
+                                "corruption monitor; 0 = off)",
+    "ksp_rtol": "relative convergence tolerance",
+    "ksp_true_residual_check": "gate convergence on the TRUE residual",
+    "ksp_true_residual_margin": "in-program target tightening under the "
+                                "true-residual gate (0 < m <= 1)",
+    "ksp_type": "Krylov solver type",
+    "ksp_unroll": "masked CG steps per while_loop dispatch",
+    "ksp_view": "print the solver configuration after each solve",
+    # ---- PC (solvers/pc.py via KSP.set_from_options) ----
+    "pc_asm_overlap": "additive-Schwarz overlap rows",
+    "pc_bjacobi_blocks": "block-Jacobi blocks per device shard",
+    "pc_composite_pcs": "comma-separated child PCs of a composite PC",
+    "pc_composite_type": "composite PC combination (additive/"
+                         "multiplicative)",
+    "pc_factor_fill": "ILU/ICC fill factor",
+    "pc_factor_mat_solver_type": "direct-factorization backend selector",
+    "pc_gamg_coarse_eq_limit": "GAMG coarse-grid size limit",
+    "pc_gamg_threshold": "GAMG strength-of-connection threshold",
+    "pc_mg_levels": "multigrid level cap",
+    "pc_mg_smooth_type": "multigrid smoother (chebyshev/jacobi)",
+    "pc_setup_device": "where block inversions run (host/device/auto)",
+    "pc_sor_omega": "SOR/SSOR relaxation factor",
+    "pc_type": "preconditioner type",
+    # ---- EPS (solvers/eps.py) ----
+    "eps_gd_blocksize": "generalized-Davidson block size",
+    "eps_hermitian": "declare the problem Hermitian (HEP)",
+    "eps_max_it": "maximum restart cycles",
+    "eps_monitor": "print eigenvalue-residual monitors per restart",
+    "eps_ncv": "working subspace dimension",
+    "eps_nev": "number of eigenpairs to compute",
+    "eps_target": "shift-and-invert / closest-to target",
+    "eps_tol": "eigenpair residual tolerance",
+    "eps_type": "eigensolver type",
+    "eps_which": "which part of the spectrum to compute",
+    # ---- SVD (solvers/svd.py) ----
+    "svd_max_it": "maximum iterations",
+    "svd_ncv": "working subspace dimension",
+    "svd_nsv": "number of singular triplets",
+    "svd_tol": "singular-triplet residual tolerance",
+    "svd_which": "largest/smallest singular values",
+    # ---- ST (solvers/st.py) ----
+    "st_cayley_antishift": "Cayley transform anti-shift",
+    "st_shift": "spectral-transformation shift",
+    "st_type": "spectral transformation (shift/sinvert/cayley)",
+}
+
 
 class Options:
     """A PETSc-style string->string options database."""

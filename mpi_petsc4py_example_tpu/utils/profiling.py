@@ -51,6 +51,25 @@ def record_event(what: str, n: int, iterations: int, wall: float,
         atexit.register(log_view)
 
 
+# silent-error detection totals: [abft_checks, detections, replacements]
+# (README "Silent-error detection"; filled by guarded KSP solves)
+_SDC = [0, 0, 0]
+
+
+def record_sdc(checks: int = 0, detections: int = 0, replacements: int = 0):
+    """Accumulate silent-error-detection activity for the -log_view row:
+    ABFT checksum checks performed, detectors fired, and true-residual
+    replacements executed (solvers/ksp.py guarded solves)."""
+    _SDC[0] += int(checks)
+    _SDC[1] += int(detections)
+    _SDC[2] += int(replacements)
+
+
+def sdc_counts() -> dict:
+    return {"abft_checks": _SDC[0], "detections": _SDC[1],
+            "replacements": _SDC[2]}
+
+
 def record_sync(kind: str, count: int = 1):
     """Count a host<->device synchronization point (a blocking D2H fetch).
 
@@ -106,12 +125,14 @@ def clear_events():
     _EVENTS.clear()
     _SYNCS.clear()
     _KERNEL_TRAFFIC.clear()
+    _SDC[:] = [0, 0, 0]
 
 
 def log_view(file=None):
     """Print the accumulated solve log, -log_view style."""
     file = file or sys.stderr
-    if not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS:
+    if (not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS
+            and not any(_SDC)):
         print("log_view: no solve events recorded", file=file)
         return
     if _EVENTS:
@@ -130,6 +151,10 @@ def log_view(file=None):
     if _SYNCS:
         parts = ", ".join(f"{k}: {v}" for k, v in sorted(_SYNCS.items()))
         print(f"host-device sync points: {parts}", file=file)
+    if any(_SDC):
+        print(f"silent-error detection: {_SDC[0]} ABFT check(s), "
+              f"{_SDC[1]} detection(s), {_SDC[2]} residual "
+              f"replacement(s)", file=file)
     if _KERNEL_TRAFFIC:
         print("kernel traffic (model bytes / measured time = achieved "
               "GB/s):", file=file)

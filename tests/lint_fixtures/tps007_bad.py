@@ -1,0 +1,23 @@
+"""TPS007 bad fixture: typo'd / unregistered options-flag reads.
+
+Each marked getter call reads a flag absent from
+``utils/options.KNOWN_FLAGS`` — it would parse, run, and silently change
+nothing (the driver's configuration never reaches the solver), which is
+exactly the hazard the rule exists for.
+"""
+
+from mpi_petsc4py_example_tpu.utils.options import global_options
+
+
+def configure(prefix=""):
+    opt = global_options()
+    rtol = opt.get_real("ksp_rtoll", 1e-5)  # BAD: TPS007
+    nev = opt.get_int(prefix + "eps_nevv", 1)  # BAD: TPS007
+    if opt.has("pc_typ"):  # BAD: TPS007
+        pass
+    return rtol, nev
+
+
+def unregistered_new_flag():
+    # a NEW flag wired into set_from_options but never registered
+    return global_options().get_bool("ksp_frobnicate", False)  # BAD: TPS007

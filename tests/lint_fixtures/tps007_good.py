@@ -1,0 +1,35 @@
+"""TPS007 good fixture: registered flags, dynamic keys, and
+out-of-scope literals.
+
+Registered flags pass (plain literal AND the ``prefix + "flag"``
+concatenation idiom); dynamic keys are not statically checkable; flag
+names outside the solver prefixes (``log_view``) are out of the
+registry's scope.
+"""
+
+from mpi_petsc4py_example_tpu.utils.options import global_options
+
+
+def configure(prefix=""):
+    opt = global_options()
+    rtol = opt.get_real("ksp_rtol", 1e-5)
+    max_it = opt.get_int(prefix + "ksp_max_it", 10000)
+    nev = opt.get_int("eps_nev", 1)
+    if opt.has("pc_type"):
+        pass
+    return rtol, max_it, nev
+
+
+def dynamic_key(key):
+    # not a literal: the rule cannot verify it
+    return global_options().get(key)
+
+
+def out_of_scope():
+    # a non-solver flag — governed by nothing, stays silent
+    return global_options().get_bool("log_view", False)
+
+
+def unrelated_getter(store):
+    # .get on a plain mapping with a non-flag key is not an options read
+    return store.get("cache_entry")

@@ -254,6 +254,122 @@ class _PerColumnGatherEll:
         return spmv_many
 
 
+def _lower_cg_guard(comm, M, abft_pc=True, rr=False, monkeypatch=None):
+    """Lower the guarded (ABFT/replacement) CG program."""
+    from mpi_petsc4py_example_tpu.resilience import abft
+    from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_up()
+    pc = ksp.get_pc()
+    cs = abft.column_checksum(M)
+    csM = abft.pc_checksum(pc, M)
+    placed = comm.put_rows_many([cs] + ([csM] if abft_pc else []))
+    prog = build_ksp_program(comm, "cg", pc, M, abft=True,
+                             abft_pc=abft_pc, rr=rr)
+    x, b = M.get_vecs()
+    dt = np.dtype(np.float64)
+    return prog.lower(
+        M.device_arrays(), pc.device_arrays(), *placed, b.data, x.data,
+        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50),
+        dt.type(256.0), np.int32(50 if rr else 0)).as_text()
+
+
+def _lower_cg_jacobi(comm, M):
+    from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_up()
+    pc = ksp.get_pc()
+    prog = build_ksp_program(comm, "cg", pc, M)
+    x, b = M.get_vecs()
+    dt = np.dtype(np.float64)
+    return prog.lower(
+        M.device_arrays(), pc.device_arrays(), b.data, x.data,
+        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+
+
+class TestAbftGuardVolume:
+    """ISSUE 5 acceptance: the ABFT/monitor path adds ZERO extra psum
+    sites per CG iteration — every checksum partial folds into an
+    existing reduction phase as one stacked psum. The guarded program in
+    fact has FEWER reduce sites than the plain kernel (the plain phase-2
+    psums rz and ||r|| separately; the guard stacks them)."""
+
+    def test_abft_program_reduce_count_not_larger(self, comm8):
+        n = 512
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        plain = _lower_cg_jacobi(comm8, M)
+        guarded = _lower_cg_guard(comm8, M, abft_pc=True, rr=False)
+        assert guarded.count("all_reduce") <= plain.count("all_reduce"), (
+            guarded.count("all_reduce"), plain.count("all_reduce"))
+
+    def test_replacement_adds_no_per_iteration_reduces(self, comm8):
+        """The periodic replacement's verifier psums live inside the
+        every-N conditional branch — enabling it must not add reduce
+        SITES beyond that branch (compare rr on/off: identical counts,
+        the branch is traced either way)."""
+        n = 512
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        on = _lower_cg_guard(comm8, M, rr=True)
+        off = _lower_cg_guard(comm8, M, rr=False)
+        assert on.count("all_reduce") == off.count("all_reduce")
+
+    def test_abft_gathers_stay_vector_sized(self, comm8):
+        """The checksum vectors ride as sharded ARGUMENTS — no gather may
+        grow beyond one padded vector (a checksum replication would be
+        the regression)."""
+        n = 512
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        vols = all_gather_volumes(_lower_cg_guard(comm8, M, rr=True))
+        n_pad = comm8.padded_size(n)
+        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+
+    def test_batched_guard_gather_count_matches_k1(self, comm8,
+                                                   monkeypatch):
+        """Mask-aware per-column guarding keeps the batched comm
+        contract: gather op count independent of k, bytes scaling
+        with k."""
+        from mpi_petsc4py_example_tpu.resilience import abft
+        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+        krylov_mod._PROGRAM_CACHE_MANY.clear()
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_up()
+        pc = ksp.get_pc()
+        cs = abft.column_checksum(M)
+        csM = abft.pc_checksum(pc, M)
+        dt = np.dtype(np.float64)
+
+        def lower_many(nrhs):
+            placed = comm8.put_rows_many([cs, csM])
+            prog = build_ksp_program_many(comm8, "cg", pc, M, nrhs=nrhs,
+                                          abft=True, abft_pc=True, rr=True)
+            Bp = comm8.put_rows(np.zeros((n, nrhs)))
+            X0 = comm8.put_rows(np.zeros((n, nrhs)))
+            return prog.lower(
+                M.device_arrays(), pc.device_arrays(), *placed, Bp, X0,
+                dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50),
+                dt.type(256.0), np.int32(25)).as_text()
+
+        txt1, txtk = lower_many(1), lower_many(k)
+        vols1 = all_gather_volumes(txt1)
+        volsk = all_gather_volumes(txtk)
+        n_pad = comm8.padded_size(n)
+        assert len(volsk) == len(vols1), (volsk, vols1)
+        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
+        assert txtk.count("all_reduce") == txt1.count("all_reduce")
+
+
 class _RegressedEll:
     """A Mat shim whose local SpMV all-gathers the ELL value matrix —
     the injected volume regression the gates must catch."""

@@ -753,8 +753,13 @@ class TestDivtol:
 
 
 class TestUnroll:
-    """-ksp_unroll packs masked CG steps per loop dispatch — results and
-    iteration counts must be identical to unroll=1."""
+    """-ksp_unroll packs masked CG steps per loop dispatch — iteration
+    counts and reasons must be identical to unroll=1, and iterates equal
+    to a few ulps (the per-step masking keeps the ARITHMETIC identical,
+    but XLA schedules/contracts the differently-shaped loop bodies
+    differently — measured: unroll=2 drifts <= 2 ulps on CPU while 4 and
+    7 happen to compile bit-identically; demanding bit equality pinned
+    compiler instruction scheduling, not solver semantics)."""
 
     @pytest.mark.parametrize("unroll", [2, 4, 7])
     def test_identical_results(self, comm8, unroll):
@@ -778,7 +783,10 @@ class TestUnroll:
         xu, ru = run(unroll)
         assert ru.iterations == r1.iterations
         assert ru.reason == r1.reason
-        np.testing.assert_array_equal(xu, x1)     # bit-identical
+        # ulp-level equality: same arithmetic, compiler-scheduling noise
+        # only (fp64 eps = 2.2e-16; 1e-14 relative = a few dozen ulps of
+        # headroom without admitting any algorithmic drift)
+        np.testing.assert_allclose(xu, x1, rtol=1e-14, atol=0.0)
 
     def test_option_wiring(self, comm8):
         tps.global_options().parse_argv(["prog", "-ksp_unroll", "6"])

@@ -1,0 +1,445 @@
+"""Silent-data-corruption resilience (ISSUE 5): ABFT-checksummed applies,
+in-program invariant monitors, and detection -> rollback -> verified
+recovery.
+
+The threat model: a corrupted SpMV result, preconditioner apply, or psum
+produces no crash and no NaN — without a detector the recurrence reports
+CONVERGED over a wrong iterate (the control-case test PROVES the feature
+is load-bearing). With the guard on (-ksp_abft / -ksp_residual_replacement)
+every silent fault kind injectable at spmv.result / pc.apply / comm.psum
+is detected by an ABFT checksum or an invariant monitor, the solve raises
+the DETECTED_SDC failure class with the caller's vector rolled back to
+the last VERIFIED iterate, and resilience.resilient_solve recovers to an
+independently re-verified true-residual answer.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import (StencilPoisson3D, poisson2d_csr,
+                                             poisson3d_csr, tridiag_family)
+from mpi_petsc4py_example_tpu.resilience import RetryPolicy, abft
+from mpi_petsc4py_example_tpu.resilience import resilient_solve
+from mpi_petsc4py_example_tpu.resilience import resilient_solve_many
+from mpi_petsc4py_example_tpu.utils.errors import (DeviceExecutionError,
+                                                   SilentCorruptionError)
+
+RTOL = 1e-10
+
+
+def _setup(comm, n_side=12, pc="jacobi", guard=True, rr=8, rtol=RTOL,
+           dtype=np.float64):
+    A = poisson2d_csr(n_side)
+    M = tps.Mat.from_scipy(comm, A, dtype=dtype)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type(pc)
+    ksp.set_tolerances(rtol=rtol)
+    if guard:
+        ksp.abft = True
+        ksp.residual_replacement = rr
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+    return ksp, M, A, x, bv, b, x_true
+
+
+# ---------------------------------------------------------------- checksums
+class TestColumnChecksum:
+    def test_ell_checksum_matches_dense(self, comm8):
+        rng = np.random.default_rng(3)
+        A = sp.random(96, 96, density=0.05, random_state=rng,
+                      format="csr") + sp.eye(96, format="csr") * 4
+        M = tps.Mat.from_scipy(comm8, A.tocsr())
+        assert M.dia_vals is None or True  # layout-agnostic: host CSR path
+        c = abft.column_checksum(M)
+        np.testing.assert_allclose(c, np.asarray(A.sum(axis=0)).ravel(),
+                                   rtol=1e-13)
+
+    def test_dia_checksum_matches_dense(self, comm8):
+        A = tridiag_family(64)
+        M = tps.Mat.from_scipy(comm8, A)
+        assert M.dia_vals is not None
+        c = abft.column_checksum(M)
+        np.testing.assert_allclose(c, np.asarray(A.sum(axis=0)).ravel(),
+                                   rtol=1e-13)
+
+    def test_ell_device_only_checksum(self, comm8):
+        """No host CSR retained: the checksum reassembles from the
+        fetched ELL shards."""
+        A = poisson2d_csr(8)
+        M = tps.Mat.from_scipy(comm8, A)
+        M.host_csr = None
+        c = abft.column_checksum(M)
+        np.testing.assert_allclose(c, np.asarray(A.sum(axis=0)).ravel(),
+                                   rtol=1e-13)
+
+    def test_stencil_checksum_analytic(self, comm8):
+        op = StencilPoisson3D(comm8, 8)
+        A = poisson3d_csr(8)
+        np.testing.assert_allclose(abft.column_checksum(op),
+                                   np.asarray(A.sum(axis=0)).ravel(),
+                                   rtol=1e-13)
+
+    def test_checksum_cache_invalidates_on_mutation(self, comm8):
+        M = tps.Mat.from_scipy(comm8, poisson2d_csr(6))
+        c1 = abft.column_checksum(M)
+        M.scale(2.0)
+        c2 = abft.column_checksum(M)
+        np.testing.assert_allclose(c2, 2.0 * c1, rtol=1e-13)
+
+    def test_pc_checksum_kinds(self, comm8):
+        A = poisson2d_csr(6)
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        pc = ksp.get_pc()
+        pc.set_type("none")
+        np.testing.assert_allclose(abft.pc_checksum(pc, M), 1.0)
+        pc.set_type("jacobi")
+        np.testing.assert_allclose(abft.pc_checksum(pc, M),
+                                   1.0 / A.diagonal(), rtol=1e-13)
+        pc.set_type("bjacobi")
+        assert abft.pc_checksum(pc, M) is None   # no checksum: M-channel off
+
+
+# ------------------------------------------------------- the control case
+class TestUndetectedControlCase:
+    """Why the feature exists: WITHOUT the guard, a silent scale
+    corruption of every loop SpMV sails through to a CONVERGED answer
+    whose TRUE residual misses rtol by orders of magnitude."""
+
+    def test_scale_corruption_sails_through_unguarded(self, comm8):
+        ksp, M, A, x, bv, b, _ = _setup(comm8, guard=False)
+        with tps.inject_faults("spmv.result=scale:mag=1e-3:times=*"):
+            res = ksp.solve(bv, x)
+        assert res.converged, res           # the recurrence's word
+        rtrue = (np.linalg.norm(b - A @ x.to_numpy())
+                 / np.linalg.norm(b))
+        # ...but the answer is silently wrong by ~mag
+        assert rtrue > 1e3 * RTOL, rtrue
+
+    def test_same_corruption_detected_with_guard(self, comm8):
+        ksp, M, A, x, bv, b, _ = _setup(comm8, guard=True)
+        with tps.inject_faults("spmv.result=scale:mag=1e-3:times=*"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+        assert ei.value.failure_class == "detected_sdc"
+        assert ei.value.retriable
+        assert ei.value.detector in ("abft", "drift")
+
+
+# ------------------------------------------------------------- detection
+class TestDetection:
+    """Every silent fault kind injectable at spmv.result / pc.apply /
+    comm.psum fires a detector under the guard (acceptance criterion).
+    at=2 targets the LOOP apply site (at=1 is the init apply; both are
+    covered)."""
+
+    @pytest.mark.parametrize("spec,detectors", [
+        ("spmv.result=bitflip:at=2:times=1", ("abft",)),
+        ("spmv.result=scale:mag=1e-3:at=2:times=1", ("abft", "drift")),
+        ("pc.apply=bitflip:at=2:times=1", ("abft_pc",)),
+        ("pc.apply=scale:mag=1e-3:at=2:times=1", ("abft_pc",)),
+        ("comm.psum=corrupt:times=*", ("nan",)),
+    ])
+    def test_detectors_fire(self, comm8, spec, detectors):
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        with tps.inject_faults(spec) as plan:
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+            assert plan[0].fired >= 1
+        assert ei.value.detector in detectors, ei.value.detector
+
+    def test_init_apply_bitflip_detected(self, comm8):
+        """The iteration-0 apply (r = b - A x0) is checksummed too; a
+        corruption of a NONZERO initial residual computation is caught at
+        entry (zero guess makes A(x0)=0 immune to magnitude flips, so
+        start from a nonzero guess)."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        ksp.set_initial_guess_nonzero(True)
+        x.set_global(np.random.default_rng(5).random(M.shape[0]))
+        with tps.inject_faults("spmv.result=bitflip:at=1:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+        assert ei.value.detector in ("abft", "drift")
+
+    def test_dropped_psum_detected(self, comm8):
+        """A dropped reduction leaves per-shard partial scalars — the
+        checksum identity fails locally and ABFT flags it."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        with tps.inject_faults("comm.psum=drop:times=*"):
+            with pytest.raises(SilentCorruptionError):
+                ksp.solve(bv, x)
+
+    def test_detection_rolls_back_to_verified_iterate(self, comm8):
+        """On detection the caller's x holds the last VERIFIED iterate,
+        not the corrupted one (here: detection at iteration 1 -> the
+        initial guess)."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+        assert ei.value.iteration <= 1
+        np.testing.assert_array_equal(x.to_numpy(), 0.0)
+
+    def test_clean_program_after_spent_fault(self, comm8):
+        """trace_key() isolation: once the silent clause is spent, a
+        fresh build is clean and cached normally."""
+        ksp, M, A, x, bv, b, x_true = _setup(comm8)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError):
+                ksp.solve(bv, x)
+            x.zero()
+            res = ksp.solve(bv, x)      # clause spent: clean re-trace
+        assert res.converged
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-7)
+
+    def test_guard_unsupported_type_raises(self, comm8):
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        ksp.set_type("gmres")
+        with pytest.raises(ValueError, match="guard"):
+            ksp.solve(bv, x)
+
+    def test_guard_rejects_nullspace(self, comm8):
+        from mpi_petsc4py_example_tpu.core.nullspace import NullSpace
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        M.set_nullspace(NullSpace(constant=True))
+        with pytest.raises(ValueError, match="null-space"):
+            ksp.solve(bv, x)
+
+
+# ------------------------------------------------ clean-path invariants
+class TestCleanGuardedSolve:
+    def test_no_false_positives_and_counters(self, comm8):
+        ksp, M, A, x, bv, b, x_true = _setup(comm8, rr=10)
+        res = ksp.solve(bv, x)
+        assert res.converged, res
+        assert res.sdc_detections == 0
+        assert res.abft_checks > res.iterations       # init + per-iter
+        assert res.residual_replacements >= 1
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-7)
+
+    def test_abft_only_iteration_parity(self, comm8):
+        """Pure ABFT (no replacement) runs the IDENTICAL recurrence:
+        iteration counts match the unguarded solve exactly."""
+        ksp_g, M, A, xg, bv, b, _ = _setup(comm8, rr=0)
+        res_g = ksp_g.solve(bv, xg)
+        ksp_u, M2, _A2, xu, bv2, _b2, _ = _setup(comm8, guard=False)
+        res_u = ksp_u.solve(bv2, xu)
+        assert res_g.converged and res_u.converged
+        assert res_g.iterations == res_u.iterations
+
+    def test_replacement_bounds_drift_fp32(self, comm8):
+        """fp32, tight target: periodic true-residual replacement keeps
+        the recurrence honest — the final fp64 true residual meets the
+        target without the true-residual gate."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8, n_side=24, rr=25,
+                                        rtol=2e-6, dtype=np.float32)
+        ksp.abft = False                     # isolate the monitor
+        res = ksp.solve(bv, x)
+        assert res.converged
+        assert res.residual_replacements >= 1
+        rtrue = (np.linalg.norm(b - A @ x.to_numpy().astype(np.float64))
+                 / np.linalg.norm(b))
+        assert rtrue <= 2e-6 * 1.6, rtrue
+
+    def test_log_view_row(self, comm8, capsys):
+        from mpi_petsc4py_example_tpu.utils import profiling
+        profiling.clear_events()
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        ksp.solve(bv, x)
+        profiling.log_view(file=None)
+        err = capsys.readouterr().err
+        assert "silent-error detection:" in err
+        assert "ABFT check(s)" in err
+        profiling.clear_events()
+
+    def test_options_wiring(self, comm8):
+        tps.init(["prog", "-ksp_abft", "-ksp_abft_tol", "512",
+                  "-ksp_residual_replacement", "40"])
+        try:
+            ksp = tps.KSP().create(comm8)
+            ksp.set_from_options()
+            assert ksp.abft is True
+            assert ksp.abft_tol == 512.0
+            assert ksp.residual_replacement == 40
+        finally:
+            tps.global_options().clear()
+
+
+# ------------------------------------------------------------- recovery
+class TestRecovery:
+    def test_detect_rollback_resume_verify(self, comm8):
+        """The acceptance path: silent corruption -> DETECTED_SDC ->
+        rollback (no backoff) -> clean re-entry -> independently verified
+        true-residual answer."""
+        ksp, M, A, x, bv, b, x_true = _setup(comm8)
+        delays = []
+        policy = RetryPolicy(sleep=delays.append)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            res = resilient_solve(ksp, bv, x, policy)
+        assert res.converged and res.attempts == 2
+        assert delays == []                  # SDC retries immediately
+        kinds = [e.kind for e in res.recovery_events]
+        assert kinds == ["fault", "checkpoint", "rollback", "resume",
+                         "verify"]
+        assert res.recovery_events[0].error_class == "detected_sdc"
+        assert res.recovery_events[0].detector == "abft"
+        assert res.recovery_events[2].detector == "abft"
+        assert res.sdc_detections == 1
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-7)
+
+    @pytest.mark.parametrize("spec", [
+        "spmv.result=scale:mag=1e-3:at=2:times=1",
+        "pc.apply=bitflip:at=2:times=1",
+        "pc.apply=scale:mag=1e-2:at=2:times=1",
+        "comm.psum=corrupt:times=1:at=3",
+    ])
+    def test_recovers_every_silent_kind(self, comm8, spec):
+        ksp, M, A, x, bv, b, x_true = _setup(comm8)
+        with tps.inject_faults(spec):
+            res = resilient_solve(ksp, bv, x,
+                                  RetryPolicy(sleep=lambda d: None))
+        assert res.converged and res.attempts >= 2, res
+        assert any(e.detector for e in res.recovery_events)
+        assert res.recovery_events[-1].kind == "verify"
+        rtrue = (np.linalg.norm(b - A @ x.to_numpy())
+                 / np.linalg.norm(b))
+        assert rtrue <= RTOL * 1.05, rtrue
+
+    def test_matrix_free_stencil_recovery(self, comm8):
+        """No host CSR to checkpoint: recovery re-enters purely from the
+        in-memory verified iterate."""
+        op = StencilPoisson3D(comm8, 8)
+        x_true = np.random.default_rng(2).random(op.shape[0])
+        b = np.asarray(op.mult(tps.Vec.from_global(comm8, x_true))
+                       .to_numpy())
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL)
+        ksp.abft = True
+        ksp.residual_replacement = 10
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            res = resilient_solve(ksp, bv, x,
+                                  RetryPolicy(sleep=lambda d: None))
+        assert res.converged and res.attempts == 2
+        kinds = [e.kind for e in res.recovery_events]
+        assert "rollback" in kinds and "verify" in kinds
+        assert "checkpoint" not in kinds     # matrix-free: nothing to persist
+        np.testing.assert_allclose(x.to_numpy(), x_true, atol=1e-7)
+
+    def test_unavailable_path_unchanged(self, comm8, tmp_path):
+        """The fail-stop escalation is untouched: crash faults still
+        checkpoint + back off + rebuild, with no detector/verify events."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8, guard=False)
+        delays = []
+        with tps.inject_faults("ksp.program=unavailable:iter=4"):
+            res = resilient_solve(ksp, bv, x,
+                                  RetryPolicy(base_delay=0.25,
+                                              sleep=delays.append),
+                                  checkpoint_path=str(tmp_path / "s.npz"))
+        assert res.converged and res.attempts == 2
+        assert delays == [0.25]
+        assert [e.kind for e in res.recovery_events] == [
+            "fault", "checkpoint", "backoff", "resume"]
+        assert res.sdc_detections == 0
+
+    def test_persistent_corruption_exhausts_attempts(self, comm8):
+        """A corruption that re-arms on every rebuild (times=*) defeats
+        recovery — the DETECTED_SDC error surfaces after max_attempts."""
+        ksp, M, A, x, bv, b, _ = _setup(comm8)
+        with tps.inject_faults("spmv.result=bitflip:times=*"):
+            with pytest.raises(DeviceExecutionError) as ei:
+                resilient_solve(ksp, bv, x,
+                                RetryPolicy(max_attempts=2,
+                                            sleep=lambda d: None))
+        assert ei.value.failure_class == "detected_sdc"
+
+
+# ---------------------------------------------------------------- batched
+class TestBatchedGuard:
+    def _batched(self, comm, k=4, guard=True):
+        A = poisson2d_csr(12)
+        M = tps.Mat.from_scipy(comm, A)
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL)
+        if guard:
+            ksp.abft = True
+            ksp.residual_replacement = 8
+        Xt = np.random.default_rng(1).random((A.shape[0], k))
+        B = np.asarray(A @ Xt)
+        return ksp, M, A, B, Xt
+
+    def test_clean_batched_counters_and_parity(self, comm8):
+        ksp, M, A, B, Xt = self._batched(comm8)
+        res = ksp.solve_many(B.copy())
+        assert res.converged, res
+        assert res.sdc_detections == 0
+        assert res.residual_replacements >= 1
+        np.testing.assert_allclose(res.X, Xt, atol=1e-7)
+
+    def test_per_column_detection_and_rollback(self, comm8):
+        """The bitflip corrupts column 0 of every apply; detection is
+        per-column (mask-aware) and the restored block holds the
+        verified iterates."""
+        ksp, M, A, B, Xt = self._batched(comm8)
+        X = np.ones_like(B)                  # sentinel, must be replaced
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve_many(B.copy(), X)
+        assert ei.value.failure_class == "detected_sdc"
+        assert "columns [0]" in str(ei.value.original)
+        # the corrupted column rolls back to its only verified iterate
+        # (the initial guess); CLEAN columns keep their last verified
+        # replacement iterate — per-column progress is preserved
+        np.testing.assert_array_equal(X[:, 0], 0.0)
+        assert all(np.linalg.norm(X[:, j]) > 0 for j in range(1, 4))
+
+    def test_batched_recovery_end_to_end(self, comm8):
+        ksp, M, A, B, Xt = self._batched(comm8)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            res = resilient_solve_many(ksp, B,
+                                       policy=RetryPolicy(
+                                           sleep=lambda d: None))
+        assert res.converged and res.attempts == 2
+        kinds = [e.kind for e in res.recovery_events]
+        assert kinds == ["fault", "checkpoint", "rollback", "resume",
+                         "verify"]
+        assert res.sdc_detections == 1
+        np.testing.assert_allclose(res.X, Xt, atol=1e-7)
+
+
+# ----------------------------------------------------- guarded stencil path
+class TestStencilGuard:
+    def test_stencil_fast_path_detection(self, comm8):
+        op = StencilPoisson3D(comm8, 8)
+        b = np.asarray(op.mult(tps.Vec.from_global(
+            comm8, np.random.default_rng(4).random(op.shape[0])))
+            .to_numpy())
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL)
+        ksp.abft = True
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)               # clean: no false positives
+        assert res.converged and res.sdc_detections == 0
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                x.zero()
+                ksp.solve(bv, x)
+        assert ei.value.detector == "abft"

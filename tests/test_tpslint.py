@@ -28,7 +28,7 @@ from tools.tpslint.cli import main as tpslint_main
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006",
-            "TPS011", "TPS012")
+            "TPS007", "TPS011", "TPS012")
 #: current advisory (warn-tier) count over the repo's own packages — the
 #: CI --warn-budget. Raising it requires looking at the new advisory and
 #: deciding it is acceptable; that is the tier's whole contract.
@@ -278,6 +278,38 @@ def test_repo_warn_budget():
     assert len(warn_sites) <= REPO_WARN_BUDGET, warn_sites
     assert result.exit_code(strict=True,
                             warn_budget=REPO_WARN_BUDGET) == 0
+
+
+def test_options_registry_parses():
+    """TPS007 reads KNOWN_FLAGS from utils/options.py by AST — the
+    registry must parse non-empty or the rule is silently toothless."""
+    from tools.tpslint.rules.tps007_options_registry import registered_flags
+    flags = registered_flags()
+    assert "ksp_type" in flags and "eps_nev" in flags, flags
+    # the silent-corruption flag family is registered from day one
+    assert {"ksp_abft", "ksp_abft_tol",
+            "ksp_residual_replacement"} <= flags
+
+
+def test_options_registry_coverage():
+    """The reverse direction of TPS007: every registered flag has at
+    least one literal read site in the framework — a registered-but-
+    never-read flag is dead configuration surface."""
+    import ast as _ast
+
+    from tools.tpslint.engine import iter_python_files
+    from tools.tpslint.rules.tps007_options_registry import (
+        flag_read_sites, registered_flags)
+    flags = registered_flags()
+    assert flags
+    seen = set()
+    for fname in iter_python_files([str(REPO / "mpi_petsc4py_example_tpu")]):
+        tree = _ast.parse(Path(fname).read_text())
+        for flag, _node in flag_read_sites(tree):
+            seen.add(flag)
+    missing = set(flags) - seen
+    assert not missing, (
+        f"KNOWN_FLAGS entries with no read site: {sorted(missing)}")
 
 
 def test_fault_registry_parses():

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Chaos smoke: silent-data-corruption drills for CI (ISSUE 5 satellite).
+
+Runs a CG solve under silent-corruption fault specs and asserts the full
+detection -> rollback -> recovery -> verification chain:
+
+* a detector fired (ABFT checksum / drift gate / sentinel — the
+  recovery trail carries its name);
+* the recovered answer's fp64 TRUE relative residual meets rtol;
+* the iterate matches the manufactured solution.
+
+Exit status is NONZERO if corruption goes undetected or the recovered
+answer is wrong — the CI contract that silent corruption cannot
+silently regress.
+
+Two modes:
+
+* ``TPU_SOLVE_FAULTS`` set in the environment: ONE drill under exactly
+  that spec (the env-activation route, like the crash smoke steps);
+* unset: the builtin sweep over every silent fault kind at every
+  injectable point (spmv.result / pc.apply / comm.psum), via
+  ``inject_faults``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RTOL = 1e-10
+
+#: the builtin sweep: every silent kind at every injectable point
+#: (at=2 targets the loop apply; times=1 lets the retry re-trace clean)
+BUILTIN_SPECS = (
+    "spmv.result=bitflip:at=2:times=1",
+    "spmv.result=scale:mag=1e-3:at=2:times=1",
+    "pc.apply=bitflip:at=2:times=1",
+    "pc.apply=scale:mag=1e-2:at=2:times=1",
+    "comm.psum=corrupt:times=1:at=3",
+)
+
+
+def drill(label: str, ctx) -> list[str]:
+    """One corruption drill; returns a list of failure descriptions."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(12)
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=RTOL)
+    ksp.abft = True
+    ksp.residual_replacement = 10
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+
+    problems: list[str] = []
+    with ctx:
+        res = tps.resilient_solve(
+            ksp, bv, x, tps.RetryPolicy(sleep=lambda _d: None))
+    detectors = [e.detector for e in res.recovery_events
+                 if e.kind == "fault" and e.detector]
+    if not detectors:
+        problems.append("corruption went UNDETECTED (no detector event)")
+    if not res.converged:
+        problems.append(f"recovered solve did not converge: {res}")
+    if not any(e.kind == "verify" for e in res.recovery_events):
+        problems.append("no post-recovery true-residual verification ran")
+    rtrue = (np.linalg.norm(b - A @ x.to_numpy())
+             / np.linalg.norm(b))
+    if not rtrue <= RTOL * 1.05:
+        problems.append(f"true relative residual {rtrue:.3e} misses rtol")
+    if not np.allclose(x.to_numpy(), x_true, atol=1e-7):
+        problems.append("recovered iterate differs from the manufactured "
+                        "solution")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] {label}: {status} detectors={detectors} "
+          f"attempts={res.attempts} true_rres={rtrue:.3e}")
+    return [f"{label}: {p}" for p in problems]
+
+
+def main() -> int:
+    import contextlib
+
+    import mpi_petsc4py_example_tpu as tps
+
+    failures: list[str] = []
+    env_spec = os.environ.get("TPU_SOLVE_FAULTS", "").strip()
+    if env_spec:
+        # env-armed: the plan is already active from the environment
+        failures += drill(f"env:{env_spec}", contextlib.nullcontext())
+    else:
+        for spec in BUILTIN_SPECS:
+            failures += drill(spec, tps.inject_faults(spec))
+    if failures:
+        print("[chaos] FAILURES:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("[chaos] all silent-corruption drills recovered and verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

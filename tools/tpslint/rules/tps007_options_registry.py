@@ -1,0 +1,113 @@
+"""TPS007 — options-flag registry check (ROADMAP, deferred from the
+initial rule set; landed alongside the -ksp_abft* flag family).
+
+Every ``-ksp_*``/``-eps_*``/``-pc_*``/``-svd_*``/``-st_*`` flag read from
+the options database (``utils/options.py`` getters: ``get``,
+``get_string``, ``get_int``, ``get_real``, ``get_bool``, ``has``) must
+appear in the documented ``utils/options.KNOWN_FLAGS`` registry: a typo'd
+flag name parses, runs, and silently changes nothing — the configuration
+the driver thought it applied never reached the solver (the options-DB
+analog of TPS012's fault-point registry).
+
+The registry is read by PARSING the options module's AST (the
+``KNOWN_FLAGS`` dict literal's string keys) — tpslint stays stdlib-only.
+Flag arguments are recognized both as plain string literals
+(``opt.get_int("eps_nev", ...)``) and as the repo's prefix-concatenation
+idiom (``opt.get_real(p + "ksp_rtol", ...)`` — the RIGHT operand of the
+``+``). Dynamic keys and literals outside the solver-flag prefixes (e.g.
+``log_view``) are out of scope and stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import re
+from pathlib import Path
+
+from .base import Rule, register
+
+#: options-database getter method names whose first argument is a flag key
+_GETTERS = ("get", "get_string", "get_int", "get_real", "get_bool", "has")
+
+#: flag-name shape the registry governs (solver-object prefixes only)
+_FLAG_RE = re.compile(r"^(ksp|eps|pc|svd|st)_[a-z0-9_]+$")
+
+_OPTIONS_REL = Path("mpi_petsc4py_example_tpu") / "utils" / "options.py"
+
+
+@functools.lru_cache(maxsize=1)
+def registered_flags() -> frozenset:
+    """String keys of ``utils/options.KNOWN_FLAGS``, parsed from the
+    module's AST. Empty when the file (or the dict) cannot be found — the
+    rule then has nothing to check against and stays silent (the
+    coverage meta-test in tests/test_tpslint.py fails loudly instead)."""
+    path = Path(__file__).resolve().parents[3] / _OPTIONS_REL
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return frozenset()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "KNOWN_FLAGS" not in targets:
+            continue
+        if isinstance(node.value, ast.Dict):
+            return frozenset(
+                key.value for key in node.value.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value,
+                                                                str))
+    return frozenset()
+
+
+def _flag_literal(arg):
+    """The flag-name literal of a getter's first argument, or None.
+
+    Handles the two repo idioms: a plain string constant, and the
+    options-prefix concatenation ``p + "ksp_rtol"`` (flag = the right
+    operand). Anything else (a variable, an f-string) is dynamic and not
+    statically checkable."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+            and isinstance(arg.right, ast.Constant)
+            and isinstance(arg.right.value, str)):
+        return arg.right.value
+    return None
+
+
+def flag_read_sites(tree):
+    """Yield ``(flag_or_None, call_node)`` for every options-getter call
+    in ``tree`` whose first argument looks like a solver flag."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _GETTERS
+                and node.args):
+            continue
+        flag = _flag_literal(node.args[0])
+        if flag is not None and _FLAG_RE.match(flag):
+            yield flag, node
+
+
+@register
+class OptionsRegistryRule(Rule):
+    id = "TPS007"
+    name = "options-flag-registry"
+    description = ("every -ksp_*/-eps_*/-pc_*/-svd_*/-st_* flag read from "
+                   "the options DB must appear in utils/options."
+                   "KNOWN_FLAGS — a typo'd flag silently changes nothing")
+
+    def check(self, module):
+        known = registered_flags()
+        if not known:
+            return
+        for flag, node in flag_read_sites(module.tree):
+            if flag not in known:
+                yield self.finding(
+                    node,
+                    f"options flag {flag!r} is not registered in "
+                    "utils/options.KNOWN_FLAGS — a typo here (or a "
+                    "missing registry entry) makes the flag silently "
+                    "inert; register it or fix the name")

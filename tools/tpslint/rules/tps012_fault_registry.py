@@ -4,8 +4,8 @@ Every ``faults.check("...")`` / ``faults.triggered("...")`` call site must
 name a point registered in ``resilience/faults.FAULT_POINTS``: a typo'd
 point name parses, runs, and simply NEVER FIRES — the injected-fault test
 that was supposed to exercise a recovery path silently exercises nothing
-(the fault-injection analog of TPS007's options-flag registry check,
-ROADMAP).  The reverse direction — every registered point has at least one
+(the fault-injection analog of TPS007's options-flag registry check).
+The reverse direction — every registered point has at least one
 call site — is a repo-level property and is enforced by the meta-test
 ``tests/test_tpslint.py::test_fault_registry_coverage`` built on this
 module's :func:`fault_point_sites` helper.
@@ -27,9 +27,11 @@ from ..context import terminal_name
 from .base import Rule, register
 
 #: attribute names that count as fault-point hooks on a faults module
-_HOOKS = ("check", "triggered")
-#: module aliases the repo binds resilience.faults to
-_MODULE_NAMES = ("faults", "_faults")
+#: (apply_silent_fault is resilience/abft.py's trace-time applicator for
+#: the silent kinds — its point argument names FAULT_POINTS entries too)
+_HOOKS = ("check", "triggered", "apply_silent_fault")
+#: module aliases the repo binds resilience.faults / resilience.abft to
+_MODULE_NAMES = ("faults", "_faults", "abft", "_abft")
 
 _FAULTS_REL = Path("mpi_petsc4py_example_tpu") / "resilience" / "faults.py"
 
