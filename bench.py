@@ -205,6 +205,62 @@ def batched_delta(nx, k=8, reps=3, lo=20, hi=220):
     return delta_rate_many(fixed, B, reps=reps, lo=lo, hi=hi)
 
 
+def serving_episode(nx, requests=64, max_k=16, window=0.003, rtol=1e-6):
+    """Coalesced-serving episode (--serving): the SAME request set
+    through a SolveServer session (block-CG dispatch, donated buffers)
+    and through sequential per-request ``ksp.solve`` launches, on the
+    headline stencil operator. Prints one extra JSON line; the ratio
+    measures dispatch amortization + block-kernel throughput (cfg9 in
+    benchmarks/run_all.py is the full Poisson-arrival protocol with the
+    injected-fault recovery)."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.serving import SolveServer
+
+    comm, op, ksp, b = make_problem(nx, "jacobi")
+    n = nx ** 3
+    rng = np.random.default_rng(13)
+    B = np.stack([np.asarray(op.mult(tps.Vec.from_global(
+        comm, rng.random(n).astype(np.float32))).to_numpy())
+        for _ in range(requests)], axis=1)
+    ksp.set_tolerances(rtol=rtol, atol=0.0, max_it=20000)
+    x, bv = op.get_vecs()
+    bv.set_global(B[:, 0])
+    ksp.solve(bv, x)                  # warm the k=1 program
+    t0 = time.perf_counter()
+    for j in range(requests):
+        x, bv = op.get_vecs()
+        bv.set_global(B[:, j])
+        ksp.solve(bv, x)
+    seq_wall = time.perf_counter() - t0
+
+    srv = SolveServer(comm, window=window, max_k=max_k)
+    srv.register_operator("stencil", op, pc_type="jacobi", rtol=rtol,
+                          warm_widths=(max_k,))
+    t0 = time.perf_counter()
+    futs = [srv.submit("stencil", B[:, j]) for j in range(requests)]
+    res = [f.result(600) for f in futs]
+    wall = time.perf_counter() - t0
+    stats = srv.stats()
+    srv.shutdown()
+    assert all(r.converged for r in res)
+    line = {
+        "metric": f"serving: {requests} coalesced solves ({nx}^3 "
+                  f"stencil, max_k={max_k}) vs sequential dispatch",
+        "value": round(requests / wall, 2) if wall > 0 else 0.0,
+        "unit": "solves/s",
+        "vs_baseline": round(seq_wall / wall, 3) if wall > 0 else 0.0,
+        "extra": {
+            "seq_solves_per_s": round(requests / seq_wall, 2)
+            if seq_wall > 0 else 0.0,
+            "mean_batch_width": round(stats["mean_width"], 2),
+            "batches": stats["batches"],
+            "queue_wait_p50_ms": round(
+                stats.get("queue_wait_p50_s", 0.0) * 1e3, 2),
+        },
+    }
+    print(json.dumps(line))
+
+
 def cpu_baseline(nx, b: np.ndarray, rtol: float):
     """scipy fp64 CG on the identical operator/tolerance."""
     import scipy.sparse.linalg as spla
@@ -239,6 +295,10 @@ def main():
     ap.add_argument("--log-view", action="store_true",
                     help="print the -log_view solve/kernel-traffic "
                          "summary after the JSON line")
+    ap.add_argument("--serving", action="store_true",
+                    help="additionally run the coalesced-serving "
+                         "episode (SolveServer vs sequential dispatch) "
+                         "and print its JSON line")
     opts = ap.parse_args()
     nx = opts.n or (32 if opts.quick else 128)
 
@@ -333,6 +393,10 @@ def main():
         },
     }
     print(json.dumps(line))
+    if opts.serving:
+        serving_episode(nx if opts.quick else min(nx, 64),
+                        requests=32 if opts.quick else 64,
+                        rtol=opts.rtol)
     if opts.log_view:
         from mpi_petsc4py_example_tpu.utils import profiling
         profiling.log_view()

@@ -85,6 +85,7 @@ __all__ = [
     "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
     "resilient_solve_many",
     "KSPFallbackChain",
+    "SolveServer", "ServedSolveResult", "ServerClosedError",
 ]
 
 
@@ -103,4 +104,9 @@ def __getattr__(name):
     if name in ("RetryPolicy", "resilient_solve",
                 "resilient_solve_many", "KSPFallbackChain"):
         return getattr(resilience, name)
+    if name in ("SolveServer", "ServedSolveResult", "ServerClosedError"):
+        # the serving layer pulls in KSP + resilience machinery — lazy,
+        # like the other solver-object imports above
+        from . import serving as _serving
+        return getattr(_serving, name)
     raise AttributeError(name)

@@ -134,8 +134,13 @@ class KSPFallbackChain:
         ksp = self.ksp
         config0 = (ksp.get_type(), ksp.get_pc().get_type())
         # pristine initial guess: restored before every escalation so a
-        # poisoned iterate never seeds the next method
-        x0_data = x.data
+        # poisoned iterate never seeds the next method. COPIED, not
+        # referenced: the solve programs DONATE the iterate buffer
+        # (krylov donate=True), so x.data is consumed by each stage —
+        # a bare reference here would be a deleted array by the time a
+        # fallback needs it
+        import jax.numpy as jnp
+        x0_data = jnp.copy(x.data)
         events: list[RecoveryEvent] = []
         # stage dedup happens at SOLVE time against the KSP's current type:
         # after a kept escalation (say cg->bcgs), the next call must not
@@ -151,7 +156,9 @@ class KSPFallbackChain:
             for ksp_type, pc_type in plan:
                 attempt += 1
                 if attempt > 1:
-                    x.data = x0_data
+                    # hand each stage its OWN donable copy — the stage's
+                    # solve consumes what it is given
+                    x.data = jnp.copy(x0_data)
                 ksp.set_type(ksp_type)
                 if pc_type is not None:
                     ksp.get_pc().set_type(pc_type)

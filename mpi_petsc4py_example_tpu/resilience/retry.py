@@ -66,6 +66,16 @@ class RetryPolicy:
     retriable_classes: tuple = ("unavailable", "detected_sdc")
     sleep: object = time.sleep     # injectable for tests (recorded delays)
 
+    @classmethod
+    def serving(cls) -> "RetryPolicy":
+        """The solve server's default policy (serving/server.py):
+        clients are WAITING on futures, so the worker-restart backoff is
+        two orders shorter than the batch default (50 ms base, 1 s cap)
+        while staying deterministic; DETECTED_SDC re-entries are
+        immediate either way. ``-solve_server_retry_delay`` overrides
+        the base delay at runtime."""
+        return cls(max_attempts=3, base_delay=0.05, max_delay=1.0)
+
     def delay(self, retry_index: int) -> float:
         """Backoff before retry ``retry_index`` (0-based)."""
         d = min(self.base_delay * self.backoff_factor ** retry_index,

@@ -1,0 +1,15 @@
+"""Serving layer: persistent device-resident solve sessions.
+
+See :mod:`.server` (the SolveServer session + client APIs) and
+:mod:`.coalescer` (the pure request-grouping logic). README "Serving"
+documents the user surface; PARITY.md "Serving sessions" maps the
+session model onto PETSc's reuse-the-KSP-object idiom.
+"""
+
+from .coalescer import SolveRequest, coalesce, padded_width
+from .server import (ServedSolveResult, ServerClosedError, SolveServer)
+
+__all__ = [
+    "SolveServer", "ServedSolveResult", "ServerClosedError",
+    "SolveRequest", "coalesce", "padded_width",
+]

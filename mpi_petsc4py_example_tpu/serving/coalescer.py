@@ -1,0 +1,88 @@
+"""Request coalescing for the solve server (serving/server.py).
+
+The coalescer is deliberately PURE host logic — no threads, no device
+work — so its grouping semantics are unit-testable in isolation and the
+server's dispatcher thread stays the only place concurrency lives.
+
+Semantics (the batching contract the server's tests pin):
+
+* requests are compatible — and may share one ``KSP.solve_many`` block —
+  only when they target the SAME registered operator with the SAME
+  tolerances (rtol, atol, max_it): tolerances are runtime scalars of one
+  compiled program launch, so a block has exactly one convergence
+  contract. Mixed-tolerance requests NEVER batch together.
+* FIFO order is preserved within a compatibility group, and groups are
+  dispatched in order of their oldest member — a coalesced server must
+  not reorder a client's causally ordered submissions to the same
+  session.
+* a group wider than ``max_k`` splits into ceil(k/max_k) blocks
+  (the ``-ksp_batch_limit`` discipline applied at the serving layer,
+  where the split can also respect arrival order).
+* optionally a block's width is PADDED up to the next power of two
+  (zero RHS columns — they converge at iteration 0 under the masked
+  block-CG kernel and freeze): the set of compiled program widths is
+  then bounded by log2(max_k) + 1 instead of one shape-specialized
+  program per distinct request count, which is what keeps a long-lived
+  server's compile count (and AOT blob population) finite.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class SolveRequest:
+    """One pending solve: the unit the coalescer groups.
+
+    ``future`` is the ``concurrent.futures.Future`` the client holds;
+    the server resolves it with a per-request
+    :class:`~.server.ServedSolveResult` after the batch it rode in
+    returns. ``t_submit`` (``time.monotonic``) feeds the queue-wait
+    statistics and the batching-window deadline.
+    """
+    op: str
+    b: Any                      # (n,) host RHS, already dtype-validated
+    rtol: float
+    atol: float
+    max_it: int
+    future: Any
+    t_submit: float = field(default_factory=time.monotonic)
+
+    @property
+    def key(self) -> tuple:
+        """Compatibility key: requests batch together iff keys match."""
+        return (self.op, float(self.rtol), float(self.atol),
+                int(self.max_it))
+
+
+def coalesce(requests, max_k: int):
+    """Group pending ``requests`` into dispatchable batches.
+
+    Returns a list of request lists: one list per ``(compatibility key,
+    max_k-chunk)``, FIFO within each batch, batches ordered by oldest
+    member. Never mixes compatibility keys in one batch.
+    """
+    groups: dict = {}
+    for r in requests:
+        # dict insertion order IS the oldest-member group order
+        groups.setdefault(r.key, []).append(r)
+    max_k = max(1, int(max_k))
+    batches = []
+    for g in groups.values():
+        for s in range(0, len(g), max_k):
+            batches.append(g[s:s + max_k])
+    return batches
+
+
+def padded_width(k: int, max_k: int, pad_pow2: bool) -> int:
+    """The dispatched block width for ``k`` coalesced requests: ``k``
+    itself, or the next power of two (capped at ``max_k``) when padding
+    is on — see the module docstring for why padding bounds the
+    program-cache population."""
+    if not pad_pow2 or k <= 0:
+        return k
+    p = 1 << max(k - 1, 0).bit_length()
+    return min(max(p, 1), max(int(max_k), k))

@@ -1,0 +1,488 @@
+"""SolveServer — a persistent, device-resident solve session.
+
+The bench data (BENCH_r05 / ROADMAP item 1) shows the on-chip CG loop at
+~35k iters/s while an end-to-end solve spends ~95% of its wall in
+per-request dispatch/launch latency. The serving answer is to stop
+paying that latency per request: a long-lived :class:`SolveServer`
+session registers each operator ONCE — CSR/ELL/DIA operands, PC
+factors, and the AOT-cached compiled programs stay resident in device
+HBM — and a concurrent stream of solve requests is COALESCED into
+``(n, k)`` blocks dispatched through the PR-4 block-CG kernels
+(``KSP.solve_many``: collective count per iteration independent of k),
+with donated iterate buffers on the hot path (krylov ``donate=True``:
+zero extra device allocations per launch). This is the PETSc
+reuse-the-KSP-object idiom (PARITY.md "Serving sessions") made
+concurrent: JAXMg and JAX-AMG (PAPERS.md) both keep solver state
+device-resident between solves for exactly this reason.
+
+Client APIs:
+
+* :meth:`SolveServer.submit` — async: returns a
+  ``concurrent.futures.Future`` resolving to a
+  :class:`ServedSolveResult` (per-request iterations/residual/reason +
+  the solution vector).
+* :meth:`SolveServer.solve` — sync: submit + wait.
+
+Requests are grouped by the coalescer (serving/coalescer.py): same
+operator + same tolerances may share a block; a batching window
+(``-solve_server_window``) holds the first request briefly so
+concurrent arrivals ride the same launch; ``-solve_server_max_k`` caps
+the block width and ``-solve_server_pad_pow2`` rounds widths up to
+powers of two so a server compiles at most log2(max_k)+1 block
+programs per operator configuration.
+
+Resilience rides along PER REQUEST: with ``-solve_server_resilient``
+(default on) every dispatched block runs under
+:func:`resilience.retry.resilient_solve_many` — a worker crash
+checkpoints the partial iterate block, backs off
+(:meth:`RetryPolicy.serving`'s short deterministic delays), rebuilds,
+and resumes; a detected silent corruption rolls the block back to the
+verified iterates and re-enters immediately, and the PR-5 per-column
+detection means one poisoned request cannot contaminate its
+batch-mates' verified answers (the independent final re-verification
+covers every column).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.mat import Mat
+from ..parallel.mesh import as_comm
+from ..resilience.retry import RetryPolicy, resilient_solve_many
+from ..solvers.ksp import KSP
+from ..utils.convergence import SolveResult
+from ..utils.options import global_options
+from ..utils.profiling import record_serving
+from .coalescer import SolveRequest, coalesce, padded_width
+
+
+class ServerClosedError(RuntimeError):
+    """Submission to a server that has been shut down."""
+
+
+@dataclass
+class ServedSolveResult(SolveResult):
+    """A per-request :class:`SolveResult` as demultiplexed from the
+    coalesced block it rode in.
+
+    ``x`` is the request's solution vector (host copy of its block
+    column); ``batch_width`` the number of REAL requests coalesced into
+    the dispatched block (padding columns excluded); ``queue_wait`` the
+    seconds the request waited between submission and dispatch (the
+    batching-window + backlog cost the latency percentiles in
+    benchmarks/run_all.py cfg9 report). ``wall_time`` is the whole
+    block's wall — launches are shared, so per-request wall is not a
+    meaningful quantity. The resilience trail (``attempts`` /
+    ``recovery_events`` / SDC counters) is the BLOCK's: recovery acts on
+    the dispatched block as a unit.
+    """
+    x: object = None
+    op: str = ""
+    batch_width: int = 1
+    queue_wait: float = 0.0
+
+
+class _OperatorSession:
+    """One registered operator: device-resident operands + a dedicated
+    KSP whose PC factors and compiled programs persist across requests.
+
+    The registered tolerance DEFAULTS are stored here, not read back
+    from the KSP: dispatches set the session KSP's tolerances to each
+    batch's (possibly overridden) values, so the KSP object's own
+    rtol/atol/max_it drift with traffic while these stay the contract
+    ``register_operator`` documented."""
+
+    __slots__ = ("name", "operator", "ksp", "dtype", "n",
+                 "rtol", "atol", "max_it")
+
+    def __init__(self, name, operator, ksp):
+        self.name = name
+        self.operator = operator
+        self.ksp = ksp
+        self.dtype = np.dtype(operator.dtype)
+        self.n = int(operator.shape[0])
+        self.rtol = float(ksp.rtol)
+        self.atol = float(ksp.atol)
+        self.max_it = int(ksp.max_it)
+
+
+class SolveServer:
+    """Long-lived solve session with request coalescing (module doc).
+
+    Parameters (each overridable at construction time by the options DB
+    — PETSc precedence: runtime flags beat programmatic defaults):
+
+    window
+        Batching window in seconds (``-solve_server_window``): the
+        dispatcher holds the OLDEST pending request this long so
+        concurrent arrivals coalesce into its block. 0 dispatches
+        every snapshot of the queue immediately.
+    max_k
+        Maximum coalesced block width (``-solve_server_max_k``).
+    pad_pow2
+        Round block widths up to powers of two with zero columns
+        (``-solve_server_pad_pow2``) — bounds the compiled-program
+        population; a zero column freezes at iteration 0 under the
+        masked block-CG kernel.
+    resilient
+        Dispatch through ``resilient_solve_many``
+        (``-solve_server_resilient``).
+    retry_policy
+        The :class:`RetryPolicy` for resilient dispatches; default
+        :meth:`RetryPolicy.serving` (short deterministic backoff —
+        clients are waiting). ``-solve_server_retry_delay`` overrides
+        its base delay.
+    autostart
+        Start the dispatcher thread immediately. ``False`` lets tests
+        (and batch drivers) enqueue a known request population and then
+        :meth:`start` — every pending request is then coalesced in one
+        deterministic window.
+    """
+
+    def __init__(self, comm=None, *, window: float = 0.002,
+                 max_k: int = 32, pad_pow2: bool = True,
+                 resilient: bool = True,
+                 retry_policy: RetryPolicy | None = None,
+                 autostart: bool = True):
+        self.comm = as_comm(comm)
+        self.window = float(window)
+        self.max_k = int(max_k)
+        self.pad_pow2 = bool(pad_pow2)
+        self.resilient = bool(resilient)
+        self.retry_policy = retry_policy or RetryPolicy.serving()
+        self._sessions: dict[str, _OperatorSession] = {}
+        self._pending: list[SolveRequest] = []
+        self._inflight = 0
+        self._stop = False
+        self._closed = False
+        self._cv = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._dispatch_hook = None       # test seam: called per batch
+        self._stats = {"requests": 0, "batches": 0, "padded_cols": 0,
+                       "width_hist": {}, "queue_waits": []}
+        self.set_from_options()
+        if autostart:
+            self.start()
+
+    # ---- configuration ------------------------------------------------------
+    def set_from_options(self):
+        """Apply ``-solve_server_*`` runtime flags (utils/options)."""
+        opt = global_options()
+        self.window = opt.get_real("solve_server_window", self.window)
+        self.max_k = opt.get_int("solve_server_max_k", self.max_k)
+        self.pad_pow2 = opt.get_bool("solve_server_pad_pow2",
+                                     self.pad_pow2)
+        self.resilient = opt.get_bool("solve_server_resilient",
+                                      self.resilient)
+        delay = opt.get_real("solve_server_retry_delay", None)
+        if delay is not None:
+            # REPLACE, never mutate: the caller may share one
+            # RetryPolicy object with non-serving resilient solves
+            import dataclasses
+            self.retry_policy = dataclasses.replace(
+                self.retry_policy, base_delay=float(delay))
+        return self
+
+    setFromOptions = set_from_options
+
+    # ---- operator registry --------------------------------------------------
+    def register_operator(self, name: str, A, *, ksp_type: str = "cg",
+                          pc_type: str = "jacobi", dtype=None,
+                          rtol: float = 1e-5, atol: float = 0.0,
+                          max_it: int = 10000, abft: bool = False,
+                          residual_replacement: int = 0,
+                          warm_widths=()):
+        """Register operator ``name`` and make its solve state resident.
+
+        ``A`` is a framework operator (Mat / matrix-free stencil) or
+        anything ``Mat.from_scipy`` accepts (scipy sparse, dense
+        ndarray). Registration builds the session KSP, places the
+        operands, and sets up the PC ONCE — every later request reuses
+        the resident factors and cached programs. ``rtol/atol/max_it``
+        are the session DEFAULTS a request may override per submit
+        (different tolerances then coalesce separately).
+
+        ``warm_widths`` pre-compiles (and AOT-caches) the block
+        programs for the given widths by dispatching zero-RHS blocks —
+        they converge at iteration 0 — so the first real request at
+        that width pays no compile.
+
+        ``abft`` / ``residual_replacement`` arm the PR-5
+        silent-corruption guard on the session: an in-program detection
+        rolls the whole block back to the verified iterates and the
+        resilient dispatch re-enters immediately — one poisoned request
+        cannot contaminate its batch-mates (per-column detection +
+        independent final re-verification). The session KSP also
+        applies the options DB (``-ksp_*`` flags — abft, residual
+        replacement, true-residual gating — override these defaults at
+        runtime, the PETSc precedence).
+        """
+        if name in self._sessions:
+            raise ValueError(f"operator {name!r} already registered")
+        op = A
+        if not hasattr(op, "device_arrays"):
+            import scipy.sparse as sp
+            op = Mat.from_scipy(self.comm, sp.csr_matrix(A), dtype=dtype)
+        ksp = KSP().create(self.comm)
+        ksp.set_operators(op)
+        ksp.set_type(ksp_type)
+        ksp.get_pc().set_type(pc_type)
+        ksp.set_tolerances(rtol=rtol, atol=atol, max_it=max_it)
+        ksp.abft = bool(abft)
+        ksp.residual_replacement = int(residual_replacement)
+        ksp.set_from_options()
+        # the options DB keeps PETSc precedence, but a global -ksp_type/
+        # -pc_type aimed at some OTHER solver in the process can silently
+        # turn this session's coalesced block dispatch into per-column
+        # sequential solves (KSP.solve_many's fallback routing) — results
+        # stay correct, the serving throughput win evaporates. Say so.
+        from ..solvers.krylov import batched_pc_supported
+        if (ksp.get_type() != "cg"
+                or not batched_pc_supported(ksp.get_pc())):
+            import warnings
+            warnings.warn(
+                f"SolveServer operator {name!r}: configuration "
+                f"{ksp.get_type()}+{ksp.get_pc().get_type()} has no "
+                "batched kernel — coalesced blocks will dispatch as "
+                "per-column sequential solves (check for stray global "
+                "-ksp_type/-pc_type options)", stacklevel=2)
+        ksp.set_up()                  # PC factors placed NOW, once
+        sess = _OperatorSession(name, op, ksp)
+        self._sessions[name] = sess
+        for w in warm_widths:
+            w = padded_width(int(w), self.max_k, self.pad_pow2)
+            ksp.solve_many(np.zeros((sess.n, w), sess.dtype))
+        return sess
+
+    registerOperator = register_operator
+
+    def operators(self):
+        return sorted(self._sessions)
+
+    # ---- client APIs --------------------------------------------------------
+    def submit(self, op: str, b, *, rtol: float | None = None,
+               atol: float | None = None,
+               max_it: int | None = None) -> Future:
+        """Enqueue one solve; returns a Future of ServedSolveResult.
+
+        Tolerance overrides narrow the request's compatibility group —
+        requests with different tolerances never share a block.
+        """
+        sess = self._sessions.get(op)
+        if sess is None:
+            raise ValueError(f"unknown operator {op!r}; registered: "
+                             f"{self.operators()}")
+        b = np.asarray(b)
+        if b.shape != (sess.n,):
+            raise ValueError(f"submit({op!r}): b must be ({sess.n},), "
+                             f"got {b.shape}")
+        fut: Future = Future()
+        req = SolveRequest(
+            # a COPY of the caller's RHS: the request sits in the
+            # batching window while the caller may reuse its buffer for
+            # the next submission — a zero-copy view would silently
+            # rewrite this request's RHS
+            op=op, b=np.array(b, dtype=sess.dtype, copy=True),
+            rtol=sess.rtol if rtol is None else float(rtol),
+            atol=sess.atol if atol is None else float(atol),
+            max_it=sess.max_it if max_it is None else int(max_it),
+            future=fut)
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("SolveServer is shut down")
+            self._pending.append(req)
+            self._cv.notify_all()
+        return fut
+
+    def solve(self, op: str, b, *, timeout: float | None = None,
+              **tol_overrides) -> ServedSolveResult:
+        """Synchronous client API: submit + wait."""
+        return self.submit(op, b, **tol_overrides).result(timeout)
+
+    # ---- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Start the dispatcher thread (idempotent)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="SolveServer-dispatch",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted request has resolved; False on
+        timeout. The server stays open for new submissions."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._inflight:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(rem if rem is not None else 0.5)
+        return True
+
+    def shutdown(self, wait: bool = True):
+        """Stop the server. ``wait=True`` (default) FLUSHES the queue —
+        every pending future resolves (the drain-on-shutdown contract) —
+        then joins the dispatcher. ``wait=False`` fails pending futures
+        with :class:`ServerClosedError` and returns promptly."""
+        with self._cv:
+            if self._closed and self._thread is None:
+                return
+            self._closed = True
+            if not wait:
+                for r in self._pending:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(
+                            ServerClosedError("server shut down before "
+                                              "dispatch"))
+                self._pending.clear()
+            pending = bool(self._pending)
+        if self._thread is None and pending:
+            # never-started server (autostart=False): flush inline so
+            # shutdown keeps the every-future-resolves contract
+            self.start()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=exc == (None, None, None))
+        return False
+
+    # ---- dispatcher ---------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if not self._pending and self._stop:
+                    return
+                t_open = self._pending[0].t_submit
+            # batching window: hold the oldest pending request at most
+            # `window` seconds so concurrent arrivals ride its block;
+            # shutdown flushes immediately. Requests arriving after the
+            # snapshot below land in the NEXT window by construction.
+            while True:
+                with self._cv:
+                    if self._stop:
+                        break
+                    rem = self.window - (time.monotonic() - t_open)
+                    if rem <= 0:
+                        break
+                    self._cv.wait(timeout=rem)
+            with self._cv:
+                taken = list(self._pending)
+                self._pending.clear()
+                self._inflight += len(taken)
+            try:
+                for batch in coalesce(taken, self.max_k):
+                    self._dispatch(batch)
+            finally:
+                with self._cv:
+                    self._inflight -= len(taken)
+                    self._cv.notify_all()
+
+    def _dispatch(self, reqs):
+        """Solve one coalesced batch and demux per-request results."""
+        if self._dispatch_hook is not None:
+            self._dispatch_hook(reqs)
+        # honor client-side cancellation (Future protocol): a request
+        # cancelled before dispatch never reaches the device
+        reqs = [r for r in reqs
+                if r.future.set_running_or_notify_cancel()]
+        if not reqs:
+            return
+        sess = self._sessions[reqs[0].op]
+        k = len(reqs)
+        t0 = time.monotonic()
+        waits = [t0 - r.t_submit for r in reqs]
+        kpad = padded_width(k, self.max_k, self.pad_pow2)
+        B = np.zeros((sess.n, kpad), dtype=sess.dtype)
+        for j, r in enumerate(reqs):
+            B[:, j] = r.b
+        ksp = sess.ksp
+        ksp.set_tolerances(rtol=reqs[0].rtol, atol=reqs[0].atol,
+                           max_it=reqs[0].max_it)
+        try:
+            if self.resilient:
+                res = resilient_solve_many(ksp, B,
+                                           policy=self.retry_policy)
+            else:
+                res = ksp.solve_many(B)
+        # tpslint: disable=TPS005 — whatever the dispatch raised
+        # (exhausted retries, validation, a non-retriable device
+        # failure) must reach the WAITING CLIENT FUTURES, not kill the
+        # dispatcher thread; re-raising here would hang every later
+        # request
+        except Exception as exc:  # noqa: BLE001
+            for r in reqs:
+                r.future.set_exception(exc)
+            self._record(k, waits, kpad - k)
+            return
+        per = res.per_rhs()
+        for j, r in enumerate(reqs):
+            col = per[j]
+            out = ServedSolveResult(
+                iterations=col.iterations,
+                residual_norm=col.residual_norm,
+                reason=col.reason, wall_time=res.wall_time,
+                history=col.history,
+                attempts=res.attempts,
+                recovery_events=list(res.recovery_events),
+                abft_checks=res.abft_checks,
+                sdc_detections=res.sdc_detections,
+                residual_replacements=res.residual_replacements,
+                x=np.array(res.X[:, j]), op=r.op, batch_width=k,
+                queue_wait=waits[j])
+            r.future.set_result(out)
+        self._record(k, waits, kpad - k)
+
+    def _record(self, width, waits, padded):
+        record_serving(width, waits, padded)
+        with self._cv:
+            st = self._stats
+            st["requests"] += width
+            st["batches"] += 1
+            st["padded_cols"] += padded
+            st["width_hist"][width] = st["width_hist"].get(width, 0) + 1
+            st["queue_waits"].extend(waits)
+            del st["queue_waits"][:-10000]     # bounded reservoir
+
+    # ---- observability ------------------------------------------------------
+    def stats(self) -> dict:
+        """Per-server coalescing statistics (the profiling module keeps
+        the process-wide twin printed by ``log_view``)."""
+        with self._cv:
+            st = self._stats
+            waits = list(st["queue_waits"])
+            out = {"requests": st["requests"], "batches": st["batches"],
+                   "padded_cols": st["padded_cols"],
+                   "width_hist": dict(st["width_hist"])}
+        out["mean_width"] = (out["requests"] / out["batches"]
+                             if out["batches"] else 0.0)
+        if waits:
+            w = np.sort(np.asarray(waits))
+            out["queue_wait_mean_s"] = float(w.mean())
+            out["queue_wait_p50_s"] = float(np.percentile(w, 50))
+            out["queue_wait_p99_s"] = float(np.percentile(w, 99))
+            out["queue_wait_max_s"] = float(w[-1])
+        return out
+
+    def __repr__(self):
+        return (f"SolveServer(ops={self.operators()}, "
+                f"window={self.window:g}s, max_k={self.max_k}, "
+                f"resilient={self.resilient})")

@@ -18,6 +18,7 @@ stencil operators plug in unchanged.
 from __future__ import annotations
 
 import contextlib as _contextlib
+import functools as _functools
 import threading as _threading
 import types as _types
 
@@ -2114,6 +2115,41 @@ NATURAL_TYPES = ("cg", "fcg", "cr")
 _PROGRAM_CACHE: dict = {}
 
 
+@_functools.lru_cache(maxsize=1)
+def donation_supported() -> bool:
+    """Whether the active backend actually ALIASES donated buffers.
+
+    Solve programs donate the initial-iterate argument (the output x has
+    identical shape/sharding, so XLA reuses the buffer in place — every
+    repeat solve on a session then runs at ZERO extra HBM allocations,
+    the serving hot-path requirement). Backends that cannot alias ignore
+    the donation with a per-call UserWarning; this one tiny probe decides
+    once per process so such backends never pay the warning spam and the
+    cache key stays honest about what was compiled.
+    """
+    import warnings
+    probe = jax.jit(lambda v: v + 1, donate_argnums=(0,))
+    x = jnp.zeros((8,), jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        probe(x)
+    return bool(getattr(x, "is_deleted", lambda: False)())
+
+
+def _consumed_zeros(x0):
+    """A zero initial iterate that still CONSUMES the ``x0`` argument.
+
+    Donated zero-guess programs cannot use ``jnp.zeros_like``: the x0
+    parameter would be dead in the jaxpr, jit would PRUNE it, and the
+    donated buffer could never alias the output (the zero-allocation
+    contract silently evaporates — measured: no warning is emitted).
+    ``nan_to_num`` first makes ``v * 0 == 0`` exact for ANY buffer
+    content — a donated buffer may carry a previous solve's NaN/Inf
+    iterate, and ``NaN * 0`` is NaN. Two elementwise passes over one
+    vector, once per solve."""
+    return jnp.nan_to_num(x0, nan=0.0, posinf=0.0, neginf=0.0) * 0
+
+
 # kernels supporting masked multi-step unrolling per while_loop iteration
 _UNROLLABLE = ("cg",)
 
@@ -2132,7 +2168,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       natural: bool = False, hist_cap: int = 0,
                       live: bool = False, true_res: bool = False,
                       abft: bool = False, abft_pc: bool = False,
-                      rr: bool = False):
+                      rr: bool = False, donate: bool = False):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -2190,6 +2226,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     (:data:`SDC_DETECTOR_NAMES`; 0 = clean), ``rrc`` the residual
     replacements performed, ``xv`` the last VERIFIED iterate the caller
     rolls back to on detection. See :func:`cg_kernel_guarded`.
+
+    ``donate=True`` donates the ``x0`` argument into the program
+    (``jax.jit(..., donate_argnums=...)``): the output iterate aliases
+    the input buffer, so a session issuing repeat solves (KSP.solve's
+    hot path, the serving dispatch loop) performs ZERO extra device
+    allocations per solve. The caller must treat its ``x0`` buffer as
+    CONSUMED by the call (KSP.solve rebinds ``x.data`` to the program's
+    output). Silently off on backends that cannot alias
+    (:func:`donation_supported`).
     """
     axis = comm.axis
     n = operator.shape[0]
@@ -2236,10 +2281,12 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # armed it is a fresh nonce, so a program traced under injection (e.g.
     # a corrupted comm.psum baked into the jaxpr) is never cached into —
     # or served from — the fault-free program set.
+    donate_k = bool(donate) and donation_supported()
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k,
-           true_res_k, abft_k, abft_pc_k, bool(rr), _faults.trace_key())
+           true_res_k, abft_k, abft_pc_k, bool(rr), donate_k,
+           _faults.trace_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         return cached
@@ -2306,7 +2353,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         def body(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit,
                  guard_args=None):
             if zero_guess:
-                x0 = jnp.zeros_like(b)
+                x0 = _consumed_zeros(x0) if donate_k else jnp.zeros_like(b)
             b, x0 = project(b), project(x0)
             # the spmv.result / pc.apply SILENT fault points apply at
             # trace time (resilience/abft.py): the solver-loop operator
@@ -2483,6 +2530,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
         in_specs = (op_specs, pc.in_specs(axis), P(None, axis),
                     P(axis), P(axis), P(), P(), P(), P())
+        x0_idx = 4
     elif guard_k:
         # guard signature: leading checksum vectors (present per flag),
         # trailing runtime guard scalars (tolerance factor + replacement
@@ -2507,6 +2555,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         in_specs = (op_specs, pc.in_specs(axis)) \
             + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
             + (P(axis), P(axis), P(), P(), P(), P(), P(), P())
+        x0_idx = 3 + abft_k + abft_pc_k
     else:
         def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
             out = make_body(lambda v: v)(op_arrays, pc_arrays, b, x0,
@@ -2517,6 +2566,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
         in_specs = (op_specs, pc.in_specs(axis),
                     P(axis), P(axis), P(), P(), P(), P())
+        x0_idx = 3
     # the history buffer rides as a 5th (replicated) output — every device
     # writes identical psum'd norms into it; with true_res the epilogue's
     # two scalars follow as replicated trailing outputs; the guard appends
@@ -2526,7 +2576,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
         out_specs = out_specs + (P(), P(), P(axis))
     if true_res_k:
         out_specs = out_specs + (P(), P())
-    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
+    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs),
+                   donate_argnums=(x0_idx,) if donate_k else ())
     _PROGRAM_CACHE[key] = prog
     return prog
 
@@ -2830,7 +2881,8 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                            nrhs: int, monitored: bool = False,
                            zero_guess: bool = False, hist_cap: int = 0,
                            abft: bool = False, abft_pc: bool = False,
-                           rr: bool = False, true_res: bool = False):
+                           rr: bool = False, true_res: bool = False,
+                           donate: bool = False):
     """Build (or fetch cached) the batched multi-RHS solve program.
 
     Signature of the returned callable::
@@ -2877,10 +2929,11 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     true_res_k = bool(true_res)
     trace_nonce = _faults.trace_key()
     aot_on = aot.aot_enabled() and trace_nonce is None
+    donate_k = bool(donate) and donation_supported()
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
            int(nrhs), monitored, zero_guess, operator.program_key(),
-           cap_k, abft_k, abft_pc_k, bool(rr), true_res_k, trace_nonce,
-           aot_on)
+           cap_k, abft_k, abft_pc_k, bool(rr), true_res_k, donate_k,
+           trace_nonce, aot_on)
     cached = _PROGRAM_CACHE_MANY.get(key)
     if cached is not None:
         return cached
@@ -2918,7 +2971,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     def body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit,
              guard_args=None):
         if zero_guess:
-            X0 = jnp.zeros_like(B)
+            X0 = _consumed_zeros(X0) if donate_k else jnp.zeros_like(B)
         cdot = lambda U, V: jnp.sum(jnp.conj(U) * V, axis=0)
         pdotc = lambda U, V: _psum(cdot(U, V), axis)
         pnormc = lambda U: jnp.sqrt(jnp.real(_psum(cdot(U, U), axis)))
@@ -2983,6 +3036,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
         in_specs = (op_specs, pc.in_specs(axis)) \
             + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
             + (P(axis, None), P(axis, None), P(), P(), P(), P(), P(), P())
+        x0_idx = 3 + abft_k + abft_pc_k
     else:
         def local_fn(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit):
             out = body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol,
@@ -2993,17 +3047,25 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
 
         in_specs = (op_specs, pc.in_specs(axis), P(axis, None),
                     P(axis, None), P(), P(), P(), P())
+        x0_idx = 3
     out_specs = (P(axis, None), P(), P(), P(), P())
     if guard_k:
         out_specs = out_specs + (P(), P(), P(axis, None))
     if true_res_k:
         out_specs = out_specs + (P(), P())
-    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs))
+    # the X0 block is donated on aliasing-capable backends: the program's
+    # output X reuses the input buffer, so the serving dispatch loop's
+    # repeat launches allocate nothing (KSP.solve_many always passes a
+    # freshly placed X0 it never reads back)
+    dn = (x0_idx,) if donate_k else ()
+    prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs),
+                   donate_argnums=dn)
     if aot_on:
         # key_parts: the full program identity minus the mesh (the wrap
         # appends its own mesh/jax-version/x64 fingerprint) — nrhs is in
         # there, so each batch width gets its own shape-specialized blob
         prog = aot.wrap("ksp_many", comm, key[1:],
-                        prog, code=aot.source_fingerprint(__file__))
+                        prog, code=aot.source_fingerprint(__file__),
+                        donate_argnums=dn)
     _PROGRAM_CACHE_MANY[key] = prog
     return prog

@@ -538,7 +538,8 @@ class KSP:
                                  abft=guard and self.abft,
                                  abft_pc=abft_pc_on,
                                  rr=guard
-                                 and self.residual_replacement > 0)
+                                 and self.residual_replacement > 0,
+                                 donate=True)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the
@@ -568,11 +569,20 @@ class KSP:
         # into x before the synthetic failure, exactly what a checkpoint
         # after a real mid-solve crash would hold (resilience/retry.py
         # resumes from it).
+        # the program DONATES the initial-iterate argument (krylov
+        # donate=True: the output x aliases the x0 buffer — zero extra
+        # device allocations per repeat solve). x.data is rebound to the
+        # program's output right after the call; an x0 that aliases the
+        # RHS buffer must be copied first or the donation would delete b.
+        from .krylov import donation_supported
+        x0d = x.data
+        if donation_supported() and x0d is b.data:
+            x0d = jnp.array(x0d)
         fault = _faults.triggered("ksp.program")
         if fault is not None:
             if fault.iter_k:
                 part = prog(mat.device_arrays(), pc.device_arrays(),
-                            *ns_args, *cs_args, b.data, x.data,
+                            *ns_args, *cs_args, b.data, x0d,
                             dt.type(0.0), dt.type(0.0), dt.type(divtol),
                             np.int32(min(int(fault.iter_k), self.max_it)),
                             *guard_scalars)
@@ -619,11 +629,16 @@ class KSP:
             with live_ctx:
                 out = prog(
                     mat.device_arrays(), pc.device_arrays(), *ns_args,
-                    *cs_args, b.data, x.data,
+                    *cs_args, b.data, x0d,
                     dt.type(rtol * margin), dt.type(atol * margin),
                     dt.type(divtol), np.int32(self.max_it),
                     *guard_scalars)
                 xd, iters, rnorm, reason, hist = out[:5]
+                # rebind the caller's vector IMMEDIATELY: the donated x0
+                # buffer is gone, so any exit path from here on (a raising
+                # user monitor, the guard's rollback, a poisoned fetch)
+                # must already see the program's output as x
+                x.data = xd
                 det = rrc = xv = None
                 true_rn = bnorm = None
                 rest = out[5:]
@@ -697,7 +712,6 @@ class KSP:
                     detail=f"{int(rrc)} residual replacement(s) passed "
                            "before detection")
             record_sdc(checks, 0, int(rrc))
-        x.data = xd
         # fault point 'ksp.result': poison the fetched residual norm — the
         # deterministic stand-in for a recurrence blowing up at iteration
         # iter=K (real blow-ups reach this same fetch carrying their NaN)
@@ -959,11 +973,15 @@ class KSP:
         cs_args, abft_pc_on = ((), False)
         if guard:
             cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
+        # donate=True: the X0 block is consumed by the program (the
+        # output X aliases it) — both the first launch and every gate
+        # re-entry run at zero extra device allocations, the serving
+        # dispatch loop's realloc-churn killer
         build_kw = dict(monitored=monitored,
                         hist_cap=hist_capacity(self.max_it, 0),
                         abft=guard and self.abft, abft_pc=abft_pc_on,
                         rr=guard and self.residual_replacement > 0,
-                        true_res=gate)
+                        true_res=gate, donate=True)
         prog = build_ksp_program_many(
             comm, "cg", pc, mat, nrhs=k,
             zero_guess=not guess_nonzero, **build_kw)

@@ -75,13 +75,16 @@ def _digest(kind: str, comm, key_parts, code: str = "") -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
-def _load(path: str):
+def _load(path: str, donate_argnums=()):
     """Deserialize a blob into a jitted callable, or None."""
     try:
         with open(path, "rb") as fh:
             blob = fh.read()
         exported = jax.export.deserialize(bytearray(blob))
-        return jax.jit(exported.call)
+        # donation is a property of the jit wrapper, not the serialized
+        # StableHLO — re-apply it so a loaded program keeps the traced
+        # program's zero-allocation aliasing (krylov donated solves)
+        return jax.jit(exported.call, donate_argnums=donate_argnums)
     # tpslint: disable=TPS005 — best-effort load: a stale/corrupt blob or
     # a jax ABI change must fall back to tracing, whatever it raises
     except Exception:
@@ -103,7 +106,8 @@ def _store(path: str, exported_bytes: bytes):
             pass
 
 
-def wrap(kind: str, comm, key_parts, prog, code: str = ""):
+def wrap(kind: str, comm, key_parts, prog, code: str = "",
+         donate_argnums=()):
     """AOT-cache a compiled program factory's jitted ``prog``.
 
     On a cache hit the deserialized program replaces ``prog`` outright —
@@ -113,13 +117,20 @@ def wrap(kind: str, comm, key_parts, prog, code: str = ""):
     processes hit. ``key_parts`` must pin everything the trace depends on
     (ncv, operator key, ...); the mesh topology, jax version, x64 mode,
     and the builder's ``code`` fingerprint (:func:`source_fingerprint`)
-    are appended automatically.
+    are appended automatically. ``donate_argnums`` (when the wrapped
+    ``prog`` was jitted with donation) is re-applied to the deserialized
+    call, so loaded programs keep the traced program's buffer aliasing.
     """
     if not aot_enabled():
         return prog
     path = os.path.join(cache_dir(), _digest(kind, comm, key_parts, code)
                         + ".jaxexport")
-    loaded = _load(path) if os.path.exists(path) else None
+    # undonated programs keep the 1-arg call shape (_load(path)) so
+    # test doubles that stub _load stay signature-compatible
+    loaded = None
+    if os.path.exists(path):
+        loaded = (_load(path, donate_argnums) if donate_argnums
+                  else _load(path))
 
     exported_once = [False]
 

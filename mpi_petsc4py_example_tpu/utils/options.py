@@ -68,6 +68,18 @@ KNOWN_FLAGS = {
     "pc_setup_device": "where block inversions run (host/device/auto)",
     "pc_sor_omega": "SOR/SSOR relaxation factor",
     "pc_type": "preconditioner type",
+    # ---- SolveServer (serving/server.py) ----
+    "solve_server_max_k": "max coalesced RHS columns per dispatched "
+                          "block",
+    "solve_server_pad_pow2": "round coalesced block widths up to powers "
+                             "of two (bounds the compiled-program "
+                             "population)",
+    "solve_server_resilient": "dispatch coalesced blocks through "
+                              "resilient_solve_many (retry/rollback "
+                              "per block)",
+    "solve_server_retry_delay": "serving retry backoff base delay "
+                                "seconds",
+    "solve_server_window": "request-coalescing batching window seconds",
     # ---- EPS (solvers/eps.py) ----
     "eps_gd_blocksize": "generalized-Davidson block size",
     "eps_hermitian": "declare the problem Hermitian (HEP)",

@@ -70,6 +70,40 @@ def sdc_counts() -> dict:
             "replacements": _SDC[2]}
 
 
+# solve-server coalescing totals (serving/server.py): dispatched batch
+# widths (histogram), per-request queue waits, zero-padding columns —
+# the per-window observability ROADMAP item 1 asks for, printed as a
+# -log_view row
+_SERVING = {"requests": 0, "batches": 0, "padded_cols": 0,
+            "width_hist": {}, "wait_sum_s": 0.0, "wait_max_s": 0.0}
+
+
+def record_serving(width: int, waits=(), padded: int = 0):
+    """Accumulate one dispatched coalesced batch: ``width`` REAL
+    requests (padding excluded), their queue waits in seconds, and the
+    zero columns added by the pow2 padding policy."""
+    _SERVING["requests"] += int(width)
+    _SERVING["batches"] += 1
+    _SERVING["padded_cols"] += int(padded)
+    hist = _SERVING["width_hist"]
+    hist[int(width)] = hist.get(int(width), 0) + 1
+    for w in waits:
+        _SERVING["wait_sum_s"] += float(w)
+        _SERVING["wait_max_s"] = max(_SERVING["wait_max_s"], float(w))
+
+
+def serving_stats() -> dict:
+    """Process-wide coalescing stats: batch-width histogram + queue-wait
+    aggregates (per-server percentiles live on SolveServer.stats())."""
+    out = dict(_SERVING)
+    out["width_hist"] = dict(_SERVING["width_hist"])
+    out["mean_width"] = (out["requests"] / out["batches"]
+                         if out["batches"] else 0.0)
+    out["wait_mean_s"] = (out["wait_sum_s"] / out["requests"]
+                          if out["requests"] else 0.0)
+    return out
+
+
 def record_sync(kind: str, count: int = 1):
     """Count a host<->device synchronization point (a blocking D2H fetch).
 
@@ -126,13 +160,15 @@ def clear_events():
     _SYNCS.clear()
     _KERNEL_TRAFFIC.clear()
     _SDC[:] = [0, 0, 0]
+    _SERVING.update(requests=0, batches=0, padded_cols=0,
+                    width_hist={}, wait_sum_s=0.0, wait_max_s=0.0)
 
 
 def log_view(file=None):
     """Print the accumulated solve log, -log_view style."""
     file = file or sys.stderr
     if (not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS
-            and not any(_SDC)):
+            and not any(_SDC) and not _SERVING["batches"]):
         print("log_view: no solve events recorded", file=file)
         return
     if _EVENTS:
@@ -155,6 +191,16 @@ def log_view(file=None):
         print(f"silent-error detection: {_SDC[0]} ABFT check(s), "
               f"{_SDC[1]} detection(s), {_SDC[2]} residual "
               f"replacement(s)", file=file)
+    if _SERVING["batches"]:
+        st = serving_stats()
+        hist = ", ".join(f"k={k}: {v}"
+                         for k, v in sorted(st["width_hist"].items()))
+        print(f"solve server: {st['batches']} coalesced dispatch(es), "
+              f"{st['requests']} request(s), mean width "
+              f"{st['mean_width']:.1f} [{hist}], queue wait mean "
+              f"{st['wait_mean_s'] * 1e3:.1f} ms / max "
+              f"{st['wait_max_s'] * 1e3:.1f} ms, "
+              f"{st['padded_cols']} padded column(s)", file=file)
     if _KERNEL_TRAFFIC:
         print("kernel traffic (model bytes / measured time = achieved "
               "GB/s):", file=file)

@@ -1,0 +1,144 @@
+"""Donated iterate buffers (krylov ``donate=True`` — ISSUE 6 satellite).
+
+The solve programs donate the initial-iterate argument so the output
+aliases the input buffer: a session issuing repeat solves (KSP.solve /
+KSP.solve_many — the serving hot path) performs no extra device
+allocations per solve. These tests pin (a) the donation actually
+happening (the consumed-zeros fix: a pruned x0 parameter silently
+disables aliasing), (b) allocation-neutral repeat solves, and (c) the
+NaN-safety of the zero-guess path over a donated buffer with arbitrary
+content.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.solvers.krylov import donation_supported
+
+RTOL = 1e-8
+NX = 10
+
+needs_donation = pytest.mark.skipif(
+    not donation_supported(),
+    reason="backend cannot alias donated buffers — the donation path "
+           "degrades to plain (still-correct) solves there")
+
+
+def _ksp(comm, A, pc="jacobi"):
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type(pc)
+    ksp.set_tolerances(rtol=RTOL)
+    return ksp, M
+
+
+class TestSingleRhsDonation:
+    @needs_donation
+    def test_repeat_solve_donates_previous_iterate(self, comm8):
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(A.shape[0]))
+        ksp.solve(b, x)                  # warm-up / compile
+        prev = x.data
+        res = ksp.solve(b, x)
+        assert res.converged
+        # the previous iterate buffer was CONSUMED by the program (the
+        # output x.data aliases it) — the no-realloc-churn contract
+        assert prev.is_deleted()
+        assert not x.data.is_deleted()
+        np.testing.assert_allclose(x.to_numpy(), 1.0, atol=1e-7)
+
+    @needs_donation
+    def test_no_extra_device_allocations_per_repeat_solve(self, comm8):
+        """The satellite's acceptance: repeat solves on a warmed session
+        leave the live device-buffer population EXACTLY unchanged."""
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(A.shape[0]))
+        for _ in range(2):               # warm program + steady state
+            ksp.solve(b, x)
+        n0 = len(jax.live_arrays())
+        for _ in range(5):
+            res = ksp.solve(b, x)
+        assert res.converged
+        assert len(jax.live_arrays()) == n0
+
+    @needs_donation
+    def test_zero_guess_exact_over_poisoned_donated_buffer(self, comm8):
+        """The consumed-zeros regression guard: the donated x0 buffer
+        may hold ANY previous content (here NaN/Inf) and the zero-guess
+        solve must still start from exact zeros — ``x0 * 0`` alone
+        would propagate the NaN into every iterate."""
+        import jax.numpy as jnp
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(A.shape[0]))
+        ksp.solve(b, x)
+        x.data = x.data.at[0].set(jnp.nan).at[1].set(jnp.inf)
+        res = ksp.solve(b, x)            # zero guess ignores the buffer
+        assert res.converged, res
+        np.testing.assert_allclose(x.to_numpy(), 1.0, atol=1e-7)
+
+    def test_guess_nonzero_restart_still_correct(self, comm8):
+        """Warm restarts pass the (donated) previous iterate as a REAL
+        initial guess — the resume path retry/gate re-entries use."""
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(A.shape[0]))
+        ksp.solve(b, x)
+        ksp.set_initial_guess_nonzero(True)
+        res = ksp.solve(b, x)            # restart from the solution
+        assert res.converged and res.iterations <= 1
+        np.testing.assert_allclose(x.to_numpy(), 1.0, atol=1e-7)
+
+    def test_aliased_rhs_survives_donation(self, comm8):
+        """x.data is b.data: the solve must copy rather than let the
+        donation delete the caller's RHS buffer."""
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        x, b = M.get_vecs()
+        b.set_global(A @ np.ones(A.shape[0]))
+        x.data = b.data                  # deliberate aliasing
+        res = ksp.solve(b, x)
+        assert res.converged
+        assert not b.data.is_deleted()
+        np.testing.assert_allclose(b.to_numpy(),
+                                   A @ np.ones(A.shape[0]), atol=1e-10)
+        np.testing.assert_allclose(x.to_numpy(), 1.0, atol=1e-7)
+
+
+class TestBatchedDonation:
+    @needs_donation
+    def test_solve_many_no_alloc_growth(self, comm8):
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        B = np.asarray(A @ np.random.default_rng(0).random(
+            (A.shape[0], 4)))
+        for _ in range(2):
+            ksp.solve_many(B.copy())
+        n0 = len(jax.live_arrays())
+        for _ in range(5):
+            res = ksp.solve_many(B.copy())
+        assert res.converged
+        assert len(jax.live_arrays()) == n0
+
+    def test_batched_parity_unchanged_by_donation(self, comm8):
+        """Donated and per-column sequential answers agree — donation
+        is an allocation property, never a numerics one."""
+        A = poisson2d_csr(NX)
+        ksp, M = _ksp(comm8, A)
+        rng = np.random.default_rng(2)
+        Xt = rng.random((A.shape[0], 3))
+        B = np.asarray(A @ Xt)
+        res = ksp.solve_many(B.copy())
+        assert res.converged
+        np.testing.assert_allclose(res.X, Xt, atol=1e-6)

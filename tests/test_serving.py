@@ -1,0 +1,368 @@
+"""SolveServer: persistent sessions, request coalescing, per-request
+resilience (serving/server.py + serving/coalescer.py).
+
+The coalescer's grouping semantics are unit-tested pure (no threads);
+server tests pin the concurrency contracts the serving layer promises:
+burst coalescing, mixed-tolerance isolation, mid-flight arrivals landing
+in the next window, drain/shutdown flushing every pending future, and a
+faulted request recovering without poisoning its batch-mates.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.serving import (SolveRequest, coalesce,
+                                              padded_width)
+from mpi_petsc4py_example_tpu.serving.server import (ServedSolveResult,
+                                                     ServerClosedError,
+                                                     SolveServer)
+from mpi_petsc4py_example_tpu.utils import profiling
+from mpi_petsc4py_example_tpu.utils.errors import DeviceExecutionError
+
+RTOL = 1e-8
+NX = 10                      # 100-dof 2D Poisson: compile-light
+
+
+def _problem(k=4, seed=0):
+    A = poisson2d_csr(NX)
+    rng = np.random.default_rng(seed)
+    Xt = rng.random((A.shape[0], k))
+    return A, Xt, np.asarray(A @ Xt)
+
+
+def _req(op="a", rtol=1e-6, atol=0.0, max_it=100):
+    return SolveRequest(op=op, b=None, rtol=rtol, atol=atol,
+                        max_it=max_it, future=Future())
+
+
+def _fast_policy():
+    return tps.RetryPolicy(sleep=lambda d: None, base_delay=0.0)
+
+
+# --------------------------------------------------------------- coalescer
+class TestCoalescer:
+    def test_groups_by_compatibility_key(self):
+        r1, r2 = _req(rtol=1e-6), _req(rtol=1e-6)
+        r3 = _req(rtol=1e-8)                     # mixed tolerance
+        r4 = _req(op="b", rtol=1e-6)             # different operator
+        batches = coalesce([r1, r3, r2, r4], max_k=8)
+        assert [len(b) for b in batches] == [2, 1, 1]
+        assert batches[0] == [r1, r2]            # FIFO within the group
+        assert batches[1] == [r3] and batches[2] == [r4]
+
+    def test_atol_and_maxit_split_groups(self):
+        rs = [_req(atol=0.0), _req(atol=1e-12), _req(max_it=50)]
+        assert [len(b) for b in coalesce(rs, 8)] == [1, 1, 1]
+
+    def test_max_k_chunks_preserve_order(self):
+        rs = [_req() for _ in range(7)]
+        batches = coalesce(rs, max_k=3)
+        assert [len(b) for b in batches] == [3, 3, 1]
+        assert [r for b in batches for r in b] == rs
+
+    def test_padded_width(self):
+        assert padded_width(1, 64, True) == 1
+        assert padded_width(3, 64, True) == 4
+        assert padded_width(4, 64, True) == 4
+        assert padded_width(5, 8, True) == 8
+        assert padded_width(5, 4, True) == 5     # cap never truncates
+        assert padded_width(5, 64, False) == 5   # padding off
+
+
+# ------------------------------------------------------------ server basics
+class TestServerBasics:
+    def test_sync_solve_matches_direct_ksp(self, comm8):
+        A, Xt, B = _problem(k=1)
+        with SolveServer(comm8, window=0.0, max_k=4) as srv:
+            srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+            out = srv.solve("p", B[:, 0], timeout=120)
+        assert isinstance(out, ServedSolveResult)
+        assert out.converged and out.op == "p" and out.batch_width == 1
+        np.testing.assert_allclose(out.x, Xt[:, 0], atol=1e-6)
+        # the direct (non-served) solve agrees column-for-column
+        M = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL)
+        x, bv = M.get_vecs()
+        bv.set_global(B[:, 0])
+        ref = ksp.solve(bv, x)
+        np.testing.assert_allclose(out.x, x.to_numpy(), atol=1e-9)
+        assert out.iterations == ref.iterations
+
+    def test_async_futures_all_resolve(self, comm8):
+        A, Xt, B = _problem(k=6)
+        with SolveServer(comm8, window=0.05, max_k=8) as srv:
+            srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+            futs = [srv.submit("p", B[:, j]) for j in range(6)]
+            res = [f.result(180) for f in futs]
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+
+    def test_validation_errors(self, comm8):
+        A, _, B = _problem()
+        with SolveServer(comm8, window=0.0) as srv:
+            srv.register_operator("p", A)
+            with pytest.raises(ValueError, match="unknown operator"):
+                srv.submit("nope", B[:, 0])
+            with pytest.raises(ValueError, match="must be"):
+                srv.submit("p", B[:, 0][:-1])
+            with pytest.raises(ValueError, match="already registered"):
+                srv.register_operator("p", A)
+
+    def test_submit_after_shutdown_raises(self, comm8):
+        A, _, B = _problem()
+        srv = SolveServer(comm8, window=0.0)
+        srv.register_operator("p", A)
+        srv.shutdown()
+        with pytest.raises(ServerClosedError):
+            srv.submit("p", B[:, 0])
+
+    def test_session_defaults_survive_per_request_override(self, comm8):
+        """A loose per-request override must not bleed into later
+        no-override requests: submit reads the REGISTERED defaults, not
+        the session KSP's (traffic-mutated) tolerances."""
+        A, Xt, B = _problem(k=2)
+        with SolveServer(comm8, window=0.0) as srv:
+            srv.register_operator("p", A, pc_type="jacobi", rtol=1e-10)
+            srv.solve("p", B[:, 0], timeout=120, rtol=1e-3)
+            r = srv.solve("p", B[:, 1], timeout=120)   # default again
+        assert r.converged
+        np.testing.assert_allclose(r.x, Xt[:, 1], atol=1e-8)
+
+    def test_submitted_rhs_buffer_can_be_reused(self, comm8):
+        """submit() copies the RHS: a client reusing one buffer across
+        async submissions must get each submission's values, not the
+        buffer's final content."""
+        A, Xt, B = _problem(k=2)
+        srv = SolveServer(comm8, window=0.0, autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        buf = B[:, 0].copy()
+        f1 = srv.submit("p", buf)
+        buf[:] = B[:, 1]                 # overwrite before dispatch
+        f2 = srv.submit("p", buf)
+        srv.start()
+        r1, r2 = f1.result(180), f2.result(180)
+        srv.shutdown()
+        np.testing.assert_allclose(r1.x, Xt[:, 0], atol=1e-6)
+        np.testing.assert_allclose(r2.x, Xt[:, 1], atol=1e-6)
+
+    def test_per_request_tolerance_override(self, comm8):
+        A, Xt, B = _problem(k=2)
+        with SolveServer(comm8, window=0.0) as srv:
+            srv.register_operator("p", A, rtol=1e-3)
+            loose = srv.solve("p", B[:, 0], timeout=120)
+            tight = srv.solve("p", B[:, 1], timeout=120, rtol=1e-10)
+        assert loose.converged and tight.converged
+        assert tight.iterations > loose.iterations
+        np.testing.assert_allclose(tight.x, Xt[:, 1], atol=1e-8)
+
+
+# -------------------------------------------------------------- coalescing
+class TestCoalescingBehavior:
+    def test_burst_coalesces_into_one_padded_block(self, comm8):
+        """autostart=False gives a deterministic window: every request
+        enqueued before start() rides ONE block (padded 5 -> 8)."""
+        A, Xt, B = _problem(k=5)
+        srv = SolveServer(comm8, window=0.0, max_k=8, pad_pow2=True,
+                          autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        futs = [srv.submit("p", B[:, j]) for j in range(5)]
+        srv.start()
+        res = [f.result(180) for f in futs]
+        srv.shutdown()
+        st = srv.stats()
+        assert st["width_hist"] == {5: 1} and st["batches"] == 1
+        assert st["padded_cols"] == 3            # 5 padded to 8
+        for j, r in enumerate(res):
+            assert r.converged and r.batch_width == 5
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+
+    def test_mixed_tolerances_never_batch(self, comm8):
+        A, Xt, B = _problem(k=4)
+        srv = SolveServer(comm8, window=0.0, max_k=8, autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi")
+        futs = ([srv.submit("p", B[:, j], rtol=1e-6) for j in (0, 1)]
+                + [srv.submit("p", B[:, j], rtol=1e-10) for j in (2, 3)])
+        srv.start()
+        res = [f.result(180) for f in futs]
+        srv.shutdown()
+        # two dispatches of width 2 — one per tolerance class
+        assert srv.stats()["width_hist"] == {2: 2}
+        assert all(r.converged for r in res)
+        assert {r.batch_width for r in res} == {2}
+        # the tight group actually solved tighter
+        assert min(r.iterations for r in res[2:]) > \
+            max(r.iterations for r in res[:2])
+
+    def test_request_arriving_mid_flight_lands_in_next_window(self, comm8):
+        A, Xt, B = _problem(k=2)
+        seen = []
+        started = threading.Event()
+        release = threading.Event()
+
+        def hook(reqs):
+            seen.append(list(reqs))
+            started.set()
+            if len(seen) == 1:          # block only the FIRST dispatch
+                assert release.wait(60)
+
+        srv = SolveServer(comm8, window=0.0, max_k=8, autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        srv._dispatch_hook = hook
+        f1 = srv.submit("p", B[:, 0])
+        srv.start()
+        assert started.wait(60)
+        # the dispatcher is now mid-flight on [f1]: this request must
+        # land in the NEXT window, never join the in-flight block
+        f2 = srv.submit("p", B[:, 1])
+        release.set()
+        r1, r2 = f1.result(180), f2.result(180)
+        srv.shutdown()
+        assert [len(b) for b in seen] == [1, 1]
+        assert seen[0][0].future is f1 and seen[1][0].future is f2
+        assert r1.converged and r2.converged
+
+    def test_shutdown_flushes_pending_futures(self, comm8):
+        A, Xt, B = _problem(k=3)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        futs = [srv.submit("p", B[:, j]) for j in range(3)]
+        srv.shutdown(wait=True)       # never started: flushes inline
+        for j, f in enumerate(futs):
+            r = f.result(0)           # already resolved
+            assert r.converged
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+
+    def test_shutdown_nowait_fails_pending(self, comm8):
+        A, _, B = _problem(k=2)
+        srv = SolveServer(comm8, window=0.0, autostart=False)
+        srv.register_operator("p", A)
+        futs = [srv.submit("p", B[:, j]) for j in range(2)]
+        srv.shutdown(wait=False)
+        for f in futs:
+            with pytest.raises(ServerClosedError):
+                f.result(0)
+
+    def test_drain_returns_with_empty_queue(self, comm8):
+        A, _, B = _problem(k=1)
+        with SolveServer(comm8, window=0.0) as srv:
+            srv.register_operator("p", A, rtol=RTOL)
+            f = srv.submit("p", B[:, 0])
+            assert srv.drain(timeout=180)
+            assert f.done()
+            # server still open after drain
+            assert srv.solve("p", B[:, 0], timeout=120).converged
+
+
+# -------------------------------------------------------------- resilience
+class TestServingResilience:
+    def test_worker_crash_mid_batch_recovers(self, comm8):
+        A, Xt, B = _problem(k=4, seed=3)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False,
+                          retry_policy=_fast_policy())
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        with tps.inject_faults("ksp.program=unavailable:at=1:iter=4"):
+            futs = [srv.submit("p", B[:, j]) for j in range(4)]
+            srv.start()
+            res = [f.result(300) for f in futs]
+        srv.shutdown()
+        for j, r in enumerate(res):
+            assert r.converged and r.attempts == 2, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+        kinds = [e.kind for e in res[0].recovery_events]
+        assert kinds == ["fault", "checkpoint", "backoff", "resume"]
+
+    def test_poisoned_request_does_not_contaminate_batch(self, comm8):
+        """A silent bitflip lands in ONE column of the coalesced block;
+        the ABFT guard detects it, the resilient dispatch rolls back to
+        the verified iterates and re-enters, and EVERY batch-mate's
+        answer passes the independent final re-verification."""
+        A, Xt, B = _problem(k=4, seed=4)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False,
+                          retry_policy=_fast_policy())
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL,
+                              abft=True)
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            futs = [srv.submit("p", B[:, j]) for j in range(4)]
+            srv.start()
+            res = [f.result(600) for f in futs]
+        srv.shutdown()
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+        assert res[0].sdc_detections == 1
+        kinds = [e.kind for e in res[0].recovery_events]
+        assert kinds == ["fault", "checkpoint", "rollback", "resume",
+                         "verify"]
+
+    def test_non_retriable_failure_reaches_futures(self, comm8):
+        """A dispatch failure the policy cannot retry must resolve the
+        waiting futures with the error — never hang the dispatcher."""
+        A, _, B = _problem(k=2)
+        srv = SolveServer(comm8, window=0.0, autostart=False,
+                          retry_policy=_fast_policy())
+        srv.register_operator("p", A, rtol=RTOL)
+        with tps.inject_faults("ksp.solve=oom"):
+            futs = [srv.submit("p", B[:, j]) for j in range(2)]
+            srv.start()
+            errs = []
+            for f in futs:
+                with pytest.raises(DeviceExecutionError) as ei:
+                    f.result(120)
+                errs.append(ei.value)
+        assert all(e.failure_class == "oom" for e in errs)
+        # the dispatcher survived: a later request still solves
+        assert srv.solve("p", B[:, 0], timeout=120).converged
+        srv.shutdown()
+
+
+# ---------------------------------------------------------- stats / options
+class TestStatsAndOptions:
+    def test_stats_and_log_view_row(self, comm8, capsys):
+        profiling.clear_events()
+        A, _, B = _problem(k=3)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        srv.register_operator("p", A, rtol=RTOL)
+        futs = [srv.submit("p", B[:, j]) for j in range(3)]
+        srv.start()
+        [f.result(180) for f in futs]
+        srv.shutdown()
+        st = srv.stats()
+        assert st["requests"] == 3 and st["batches"] == 1
+        assert st["mean_width"] == 3.0
+        assert st["queue_wait_p99_s"] >= st["queue_wait_p50_s"] >= 0.0
+        # the process-wide profiling twin feeds the -log_view row
+        ps = profiling.serving_stats()
+        assert ps["batches"] >= 1 and ps["width_hist"].get(3) >= 1
+        import sys
+        profiling.log_view(file=sys.stdout)
+        out = capsys.readouterr().out
+        assert "solve server:" in out and "coalesced dispatch" in out
+
+    def test_options_flags_configure_server(self, comm8):
+        opt = tps.global_options()
+        opt.set("solve_server_window", "0.25")
+        opt.set("solve_server_max_k", "16")
+        opt.set("solve_server_pad_pow2", "false")
+        opt.set("solve_server_resilient", "false")
+        opt.set("solve_server_retry_delay", "0.125")
+        srv = SolveServer(comm8, window=0.001, max_k=4, autostart=False)
+        assert srv.window == 0.25 and srv.max_k == 16
+        assert srv.pad_pow2 is False and srv.resilient is False
+        assert srv.retry_policy.base_delay == 0.125
+        srv.shutdown()
+
+    def test_serving_retry_policy_defaults(self):
+        pol = tps.RetryPolicy.serving()
+        assert pol.base_delay == 0.05 and pol.max_delay == 1.0
+        assert "detected_sdc" in pol.retriable_classes

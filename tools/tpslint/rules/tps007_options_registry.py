@@ -1,7 +1,8 @@
 """TPS007 — options-flag registry check (ROADMAP, deferred from the
 initial rule set; landed alongside the -ksp_abft* flag family).
 
-Every ``-ksp_*``/``-eps_*``/``-pc_*``/``-svd_*``/``-st_*`` flag read from
+Every ``-ksp_*``/``-eps_*``/``-pc_*``/``-svd_*``/``-st_*``/
+``-solve_server_*`` flag read from
 the options database (``utils/options.py`` getters: ``get``,
 ``get_string``, ``get_int``, ``get_real``, ``get_bool``, ``has``) must
 appear in the documented ``utils/options.KNOWN_FLAGS`` registry: a typo'd
@@ -30,8 +31,9 @@ from .base import Rule, register
 #: options-database getter method names whose first argument is a flag key
 _GETTERS = ("get", "get_string", "get_int", "get_real", "get_bool", "has")
 
-#: flag-name shape the registry governs (solver-object prefixes only)
-_FLAG_RE = re.compile(r"^(ksp|eps|pc|svd|st)_[a-z0-9_]+$")
+#: flag-name shape the registry governs (solver-object prefixes, plus
+#: the serving layer's -solve_server_* family)
+_FLAG_RE = re.compile(r"^(ksp|eps|pc|svd|st|solve_server)_[a-z0-9_]+$")
 
 _OPTIONS_REL = Path("mpi_petsc4py_example_tpu") / "utils" / "options.py"
 
