@@ -211,6 +211,28 @@ class StencilPoisson3D:
         carries its state in."""
         return (self.lz, self.ny, self.nx)
 
+    def local_apply_grid3(self, comm: DeviceComm):
+        """3D-native local stencil apply ``u (lz,ny,nx) -> A u`` — the
+        body of :meth:`local_spmv` WITHOUT the flat reshapes, for loop
+        builders that keep grid-shaped carries but do not want the fused
+        ``<u, Au>`` reduction (the pipelined CG plan: its single stacked
+        psum reduces different inner products, so the fused-dot kernel's
+        internal psum would be a second reduce site)."""
+        nx, ny, lz = self.nx, self.ny, self.lz
+        from ..ops.pallas_stencil import (pallas_supported,
+                                          stencil3d_apply_pallas)
+        use_pallas = pallas_supported(ny, nx, self._dtype, comm.platform)
+        exchange = self._halo_exchange(comm)
+
+        def apply3(op_local, u):
+            halo_lo, halo_hi = exchange(u)
+            if use_pallas:
+                return stencil3d_apply_pallas(u, halo_lo[None],
+                                              halo_hi[None], lz, ny, nx)
+            return self._stencil7_jnp(u, halo_lo, halo_hi)
+
+        return apply3
+
     def local_matvec_dot(self, comm: DeviceComm):
         """Fused local ``u (lz,ny,nx) -> (A u, psum <u, A u>)`` for the CG
         fast path — 3D in AND out.

@@ -243,7 +243,7 @@ class SolveServer:
         # sequential solves (KSP.solve_many's fallback routing) — results
         # stay correct, the serving throughput win evaporates. Say so.
         from ..solvers.krylov import batched_pc_supported
-        if (ksp.get_type() != "cg"
+        if (ksp.get_type() not in ("cg", "pipecg")
                 or not batched_pc_supported(ksp.get_pc())):
             import warnings
             warnings.warn(

@@ -1,0 +1,694 @@
+"""Composable CG loop-body plans (ROADMAP items 2+5, landed together).
+
+``krylov.py`` used to carry five hand-fused copies of the CG recurrence
+(plain / stencil / many / guarded / guarded-many), and every new axis —
+pipelined, batched, guarded, grid-shaped — multiplied the matrix again.
+This module factors the recurrence into orthogonal *plans* assembled into
+ONE ``lax.while_loop`` body per recurrence family:
+
+* **operator-apply plan** — the (possibly fused-dot) operator closure:
+  ``A(v)`` for general operators, ``Adot(v) -> (Av, psum<v,Av>)`` for the
+  VMEM-resident stencil fast path;
+* **PC plan** — how the preconditioned direction is produced: a
+  materialized ``z = M r``, the scalar uniform-diagonal identity
+  (``z = r/diag`` never materialized), or the 3D-native V-cycle ``M3``;
+* **reduction plan** — how the iteration's inner products map onto psum
+  SITES: classic 3-site (2 under the natural norm), the fused 2-site
+  stacked pair, the guarded 2-site phases with the ABFT partials folded
+  in, or the PIPELINED 1-site plan (:func:`pipelined_cg_loop`) whose one
+  stacked psum is overlapped against the next SpMV/PC apply;
+* **guard plan** — ``None``, or the silent-corruption bookkeeping
+  (NaN/monotonicity sentinels, periodic true-residual replacement with
+  the drift gate, ``det``/``rrc``/verified-iterate outputs);
+* **batching plan** — :class:`SingleBatch` / :class:`ManyBatch`: scalar
+  broadcasting, per-column mask selects, and loop-condition aggregation.
+
+The assembled bodies reproduce the retired kernels' arithmetic exactly
+(masked selects with an always-true mask are the identity), so iteration
+counts, reasons, and the collective-volume gates are unchanged — and
+pipelined CG (Ghysels & Vanroose; PETSc's KSPPIPECG slot) lands as a new
+reduction plan rather than a sixth kernel family.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# shared numeric helpers (moved here from krylov.py so both modules — and
+# every plan — read ONE definition; krylov re-exports them unchanged)
+# ---------------------------------------------------------------------------
+
+
+def _dmax(rnorm0, dtol):
+    """Divergence ceiling: ``dtol * rnorm0`` — the INITIAL residual norm, as
+    in PETSc's KSPConvergedDefault DIVERGED_DTOL test (a merely-large initial
+    guess must not trigger instant divergence). ``dtol`` None/<=0 disables."""
+    if dtol is None:
+        return jnp.inf
+    return jnp.where(dtol > 0, dtol * rnorm0, jnp.inf)
+
+
+def _tol(pnorm, b, rtol, atol):
+    bnorm = pnorm(b)
+    return bnorm, jnp.maximum(rtol * bnorm, atol)
+
+
+def _nat(rz):
+    """KSP_NORM_NATURAL: sqrt <r, M r> — the scalar the CG-family
+    recurrences already carry (real by construction for the SPD/Hermitian
+    operators these types require)."""
+    return jnp.sqrt(jnp.maximum(jnp.real(rz), 0.0))
+
+
+def _reason(rnorm, tol, atol, k, maxit, brk, dmax=None):
+    from ..utils.convergence import ConvergedReason as CR
+    diverged = (CR.DIVERGED_MAX_IT if dmax is None else
+                jnp.where(rnorm >= dmax, CR.DIVERGED_DTOL,
+                          CR.DIVERGED_MAX_IT))
+    return jnp.where(
+        brk, CR.DIVERGED_BREAKDOWN,
+        jnp.where(rnorm <= tol,
+                  jnp.where(rnorm <= atol, CR.CONVERGED_ATOL,
+                            CR.CONVERGED_RTOL),
+                  diverged)).astype(jnp.int32)
+
+
+def _no_hist(dtype):
+    """Zero-size placeholder carried when monitoring is off — compiled
+    away entirely, but keeps every kernel's carry structure uniform."""
+    return jnp.zeros((0,), jnp.real(jnp.zeros((), dtype)).dtype)
+
+
+def _hist0(monitor, dtype):
+    """The history carry every kernel threads through its loop: the real
+    recorder when monitoring, a zero-size placeholder otherwise."""
+    return monitor.init() if monitor is not None else _no_hist(dtype)
+
+
+def _mon0(monitor, rn0, dtype):
+    """Build the history carry and record the iteration-0 (initial)
+    residual norm. petsc4py's monitors and KSPSetResidualHistory include
+    it — history length is iterations+1, and drivers index history[0] for
+    the starting norm."""
+    hist = _hist0(monitor, dtype)
+    if monitor is not None:
+        return monitor(hist, jnp.int32(0), rn0)
+    return hist
+
+
+# ---------------------------------------------------------------------------
+# silent-data-corruption detector codes + thresholds (single source; the
+# guarded plans and solvers/ksp.py both read these via krylov's re-export)
+# ---------------------------------------------------------------------------
+
+SDC_NONE, SDC_ABFT, SDC_ABFT_PC, SDC_DRIFT, SDC_NAN, SDC_MONO = range(6)
+SDC_DETECTOR_NAMES = {SDC_ABFT: "abft", SDC_ABFT_PC: "abft_pc",
+                      SDC_DRIFT: "drift", SDC_NAN: "nan",
+                      SDC_MONO: "monotonic"}
+
+# monotonicity sentinel: a residual norm this far above the best seen so
+# far is beyond any healthy CG transient (bounded by sqrt(cond(A)))
+_SDC_MONO_FACTOR = 1e4
+# drift gate: recurrence-vs-true relative mismatch beyond this fraction
+# (plus a rounding floor of _SDC_DRIFT_FLOOR_EPS * eps * ||b||) flags SDC
+_SDC_DRIFT_REL = 0.25
+_SDC_DRIFT_FLOOR_EPS = 1024.0
+
+
+def _det4(badA, badM, badnan, badmono):
+    """First-detector-wins detection code (elementwise for batched)."""
+    return jnp.where(
+        badA, SDC_ABFT,
+        jnp.where(badM, SDC_ABFT_PC,
+                  jnp.where(badnan, SDC_NAN,
+                            jnp.where(badmono, SDC_MONO,
+                                      SDC_NONE)))).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# batching plans
+# ---------------------------------------------------------------------------
+
+
+class SingleBatch:
+    """One RHS: scalars are scalars, the continuation mask broadcasts
+    trivially, and the loop condition is the mask itself."""
+
+    many = False
+
+    def ex(self, s):
+        return s
+
+    def agg(self, m):
+        return m
+
+
+class ManyBatch:
+    """``nrhs`` lockstep recurrences: per-column ``(nrhs,)`` scalars, a
+    column mask broadcast against the vector-block layout, and the loop
+    running until the LAST active column exits.
+
+    ``layout='cols'`` is the flat ``(lsize, nrhs)`` block (mask/scalars
+    expand as ``s[None, :]``); ``layout='slabs'`` the grid-shaped
+    ``(nrhs, lz, ny, nx)`` stencil block (``s[:, None, None, None]``).
+    """
+
+    many = True
+
+    def __init__(self, layout: str = "cols"):
+        if layout not in ("cols", "slabs"):
+            raise ValueError(f"unknown ManyBatch layout {layout!r}")
+        self._cols = layout == "cols"
+
+    def ex(self, s):
+        return s[None, :] if self._cols else s[:, None, None, None]
+
+    def agg(self, m):
+        return jnp.any(m)
+
+
+def _false_like(rn):
+    return jnp.zeros(jnp.shape(rn), bool)
+
+
+def _it0(rn):
+    return jnp.zeros(jnp.shape(rn), jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# the pipelined plan's single reduce site (test-injection seam)
+# ---------------------------------------------------------------------------
+
+
+def fuse_psum(parts, psum, axis, dtype):
+    """ONE stacked collective for ALL of a pipelined iteration's scalar
+    reductions — the 1-reduce-site contract of the pipelined plan.
+
+    Kept as a module-level seam on purpose: the collective-volume gate's
+    injected-regression test monkeypatches this into a two-psum split to
+    prove the one-site assert has teeth. ``parts`` may be per-column
+    ``(nrhs,)`` rows; everything is cast to the operator scalar so the
+    stack is homogeneous (the callers re-take real parts of norms)."""
+    return psum(jnp.stack([jnp.asarray(q, dtype) for q in parts]), axis)
+
+
+# ---------------------------------------------------------------------------
+# classic CG: one while_loop body serving plain/stencil/many/guarded
+# ---------------------------------------------------------------------------
+
+
+def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
+                    A=None, M=None, Adot=None, inv_diag=None, M3=None,
+                    pdot=None, pnorm=None, pduo=None, guard=None,
+                    bp=None, monitor=None, unroll=1, natural=False):
+    """Assemble and run the classic (two-phase) CG recurrence.
+
+    Plan axes (module docstring): the operator plan is ``A`` or the fused
+    ``Adot``; the PC plan is ``M`` (materialized z), ``inv_diag`` (scalar
+    uniform-diagonal identity) or ``M3`` (3D-native V-cycle); the
+    reduction plan is implied by what is supplied — plain ``pdot``/
+    ``pnorm`` (3 sites; 2 under ``natural``), the stacked ``pduo`` pair
+    (2 sites), or a ``guard`` namespace whose ``p1``/``p2``/
+    ``p2_stencil`` phases carry the folded ABFT partials (2 sites);
+    ``bp`` is the batching plan. Per-column masked freezing, unrolled
+    multi-step dispatch, and the guard's replacement/rollback bookkeeping
+    are all specializations of this one body.
+
+    Returns the retired kernels' exact output tuples:
+    ``(x, it, rnorm, reason, hist)`` and, guarded,
+    ``(..., det, rrc, xv)``.
+    """
+    bp = bp or SingleBatch()
+    g = guard
+    stencil = Adot is not None
+    carry_z = not stencil
+
+    # ---- init: initial residual + the plan's init reductions ---------------
+    if stencil:
+        if g is not None:
+            r = b - Adot(x0)[0]
+            bnorm, rnorm, badA0 = g.init(b, r, x0)
+            rz = rnorm * rnorm * inv_diag
+            p = r * inv_diag
+            badM0 = _false_like(rnorm)
+        else:
+            bnorm = pnorm(b)
+            r = b - Adot(x0)[0]
+            rr0 = pdot(r, r)
+            rnorm = jnp.sqrt(rr0)
+            if M3 is None:
+                rz = rr0 * inv_diag
+                p = r * inv_diag
+            else:
+                z0 = M3(r)
+                rz = pdot(r, z0)
+                p = z0
+        tol = jnp.maximum(rtol * bnorm, atol)
+        brk0 = _false_like(rnorm)
+        z = None
+    else:
+        r = b - A(x0)
+        if g is not None:
+            bnorm, badA0 = g.init(b, r, x0)
+            z = M(r)
+            rz, rn2, badM0 = g.p2(r, z)
+            rnorm = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+            p = z
+            tol = jnp.maximum(rtol * bnorm, atol)
+            brk0 = _false_like(rnorm)
+        else:
+            z = M(r)
+            p = z
+            rz = pdot(r, z)
+            if natural:
+                rnorm = _nat(rz)
+                tol = jnp.maximum(rtol * rnorm, atol)
+                # a negative <r, M r> means M (or A) is indefinite — the
+                # natural norm is undefined there; flag breakdown instead
+                # of letting the 0-clamped norm fake instant convergence
+                brk0 = jnp.real(rz) < 0
+            else:
+                bnorm, tol = _tol(pnorm, b, rtol, atol)
+                rnorm = pnorm(r)
+                brk0 = _false_like(rnorm)
+    dmax = _dmax(rnorm, dtol)
+    hist = _mon0(monitor, rnorm, b.dtype)
+
+    st0 = dict(it=_it0(rnorm), x=x0, r=r, p=p, rz=rz, rn=rnorm, brk=brk0,
+               hist=hist)
+    if carry_z:
+        st0["z"] = z
+    if g is not None:
+        drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
+        st0.update(det=_det4(badA0, badM0, ~jnp.isfinite(rnorm),
+                             _false_like(rnorm)),
+                   rrc=_it0(rnorm), xv=x0, rnb=rnorm)
+        if bp.many:
+            # the lockstep STEP counter the replacement interval runs on
+            # (per-column iteration counts diverge once columns freeze)
+            st0["ks"] = jnp.int32(0)
+
+    def active(st):
+        live = ((st["rn"] > tol) & (st["rn"] < dmax) & (st["it"] < maxit)
+                & ~st["brk"])
+        if g is not None:
+            live = live & (st["det"] == SDC_NONE)
+        return live
+
+    def cond(st):
+        return bp.agg(active(st))
+
+    def step(st):
+        cont = active(st)
+        cm = bp.ex(cont)
+        it, x, r, p, rz = st["it"], st["x"], st["r"], st["p"], st["rz"]
+
+        # ---- operator apply + reduction phase 1 ----
+        if stencil:
+            Ap, pAp = Adot(p)                  # fused matvec+dot (1 psum)
+            badA = None
+        elif g is not None:
+            Ap = A(p)
+            pAp, badA = g.p1(p, Ap)            # stacked phase 1 + A-ABFT
+        else:
+            Ap = A(p)
+            pAp = pdot(p, Ap)                  # reduction phase 1
+            badA = None
+        brk_new = cont & (pAp == 0)
+        alpha = jnp.where(pAp == 0, 0.0,
+                          rz / jnp.where(pAp == 0, 1.0, pAp))
+        # frozen steps/columns SELECT the old state rather than multiplying
+        # by a zero gate: once a diverging active step has produced
+        # inf/NaN, 0 * inf = NaN would destroy the preserved iterate
+        al = bp.ex(alpha)
+        x = jnp.where(cm, x + al * p, x)
+        r = jnp.where(cm, r - al * Ap, r)
+
+        # ---- PC apply + reduction phase 2 ----
+        z = None
+        badM = None
+        if stencil:
+            if g is not None:
+                rr, badA = g.p2_stencil(r, p, Ap)   # fused phase 2 + ABFT
+                rz_new = rr * inv_diag
+                zdir = r * inv_diag
+                rn_new = jnp.sqrt(rr)
+            elif M3 is not None:
+                rr = pdot(r, r)
+                zn = M3(r)
+                rz_new = pdot(r, zn)
+                zdir = zn
+                rn_new = jnp.sqrt(rr)
+            else:
+                rr = pdot(r, r)
+                rz_new = rr * inv_diag
+                zdir = r * inv_diag
+                rn_new = jnp.sqrt(rr)
+        else:
+            z = jnp.where(cm, M(r), st["z"])
+            zdir = z
+            if g is not None:
+                rz_new, rn2, badM = g.p2(r, z)      # stacked phase 2
+                rn_new = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
+            elif pduo is not None:
+                rz_new, rr = pduo(r, z)             # fused (rz, rr) pair
+                rn_new = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))
+            else:
+                rz_new = pdot(r, z)                 # reduction phase 2
+                rn_new = None                       # phase 3 / natural below
+        if natural and g is None and not stencil:
+            brk_new = brk_new | (cont & (jnp.real(rz_new) < 0))
+        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
+        p = jnp.where(cm, zdir + bp.ex(beta) * p, p)
+        rz = jnp.where(cont, rz_new, rz)
+        if rn_new is None:
+            rn_new = _nat(rz_new) if natural else pnorm(r)
+        rn = jnp.where(cont, rn_new, st["rn"])
+        it = it + cont.astype(jnp.int32)
+
+        st2 = dict(it=it, x=x, r=r, p=p, rz=rz, rn=rn,
+                   brk=st["brk"] | brk_new, hist=st["hist"])
+        if carry_z:
+            st2["z"] = z
+
+        # ---- guard plan: sentinels + periodic replacement ----
+        if g is not None:
+            if bp.many:
+                badnan = cont & ~jnp.isfinite(rn)
+                badmono = cont & jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
+                                                     * st["rnb"])
+                rnb = jnp.where(cont & jnp.isfinite(rn),
+                                jnp.minimum(st["rnb"], rn), st["rnb"])
+                # STICKY per-column detection: a frozen column's code must
+                # survive later passes (cont masks its checks once frozen)
+                badA_m = cont & badA if badA is not None else badnan & False
+                badM_m = cont & badM if badM is not None else badnan & False
+                det = jnp.where(st["det"] == SDC_NONE,
+                                _det4(badA_m, badM_m, badnan, badmono),
+                                st["det"])
+                ks = st["ks"] + 1
+                clean = det == SDC_NONE
+                do_rr = (jnp.any(cont & clean) & (g.rr_n > 0)
+                         & (ks % jnp.maximum(g.rr_n, 1) == 0))
+                st2["ks"] = ks
+            else:
+                badnan = ~jnp.isfinite(rn)
+                badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
+                                              * st["rnb"])
+                rnb = jnp.where(jnp.isfinite(rn),
+                                jnp.minimum(st["rnb"], rn), st["rnb"])
+                fA = badA if badA is not None else badnan & False
+                fM = badM if badM is not None else badnan & False
+                det = _det4(fA, fM, badnan, badmono)
+                clean = det == SDC_NONE
+                do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
+                         & (it % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
+            st2["rnb"] = rnb
+
+            def replace(args):
+                x, r, z, p, rz, rn, rrc, xv = args
+                if stencil:
+                    rt = b - Adot(x)[0]
+                    rtn2 = g.vnorm2(rt)            # plain-psum verifier
+                    rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+                else:
+                    rt = b - A(x)
+                    zt = M(rt)
+                    rtn2, rzt = g.vpair(rt, zt)    # plain-psum verifier
+                    rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+                drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
+                         + drift_floor)
+                ok = (cont & clean & ~drift) if bp.many else ~drift
+                okm = bp.ex(ok)
+                # replacement restarts the direction from the true
+                # residual, bounding recurrence drift; the passing iterate
+                # is promoted to the rollback target xv
+                r = jnp.where(okm, rt, r)
+                if stencil:
+                    p = jnp.where(okm, rt * inv_diag, p)
+                    rz = jnp.where(ok, rtn2 * inv_diag, rz)
+                else:
+                    z = jnp.where(okm, zt, z)
+                    p = jnp.where(okm, zt, p)
+                    rz = jnp.where(ok, rzt, rz)
+                rn = jnp.where(ok, rtn, rn)
+                xv = jnp.where(okm, x, xv)
+                rrc = rrc + ok.astype(jnp.int32)
+                bad = (cont & clean & drift) if bp.many else drift
+                det_rr = jnp.where(bad, SDC_DRIFT,
+                                   SDC_NONE).astype(jnp.int32)
+                return (x, r, z, p, rz, rn, rrc, xv, det_rr)
+
+            def keep(args):
+                x, r, z, p, rz, rn, rrc, xv = args
+                return (x, r, z, p, rz, rn, rrc, xv,
+                        jnp.zeros(jnp.shape(rn), jnp.int32))
+
+            zc = z if carry_z else jnp.zeros((0,), b.dtype)
+            x, r, zc, p, rz, rn, rrc, xv, det_rr = lax.cond(
+                do_rr, replace, keep,
+                (x, r, zc, p, rz, rn, st["rrc"], st["xv"]))
+            det = jnp.where(det == SDC_NONE, det_rr, det)
+            st2.update(x=x, r=r, p=p, rz=rz, rn=rn, det=det, rrc=rrc,
+                       xv=xv)
+            if carry_z:
+                st2["z"] = zc
+        if monitor is not None:
+            st2["hist"] = monitor(st2["hist"], it, st2["rn"])
+        return st2
+
+    def body(st):
+        for _ in range(max(1, int(unroll))):
+            st = step(st)
+        return st
+
+    st = lax.while_loop(cond, body, st0)
+    out = (st["x"], st["it"], st["rn"],
+           _reason(st["rn"], tol, atol, st["it"], maxit, st["brk"], dmax),
+           st["hist"])
+    if g is not None:
+        out = out + (st["det"], st["rrc"], st["xv"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pipelined CG: the 1-reduce-site reduction plan (Ghysels & Vanroose)
+# ---------------------------------------------------------------------------
+
+
+def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
+                      A=None, M=None, pnorm=None, fused=None,
+                      guard=None, bp=None, monitor=None):
+    """Assemble and run the pipelined (single-reduction) CG recurrence.
+
+    Ghysels–Vanroose pipelined CG ("Pipelined, Flexible Krylov Subspace
+    Methods", PAPERS.md): every inner product of the iteration —
+    ``gamma = <r, u>``, ``delta = <w, u>``, and the monitored
+    ``||r||^2`` — is computed from the CURRENT vectors and issued as ONE
+    stacked psum (``fused``; the :func:`fuse_psum` seam), while the next
+    iteration's operator/PC applies ``m = M w``, ``n = A m`` are
+    independent of the reduction results — XLA's async collectives
+    overlap the reduce with the SpMV, the latency-hiding the two-stage
+    multisplitting line of work gets from restructured communication.
+    The extra recurrences (``s = A p``, ``q = M s``, ``z = A M s``) trade
+    three more AXPYs for two fewer reduce sites and the overlap.
+
+    The monitored norm lags one iteration (``rr`` is reduced before the
+    update it gates), so convergence is detected one body later than
+    classic CG — iterates match CG to rounding, iteration counts run one
+    higher. The known residual-drift of the u/w recurrences is exactly
+    what the guard plan's periodic replacement bounds: the replacement
+    recomputes ``r``/``u``/``w`` from the iterate and zeroes the
+    direction recurrences (``gamma = 0`` restarts the beta chain).
+
+    ``fused(r, u, w)`` returns ``(gamma, delta, rr)``; guarded,
+    ``fused(r, u, w, chk)`` additionally reduces the PREVIOUS body's
+    locally-summed ABFT partials (``guard.chk_parts`` — checksum checks
+    of that body's fresh ``m = M w``/``n = A m`` applies, carried one
+    iteration) in the SAME single psum and returns
+    ``(gamma, delta, rr, badA, badM)``.
+    """
+    bp = bp or SingleBatch()
+    g = guard
+
+    r = b - A(x0)
+    if g is not None:
+        bnorm, badA0 = g.init(b, r, x0)
+    else:
+        bnorm = pnorm(b)
+    tol = jnp.maximum(rtol * bnorm, atol)
+    u = M(r)
+    w = A(u)
+    rn0 = pnorm(r)
+    dmax = _dmax(rn0, dtol)
+    hist = _mon0(monitor, rn0, b.dtype)
+    sc0 = jnp.zeros(jnp.shape(rn0), b.dtype)
+
+    # STACKED carries: the state block S = [w, u, r, x] and the direction
+    # block V = [z, q, s, p] each update in ONE fused AXPY kernel
+    # (S += alpha * sgn * V; V = C + beta * V) instead of eight separate
+    # recurrences — on dispatch-bound meshes the kernel count, not the
+    # bytes, is the per-iteration floor (measured ~15%/iter on the
+    # 8-virtual-device CPU mesh). ``sgn`` encodes the update directions
+    # (w/u/r subtract, x adds).
+    sgn = jnp.asarray([-1.0, -1.0, -1.0, 1.0],
+                      jnp.real(jnp.zeros((), b.dtype)).dtype
+                      ).reshape((4,) + (1,) * b.ndim)
+    S0 = jnp.stack([w, u, r, x0])
+    st0 = dict(it=_it0(rn0), S=S0, V=jnp.zeros_like(S0),
+               gamma=sc0, alpha=sc0, rn=rn0, brk=_false_like(rn0),
+               hist=hist)
+    if g is not None:
+        drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
+        st0.update(det=_det4(badA0, _false_like(rn0), ~jnp.isfinite(rn0),
+                             _false_like(rn0)),
+                   rrc=_it0(rn0), xv=x0, rnb=rn0,
+                   # the init applies' checksum partials, checked by the
+                   # FIRST body's stacked psum (one-iteration lag)
+                   chk=g.chk_init(r, u, w))
+        if bp.many:
+            st0["ks"] = jnp.int32(0)
+
+    def active(st):
+        live = ((st["rn"] > tol) & (st["rn"] < dmax) & (st["it"] < maxit)
+                & ~st["brk"])
+        if g is not None:
+            live = live & (st["det"] == SDC_NONE)
+        return live
+
+    def cond(st):
+        return bp.agg(active(st))
+
+    def body(st):
+        cont = active(st)
+        cm = bp.ex(cont)
+        S = st["S"]
+        w, u, r = S[0], S[1], S[2]
+        if g is not None:                      # the ONE reduce site
+            gamma, delta, rr, badA, badM = fused(r, u, w, st["chk"])
+        else:
+            gamma, delta, rr = fused(r, u, w)
+            badA = badM = None
+        # overlap work: both applies are independent of the reduction's
+        # results, so the collective hides behind them
+        m = M(w)
+        n = A(m)
+        if g is not None:
+            # this body's fresh-apply checksum partials, reduced by the
+            # NEXT body's stacked psum (w here is the pre-update M input)
+            chk_new = g.chk_parts(m, n, w)
+        # gamma==0 marks both the first iteration and a post-replacement
+        # restart (the guard zeroes the carry): the beta chain starts fresh
+        first = st["gamma"] == 0
+        gold = jnp.where(first, 1.0, st["gamma"])
+        beta = jnp.where(first, 0.0, gamma / gold)
+        aold = jnp.where(st["alpha"] == 0, 1.0, st["alpha"])
+        denom = jnp.where(first, delta, delta - beta * gamma / aold)
+        brk_new = cont & (denom == 0)
+        alpha = jnp.where(denom == 0, 0.0,
+                          gamma / jnp.where(denom == 0, 1.0, denom))
+        be, al = bp.ex(beta), bp.ex(alpha)
+        # V = [z, q, s, p] <- [n, m, w, u] + beta V ; then the state rows
+        # [w, u, r, x] -= / += alpha * V rows — two fused kernels total
+        V = jnp.where(cm, jnp.stack([n, m, w, u]) + be * st["V"], st["V"])
+        S = jnp.where(cm, S + al * (sgn * V), S)
+        # rr = <r, r> is real by construction; take the real part so the
+        # carried norm stays real-typed for complex operators
+        rn_new = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))
+        rn = jnp.where(cont, rn_new, st["rn"])
+        gamma_c = jnp.where(cont, gamma, st["gamma"])
+        alpha_c = jnp.where(cont, alpha, st["alpha"])
+        it = st["it"] + cont.astype(jnp.int32)
+
+        st2 = dict(it=it, S=S, V=V, gamma=gamma_c, alpha=alpha_c, rn=rn,
+                   brk=st["brk"] | brk_new, hist=st["hist"])
+
+        if g is not None:
+            if bp.many:
+                badnan = cont & ~jnp.isfinite(rn)
+                badmono = cont & jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
+                                                     * st["rnb"])
+                rnb = jnp.where(cont & jnp.isfinite(rn),
+                                jnp.minimum(st["rnb"], rn), st["rnb"])
+                det = jnp.where(st["det"] == SDC_NONE,
+                                _det4(cont & badA, cont & badM, badnan,
+                                      badmono),
+                                st["det"])
+                ks = st["ks"] + 1
+                clean = det == SDC_NONE
+                do_rr = (jnp.any(cont & clean) & (g.rr_n > 0)
+                         & (ks % jnp.maximum(g.rr_n, 1) == 0))
+                st2["ks"] = ks
+            else:
+                badnan = ~jnp.isfinite(rn)
+                badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
+                                              * st["rnb"])
+                rnb = jnp.where(jnp.isfinite(rn),
+                                jnp.minimum(st["rnb"], rn), st["rnb"])
+                det = _det4(badA, badM, badnan, badmono)
+                clean = det == SDC_NONE
+                do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
+                         & (it % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
+            st2["rnb"] = rnb
+
+            def replace(args):
+                S, V, gamma_c, alpha_c, rn, rrc, xv = args
+                x = S[3]
+                # full pipeline refill from the TRUE residual: the u/w
+                # recurrences (the pipelined drift source) are recomputed
+                # from scratch, the direction recurrences restart
+                rt = b - A(x)
+                ut = M(rt)
+                wt = A(ut)
+                # plain-psum verifier; the drift gate compares against the
+                # CURRENT recurrence residual (the carried norm lags one
+                # iteration — see _make_pipe_guard.vpair2)
+                rtn2, rc2 = g.vpair2(rt, S[2])
+                rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
+                rcur = jnp.sqrt(jnp.maximum(rc2, 0.0))
+                drift = (jnp.abs(rtn - rcur)
+                         > _SDC_DRIFT_REL * (rtn + rcur) + drift_floor)
+                ok = (cont & clean & ~drift) if bp.many else ~drift
+                okm = bp.ex(ok)
+                S = jnp.where(okm, jnp.stack([wt, ut, rt, x]), S)
+                V = jnp.where(okm, 0.0, V)
+                gamma_c = jnp.where(ok, 0.0, gamma_c)  # fresh beta chain
+                alpha_c = jnp.where(ok, 0.0, alpha_c)
+                rn = jnp.where(ok, rtn, rn)
+                xv = jnp.where(okm, x, xv)
+                rrc = rrc + ok.astype(jnp.int32)
+                bad = (cont & clean & drift) if bp.many else drift
+                det_rr = jnp.where(bad, SDC_DRIFT,
+                                   SDC_NONE).astype(jnp.int32)
+                return (S, V, gamma_c, alpha_c, rn, rrc, xv, det_rr)
+
+            def keep(args):
+                return args + (jnp.zeros(jnp.shape(args[4]), jnp.int32),)
+
+            (S, V, gamma_c, alpha_c, rn, rrc, xv, det_rr) = lax.cond(
+                do_rr, replace, keep,
+                (S, V, gamma_c, alpha_c, rn, st["rrc"], st["xv"]))
+            det = jnp.where(det == SDC_NONE, det_rr, det)
+            st2.update(S=S, V=V, gamma=gamma_c, alpha=alpha_c, rn=rn,
+                       det=det, rrc=rrc, xv=xv, chk=chk_new)
+        if monitor is not None:
+            st2["hist"] = monitor(st2["hist"], it, st2["rn"])
+        return st2
+
+    st = lax.while_loop(cond, body, st0)
+    xf = st["S"][3]
+    # the monitored norm lags one iteration; report the exact final
+    # residual (plain psum — the verifier channel, outside the loop) while
+    # judging the reason on the norm the loop actually tested
+    if g is not None:
+        rn_true = jnp.sqrt(jnp.maximum(g.vnorm2(b - A(xf)), 0.0))
+    else:
+        rn_true = pnorm(b - A(xf))
+    out = (xf, st["it"], rn_true,
+           _reason(st["rn"], tol, atol, st["it"], maxit, st["brk"], dmax),
+           st["hist"])
+    if g is not None:
+        out = out + (st["det"], st["rrc"], st["xv"])
+    return out

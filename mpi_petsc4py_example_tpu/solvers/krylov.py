@@ -33,6 +33,15 @@ from ..resilience import abft as _abft
 from ..utils.dtypes import is_complex
 from ..parallel.mesh import DeviceComm, faulted_psum
 from ..utils.convergence import ConvergedReason as CR
+from . import cg_plans as _plans
+# shared numeric helpers + SDC detector codes live in cg_plans (the plan
+# assemblies and this module's non-CG kernels read ONE definition);
+# re-imported here so every existing import site keeps working
+from .cg_plans import (SDC_NONE, SDC_ABFT, SDC_ABFT_PC, SDC_DRIFT, SDC_NAN,
+                       SDC_MONO, SDC_DETECTOR_NAMES, _det4,
+                       _SDC_MONO_FACTOR, _SDC_DRIFT_REL,
+                       _SDC_DRIFT_FLOOR_EPS, _dmax, _tol, _nat, _reason,
+                       _no_hist, _hist0, _mon0)
 
 
 # ---------------------------------------------------------------------------
@@ -45,32 +54,6 @@ from ..utils.convergence import ConvergedReason as CR
 # true-residual verification epilogue stays on plain lax.psum on purpose —
 # a corrupted verifier would make the gate lie about recovery.
 _psum = faulted_psum
-
-
-def _dmax(rnorm0, dtol):
-    """Divergence ceiling: ``dtol * rnorm0`` — the INITIAL residual norm, as
-    in PETSc's KSPConvergedDefault DIVERGED_DTOL test (a merely-large initial
-    guess must not trigger instant divergence). ``dtol`` None/<=0 disables."""
-    if dtol is None:
-        return jnp.inf
-    return jnp.where(dtol > 0, dtol * rnorm0, jnp.inf)
-
-
-def _tol(pnorm, b, rtol, atol):
-    bnorm = pnorm(b)
-    return bnorm, jnp.maximum(rtol * bnorm, atol)
-
-
-def _reason(rnorm, tol, atol, k, maxit, brk, dmax=None):
-    diverged = (CR.DIVERGED_MAX_IT if dmax is None else
-                jnp.where(rnorm >= dmax, CR.DIVERGED_DTOL,
-                          CR.DIVERGED_MAX_IT))
-    return jnp.where(
-        brk, CR.DIVERGED_BREAKDOWN,
-        jnp.where(rnorm <= tol,
-                  jnp.where(rnorm <= atol, CR.CONVERGED_ATOL,
-                            CR.CONVERGED_RTOL),
-                  diverged)).astype(jnp.int32)
 
 
 # the in-program history buffer has a STATIC capacity (maxit is a runtime
@@ -201,39 +184,15 @@ class _LiveMonitor(_HistMonitor):
         return super().__call__(hist, k, rn)
 
 
-def _no_hist(dtype):
-    """Zero-size placeholder carried when monitoring is off — compiled
-    away entirely, but keeps every kernel's carry structure uniform."""
-    return jnp.zeros((0,), jnp.real(jnp.zeros((), dtype)).dtype)
-
-
-def _hist0(monitor, dtype):
-    """The history carry every kernel threads through its loop: the real
-    recorder when monitoring, a zero-size placeholder otherwise."""
-    return monitor.init() if monitor is not None else _no_hist(dtype)
-
-
-def _mon0(monitor, rn0, dtype):
-    """Build the history carry and record the iteration-0 (initial)
-    residual norm. petsc4py's monitors and KSPSetResidualHistory include
-    it — history length is iterations+1, and drivers index history[0] for
-    the starting norm."""
-    hist = _hist0(monitor, dtype)
-    if monitor is not None:
-        return monitor(hist, jnp.int32(0), rn0)
-    return hist
-
-
-def _nat(rz):
-    """KSP_NORM_NATURAL: sqrt <r, M r> — the scalar the CG-family
-    recurrences already carry (real by construction for the SPD/Hermitian
-    operators these types require)."""
-    return jnp.sqrt(jnp.maximum(jnp.real(rz), 0.0))
-
-
 def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
               dtol=None, unroll=1, natural=False):
     """Preconditioned conjugate gradients (KSPCG equivalent).
+
+    Assembled from the composable plans in :mod:`.cg_plans` (classic
+    recurrence, 3-site reduction plan — 2 under ``natural``), as are every
+    other CG variant in this module: one ``while_loop`` body serves
+    plain/stencil/batched/guarded, and pipelined CG is a reduction plan
+    (``pipecg_kernel``) rather than another kernel copy.
 
     ``unroll`` packs that many CG steps into each ``while_loop`` body with
     per-step continuation masking: active steps run arithmetic identical to
@@ -251,63 +210,10 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     reductions); the relative tolerance is then taken against the initial
     natural norm (= the natural norm of b for the default zero guess).
     """
-    r = b - A(x0)
-    z = M(r)
-    p = z
-    rz = pdot(r, z)
-    if natural:
-        rnorm = _nat(rz)
-        tol = jnp.maximum(rtol * rnorm, atol)
-        # a negative <r, M r> means M (or A) is indefinite — the natural
-        # norm is undefined there; flag breakdown instead of letting the
-        # 0-clamped norm fake instant convergence
-        brk0 = jnp.real(rz) < 0
-    else:
-        bnorm, tol = _tol(pnorm, b, rtol, atol)
-        rnorm = pnorm(r)
-        brk0 = rnorm <= -1.0
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, b.dtype)
-
-    def active(st):
-        k, x, r, z, p, rz, rn, brk, hist = st
-        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
-
-    def step(st):
-        k, x, r, z, p, rz, rn, brk, hist = st
-        cont = active(st)
-        Ap = A(p)
-        pAp = pdot(p, Ap)
-        brk_new = cont & (pAp == 0)
-        alpha = jnp.where(pAp == 0, 0.0,
-                          rz / jnp.where(pAp == 0, 1.0, pAp))
-        # frozen sub-steps SELECT the old state rather than multiplying by a
-        # zero gate: once a diverging active step has produced inf/NaN,
-        # 0 * inf = NaN would destroy the preserved iterate
-        x = jnp.where(cont, x + alpha * p, x)
-        r = jnp.where(cont, r - alpha * Ap, r)
-        z = jnp.where(cont, M(r), z)
-        rz_new = pdot(r, z)
-        if natural:
-            brk_new = brk_new | (cont & (jnp.real(rz_new) < 0))
-        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = jnp.where(cont, z + beta * p, p)
-        rz = jnp.where(cont, rz_new, rz)
-        rn = jnp.where(cont, _nat(rz_new) if natural else pnorm(r), rn)
-        k = k + cont.astype(jnp.int32)
-        if monitor is not None:
-            hist = monitor(hist, k, rn)
-        return (k, x, r, z, p, rz, rn, brk | brk_new, hist)
-
-    def body(st):
-        for _ in range(max(1, int(unroll))):
-            st = step(st)
-        return st
-
-    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, brk0, hist)
-    k, x, r, z, p, rz, rnorm, brk, hist = lax.while_loop(active, body, st0)
-    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
-            hist)
+    return _plans.classic_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pdot=pdot, pnorm=pnorm, monitor=monitor,
+        unroll=unroll, natural=natural)
 
 
 def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -315,8 +221,9 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     """CG fast path for uniform-diagonal stencil operators (the BASELINE
     cfg1/cfg5 hot loop, reference ``test.py:50``'s iterative analog).
 
-    Identical recurrence to :func:`cg_kernel` with PC none/jacobi/mg, but
-    restructured for minimum HBM traffic on the matrix-free stencil path:
+    Identical recurrence to :func:`cg_kernel` with PC none/jacobi/mg —
+    the same :func:`cg_plans.classic_cg_loop` body with the stencil
+    operator-apply and PC plans:
 
     - the SpMV and the ``<p, Ap>`` reduction run in ONE fused Pallas pass
       (``Adot``) while both operands are VMEM-resident;
@@ -343,53 +250,12 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     if grid3d is not None:
         b = b.reshape(grid3d)
         x0 = x0.reshape(grid3d)
-    bnorm = pnorm(b)
-    tol = jnp.maximum(rtol * bnorm, atol)
-    r = b - Adot(x0)[0]
-    rr = pdot(r, r)
-    rnorm = jnp.sqrt(rr)
-    if M3 is None:
-        rz = rr * inv_diag
-        p = r * inv_diag
-    else:
-        z = M3(r)
-        rz = pdot(r, z)
-        p = z
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, b.dtype)
-
-    def active(st):
-        k, x, r, p, rz, rn, brk, hist = st
-        return (rn > tol) & (rn < dmax) & (k < maxit) & ~brk
-
-    def body(st):
-        k, x, r, p, rz, rn, brk, hist = st
-        Ap, pAp = Adot(p)
-        brk_new = pAp == 0
-        alpha = jnp.where(brk_new, 0.0, rz / jnp.where(brk_new, 1.0, pAp))
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rr = pdot(r, r)
-        if M3 is None:
-            rz_new = rr * inv_diag
-            zn = r * inv_diag
-        else:
-            zn = M3(r)
-            rz_new = pdot(r, zn)
-        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = zn + beta * p
-        rn = jnp.sqrt(rr)
-        k = k + 1
-        if monitor is not None:
-            hist = monitor(hist, k, rn)
-        return (k, x, r, p, rz_new, rn, brk | brk_new, hist)
-
-    st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0, hist)
-    k, x, r, p, rz, rnorm, brk, hist = lax.while_loop(active, body, st0)
-    if grid3d is not None:
-        x = x.reshape(flat)
-    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
-            hist)
+    out = _plans.classic_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        Adot=Adot, inv_diag=inv_diag, M3=M3, pdot=pdot, pnorm=pnorm,
+        monitor=monitor)
+    x = out[0].reshape(flat) if grid3d is not None else out[0]
+    return (x,) + out[1:]
 
 
 # ---------------------------------------------------------------------------
@@ -397,32 +263,13 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
 # monitors (README "Silent-error detection", resilience/abft.py)
 # ---------------------------------------------------------------------------
 
-# in-program detector codes carried in the guarded kernels' `det` output
-SDC_NONE, SDC_ABFT, SDC_ABFT_PC, SDC_DRIFT, SDC_NAN, SDC_MONO = range(6)
-SDC_DETECTOR_NAMES = {SDC_ABFT: "abft", SDC_ABFT_PC: "abft_pc",
-                      SDC_DRIFT: "drift", SDC_NAN: "nan",
-                      SDC_MONO: "monotonic"}
+# detector codes (SDC_*), sentinels, and thresholds are defined once in
+# cg_plans.py and re-exported at the top of this module
 
-# monotonicity sentinel: a residual norm this far above the best seen so
-# far is beyond any healthy CG transient (bounded by sqrt(cond(A)))
-_SDC_MONO_FACTOR = 1e4
-# drift gate: recurrence-vs-true relative mismatch beyond this fraction
-# (plus a rounding floor of _SDC_DRIFT_FLOOR_EPS * eps * ||b||) flags SDC
-_SDC_DRIFT_REL = 0.25
-_SDC_DRIFT_FLOOR_EPS = 1024.0
-
-# KSP types with a guarded (ABFT + invariant-monitor) kernel variant
-GUARDED_TYPES = ("cg",)
-
-
-def _det4(badA, badM, badnan, badmono):
-    """First-detector-wins detection code (elementwise for batched)."""
-    return jnp.where(
-        badA, SDC_ABFT,
-        jnp.where(badM, SDC_ABFT_PC,
-                  jnp.where(badnan, SDC_NAN,
-                            jnp.where(badmono, SDC_MONO,
-                                      SDC_NONE)))).astype(jnp.int32)
+# KSP types with a guarded (ABFT + invariant-monitor) kernel variant:
+# cg's two-phase plan folds the checksums into its stacked psums, pipecg's
+# single-reduction plan folds them into its ONE stacked psum
+GUARDED_TYPES = ("cg", "pipecg")
 
 
 def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
@@ -499,6 +346,94 @@ def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
                                   vpair=vpair, rr_n=rr_n, eps=eps)
 
 
+def _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
+                     tsum, tasum, cmul, no_bad, pdot, pnorm):
+    """The guard bundle for the PIPELINED reduction plan.
+
+    Pipelined CG's one stacked psum per iteration reduces ``<r,u>``,
+    ``<w,u>`` and ``||r||²`` from the CURRENT vectors; the ABFT partials
+    ride the SAME stack, so the guarded pipelined program still has
+    exactly ONE reduce site per iteration.
+
+    What is checked: each body's FRESH applies — ``m = M w`` and
+    ``n = A m`` are computed in the same body (they are the overlap
+    work), so their checksum identities ``Σn ≈ ⟨c, m⟩`` (``c = Aᵀ1``)
+    and ``Σm ≈ ⟨c_M, w⟩`` (``c_M = Mᵀ1``) compare same-magnitude
+    same-iteration quantities, exactly like the classic guard's phases.
+    The local (collective-free) partials are carried ONE iteration and
+    folded into the NEXT body's stacked psum (``chk_parts`` ->
+    ``fused``), so detection lags one iteration and the collective count
+    stays at one. Checking the u/w RECURRENCES against the checksums
+    instead would false-positive by construction: their drift is the
+    classic pipelined-CG rounding loss, which grows relative to the
+    decaying residual scale — that drift is the replacement gate's job,
+    not ABFT's. ``init``/``vnorm2`` reuse the classic guard's init check
+    and plain-psum verifier (:func:`_make_guard` — the replacement
+    verifier must never ride the injectable psum).
+    """
+    base = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, dot=dot,
+                       tsum=tsum, tasum=tasum, cmul=cmul, no_bad=no_bad,
+                       pdot=pdot, pnorm=pnorm)
+    eps = base.eps
+    thr = lambda scale: abft_tol * eps * scale
+
+    def chk_parts(mv, nv, wv):
+        """Local checksum partials of THIS body's fresh applies, reduced
+        in the NEXT body's single stacked psum: operator channel
+        ``n = A m`` -> ``Σn`` vs ``⟨c, m⟩``; PC channel ``m = M w`` ->
+        ``Σm`` vs ``⟨c_M, w⟩``. At init the same identities read
+        ``(u0, w0, r0)`` for ``(m, n, w)`` — ``w0 = A u0``,
+        ``u0 = M r0``."""
+        parts = ()
+        if cs_l is not None:
+            cm_ = cmul(cs_l, mv)
+            parts += (tsum(nv), tsum(cm_), tasum(nv), tasum(cm_))
+        if csM_l is not None:
+            cw_ = cmul(csM_l, wv)
+            parts += (tsum(mv), tsum(cw_), tasum(mv), tasum(cw_))
+        return parts
+
+    def chk_init(r0, u0, w0):
+        return chk_parts(u0, w0, r0)
+
+    def fused(r, u, w, chk):
+        parts = [dot(r, u), dot(w, u), dot(r, r)] + list(chk)
+        s = _plans.fuse_psum(parts, _psum, axis, dtype)
+        gamma, delta, rr = s[0], s[1], s[2]
+        i = 3
+        if cs_l is not None:
+            badA = (jnp.abs(s[i] - s[i + 1])
+                    > thr(jnp.real(s[i + 2]) + jnp.real(s[i + 3])))
+            i += 4
+        else:
+            badA = no_bad(r)
+        if csM_l is not None:
+            badM = (jnp.abs(s[i] - s[i + 1])
+                    > thr(jnp.real(s[i + 2]) + jnp.real(s[i + 3])))
+        else:
+            badM = no_bad(r)
+        return gamma, delta, rr, badA, badM
+
+    def vnorm2(rt):
+        return jnp.real(lax.psum(jnp.asarray(dot(rt, rt), dtype), axis))
+
+    def vpair2(rt, rc):
+        """Replacement verifier: ‖true residual‖² and ‖CURRENT recurrence
+        residual‖² in one plain stacked psum. The pipelined loop's carried
+        norm lags one iteration, so the drift gate must compare the true
+        residual against the current recurrence residual — gating on the
+        lagged norm would flag every superlinear convergence drop as
+        corruption."""
+        s = lax.psum(jnp.stack([jnp.asarray(dot(rt, rt), dtype),
+                                jnp.asarray(dot(rc, rc), dtype)]), axis)
+        return jnp.real(s[0]), jnp.real(s[1])
+
+    return _types.SimpleNamespace(init=base.init, fused=fused,
+                                  chk_parts=chk_parts, chk_init=chk_init,
+                                  vnorm2=vnorm2, vpair2=vpair2,
+                                  rr_n=rr_n, eps=eps)
+
+
 def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
                       monitor=None, dtol=None):
     """Preconditioned CG with the in-program silent-corruption guard.
@@ -525,89 +460,9 @@ def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
     count, ``xv`` the last verified iterate (``x0`` until a replacement
     passes).
     """
-    r = b - A(x0)
-    bnorm, badA0 = g.init(b, r, x0)
-    z = M(r)
-    rz, rn2, badM0 = g.p2(r, z)
-    rnorm = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
-    p = z
-    tol = jnp.maximum(rtol * bnorm, atol)
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, b.dtype)
-    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
-    det0 = _det4(badA0, badM0, ~jnp.isfinite(rnorm), False)
-
-    def active(st):
-        k, x, r, z, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
-        return ((rn > tol) & (rn < dmax) & (k < maxit) & ~brk
-                & (det == SDC_NONE))
-
-    def body(st):
-        k, x, r, z, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
-        Ap = A(p)
-        pAp, badA = g.p1(p, Ap)                # reduction phase 1 (fused)
-        brk_new = pAp == 0
-        alpha = jnp.where(pAp == 0, 0.0,
-                          rz / jnp.where(pAp == 0, 1.0, pAp))
-        x = x + alpha * p
-        r = r - alpha * Ap
-        z = M(r)
-        rz_new, rn2, badM = g.p2(r, z)         # reduction phase 2 (fused)
-        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = z + beta * p
-        rz = rz_new
-        rn = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
-        k = k + 1
-        badnan = ~jnp.isfinite(rn)
-        badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR * rnb)
-        rnb = jnp.where(jnp.isfinite(rn), jnp.minimum(rnb, rn), rnb)
-        det = _det4(badA, badM, badnan, badmono)
-
-        # periodic true-residual replacement + drift gate + verification
-        do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
-                 & (k % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
-
-        def replace(args):
-            x, r, z, p, rz, rn, rrc, xv = args
-            rt = b - A(x)
-            zt = M(rt)
-            rtn2, rzt = g.vpair(rt, zt)        # plain-psum verifier
-            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
-            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
-                     + drift_floor)
-            ok = ~drift
-            # replacement restarts the direction from the true residual
-            # (p ← z), bounding recurrence drift; the passing iterate is
-            # promoted to the rollback target xv
-            r = jnp.where(ok, rt, r)
-            z = jnp.where(ok, zt, z)
-            p = jnp.where(ok, zt, p)
-            rz = jnp.where(ok, rzt, rz)
-            rn = jnp.where(ok, rtn, rn)
-            xv = jnp.where(ok, x, xv)
-            rrc = rrc + ok.astype(jnp.int32)
-            det_rr = jnp.where(drift, SDC_DRIFT,
-                               SDC_NONE).astype(jnp.int32)
-            return (x, r, z, p, rz, rn, rrc, xv, det_rr)
-
-        def keep(args):
-            x, r, z, p, rz, rn, rrc, xv = args
-            return (x, r, z, p, rz, rn, rrc, xv, jnp.int32(SDC_NONE))
-
-        x, r, z, p, rz, rn, rrc, xv, det_rr = lax.cond(
-            do_rr, replace, keep, (x, r, z, p, rz, rn, rrc, xv))
-        det = jnp.where(det == SDC_NONE, det_rr, det)
-        if monitor is not None:
-            hist = monitor(hist, k, rn)
-        return (k, x, r, z, p, rz, rn, brk | brk_new, hist, det, rrc, xv,
-                rnb)
-
-    st0 = (jnp.int32(0), x0, r, z, p, rz, rnorm, rnorm <= -1.0, hist,
-           det0, jnp.int32(0), x0, rnorm)
-    st = lax.while_loop(active, body, st0)
-    k, x, r, z, p, rz, rnorm, brk, hist, det, rrc, xv = st[:12]
-    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
-            hist, det, rrc, xv)
+    return _plans.classic_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pdot=pdot, pnorm=pnorm, guard=g, monitor=monitor)
 
 
 def cg_stencil_kernel_guarded(Adot, inv_diag, pdot3, pnorm3, b, x0, rtol,
@@ -626,80 +481,14 @@ def cg_stencil_kernel_guarded(Adot, inv_diag, pdot3, pnorm3, b, x0, rtol,
     if grid3d is not None:
         b = b.reshape(grid3d)
         x0 = x0.reshape(grid3d)
-    r = b - Adot(x0)[0]
-    bnorm, rnorm, badA0 = g.init(b, r, x0)
-    rz = rnorm * rnorm * inv_diag
-    p = r * inv_diag
-    tol = jnp.maximum(rtol * bnorm, atol)
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, b.dtype)
-    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
-    det0 = _det4(badA0, False, ~jnp.isfinite(rnorm), False)
-
-    def active(st):
-        k, x, r, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
-        return ((rn > tol) & (rn < dmax) & (k < maxit) & ~brk
-                & (det == SDC_NONE))
-
-    def body(st):
-        k, x, r, p, rz, rn, brk, hist, det, rrc, xv, rnb = st
-        Ap, pAp = Adot(p)
-        brk_new = pAp == 0
-        alpha = jnp.where(brk_new, 0.0, rz / jnp.where(brk_new, 1.0, pAp))
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rr, badA = g.p2_stencil(r, p, Ap)      # fused phase-2 + A-ABFT
-        rz_new = rr * inv_diag
-        beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = r * inv_diag + beta * p
-        rz = rz_new
-        rn = jnp.sqrt(rr)
-        k = k + 1
-        badnan = ~jnp.isfinite(rn)
-        badmono = jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR * rnb)
-        rnb = jnp.where(jnp.isfinite(rn), jnp.minimum(rnb, rn), rnb)
-        det = _det4(badA, False, badnan, badmono)
-
-        do_rr = ((det == SDC_NONE) & (g.rr_n > 0)
-                 & (k % jnp.maximum(g.rr_n, 1) == 0) & (rn > tol))
-
-        def replace(args):
-            x, r, p, rz, rn, rrc, xv = args
-            rt = b - Adot(x)[0]
-            rtn2 = g.vnorm2(rt)                # plain-psum verifier
-            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
-            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
-                     + drift_floor)
-            ok = ~drift
-            r = jnp.where(ok, rt, r)
-            p = jnp.where(ok, rt * inv_diag, p)
-            rz = jnp.where(ok, rtn2 * inv_diag, rz)
-            rn = jnp.where(ok, rtn, rn)
-            xv = jnp.where(ok, x, xv)
-            rrc = rrc + ok.astype(jnp.int32)
-            return (x, r, p, rz, rn, rrc, xv,
-                    jnp.where(drift, SDC_DRIFT, SDC_NONE).astype(jnp.int32))
-
-        def keep(args):
-            x, r, p, rz, rn, rrc, xv = args
-            return (x, r, p, rz, rn, rrc, xv, jnp.int32(SDC_NONE))
-
-        x, r, p, rz, rn, rrc, xv, det_rr = lax.cond(
-            do_rr, replace, keep, (x, r, p, rz, rn, rrc, xv))
-        det = jnp.where(det == SDC_NONE, det_rr, det)
-        if monitor is not None:
-            hist = monitor(hist, k, rn)
-        return (k, x, r, p, rz, rn, brk | brk_new, hist, det, rrc, xv, rnb)
-
-    st0 = (jnp.int32(0), x0, r, p, rz, rnorm, rnorm <= -1.0, hist, det0,
-           jnp.int32(0), x0, rnorm)
-    st = lax.while_loop(active, body, st0)
-    k, x, r, p, rz, rnorm, brk, hist, det, rrc, xv = st[:11]
+    out = _plans.classic_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        Adot=Adot, inv_diag=inv_diag, pdot=pdot3, pnorm=pnorm3, guard=g,
+        monitor=monitor)
     if grid3d is not None:
-        x = x.reshape(flat)
-        xv = xv.reshape(flat)
-    return (x, k, rnorm, _reason(rnorm, tol, atol, k, maxit, brk, dmax),
-            hist, det, rrc, xv)
+        out = ((out[0].reshape(flat),) + out[1:7]
+               + (out[7].reshape(flat),))
+    return out
 
 
 def bcgs_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
@@ -1175,65 +964,92 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
 def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
                   preduce=None, monitor=None, dtol=None):
-    """Single-reduction CG (Chronopoulos–Gear recurrences; KSPPIPECG slot).
+    """Pipelined single-reduction CG (Ghysels–Vanroose; KSPPIPECG slot).
 
     Standard CG needs three separate reductions per iteration ((p,Ap),
-    (r,z), ||r||); here all three inner products are computed from the same
-    vectors *before* the updates and fused into ONE stacked ``lax.psum`` —
-    the communication-optimal CG on a device mesh, trading one extra vector
-    recurrence for two collectives. Mathematically equivalent to CG in exact
-    arithmetic (Chronopoulos & Gear 1989).
+    (r,z), ||r||); here all three inner products are computed from the
+    CURRENT vectors and fused into ONE stacked ``lax.psum``
+    (:func:`cg_plans.fuse_psum`) — and, unlike the Chronopoulos–Gear
+    form, the next iteration's PC+operator applies (``m = M w``,
+    ``n = A m``) are INDEPENDENT of the reduction's results, so XLA's
+    async collectives overlap the reduce with the SpMV (the
+    latency-hiding the two-stage multisplitting line of work gets from
+    restructured communication). Mathematically equivalent to CG in
+    exact arithmetic; the extra u/w recurrences drift in finite
+    precision — the residual-replacement gate of the guarded variant
+    (:func:`pipecg_kernel_guarded`) is the bound. PETSc's KSPPIPECG
+    needs ``MPI_Iallreduce`` for the same overlap (PARITY.md).
     """
-    bnorm, tol = _tol(pnorm, b, rtol, atol)
-    r = b - A(x0)
-    u = M(r)
-    w = A(u)
-    rn0 = pnorm(r)
-    dmax = _dmax(rn0, dtol)
-    hist = _mon0(monitor, rn0, b.dtype)
-    zero = jnp.zeros_like(b)
-    dt = b.dtype
-
     def fused(r, u, w):
-        return preduce(jnp.vdot(r, u), jnp.vdot(w, u), jnp.vdot(r, r))
+        s = preduce(jnp.vdot(r, u), jnp.vdot(w, u), jnp.vdot(r, r))
+        return s[0], s[1], s[2]
 
-    def cond(st):
-        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["k"] < maxit)
-                & ~st["brk"])
+    return _plans.pipelined_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pnorm=pnorm, fused=fused, monitor=monitor)
 
-    def body(st):
-        k = st["k"]
-        gamma, delta, rr = fused(st["r"], st["u"], st["w"])
-        first = k == 0
-        gold = jnp.where(st["gamma"] == 0, 1.0, st["gamma"])
-        beta = jnp.where(first, 0.0, gamma / gold)
-        aold = jnp.where(st["alpha"] == 0, 1.0, st["alpha"])
-        denom = jnp.where(first, delta, delta - beta * gamma / aold)
-        brk = denom == 0
-        alpha = jnp.where(brk, 0.0, gamma / jnp.where(brk, 1.0, denom))
-        p = st["u"] + beta * st["p"]
-        s = st["w"] + beta * st["s"]
-        x = st["x"] + alpha * p
-        r = st["r"] - alpha * s
-        u = M(r)
-        w = A(u)
-        # rr = <r, r> is real by construction; take the real part so the
-        # carried norm stays real-typed for complex operators
-        rn = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))
-        hist = st["hist"]
-        if monitor is not None:
-            hist = monitor(hist, k + 1, rn)
-        return dict(k=k + 1, x=x, r=r, u=u, w=w, p=p, s=s,
-                    gamma=gamma, alpha=alpha, rn=rn, brk=brk, hist=hist)
 
-    st0 = dict(k=jnp.int32(0), x=x0, r=r, u=u, w=w, p=zero, s=zero,
-               gamma=jnp.asarray(0.0, dt), alpha=jnp.asarray(0.0, dt),
-               rn=pnorm(r), brk=pnorm(r) <= -1.0, hist=hist)
-    st = lax.while_loop(cond, body, st0)
-    rn_true = pnorm(b - A(st["x"]))
-    return (st["x"], st["k"], rn_true,
-            _reason(st["rn"], tol, atol, st["k"], maxit, st["brk"], dmax),
-            st["hist"])
+def pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
+                          monitor=None, dtol=None):
+    """Guarded pipelined CG: the GV recurrences with the ABFT partials
+    folded into the ONE stacked psum (:func:`_make_pipe_guard` — the
+    guarded pipelined program keeps exactly one reduce site per
+    iteration), NaN/monotonicity sentinels, and the periodic
+    true-residual replacement that both bounds the pipelined drift and
+    promotes verified iterates (``xv``) for rollback. Output contract
+    matches :func:`cg_kernel_guarded`."""
+    return _plans.pipelined_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pnorm=pnorm, fused=g.fused, guard=g,
+        monitor=monitor)
+
+
+def pipecg_stencil_kernel(A3, inv_diag, pnorm3, fused, b, x0, rtol, atol,
+                          maxit, monitor=None, dtol=None, grid3d=None):
+    """Pipelined-CG fast path for uniform-diagonal stencil operators:
+    grid-shaped carries (zero in-loop reshapes — the
+    :func:`cg_stencil_kernel` traffic discipline), the 3D-native apply
+    (``StencilPoisson3D.local_apply_grid3``), and the scalar-Jacobi
+    identity ``m = w / diag`` — still exactly ONE stacked psum per
+    iteration (the fused matvec+dot kernel is deliberately NOT used
+    here: its internal ``<u, Au>`` psum would be a second reduce
+    site)."""
+    flat = b.shape
+    if grid3d is not None:
+        b = b.reshape(grid3d)
+        x0 = x0.reshape(grid3d)
+    out = _plans.pipelined_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A3, M=lambda r: r * inv_diag, pnorm=pnorm3, fused=fused,
+        monitor=monitor)
+    x = out[0].reshape(flat) if grid3d is not None else out[0]
+    return (x,) + out[1:]
+
+
+def pipecg_kernel_many(A, M, pdotc, pnormc, fused, B, X0, rtol, atol,
+                      maxit, monitor=None, dtol=None):
+    """Batched pipelined CG: ``nrhs`` GV recurrences in lockstep with
+    per-column masked convergence (the :func:`cg_kernel_many`
+    discipline); ``fused`` reduces every column's (gamma, delta, ||r||²)
+    rows in ONE stacked psum, so the per-iteration collective count is
+    ONE — independent of both nrhs and, vs the classic plan, the phase
+    count."""
+    return _plans.pipelined_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pnorm=pnormc, fused=fused,
+        bp=_plans.ManyBatch("cols"), monitor=monitor)
+
+
+def pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol,
+                               maxit, g, monitor=None, dtol=None):
+    """Batched guarded pipelined CG: mask-aware per-column detection
+    (sticky det codes, frozen columns keep verified state) with all
+    guard partials riding the single stacked psum. Output contract
+    matches :func:`cg_kernel_many_guarded`."""
+    return _plans.pipelined_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pnorm=pnormc, fused=g.fused, guard=g,
+        bp=_plans.ManyBatch("cols"), monitor=monitor)
 
 
 def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -2331,6 +2147,21 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     matvec_dot = operator.local_matvec_dot(comm) if stencil_cg else None
     pc_apply3 = (pc.local_apply_grid3d(comm)
                  if stencil_cg and pc.get_type() == "mg" else None)
+    # pipelined-CG stencil fast path: grid-shaped carries + the 3D-native
+    # apply (zero in-loop reshapes) with the scalar-Jacobi PC identity;
+    # guard/complex/nullspace configurations route through the general
+    # flat kernel (pipecg_kernel). Dispatch is part of the cache key via
+    # pc.program_key() + operator.program_key().
+    stencil_pipe = (ksp_type == "pipecg" and nullspace_dim == 0
+                    and not guard_k and not is_complex(dtype)
+                    and pc.get_type() in ("none", "jacobi")
+                    and hasattr(operator, "local_apply_grid3")
+                    and hasattr(operator, "grid3d")
+                    and getattr(operator, "uniform_diagonal", None)
+                    is not None
+                    and (pc.get_type() == "none"
+                         or getattr(pc, "_mat", None) is operator))
+    apply3 = operator.local_apply_grid3(comm) if stencil_pipe else None
 
     pc_apply = pc.local_apply(comm, n)
     spmv_local = operator.local_spmv(comm)
@@ -2450,14 +2281,40 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     pdot3, pnorm3, b, x0, rtol, atol, maxit,
                     grid3d=operator.grid3d, **kw)
 
+            if stencil_pipe:
+                inv_diag = (jnp.asarray(1.0, b.dtype)
+                            if pc.get_type() == "none"
+                            else jnp.asarray(1.0 / operator.uniform_diagonal,
+                                             b.dtype))
+                A3 = lambda u: _abft.apply_silent_fault(
+                    "spmv.result", apply3(op_arrays, u))
+                pnorm3 = lambda v: jnp.sqrt(_psum(jnp.sum(v * v), axis))
+
+                def fused3(r_, u_, w_):
+                    s = _plans.fuse_psum(
+                        [jnp.sum(r_ * u_), jnp.sum(w_ * u_),
+                         jnp.sum(r_ * r_)], _psum, axis, dtype)
+                    return s[0], s[1], s[2]
+
+                return pipecg_stencil_kernel(
+                    A3, inv_diag, pnorm3, fused3, b, x0, rtol, atol,
+                    maxit, grid3d=operator.grid3d, **kw)
+
             if guard_args is not None:
                 cs_l, csM_l, abft_tol, rr_n = guard_args
+                flavor = dict(dot=jnp.vdot, tsum=jnp.sum,
+                              tasum=lambda u: jnp.sum(jnp.abs(u)),
+                              cmul=lambda c, v: c * v,
+                              no_bad=lambda v: False,
+                              pdot=pdot, pnorm=pnorm)
+                if ksp_type == "pipecg":
+                    gp = _make_pipe_guard(dtype, axis, cs_l, csM_l,
+                                          abft_tol, rr_n, **flavor)
+                    return pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0,
+                                                 rtol, atol, maxit, gp,
+                                                 **kw)
                 g = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n,
-                                dot=jnp.vdot, tsum=jnp.sum,
-                                tasum=lambda u: jnp.sum(jnp.abs(u)),
-                                cmul=lambda c, v: c * v,
-                                no_bad=lambda v: False,
-                                pdot=pdot, pnorm=pnorm)
+                                **flavor)
                 return cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol,
                                          atol, maxit, g, **kw)
             if unroll_k > 1:
@@ -2478,9 +2335,12 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # is in the cache key, so this bool can't go stale
                 kw["refine"] = pc.kind in ("lu", "crtri", "crband")
             elif ksp_type in ("pipecg", "fbcgsr"):
-                # the whole point: all per-iteration dots in ONE fused psum
-                kw["preduce"] = lambda *parts: _psum(jnp.stack(parts),
-                                                     axis)
+                # the whole point: all per-iteration dots in ONE fused
+                # psum — routed through the cg_plans.fuse_psum seam so
+                # the 1-reduce-site gate's injected-regression test can
+                # split it and prove the assert has teeth
+                kw["preduce"] = lambda *parts: _plans.fuse_psum(
+                    list(parts), _psum, axis, dtype)
             elif ksp_type in _NEEDS_TRANSPOSE:
                 # the adjoint of the projected operator v -> P(Av) is
                 # w -> A^T(Pw): project BEFORE the transpose product (P is
@@ -2626,55 +2486,10 @@ def cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol, atol, maxit,
     runs until the last active column exits. Returns per-column
     ``(X, iters, rnorm, reason, hist)`` with shapes (nrhs,)-batched.
     """
-    R = B - A(X0)
-    Z = M(R)
-    P = Z
-    rz = pdotc(R, Z)
-    bnorm = pnormc(B)
-    tol = jnp.maximum(rtol * bnorm, atol)
-    rnorm = pnormc(R)
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, B.dtype)
-    brk0 = jnp.zeros(rnorm.shape, bool)
-
-    def active(st):
-        it, X, R, Z, P, rz, rn, brk, hist = st
-        return (rn > tol) & (rn < dmax) & (it < maxit) & ~brk
-
-    def cond(st):
-        return jnp.any(active(st))
-
-    def body(st):
-        it, X, R, Z, P, rz, rn, brk, hist = st
-        cont = active(st)
-        cm = cont[None, :]
-        AP = A(P)
-        pAp = pdotc(P, AP)                     # reduction phase 1
-        brk_new = cont & (pAp == 0)
-        alpha = jnp.where(pAp == 0, 0.0,
-                          rz / jnp.where(pAp == 0, 1.0, pAp))
-        # frozen columns SELECT their old state (the cg_kernel unroll
-        # discipline: a diverged column's inf/NaN must not leak through a
-        # zero-gate multiply into the preserved iterate)
-        X = jnp.where(cm, X + alpha[None, :] * P, X)
-        R = jnp.where(cm, R - alpha[None, :] * AP, R)
-        Z = jnp.where(cm, M(R), Z)
-        rz_new, rr = pduo(R, Z)                # reduction phase 2 (fused)
-        beta = jnp.where(rz == 0, 0.0,
-                         rz_new / jnp.where(rz == 0, 1.0, rz))
-        P = jnp.where(cm, Z + beta[None, :] * P, P)
-        rz = jnp.where(cont, rz_new, rz)
-        rn = jnp.where(cont, jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0)), rn)
-        it = it + cont.astype(jnp.int32)
-        if monitor is not None:
-            hist = monitor(hist, it, rn)
-        return (it, X, R, Z, P, rz, rn, brk | brk_new, hist)
-
-    st0 = (jnp.zeros(rnorm.shape, jnp.int32), X0, R, Z, P, rz, rnorm,
-           brk0, hist)
-    it, X, R, Z, P, rz, rnorm, brk, hist = lax.while_loop(cond, body, st0)
-    return (X, it, rnorm, _reason(rnorm, tol, atol, it, maxit, brk, dmax),
-            hist)
+    return _plans.classic_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pdot=pdotc, pnorm=pnormc, pduo=pduo,
+        bp=_plans.ManyBatch("cols"), monitor=monitor)
 
 
 def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
@@ -2692,53 +2507,13 @@ def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
     flat = B.shape
     B3 = B.T.reshape((nrhs,) + grid3d)
     X3 = X0.T.reshape((nrhs,) + grid3d)
-    bnorm = jnp.sqrt(pdotc3(B3, B3))
-    tol = jnp.maximum(rtol * bnorm, atol)
-    R = B3 - Adot(X3)[0]
-    rr = pdotc3(R, R)
-    rnorm = jnp.sqrt(rr)
-    rz = rr * inv_diag
-    P = R * inv_diag
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, B.dtype)
-    brk0 = jnp.zeros(rnorm.shape, bool)
-
-    def active(st):
-        it, X, R, P, rz, rn, brk, hist = st
-        return (rn > tol) & (rn < dmax) & (it < maxit) & ~brk
-
-    def cond(st):
-        return jnp.any(active(st))
-
-    def body(st):
-        it, X, R, P, rz, rn, brk, hist = st
-        cont = active(st)
-        cm = cont[:, None, None, None]
-        AP, pAp = Adot(P)                      # fused phase-1 reduction
-        brk_new = cont & (pAp == 0)
-        alpha = jnp.where(pAp == 0, 0.0,
-                          rz / jnp.where(pAp == 0, 1.0, pAp))
-        al = alpha[:, None, None, None]
-        X = jnp.where(cm, X + al * P, X)
-        R = jnp.where(cm, R - al * AP, R)
-        rr = pdotc3(R, R)                      # phase-2 reduction
-        rz_new = rr * inv_diag
-        beta = jnp.where(rz == 0, 0.0,
-                         rz_new / jnp.where(rz == 0, 1.0, rz))
-        P = jnp.where(cm, R * inv_diag + beta[:, None, None, None] * P, P)
-        rz = jnp.where(cont, rz_new, rz)
-        rn = jnp.where(cont, jnp.sqrt(rr), rn)
-        it = it + cont.astype(jnp.int32)
-        if monitor is not None:
-            hist = monitor(hist, it, rn)
-        return (it, X, R, P, rz, rn, brk | brk_new, hist)
-
-    st0 = (jnp.zeros(rnorm.shape, jnp.int32), X3, R, P, rz, rnorm, brk0,
-           hist)
-    it, X, R, P, rz, rnorm, brk, hist = lax.while_loop(cond, body, st0)
-    X = X.reshape(nrhs, -1).T.reshape(flat)
-    return (X, it, rnorm, _reason(rnorm, tol, atol, it, maxit, brk, dmax),
-            hist)
+    out = _plans.classic_cg_loop(
+        b=B3, x0=X3, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        Adot=Adot, inv_diag=inv_diag, pdot=pdotc3,
+        pnorm=lambda U: jnp.sqrt(pdotc3(U, U)),
+        bp=_plans.ManyBatch("slabs"), monitor=monitor)
+    X = out[0].reshape(nrhs, -1).T.reshape(flat)
+    return (X,) + out[1:]
 
 
 def cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
@@ -2759,112 +2534,10 @@ def cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
     ``det``/``rrc`` per-column ``(nrhs,)`` vectors and ``Xv`` the
     per-column last-verified iterate block.
     """
-    R = B - A(X0)
-    bnorm, badA0 = g.init(B, R, X0)
-    Z = M(R)
-    rz, rn2, badM0 = g.p2(R, Z)
-    rnorm = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
-    P = Z
-    tol = jnp.maximum(rtol * bnorm, atol)
-    dmax = _dmax(rnorm, dtol)
-    hist = _mon0(monitor, rnorm, B.dtype)
-    drift_floor = _SDC_DRIFT_FLOOR_EPS * g.eps * bnorm
-    det0 = _det4(badA0, badM0, ~jnp.isfinite(rnorm),
-                 jnp.zeros(rnorm.shape, bool))
-    brk0 = jnp.zeros(rnorm.shape, bool)
-
-    def active(st):
-        return ((st["rn"] > tol) & (st["rn"] < dmax) & (st["it"] < maxit)
-                & ~st["brk"] & (st["det"] == SDC_NONE))
-
-    def cond(st):
-        return jnp.any(active(st))
-
-    def body(st):
-        cont = active(st)
-        cm = cont[None, :]
-        it, X, R, Z, P, rz, rn = (st["it"], st["X"], st["R"], st["Z"],
-                                  st["P"], st["rz"], st["rn"])
-        AP = A(P)
-        pAp, badA = g.p1(P, AP)                # fused phase-1 (per column)
-        brk_new = cont & (pAp == 0)
-        alpha = jnp.where(pAp == 0, 0.0,
-                          rz / jnp.where(pAp == 0, 1.0, pAp))
-        X = jnp.where(cm, X + alpha[None, :] * P, X)
-        R = jnp.where(cm, R - alpha[None, :] * AP, R)
-        Z = jnp.where(cm, M(R), Z)
-        rz_new, rn2, badM = g.p2(R, Z)         # fused phase-2 (per column)
-        beta = jnp.where(rz == 0, 0.0,
-                         rz_new / jnp.where(rz == 0, 1.0, rz))
-        P = jnp.where(cm, Z + beta[None, :] * P, P)
-        rz = jnp.where(cont, rz_new, rz)
-        rn_new = jnp.sqrt(jnp.maximum(jnp.real(rn2), 0.0))
-        rn = jnp.where(cont, rn_new, rn)
-        it = it + cont.astype(jnp.int32)
-        ks = st["ks"] + 1
-        badnan = cont & ~jnp.isfinite(rn)
-        badmono = cont & jnp.isfinite(rn) & (rn > _SDC_MONO_FACTOR
-                                             * st["rnb"])
-        rnb = jnp.where(cont & jnp.isfinite(rn),
-                        jnp.minimum(st["rnb"], rn), st["rnb"])
-        # STICKY per-column detection: a frozen column's code must
-        # survive later passes (cont masks its checks off once frozen)
-        det = jnp.where(st["det"] == SDC_NONE,
-                        _det4(cont & badA, cont & badM, badnan, badmono),
-                        st["det"])
-
-        # replacement on the lockstep STEP counter (per-column iteration
-        # counts diverge once columns freeze); applies to active, clean
-        # columns only — mask-aware per-column drift verdicts
-        clean = det == SDC_NONE
-        do_rr = jnp.any(cont & clean) & (g.rr_n > 0) \
-            & (ks % jnp.maximum(g.rr_n, 1) == 0)
-
-        def replace(args):
-            X, R, Z, P, rz, rn, rrc, Xv = args
-            RT = B - A(X)
-            ZT = M(RT)
-            rtn2, rzt = g.vpair(RT, ZT)        # plain-psum verifier
-            rtn = jnp.sqrt(jnp.maximum(rtn2, 0.0))
-            drift = (jnp.abs(rtn - rn) > _SDC_DRIFT_REL * (rtn + rn)
-                     + drift_floor)
-            ok = cont & clean & ~drift
-            okm = ok[None, :]
-            R = jnp.where(okm, RT, R)
-            Z = jnp.where(okm, ZT, Z)
-            P = jnp.where(okm, ZT, P)
-            rz = jnp.where(ok, rzt, rz)
-            rn = jnp.where(ok, rtn, rn)
-            Xv = jnp.where(okm, X, Xv)
-            rrc = rrc + ok.astype(jnp.int32)
-            det_rr = jnp.where(cont & clean & drift, SDC_DRIFT,
-                               SDC_NONE).astype(jnp.int32)
-            return (X, R, Z, P, rz, rn, rrc, Xv, det_rr)
-
-        def keep(args):
-            X, R, Z, P, rz, rn, rrc, Xv = args
-            return (X, R, Z, P, rz, rn, rrc, Xv,
-                    jnp.zeros(rn.shape, jnp.int32))
-
-        X, R, Z, P, rz, rn, rrc, Xv, det_rr = lax.cond(
-            do_rr, replace, keep,
-            (X, R, Z, P, rz, rn, st["rrc"], st["Xv"]))
-        det = jnp.where(det == SDC_NONE, det_rr, det)
-        hist = st["hist"]
-        if monitor is not None:
-            hist = monitor(hist, it, rn)
-        return dict(it=it, ks=ks, X=X, R=R, Z=Z, P=P, rz=rz, rn=rn,
-                    brk=st["brk"] | brk_new, hist=hist, det=det, rrc=rrc,
-                    Xv=Xv, rnb=rnb)
-
-    st0 = dict(it=jnp.zeros(rnorm.shape, jnp.int32), ks=jnp.int32(0),
-               X=X0, R=R, Z=Z, P=P, rz=rz, rn=rnorm, brk=brk0, hist=hist,
-               det=det0, rrc=jnp.zeros(rnorm.shape, jnp.int32), Xv=X0,
-               rnb=rnorm)
-    st = lax.while_loop(cond, body, st0)
-    return (st["X"], st["it"], st["rn"],
-            _reason(st["rn"], tol, atol, st["it"], maxit, st["brk"], dmax),
-            st["hist"], st["det"], st["rrc"], st["Xv"])
+    return _plans.classic_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
+        A=A, M=M, pdot=pdotc, pnorm=pnormc, guard=g,
+        bp=_plans.ManyBatch("cols"), monitor=monitor)
 
 
 _PROGRAM_CACHE_MANY: dict = {}
@@ -2913,11 +2586,11 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     except while a fault plan with live trace-time faults is armed
     (a program traced under injection must never be persisted).
     """
-    if ksp_type != "cg":
+    if ksp_type not in ("cg", "pipecg"):
         raise ValueError(
-            f"batched multi-RHS programs support KSP 'cg' (the block-CG "
-            f"kernel); {ksp_type!r} solves route through the sequential "
-            "fallback (KSP.solve_many)")
+            f"batched multi-RHS programs support KSP 'cg'/'pipecg' (the "
+            f"block-CG plans); {ksp_type!r} solves route through the "
+            "sequential fallback (KSP.solve_many)")
     from ..utils import aot
     axis = comm.axis
     n = operator.shape[0]
@@ -2944,7 +2617,8 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
             f"pc {pc.get_type()!r} has no batched apply "
             "(krylov.batched_pc_supported); KSP.solve_many falls back to "
             "sequential per-column solves for it")
-    stencil_cg = (not is_complex(dtype)
+    stencil_cg = (ksp_type == "cg"
+                  and not is_complex(dtype)
                   and not guard_k and not true_res_k
                   and pc.get_type() in ("none", "jacobi")
                   and hasattr(operator, "local_matvec_dot_many")
@@ -3004,15 +2678,29 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
             "pc.apply", pc_apply(pc_arrays, R))
         if guard_args is not None:
             cs_l, csM_l, abft_tol, rr_n = guard_args
-            g = _make_guard(
-                dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+            flavor = dict(
                 dot=cdot, tsum=lambda U: jnp.sum(U, axis=0),
                 tasum=lambda U: jnp.sum(jnp.abs(U), axis=0),
                 cmul=lambda c, V: c[:, None] * V,
                 no_bad=lambda V: jnp.zeros(V.shape[1], bool),
                 pdot=pdotc, pnorm=pnormc)
+            if ksp_type == "pipecg":
+                gp = _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol,
+                                      rr_n, **flavor)
+                return pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B,
+                                                  X0, rtol, atol, maxit,
+                                                  gp, **kw)
+            g = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+                            **flavor)
             return cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0,
                                           rtol, atol, maxit, g, **kw)
+        if ksp_type == "pipecg":
+            def fusedc(Rb, U, W):
+                s = _plans.fuse_psum([cdot(Rb, U), cdot(W, U),
+                                      cdot(Rb, Rb)], _psum, axis, dtype)
+                return s[0], s[1], s[2]
+            return pipecg_kernel_many(A, M, pdotc, pnormc, fusedc, B, X0,
+                                      rtol, atol, maxit, **kw)
         return cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol,
                               atol, maxit, **kw)
 
@@ -3064,8 +2752,9 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
         # key_parts: the full program identity minus the mesh (the wrap
         # appends its own mesh/jax-version/x64 fingerprint) — nrhs is in
         # there, so each batch width gets its own shape-specialized blob
-        prog = aot.wrap("ksp_many", comm, key[1:],
-                        prog, code=aot.source_fingerprint(__file__),
+        prog = aot.wrap("ksp_many", comm, key[1:], prog,
+                        code=aot.source_fingerprint(__file__,
+                                                    _plans.__file__),
                         donate_argnums=dn)
     _PROGRAM_CACHE_MANY[key] = prog
     return prog

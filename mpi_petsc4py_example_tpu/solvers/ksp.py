@@ -100,6 +100,15 @@ class KSP:
                                       # = detected corruption), replace
                                       # r and promote the iterate to the
                                       # verified rollback target; 0 = off
+        self.pipeline_auto_replacement = 0  # -ksp_pipeline_auto_replacement
+                                      # N: when KSP 'pipecg' is selected
+                                      # and -ksp_residual_replacement is
+                                      # unset, arm the true-residual
+                                      # replacement every N iterations —
+                                      # the standard bound on pipelined
+                                      # CG's u/w recurrence drift
+                                      # (Ghysels-Vanroose); 0 = off.
+                                      # Non-pipelined types ignore it.
         self._true_residual_check = False  # -ksp_true_residual_check
         self.true_residual_margin = 1.0    # -ksp_true_residual_margin: with
                                       # the gate on, the COMPILED program
@@ -355,6 +364,9 @@ class KSP:
         self.abft_tol = opt.get_real(p + "ksp_abft_tol", self.abft_tol)
         self.residual_replacement = opt.get_int(
             p + "ksp_residual_replacement", self.residual_replacement)
+        self.pipeline_auto_replacement = opt.get_int(
+            p + "ksp_pipeline_auto_replacement",
+            self.pipeline_auto_replacement)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         self._view_flag = opt.get_bool(p + "ksp_view", False)
         self._reason_flag = opt.get_bool(p + "ksp_converged_reason", False)
@@ -402,8 +414,19 @@ class KSP:
     setUp = set_up
 
     # ---- silent-corruption guard plumbing -----------------------------------
+    def _effective_replacement(self) -> int:
+        """The replacement interval a solve actually arms:
+        ``-ksp_residual_replacement`` when set, else — for the pipelined
+        type only — the ``-ksp_pipeline_auto_replacement`` fallback (the
+        drift bound pipelined CG's recurrences want by default)."""
+        if self.residual_replacement > 0:
+            return int(self.residual_replacement)
+        if self._type == "pipecg":
+            return int(self.pipeline_auto_replacement)
+        return 0
+
     def _guard_requested(self) -> bool:
-        return bool(self.abft or self.residual_replacement > 0)
+        return bool(self.abft or self._effective_replacement() > 0)
 
     def _check_guard(self):
         if self._guard_requested() and self._type not in GUARDED_TYPES:
@@ -538,7 +561,7 @@ class KSP:
                                  abft=guard and self.abft,
                                  abft_pc=abft_pc_on,
                                  rr=guard
-                                 and self.residual_replacement > 0,
+                                 and self._effective_replacement() > 0,
                                  donate=True)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
@@ -560,7 +583,7 @@ class KSP:
         # trailing runtime guard scalars (tolerance factor + replacement
         # interval) — runtime args, so tuning them never recompiles
         guard_scalars = ((dt.type(self.abft_tol),
-                          np.int32(self.residual_replacement))
+                          np.int32(self._effective_replacement()))
                          if guard else ())
         # fault point 'ksp.program': a simulated worker crash DURING the
         # compiled solve. With iter=K the crash leaves real partial state —
@@ -942,7 +965,7 @@ class KSP:
         from .krylov import (batched_pc_supported, build_ksp_program_many,
                              hist_capacity)
         nullspace = getattr(mat, "nullspace", None)
-        batched = (self._type == "cg"
+        batched = (self._type in ("cg", "pipecg")
                    and batched_pc_supported(pc)
                    and (nullspace is None or nullspace.dim == 0)
                    and self._norm_type in ("default", "none"))
@@ -980,14 +1003,14 @@ class KSP:
         build_kw = dict(monitored=monitored,
                         hist_cap=hist_capacity(self.max_it, 0),
                         abft=guard and self.abft, abft_pc=abft_pc_on,
-                        rr=guard and self.residual_replacement > 0,
+                        rr=guard and self._effective_replacement() > 0,
                         true_res=gate, donate=True)
         prog = build_ksp_program_many(
-            comm, "cg", pc, mat, nrhs=k,
+            comm, self._type, pc, mat, nrhs=k,
             zero_guess=not guess_nonzero, **build_kw)
         dt = np.dtype(op_dt.type(0).real.dtype)
         guard_scalars = ((dt.type(self.abft_tol),
-                          np.int32(self.residual_replacement))
+                          np.int32(self._effective_replacement()))
                          if guard else ())
         # ONE batched placement for both blocks (the PR-3 put_rows_many
         # discipline: sequential put_rows would pay the runtime's fixed
@@ -1146,8 +1169,8 @@ class KSP:
                     # (guess nonzero); frozen-instantly for columns whose
                     # entry residual already meets their tolerance
                     prog2 = build_ksp_program_many(
-                        comm, "cg", pc, mat, nrhs=k, zero_guess=False,
-                        **build_kw)
+                        comm, self._type, pc, mat, nrhs=k,
+                        zero_guess=False, **build_kw)
                 out = prog2(mat.device_arrays(), pc.device_arrays(),
                             *cs_args, Bd, Xd,
                             dt.type(rtol * margin), dt.type(atol * margin),
@@ -1197,7 +1220,7 @@ class KSP:
             res.abft_checks = checks
             res.residual_replacements = int(rrc_h.sum())
         self.result_many = res
-        record_event(f"KSPSolveMany(cg+{pc.get_type()},k={k})",
+        record_event(f"KSPSolveMany({self._type}+{pc.get_type()},k={k})",
                      mat.shape[0], max(iters) if iters else 0, wall,
                      max(reasons) if res.converged else min(reasons))
         return res

@@ -34,17 +34,24 @@ import jax.export  # noqa: F401 — not re-exported from the bare jax module
 
 
 @functools.lru_cache(maxsize=None)
-def source_fingerprint(module_file: str) -> str:
+def source_fingerprint(module_file: str, *extra_files: str) -> str:
     """sha256 of a builder module's source — part of every blob key, so a
     code change (new factorization math, changed specs) can never be
-    served a stale pre-change program. Unreadable source (frozen app)
-    degrades to the module path: correctness then rests on the jax-version
-    key alone, which still covers the common upgrade hazard."""
-    try:
-        with open(module_file, "rb") as fh:
-            return hashlib.sha256(fh.read()).hexdigest()
-    except OSError:
-        return module_file
+    served a stale pre-change program. ``extra_files`` are hashed in for
+    builders whose kernel bodies live in OTHER modules (krylov.py's
+    loops are assembled from cg_plans.py plans: an edit there changes
+    the traced program without touching the builder file). Unreadable
+    source (frozen app) degrades to hashing the module path: correctness
+    then rests on the jax-version key alone, which still covers the
+    common upgrade hazard."""
+    h = hashlib.sha256()
+    for f in (module_file,) + extra_files:
+        try:
+            with open(f, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(f.encode())
+    return h.hexdigest()
 
 
 def aot_enabled() -> bool:
@@ -68,8 +75,44 @@ def _mesh_fingerprint(comm) -> tuple:
             getattr(d0, "device_kind", ""), comm.axis)
 
 
+@functools.lru_cache(maxsize=1)
+def host_machine_fingerprint() -> str:
+    """CPU-feature fingerprint of THIS host, keyed into every CPU-platform
+    blob digest.
+
+    XLA:CPU AOT artifacts embed the COMPILE machine's ISA feature set; a
+    blob produced on one machine and executed on another with different
+    features makes ``cpu_aot_loader`` spam per-load "machine features
+    ... not supported on the host machine ... could lead to SIGILL"
+    warnings (the MULTICHIP_r05 tail) and genuinely risks illegal
+    instructions. Keying the digest on the host's feature flags means a
+    different machine simply MISSES the cache and falls back to fresh
+    tracing — a mismatched blob is never even opened. Linux exposes the
+    flags in ``/proc/cpuinfo``; elsewhere the platform string is the
+    best (coarser) stand-in."""
+    import platform
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                # x86 spells it "flags", arm64 "Features"
+                if line.startswith(("flags", "Features")):
+                    parts.append(" ".join(sorted(
+                        line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        parts.append(platform.platform())
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
 def _digest(kind: str, comm, key_parts, code: str = "") -> str:
-    payload = repr((kind, _mesh_fingerprint(comm), key_parts, code,
+    # CPU-platform programs additionally pin the host machine's feature
+    # set (host_machine_fingerprint) — accelerator blobs are StableHLO
+    # recompiled for the local device generation, which the
+    # device_kind in _mesh_fingerprint already covers
+    host = (host_machine_fingerprint()
+            if comm.devices[0].platform == "cpu" else "")
+    payload = repr((kind, _mesh_fingerprint(comm), host, key_parts, code,
                     jax.__version__,
                     bool(jax.config.jax_enable_x64)))
     return hashlib.sha256(payload.encode()).hexdigest()

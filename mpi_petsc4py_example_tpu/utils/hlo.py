@@ -1,0 +1,94 @@
+"""Lowered-StableHLO inspection helpers for the collective-schedule gates.
+
+The collective-volume tests (tests/test_collective_volume.py) and the
+MULTICHIP weak-scaling bench both need to count the reduce sites INSIDE a
+solver loop's body — the per-iteration communication schedule the
+pipelined/guarded/classic reduction plans pin (1 / 2 / 3 sites). Whole-
+program ``all_reduce`` counts can't distinguish init/epilogue reductions
+from per-iteration ones, so this module walks the pretty-printed
+StableHLO's region structure instead.
+
+Purely textual (brace matching on the ``stablehlo.while`` body region) —
+no MLIR bindings required; the text shape is pinned by the jax version
+the repo runs, and the tests exercising this parser fail loudly if a
+version bump changes it.
+"""
+
+from __future__ import annotations
+
+
+def _body_region(lines, start):
+    """Lines of the ``do { ... }`` region of the while op whose header is
+    at ``lines[start]``, by brace counting from the ``do {`` opener."""
+    depth = 0
+    body: list[str] = []
+    in_do = False
+    for line in lines[start:]:
+        if not in_do:
+            # the cond region comes first; the body region opens at
+            # '} do {' (the '}' closes the cond region — only braces
+            # AFTER the 'do {' opener belong to the body's depth)
+            if " do {" in line:
+                in_do = True
+                suf = line.split(" do {", 1)[1]
+                depth = 1 + suf.count("{") - suf.count("}")
+                if depth <= 0:
+                    break
+            continue
+        depth += line.count("{") - line.count("}")
+        if depth <= 0:
+            break
+        body.append(line)
+    return body
+
+
+def _count_sites(body_lines, exclude_conditionals=True) -> int:
+    count = 0
+    cond_depth = 0
+    in_cond = False
+    for bl in body_lines:
+        if in_cond:
+            cond_depth += bl.count("{") - bl.count("}")
+            if cond_depth <= 0:
+                in_cond = False
+            continue
+        if exclude_conditionals and ("stablehlo.if" in bl
+                                     or "stablehlo.case" in bl):
+            cond_depth = bl.count("{") - bl.count("}")
+            in_cond = cond_depth > 0
+            continue
+        if "all_reduce" in bl:
+            count += 1
+    return count
+
+
+def while_body_reduce_sites(stablehlo_text: str,
+                            exclude_conditionals: bool = True) -> list[int]:
+    """Per-``stablehlo.while`` count of ``all_reduce`` sites in the LOOP
+    BODY — the per-iteration reduce-site schedule.
+
+    ``exclude_conditionals`` skips sites nested inside ``stablehlo.if`` /
+    ``stablehlo.case`` regions of the body: the guard's periodic
+    replacement verifier lives in an every-N conditional branch, which is
+    not a per-iteration cost (the rr on/off volume gate pins that
+    separately). Returns one count per while op, in program order.
+    """
+    lines = stablehlo_text.splitlines()
+    return [_count_sites(_body_region(lines, i), exclude_conditionals)
+            for i, line in enumerate(lines)
+            if "stablehlo.while" in line]
+
+
+def solver_loop_reduce_sites(stablehlo_text: str) -> int:
+    """The reduce-site count of a solve program's MAIN loop: the while op
+    with the largest body (the Krylov iteration — monitors/power
+    iterations/helper loops are smaller in every program this gates)."""
+    lines = stablehlo_text.splitlines()
+    best_len, best_sites = -1, 0
+    for i, line in enumerate(lines):
+        if "stablehlo.while" not in line:
+            continue
+        body = _body_region(lines, i)
+        if len(body) > best_len:
+            best_len, best_sites = len(body), _count_sites(body)
+    return best_sites

@@ -43,6 +43,11 @@ KNOWN_FLAGS = {
     "ksp_monitor": "print the residual norm each iteration",
     "ksp_norm_type": "monitored norm (default/none/preconditioned/"
                      "unpreconditioned/natural)",
+    "ksp_pipeline_auto_replacement": "pipecg only: arm true-residual "
+                                     "replacement every N iterations when "
+                                     "-ksp_residual_replacement is unset "
+                                     "(bounds the pipelined recurrences' "
+                                     "drift; 0 = off)",
     "ksp_residual_replacement": "recompute/replace the true residual every "
                                 "N iterations with a drift gate (silent-"
                                 "corruption monitor; 0 = off)",

@@ -104,6 +104,38 @@ def serving_stats() -> dict:
     return out
 
 
+# collective-latency itemization (the MULTICHIP weak-scaling bench):
+# label -> [reduce_sites_per_iter, per_iter_seconds_sum, episodes]
+_COLLECTIVES: dict[str, list] = {}
+
+
+def record_collective_latency(label: str, reduce_sites: int,
+                              per_iter_seconds: float):
+    """Record one measured collective-latency episode: a solver loop with
+    ``reduce_sites`` psum/all-reduce sites per iteration that ran at
+    ``per_iter_seconds`` per iteration on the mesh.
+
+    The MULTICHIP weak-scaling bench records each (solver, mesh, size)
+    point — classic CG's multi-site loop vs pipelined CG's 1-site loop
+    plus a direct chained-psum latency probe — so ``-log_view`` prints
+    the psum-latency itemization (seconds attributable to reduce sites
+    per iteration) instead of leaving it as benchmark prose."""
+    if per_iter_seconds <= 0:
+        return
+    entry = _COLLECTIVES.setdefault(label, [int(reduce_sites), 0.0, 0])
+    entry[1] += float(per_iter_seconds)
+    entry[2] += 1
+
+
+def collective_latency() -> dict[str, dict]:
+    """label -> {reduce_sites, per_iter_s (mean), episodes}."""
+    out = {}
+    for k, (sites, tot, n) in _COLLECTIVES.items():
+        out[k] = {"reduce_sites": sites, "episodes": n,
+                  "per_iter_s": tot / n if n else 0.0}
+    return out
+
+
 def record_sync(kind: str, count: int = 1):
     """Count a host<->device synchronization point (a blocking D2H fetch).
 
@@ -159,6 +191,7 @@ def clear_events():
     _EVENTS.clear()
     _SYNCS.clear()
     _KERNEL_TRAFFIC.clear()
+    _COLLECTIVES.clear()
     _SDC[:] = [0, 0, 0]
     _SERVING.update(requests=0, batches=0, padded_cols=0,
                     width_hist={}, wait_sum_s=0.0, wait_max_s=0.0)
@@ -168,7 +201,8 @@ def log_view(file=None):
     """Print the accumulated solve log, -log_view style."""
     file = file or sys.stderr
     if (not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS
-            and not any(_SDC) and not _SERVING["batches"]):
+            and not any(_SDC) and not _SERVING["batches"]
+            and not _COLLECTIVES):
         print("log_view: no solve events recorded", file=file)
         return
     if _EVENTS:
@@ -201,6 +235,13 @@ def log_view(file=None):
               f"{st['wait_mean_s'] * 1e3:.1f} ms / max "
               f"{st['wait_max_s'] * 1e3:.1f} ms, "
               f"{st['padded_cols']} padded column(s)", file=file)
+    if _COLLECTIVES:
+        print("collective latency itemization (reduce sites x per-iter "
+              "wall):", file=file)
+        for k, info in sorted(collective_latency().items()):
+            print(f"  {k:36s} {info['reduce_sites']:2d} site(s) "
+                  f"{info['per_iter_s'] * 1e6:10.1f} us/iter "
+                  f"({info['episodes']} episode(s))", file=file)
     if _KERNEL_TRAFFIC:
         print("kernel traffic (model bytes / measured time = achieved "
               "GB/s):", file=file)

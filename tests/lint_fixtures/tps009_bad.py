@@ -1,0 +1,37 @@
+"""TPS009 bad fixture: shard_map spec/signature inconsistencies.
+
+Each marked site either zips an in_specs tuple of the wrong length
+against the wrapped function's positional signature (a trace-time pytree
+error on the first real mesh) or names a P() axis no Mesh in the module
+defines (shards nothing / aborts at run time).
+"""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), axis_names=("rows",))
+
+
+def local_fn(op_arrays, b, x0):
+    return b + x0
+
+
+def too_few_specs():
+    # 2 specs for a 3-argument function
+    return jax.shard_map(local_fn, mesh=mesh,  # BAD: TPS009
+                         in_specs=(P("rows"), P("rows")),
+                         out_specs=P("rows"))
+
+
+def too_many_specs(comm):
+    # comm.shard_map positional idiom, 4 specs for 3 arguments
+    return comm.shard_map(local_fn,  # BAD: TPS009
+                          (P(), P("rows"), P("rows"), P()),
+                          P("rows"))
+
+
+def unbound_axis():
+    # "cols" is not an axis of any Mesh this module constructs
+    return jax.shard_map(local_fn, mesh=mesh,
+                         in_specs=(P(), P("rows"), P("cols")),  # BAD: TPS009
+                         out_specs=P("rows"))

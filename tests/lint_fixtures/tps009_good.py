@@ -1,0 +1,46 @@
+"""TPS009 good fixture: consistent specs, threaded axes, and the
+statically-unresolvable shapes the rule must stay silent on.
+"""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), axis_names=("rows",))
+
+
+def local_fn(op_arrays, b, x0):
+    return b + x0
+
+
+def matched_arity():
+    return jax.shard_map(local_fn, mesh=mesh,
+                        in_specs=(P(), P("rows"), P("rows")),
+                        out_specs=P("rows"))
+
+
+def comm_idiom(comm):
+    # positional comm.shard_map with a matching 3-tuple
+    return comm.shard_map(local_fn, (P(), P("rows"), P("rows")), P("rows"))
+
+
+def threaded_axis(comm, axis):
+    # dynamic axis names (the production DeviceComm.axis idiom) are not
+    # statically comparable — out of scope
+    return comm.shard_map(local_fn, (P(), P(axis), P(axis)), P(axis))
+
+
+def varargs_fn(comm):
+    # *args signatures have unbounded arity — not checkable
+    def fn(op_arrays, *args):
+        return args[0]
+
+    return comm.shard_map(fn, (P(), P("rows"), P("rows"), P("rows")),
+                          P("rows"))
+
+
+def defaulted_params(comm):
+    # 2 specs vs fn(a, b=None): within the (1..2) positional range
+    def fn(a, b=None):
+        return a
+
+    return comm.shard_map(fn, (P("rows"), P("rows")), P("rows"))

@@ -161,5 +161,14 @@ class TestAotGates:
         fp = aot.source_fingerprint(eps_mod.__file__)
         assert len(fp) == 64
         assert fp == aot.source_fingerprint(eps_mod.__file__)  # cached
-        assert aot.source_fingerprint("/nonexistent/mod.py") == \
-            "/nonexistent/mod.py"
+        # unreadable source degrades to hashing the path — stable, and
+        # never colliding with a real source hash
+        missing = aot.source_fingerprint("/nonexistent/mod.py")
+        assert len(missing) == 64 and missing != fp
+        assert missing == aot.source_fingerprint("/nonexistent/mod.py")
+        # multi-file form: extra kernel-body modules change the digest
+        # (the ksp_many blobs hash krylov.py AND cg_plans.py — an edit
+        # to the plan module must never serve a stale pre-edit program)
+        import mpi_petsc4py_example_tpu.solvers.cg_plans as plans_mod
+        both = aot.source_fingerprint(eps_mod.__file__, plans_mod.__file__)
+        assert len(both) == 64 and both != fp

@@ -370,6 +370,147 @@ class TestAbftGuardVolume:
         assert txtk.count("all_reduce") == txt1.count("all_reduce")
 
 
+def _lower_pipecg(comm, M, pc_type="jacobi", guard=False, rr=False):
+    from mpi_petsc4py_example_tpu.resilience import abft
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("pipecg")
+    ksp.get_pc().set_type(pc_type)
+    ksp.set_up()
+    pc = ksp.get_pc()
+    x, b = M.get_vecs()
+    dt = np.dtype(np.float64)
+    if guard:
+        cs = abft.column_checksum(M)
+        csM = abft.pc_checksum(pc, M)
+        placed = comm.put_rows_many([cs, csM])
+        prog = build_ksp_program(comm, "pipecg", pc, M, abft=True,
+                                 abft_pc=True, rr=rr)
+        return prog.lower(
+            M.device_arrays(), pc.device_arrays(), *placed, b.data,
+            x.data, dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+            np.int32(50), dt.type(256.0),
+            np.int32(25 if rr else 0)).as_text()
+    prog = build_ksp_program(comm, "pipecg", pc, M)
+    return prog.lower(
+        M.device_arrays(), pc.device_arrays(), b.data, x.data,
+        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+
+
+class TestPipelinedReduceSites:
+    """ISSUE 7 acceptance: the pipelined program lowers to exactly ONE
+    psum/reduce site per iteration — vs 2 for the guarded classic loop
+    and 3 for plain CG — pinned on the WHILE BODY of the lowered
+    StableHLO (utils/hlo.solver_loop_reduce_sites; whole-program counts
+    can't tell init/epilogue reductions from per-iteration ones)."""
+
+    def test_site_schedule_3_2_1(self, comm8):
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
+        assert solver_loop_reduce_sites(_lower_cg_jacobi(comm8, M)) == 3
+        assert solver_loop_reduce_sites(
+            _lower_cg_guard(comm8, M, rr=True)) == 2
+        assert solver_loop_reduce_sites(_lower_pipecg(comm8, M)) == 1
+        # the guarded pipelined program KEEPS the 1-site schedule: ABFT
+        # partials ride the same stacked psum, the replacement verifier
+        # lives in the every-N conditional branch
+        assert solver_loop_reduce_sites(
+            _lower_pipecg(comm8, M, guard=True, rr=True)) == 1
+
+    def test_stencil_pipelined_one_site(self, comm8):
+        """The grid-carry stencil fast path (pipecg_stencil_kernel) also
+        honors the 1-site contract; classic stencil CG has 2 (the fused
+        matvec+dot psum + the residual-norm psum)."""
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        op = StencilPoisson3D(comm8, 16, 16, 16)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("pipecg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_up()
+        pc = ksp.get_pc()
+        dt = np.dtype(np.float64)
+        x, b = op.get_vecs()
+
+        def lower(tp):
+            prog = build_ksp_program(comm8, tp, pc, op)
+            return prog.lower(
+                op.device_arrays(), pc.device_arrays(), b.data, x.data,
+                dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+                np.int32(50)).as_text()
+
+        assert solver_loop_reduce_sites(lower("pipecg")) == 1
+        assert solver_loop_reduce_sites(lower("cg")) == 2
+
+    def test_batched_pipelined_one_site_and_gather_count(self, comm8,
+                                                         monkeypatch):
+        """The batched pipelined program keeps ONE reduce site per
+        iteration with the same gather op count as k=1 (bytes x k)."""
+        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+        krylov_mod._PROGRAM_CACHE_MANY.clear()
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("pipecg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_up()
+        pc = ksp.get_pc()
+        dt = np.dtype(np.float64)
+
+        def lower_many(nrhs):
+            prog = build_ksp_program_many(comm8, "pipecg", pc, M,
+                                          nrhs=nrhs)
+            Bp = comm8.put_rows(np.zeros((n, nrhs)))
+            X0 = comm8.put_rows(np.zeros((n, nrhs)))
+            return prog.lower(
+                M.device_arrays(), pc.device_arrays(), Bp, X0,
+                dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+                np.int32(50)).as_text()
+
+        txt1, txtk = lower_many(1), lower_many(k)
+        assert solver_loop_reduce_sites(txtk) == 1
+        vols1 = all_gather_volumes(txt1)
+        volsk = all_gather_volumes(txtk)
+        n_pad = comm8.padded_size(n)
+        assert len(volsk) == len(vols1), (volsk, vols1)
+        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
+
+    def test_injected_two_site_regression_fails_gate(self, comm8,
+                                                     monkeypatch):
+        """Teeth: split the fuse_psum seam into TWO psums (the regression
+        a careless reduction-plan edit would introduce) — the lowered
+        body must show 2 sites and the ==1 gate must fail."""
+        import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
+        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+
+        def split_fuse(parts, psum, axis, dtype):
+            parts = [jnp.asarray(q, dtype) for q in parts]
+            head = psum(jnp.stack(parts[:1]), axis)
+            tail = psum(jnp.stack(parts[1:]), axis)
+            return jnp.concatenate([head, tail])
+
+        # the regression program would cache under the SAME key as the
+        # healthy pipelined program — clear around the experiment
+        krylov_mod._PROGRAM_CACHE.clear()
+        monkeypatch.setattr(cg_plans, "fuse_psum", split_fuse)
+        try:
+            M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
+            sites = solver_loop_reduce_sites(_lower_pipecg(comm8, M))
+            assert sites == 2, sites
+        finally:
+            monkeypatch.undo()
+            krylov_mod._PROGRAM_CACHE.clear()
+
+
 class _RegressedEll:
     """A Mat shim whose local SpMV all-gathers the ELL value matrix —
     the injected volume regression the gates must catch."""

@@ -1,0 +1,144 @@
+"""TPS009 — shard_map sharding-spec consistency (ROADMAP, deferred from
+the initial rule set; landed with the multi-chip weak-scaling work this
+rule directly guards).
+
+Two statically-checkable shard_map hazards:
+
+* **in_specs arity vs wrapped signature** — ``shard_map(fn, in_specs,
+  out_specs)`` zips ``in_specs`` against ``fn``'s positional parameters;
+  a spec tuple that is longer or shorter than the signature fails only
+  at trace time, on the first real mesh, with a pytree-mismatch error
+  pointing nowhere near the call site. Checked whenever ``fn`` resolves
+  to a def in an enclosing scope (``*args`` signatures and dynamic
+  callables are skipped) and the specs are a tuple/list literal.
+
+* **P(axis) axes must exist in the enclosing mesh** — a
+  ``PartitionSpec`` naming an axis no ``Mesh`` in the module defines
+  shards nothing (or aborts) at run time. Only LITERAL axis names are
+  comparable statically, and only when the module constructs at least
+  one ``Mesh`` with literal ``axis_names`` — the repo's production idiom
+  (threading ``DeviceComm.axis``) is dynamic and stays out of scope
+  (TPS003 separately flags literal axis names at collective sites).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import terminal_name
+from .base import Rule, register
+
+
+def _mesh_axis_literals(tree) -> set:
+    """Literal axis names of every Mesh(...) construction in the module:
+    ``Mesh(devs, ("x", "y"))`` / ``Mesh(devs, axis_names=("x",))`` /
+    ``Mesh(devs, "x")``."""
+    axes = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "Mesh"):
+            continue
+        cand = None
+        if len(node.args) >= 2:
+            cand = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                cand = kw.value
+        if cand is None:
+            continue
+        for c in ast.walk(cand):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                axes.add(c.value)
+    return axes
+
+
+def _spec_axis_literals(spec_node):
+    """(axis_literal, P_call_node) pairs inside an in_specs/out_specs
+    expression: string constants appearing as arguments of
+    ``P(...)`` / ``PartitionSpec(...)`` calls."""
+    for node in ast.walk(spec_node):
+        if not (isinstance(node, ast.Call)
+                and terminal_name(node.func) in ("P", "PartitionSpec")):
+            continue
+        for arg in node.args:
+            for c in ast.walk(arg):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    yield c.value, node
+
+
+def _positional_arity(fn_def):
+    """(min, max) positional-argument count of a def, or None when it
+    takes *args (arity unbounded — not checkable)."""
+    a = fn_def.args
+    if a.vararg is not None:
+        return None
+    pos = len(a.posonlyargs) + len(a.args)
+    return (pos - len(a.defaults), pos)
+
+
+@register
+class ShardingSpecRule(Rule):
+    id = "TPS009"
+    name = "sharding-spec-consistency"
+    description = ("shard_map in_specs arity must match the wrapped "
+                   "function's signature, and literal P(axis) names must "
+                   "be axes some enclosing Mesh defines")
+
+    def check(self, module):
+        mesh_axes = _mesh_axis_literals(module.tree)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "shard_map"
+                    and node.args):
+                continue
+            # the repo spells both jax.shard_map(fn, mesh=..., in_specs=...)
+            # and comm.shard_map(fn, in_specs, out_specs) — positional
+            # index 1/2 covers the comm idiom, keywords the jax one
+            in_specs = out_specs = None
+            if len(node.args) >= 2:
+                in_specs = node.args[1]
+            if len(node.args) >= 3:
+                out_specs = node.args[2]
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+                elif kw.arg == "out_specs":
+                    out_specs = kw.value
+
+            # ---- arity: in_specs tuple literal vs resolvable def ----
+            fn_def = None
+            if isinstance(node.args[0], ast.Name):
+                fn_def = module._resolve_name_to_def(node.args[0])
+            elif isinstance(node.args[0], ast.Lambda):
+                fn_def = node.args[0]
+            if (fn_def is not None
+                    and isinstance(in_specs, (ast.Tuple, ast.List))):
+                arity = _positional_arity(fn_def)
+                if arity is not None:
+                    lo, hi = arity
+                    n = len(in_specs.elts)
+                    if not lo <= n <= hi:
+                        want = (f"{hi}" if lo == hi else f"{lo}..{hi}")
+                        yield self.finding(
+                            node,
+                            f"shard_map in_specs has {n} spec(s) but the "
+                            f"wrapped function "
+                            f"{getattr(fn_def, 'name', '<lambda>')!r} "
+                            f"takes {want} positional argument(s) — the "
+                            "mismatch only surfaces as a trace-time "
+                            "pytree error on a real mesh")
+
+            # ---- literal P(axis) names vs module Mesh axis names ----
+            if mesh_axes:
+                for spec in (in_specs, out_specs):
+                    if spec is None:
+                        continue
+                    for axis, pnode in _spec_axis_literals(spec):
+                        if axis not in mesh_axes:
+                            yield self.finding(
+                                pnode,
+                                f"PartitionSpec names axis {axis!r} but "
+                                f"the meshes constructed in this module "
+                                f"define axes {sorted(mesh_axes)} — an "
+                                "unbound axis shards nothing (or aborts) "
+                                "at run time")
