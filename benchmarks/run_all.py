@@ -28,6 +28,14 @@ Configs (BASELINE.json `configs` + the round-6 reference-precision row):
      latency dominates (the ~100 ms/launch tunnel runtime; a local CPU
      mesh has microsecond dispatch, so the ratio there measures only
      the block-kernel amortization)
+ 10. elastic recovery: sustained serving load with ONE injected
+     PERMANENT device loss (device.lost — sticky, same-mesh retries
+     futile) — healthy vs degraded solves/s, the recovery wall-clock
+     (reshard + rebuild + mesh adoption), the resumed iteration, and
+     the strict per-request fp64 residual-parity gate applied ACROSS
+     the shrink boundary (requests in flight when the hardware died
+     included); needs a multi-device mesh, so a 1-device parent
+     re-runs itself on the 8-virtual-device CPU host platform
 
 CPU baselines use scipy (fp64) where a matching algorithm exists; scipy is
 the only CPU oracle available (SURVEY.md §4).
@@ -227,6 +235,11 @@ _REQUIRED_FIELDS = {
         "speedup_vs_sequential", "p50_latency_ms", "p99_latency_ms",
         "mean_batch_width", "max_batch_width", "queue_wait_p50_ms",
         "injected_fault_recovered", "target_100x", "residual_parity"),
+    "cfg10_elastic": (
+        "wall_s", "healthy_solves_per_s", "degraded_solves_per_s",
+        "degraded_capacity_ratio", "recovery_wall_s", "reshard_s",
+        "adopt_s", "old_devices", "new_devices", "resumed_iteration",
+        "residual_parity"),
 }
 
 
@@ -957,6 +970,141 @@ def config9(comm, quick):
                 residual_parity=parity)
 
 
+def config10(comm, quick):
+    """Elastic degraded-mesh recovery under sustained serving load
+    (round 11, ISSUE 8): a SolveServer session survives ONE injected
+    PERMANENT device loss (``device.lost`` — sticky per-device, so
+    same-mesh retries are futile by construction) by resharding the
+    in-flight block onto the largest viable smaller mesh, resuming it
+    from the checkpointed iterate, and adopting the degraded mesh
+    server-wide.
+
+    Three phases over the same operator/session: HEALTHY load on the
+    full mesh (baseline solves/s), the LOSS phase (the fault fires at
+    the 2nd dispatched block with real partial state, every pending
+    future must still resolve), and DEGRADED load on the shrunk mesh
+    (the capacity number an operator plans around). Reported: both
+    sustained rates and their ratio, the recovery wall-clock split into
+    reshard (checkpoint reload + operand/PC/program rebuild on the new
+    geometry) and adoption (re-registering other residents), the
+    old/new device counts, the iteration the resumed solve continued
+    from (must be > 0 — progress survived the hardware), and the
+    strict per-request fp64 residual-parity gate applied ACROSS the
+    shrink boundary: every request of every phase, batch-mates of the
+    dying block included, must converge with a true fp64 relative
+    residual at rtol. A 1-device parent cannot shrink, so it re-runs
+    this config in a subprocess on the 8-virtual-device CPU host
+    platform (XLA_FLAGS must precede the jax import) and adopts that
+    row, marked ``virtual_mesh``.
+    """
+    if comm.size < 2:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--configs", "cfg10"]
+        if quick:
+            cmd.append("--quick")
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=1800)
+        for line in proc.stdout.splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and row.get("config") == "cfg10_elastic":
+                row["virtual_mesh"] = True
+                return row
+        raise RuntimeError(
+            f"cfg10 subprocess produced no row (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+
+    from mpi_petsc4py_example_tpu.resilience import RetryPolicy
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.serving import SolveServer
+    from mpi_petsc4py_example_tpu.utils import profiling
+
+    R = 12 if quick else 48          # requests PER PHASE
+    nx = 10 if quick else 16
+    max_k = 4 if quick else 8
+    A = poisson3d_csr(nx)
+    n = nx ** 3
+    rng = np.random.default_rng(10)
+    Xt = rng.random((n, 3 * R)).astype(np.float32)
+    B = np.asarray(A @ Xt).astype(np.float32)
+    rtol_inner = RTOL * 0.5          # the cfg-suite margin discipline
+
+    srv = SolveServer(comm, window=0.002, max_k=max_k, pad_pow2=True,
+                      resilient=True,
+                      retry_policy=RetryPolicy(sleep=lambda _d: None))
+    widths = [1 << p for p in range(max_k.bit_length())
+              if (1 << p) <= max_k]
+    srv.register_operator("poisson", A, pc_type="jacobi",
+                          rtol=rtol_inner, warm_widths=widths)
+    rres = {}
+
+    def phase(lo, hi):
+        t0 = time.perf_counter()
+        futs = {j: srv.submit("poisson", B[:, j]) for j in range(lo, hi)}
+        results = {j: f.result(600) for j, f in futs.items()}
+        wall = time.perf_counter() - t0
+        for j, r in results.items():
+            rres[j] = true_relres(A, r.x, B[:, j])
+        ok = all(r.converged for r in results.values())
+        return wall, ok
+
+    try:
+        # ---- phase 1: healthy load on the full mesh
+        healthy_wall, healthy_ok = phase(0, R)
+        healthy_rate = R / healthy_wall if healthy_wall > 0 else 0.0
+
+        # ---- phase 2: permanent loss mid-load — fires at the 2nd
+        # dispatched block boundary with 6 iterations of real partial
+        # state; the shrink must resume it, not restart it
+        victim = comm.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:at=2:iter=6"):
+            loss_wall, loss_ok = phase(R, 2 * R)
+        stats = srv.stats()
+        shrinks = stats["mesh_shrinks"]
+        reshard_s = (profiling.mesh_shrinks()[-1]["rebuild_s"]
+                     if profiling.mesh_shrinks() else 0.0)
+        adopt_s = shrinks[-1]["adopt_wall_s"] if shrinks else 0.0
+        resumed = shrinks[-1]["resumed_iteration"] if shrinks else 0
+        old_n, new_n = comm.size, srv.comm.size
+
+        # ---- phase 3: degraded load on the shrunk mesh
+        degraded_wall, degraded_ok = phase(2 * R, 3 * R)
+        degraded_rate = (R / degraded_wall if degraded_wall > 0 else 0.0)
+    finally:
+        srv.shutdown(wait=False)
+        _faults.heal()
+
+    parity = bool(healthy_ok and loss_ok and degraded_ok
+                  and all(r <= RTOL * 1.05 for r in rres.values())
+                  and len(shrinks) == 1 and new_n < old_n
+                  and resumed > 0)
+    return dict(config="cfg10_elastic", n=n, requests_per_phase=R,
+                max_k=max_k, devices=old_n,
+                wall_s=round(loss_wall, 4),
+                healthy_wall_s=round(healthy_wall, 4),
+                degraded_wall_s=round(degraded_wall, 4),
+                healthy_solves_per_s=round(healthy_rate, 2),
+                degraded_solves_per_s=round(degraded_rate, 2),
+                degraded_capacity_ratio=round(
+                    degraded_rate / healthy_rate, 3)
+                    if healthy_rate > 0 else 0.0,
+                recovery_wall_s=round(reshard_s + adopt_s, 4),
+                reshard_s=round(reshard_s, 4),
+                adopt_s=round(adopt_s, 4),
+                old_devices=old_n, new_devices=new_n,
+                resumed_iteration=int(resumed),
+                max_rel_residual=float(max(rres.values())),
+                residual_parity=parity)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -974,7 +1122,8 @@ def main():
                "devices": len(jax.devices()), "configs": []}
     all_cfgs = {"cfg1": config1, "cfg2": config2, "cfg3": config3,
                 "cfg4": config4, "cfg5": config5, "cfg6": config6,
-                "cfg7": config7, "cfg8": config8, "cfg9": config9}
+                "cfg7": config7, "cfg8": config8, "cfg9": config9,
+                "cfg10": config10}
     if opts.configs:
         names = [s.strip() for s in opts.configs.split(",") if s.strip()]
         bad = [s for s in names if s not in all_cfgs]

@@ -64,7 +64,8 @@ from .solvers.pc import PC
 from .solvers.ksp import KSP
 from .utils.convergence import (BatchedSolveResult, ConvergedReason,
                                 RecoveryEvent, SolveResult)
-from .utils.errors import DeviceExecutionError, SilentCorruptionError
+from .utils.errors import (DeadlineExceededError, DeviceExecutionError,
+                           ServerOverloadedError, SilentCorruptionError)
 from .utils.options import Options, global_options, init, backend
 from .utils import petsc_io
 from . import resilience
@@ -81,9 +82,10 @@ __all__ = [
     "ConvergedReason", "RecoveryEvent", "SolveResult",
     "BatchedSolveResult",
     "DeviceExecutionError", "SilentCorruptionError",
+    "DeadlineExceededError", "ServerOverloadedError",
     "Options", "global_options", "init", "backend", "petsc_io",
     "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
-    "resilient_solve_many",
+    "resilient_solve_many", "ElasticPolicy", "HealthMonitor",
     "KSPFallbackChain",
     "SolveServer", "ServedSolveResult", "ServerClosedError",
 ]
@@ -102,7 +104,8 @@ def __getattr__(name):
         from .solvers.svd import SVD
         return SVD
     if name in ("RetryPolicy", "resilient_solve",
-                "resilient_solve_many", "KSPFallbackChain"):
+                "resilient_solve_many", "KSPFallbackChain",
+                "ElasticPolicy", "HealthMonitor"):
         return getattr(resilience, name)
     if name in ("SolveServer", "ServedSolveResult", "ServerClosedError"):
         # the serving layer pulls in KSP + resilience machinery — lazy,

@@ -264,6 +264,15 @@ class StencilPoisson3D:
 
         return matvec_dot
 
+    def with_comm(self, comm) -> "StencilPoisson3D":
+        """The same operator re-derived for another communicator — the
+        matrix-free elastic-rebuild hook (resilience/elastic.py): geometry
+        is parametric, so a degraded mesh just gets a fresh instance with
+        its own z-slab decomposition (``nz`` must divide the new device
+        count, the constructor's standing constraint)."""
+        return StencilPoisson3D(comm, self.nx, self.ny, self.nz,
+                                dtype=self._dtype)
+
     # ---- Mat-compatible conveniences ----------------------------------------
     def get_vecs(self) -> tuple[Vec, Vec]:
         mk = lambda: Vec(self.comm, self.shape[0], dtype=self._dtype,

@@ -37,6 +37,48 @@ else:
 
 ROW_AXIS = "rows"
 
+# ``device_put(..., may_alias=False)`` where available (jax >= 0.4.31):
+# placements must hand back XLA-OWNED buffers — see DeviceComm._put.
+# Older jax has no kwarg AND no zero-copy CPU fast path, so plain
+# device_put already copies there.
+try:
+    import inspect as _inspect
+    _NO_ALIAS = ({"may_alias": False}
+                 if "may_alias" in _inspect.signature(jax.device_put).parameters
+                 else {})
+except (ValueError, TypeError):   # signature introspection unavailable
+    _NO_ALIAS = {}
+
+# Registry of arrays produced by host->device PLACEMENT (device_put).
+# On the jax 0.4.x CPU runtime, DONATING a placement-sourced buffer is
+# unsafe: the in-place output keeps pointing at memory the runtime
+# reclaims anyway, and the next same-size placement lands in it — the
+# solve "output" then silently re-reads as its own initial guess (or
+# garbage/Inf once the block is recycled further). Program-OUTPUT
+# buffers donate correctly, so the solve entry points re-own (copy) an
+# initial guess iff it came straight from placement (`is_placed`) —
+# the serving hot path, whose donated guesses are prior program
+# outputs, keeps its zero-allocation repeat dispatch.
+_PLACED: dict = {}
+
+
+def _mark_placed(arr):
+    import weakref
+    k = id(arr)
+    try:
+        _PLACED[k] = weakref.ref(arr, lambda _r, _k=k: _PLACED.pop(_k, None))
+    except TypeError:        # non-weakref-able (tracers in tests): skip
+        pass
+    return arr
+
+
+def is_placed(arr) -> bool:
+    """True iff ``arr`` is an array object returned by a DeviceComm
+    placement call (``_put``/``put_rows``/``put_rows_many``) — the
+    donation-unsafe provenance (see ``_PLACED``)."""
+    r = _PLACED.get(id(arr))
+    return r is not None and r() is arr
+
 
 def faulted_psum(x, axis: str):
     """``lax.psum`` with the ``comm.psum`` fault point applied at TRACE
@@ -81,6 +123,9 @@ class DeviceComm:
             mesh = Mesh(np.asarray(devices), (axis,))
         self.mesh = mesh
         self.axis = axis
+        # device ids of the mesh members, precomputed for the hot-path
+        # lost-device guards (resilience/faults.check_lost / mesh_fault)
+        self.device_ids = tuple(int(d.id) for d in self.mesh.devices.ravel())
 
     # ---- MPI-communicator-shaped info --------------------------------------
     @property
@@ -148,10 +193,18 @@ class DeviceComm:
         ``device_put``, multi-process builds the global array from the
         per-process addressable pieces."""
         _faults.check("comm.put")     # injectable placement failure
+        _faults.check_lost(self.device_ids)   # mesh holds a LOST device?
         if not self.multiprocess:
-            return jax.device_put(arr, sharding)
-        return jax.make_array_from_callback(arr.shape, sharding,
-                                            lambda idx: arr[idx])
+            # may_alias=False: CPU device_put is otherwise ZERO-COPY — the
+            # device array aliases the caller's numpy memory (sharded
+            # placement aliases interior SLICES), so mutating the source
+            # array after placement would silently change device data.
+            # Owned copies match TPU put semantics (host->HBM always
+            # copies). NOTE this does NOT make the result donation-safe
+            # on the CPU runtime — see _PLACED/is_placed above.
+            return _mark_placed(jax.device_put(arr, sharding, **_NO_ALIAS))
+        return _mark_placed(jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]))
 
     def put_rows(self, arr, dtype=None) -> jax.Array:
         """Host array -> device array sharded on the leading (row) axis.
@@ -184,7 +237,11 @@ class DeviceComm:
             # multiprocess path checks inside _put per array — no extra
             # check here, or injected schedules would double-count)
             _faults.check("comm.put")
-            return list(jax.device_put(host, self.row_sharding))
+            _faults.check_lost(self.device_ids)
+            # owned buffers, same reason as _put
+            return [_mark_placed(a)
+                    for a in jax.device_put(host, self.row_sharding,
+                                            **_NO_ALIAS)]
         return [self._put(a, self.row_sharding) for a in host]
 
     def put_axis0(self, arr, dtype=None) -> jax.Array:

@@ -11,22 +11,29 @@ Submodules:
 * :mod:`.fallback` — :class:`KSPFallbackChain` (method escalation on
   breakdown/NaN, reduced-precision retry on device OOM);
 * :mod:`.abft` — ABFT column checksums + trace-time silent-corruption
-  applicator (README "Silent-error detection").
+  applicator (README "Silent-error detection");
+* :mod:`.elastic` — degraded-mesh recovery from PERSISTENT device loss
+  (:class:`ElasticPolicy` + :class:`MeshRebuilder`; the ``mesh_shrink``
+  escalation stage retry.py engages once the
+  :class:`~.faults.HealthMonitor` classifies repeated failures as a
+  loss — README "Elastic recovery").
 
 ``faults`` is stdlib-only and imported eagerly (``parallel/mesh.py``
-depends on it); ``retry``/``fallback`` import solver machinery and load
-lazily to keep this package importable from anywhere in the framework.
+depends on it); ``retry``/``fallback``/``elastic`` import solver
+machinery and load lazily to keep this package importable from anywhere
+in the framework.
 """
 
 from . import faults
 from . import abft
-from .faults import FaultSpecError, inject_faults
+from .faults import FaultSpecError, HealthMonitor, inject_faults
 
 __all__ = [
-    "faults", "abft", "inject_faults", "FaultSpecError",
+    "faults", "abft", "inject_faults", "FaultSpecError", "HealthMonitor",
     "RetryPolicy", "resilient_solve", "resilient_solve_many",
     "default_checkpoint_path",
     "KSPFallbackChain", "reduced_dtype",
+    "ElasticPolicy", "MeshRebuilder",
 ]
 
 
@@ -38,4 +45,7 @@ def __getattr__(name):
     if name in ("KSPFallbackChain", "reduced_dtype"):
         from . import fallback
         return getattr(fallback, name)
+    if name in ("ElasticPolicy", "MeshRebuilder"):
+        from . import elastic
+        return getattr(elastic, name)
     raise AttributeError(name)

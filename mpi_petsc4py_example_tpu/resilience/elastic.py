@@ -1,0 +1,282 @@
+"""Elastic degraded-mesh recovery: rebuild a solve on fewer devices.
+
+The PR-2/PR-5 resilience machinery recovers onto the SAME mesh geometry:
+a transient ``unavailable`` fault is retried in place after a backoff.
+A PERSISTENTLY lost device breaks that model — every same-mesh retry
+fails identically, ``resilient_solve`` backs off until its policy is
+exhausted, and a serving session dies with its hardware. This module is
+the escalation tier past same-mesh retries:
+
+* :class:`ElasticPolicy` — when to give up on the current mesh
+  (``-elastic_max_same_mesh_retries``), how far down the ladder to go
+  (``-elastic_min_devices``), and whether UNattributed persistent
+  failures may trigger a speculative shrink
+  (``-elastic_shrink_unattributed``, default off: without a device to
+  exclude, halving the mesh is a guess — with a real lost device the
+  next shrink excludes more until the bad device is out or the floor is
+  hit).
+* :class:`MeshRebuilder` — plans the largest viable STRICTLY SMALLER
+  mesh from surviving devices (8 -> 4 -> 2 -> 1 on the default
+  power-of-two ladder, which keeps the compiled-program population
+  bounded exactly like the serving layer's pad_pow2 policy) and
+  rebuilds operators / PC factors / solver sessions on it.
+* helpers shared by retry.py's ``mesh_shrink`` escalation stage and the
+  SolveServer's shrink adoption: :func:`rebuild_operator` (re-place the
+  operand arrays on the new mesh — CSR matrices round-trip through
+  their host CSR; matrix-free operators expose ``with_comm``),
+  :func:`rebuild_ksp` (fresh PC of the same type and tunables, factors
+  re-set-up on the new geometry; the ABFT checksum placement re-keys
+  automatically on the new operator identity), :func:`rebind_vec`
+  (re-point a caller's Vec at new-mesh storage in place, so the vectors
+  a driver holds stay valid across the shrink), and :func:`warm`
+  (pre-build — compile or AOT-load — the new geometry's programs by
+  dispatching zero-RHS solves that converge at iteration 0).
+
+The state that moves across the shrink is the last CHECKPOINTED (or
+in-memory partial) iterate, resharded through the already-elastic
+checkpoint format (utils/checkpoint.py round-trips any mesh size): the
+resumed solve continues from the verified iteration, never from zero.
+
+PARITY.md "Elastic recovery": PETSc-on-MPI has no analog — a rank loss
+aborts the communicator (MPI ULFM, the closest standard, still requires
+the application to rebuild everything by hand). This is a deliberate
+divergence the checkpoint layer was designed for.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.options import global_options
+from . import faults as _faults
+
+
+@dataclass
+class ElasticPolicy:
+    """When and how far to escalate from same-mesh retries to a shrink.
+
+    ``enabled``
+        Master switch (``-elastic_enable``). On by default: the shrink
+        stage only ever engages after the HealthMonitor classifies the
+        failure pattern as persistent, so transient-fault behavior is
+        byte-identical with or without it.
+    ``max_same_mesh_retries``
+        Unavailable failures on one mesh before the escalation
+        (``-elastic_max_same_mesh_retries``) — also the
+        :class:`~.faults.HealthMonitor` classification threshold.
+    ``min_devices``
+        The smallest mesh the ladder may land on
+        (``-elastic_min_devices``); below it the original error
+        re-raises (nothing left to degrade to).
+    ``shrink_unattributed``
+        Allow a speculative halving when the repeated failures name no
+        device (``-elastic_shrink_unattributed``, default off — see the
+        module docstring).
+    ``prefer_pow2``
+        Land on power-of-two mesh sizes (the bounded-program-population
+        ladder); False uses every surviving device.
+    """
+    enabled: bool = True
+    max_same_mesh_retries: int = 2
+    min_devices: int = 1
+    shrink_unattributed: bool = False
+    prefer_pow2: bool = True
+
+    @classmethod
+    def from_options(cls) -> "ElasticPolicy":
+        """Policy from the runtime options DB (``-elastic_*`` flags)."""
+        opt = global_options()
+        p = cls()
+        p.enabled = opt.get_bool("elastic_enable", p.enabled)
+        p.max_same_mesh_retries = opt.get_int(
+            "elastic_max_same_mesh_retries", p.max_same_mesh_retries)
+        p.min_devices = opt.get_int("elastic_min_devices", p.min_devices)
+        p.shrink_unattributed = opt.get_bool(
+            "elastic_shrink_unattributed", p.shrink_unattributed)
+        return p
+
+
+def _largest_pow2_at_most(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n >= 1 else 0
+
+
+class MeshRebuilder:
+    """Plans and executes degraded-mesh rebuilds (module docstring)."""
+
+    def __init__(self, policy: ElasticPolicy | None = None):
+        self.policy = policy or ElasticPolicy()
+
+    # ---- planning ----------------------------------------------------------
+    def survivors(self, comm, lost=frozenset()):
+        """Mesh members not marked lost — by the sticky fault registry
+        (:func:`resilience.faults.lost_devices`) or the caller's extra
+        attribution set (a HealthMonitor classification)."""
+        dead = set(int(d) for d in lost) | set(_faults.lost_devices())
+        return [d for d in comm.devices if int(d.id) not in dead]
+
+    def shrunk_comm(self, comm, lost=frozenset()):
+        """The largest viable STRICTLY smaller communicator over
+        surviving devices, or None when no viable smaller mesh exists
+        (already at ``min_devices``, every device lost, or the failures
+        are unattributed and speculative shrinking is off)."""
+        from ..parallel.mesh import DeviceComm
+        cur = comm.size
+        surv = self.survivors(comm, lost)
+        n = len(surv)
+        if n < 1 or cur <= 1:
+            return None
+        if n < cur:
+            # attributed: the largest ladder size the survivors support
+            size = _largest_pow2_at_most(n) if self.policy.prefer_pow2 \
+                else n
+        elif self.policy.shrink_unattributed:
+            # unattributed: nothing to exclude — halve speculatively
+            # (the ladder bottoms out at min_devices, bounding guesses)
+            size = _largest_pow2_at_most(cur - 1)
+        else:
+            return None
+        if size < max(1, self.policy.min_devices) or size >= cur:
+            return None
+        return DeviceComm(devices=surv[:size], axis=comm.axis)
+
+def rebuild_operator(mat, comm_new):
+    """Re-place an operator's operands on another communicator.
+
+    Matrix-free operators expose ``with_comm`` (e.g.
+    :class:`models.stencil.StencilPoisson3D` — geometry re-derived for
+    the new device count); CSR-backed :class:`core.mat.Mat` round-trips
+    through its host CSR. Raises :class:`ValueError` when neither path
+    exists (the escalation then falls through to the original error) or
+    when the operator's sharding constraints reject the new size.
+    """
+    if hasattr(mat, "with_comm"):
+        return mat.with_comm(comm_new)
+    if hasattr(mat, "to_scipy"):
+        from ..core.mat import Mat
+        m2 = Mat.from_scipy(comm_new, mat.to_scipy(), dtype=mat.dtype)
+        ns = getattr(mat, "nullspace", None)
+        if ns is not None:
+            m2.set_nullspace(ns)
+        return m2
+    raise ValueError(
+        f"operator {type(mat).__name__} cannot be rebuilt on a new mesh: "
+        "no with_comm() and no to_scipy() — provide one to make it "
+        "elastic")
+
+
+def rebuild_ksp(ksp, mat_new):
+    """Rebind a KSP session to ``mat_new`` and its communicator.
+
+    Builds a fresh PC of the same type with the same tunables (factors
+    are re-set-up — placed on the new mesh — by ``set_up``), points the
+    KSP's comm at the new mesh, and leaves compiled-program and ABFT
+    checksum caches to re-key naturally on the new operator identity and
+    mesh fingerprint (a previously AOT-exported program for this
+    geometry loads from disk instead of re-tracing — utils/aot).
+    """
+    from ..solvers.pc import PC
+    old_pc = ksp.get_pc()
+    comm_new = mat_new.comm
+    pc = PC(comm_new)
+    pc.set_type(old_pc.get_type())
+    for attr in ("sor_omega", "asm_overlap", "factor_fill",
+                 "gamg_threshold", "gamg_coarse_size", "gamg_max_levels",
+                 "mg_smoother", "bjacobi_blocks", "setup_device",
+                 "_factor_solver_type"):
+        if hasattr(old_pc, attr):
+            setattr(pc, attr, getattr(old_pc, attr))
+    ksp.comm = comm_new
+    ksp.set_pc(pc)
+    ksp.set_operators(mat_new)
+    ksp.set_up()                  # PC factors placed on the new mesh NOW
+    return ksp
+
+
+def rebind_vec(vec, new):
+    """Re-point a caller's Vec at new-mesh storage IN PLACE — the object
+    identity the driver holds stays valid across the shrink (the same
+    contract retry.py's same-mesh restore keeps via ``x.data = x2.data``,
+    extended to the comm/layout that change with the mesh size)."""
+    vec.comm = new.comm
+    vec.layout = new.layout
+    vec.n = new.n
+    vec.data = new.data
+    return vec
+
+
+def replant_vectors(comm_new, mat_new, *vecs):
+    """Host-round-trip re-placement of vectors onto ``comm_new`` (the
+    in-memory path for operators without a persisted checkpoint). Each
+    input Vec is rebound in place; returns them."""
+    from ..core.vec import Vec
+    out = []
+    for v in vecs:
+        nv = Vec.from_global(comm_new, v.to_numpy(), dtype=mat_new.dtype,
+                             layout=mat_new.layout)
+        out.append(rebind_vec(v, nv))
+    return out
+
+
+def warm(ksp, widths=()):
+    """Pre-build (trace+compile, or AOT-load) the rebuilt session's
+    programs for the new geometry by dispatching zero-RHS solves — a
+    zero right-hand side converges at iteration 0, so each warm costs
+    one launch and no iterations. ``widths`` re-warms the batched block
+    programs a serving session dispatches (serving/server.py re-warms
+    the widths it has seen)."""
+    from ..core.vec import Vec
+    mat = ksp.get_operators()[0]
+    comm = mat.comm
+    n = int(mat.shape[0])
+    dt = np.dtype(mat.dtype)
+    x0 = Vec(comm, n, dtype=dt, layout=getattr(mat, "layout", None))
+    b0 = Vec(comm, n, dtype=dt, layout=getattr(mat, "layout", None))
+    ksp.solve(b0, x0)
+    for w in sorted(set(int(w) for w in widths if int(w) > 0)):
+        ksp.solve_many(np.zeros((n, w), dtype=dt))
+    return ksp
+
+
+def shrink_solve_session(ksp, comm_new, *, checkpoint_path=None, b=None,
+                         x=None, B=None, X=None, many=False):
+    """Reshard a failed solve onto ``comm_new`` and rebuild the session.
+
+    The iterate/RHS state moves through the elastic checkpoint when one
+    was persisted (``checkpoint_path`` — the authoritative route: the
+    checkpoint holds the last verified/partial iterate the failure left
+    behind), else through an in-memory host round trip (matrix-free
+    operators). Single-RHS mode rebinds the caller's ``b``/``x`` Vecs in
+    place; batched mode restores the ``(n, nrhs)`` blocks into the
+    caller's writable ``X`` host array. Returns the checkpoint's stored
+    iteration (0 when unknown/in-memory).
+
+    Raises ``ValueError`` when the operator cannot be rebuilt on the new
+    size (callers treat that as "cannot shrink" and fall through to the
+    original failure).
+    """
+    mat = ksp.get_operators()[0]
+    iteration = 0
+    if many:
+        if checkpoint_path is not None:
+            from ..utils.checkpoint import load_solve_state_many
+            mat2, X2, _B2, iteration = load_solve_state_many(
+                checkpoint_path, comm_new)
+            X[...] = X2.astype(X.dtype, copy=False)
+        else:
+            mat2 = rebuild_operator(mat, comm_new)
+        rebuild_ksp(ksp, mat2)
+        return iteration
+    if checkpoint_path is not None:
+        from ..utils.checkpoint import load_solve_state
+        mat2, x2, b2, iteration = load_solve_state(checkpoint_path,
+                                                   comm_new)
+        rebuild_ksp(ksp, mat2)
+        rebind_vec(x, x2)
+        rebind_vec(b, b2)
+    else:
+        mat2 = rebuild_operator(mat, comm_new)
+        rebuild_ksp(ksp, mat2)
+        replant_vectors(comm_new, mat2, x, b)
+    return iteration

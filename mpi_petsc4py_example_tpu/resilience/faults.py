@@ -16,7 +16,15 @@ boundaries that synthetic, reproducible faults can be attached to:
 * SILENTLY corrupt an in-program operator or preconditioner apply
   (``spmv.result`` / ``pc.apply``, trace time: ``bitflip``/``scale`` —
   no crash, no NaN; the corruption the ABFT checksums and invariant
-  monitors in resilience/abft.py + solvers/krylov.py must detect).
+  monitors in resilience/abft.py + solvers/krylov.py must detect);
+* PERMANENTLY lose a device (``device.lost``): unlike the hit-count
+  one-shots above, a fired loss is STICKY — the device goes into a
+  per-process lost registry and every later solve or placement touching
+  a mesh that contains it fails with the ``unavailable`` signature,
+  until :func:`heal` clears it. This is the persistent-failure model
+  the elastic degraded-mesh escalation (resilience/elastic.py +
+  retry.py ``mesh_shrink``) recovers from: same-mesh retries CANNOT
+  succeed, only excluding the device can.
 
 Activation — spec string via either route::
 
@@ -32,6 +40,8 @@ Spec grammar (comma-separated clauses)::
     kind   := unavailable | oom | nan | inf | drop | corrupt
             | bitflip | scale                  (silent corruption)
     params := at=N      trigger on the Nth hit of the point (default 1)
+              device=D  device id to lose ('device.lost' clauses; default:
+                        the highest device id in the checked mesh)
               mag=M     relative error of 'scale' corruption (default 1e-3)
               times=M   stay armed for M consecutive hits ('*' = forever)
               iter=K    simulated crash/poison iteration (ksp.program /
@@ -56,6 +66,7 @@ from __future__ import annotations
 import contextlib
 import os
 import random
+import re
 import threading
 
 # Registry of named fault points and the fault kinds each supports.
@@ -81,6 +92,15 @@ FAULT_POINTS = {
     # cache isolation and retries get a clean program (trace_key()).
     "spmv.result": ("bitflip", "scale"),     # operator apply, in-program
     "pc.apply":    ("bitflip", "scale"),     # PC apply, in-program
+    # PERSISTENT device loss (sticky until heal()): a fired clause marks
+    # its device= in the module's lost registry; solves and placements on
+    # meshes containing a lost device keep failing 'unavailable' until
+    # faults.heal() — or until the elastic layer rebuilds onto a smaller
+    # mesh that excludes it (resilience/elastic.py). Hit counters advance
+    # once per SOLVE-PROGRAM boundary on a mesh containing the device
+    # (solvers/ksp.py mesh_fault site), so at=N picks the Nth solve and
+    # iter=K leaves K iterations of real partial state, like ksp.program.
+    "device.lost": ("unavailable",),         # permanent worker/chip loss
 }
 
 RAISING_KINDS = ("unavailable", "oom")
@@ -110,7 +130,7 @@ class Fault:
     def __init__(self, point: str, kind: str, at: int = 1, times: int = 1,
                  forever: bool = False, iter_k: int | None = None,
                  seed: int | None = None, prob: float = 1.0,
-                 mag: float = 1e-3):
+                 mag: float = 1e-3, device: int | None = None):
         self.point = point
         self.kind = kind
         self.at = at
@@ -119,6 +139,7 @@ class Fault:
         self.iter_k = iter_k
         self.prob = prob
         self.mag = mag       # relative magnitude of 'scale' corruption
+        self.device = device  # device id ('device.lost' clauses)
         self._rng = random.Random(seed) if seed is not None else None
         self.hits = 0      # times the point was reached
         self.fired = 0     # times this fault actually triggered
@@ -142,8 +163,18 @@ class Fault:
                 and self.hits >= self.at + self.times - 1)
 
     def error(self) -> XlaRuntimeError:
-        return XlaRuntimeError(
-            _KIND_MESSAGES[self.kind].format(point=self.point))
+        msg = _KIND_MESSAGES[self.kind].format(point=self.point)
+        if self.device is not None:
+            # name the device: HealthMonitor attributes repeated failures
+            # by parsing this (real runtimes name failing chips too)
+            msg += (f"; device {self.device} is LOST — persistent until "
+                    "faults.heal() or a mesh rebuild excludes it")
+        err = XlaRuntimeError(msg)
+        # iter=K clauses leave K iterations of real partial state in the
+        # caller's iterate; carry that so the resilience layer checkpoints
+        # the true progress (retry.py records/resumes the iteration)
+        err.iteration = int(self.iter_k or 0)
+        return err
 
     def __repr__(self):
         sched = (f"seed prob={self.prob}" if self._rng is not None else
@@ -189,10 +220,12 @@ def _parse_clause(clause: str) -> Fault:
                 kw["prob"] = float(value)
             elif key == "mag":
                 kw["mag"] = float(value)
+            elif key == "device":
+                kw["device"] = int(value)
             else:
                 raise FaultSpecError(
                     f"fault clause {clause!r}: unknown parameter {key!r} "
-                    "(have: at, times, iter, seed, prob, mag)")
+                    "(have: at, times, iter, seed, prob, mag, device)")
         except ValueError as e:
             if isinstance(e, FaultSpecError):
                 raise
@@ -313,3 +346,157 @@ def trace_key():
             return None
         _TRACE_NONCE += 1
         return _TRACE_NONCE
+
+
+# ---- persistent device loss ----------------------------------------------
+# Unlike the hit-count one-shots, a lost device is STICKY process state:
+# device id -> description, populated by a fired 'device.lost' clause or
+# mark_lost(), cleared only by heal(). Every solve-program boundary and
+# data placement consults it, so a mesh containing a lost device keeps
+# failing 'unavailable' — the failure model where same-mesh retries are
+# futile and only the elastic shrink (resilience/elastic.py) helps.
+_LOST: dict[int, str] = {}
+
+
+def lost_devices() -> frozenset:
+    """Device ids currently marked lost (sticky until :func:`heal`)."""
+    with _LOCK:
+        return frozenset(_LOST)
+
+
+def mark_lost(device_id: int, reason: str = "marked via faults.mark_lost"):
+    """Mark a device as persistently lost (the programmatic route — a
+    health monitor that classified real repeated failures uses this)."""
+    with _LOCK:
+        _LOST[int(device_id)] = str(reason)
+
+
+def heal(device_id: int | None = None) -> tuple:
+    """Clear the lost mark from one device (or all, when ``device_id`` is
+    None) — the explicit 'hardware was replaced/repaired' signal. Returns
+    the ids that were healed."""
+    with _LOCK:
+        if device_id is None:
+            healed = tuple(sorted(_LOST))
+            _LOST.clear()
+            return healed
+        return ((int(device_id),)
+                if _LOST.pop(int(device_id), None) is not None else ())
+
+
+def check_lost(device_ids):
+    """Raise the 'unavailable' loss error if any of ``device_ids`` is in
+    the sticky lost registry. Registry-only (never consumes armed
+    clauses) — the placement-boundary guard (parallel/mesh.py), so data
+    cannot be placed onto a mesh containing a lost device."""
+    if not _LOST:               # lock-free fast path: empty registry
+        return
+    with _LOCK:
+        down = sorted(d for d in device_ids if d in _LOST)
+    if down:
+        raise Fault("device.lost", "unavailable", device=down[0]).error()
+
+
+def mesh_fault(point, device_ids):
+    """Hot-path hook for the solve-program boundary (solvers/ksp.py):
+    returns the :class:`Fault` to apply when the mesh over ``device_ids``
+    has (or just) lost a device, else None.
+
+    Two routes produce a fault: an armed ``device.lost`` clause whose
+    device is in the mesh fires (counting one hit per call — at=N picks
+    the Nth solve; the device goes into the sticky registry, and the
+    returned clause may carry ``iter=K`` partial-progress semantics), or
+    the registry already holds a mesh member (every later solve fails
+    until heal()/shrink). Near-no-op with no plan and an empty registry.
+    """
+    plan = _active_plan()
+    if plan is None and not _LOST:
+        return None
+    ids = tuple(int(i) for i in device_ids)
+    fired = None
+    if plan is not None:
+        with _LOCK:
+            for fault in plan:
+                if fault.point != point:
+                    continue
+                dev = fault.device
+                if dev is None:
+                    dev = max(ids) if ids else 0
+                if dev not in ids:
+                    continue
+                if fault.check():
+                    fault.device = dev
+                    _LOST[dev] = f"injected {point}={fault.kind}"
+                    if fired is None:
+                        fired = fault
+    if fired is not None:
+        return fired
+    with _LOCK:
+        down = sorted(d for d in ids if d in _LOST)
+    if down:
+        return Fault(point, "unavailable", device=down[0])
+    return None
+
+
+# ---- health monitoring ----------------------------------------------------
+_DEVICE_ID_RE = re.compile(r"device\s+(\d+)", re.IGNORECASE)
+
+
+def device_from_error(exc) -> int | None:
+    """Device id named by a failure, or None when unattributable. Looks
+    at the ORIGINAL runtime error when the exception is a classified
+    wrapper (utils.errors.DeviceExecutionError keeps it on
+    ``.original``) — the wrapper's own message is the hint, not the
+    device-naming runtime text."""
+    msg = str(getattr(exc, "original", None) or exc)
+    m = _DEVICE_ID_RE.search(msg)
+    return int(m.group(1)) if m else None
+
+
+class HealthMonitor:
+    """Classifies repeated ``unavailable`` failures as persistent loss.
+
+    A transient worker crash recovers after one backoff; a device that
+    keeps failing is GONE and waiting on it is futile. The monitor
+    counts consecutive unavailable failures per attributed device (or
+    per mesh, when the error names no device); once a device reaches
+    ``threshold`` it is classified lost (:meth:`lost_devices` — the set
+    the elastic MeshRebuilder excludes), and :meth:`persistent` reports
+    when same-mesh retrying has used up its evidence either way. A
+    successful solve calls :meth:`healthy` — the evidence is
+    consecutive-failure evidence, success resets it.
+    """
+
+    def __init__(self, threshold: int = 2):
+        self.threshold = max(1, int(threshold))
+        self._counts: dict = {}       # device id (or None) -> failures
+        self.failures = 0             # total recorded since last healthy()
+
+    def record(self, exc) -> int | None:
+        """Count one unavailable failure; returns the attributed device
+        id (None when the error names no device)."""
+        dev = device_from_error(exc)
+        self.failures += 1
+        self._counts[dev] = self._counts.get(dev, 0) + 1
+        return dev
+
+    def healthy(self):
+        """A solve succeeded on the current mesh: reset the evidence."""
+        self._counts.clear()
+        self.failures = 0
+
+    def lost_devices(self) -> frozenset:
+        """Devices classified lost: attributed failure count reached the
+        threshold."""
+        return frozenset(d for d, c in self._counts.items()
+                         if d is not None and c >= self.threshold)
+
+    def persistent(self) -> bool:
+        """True once ANY attribution (a device, or the unattributed mesh
+        bucket) has failed ``threshold`` times — the same-mesh-retries-
+        are-futile classification that triggers the shrink escalation."""
+        return any(c >= self.threshold for c in self._counts.values())
+
+    def __repr__(self):
+        return (f"HealthMonitor(threshold={self.threshold}, "
+                f"counts={self._counts})")

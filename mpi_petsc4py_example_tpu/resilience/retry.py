@@ -20,6 +20,19 @@ device failure (a TPU worker crash/restart — ``DeviceExecutionError`` with
 Every action is recorded as a :class:`utils.convergence.RecoveryEvent` on
 the returned result's ``recovery_events`` trail.
 
+Same-mesh retries assume the failure is TRANSIENT — the worker restarts
+and the identical mesh works again. A PERSISTENTLY lost device breaks
+that assumption: every same-mesh attempt fails identically. The
+escalation ladder past the retry stage is the ELASTIC one
+(resilience/elastic.py): once the :class:`~.faults.HealthMonitor`
+classifies the failure pattern as a persistent loss (or the same-mesh
+budget is exhausted with a known-lost device), the wrappers reshard the
+last checkpointed/in-memory iterate onto the largest viable smaller mesh
+and RESUME from that iteration — a ``mesh_shrink``
+:class:`~..utils.convergence.RecoveryEvent` with the old/new device
+counts, a fresh same-mesh retry budget on the degraded mesh, and the
+ladder bounded below by ``-elastic_min_devices``.
+
 With no failure, :func:`resilient_solve` is exactly one ``ksp.solve`` —
 same compiled program, zero extra XLA programs, zero device round trips.
 """
@@ -137,6 +150,113 @@ def _verify_true_residual_many(ksp, B, X):
     return ok, rres
 
 
+def _reraise_if_rebuild_failed(rebuild_exc, original):
+    """The SAME-MESH checkpoint reload failed. When the rebuild died the
+    way the solve did — a device-shaped failure, e.g. placement onto a
+    mesh that has genuinely lost hardware — surface the ORIGINAL
+    classified solve error (chained): the mesh is the problem, and the
+    caller's recovery contract is written in DeviceExecutionError terms.
+    Anything else (a corrupt checkpoint's ValueError) propagates as
+    itself."""
+    name = type(rebuild_exc).__name__
+    if ("XlaRuntimeError" in name or "JaxRuntimeError" in name
+            or isinstance(rebuild_exc, DeviceExecutionError)):
+        raise original from rebuild_exc
+    raise rebuild_exc
+
+
+def _failure_iteration(exc) -> int:
+    """Iterations of real partial state a failure left in the caller's
+    iterate: SilentCorruptionError carries it directly; fail-stop faults
+    carry it on the wrapped runtime error (faults.Fault.error). 0 when
+    unknown — the checkpoint then just records 'progress unquantified',
+    the iterate itself still holds whatever was reached."""
+    it = getattr(exc, "iteration", None)
+    if it is None:
+        it = getattr(getattr(exc, "original", None), "iteration", None)
+    return int(it or 0)
+
+
+class _ElasticEscalation:
+    """Per-solve elastic state shared by the two resilient wrappers.
+
+    Owns the :class:`~.faults.HealthMonitor` (consecutive-unavailable
+    evidence, reset on success) and executes the shrink step: plan the
+    degraded mesh, reshard the checkpointed/in-memory state onto it via
+    :func:`~.elastic.shrink_solve_session`, and record the
+    ``mesh_shrink`` event. ``None``-policy construction reads the
+    ``-elastic_*`` runtime flags.
+    """
+
+    def __init__(self, policy=None):
+        from .elastic import ElasticPolicy, MeshRebuilder
+        from .faults import HealthMonitor
+        self.policy = (policy if policy is not None
+                       else ElasticPolicy.from_options())
+        self.monitor = HealthMonitor(
+            threshold=self.policy.max_same_mesh_retries)
+        self.rebuilder = MeshRebuilder(self.policy)
+
+    def record(self, exc):
+        """Count one failure toward the persistent-loss classification
+        (``unavailable`` failures only — OOM/SDC have their own
+        escalations)."""
+        if getattr(exc, "failure_class", "") == "unavailable":
+            self.monitor.record(exc)
+
+    def plan(self, ksp, exc, budget_exhausted: bool):
+        """The degraded communicator to rebuild onto, or None when the
+        shrink stage must not (yet) engage: escalate once the failure is
+        CLASSIFIED persistent — a current mesh member is in the sticky
+        lost registry (ground truth: a fired ``device.lost`` or an
+        explicit ``mark_lost``; same-mesh retries on such a mesh cannot
+        succeed, so no evidence-gathering retries are owed), or the
+        monitor's consecutive-failure evidence reached its threshold —
+        or as the last rung before giving up when the same-mesh budget
+        is spent."""
+        from . import faults as _faults
+        if (not self.policy.enabled
+                or getattr(exc, "failure_class", "") != "unavailable"):
+            return None
+        ids = set(getattr(ksp.comm, "device_ids", ()))
+        registry_hit = any(d in ids for d in _faults.lost_devices())
+        if not (registry_hit or self.monitor.persistent()
+                or budget_exhausted):
+            return None
+        return self.rebuilder.shrunk_comm(ksp.comm,
+                                          self.monitor.lost_devices())
+
+    def shrink(self, ksp, comm_new, events, attempt, *, persisted, path,
+               b=None, x=None, B=None, X=None, many=False) -> bool:
+        """Execute the rebuild onto ``comm_new``; False when the operator
+        cannot be rebuilt there (callers fall through to the original
+        failure)."""
+        from .elastic import shrink_solve_session
+        from ..utils.profiling import record_mesh_shrink
+        old_n = ksp.comm.size
+        t0 = time.perf_counter()
+        try:
+            it0 = shrink_solve_session(
+                ksp, comm_new,
+                checkpoint_path=path if persisted else None,
+                b=b, x=x, B=B, X=X, many=many)
+        except ValueError:
+            return False
+        wall = time.perf_counter() - t0
+        record_mesh_shrink(old_n, comm_new.size, wall)
+        events.append(RecoveryEvent(
+            kind="mesh_shrink", attempt=attempt,
+            detail=(f"rebuilt {old_n} -> {comm_new.size} devices in "
+                    f"{wall:.3f}s; resuming from iteration {it0}"),
+            error_class="unavailable", iterations=it0,
+            old_devices=old_n, new_devices=comm_new.size))
+        # the degraded mesh gets fresh consecutive-failure evidence (the
+        # sticky faults.lost_devices registry keeps the excluded devices
+        # out of any FURTHER shrink planning either way)
+        self.monitor.healthy()
+        return True
+
+
 def default_checkpoint_path(ksp=None) -> str:
     """Default solve-state checkpoint path, unique per process AND per
     solver object — concurrent resilient solves in one process must never
@@ -147,35 +267,49 @@ def default_checkpoint_path(ksp=None) -> str:
 
 
 def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
-                    checkpoint_path: str | None = None) -> SolveResult:
+                    checkpoint_path: str | None = None,
+                    elastic=None) -> SolveResult:
     """``ksp.solve(b, x)`` that survives retriable device failures.
 
     On a retriable ``DeviceExecutionError`` (per ``policy``), checkpoints
     the best iterate, backs off, rebuilds the operators from the
     checkpoint, and resumes from the restored iterate — up to
-    ``policy.max_attempts`` total attempts. Non-retriable failures and
-    exhausted policies re-raise the original error.
+    ``policy.max_attempts`` attempts per mesh. PERSISTENT device loss
+    escalates past same-mesh retries (module docstring): once the
+    health monitor classifies the pattern — or as the last rung before
+    giving up — the solve is resharded onto the largest viable smaller
+    mesh and resumes from the checkpointed iterate, with a fresh
+    same-mesh budget there. Non-retriable failures and exhausted
+    policies (with no viable smaller mesh) re-raise the original error.
 
     ``checkpoint_path`` defaults to :func:`default_checkpoint_path`.
-    Matrix-free operators (no ``to_scipy``) skip persistence — the retry
-    still resumes from the in-memory iterate.
+    Matrix-free operators (no ``to_scipy``) skip persistence — retries
+    and shrinks still resume from the in-memory iterate. ``elastic``
+    is an :class:`~.elastic.ElasticPolicy` (default: the ``-elastic_*``
+    runtime flags).
 
     Returns the converged attempt's :class:`SolveResult` with ``attempts``
     and the ``recovery_events`` trail filled in.
     """
     policy = policy or RetryPolicy()
     path = checkpoint_path or default_checkpoint_path(ksp)
+    esc = _ElasticEscalation(elastic)
     events: list[RecoveryEvent] = []
     guess_flag0 = ksp._initial_guess_nonzero
-    attempt = 1
+    attempt = 1        # total attempts across meshes (result.attempts)
+    mesh_attempt = 1   # attempts on the CURRENT mesh (the retry budget)
     try:
         while True:
             try:
                 result = ksp.solve(b, x)
                 break
             except DeviceExecutionError as exc:
-                if (attempt >= policy.max_attempts
-                        or not policy.should_retry(exc)):
+                esc.record(exc)
+                retriable = policy.should_retry(exc)
+                exhausted = mesh_attempt >= policy.max_attempts
+                comm_new = (esc.plan(ksp, exc, exhausted)
+                            if retriable else None)
+                if comm_new is None and (exhausted or not retriable):
                     raise
                 detector = getattr(exc, "detector", "")
                 sdc = exc.failure_class == "detected_sdc"
@@ -188,10 +322,20 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                     # for DETECTED_SDC the solve boundary already rolled
                     # x back to the last VERIFIED iterate — the
                     # checkpoint persists exactly that rollback target
-                    save_solve_state(path, mat, x, b, iteration=0)
+                    save_solve_state(path, mat, x, b,
+                                     iteration=_failure_iteration(exc))
                     events.append(RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
-                if sdc:
+                if comm_new is not None:
+                    # ELASTIC escalation: same-mesh retrying is futile —
+                    # reshard the checkpointed (or in-memory) iterate
+                    # onto the degraded mesh and resume from it
+                    if not esc.shrink(ksp, comm_new, events, attempt,
+                                      persisted=persisted, path=path,
+                                      b=b, x=x):
+                        raise    # operator not rebuildable on that size
+                    mesh_attempt = 0   # fresh budget on the new mesh
+                elif sdc:
                     # no crashed worker to wait out: re-enter immediately
                     # from the verified iterate (retry.py's DETECTED_SDC
                     # escalation — the final answer is re-verified against
@@ -201,7 +345,7 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                         detail="re-entering from verified iterate",
                         detector=detector))
                 else:
-                    delay = policy.delay(attempt - 1)
+                    delay = policy.delay(mesh_attempt - 1)
                     events.append(RecoveryEvent(
                         kind="backoff", attempt=attempt, delay=delay,
                         error_class=exc.failure_class))
@@ -211,12 +355,18 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                         # buffers (nothing from before the failure is
                         # trusted), iterate restored onto the CALLER's
                         # vector so x stays live
-                        mat2, x2, _b2, _it = load_solve_state(path,
-                                                              mat.comm)
+                        try:
+                            mat2, x2, _b2, _it = load_solve_state(
+                                path, mat.comm)
+                        # tpslint: disable=TPS005 — classified and
+                        # re-raised by kind immediately below
+                        except Exception as rexc:  # noqa: BLE001
+                            _reraise_if_rebuild_failed(rexc, exc)
                         ksp.set_operators(mat2)
                         x.data = x2.data
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
+                mesh_attempt += 1
                 events.append(RecoveryEvent(
                     kind="resume", attempt=attempt,
                     detail="initial_guess_nonzero from restored iterate"))
@@ -244,8 +394,8 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
 
 
 def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
-                         *, checkpoint_path: str | None = None
-                         ) -> BatchedSolveResult:
+                         *, checkpoint_path: str | None = None,
+                         elastic=None) -> BatchedSolveResult:
     """``ksp.solve_many(B, X)`` that survives retriable device failures —
     the batched twin of :func:`resilient_solve`.
 
@@ -255,12 +405,16 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
     boundary in KSP.solve_many writes it before raising), the rebuilt
     solve resumes every column from where it froze, and already-converged
     columns re-converge in O(1) iterations under the masked-convergence
-    kernel. Same zero-overhead contract: no failure means exactly one
-    ``ksp.solve_many``.
+    kernel. Persistent device loss escalates to a mesh shrink exactly
+    like :func:`resilient_solve` — the whole block is resharded and every
+    in-flight column (batch-mates included) replays from its restored
+    iterate on the degraded mesh. Same zero-overhead contract: no
+    failure means exactly one ``ksp.solve_many``.
     """
     import numpy as np
     policy = policy or RetryPolicy()
     path = checkpoint_path or default_checkpoint_path(ksp)
+    esc = _ElasticEscalation(elastic)
     events: list[RecoveryEvent] = []
     guess_flag0 = ksp._initial_guess_nonzero
     mat = ksp.get_operators()[0]
@@ -282,14 +436,19 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
         if not X.flags.writeable:
             X = X.copy()
     attempt = 1
+    mesh_attempt = 1
     try:
         while True:
             try:
                 result = ksp.solve_many(B, X)
                 break
             except DeviceExecutionError as exc:
-                if (attempt >= policy.max_attempts
-                        or not policy.should_retry(exc)):
+                esc.record(exc)
+                retriable = policy.should_retry(exc)
+                exhausted = mesh_attempt >= policy.max_attempts
+                comm_new = (esc.plan(ksp, exc, exhausted)
+                            if retriable else None)
+                if comm_new is None and (exhausted or not retriable):
                     raise
                 detector = getattr(exc, "detector", "")
                 sdc = exc.failure_class == "detected_sdc"
@@ -301,27 +460,40 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
                 if persisted:
                     # on DETECTED_SDC, X already holds the per-column
                     # verified iterate block the solve boundary restored
-                    save_solve_state_many(path, mat, X, B, iteration=0)
+                    save_solve_state_many(path, mat, X, B,
+                                          iteration=_failure_iteration(exc))
                     events.append(RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
-                if sdc:
+                if comm_new is not None:
+                    if not esc.shrink(ksp, comm_new, events, attempt,
+                                      persisted=persisted, path=path,
+                                      B=B, X=X, many=True):
+                        raise
+                    mesh_attempt = 0
+                elif sdc:
                     events.append(RecoveryEvent(
                         kind="rollback", attempt=attempt,
                         detail="re-entering from verified iterate block",
                         detector=detector))
                 else:
-                    delay = policy.delay(attempt - 1)
+                    delay = policy.delay(mesh_attempt - 1)
                     events.append(RecoveryEvent(
                         kind="backoff", attempt=attempt, delay=delay,
                         error_class=exc.failure_class))
                     policy.sleep(delay)
                     if persisted:
-                        mat2, X2, _B2, _it = load_solve_state_many(
-                            path, mat.comm)
+                        try:
+                            mat2, X2, _B2, _it = load_solve_state_many(
+                                path, mat.comm)
+                        # tpslint: disable=TPS005 — classified and
+                        # re-raised by kind immediately below
+                        except Exception as rexc:  # noqa: BLE001
+                            _reraise_if_rebuild_failed(rexc, exc)
                         ksp.set_operators(mat2)
                         X[...] = X2.astype(X.dtype, copy=False)
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
+                mesh_attempt += 1
                 events.append(RecoveryEvent(
                     kind="resume", attempt=attempt,
                     detail="initial_guess_nonzero from restored "

@@ -50,12 +50,22 @@ class SolveRequest:
     max_it: int
     future: Any
     t_submit: float = field(default_factory=time.monotonic)
+    # absolute time.monotonic() the request must have DISPATCHED by, or
+    # None for no deadline (serving/server.py resolves expired requests
+    # with DeadlineExceededError instead of giving them a batch column).
+    # NOT part of the compatibility key: deadlines shape admission, not
+    # the convergence contract of the block a request rides in.
+    t_deadline: float | None = None
 
     @property
     def key(self) -> tuple:
         """Compatibility key: requests batch together iff keys match."""
         return (self.op, float(self.rtol), float(self.atol),
                 int(self.max_it))
+
+    def expired(self, now: float) -> bool:
+        """Whether the request's dispatch deadline has passed."""
+        return self.t_deadline is not None and now >= self.t_deadline
 
 
 def coalesce(requests, max_k: int):

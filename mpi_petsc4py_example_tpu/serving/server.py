@@ -41,6 +41,23 @@ verified iterates and re-enters immediately, and the PR-5 per-column
 detection means one poisoned request cannot contaminate its
 batch-mates' verified answers (the independent final re-verification
 covers every column).
+
+PERSISTENT device loss rides the elastic escalation
+(resilience/elastic.py): when a dispatch's recovery trail reports a
+``mesh_shrink`` — the resilient wrapper already resharded the failing
+session and replayed its in-flight batch-mates from the checkpointed
+iterate block — the server ADOPTS the degraded mesh: every other
+resident operator is rebuilt on it and re-warmed at the block widths
+traffic has used, so the session survives losing hardware instead of
+dying with it. Degraded capacity also demands admission control, so
+the server carries two hardening knobs: ``-solve_server_max_queue``
+bounds the pending queue (excess submissions are REJECTED with a typed
+:class:`~..utils.errors.ServerOverloadedError` instead of queueing
+unboundedly) and ``-solve_server_deadline`` gives each request a
+server-side dispatch deadline (expired requests resolve with
+:class:`~..utils.errors.DeadlineExceededError` rather than occupying a
+batch column). Every pending future always resolves — a result, a
+typed rejection, or the dispatch error — never a hang.
 """
 
 from __future__ import annotations
@@ -57,8 +74,9 @@ from ..parallel.mesh import as_comm
 from ..resilience.retry import RetryPolicy, resilient_solve_many
 from ..solvers.ksp import KSP
 from ..utils.convergence import SolveResult
+from ..utils.errors import DeadlineExceededError, ServerOverloadedError
 from ..utils.options import global_options
-from ..utils.profiling import record_serving
+from ..utils.profiling import record_admission, record_serving
 from .coalescer import SolveRequest, coalesce, padded_width
 
 
@@ -138,6 +156,16 @@ class SolveServer:
         :meth:`RetryPolicy.serving` (short deterministic backoff —
         clients are waiting). ``-solve_server_retry_delay`` overrides
         its base delay.
+    max_queue
+        Admission control (``-solve_server_max_queue``): pending-queue
+        bound above which :meth:`submit` raises
+        :class:`ServerOverloadedError` instead of enqueueing. 0 (the
+        default) queues unboundedly.
+    deadline
+        Default server-side dispatch deadline in seconds per request
+        (``-solve_server_deadline``); a request still queued past it
+        resolves with :class:`DeadlineExceededError`. 0 disables;
+        :meth:`submit` takes a per-request override.
     autostart
         Start the dispatcher thread immediately. ``False`` lets tests
         (and batch drivers) enqueue a known request population and then
@@ -149,6 +177,7 @@ class SolveServer:
                  max_k: int = 32, pad_pow2: bool = True,
                  resilient: bool = True,
                  retry_policy: RetryPolicy | None = None,
+                 max_queue: int = 0, deadline: float = 0.0,
                  autostart: bool = True):
         self.comm = as_comm(comm)
         self.window = float(window)
@@ -156,6 +185,8 @@ class SolveServer:
         self.pad_pow2 = bool(pad_pow2)
         self.resilient = bool(resilient)
         self.retry_policy = retry_policy or RetryPolicy.serving()
+        self.max_queue = int(max_queue)
+        self.deadline = float(deadline)
         self._sessions: dict[str, _OperatorSession] = {}
         self._pending: list[SolveRequest] = []
         self._inflight = 0
@@ -165,7 +196,8 @@ class SolveServer:
         self._thread: threading.Thread | None = None
         self._dispatch_hook = None       # test seam: called per batch
         self._stats = {"requests": 0, "batches": 0, "padded_cols": 0,
-                       "width_hist": {}, "queue_waits": []}
+                       "width_hist": {}, "queue_waits": [],
+                       "rejected": 0, "expired": 0, "mesh_shrinks": []}
         self.set_from_options()
         if autostart:
             self.start()
@@ -180,6 +212,10 @@ class SolveServer:
                                      self.pad_pow2)
         self.resilient = opt.get_bool("solve_server_resilient",
                                       self.resilient)
+        self.max_queue = opt.get_int("solve_server_max_queue",
+                                     self.max_queue)
+        self.deadline = opt.get_real("solve_server_deadline",
+                                     self.deadline)
         delay = opt.get_real("solve_server_retry_delay", None)
         if delay is not None:
             # REPLACE, never mutate: the caller may share one
@@ -267,12 +303,16 @@ class SolveServer:
 
     # ---- client APIs --------------------------------------------------------
     def submit(self, op: str, b, *, rtol: float | None = None,
-               atol: float | None = None,
-               max_it: int | None = None) -> Future:
+               atol: float | None = None, max_it: int | None = None,
+               deadline: float | None = None) -> Future:
         """Enqueue one solve; returns a Future of ServedSolveResult.
 
         Tolerance overrides narrow the request's compatibility group —
         requests with different tolerances never share a block.
+        ``deadline`` overrides the server's default per-request dispatch
+        deadline in seconds (0 = none). With the queue at
+        ``max_queue``, raises :class:`ServerOverloadedError` instead of
+        enqueueing (admission control — the caller sheds load).
         """
         sess = self._sessions.get(op)
         if sess is None:
@@ -282,6 +322,7 @@ class SolveServer:
         if b.shape != (sess.n,):
             raise ValueError(f"submit({op!r}): b must be ({sess.n},), "
                              f"got {b.shape}")
+        budget = self.deadline if deadline is None else float(deadline)
         fut: Future = Future()
         req = SolveRequest(
             # a COPY of the caller's RHS: the request sits in the
@@ -293,9 +334,16 @@ class SolveServer:
             atol=sess.atol if atol is None else float(atol),
             max_it=sess.max_it if max_it is None else int(max_it),
             future=fut)
+        if budget > 0:
+            req.t_deadline = req.t_submit + budget
         with self._cv:
             if self._closed:
                 raise ServerClosedError("SolveServer is shut down")
+            if self.max_queue > 0 and len(self._pending) >= self.max_queue:
+                self._stats["rejected"] += 1
+                record_admission(rejected=1)
+                raise ServerOverloadedError(len(self._pending),
+                                            self.max_queue)
             self._pending.append(req)
             self._cv.notify_all()
         return fut
@@ -400,6 +448,21 @@ class SolveServer:
         """Solve one coalesced batch and demux per-request results."""
         if self._dispatch_hook is not None:
             self._dispatch_hook(reqs)
+        # server-side deadlines: a request whose dispatch deadline has
+        # passed resolves with DEADLINE_EXCEEDED instead of occupying a
+        # batch column — on a degraded (shrunk) mesh the capacity goes
+        # to requests whose clients are still waiting
+        now = time.monotonic()
+        expired = [r for r in reqs if r.expired(now)]
+        if expired:
+            with self._cv:
+                self._stats["expired"] += len(expired)
+            record_admission(expired=len(expired))
+            for r in expired:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(DeadlineExceededError(
+                        now - r.t_submit, r.t_deadline - r.t_submit))
+            reqs = [r for r in reqs if not r.expired(now)]
         # honor client-side cancellation (Future protocol): a request
         # cancelled before dispatch never reaches the device
         reqs = [r for r in reqs
@@ -433,6 +496,15 @@ class SolveServer:
                 r.future.set_exception(exc)
             self._record(k, waits, kpad - k)
             return
+        shrinks = [e for e in res.recovery_events
+                   if e.kind == "mesh_shrink"]
+        if shrinks:
+            # the resilient dispatch survived a persistent device loss
+            # by resharding THIS session onto a degraded mesh (its
+            # batch-mates replayed from the checkpointed block inside
+            # the retry loop) — adopt the new mesh server-wide
+            self._adopt_shrunk_mesh(sess, shrinks,
+                                    time.monotonic() - t0)
         per = res.per_rhs()
         for j, r in enumerate(reqs):
             col = per[j]
@@ -450,6 +522,52 @@ class SolveServer:
                 queue_wait=waits[j])
             r.future.set_result(out)
         self._record(k, waits, kpad - k)
+
+    def _adopt_shrunk_mesh(self, shrunk_sess, shrink_events, dispatch_wall):
+        """Adopt the degraded mesh a resilient dispatch landed on.
+
+        ``shrunk_sess``'s KSP was already rebuilt by the elastic retry
+        stage; every OTHER resident operator is re-registered here —
+        operands re-placed, PC factors re-set-up, base (and previously
+        seen block-width) programs re-warmed/AOT-loaded on the new
+        geometry — so the next dispatch of any session runs on surviving
+        hardware instead of failing on the lost device. Runs on the
+        dispatcher thread (the only place sessions are mutated
+        mid-flight)."""
+        from ..resilience import elastic as _elastic
+        comm_new = shrunk_sess.ksp.comm
+        if comm_new is self.comm or comm_new.size >= self.comm.size:
+            return
+        old_n = self.comm.size
+        t0 = time.monotonic()
+        shrunk_sess.operator = shrunk_sess.ksp.get_operators()[0]
+        with self._cv:
+            widths = sorted(padded_width(w, self.max_k, self.pad_pow2)
+                            for w in self._stats["width_hist"])
+        failures = {}
+        for s in self._sessions.values():
+            if s is shrunk_sess:
+                continue
+            try:
+                mat2 = _elastic.rebuild_operator(s.operator, comm_new)
+                _elastic.rebuild_ksp(s.ksp, mat2)
+                s.operator = mat2
+                _elastic.warm(s.ksp, widths)
+            # tpslint: disable=TPS005 — a session whose operator cannot
+            # be rebuilt on the smaller mesh must not abort adoption for
+            # the sessions that CAN: record it, keep going; its next
+            # dispatch surfaces the recorded error on client futures
+            except Exception as exc:  # noqa: BLE001
+                failures[s.name] = repr(exc)
+        self.comm = comm_new
+        entry = {"old_devices": old_n, "new_devices": comm_new.size,
+                 "dispatch_wall_s": float(dispatch_wall),
+                 "adopt_wall_s": time.monotonic() - t0,
+                 "resumed_iteration": max(
+                     (e.iterations for e in shrink_events), default=0),
+                 "rebuild_failures": failures}
+        with self._cv:
+            self._stats["mesh_shrinks"].append(entry)
 
     def _record(self, width, waits, padded):
         record_serving(width, waits, padded)
@@ -471,7 +589,10 @@ class SolveServer:
             waits = list(st["queue_waits"])
             out = {"requests": st["requests"], "batches": st["batches"],
                    "padded_cols": st["padded_cols"],
-                   "width_hist": dict(st["width_hist"])}
+                   "width_hist": dict(st["width_hist"]),
+                   "rejected": st["rejected"], "expired": st["expired"],
+                   "mesh_shrinks": [dict(e)
+                                    for e in st["mesh_shrinks"]]}
         out["mean_width"] = (out["requests"] / out["batches"]
                              if out["batches"] else 0.0)
         if waits:

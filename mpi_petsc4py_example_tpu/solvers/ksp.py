@@ -598,10 +598,23 @@ class KSP:
         # program's output right after the call; an x0 that aliases the
         # RHS buffer must be copied first or the donation would delete b.
         from .krylov import donation_supported
+        from ..parallel.mesh import is_placed
         x0d = x.data
-        if donation_supported() and x0d is b.data:
+        if donation_supported() and (x0d is b.data or is_placed(x0d)):
+            # an x0 aliasing b must be copied or the donation would
+            # delete the RHS; a PLACEMENT-sourced x0 (restored iterate,
+            # set_global guess) must be copied because donating a
+            # device_put buffer is unsafe on the CPU runtime
+            # (parallel/mesh.is_placed) — the copy is an op output,
+            # which donates correctly
             x0d = jnp.array(x0d)
         fault = _faults.triggered("ksp.program")
+        if fault is None:
+            # persistent device loss: a mesh member is (or just became)
+            # LOST — sticky 'unavailable' until heal() or an elastic
+            # mesh shrink excludes the device (resilience/elastic.py);
+            # iter=K clauses leave real partial state like ksp.program
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
         if fault is not None:
             if fault.iter_k:
                 part = prog(mat.device_arrays(), pc.device_arrays(),
@@ -1017,10 +1030,22 @@ class KSP:
         # dispatch twice and fire the comm.put fault point twice)
         Bd, Xd0 = comm.put_rows_many([B.astype(op_dt, copy=False),
                                       X.astype(op_dt, copy=False)])
+        from .krylov import donation_supported
+        if donation_supported():
+            # the donated X0 block must be an OP OUTPUT, not the raw
+            # placement: donating a device_put buffer is unsafe on the
+            # CPU runtime (parallel/mesh.is_placed — the elastic
+            # shrink-resume corruption); gate re-entries below donate
+            # the previous program's output and stay copy-free
+            Xd0 = jnp.array(Xd0)
         # fault point 'ksp.program': a worker crash mid-batched-solve —
         # the truncated re-run leaves the iteration-K iterate BLOCK in X,
         # exactly what resilient_solve_many checkpoints and resumes from
         fault = _faults.triggered("ksp.program")
+        if fault is None:
+            # persistent device loss (see KSP.solve): sticky until
+            # heal() or the elastic shrink rebuilds on a smaller mesh
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
         if fault is not None:
             if fault.iter_k:
                 part = prog(mat.device_arrays(), pc.device_arrays(),

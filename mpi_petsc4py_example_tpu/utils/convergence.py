@@ -46,7 +46,7 @@ class RecoveryEvent:
     """
     kind: str            # 'fault' | 'checkpoint' | 'backoff' | 'resume'
                          # | 'fallback' | 'precision' | 'rollback'
-                         # | 'verify'
+                         # | 'verify' | 'mesh_shrink'
     attempt: int         # 1-based attempt number the event belongs to
     detail: str = ""     # specifics: checkpoint path, 'cg->bcgs', dtypes, …
     error_class: str = ""  # DeviceExecutionError.failure_class or reason name
@@ -55,11 +55,17 @@ class RecoveryEvent:
     detector: str = ""   # what detected a silent corruption ('abft' |
                          # 'abft_pc' | 'drift' | 'nan' | 'monotonic' |
                          # 'verify') — empty for fail-stop faults
+    # degraded-mesh escalation ('mesh_shrink' events, resilience/elastic.py):
+    # the device counts before/after the rebuild onto surviving hardware
+    old_devices: int = 0
+    new_devices: int = 0
 
     def __repr__(self):
         extra = f", delay={self.delay:g}s" if self.kind == "backoff" else ""
         if self.detector:
             extra += f", detector={self.detector}"
+        if self.kind == "mesh_shrink":
+            extra += f", {self.old_devices}->{self.new_devices} devices"
         return (f"RecoveryEvent({self.kind}, attempt={self.attempt}, "
                 f"{self.detail or self.error_class}{extra})")
 

@@ -122,6 +122,47 @@ class SilentCorruptionError(DeviceExecutionError):
         self.iteration = int(iteration)
 
 
+class ServerOverloadedError(RuntimeError):
+    """A solve-server submission rejected by admission control.
+
+    Raised by ``SolveServer.submit`` when the pending queue is at
+    ``-solve_server_max_queue``: under degraded capacity (a shrunk mesh
+    serves fewer solves/s) unbounded queueing turns overload into
+    unbounded client latency and memory growth — a typed, immediate
+    rejection lets callers shed or redirect load instead. Carries
+    ``pending`` (queue depth at rejection) and ``limit``.
+    """
+
+    def __init__(self, pending: int, limit: int):
+        self.pending = int(pending)
+        self.limit = int(limit)
+        super().__init__(
+            f"solve server overloaded: {pending} request(s) pending, "
+            f"admission limit {limit} (-solve_server_max_queue) — "
+            "shed load, raise the limit, or add capacity")
+
+
+class DeadlineExceededError(RuntimeError):
+    """A solve request's server-side deadline expired before dispatch.
+
+    The serving analog of an RPC DEADLINE_EXCEEDED: a request whose
+    deadline (``-solve_server_deadline`` or the per-submit override)
+    passes while it waits in the queue resolves with THIS error instead
+    of occupying a batch column — on a degraded mesh the capacity goes
+    to requests whose clients are still waiting for the answer.
+    ``waited`` is the seconds the request sat queued; ``deadline`` the
+    budget it had.
+    """
+
+    def __init__(self, waited: float, deadline: float):
+        self.waited = float(waited)
+        self.deadline = float(deadline)
+        super().__init__(
+            f"DEADLINE_EXCEEDED: request waited {waited:.3f}s in the "
+            f"solve-server queue, past its {deadline:.3f}s deadline — "
+            "never dispatched")
+
+
 def wrap_device_errors(what: str):
     """Decorator: convert jax runtime failures into DeviceExecutionError."""
     def deco(fn):

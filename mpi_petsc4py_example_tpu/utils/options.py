@@ -73,9 +73,26 @@ KNOWN_FLAGS = {
     "pc_setup_device": "where block inversions run (host/device/auto)",
     "pc_sor_omega": "SOR/SSOR relaxation factor",
     "pc_type": "preconditioner type",
+    # ---- elastic degraded-mesh recovery (resilience/elastic.py) ----
+    "elastic_enable": "arm the mesh-shrink escalation past same-mesh "
+                      "retries on persistent device loss",
+    "elastic_max_same_mesh_retries": "unavailable failures on one mesh "
+                                     "before the shrink escalation (also "
+                                     "the HealthMonitor loss-"
+                                     "classification threshold)",
+    "elastic_min_devices": "smallest mesh the shrink ladder may land on",
+    "elastic_shrink_unattributed": "allow a speculative halving when "
+                                   "repeated failures name no device "
+                                   "(default off)",
     # ---- SolveServer (serving/server.py) ----
+    "solve_server_deadline": "default per-request server-side dispatch "
+                             "deadline seconds (expired requests resolve "
+                             "with DEADLINE_EXCEEDED; 0 = none)",
     "solve_server_max_k": "max coalesced RHS columns per dispatched "
                           "block",
+    "solve_server_max_queue": "pending-queue admission bound (excess "
+                              "submissions rejected with "
+                              "ServerOverloadedError; 0 = unbounded)",
     "solve_server_pad_pow2": "round coalesced block widths up to powers "
                              "of two (bounds the compiled-program "
                              "population)",

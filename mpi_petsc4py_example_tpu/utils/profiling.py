@@ -104,6 +104,44 @@ def serving_stats() -> dict:
     return out
 
 
+# elastic degraded-mesh recoveries (resilience/elastic.py + retry.py
+# mesh_shrink stage): one entry per executed shrink, printed as a
+# -log_view row — losing hardware mid-run is exactly the event an
+# operator reading the log needs to see
+_MESH_SHRINKS: list[dict] = []
+
+
+def record_mesh_shrink(old_devices: int, new_devices: int,
+                       rebuild_seconds: float):
+    """Record one executed degraded-mesh rebuild: the mesh went from
+    ``old_devices`` to ``new_devices`` and re-placing operands / PC
+    factors / programs took ``rebuild_seconds``."""
+    _MESH_SHRINKS.append({"old_devices": int(old_devices),
+                          "new_devices": int(new_devices),
+                          "rebuild_s": float(rebuild_seconds)})
+
+
+def mesh_shrinks() -> list[dict]:
+    return [dict(e) for e in _MESH_SHRINKS]
+
+
+# serving admission-control outcomes (serving/server.py hardening knobs):
+# requests rejected at submit (-solve_server_max_queue) and requests
+# expired before dispatch (-solve_server_deadline)
+_ADMISSION = {"rejected": 0, "expired": 0}
+
+
+def record_admission(rejected: int = 0, expired: int = 0):
+    """Accumulate serving admission-control outcomes: submissions
+    rejected by the queue bound, requests expired by their deadline."""
+    _ADMISSION["rejected"] += int(rejected)
+    _ADMISSION["expired"] += int(expired)
+
+
+def admission_counts() -> dict:
+    return dict(_ADMISSION)
+
+
 # collective-latency itemization (the MULTICHIP weak-scaling bench):
 # label -> [reduce_sites_per_iter, per_iter_seconds_sum, episodes]
 _COLLECTIVES: dict[str, list] = {}
@@ -195,6 +233,8 @@ def clear_events():
     _SDC[:] = [0, 0, 0]
     _SERVING.update(requests=0, batches=0, padded_cols=0,
                     width_hist={}, wait_sum_s=0.0, wait_max_s=0.0)
+    _MESH_SHRINKS.clear()
+    _ADMISSION.update(rejected=0, expired=0)
 
 
 def log_view(file=None):
@@ -202,7 +242,8 @@ def log_view(file=None):
     file = file or sys.stderr
     if (not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS
             and not any(_SDC) and not _SERVING["batches"]
-            and not _COLLECTIVES):
+            and not _COLLECTIVES and not _MESH_SHRINKS
+            and not any(_ADMISSION.values())):
         print("log_view: no solve events recorded", file=file)
         return
     if _EVENTS:
@@ -235,6 +276,16 @@ def log_view(file=None):
               f"{st['wait_mean_s'] * 1e3:.1f} ms / max "
               f"{st['wait_max_s'] * 1e3:.1f} ms, "
               f"{st['padded_cols']} padded column(s)", file=file)
+    if any(_ADMISSION.values()):
+        print(f"serving admission control: {_ADMISSION['rejected']} "
+              f"rejected (queue bound), {_ADMISSION['expired']} "
+              f"deadline-expired", file=file)
+    if _MESH_SHRINKS:
+        shr = ", ".join(f"{e['old_devices']}->{e['new_devices']} "
+                        f"({e['rebuild_s'] * 1e3:.0f} ms)"
+                        for e in _MESH_SHRINKS)
+        print(f"elastic recovery: {len(_MESH_SHRINKS)} mesh shrink(s) "
+              f"[{shr}]", file=file)
     if _COLLECTIVES:
         print("collective latency itemization (reduce sites x per-iter "
               "wall):", file=file)

@@ -23,3 +23,7 @@ def fetch_result():
 def unregistered_new_point():
     _faults.check("solver.batched")  # BAD: TPS012
     return None
+
+
+def mistyped_loss_point(device_ids):
+    return _faults.mesh_fault("device.los", device_ids)  # BAD: TPS012

@@ -21,6 +21,11 @@ def fetch_result():
     return _faults.triggered("comm.psum")
 
 
+def program_boundary(device_ids):
+    # persistent-loss hook: point-name literal first, device ids second
+    return _faults.mesh_fault("device.lost", device_ids)
+
+
 def dynamic_point(point):
     # not a string literal: the rule cannot verify it (the coverage
     # meta-test pins the registry from the literal sites instead)

@@ -1,0 +1,474 @@
+"""Elastic degraded-mesh recovery (resilience/elastic.py + the retry
+shrink escalation + serving adoption + admission control / deadlines).
+
+The failure model under test is PERSISTENT device loss: a ``device.lost``
+fault is sticky — every solve and placement on a mesh containing the
+lost device keeps failing ``unavailable`` until ``faults.heal()`` — so
+same-mesh retries cannot succeed and the only way forward is the
+escalation ladder's last rung: rebuild on the largest viable smaller
+mesh and RESUME from the checkpointed iterate. Everything here is
+deterministic (exact hit counts, injected sleeps, structured
+``recovery_events``/stats assertions).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.models.stencil import StencilPoisson3D
+from mpi_petsc4py_example_tpu.resilience import elastic, faults
+from mpi_petsc4py_example_tpu.resilience.retry import (RetryPolicy,
+                                                       resilient_solve,
+                                                       resilient_solve_many)
+from mpi_petsc4py_example_tpu.serving import SolveServer
+from mpi_petsc4py_example_tpu.utils.checkpoint import (load_solve_state,
+                                                       save_solve_state)
+from mpi_petsc4py_example_tpu.utils.errors import (DeadlineExceededError,
+                                                   DeviceExecutionError,
+                                                   ServerOverloadedError)
+
+CR = tps.ConvergedReason
+NOSLEEP = dict(sleep=lambda _d: None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loss_state():
+    """No fault plan OR sticky lost-device mark may leak across tests."""
+    faults.reset()
+    faults.heal()
+    yield
+    assert not faults.active(), "a test left a fault plan armed"
+    faults.reset()
+    faults.heal()
+
+
+def _setup(comm, n_side=12, rtol=1e-10):
+    A = poisson2d_csr(n_side)
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=rtol)
+    x, b = M.get_vecs()
+    x_true = np.random.default_rng(3).random(A.shape[0])
+    b.set_global(A @ x_true)
+    return ksp, A, x, b, x_true
+
+
+def _true_rres(A, xh, bh):
+    return float(np.linalg.norm(bh - A @ xh) / np.linalg.norm(bh))
+
+
+class TestLostRegistry:
+    def test_mark_heal_roundtrip(self):
+        assert faults.lost_devices() == frozenset()
+        faults.mark_lost(3)
+        faults.mark_lost(5, reason="test")
+        assert faults.lost_devices() == frozenset({3, 5})
+        assert faults.heal(3) == (3,)
+        assert faults.heal(3) == ()          # already healed
+        assert faults.lost_devices() == frozenset({5})
+        assert faults.heal() == (5,)
+        assert faults.lost_devices() == frozenset()
+
+    def test_check_lost_raises_only_on_overlap(self):
+        faults.check_lost((0, 1, 2))         # empty registry: silent
+        faults.mark_lost(2)
+        faults.check_lost((0, 1))            # disjoint mesh: silent
+        with pytest.raises(faults.XlaRuntimeError, match="device 2"):
+            faults.check_lost((0, 1, 2))
+
+    def test_spec_parses_device_param(self):
+        (f,) = faults.parse_spec("device.lost=unavailable:device=6:iter=9")
+        assert (f.point, f.kind, f.device, f.iter_k) == (
+            "device.lost", "unavailable", 6, 9)
+
+    def test_mesh_fault_counts_solves_and_sticks(self):
+        ids = (0, 1, 2, 3)
+        with faults.inject_faults("device.lost=unavailable:device=3:at=2"):
+            assert faults.mesh_fault("device.lost", ids) is None
+            f = faults.mesh_fault("device.lost", ids)
+            assert f is not None and f.device == 3
+            assert faults.lost_devices() == frozenset({3})
+        # plan gone, but the loss is STICKY — and registry-produced
+        # faults keep naming the device
+        f2 = faults.mesh_fault("device.lost", ids)
+        assert f2 is not None and f2.device == 3
+        # a mesh that excludes the lost device is healthy
+        assert faults.mesh_fault("device.lost", (0, 1, 2)) is None
+        faults.heal()
+        assert faults.mesh_fault("device.lost", ids) is None
+
+    def test_default_device_is_highest_in_mesh(self):
+        with faults.inject_faults("device.lost=unavailable"):
+            f = faults.mesh_fault("device.lost", (4, 1, 2))
+            assert f is not None and f.device == 4
+        assert faults.lost_devices() == frozenset({4})
+
+    def test_lost_device_blocks_placement(self, comm8):
+        """Data placement onto a mesh holding a lost device must fail —
+        stale buffers on dead hardware are exactly what a rebuild must
+        never trust."""
+        faults.mark_lost(comm8.device_ids[-1])
+        with pytest.raises(faults.XlaRuntimeError, match="LOST"):
+            tps.Mat.from_scipy(comm8, poisson2d_csr(6))
+
+
+class TestHealthMonitor:
+    def _unavailable(self, device=None):
+        f = faults.Fault("ksp.program", "unavailable", device=device)
+        return DeviceExecutionError("KSPSolve", f.error())
+
+    def test_attributed_loss_classified_at_threshold(self):
+        mon = faults.HealthMonitor(threshold=2)
+        assert mon.record(self._unavailable(device=5)) == 5
+        assert not mon.persistent() and mon.lost_devices() == frozenset()
+        mon.record(self._unavailable(device=5))
+        assert mon.persistent()
+        assert mon.lost_devices() == frozenset({5})
+
+    def test_unattributed_failures_never_name_a_device(self):
+        mon = faults.HealthMonitor(threshold=2)
+        assert mon.record(self._unavailable()) is None
+        mon.record(self._unavailable())
+        assert mon.persistent()              # retrying IS futile...
+        assert mon.lost_devices() == frozenset()   # ...but no exclusion
+
+    def test_success_resets_evidence(self):
+        mon = faults.HealthMonitor(threshold=2)
+        mon.record(self._unavailable(device=1))
+        mon.healthy()
+        mon.record(self._unavailable(device=1))
+        assert not mon.persistent()
+
+    def test_device_parsed_from_wrapped_original(self):
+        exc = self._unavailable(device=7)
+        # the wrapper's own message has no device id — attribution must
+        # look through to the runtime error
+        assert "device 7" not in str(exc)
+        assert faults.device_from_error(exc) == 7
+        assert faults.device_from_error(ValueError("nope")) is None
+
+
+class TestMeshRebuilder:
+    def test_survivors_exclude_registry_and_argument(self, comm8):
+        rb = elastic.MeshRebuilder()
+        faults.mark_lost(comm8.device_ids[-1])
+        surv = rb.survivors(comm8, lost={comm8.device_ids[0]})
+        ids = {int(d.id) for d in surv}
+        assert comm8.device_ids[-1] not in ids
+        assert comm8.device_ids[0] not in ids
+        assert len(surv) == 6
+
+    def test_ladder_lands_on_pow2(self, comm8):
+        rb = elastic.MeshRebuilder()
+        faults.mark_lost(comm8.device_ids[-1])   # 7 survivors -> 4
+        c = rb.shrunk_comm(comm8)
+        assert c is not None and c.size == 4
+        assert comm8.device_ids[-1] not in c.device_ids
+
+    def test_ladder_all_survivors_without_pow2(self, comm8):
+        rb = elastic.MeshRebuilder(elastic.ElasticPolicy(prefer_pow2=False))
+        faults.mark_lost(comm8.device_ids[-1])
+        c = rb.shrunk_comm(comm8)
+        assert c is not None and c.size == 7
+
+    def test_unattributed_does_not_shrink_by_default(self, comm8):
+        rb = elastic.MeshRebuilder()
+        assert rb.shrunk_comm(comm8) is None
+
+    def test_unattributed_speculative_halving_opt_in(self, comm8):
+        pol = elastic.ElasticPolicy(shrink_unattributed=True)
+        c = elastic.MeshRebuilder(pol).shrunk_comm(comm8)
+        assert c is not None and c.size == 4
+
+    def test_min_devices_floor(self, comm1, comm8):
+        pol = elastic.ElasticPolicy(min_devices=8)
+        faults.mark_lost(comm8.device_ids[-1])
+        assert elastic.MeshRebuilder(pol).shrunk_comm(comm8) is None
+        # a 1-device mesh has nothing left to degrade to
+        assert elastic.MeshRebuilder().shrunk_comm(comm1) is None
+
+    def test_policy_from_options(self):
+        opt = tps.global_options()
+        opt.set("elastic_enable", "0")
+        opt.set("elastic_max_same_mesh_retries", "7")
+        opt.set("elastic_min_devices", "2")
+        opt.set("elastic_shrink_unattributed", "1")
+        p = elastic.ElasticPolicy.from_options()
+        assert (p.enabled, p.max_same_mesh_retries, p.min_devices,
+                p.shrink_unattributed) == (False, 7, 2, True)
+
+    def test_rebuild_operator_requires_a_hook(self, comm8):
+        class Opaque:
+            dtype = np.float64
+        with pytest.raises(ValueError, match="cannot be rebuilt"):
+            elastic.rebuild_operator(Opaque(), comm8)
+
+
+class TestElasticSolveRecovery:
+    def test_live_shrink_resumes_from_iterate(self, comm8):
+        """The acceptance scenario: a permanent loss mid-solve recovers
+        onto a strictly smaller mesh, provably resuming from the
+        checkpointed iterate (fewer remaining iterations than a cold
+        start) with the answer matching the uninterrupted one."""
+        ksp, A, x, b, x_true = _setup(comm8, n_side=16)
+        cold = ksp.solve(b, x)
+        x_cold = x.to_numpy()
+        x2, b2 = ksp.get_operators()[0].get_vecs()
+        b2.set_global(np.asarray(b.to_numpy()))
+        victim = comm8.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:iter=20"):
+            res = resilient_solve(
+                ksp, b2, x2, RetryPolicy(**NOSLEEP),
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        assert res.converged and res.attempts == 2
+        shr = [e for e in res.recovery_events if e.kind == "mesh_shrink"]
+        assert len(shr) == 1
+        assert (shr[0].old_devices, shr[0].new_devices) == (8, 4)
+        assert shr[0].iterations == 20       # resumed, not iteration 0
+        assert ksp.comm.size == 4
+        assert victim not in ksp.comm.device_ids
+        # fewer REMAINING iterations than the cold start
+        assert res.iterations < cold.iterations
+        bh = np.asarray(b2.to_numpy())
+        assert _true_rres(A, x2.to_numpy(), bh) <= 1e-10 * 1.05
+        np.testing.assert_allclose(x2.to_numpy(), x_cold, atol=1e-7)
+
+    def test_checkpointed_on_8_resumes_on_2(self, comm8, tmp_path):
+        """Losing most of the machine: a solve checkpointed on the
+        8-device mesh lands on 2 devices (5 lost -> 3 survivors -> pow2
+        ladder 2) and still resumes from the stored iteration."""
+        ksp, A, x, b, x_true = _setup(comm8, n_side=16)
+        cold = ksp.solve(b, x)
+        x2, b2 = ksp.get_operators()[0].get_vecs()
+        b2.set_global(np.asarray(b.to_numpy()))
+        ids = comm8.device_ids
+        spec = ",".join(
+            [f"device.lost=unavailable:device={ids[3]}:iter=25"]
+            + [f"device.lost=unavailable:device={d}" for d in ids[4:]])
+        path = str(tmp_path / "elastic_ckpt")
+        with tps.inject_faults(spec):
+            res = resilient_solve(
+                ksp, b2, x2, RetryPolicy(**NOSLEEP),
+                checkpoint_path=path,
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        shr = [e for e in res.recovery_events if e.kind == "mesh_shrink"]
+        assert len(shr) == 1
+        assert (shr[0].old_devices, shr[0].new_devices) == (8, 2)
+        assert res.converged and ksp.comm.size == 2
+        assert res.iterations < cold.iterations
+        # the persisted checkpoint recorded the failure iteration
+        _m, _x, _b, it = load_solve_state(path, ksp.comm)
+        assert it == 25 and shr[0].iterations == 25
+        bh = np.asarray(b2.to_numpy())
+        assert _true_rres(A, x2.to_numpy(), bh) <= 1e-10 * 1.05
+
+    def test_batched_block_shrinks_and_replays(self, comm8):
+        ksp, A, _x, _b, _xt = _setup(comm8, n_side=12)
+        k = 3
+        Xt = np.random.default_rng(5).random((A.shape[0], k))
+        B = np.asarray(A @ Xt)
+        victim = comm8.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:iter=10"):
+            res = resilient_solve_many(
+                ksp, B, policy=RetryPolicy(**NOSLEEP),
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        assert res.converged and ksp.comm.size == 4
+        assert any(e.kind == "mesh_shrink" for e in res.recovery_events)
+        for j in range(k):
+            assert _true_rres(A, res.X[:, j], B[:, j]) <= 1e-10 * 1.05
+
+    def test_matrix_free_stencil_shrinks_in_memory(self, comm8):
+        """No persisted checkpoint for matrix-free operators — the
+        shrink replants the in-memory iterate through with_comm()."""
+        op = StencilPoisson3D(comm8, 8)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(op)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("none")
+        ksp.set_tolerances(rtol=1e-8)
+        x, b = op.get_vecs()
+        rhs = np.random.default_rng(7).random(op.shape[0])
+        b.set_global(rhs)
+        victim = comm8.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:iter=5"):
+            res = resilient_solve(
+                ksp, b, x, RetryPolicy(**NOSLEEP),
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        assert res.converged
+        assert ksp.comm.size == 4
+        op2 = ksp.get_operators()[0]
+        assert op2.comm.size == 4            # geometry re-derived
+        y = op2.mult(x).to_numpy()
+        assert np.linalg.norm(rhs - y) / np.linalg.norm(rhs) <= 1e-8 * 2
+
+    def test_disabled_policy_reraises_original(self, comm8):
+        ksp, _A, x, b, _xt = _setup(comm8)
+        victim = comm8.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}"):
+            with pytest.raises(DeviceExecutionError,
+                               match="worker crashed"):
+                resilient_solve(
+                    ksp, b, x,
+                    RetryPolicy(max_attempts=2, **NOSLEEP),
+                    elastic=tps.ElasticPolicy(enabled=False))
+        assert ksp.comm.size == 8            # nothing was rebuilt
+
+    def test_transient_fault_path_unchanged(self, comm8, tmp_path):
+        """A one-shot transient crash must keep the PR-2 same-mesh
+        recovery trail byte-identical — no shrink, no mesh change —
+        even with the elastic stage enabled (its default)."""
+        ksp, A, x, b, _xt = _setup(comm8)
+        with tps.inject_faults("ksp.program=unavailable:iter=4"):
+            res = resilient_solve(ksp, b, x, RetryPolicy(**NOSLEEP))
+        assert res.converged and res.attempts == 2
+        assert [e.kind for e in res.recovery_events] == [
+            "fault", "checkpoint", "backoff", "resume"]
+        assert ksp.comm.size == 8
+
+    def test_shrink_event_carries_rebuild_detail(self, comm8):
+        ksp, _A, x, b, _xt = _setup(comm8)
+        victim = comm8.device_ids[-1]
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:iter=8"):
+            res = resilient_solve(
+                ksp, b, x, RetryPolicy(**NOSLEEP),
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        (shr,) = [e for e in res.recovery_events
+                  if e.kind == "mesh_shrink"]
+        assert "8 -> 4" in shr.detail and "iteration 8" in shr.detail
+        assert shr.error_class == "unavailable"
+        # the -log_view row recorded the same shrink
+        from mpi_petsc4py_example_tpu.utils import profiling
+        shrinks = profiling.mesh_shrinks()
+        assert shrinks and shrinks[-1]["old_devices"] == 8
+        assert shrinks[-1]["new_devices"] == 4
+
+
+class TestServingElastic:
+    def _server(self, comm, **kw):
+        kw.setdefault("window", 0.005)
+        kw.setdefault("max_k", 4)
+        kw.setdefault("retry_policy", RetryPolicy(**NOSLEEP))
+        kw.setdefault("autostart", False)
+        return SolveServer(comm, **kw)
+
+    def test_mid_load_loss_shrinks_and_answers_everyone(self, comm8):
+        """The serving acceptance drill: a permanent loss mid-load, every
+        in-flight request answered at fp64 parity, the server adopted
+        onto the smaller mesh, OTHER resident operators re-registered,
+        and post-recovery traffic served."""
+        A = poisson2d_csr(12)
+        A2 = A * 2.0
+        n = A.shape[0]
+        R = 6
+        Xt = np.random.default_rng(11).random((n, R))
+        B = np.asarray(A @ Xt)
+        srv = self._server(comm8)
+        try:
+            srv.register_operator("p", A, rtol=1e-10)
+            srv.register_operator("q", A2, rtol=1e-10)
+            futs = [srv.submit("p", B[:, j]) for j in range(R)]
+            victim = comm8.device_ids[-1]
+            with tps.inject_faults(
+                    f"device.lost=unavailable:device={victim}:iter=5"):
+                srv.start()
+                assert srv.drain(300)
+            for j, f in enumerate(futs):
+                r = f.result(1)
+                assert r.converged, (j, r)
+                assert _true_rres(A, r.x, B[:, j]) <= 1e-10 * 1.05
+            st = srv.stats()
+            assert len(st["mesh_shrinks"]) == 1
+            ev = st["mesh_shrinks"][0]
+            assert ev["old_devices"] == 8 and ev["new_devices"] < 8
+            assert ev["resumed_iteration"] == 5
+            assert ev["rebuild_failures"] == {}
+            assert srv.comm.size < 8
+            # the OTHER operator was re-registered on the new mesh and
+            # still serves
+            rhs2 = np.asarray(A2 @ Xt[:, 0])
+            r2 = srv.solve("q", rhs2, timeout=120)
+            assert r2.converged
+            assert _true_rres(A2, r2.x, rhs2) <= 1e-10 * 1.05
+        finally:
+            srv.shutdown(wait=False)
+
+    def test_admission_control_rejects_above_max_queue(self, comm8):
+        A = poisson2d_csr(8)
+        b = np.ones(A.shape[0])
+        srv = self._server(comm8, max_queue=2)
+        try:
+            srv.register_operator("p", A, rtol=1e-8)
+            f1 = srv.submit("p", b)
+            f2 = srv.submit("p", b)
+            with pytest.raises(ServerOverloadedError) as ei:
+                srv.submit("p", b)
+            assert (ei.value.pending, ei.value.limit) == (2, 2)
+            assert srv.stats()["rejected"] == 1
+            # the admitted requests still resolve normally
+            srv.start()
+            assert srv.drain(120)
+            assert f1.result(1).converged and f2.result(1).converged
+            # queue drained: admission opens again
+            assert srv.solve("p", b, timeout=120).converged
+        finally:
+            srv.shutdown(wait=False)
+
+    def test_deadline_expires_queued_request(self, comm8):
+        A = poisson2d_csr(8)
+        b = np.ones(A.shape[0])
+        srv = self._server(comm8)
+        try:
+            srv.register_operator("p", A, rtol=1e-8)
+            doomed = srv.submit("p", b, deadline=0.01)
+            alive = srv.submit("p", b)       # no deadline
+            time.sleep(0.05)                 # expire before dispatch
+            srv.start()
+            assert srv.drain(120)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(1)
+            assert alive.result(1).converged
+            assert srv.stats()["expired"] == 1
+        finally:
+            srv.shutdown(wait=False)
+
+    def test_deadline_and_queue_flags_configure_server(self, comm8):
+        opt = tps.global_options()
+        opt.set("solve_server_max_queue", "17")
+        opt.set("solve_server_deadline", "2.5")
+        srv = self._server(comm8)
+        try:
+            assert srv.max_queue == 17
+            assert srv.deadline == 2.5
+        finally:
+            srv.shutdown(wait=False)
+
+    def test_deadlines_do_not_split_batches(self):
+        """t_deadline is not part of the compatibility key — deadlines
+        shape admission, not the block a request rides in."""
+        from mpi_petsc4py_example_tpu.serving.coalescer import (
+            SolveRequest, coalesce)
+        mk = lambda dl: SolveRequest(op="p", b=None, rtol=1e-8, atol=0.0,
+                                     max_it=100, future=None,
+                                     t_deadline=dl)
+        batches = coalesce([mk(None), mk(12345.0)], max_k=8)
+        assert len(batches) == 1 and len(batches[0]) == 2
+
+
+class TestElasticExports:
+    def test_package_surface(self):
+        assert tps.ElasticPolicy is elastic.ElasticPolicy
+        assert tps.HealthMonitor is faults.HealthMonitor
+        assert tps.ServerOverloadedError is ServerOverloadedError
+        assert tps.DeadlineExceededError is DeadlineExceededError
+        assert tps.resilience.MeshRebuilder is elastic.MeshRebuilder

@@ -1,31 +1,53 @@
 #!/usr/bin/env python
-"""Chaos smoke: silent-data-corruption drills for CI (ISSUE 5 satellite).
+"""Chaos smoke: silent-corruption AND device-eviction drills for CI.
 
-Runs a CG solve under silent-corruption fault specs and asserts the full
-detection -> rollback -> recovery -> verification chain:
+Silent-corruption drills (ISSUE 5 satellite) run a CG solve under
+silent-corruption fault specs and assert the full detection ->
+rollback -> recovery -> verification chain:
 
 * a detector fired (ABFT checksum / drift gate / sentinel — the
   recovery trail carries its name);
 * the recovered answer's fp64 TRUE relative residual meets rtol;
 * the iterate matches the manufactured solution.
 
-Exit status is NONZERO if corruption goes undetected or the recovered
-answer is wrong — the CI contract that silent corruption cannot
-silently regress.
+Device-eviction drills (``--evict``, ISSUE 8 satellite) arm a PERMANENT
+``device.lost`` fault mid-solve and mid-serving-load and assert the
+elastic escalation (resilience/elastic.py):
 
-Two modes:
+* the solve/serving session recovers onto a STRICTLY SMALLER mesh
+  (a ``mesh_shrink`` recovery event with old > new device counts);
+* the resumed solve provably continued from the checkpointed iterate,
+  not iteration 0 (the shrink event's resumed iteration, and fewer
+  remaining iterations than a cold start);
+* every pending serving request resolves — a converged fp64-parity
+  result, DEADLINE_EXCEEDED, or ServerOverloadedError — never a hung
+  future or a dead dispatcher.
 
-* ``TPU_SOLVE_FAULTS`` set in the environment: ONE drill under exactly
-  that spec (the env-activation route, like the crash smoke steps);
-* unset: the builtin sweep over every silent fault kind at every
-  injectable point (spmv.result / pc.apply / comm.psum), via
-  ``inject_faults``.
+Exit status is NONZERO on any failed drill — the CI contract that
+neither silent corruption nor hardware loss can silently regress.
+
+Modes:
+
+* ``TPU_SOLVE_FAULTS`` set in the environment: ONE corruption drill
+  under exactly that spec (the env-activation route);
+* ``--evict``: the two device-eviction drills via ``inject_faults``;
+* neither: the builtin silent-corruption sweep over every silent fault
+  kind at every injectable point (spmv.result / pc.apply / comm.psum).
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+# the eviction drills need a multi-device mesh to shrink; force the
+# 8-virtual-device CPU host platform (the tests/conftest.py idiom) BEFORE
+# any jax import — harmless when real accelerator devices take precedence
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np
 
@@ -90,6 +112,163 @@ def drill(label: str, ctx) -> list[str]:
     return [f"{label}: {p}" for p in problems]
 
 
+def drill_evict_solve() -> list[str]:
+    """Permanent device loss MID-SOLVE: the elastic escalation must land
+    the solve on a strictly smaller mesh, resumed from the checkpointed
+    iterate, with the answer at fp64 parity."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    if comm.size < 2:
+        return ["evict-solve: needs a multi-device mesh "
+                f"(got {comm.size} device[s])"]
+    A = poisson2d_csr(16)
+
+    def make_session():
+        M = tps.Mat.from_scipy(comm, A)
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL)
+        x, bv = M.get_vecs()
+        return ksp, x, bv
+
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    # cold baseline (same geometry ladder end state is smaller, but the
+    # iteration count to beat is the uninterrupted one)
+    ksp0, x0, bv0 = make_session()
+    bv0.set_global(b)
+    cold = ksp0.solve(bv0, x0)
+
+    ksp, x, bv = make_session()
+    bv.set_global(b)
+    victim = comm.device_ids[-1]
+    spec = f"device.lost=unavailable:device={victim}:iter=15"
+    try:
+        with tps.inject_faults(spec):
+            res = tps.resilient_solve(
+                ksp, bv, x, tps.RetryPolicy(sleep=lambda _d: None),
+                elastic=tps.ElasticPolicy(max_same_mesh_retries=1))
+        shrinks = [e for e in res.recovery_events
+                   if e.kind == "mesh_shrink"]
+        if not shrinks:
+            problems.append("no mesh_shrink recovery event")
+        elif not shrinks[0].new_devices < shrinks[0].old_devices:
+            problems.append(f"mesh did not shrink: {shrinks[0]}")
+        elif shrinks[0].iterations <= 0:
+            problems.append("resumed from iteration 0, not the "
+                            "checkpointed iterate")
+        if ksp.comm.size >= comm.size:
+            problems.append(f"session still on {ksp.comm.size} devices")
+        if not res.converged:
+            problems.append(f"recovered solve did not converge: {res}")
+        if not res.iterations < cold.iterations:
+            problems.append(
+                f"resumed solve took {res.iterations} iterations, not "
+                f"fewer than the {cold.iterations}-iteration cold start")
+        rtrue = (np.linalg.norm(b - A @ x.to_numpy())
+                 / np.linalg.norm(b))
+        if not rtrue <= RTOL * 1.05:
+            problems.append(f"true relative residual {rtrue:.3e} "
+                            "misses rtol")
+        print(f"[chaos] evict-solve: "
+              f"{'OK' if not problems else 'FAIL'} "
+              f"{comm.size}->{ksp.comm.size} devices, "
+              f"iters {res.iterations} (cold {cold.iterations}), "
+              f"true_rres={rtrue:.3e}")
+    finally:
+        _faults.heal()
+    return [f"evict-solve: {p}" for p in problems]
+
+
+def drill_evict_serving() -> list[str]:
+    """Permanent device loss MID-SERVING-LOAD: the server must adopt the
+    degraded mesh and EVERY pending future must resolve — a converged
+    fp64-parity result, DEADLINE_EXCEEDED, or ServerOverloadedError —
+    with the dispatcher alive for post-recovery traffic."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.serving import SolveServer
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    if comm.size < 2:
+        return ["evict-serving: needs a multi-device mesh "
+                f"(got {comm.size} device[s])"]
+    A = poisson2d_csr(12)
+    n = A.shape[0]
+    rng = np.random.default_rng(8)
+    R = 12
+    Xt = rng.random((n, R))
+    B = np.asarray(A @ Xt)
+    victim = comm.device_ids[-1]
+    srv = SolveServer(
+        comm, window=0.005, max_k=4, max_queue=64, deadline=120.0,
+        retry_policy=tps.RetryPolicy(sleep=lambda _d: None),
+        autostart=False)
+    try:
+        srv.register_operator("poisson", A, rtol=RTOL)
+        futs = [srv.submit("poisson", B[:, j]) for j in range(R)]
+        # the loss fires at the 2nd solve-program boundary: some blocks
+        # complete on the full mesh, the rest ride the shrink
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:at=2:iter=6"):
+            srv.start()
+            if not srv.drain(600):
+                problems.append("drain timed out — hung future(s)")
+        answered = converged = typed = 0
+        for j, f in enumerate(futs):
+            if not f.done():
+                problems.append(f"request {j} future never resolved")
+                continue
+            answered += 1
+            exc = f.exception(0)
+            if exc is None:
+                r = f.result(0)
+                rres = (np.linalg.norm(B[:, j] - A @ r.x)
+                        / np.linalg.norm(B[:, j]))
+                if not (r.converged and rres <= RTOL * 1.05):
+                    problems.append(
+                        f"request {j}: reason={r.reason_name} "
+                        f"true_rres={rres:.3e} (parity miss)")
+                else:
+                    converged += 1
+            elif isinstance(exc, (tps.DeadlineExceededError,
+                                  tps.ServerOverloadedError)):
+                typed += 1
+            else:
+                problems.append(f"request {j}: untyped failure {exc!r}")
+        st = srv.stats()
+        if not st["mesh_shrinks"]:
+            problems.append("server never adopted a shrunk mesh")
+        if srv.comm.size >= comm.size:
+            problems.append(f"server still on {srv.comm.size} devices")
+        if converged == 0:
+            problems.append("no request converged across the shrink")
+        # the dispatcher must survive: post-recovery traffic still served
+        post = srv.solve("poisson", B[:, 0], timeout=300)
+        rres = (np.linalg.norm(B[:, 0] - A @ post.x)
+                / np.linalg.norm(B[:, 0]))
+        if not (post.converged and rres <= RTOL * 1.05):
+            problems.append(f"post-recovery request failed parity "
+                            f"({post.reason_name}, {rres:.3e})")
+        print(f"[chaos] evict-serving: "
+              f"{'OK' if not problems else 'FAIL'} "
+              f"{comm.size}->{srv.comm.size} devices, {answered}/{R} "
+              f"answered ({converged} converged, {typed} typed errors), "
+              f"shrinks={len(st['mesh_shrinks'])}")
+    finally:
+        srv.shutdown(wait=False)
+        _faults.heal()
+    return [f"evict-serving: {p}" for p in problems]
+
+
 def main() -> int:
     import contextlib
 
@@ -97,18 +276,26 @@ def main() -> int:
 
     failures: list[str] = []
     env_spec = os.environ.get("TPU_SOLVE_FAULTS", "").strip()
-    if env_spec:
+    if "--evict" in sys.argv[1:]:
+        # ISSUE 8 acceptance: permanent device loss mid-solve AND
+        # mid-serving-load must recover onto a strictly smaller mesh
+        failures += drill_evict_solve()
+        failures += drill_evict_serving()
+        what = "device-eviction"
+    elif env_spec:
         # env-armed: the plan is already active from the environment
         failures += drill(f"env:{env_spec}", contextlib.nullcontext())
+        what = "silent-corruption"
     else:
         for spec in BUILTIN_SPECS:
             failures += drill(spec, tps.inject_faults(spec))
+        what = "silent-corruption"
     if failures:
         print("[chaos] FAILURES:", file=sys.stderr)
         for f in failures:
             print(f"  - {f}", file=sys.stderr)
         return 1
-    print("[chaos] all silent-corruption drills recovered and verified")
+    print(f"[chaos] all {what} drills recovered and verified")
     return 0
 
 

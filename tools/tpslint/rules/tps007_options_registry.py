@@ -2,7 +2,7 @@
 initial rule set; landed alongside the -ksp_abft* flag family).
 
 Every ``-ksp_*``/``-eps_*``/``-pc_*``/``-svd_*``/``-st_*``/
-``-solve_server_*`` flag read from
+``-solve_server_*``/``-elastic_*`` flag read from
 the options database (``utils/options.py`` getters: ``get``,
 ``get_string``, ``get_int``, ``get_real``, ``get_bool``, ``has``) must
 appear in the documented ``utils/options.KNOWN_FLAGS`` registry: a typo'd
@@ -32,8 +32,10 @@ from .base import Rule, register
 _GETTERS = ("get", "get_string", "get_int", "get_real", "get_bool", "has")
 
 #: flag-name shape the registry governs (solver-object prefixes, plus
-#: the serving layer's -solve_server_* family)
-_FLAG_RE = re.compile(r"^(ksp|eps|pc|svd|st|solve_server)_[a-z0-9_]+$")
+#: the serving layer's -solve_server_* family and the elastic
+#: degraded-mesh recovery's -elastic_* family)
+_FLAG_RE = re.compile(
+    r"^(ksp|eps|pc|svd|st|solve_server|elastic)_[a-z0-9_]+$")
 
 _OPTIONS_REL = Path("mpi_petsc4py_example_tpu") / "utils" / "options.py"
 

@@ -28,8 +28,10 @@ from .base import Rule, register
 
 #: attribute names that count as fault-point hooks on a faults module
 #: (apply_silent_fault is resilience/abft.py's trace-time applicator for
-#: the silent kinds — its point argument names FAULT_POINTS entries too)
-_HOOKS = ("check", "triggered", "apply_silent_fault")
+#: the silent kinds — its point argument names FAULT_POINTS entries too;
+#: mesh_fault is the persistent-device-loss hook at the solve-program
+#: boundary, point-name first, device ids second)
+_HOOKS = ("check", "triggered", "apply_silent_fault", "mesh_fault")
 #: module aliases the repo binds resilience.faults / resilience.abft to
 _MODULE_NAMES = ("faults", "_faults", "abft", "_abft")
 
