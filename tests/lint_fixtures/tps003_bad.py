@@ -17,3 +17,16 @@ def bad_rank():
 
 def bad_shift(x, perm):
     return lax.ppermute(x, "rows", perm)  # BAD: TPS003
+
+
+def bad_fstring(x_local):
+    # an f-string hard-codes the axis just as surely as a plain literal
+    return lax.psum(x_local, f"rows")  # BAD: TPS003
+
+
+def bad_fstring_suffix(x_local, i):
+    return lax.all_gather(x_local, axis_name=f"rows_{i}")  # BAD: TPS003
+
+
+def bad_fstring_interpolated_literal(x_local):
+    return lax.psum(x_local, f"{'rows'}")  # BAD: TPS003

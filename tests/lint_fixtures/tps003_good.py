@@ -15,3 +15,8 @@ def gather(x_local, comm):
 
 def rank(axis=ROW_AXIS):
     return lax.axis_index(axis)
+
+
+def pure_interpolation(x_local, comm):
+    # no literal text: the axis is threaded, only re-stringified
+    return lax.psum(x_local, f"{comm.axis}")

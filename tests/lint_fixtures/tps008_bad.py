@@ -1,0 +1,62 @@
+"""TPS008 fixture — interprocedural host syncs; every `# BAD:` fires.
+
+The sync sites themselves live in plain module-level helpers (host
+functions — TPS001 rightly stays silent there).  The findings anchor at
+the CALL SITES inside traced contexts that pass traced values into
+them, with the full call chain in the message.
+"""
+import jax
+import numpy as np
+from jax import lax
+
+
+def host_norm(v):
+    # fine on host paths; a trace-time sync when reached from jit
+    return float(np.linalg.norm(v))
+
+
+def two_hops(u):
+    return host_norm(u) + 1.0
+
+
+def fetch(v=None):
+    return jax.device_get(v)
+
+
+def wait_on(w):
+    return w.block_until_ready()
+
+
+def scale_by_config(x, rtol):
+    # only `rtol` syncs — per-parameter summaries keep `x` clean
+    return x * float(rtol)
+
+
+@jax.jit
+def direct_call(x):
+    return host_norm(x)  # BAD: TPS008
+
+
+@jax.jit
+def transitive_call(x):
+    y = x * 2.0
+    return two_hops(y)  # BAD: TPS008
+
+
+@jax.jit
+def keyword_call(x):
+    return fetch(v=x + 1)  # BAD: TPS008
+
+
+def body(carry):
+    x, k = carry
+    return (x * wait_on(x), k + 1)  # BAD: TPS008
+
+
+def run(x0):
+    return lax.while_loop(lambda c: c[1] < 3, body, (x0, 0))
+
+
+@jax.jit
+def tainted_param_lands_on_syncing_param(x):
+    return scale_by_config(1.0, x)  # BAD: TPS008

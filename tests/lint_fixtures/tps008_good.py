@@ -1,0 +1,62 @@
+"""TPS008 fixture — the repo's idiomatic patterns; zero findings.
+
+Helpers that sync are fine when no traced value reaches the syncing
+parameter; helpers that keep everything in jnp are fine with traced
+arguments; host-callback targets run on host by design.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RTOL = 1e-8
+
+
+def host_norm(v):
+    return float(np.linalg.norm(v))
+
+
+def scale_by_config(x, rtol):
+    return x * float(rtol)
+
+
+def jnp_norm(v):
+    # stays in the XLA program — traced arguments are fine
+    return jnp.sqrt(jnp.vdot(v, v).real)
+
+
+@jax.jit
+def traced_helper(v):
+    # a traced callee is TPS001's domain, and it does not sync anyway
+    return v * 2.0
+
+
+@jax.jit
+def config_scalar_call(x):
+    # the syncing parameter receives a host config value, not a tracer
+    s = scale_by_config(1.0, RTOL)
+    return x * s + jnp_norm(x)
+
+
+@jax.jit
+def static_arg_stays_host(x):
+    return x + traced_helper(x)
+
+
+def record(v):
+    np.asarray(v)           # host-callback target: runs on host
+
+
+@jax.jit
+def callback_site(x):
+    jax.debug.callback(record, x)
+    return x * 2.0
+
+
+def shapes_are_static(v):
+    return float(v.shape[0])
+
+
+@jax.jit
+def static_attr_call(x):
+    # x.shape concretizes at trace time; nothing syncs at run time
+    return x * shapes_are_static(x)

@@ -1,0 +1,56 @@
+"""TPS010 fixture — grid-spec objects built away from the call site;
+every `# BAD:` fires."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GRID = (4, 4)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def spec_built_far_from_call(nsteps):
+    grid_spec = pl.GridSpec(
+        grid=(nsteps, 8),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],  # BAD: TPS010
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )
+    return grid_spec
+
+
+def prefetch_arity_misses_scalar_refs(x, idx):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(16,),
+        in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],  # BAD: TPS010
+        out_specs=pl.BlockSpec((1, 128), lambda i, s_ref: (i, 0)),
+    )
+    return pl.pallas_call(kernel, out_shape=x, grid_spec=grid_spec)(idx, x)
+
+
+def grid_threaded_through_module_constant():
+    return pl.GridSpec(
+        grid=GRID,
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],  # BAD: TPS010
+    )
+
+
+def blockspec_threaded_through_local(n):
+    # the finding anchors at the index_map lambda — the construction the
+    # GridSpec's reaching-def resolution looked through
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))  # BAD: TPS010
+    return pl.GridSpec(grid=(n, 4), in_specs=[spec])
+
+
+def return_rank_mismatch(n):
+    return pl.GridSpec(
+        grid=(n,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0, 0))],  # BAD: TPS010
+    )
+
+
+def conflicting_geometry(x, spec):
+    return pl.pallas_call(kernel, out_shape=x, grid_spec=spec,  # BAD: TPS010
+                          grid=(4,))(x)

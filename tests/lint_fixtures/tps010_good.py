@@ -1,0 +1,50 @@
+"""TPS010 fixture — consistent grid-spec objects; zero findings."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GRID = (4, 4)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def spec_built_far_from_call(nsteps):
+    return pl.GridSpec(
+        grid=(nsteps, 8),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )
+
+
+def prefetch_scalar_refs_trail_grid_indices(x, idx):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(16,),
+        in_specs=[pl.BlockSpec((1, 128), lambda i, s_ref: (s_ref[i], 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i, s_ref: (i, 0)),
+    )
+    return pl.pallas_call(kernel, out_shape=x, grid_spec=grid_spec)(idx, x)
+
+
+def grid_threaded_through_module_constant():
+    return pl.GridSpec(
+        grid=GRID,
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, 0))],
+    )
+
+
+def blockspec_threaded_through_local(n):
+    spec = pl.BlockSpec((8, 128), lambda i, j: (i, 0))
+    return pl.GridSpec(grid=(n, 4), in_specs=[spec])
+
+
+def dynamic_grid_is_not_guessed(shape):
+    # grid rank unknowable statically: the rule stays silent
+    return pl.GridSpec(grid=shape,
+                       in_specs=[pl.BlockSpec((8,), lambda i: (i,))])
+
+
+def bundle_only_call_site(x, spec):
+    return pl.pallas_call(kernel, out_shape=x, grid_spec=spec)(x)

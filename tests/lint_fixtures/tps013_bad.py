@@ -1,0 +1,64 @@
+"""TPS013 fixture — use-after-donation; every `# BAD:` fires.
+
+``loop_snapshot`` reproduces the pre-fix PR-6 ``resilience/fallback.py``
+bug verbatim in shape: the pristine-guess snapshot is a BARE reference
+to ``x.data``; the first donated stage consumes the buffer, and every
+later escalation re-seeds the iterate from a deleted array.
+"""
+import jax.numpy as jnp
+
+from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+
+
+def stale_snapshot_after_solve(ksp, b, x):
+    x0_data = x.data
+    result = ksp.solve(b, x)
+    x.data = x0_data  # BAD: TPS013
+    return result
+
+
+def loop_snapshot(ksp, b, x, stages):
+    # the PR-6 fallback.py shape: snapshot by reference, donated in the
+    # first loop pass, re-read (deleted) on every later escalation
+    x0_data = x.data
+    for ksp_type in stages:
+        ksp.set_type(ksp_type)
+        x.data = x0_data  # BAD: TPS013
+        result = ksp.solve(b, x)
+        if result.converged:
+            break
+    return result
+
+
+def donated_operand_read(comm, pc, operator, operands, b, x0):
+    prog = build_ksp_program(comm, "cg", pc, operator, donate=True)
+    out = prog(operands, b, x0)
+    return b - x0  # BAD: TPS013
+
+
+def donated_keyword_operand(comm, pc, operator, operands, b, x0):
+    prog = build_ksp_program_many(comm, "cg", pc, operator, donate=True)
+    out = prog(operands, b, X0=x0)
+    rnorm = jnp.linalg.norm(x0)  # BAD: TPS013
+    return out, rnorm
+
+
+def server_dispatch_alias(comm, vec):
+    srv = SolveServer(comm)
+    snapshot = vec.data
+    fut = srv.submit("poisson", vec)
+    return snapshot * 2.0  # BAD: TPS013
+
+
+def solve_many_block_alias(ksp, B, X):
+    block = X.data
+    ksp.solve_many(B, X)
+    return block[:, 0]  # BAD: TPS013
+
+
+def build_ksp_program_many(comm, ksp_type, pc, operator, donate=False):
+    return build_ksp_program
+
+
+def SolveServer(comm):
+    return comm

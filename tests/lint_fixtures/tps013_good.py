@@ -1,0 +1,58 @@
+"""TPS013 fixture — the repo's donation-safe idioms; zero findings."""
+import jax.numpy as jnp
+
+from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+
+
+def copied_snapshot(ksp, b, x, stages):
+    # the POST-fix fallback.py idiom: jnp.copy breaks the alias, and each
+    # escalation gets its own donable copy
+    x0_data = jnp.copy(x.data)
+    for ksp_type in stages:
+        ksp.set_type(ksp_type)
+        x.data = jnp.copy(x0_data)
+        result = ksp.solve(b, x)
+        if result.converged:
+            break
+    return result
+
+
+def rebound_after_solve(ksp, b, x):
+    before = x.data
+    bnorm = jnp.linalg.norm(before)     # read BEFORE the donation: fine
+    ksp.solve(b, x)
+    after = x.data                      # rebound output buffer: fine
+    return bnorm, after
+
+
+def donating_branch_raises(comm, pc, operator, operands, b, x0, fault):
+    # the solvers/ksp.py idiom: the fault branch dispatches a truncated
+    # program (consuming x0) and RAISES — the fall-through path never saw
+    # a donation, so reading x0 there is fine
+    prog = build_ksp_program(comm, "cg", pc, operator, donate=True)
+    if fault is not None:
+        prog(operands, b, x0)
+        raise RuntimeError("injected")
+    return x0 + b
+
+
+def donation_not_armed(comm, pc, operator, operands, b, x0, flag):
+    # donate= is dynamic (or absent): the program is not statically
+    # donate-armed, so later reads are not flagged
+    prog = build_ksp_program(comm, "cg", pc, operator, donate=flag)
+    out = prog(operands, b, x0)
+    return b - x0
+
+
+def copy_before_donating_call(comm, pc, operator, operands, b, x0):
+    prog = build_ksp_program(comm, "cg", pc, operator, donate=True)
+    keep = jnp.copy(x0)
+    out = prog(operands, b, x0)
+    return b - keep
+
+
+def rebind_clears(comm, pc, operator, operands, b, x0):
+    prog = build_ksp_program(comm, "cg", pc, operator, donate=True)
+    out = prog(operands, b, x0)
+    x0 = out[0]                         # rebound from the output: fine
+    return b - x0

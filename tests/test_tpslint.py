@@ -28,13 +28,30 @@ from tools.tpslint.cli import main as tpslint_main
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006",
-            "TPS007", "TPS009", "TPS011", "TPS012")
+            "TPS007", "TPS008", "TPS009", "TPS010", "TPS011", "TPS012",
+            "TPS013")
 #: current advisory (warn-tier) count over the repo's own packages — the
 #: CI --warn-budget. Raising it requires looking at the new advisory and
 #: deciding it is acceptable; that is the tier's whole contract.
 REPO_WARN_BUDGET = 3
 
 _MARKER_RE = re.compile(r"#\s*BAD:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+#: the repo's own linted trees — the CONTRIBUTING merge-requirement scope
+REPO_DIRS = [str(REPO / d)
+             for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
+                       "examples")]
+_REPO_RESULT = None
+
+
+def _repo_analysis():
+    """The repo-wide lint, memoized — four tests assert different
+    properties of the SAME run (clean, warn budget, no stale
+    suppressions, SARIF shape); one phase-1 index build serves all."""
+    global _REPO_RESULT
+    if _REPO_RESULT is None:
+        _REPO_RESULT = analyze_paths(REPO_DIRS)
+    return _REPO_RESULT
 
 
 def _expected(path: Path):
@@ -251,15 +268,14 @@ def test_unaliased_jax_numpy_wide_dtype_detected():
 def test_repo_lints_clean():
     """The merge requirement: zero unsuppressed findings over the repo's own
     packages, and every suppression justified."""
-    dirs = [str(REPO / d)
-            for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
-                      "examples")]
-    for d in dirs:
+    from tools.tpslint.engine import iter_python_files
+    for d in REPO_DIRS:
         # guard against a vacuous pass: each linted tree must exist and
         # contribute files (a rename must break THIS test, not silently
         # shrink coverage)
-        assert analyze_paths([d]).files_linted > 0, d
-    result = analyze_paths(dirs)
+        assert list(iter_python_files([d])), d
+    result = _repo_analysis()
+    assert result.files_linted > 0
     msgs = [f.format() for f in
             result.findings + result.bad_suppressions + result.errors]
     assert msgs == []
@@ -270,10 +286,7 @@ def test_repo_warn_budget():
     budget — TPS011 advisories are acceptable where they sit, but new
     ones must be looked at (stack the reductions or raise the budget
     consciously)."""
-    dirs = [str(REPO / d)
-            for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
-                      "examples")]
-    result = analyze_paths(dirs)
+    result = _repo_analysis()
     warn_sites = [f.format() for f in result.warnings]
     assert len(warn_sites) <= REPO_WARN_BUDGET, warn_sites
     assert result.exit_code(strict=True,
@@ -384,10 +397,7 @@ def test_cli_warn_budget(capsys):
 
 
 def test_repo_has_no_stale_suppressions():
-    dirs = [str(REPO / d)
-            for d in ("mpi_petsc4py_example_tpu", "compat", "tools",
-                      "examples")]
-    result = analyze_paths(dirs)
+    result = _repo_analysis()
     stale = [(s.path, s.line) for s in result.unused_suppressions]
     assert stale == []
 
@@ -427,3 +437,436 @@ def test_console_script_runs_as_module():
         capture_output=True, text=True, cwd=str(REPO))
     assert proc.returncode == 0
     assert "TPS001" in proc.stdout
+
+
+# ---------------------------------------------- program index (round 9)
+def test_module_parts():
+    from tools.tpslint.program import module_parts
+    assert module_parts("mpi_petsc4py_example_tpu/solvers/krylov.py") == (
+        "mpi_petsc4py_example_tpu", "solvers", "krylov")
+    assert module_parts("pkg/__init__.py") == ("pkg",)
+    # non-identifier leading segments (absolute paths) are dropped
+    assert module_parts("/tmp/x-y/pkg/mod.py") == ("pkg", "mod")
+
+
+def _write_tree(tmp_path, files: dict):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return [str(tmp_path / r) for r in files]
+
+
+def test_call_graph_resolves_across_modules(tmp_path):
+    _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": ("import numpy as np\n"
+                           "def hnorm(v):\n"
+                           "    return float(np.linalg.norm(v))\n"),
+        "pkg/caller.py": ("from .helpers import hnorm\n"
+                          "def use(x):\n"
+                          "    return hnorm(x)\n"),
+    })
+    from tools.tpslint.engine import build_index
+    import ast as _ast
+    index, errors = build_index([str(tmp_path / "pkg")])
+    assert errors == []
+    caller = index.module_for(str(tmp_path / "pkg" / "caller.py"))
+    call = next(n for n in _ast.walk(caller.analysis.tree)
+                if isinstance(n, _ast.Call))
+    rec = index.resolve_call(caller.analysis, call)
+    assert rec is not None
+    assert rec.qualname == "hnorm"
+    assert rec.path.endswith("helpers.py")
+    # and the sync summary names the syncing parameter
+    assert "v" in index.summary_for(rec)
+
+
+def test_tps008_cross_module_chain_in_message(tmp_path):
+    _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/lib.py": ("import numpy as np\n"
+                       "def inner(u):\n"
+                       "    return float(np.linalg.norm(u))\n"
+                       "def outer(w):\n"
+                       "    return inner(w) + 1.0\n"),
+        "pkg/jitted.py": ("import jax\n"
+                          "from .lib import outer\n"
+                          "@jax.jit\n"
+                          "def f(x):\n"
+                          "    return outer(x)\n"),
+    })
+    result = analyze_paths([str(tmp_path / "pkg")])
+    assert [(f.rule, Path(f.path).name, f.line) for f in result.findings] \
+        == [("TPS008", "jitted.py", 5)]
+    msg = result.findings[0].message
+    # the full call chain, down to the syncing op two hops away
+    assert "outer" in msg and "inner" in msg and "float()" in msg
+    assert "lib.py:3" in msg
+
+
+def test_tps013_fires_on_prefix_pr6_fallback_pattern():
+    """The PR-6 resilience/fallback.py bug, pre-fix shape: a bare
+    x.data snapshot donated by the first escalation stage and re-read
+    by the next — the fixture the rule exists for."""
+    src = (
+        "def solve(ksp, b, x, stages):\n"
+        "    x0_data = x.data\n"
+        "    for ksp_type in stages:\n"
+        "        ksp.set_type(ksp_type)\n"
+        "        x.data = x0_data\n"
+        "        result = ksp.solve(b, x)\n"
+        "        if result.reason >= 0:\n"
+        "            break\n"
+        "    return result\n"
+    )
+    result = analyze_source(src)
+    assert [(f.rule, f.line) for f in result.findings] == [("TPS013", 5)]
+    # ...and the post-fix shape (jnp.copy both ways) is clean
+    fixed = src.replace("x0_data = x.data",
+                        "x0_data = jnp.copy(x.data)").replace(
+        "x.data = x0_data", "x.data = jnp.copy(x0_data)")
+    assert analyze_source(fixed).findings == []
+
+
+def test_tps013_current_fallback_is_clean():
+    """The shipped resilience/fallback.py (post-fix) must stay clean —
+    the regression the rule now guards structurally."""
+    path = REPO / "mpi_petsc4py_example_tpu" / "resilience" / "fallback.py"
+    result = analyze_source(path.read_text(), path=str(path),
+                            select=["TPS013"])
+    assert result.findings == []
+
+
+def test_tps013_raising_branch_does_not_poison_fallthrough():
+    """The solvers/ksp.py idiom: the fault branch consumes x0 and
+    raises; the fall-through path never saw a donation."""
+    src = (
+        "from mpi_petsc4py_example_tpu.solvers.krylov import "
+        "build_ksp_program\n"
+        "def run(comm, pc, A, ops, b, x0, fault):\n"
+        "    prog = build_ksp_program(comm, 'cg', pc, A, donate=True)\n"
+        "    if fault:\n"
+        "        prog(ops, b, x0)\n"
+        "        raise RuntimeError('injected')\n"
+        "    return x0 + b\n"
+    )
+    assert analyze_source(src).findings == []
+
+
+# --------------------------------------------- changed-files (round 9)
+def test_changed_files_keeps_full_program_index(tmp_path):
+    files = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/lib.py": ("import numpy as np\n"
+                       "def hnorm(v):\n"
+                       "    return float(np.linalg.norm(v))\n"),
+        "pkg/jitted.py": ("import jax\n"
+                          "from .lib import hnorm\n"
+                          "@jax.jit\n"
+                          "def f(x):\n"
+                          "    return hnorm(x)\n"),
+    })
+    root = str(tmp_path / "pkg")
+    full = analyze_paths([root])
+    assert [(f.rule, Path(f.path).name) for f in full.findings] \
+        == [("TPS008", "jitted.py")]
+    # report only the changed caller: the cross-file finding STILL fires
+    # (the index covers the whole tree)
+    only_caller = analyze_paths([root], report_files=[files[2]])
+    assert [(f.rule, Path(f.path).name) for f in only_caller.findings] \
+        == [("TPS008", "jitted.py")]
+    assert only_caller.files_linted == 1
+    # report only the (clean) helper: the caller's finding is filtered
+    only_helper = analyze_paths([root], report_files=[files[1]])
+    assert only_helper.findings == []
+    assert only_helper.files_linted == 1
+
+
+def test_cli_changed_files(tmp_path, capsys):
+    bad = FIXTURES / "tps001_bad.py"
+    good = FIXTURES / "tps001_good.py"
+    # findings only in the changed file
+    assert tpslint_main([str(bad), str(good),
+                         "--changed-files", str(good)]) == 0
+    assert tpslint_main([str(bad), str(good),
+                         "--changed-files", str(bad)]) == 1
+    # deleted / non-Python changed paths are ignored, not errors
+    assert tpslint_main([str(good), "--changed-files",
+                         str(tmp_path / "gone.py"), "README.md"]) == 0
+    err = capsys.readouterr().err
+    assert "no changed Python files" in err
+
+
+def test_cli_changed_files_syntax_error_fails(tmp_path, capsys):
+    """A changed file that fails to parse is skipped by phase-1 indexing
+    but is NOT 'outside the linted paths' — its TPS-PARSE finding must
+    be reported and fail the PR-lint run, not green-light it."""
+    _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/ok.py": "x = 1\n",
+        "pkg/broken.py": "def f(:\n",
+    })
+    root = str(tmp_path / "pkg")
+    broken = str(tmp_path / "pkg" / "broken.py")
+    assert tpslint_main([root, "--changed-files", broken]) == 1
+    captured = capsys.readouterr()
+    assert "TPS-PARSE" in captured.out
+    assert "outside the linted paths" not in captured.err
+
+
+def test_reindex_same_path_keeps_cross_file_resolution(tmp_path):
+    """Re-adding an already-indexed path (analyze_source against a
+    long-lived index) must evict the stale ModuleEntry: a leftover twin
+    makes dotted-name lookup ambiguous and would silently kill the
+    cross-file TPS008 finding."""
+    from tools.tpslint.engine import build_index
+    _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/lib.py": ("import numpy as np\n"
+                       "def hnorm(v):\n"
+                       "    return float(np.linalg.norm(v))\n"),
+        "pkg/jitted.py": ("import jax\n"
+                          "from .lib import hnorm\n"
+                          "@jax.jit\n"
+                          "def f(x):\n"
+                          "    return hnorm(x)\n"),
+    })
+    root = str(tmp_path / "pkg")
+    index, _ = build_index([root])
+    assert [f.rule for f in analyze_paths([root], index=index).findings] \
+        == ["TPS008"]
+    lib = tmp_path / "pkg" / "lib.py"
+    analyze_source(lib.read_text(), path=str(lib), index=index)
+    result = analyze_paths([root], index=index)
+    assert [f.rule for f in result.findings] == ["TPS008"]
+
+
+# ----------------------------------------------- index cache (round 9)
+def test_index_cache_round_trip(tmp_path):
+    from tools.tpslint.cache import load_index, save_index, tree_hash
+    from tools.tpslint.engine import build_index
+    _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/lib.py": ("import numpy as np\n"
+                       "def hnorm(v):\n"
+                       "    return float(np.linalg.norm(v))\n"),
+        "pkg/jitted.py": ("import jax\n"
+                          "from .lib import hnorm\n"
+                          "@jax.jit\n"
+                          "def f(x):\n"
+                          "    return hnorm(x)\n"),
+    })
+    root = str(tmp_path / "pkg")
+    cache = str(tmp_path / "cache.pickle")
+    key = tree_hash([root])
+    index, errors = build_index([root])
+    index.sync_summaries()          # the cache must carry the summaries
+    save_index(cache, key, index, errors)
+
+    hit = load_index(cache, key)
+    assert hit is not None
+    loaded, loaded_errors = hit
+    assert loaded_errors == []
+    # the interprocedural rule must keep firing through the UNPICKLED
+    # index (summary keys are source coordinates, not object ids)
+    result = analyze_paths([root], index=loaded)
+    assert [f.rule for f in result.findings] == ["TPS008"]
+
+    # any content change misses
+    (tmp_path / "pkg" / "lib.py").write_text("x = 1\n")
+    assert tree_hash([root]) != key
+    assert load_index(cache, tree_hash([root])) is None
+    # corrupt blobs are a silent miss, never a crash
+    Path(cache).write_bytes(b"not a pickle")
+    assert load_index(cache, key) is None
+
+
+def test_cli_index_cache(tmp_path, capsys):
+    cache = str(tmp_path / "idx")
+    bad = str(FIXTURES / "tps001_bad.py")
+    assert tpslint_main(["--index-cache", cache, bad]) == 1
+    assert Path(cache).exists()
+    # warm run: same findings from the cached index
+    assert tpslint_main(["--index-cache", cache, bad]) == 1
+    out = capsys.readouterr().out
+    assert "TPS001" in out
+
+
+# ------------------------------------------------------ SARIF (round 9)
+def _validate_sarif_210(doc):
+    """Structural validation against the SARIF 2.1.0 schema.
+
+    Uses the jsonschema validator with the schema's constraints for
+    every object tpslint emits (sarifLog / run / tool / toolComponent /
+    reportingDescriptor / result / location subset — required
+    properties, enums and const pins transcribed from
+    sarif-schema-2.1.0.json) when jsonschema is installed; otherwise
+    enforces the same constraints by hand.
+    """
+    schema = {
+        "type": "object",
+        "required": ["version", "runs"],
+        "properties": {
+            "version": {"const": "2.1.0"},
+            "$schema": {"type": "string", "format": "uri"},
+            "runs": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["tool"],
+                    "properties": {
+                        "tool": {
+                            "type": "object",
+                            "required": ["driver"],
+                            "properties": {
+                                "driver": {
+                                    "type": "object",
+                                    "required": ["name"],
+                                    "properties": {
+                                        "name": {"type": "string"},
+                                        "rules": {
+                                            "type": "array",
+                                            "items": {
+                                                "type": "object",
+                                                "required": ["id"],
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                        "results": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "required": ["message"],
+                                "properties": {
+                                    "message": {
+                                        "type": "object",
+                                        "anyOf": [
+                                            {"required": ["text"]},
+                                            {"required": ["id"]},
+                                        ],
+                                    },
+                                    "level": {"enum": ["none", "note",
+                                                       "warning",
+                                                       "error"]},
+                                    "ruleId": {"type": "string"},
+                                    "locations": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "properties": {
+                                                "physicalLocation": {
+                                                    "type": "object",
+                                                    "properties": {
+                                                        "artifactLocation": {
+                                                            "type": "object",
+                                                            "properties": {
+                                                                "uri": {"type": "string"},
+                                                            },
+                                                        },
+                                                        "region": {
+                                                            "type": "object",
+                                                            "properties": {
+                                                                "startLine": {"type": "integer", "minimum": 1},
+                                                                "startColumn": {"type": "integer", "minimum": 1},
+                                                            },
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    }
+    try:
+        import jsonschema
+    except ImportError:
+        jsonschema = None
+    if jsonschema is not None:
+        jsonschema.validate(doc, schema)
+    # the hand-rolled pass always runs — CI may lack jsonschema
+    assert doc["version"] == "2.1.0"
+    assert isinstance(doc["runs"], list) and doc["runs"]
+    for run in doc["runs"]:
+        driver = run["tool"]["driver"]
+        assert isinstance(driver["name"], str) and driver["name"]
+        rule_ids = {r["id"] for r in driver.get("rules", ())}
+        for res in run.get("results", ()):
+            assert res["message"].get("text") or res["message"].get("id")
+            assert res.get("level") in ("none", "note", "warning", "error")
+            # GitHub requires every ruleId to resolve to a descriptor
+            assert res["ruleId"] in rule_ids, res["ruleId"]
+            for loc in res.get("locations", ()):
+                region = loc["physicalLocation"]["region"]
+                assert region["startLine"] >= 1
+                assert region["startColumn"] >= 1
+                uri = loc["physicalLocation"]["artifactLocation"]["uri"]
+                assert "\\" not in uri
+
+
+def test_sarif_validates_and_maps_levels():
+    from tools.tpslint.sarif import to_sarif
+    result = analyze_paths([str(FIXTURES / "tps001_bad.py"),
+                            str(FIXTURES / "tps011_bad.py")])
+    doc = to_sarif(result, all_rules())
+    _validate_sarif_210(doc)
+    results = doc["runs"][0]["results"]
+    levels = {(r["ruleId"], r["level"]) for r in results}
+    assert ("TPS001", "error") in levels
+    assert ("TPS011", "warning") in levels      # warn tier -> warning
+    # columns are 1-based in SARIF (ast columns are 0-based)
+    f = result.findings[0]
+    sarif_cols = {r["locations"][0]["physicalLocation"]["region"]
+                  ["startColumn"] for r in results
+                  if r["ruleId"] == f.rule}
+    assert f.col + 1 in sarif_cols
+
+
+def test_sarif_stale_suppressions_and_parse_errors():
+    from tools.tpslint.sarif import to_sarif
+    stale = analyze_source(
+        "x = 1  # tpslint: disable=TPS001 — nothing fires here\n",
+        path="stale.py")
+    broken = analyze_source("def broken(:\n", path="broken.py")
+    stale.merge(broken)
+    doc = to_sarif(stale, all_rules())
+    _validate_sarif_210(doc)
+    by_rule = {r["ruleId"]: r for r in doc["runs"][0]["results"]}
+    assert by_rule["TPS-STALE"]["level"] == "note"
+    assert by_rule["TPS-PARSE"]["level"] == "error"
+
+
+def test_cli_sarif_flag(tmp_path, capsys):
+    import json
+    out = tmp_path / "lint.sarif"
+    assert tpslint_main(["--sarif", str(out),
+                         str(FIXTURES / "tps001_bad.py")]) == 1
+    doc = json.loads(out.read_text())
+    _validate_sarif_210(doc)
+    assert doc["runs"][0]["results"]
+    capsys.readouterr()
+
+
+def test_sarif_repo_run_is_empty_of_errors():
+    """The CI shape: a clean repo emits a SARIF log whose only results
+    are the budgeted warn-tier advisories."""
+    from tools.tpslint.sarif import to_sarif
+    result = _repo_analysis()
+    doc = to_sarif(result, all_rules(), base_dir=str(REPO))
+    _validate_sarif_210(doc)
+    levels = [r["level"] for r in doc["runs"][0]["results"]]
+    assert levels.count("error") == 0
+    assert levels.count("warning") <= REPO_WARN_BUDGET
+    # relative forward-slash uris (GitHub matches them against the repo)
+    for r in doc["runs"][0]["results"]:
+        uri = r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        assert not uri.startswith("/"), uri

@@ -1,0 +1,103 @@
+"""Program-index cache — skip phase-1 re-parsing when the tree is
+unchanged.
+
+CI runs tpslint several times per workflow (the full ``--strict`` run
+plus the per-subsystem ``--strict <subdir>`` steps of the serving /
+multichip / resilience jobs).  With the round-9 two-phase engine each
+run would re-parse the whole tree just to rebuild the same program
+index.  ``tpslint --index-cache PATH`` pickles the index keyed on a
+source-tree hash: a hit loads the parsed modules (and the phase-1
+read/parse error findings) instead of re-parsing; any content change,
+tpslint-source change, or Python version change misses and rebuilds.
+
+Cache failures are NEVER lint failures — a corrupt/unreadable/stale
+blob silently falls back to a fresh build (and rewrites the cache).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import sys
+
+#: bump when the pickled shape changes incompatibly
+FORMAT_VERSION = 1
+
+
+def _tpslint_source_digest() -> str:
+    """Hash of the tpslint package's own sources — a rule or engine
+    change must invalidate cached indexes built by the old code."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            fp = os.path.join(root, name)
+            h.update(name.encode())
+            with open(fp, "rb") as fh:
+                h.update(hashlib.sha256(fh.read()).digest())
+    return h.hexdigest()
+
+
+def tree_hash(paths) -> str:
+    """Content hash over every .py file the index would cover, plus the
+    tpslint source digest and the interpreter version (ast pickles are
+    not portable across minor versions)."""
+    from .engine import iter_python_files
+    h = hashlib.sha256()
+    h.update(f"fmt{FORMAT_VERSION};py{sys.version_info[:2]}".encode())
+    h.update(_tpslint_source_digest().encode())
+    for fname in sorted(iter_python_files(paths)):
+        h.update(os.path.normpath(fname).encode())
+        h.update(b"\0")
+        try:
+            with open(fname, "rb") as fh:
+                h.update(hashlib.sha256(fh.read()).digest())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def load_index(cache_path: str, key: str):
+    """``(index, phase1_errors)`` on a hit, None on any miss/failure."""
+    try:
+        with open(cache_path, "rb") as fh:
+            blob = pickle.load(fh)
+        if blob.get("key") != key:
+            return None
+        return blob["index"], blob["errors"]
+    # tpslint: disable=TPS005 — unpickling an arbitrary stale blob can
+    # raise nearly anything (Unpickling/Attribute/Import/Memory errors);
+    # every cache failure is by contract a silent miss, never a lint
+    # failure, and nothing is swallowed that a rebuild doesn't redo
+    except Exception:       # noqa: BLE001
+        return None
+
+
+def save_index(cache_path: str, key: str, index, errors):
+    """Atomic best-effort write; failures are silent (the lint already
+    has its result — caching is an optimization, never a gate)."""
+    tmp = f"{cache_path}.tmp.{os.getpid()}"
+    try:
+        parent = os.path.dirname(os.path.abspath(cache_path))
+        os.makedirs(parent, exist_ok=True)
+        limit = sys.getrecursionlimit()
+        sys.setrecursionlimit(max(limit, 100000))
+        try:
+            with open(tmp, "wb") as fh:
+                pickle.dump({"key": key, "index": index, "errors": errors},
+                            fh, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, cache_path)
+        finally:
+            sys.setrecursionlimit(limit)
+    # tpslint: disable=TPS005 — pickling deep ASTs can raise Recursion/
+    # Pickling/OS errors; the cache is an optimization, the lint result
+    # is already computed, so every failure degrades to "no cache"
+    except Exception:       # noqa: BLE001
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

@@ -6,6 +6,17 @@ Usage::
     tpslint --strict ...          # CI mode: also fail on unused suppressions
     tpslint --list-rules
     tpslint --select TPS001,TPS005 path/
+    tpslint --sarif out.sarif ...             # GitHub code-scanning log
+    tpslint ... --changed-files a.py dir/     # full index, filtered report
+    tpslint --index-cache .cache/idx ...      # reuse the phase-1 parse
+
+Two-phase (round 9): every run first builds the project-wide program
+index over ALL given paths (module/symbol table + call graph — what the
+interprocedural rules TPS008/TPS013 walk), then lints.  The
+``--changed-files`` PR mode keeps the full index but reports findings
+only in the listed files; ``--index-cache`` persists the phase-1 parse
+keyed on a source-tree hash so repeated subdir runs in one CI workflow
+parse the tree once.
 """
 
 from __future__ import annotations
@@ -14,8 +25,10 @@ import argparse
 import os
 import sys
 
-from .engine import analyze_paths
+from .cache import load_index, save_index, tree_hash
+from .engine import analyze_paths, build_index
 from .rules import all_rules
+from .sarif import write_sarif
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -25,7 +38,8 @@ def _build_parser() -> argparse.ArgumentParser:
                      "jit/shard_map/Pallas invariants of the TPU "
                      "sparse-solve stack"))
     p.add_argument("paths", nargs="*",
-                   help="files or directories to lint")
+                   help="files or directories to lint (and to index — "
+                        "the interprocedural rules see all of them)")
     p.add_argument("--strict", action="store_true",
                    help="also fail on unused (stale) suppressions")
     p.add_argument("--warn-budget", type=int, default=None,
@@ -36,6 +50,22 @@ def _build_parser() -> argparse.ArgumentParser:
                         "accumulate)")
     p.add_argument("--select", default=None, metavar="TPS001,TPS002",
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--changed-files", nargs="+", default=None,
+                   metavar="PATH",
+                   help="report findings only in these files/directories; "
+                        "the program index still covers every positional "
+                        "path, so cross-file analysis stays whole-program "
+                        "(the fast PR-lint mode). Non-Python and deleted "
+                        "paths are ignored; listed files outside the "
+                        "indexed paths are skipped with a note")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write findings as a SARIF 2.1.0 log "
+                        "(GitHub code-scanning annotations)")
+    p.add_argument("--index-cache", default=None, metavar="PATH",
+                   help="pickle the phase-1 program index here, keyed on "
+                        "a source-tree hash; a matching cache skips "
+                        "re-parsing (CI: key the cache on the tree hash "
+                        "so subdir lint steps share one parse)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     p.add_argument("--show-suppressed", action="store_true",
@@ -74,7 +104,63 @@ def main(argv=None) -> int:
                   f"{', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
 
-    result = analyze_paths(args.paths, select=select)
+    # ---- phase 1: program index (cached when --index-cache hits) ----
+    index = phase1_errors = None
+    cache_key = None
+    if args.index_cache:
+        cache_key = tree_hash(args.paths)
+        hit = load_index(args.index_cache, cache_key)
+        if hit is not None:
+            index, phase1_errors = hit
+    if index is None:
+        index, phase1_errors = build_index(args.paths)
+        if args.index_cache:
+            # precompute the expensive interprocedural summaries so the
+            # cached index carries them — a cache hit skips the whole
+            # phase-1 cost, not just the parse
+            index.sync_summaries()
+            save_index(args.index_cache, cache_key, index, phase1_errors)
+
+    # ---- changed-files scope: full index, filtered report ----
+    report_files = None
+    if args.changed_files is not None:
+        indexed = set(index.modules)
+        # a file missing from the index is not necessarily out of scope:
+        # unreadable/unparsable files are skipped by phase 1 but carry a
+        # TPS-READ/TPS-PARSE finding that must still fail a PR touching them
+        erred = {os.path.normpath(e.path) for e in phase1_errors}
+        report_files = []
+        for path in args.changed_files:
+            if os.path.isdir(path):
+                report_files.append(path)
+            elif not path.endswith(".py") or not os.path.exists(path):
+                continue        # deleted/non-Python changes: nothing to lint
+            elif os.path.normpath(path) in indexed \
+                    or os.path.normpath(path) in erred:
+                report_files.append(path)
+            else:
+                print(f"tpslint: note: {path} is outside the linted "
+                      "paths; skipping", file=sys.stderr)
+        if not report_files:
+            print("tpslint: clean (no changed Python files under the "
+                  "linted paths)", file=sys.stderr)
+            if args.sarif:
+                empty = analyze_paths([], index=index, report_files=[])
+                write_sarif(args.sarif, empty, all_rules(),
+                            base_dir=os.getcwd())
+            return 0
+
+    result = analyze_paths(args.paths, select=select, index=index,
+                           report_files=report_files)
+    if report_files is None:
+        result.errors.extend(phase1_errors)
+    else:
+        rset = _report_set(report_files)
+        result.errors.extend(e for e in phase1_errors
+                             if os.path.normpath(e.path) in rset)
+
+    if args.sarif:
+        write_sarif(args.sarif, result, all_rules(), base_dir=os.getcwd())
 
     for f in result.errors:
         print(f.format())
@@ -114,6 +200,11 @@ def main(argv=None) -> int:
     else:
         print("tpslint: clean", file=sys.stderr)
     return code
+
+
+def _report_set(report_files):
+    from .engine import iter_python_files
+    return {os.path.normpath(f) for f in iter_python_files(report_files)}
 
 
 if __name__ == "__main__":

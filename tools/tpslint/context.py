@@ -200,6 +200,10 @@ class ModuleAnalysis:
         self.tree = tree
         self.source = source
         self.path = path
+        #: the project-wide ProgramIndex (tools/tpslint/program.py), set
+        #: by the engine's phase-1 indexing pass before any rule runs —
+        #: every rule can follow calls across the analyzed file set
+        self.program = None
         self.info = ModuleInfo().collect(tree)
         self.parents = {}
         for parent in ast.walk(tree):

@@ -1,4 +1,19 @@
-"""Analysis driver: parse, run rules, apply suppressions."""
+"""Analysis driver: index (phase 1), run rules (phase 2), suppressions.
+
+Round 9: linting is two-phase.  Phase 1 parses EVERY file under the
+given paths into a :class:`~tools.tpslint.context.ModuleAnalysis` and
+builds the project-wide :class:`~tools.tpslint.program.ProgramIndex`
+(module/symbol table + call graph + dataflow summaries).  Phase 2 runs
+the rules per module with ``module.program`` pointing at the index, so
+interprocedural rules (TPS008 host-sync reachability, TPS013 donation
+safety) see the whole program while findings stay anchored to one file.
+
+``report_files`` decouples the two scopes: the index always covers all
+``paths``, but findings are reported only for the listed files — the
+``tpslint --changed-files`` PR-lint mode, where a cross-file finding in
+an unchanged file must not fail a PR that didn't touch it, yet the
+changed files are still analyzed against the FULL call graph.
+"""
 
 from __future__ import annotations
 
@@ -9,6 +24,7 @@ from dataclasses import dataclass, field
 from .context import ModuleAnalysis
 from .findings import (BAD_SUPPRESSION, Finding, Suppression,
                        parse_suppressions)
+from .program import ProgramIndex
 from .rules import all_rules
 
 
@@ -23,6 +39,8 @@ class AnalysisResult:
     unused_suppressions: list = field(default_factory=list)  # Suppression
     errors: list = field(default_factory=list)         # Finding (parse)
     files_linted: int = 0
+    #: the phase-1 ProgramIndex (analyze_paths/analyze_source fill it in)
+    index: ProgramIndex | None = None
 
     def merge(self, other: "AnalysisResult"):
         self.findings.extend(other.findings)
@@ -48,25 +66,11 @@ class AnalysisResult:
         return 0
 
 
-def analyze_source(source: str, path: str = "<string>",
-                   select=None) -> AnalysisResult:
-    """Lint one module's source.  ``select`` optionally restricts to an
-    iterable of rule ids."""
+def _lint_module(module: ModuleAnalysis, rules) -> AnalysisResult:
+    """Phase 2 for one already-parsed module: run rules, apply
+    suppressions."""
     result = AnalysisResult()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        result.errors.append(Finding(
-            rule="TPS-PARSE", message=f"syntax error: {e.msg}",
-            line=e.lineno or 1, col=(e.offset or 1) - 1, path=path))
-        return result
-
-    module = ModuleAnalysis(tree, source, path)
-    rules = all_rules()
-    if select is not None:
-        wanted = set(select)
-        rules = {rid: r for rid, r in rules.items() if rid in wanted}
-
+    path = module.path
     raw = []
     for rule in rules.values():
         for f in rule.check(module):
@@ -75,13 +79,13 @@ def analyze_source(source: str, path: str = "<string>",
                                severity=f.severity))
     raw.sort(key=lambda f: (f.line, f.col, f.rule))
 
-    suppressions = parse_suppressions(source)
+    suppressions = parse_suppressions(module.source)
     for s in suppressions:
         s.path = path
 
     # findings anchor at a statement's FIRST line; a trailing suppression on
     # a continuation line of a multi-line statement must still guard it
-    stmt_spans = [(n.lineno, n.end_lineno) for n in ast.walk(tree)
+    stmt_spans = [(n.lineno, n.end_lineno) for n in ast.walk(module.tree)
                   if isinstance(n, ast.stmt) and n.end_lineno is not None]
 
     def _statement_start(line: int):
@@ -127,6 +131,43 @@ def analyze_source(source: str, path: str = "<string>",
     return result
 
 
+def _selected_rules(select):
+    rules = all_rules()
+    if select is not None:
+        wanted = set(select)
+        rules = {rid: r for rid, r in rules.items() if rid in wanted}
+    return rules
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select=None, index: ProgramIndex | None = None
+                   ) -> AnalysisResult:
+    """Lint one module's source.  ``select`` optionally restricts to an
+    iterable of rule ids.  Without a caller-provided ``index`` the module
+    gets a single-file program index — interprocedural rules still work
+    within the module."""
+    result = AnalysisResult()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        result.errors.append(Finding(
+            rule="TPS-PARSE", message=f"syntax error: {e.msg}",
+            line=e.lineno or 1, col=(e.offset or 1) - 1, path=path))
+        return result
+
+    module = ModuleAnalysis(tree, source, path)
+    if index is None:
+        index = ProgramIndex([module])
+    else:
+        index.add_module(module)
+    result.index = index
+    lint = _lint_module(module, _selected_rules(select))
+    lint.index = index
+    lint.files_linted = 0
+    result.merge(lint)
+    return result
+
+
 def iter_python_files(paths):
     """Expand files/directories into .py files, skipping hidden dirs and
     __pycache__."""
@@ -142,18 +183,60 @@ def iter_python_files(paths):
                     yield os.path.join(root, name)
 
 
-def analyze_paths(paths, select=None) -> AnalysisResult:
-    """Lint every .py file under ``paths`` (files or directories)."""
-    total = AnalysisResult()
+def build_index(paths) -> tuple:
+    """Phase 1: parse every .py file under ``paths`` into a
+    ProgramIndex.  Returns ``(index, read_or_parse_error_findings)`` —
+    unreadable/unparsable files are reported, never silently skipped."""
+    index = ProgramIndex([])
+    errors = []
     for fname in iter_python_files(paths):
         try:
             with open(fname, "r", encoding="utf-8") as fh:
                 source = fh.read()
         except OSError as e:
-            total.errors.append(Finding(
+            errors.append(Finding(
                 rule="TPS-READ", message=f"cannot read: {e}", line=1, col=0,
                 path=fname))
             continue
-        total.merge(analyze_source(source, path=fname, select=select))
+        try:
+            tree = ast.parse(source, filename=fname)
+        except SyntaxError as e:
+            errors.append(Finding(
+                rule="TPS-PARSE", message=f"syntax error: {e.msg}",
+                line=e.lineno or 1, col=(e.offset or 1) - 1, path=fname))
+            continue
+        index.add_module(ModuleAnalysis(tree, source, fname))
+    return index, errors
+
+
+def analyze_paths(paths, select=None, report_files=None,
+                  index: ProgramIndex | None = None) -> AnalysisResult:
+    """Lint every .py file under ``paths`` (files or directories).
+
+    ``report_files`` (an iterable of files/directories) restricts which
+    files' findings are REPORTED; the program index still covers all of
+    ``paths`` so cross-file analysis stays whole-program.  ``index``
+    short-circuits phase 1 with a prebuilt/cached ProgramIndex.
+    """
+    total = AnalysisResult()
+    if index is None:
+        index, errors = build_index(paths)
+        total.errors.extend(errors)
+    total.index = index
+
+    if report_files is None:
+        report = None
+    else:
+        report = {os.path.normpath(f)
+                  for f in iter_python_files(report_files)}
+        total.errors = [e for e in total.errors
+                        if os.path.normpath(e.path) in report]
+
+    rules = _selected_rules(select)
+    for path, entry in sorted(index.modules.items()):
+        if report is not None and path not in report:
+            continue
+        total.merge(_lint_module(entry.analysis, rules))
         total.files_linted += 1
+    total.index = index
     return total

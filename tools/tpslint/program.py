@@ -1,0 +1,519 @@
+"""Project-wide program index: module/symbol table, call graph, dataflow.
+
+Round 9 grows tpslint from a per-file, per-function linter into a
+project-wide analysis.  The whole-program invariants the codebase rests
+on — no host sync reachable from inside a jitted program (TPS008), no
+read of a donated buffer after dispatch (TPS013), grid-spec objects
+consistent wherever they are constructed (TPS010) — cannot be seen one
+function body at a time: the analyzer has to follow calls.
+
+Three layers, all stdlib-``ast`` only (the TPS012 constraint — tpslint
+never imports framework packages, so it lints files that need a TPU
+backend to even import):
+
+* **module/symbol table** — every analyzed file becomes a
+  :class:`ModuleEntry` carrying its :class:`~tools.tpslint.context.
+  ModuleAnalysis`, a dotted-name key derived from its path, an import
+  table (absolute and relative imports resolved against the indexed
+  file set), and a symbol table of top-level functions and class
+  methods as :class:`FunctionRecord` objects;
+
+* **call graph** — :meth:`ProgramIndex.resolve_call` resolves a call
+  site to a :class:`FunctionRecord`: local names through the enclosing
+  scopes, ``self.method()`` through the enclosing class,
+  ``ClassName.method`` / ``module.func`` / from-imported names through
+  the import table, across files.  Unresolvable targets (function-valued
+  parameters, dynamic attributes) stay ``None`` — the analysis is
+  conservative but never guesses;
+
+* **dataflow** — a small intraprocedural lattice: per-function
+  reaching-definitions over locals (:func:`local_bindings`,
+  :meth:`ProgramIndex.resolve_local_value`) and *value provenance*
+  ("this name holds a donated operand / a grid-spec object / a traced
+  array").  TPS008 additionally computes per-parameter *sync
+  summaries* — which parameters of a function flow (transitively,
+  through the call graph) into a host-syncing operation — so a jitted
+  caller passing a traced value into a helper three calls away from the
+  ``float()`` gets the full chain in the finding message.
+
+The index is built ONCE per run (engine phase 1) and handed to every
+rule via ``module.program`` (phase 2); it pickles, so CI can cache it
+keyed on the source-tree hash (``tpslint --index-cache``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .context import (FUNCTION_NODES, ModuleAnalysis, qualifier_chain,
+                      terminal_name)
+
+#: Host-syncing operations TPS008 summarizes (superset of TPS001's sets:
+#: the interprocedural pass also covers ``jax.device_get``, which a
+#: helper legitimately uses on host paths but must never reach traced).
+SYNC_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+SYNC_JAX_CALLS = {"device_get"}
+
+
+def module_parts(path: str) -> tuple:
+    """Dotted-module parts derived from a file path.
+
+    ``mpi_petsc4py_example_tpu/solvers/krylov.py`` ->
+    ``("mpi_petsc4py_example_tpu", "solvers", "krylov")``;
+    ``pkg/__init__.py`` -> ``("pkg",)``.  Path segments that are not
+    identifiers (and everything before them) are dropped, so absolute
+    paths key on their importable suffix.
+    """
+    norm = os.path.normpath(path)
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    raw = [p for p in norm.split(os.sep) if p not in ("", ".")]
+    if raw and raw[-1] == "__init__":
+        raw = raw[:-1]
+    parts: list = []
+    for seg in reversed(raw):
+        if not seg.isidentifier():
+            break
+        parts.append(seg)
+    parts.reverse()
+    return tuple(parts)
+
+
+@dataclass
+class FunctionRecord:
+    """One function def in the symbol table."""
+
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    entry: "ModuleEntry"
+    qualname: str                  # "func" or "Class.method"
+    is_method: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def path(self) -> str:
+        return self.entry.path
+
+    def param_names(self) -> list:
+        a = self.node.args
+        names = [p.arg for p in (a.posonlyargs + a.args)]
+        return names
+
+    def positional_param(self, index: int):
+        """Parameter name receiving positional argument ``index`` at a
+        call site (``self`` already skipped for methods)."""
+        params = self.param_names()
+        if self.is_method and params:
+            params = params[1:]
+        if 0 <= index < len(params):
+            return params[index]
+        return None
+
+    def keyword_param(self, name: str):
+        a = self.node.args
+        allnames = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        return name if name in allnames else None
+
+    def is_traced(self) -> bool:
+        """The def is itself a traced context in its module — TPS001's
+        domain, so the interprocedural pass skips into it."""
+        return self.node in self.entry.analysis._trace_reasons
+
+    def is_host_target(self) -> bool:
+        return self.node in self.entry.analysis._host_marked
+
+
+@dataclass
+class ModuleEntry:
+    """One analyzed file in the program index."""
+
+    path: str
+    parts: tuple                   # dotted-module parts
+    analysis: ModuleAnalysis
+    #: top-level name -> FunctionRecord, plus "Class.method" entries
+    symbols: dict = field(default_factory=dict)
+    #: local import alias -> (module_parts, symbol_or_None)
+    imports: dict = field(default_factory=dict)
+
+    def collect(self):
+        tree = self.analysis.tree
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.symbols[stmt.name] = FunctionRecord(
+                    stmt, self, stmt.name)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        is_static = any(
+                            terminal_name(d) == "staticmethod"
+                            for d in sub.decorator_list)
+                        rec = FunctionRecord(
+                            sub, self, f"{stmt.name}.{sub.name}",
+                            is_method=not is_static)
+                        self.symbols[f"{stmt.name}.{sub.name}"] = rec
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = (tuple(a.name.split(".")) if a.asname
+                              else (a.name.split(".")[0],))
+                    self.imports[alias] = (target, None)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    alias = a.asname or a.name
+                    self.imports[alias] = (base, a.name)
+        return self
+
+    def _import_base(self, node: ast.ImportFrom):
+        """Absolute module parts of an ImportFrom's source module, with
+        relative imports resolved against this module's own parts."""
+        mod = tuple(node.module.split(".")) if node.module else ()
+        if not node.level:
+            return mod
+        # level=1 strips the module segment, each extra level one package
+        if node.level > len(self.parts):
+            return None
+        return self.parts[:len(self.parts) - node.level] + mod
+
+
+class ProgramIndex:
+    """The project-wide analysis: symbol table + call graph + summaries."""
+
+    def __init__(self, modules):
+        #: normalized path -> ModuleEntry
+        self.modules = {}
+        #: dotted parts -> [ModuleEntry] (suffix-matched at resolution)
+        self._by_parts = {}
+        self._sync_summaries = None
+        self._param_taints = {}
+        for m in modules:
+            self.add_module(m)
+
+    @staticmethod
+    def _node_key(rec: "FunctionRecord"):
+        """Stable identity for a function node — id() does not survive
+        pickling (the --index-cache round trip), source coordinates do."""
+        return (rec.entry.path, rec.node.lineno, rec.node.col_offset,
+                getattr(rec.node, "name", "<lambda>"))
+
+    # ------------------------------------------------------------ building
+    def add_module(self, analysis: ModuleAnalysis) -> ModuleEntry:
+        path = os.path.normpath(analysis.path)
+        old = self.modules.get(path)
+        if old is not None:
+            # re-adding a path (analyze_source against a long-lived
+            # index) must EVICT the stale entry: a leftover twin makes
+            # _lookup_module ambiguous (-> None) and silently kills
+            # cross-file resolution, and memoized summaries/taints key
+            # on source coordinates that may now mean different code
+            bucket = self._by_parts.get(old.parts, [])
+            if old in bucket:
+                bucket.remove(old)
+            if not bucket:
+                self._by_parts.pop(old.parts, None)
+            self._sync_summaries = None
+            self._param_taints = {}
+        entry = ModuleEntry(path, module_parts(path), analysis).collect()
+        self.modules[path] = entry
+        self._by_parts.setdefault(entry.parts, []).append(entry)
+        analysis.program = self
+        return entry
+
+    def module_for(self, path: str):
+        return self.modules.get(os.path.normpath(path))
+
+    def _lookup_module(self, parts: tuple):
+        """The unique indexed module whose dotted parts END with
+        ``parts`` (import targets are canonical names; indexed keys may
+        carry extra leading path segments)."""
+        if not parts:
+            return None
+        exact = self._by_parts.get(parts)
+        if exact and len(exact) == 1:
+            return exact[0]
+        candidates = [e for key, entries in self._by_parts.items()
+                      for e in entries
+                      if len(key) >= len(parts)
+                      and key[-len(parts):] == parts]
+        return candidates[0] if len(candidates) == 1 else None
+
+    # --------------------------------------------------------- call graph
+    def resolve_call(self, module: ModuleAnalysis, call: ast.Call):
+        """Best-effort resolution of a call site to a FunctionRecord —
+        local defs, ``self.method``, ``Class.method``, imported names and
+        ``module.func`` across the indexed files.  None when dynamic."""
+        entry = self.module_for(module.path)
+        if entry is None:
+            return None
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = module._resolve_name_to_def(func)
+            if local is not None:
+                return self._record_for(entry, local)
+            imp = entry.imports.get(func.id)
+            if imp is not None:
+                return self._resolve_imported(imp)
+            rec = entry.symbols.get(func.id)
+            if rec is not None:
+                return rec
+            return None
+        if isinstance(func, ast.Attribute):
+            chain = qualifier_chain(func)
+            if not chain:
+                return None
+            if chain == ["self"] or chain == ["cls"]:
+                cls = self._enclosing_class(module, call)
+                if cls is not None:
+                    return entry.symbols.get(f"{cls.name}.{func.attr}")
+                return None
+            if len(chain) == 1 and chain[0] in entry.symbols \
+                    and "." not in chain[0]:
+                # ClassName.method in the same module
+                rec = entry.symbols.get(f"{chain[0]}.{func.attr}")
+                if rec is not None:
+                    return rec
+            # imported module alias: mod.func / pkg.sub.func
+            imp = entry.imports.get(chain[0])
+            if imp is None:
+                return None
+            base, sym = imp
+            if sym is not None:
+                # `from pkg import mod` then mod.func: the imported name
+                # is itself a module
+                base = base + (sym,)
+            target = self._lookup_module(base + tuple(chain[1:]))
+            if target is None and len(chain) > 1:
+                target = self._lookup_module(base)
+            if target is None:
+                return None
+            return target.symbols.get(func.attr)
+        return None
+
+    def _resolve_imported(self, imp):
+        base, sym = imp
+        if sym is None:
+            return None
+        target = self._lookup_module(base)
+        if target is not None:
+            return target.symbols.get(sym)
+        return None
+
+    def _record_for(self, entry: ModuleEntry, fn_node):
+        for rec in entry.symbols.values():
+            if rec.node is fn_node:
+                return rec
+        # nested def: not in the symbol table, record on the fly so
+        # summaries still work for same-module nested helpers
+        return FunctionRecord(fn_node, entry,
+                              getattr(fn_node, "name", "<lambda>"))
+
+    @staticmethod
+    def _enclosing_class(module: ModuleAnalysis, node):
+        cur = module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = module.parents.get(cur)
+        return None
+
+    # ---------------------------------------------- per-parameter taint
+    def param_taint(self, rec: FunctionRecord, param: str) -> frozenset:
+        """Names in ``rec`` tainted by ``param`` alone (reaching-defs
+        fixpoint via ModuleAnalysis._propagate)."""
+        key = self._node_key(rec) + (param,)
+        got = self._param_taints.get(key)
+        if got is None:
+            tainted = {param}
+            rec.entry.analysis._propagate(rec.node, tainted)
+            got = frozenset(tainted)
+            self._param_taints[key] = got
+        return got
+
+    # ------------------------------------------------- TPS008 summaries
+    def sync_summaries(self) -> dict:
+        """node-id -> {param_name -> chain} where ``chain`` is a tuple of
+        ``(qualname, path, line, description)`` hops ending at the host
+        sync.  A parameter appears when a value derived from it reaches a
+        host-syncing operation — directly, or through a resolvable call
+        whose receiving parameter syncs (transitively, to a fixpoint)."""
+        if self._sync_summaries is not None:
+            return self._sync_summaries
+        summaries: dict = {}
+        records = []
+        for entry in self.modules.values():
+            seen = set()
+            for rec in list(entry.symbols.values()):
+                if rec.node in seen:
+                    continue
+                seen.add(rec.node)
+                records.append(rec)
+                direct = self._direct_syncs(rec)
+                if direct:
+                    summaries[self._node_key(rec)] = direct
+        # propagate through the call graph to a fixpoint; first evidence
+        # per parameter wins, so cycles terminate
+        changed = True
+        passes = 0
+        while changed and passes <= len(records) + 1:
+            changed = False
+            passes += 1
+            for rec in records:
+                if self._propagate_calls(rec, summaries):
+                    changed = True
+        self._sync_summaries = summaries
+        return summaries
+
+    def summary_for(self, rec: FunctionRecord) -> dict:
+        return self.sync_summaries().get(self._node_key(rec), {})
+
+    def _direct_syncs(self, rec: FunctionRecord) -> dict:
+        module = rec.entry.analysis
+        out: dict = {}
+        a = rec.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        statics = module._static_argnames(rec.node)
+        for param in params:
+            if param in statics or param in out:
+                continue
+            taint = self.param_taint(rec, param)
+            for node in module.iter_own_nodes(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = self._sync_desc(module, node, taint)
+                if desc is not None:
+                    out[param] = ((rec.qualname, rec.path, node.lineno,
+                                   f"{desc} of a value derived from "
+                                   f"parameter `{param}`"),)
+                    break
+        return out
+
+    @staticmethod
+    def _sync_desc(module: ModuleAnalysis, call: ast.Call, taint):
+        func = call.func
+        if (isinstance(func, ast.Name) and func.id in SYNC_SCALAR_CASTS
+                and call.args
+                and module.expr_tainted(call.args[0], taint)):
+            return f"`{func.id}()`"
+        if (isinstance(func, ast.Attribute) and func.attr in SYNC_METHODS
+                and module.expr_tainted(func.value, taint)):
+            return f"`.{func.attr}()`"
+        if (module.info.is_numpy_attr(func)
+                and any(module.expr_tainted(arg, taint)
+                        for arg in call.args)):
+            return f"`{ast.unparse(func)}()`"
+        if (terminal_name(func) in SYNC_JAX_CALLS
+                and isinstance(func, ast.Attribute)
+                and (chain := qualifier_chain(func))
+                and chain[0] in module.info.jax_aliases
+                and any(module.expr_tainted(arg, taint)
+                        for arg in call.args)):
+            return f"`{ast.unparse(func)}()`"
+        return None
+
+    def _propagate_calls(self, rec: FunctionRecord, summaries) -> bool:
+        """Lift callee summaries into ``rec``: a parameter of ``rec``
+        whose taint flows into a syncing parameter of a resolvable callee
+        syncs too, with the chain extended by one hop."""
+        module = rec.entry.analysis
+        mine = summaries.setdefault(self._node_key(rec), {})
+        a = rec.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        statics = module._static_argnames(rec.node)
+        changed = False
+        for node in module.iter_own_nodes(rec.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(module, node)
+            if callee is None or callee.node is rec.node:
+                continue
+            callee_sum = summaries.get(self._node_key(callee))
+            if not callee_sum:
+                continue
+            for arg_expr, callee_param in iter_argument_map(node, callee):
+                if callee_param not in callee_sum:
+                    continue
+                for param in params:
+                    if param in mine or param in statics:
+                        continue
+                    if module.expr_tainted(arg_expr,
+                                           self.param_taint(rec, param)):
+                        mine[param] = ((rec.qualname, rec.path,
+                                        node.lineno,
+                                        f"calls `{callee.qualname}()`"),
+                                       ) + callee_sum[callee_param]
+                        changed = True
+        return changed
+
+    # ------------------------------------------------ reaching defs/uses
+    def resolve_local_value(self, module: ModuleAnalysis, name: ast.Name):
+        """The defining expression of ``name`` by linear reaching-defs:
+        the LAST assignment to the name above the use, in the enclosing
+        function's own statements or the module body.  None when the
+        name is rebound ambiguously or never assigned."""
+        scope = module.parents.get(name)
+        while scope is not None and not isinstance(
+                scope, FUNCTION_NODES + (ast.Module,)):
+            scope = module.parents.get(scope)
+        if scope is None:
+            return None
+        best = None
+        nodes = (module.iter_own_nodes(scope)
+                 if isinstance(scope, FUNCTION_NODES)
+                 else ast.walk(scope))
+        for node in nodes:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            if node.lineno >= name.lineno:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name.id:
+                    if best is None or node.lineno > best.lineno:
+                        best = node
+        if best is None and isinstance(scope, FUNCTION_NODES):
+            # fall back to a module-level constant
+            mod_scope = module.tree
+            for node in mod_scope.body:
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == name.id:
+                        best = node
+        return best.value if best is not None else None
+
+
+def iter_argument_map(call: ast.Call, callee: FunctionRecord):
+    """Yield ``(arg_expr, callee_param_name)`` pairs for a call site.
+    Starred positionals make the mapping unreliable — positional pairing
+    stops at the first ``*args``; keywords always map by name."""
+    pos = 0
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            break
+        param = callee.positional_param(pos)
+        if param is not None:
+            yield arg, param
+        pos += 1
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        param = callee.keyword_param(kw.arg)
+        if param is not None:
+            yield kw.value, param
+
+
+def build_program_index(analyses) -> ProgramIndex:
+    """Phase-1 entry point: index every parsed module."""
+    return ProgramIndex(list(analyses))

@@ -38,6 +38,18 @@ def _is_string_literal(node: ast.expr) -> bool:
         return True
     if isinstance(node, (ast.Tuple, ast.List)):
         return any(_is_string_literal(e) for e in node.elts)
+    if isinstance(node, ast.JoinedStr):
+        # f-strings hard-code the axis just as surely as a plain literal:
+        # f"rows" and f"rows_{i}" both carry literal text (Constant parts
+        # or a literal inside a FormattedValue); only a PURE interpolation
+        # of a threaded name — f"{comm.axis}" — is dynamic
+        for part in node.values:
+            if (isinstance(part, ast.Constant)
+                    and isinstance(part.value, str) and part.value):
+                return True
+            if (isinstance(part, ast.FormattedValue)
+                    and _is_string_literal(part.value)):
+                return True
     return False
 
 

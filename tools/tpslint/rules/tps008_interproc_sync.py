@@ -1,0 +1,91 @@
+"""TPS008 — interprocedural host-sync reachability (ROADMAP, deferred
+from the initial rule set; landed with the program-index work it
+needed).
+
+TPS001 lints each traced function body locally: ``float(x)`` inside a
+jitted def.  But the repo's real host syncs hide behind calls — a
+module-level helper that does ``np.linalg.norm(v)`` is perfectly fine
+on host paths and a trace-time concretization error (or a silent
+per-iteration device->host sync) the moment a jitted/``shard_map``/
+Pallas region calls it with a traced value.  Per-function AST visitors
+structurally cannot see this; the program index's call graph can.
+
+The check: for every call site inside a traced context, resolve the
+callee through :class:`~tools.tpslint.program.ProgramIndex` (across
+files), look up its *sync summary* — which of its parameters flow,
+transitively through further calls, into a host-syncing operation
+(``float()``/``.item()``/``.block_until_ready()``/``np.*``/
+``jax.device_get``) — and flag the call when a TRACED argument lands on
+a syncing parameter.  The finding message carries the full call chain
+down to the syncing operation, so a three-hop sync reads as a path, not
+a mystery.
+
+Precision notes: summaries are per-parameter (a helper that syncs its
+``rtol`` config scalar does not poison calls that pass it a traced
+``x`` elsewhere), callees that are themselves traced contexts are
+skipped (their bodies are TPS001's domain), and host-callback targets
+(``io_callback`` et al.) are exempt — they run on host by design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..program import iter_argument_map
+from .base import Rule, register
+
+
+@register
+class InterproceduralSyncRule(Rule):
+    id = "TPS008"
+    name = "interprocedural-host-sync"
+    description = ("a host-syncing operation (float()/.item()/"
+                   ".block_until_ready()/np.*/jax.device_get) in any "
+                   "function transitively reachable from a jit/shard_map/"
+                   "pallas_call region, reported with the full call chain")
+
+    def check(self, module):
+        index = module.program
+        if index is None:
+            return
+        for ctx in module.contexts:
+            for node in module.iter_own_nodes(ctx.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = index.resolve_call(module, node)
+                if callee is None:
+                    continue
+                if callee.is_traced() or callee.is_host_target():
+                    # traced callee bodies are TPS001's domain; host
+                    # callback targets run on host by design
+                    continue
+                summary = index.summary_for(callee)
+                if not summary:
+                    continue
+                for arg_expr, param in iter_argument_map(node, callee):
+                    if param not in summary:
+                        continue
+                    if module.expr_tainted(arg_expr, ctx.tainted):
+                        yield self.finding(
+                            node,
+                            self._message(ctx, node, callee, param,
+                                          summary[param]))
+                        break
+
+    def _message(self, ctx, call, callee, param, chain):
+        where = (f"a function nested in a traced context (`{ctx.name}`)"
+                 if ctx.reason == "enclosing"
+                 else f"a `{ctx.reason}` context (`{ctx.name}`)")
+        hops = [f"`{ctx.name}` calls `{callee.qualname}()` "
+                f"({ctx_path(ctx, call)})"]
+        for qual, path, line, desc in chain:
+            hops.append(f"`{qual}` ({path}:{line}) {desc}")
+        return (f"call into `{callee.qualname}` from {where} passes a "
+                f"traced value to parameter `{param}`, which reaches a "
+                f"host sync — call chain: " + " -> ".join(hops) +
+                "; hoist the sync out of the traced region, use the jnp "
+                "equivalent, or pass a static value")
+
+
+def ctx_path(ctx, call) -> str:
+    return f"line {call.lineno}"

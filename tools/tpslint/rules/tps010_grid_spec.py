@@ -1,0 +1,147 @@
+"""TPS010 — grid-spec object coverage (ROADMAP, deferred from the
+initial rule set; landed with the program-index dataflow work).
+
+TPS006 checks ``grid=``/``BlockSpec`` literals AT the ``pallas_call``
+site.  Real kernels (and the matrix-free user kernels ROADMAP item 4
+will bring in) bundle their geometry into ``pl.GridSpec`` /
+``pltpu.PrefetchScalarGridSpec`` objects constructed away from the call
+and threaded through locals and kwargs — invisible to a call-site-only
+check, and a rank mismatch still surfaces only as an opaque Mosaic
+lowering error.
+
+Checks, using the program index's reaching-defs to look through local
+names (``spec = pl.BlockSpec(...)`` then ``in_specs=[spec]``, or a
+module-level ``GRID = (4, 4)`` constant threaded into ``grid=``):
+
+* **index_map arity** — a ``BlockSpec`` index_map inside a
+  ``GridSpec`` must take one index per grid dimension; inside a
+  ``PrefetchScalarGridSpec`` it takes ``num_scalar_prefetch``
+  *additional* leading scalar-ref arguments (the TPU scalar-prefetch
+  calling convention — see the Pallas grid documentation);
+* **block rank** — a tuple-literal index_map body must return one block
+  coordinate per ``block_shape`` dimension;
+* **conflicting geometry** — ``pallas_call(..., grid_spec=..., grid=...)``
+  (or ``in_specs=``/``out_specs=`` alongside ``grid_spec=``): the bundle
+  already carries grid and specs; passing both silently ignores one set
+  or raises far from the mistake.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import terminal_name
+from .base import Rule, register
+
+GRID_SPEC_NAMES = {"GridSpec", "PrefetchScalarGridSpec"}
+
+
+def _grid_rank(node):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return 1
+    return None
+
+
+def _int_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+@register
+class GridSpecRule(Rule):
+    id = "TPS010"
+    name = "grid-spec-coverage"
+    description = ("pl.GridSpec/PrefetchScalarGridSpec objects constructed "
+                   "away from the pallas_call site: index_map arity/rank "
+                   "vs grid (+num_scalar_prefetch) mismatches, and "
+                   "pallas_call given both grid_spec= and grid=/in_specs=")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name in GRID_SPEC_NAMES:
+                yield from self._check_spec(module, node,
+                                            prefetch=(name ==
+                                                      "PrefetchScalarGridSpec"))
+            elif name == "pallas_call":
+                yield from self._check_call_site(node)
+
+    # ---------------------------------------------------- construction
+    def _check_spec(self, module, call: ast.Call, prefetch: bool):
+        grid = None
+        nsp = 0
+        for kw in call.keywords:
+            if kw.arg == "grid":
+                grid = _grid_rank(self._resolve(module, kw.value))
+            elif kw.arg == "num_scalar_prefetch" and prefetch:
+                nsp = _int_const(self._resolve(module, kw.value)) or 0
+        for spec in self._blockspecs(module, call):
+            block_shape = spec.args[0] if spec.args else None
+            index_map = spec.args[1] if len(spec.args) > 1 else None
+            for kw in spec.keywords:
+                if kw.arg == "index_map":
+                    index_map = kw.value
+                elif kw.arg == "block_shape":
+                    block_shape = kw.value
+            block_shape = self._resolve(module, block_shape)
+            if not isinstance(index_map, ast.Lambda):
+                continue
+            arity = len(index_map.args.args)
+            want = None if grid is None else grid + nsp
+            if want is not None and arity != want:
+                extra = (f" + {nsp} scalar-prefetch ref(s)" if nsp else "")
+                yield self.finding(
+                    index_map,
+                    f"BlockSpec index_map takes {arity} argument(s) but "
+                    f"this {'PrefetchScalarGridSpec' if prefetch else 'GridSpec'} "
+                    f"declares a rank-{grid} grid{extra} — index_map "
+                    f"arity must be {want}")
+            if (isinstance(block_shape, (ast.Tuple, ast.List))
+                    and isinstance(index_map.body, ast.Tuple)
+                    and len(index_map.body.elts) != len(block_shape.elts)):
+                yield self.finding(
+                    index_map,
+                    f"BlockSpec index_map returns "
+                    f"{len(index_map.body.elts)} block coordinates for a "
+                    f"rank-{len(block_shape.elts)} block_shape — ranks "
+                    "must match")
+
+    def _blockspecs(self, module, call: ast.Call):
+        """BlockSpec constructions inside in_specs/out_specs — literal
+        or threaded through a local/module name (reaching-defs)."""
+        for kw in call.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            for node in ast.walk(kw.value):
+                resolved = self._resolve(module, node)
+                if (isinstance(resolved, ast.Call)
+                        and terminal_name(resolved.func) == "BlockSpec"):
+                    yield resolved
+
+    def _resolve(self, module, node):
+        """Look through a Name to its defining expression via the
+        program index's linear reaching-defs."""
+        if isinstance(node, ast.Name) and module.program is not None:
+            defined = module.program.resolve_local_value(module, node)
+            if defined is not None:
+                return defined
+        return node
+
+    # ------------------------------------------------------- call site
+    def _check_call_site(self, call: ast.Call):
+        kwargs = {kw.arg for kw in call.keywords if kw.arg}
+        if "grid_spec" not in kwargs:
+            return
+        clash = sorted(kwargs & {"grid", "in_specs", "out_specs"})
+        if clash:
+            yield self.finding(
+                call,
+                f"pallas_call given both grid_spec= and "
+                f"{'/'.join(clash)}= — the grid-spec bundle already "
+                "carries the grid and block specs; passing both silently "
+                "ignores one set or fails far from the mistake")

@@ -1,0 +1,329 @@
+"""TPS013 — donation safety: use-after-donation of solve buffers.
+
+The solve programs DONATE their initial-iterate argument
+(``build_ksp_program(..., donate=True)`` -> ``jax.jit(...,
+donate_argnums=...)``): after dispatch the donated buffer is deleted —
+its storage belongs to the program's output.  A stale reference reads a
+deleted array and fails (or worse, on some runtimes, reads garbage)
+far from the donation site.  PR 6's ``resilience/fallback.py`` bug was
+exactly this: the pristine-guess snapshot ``x0 = x.data`` captured a
+BARE reference; after the first donated stage consumed the buffer,
+every later escalation re-seeded from a deleted array.  Found by hand
+then; this rule finds it structurally.
+
+Tracked provenance (the program index's intraprocedural lattice):
+
+* ``prog = build_ksp_program(..., donate=True)`` (or ``_many``) makes
+  ``prog`` a *donate-armed program*.  Calling it consumes its donated
+  operand — the ``x0=``/``X0=`` keyword, or the LAST bare-name
+  positional argument (the repo's calling convention:
+  ``prog(mat_arrays, pc_arrays, b.data, x0d, rtol, ...)`` — trailing
+  scalars are never bare names).  Any later read of that name is an
+  error until it is rebound.
+* ``ksp.solve(b, x)`` / ``ksp.solve_many(B, X)`` donate ``x.data``
+  internally and rebind it to the program output — ``x`` itself stays
+  valid, but any name previously bound to BARE ``x.data`` (not wrapped
+  in ``jnp.copy``/``jnp.array``) is a deleted array afterwards: reading
+  it is an error.
+* ``SolveServer`` dispatch (``srv.submit(...)``/``srv.solve(...)`` on a
+  name constructed via ``SolveServer(...)``) likewise invalidates bare
+  ``.data`` aliases of its vector arguments — served sessions run the
+  donated paths.
+
+The walk is branch-aware (an ``if`` arm that ``raise``s contributes no
+state downstream — the ``ksp.py`` idiom of dispatching a fault branch
+and raising is clean) and runs loop bodies twice, so a snapshot taken
+before a loop and re-read after the first donated solve inside it — the
+PR-6 shape — is caught.  Traced contexts are skipped: donation is a
+host-boundary concern, and inside the program the operand is live.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES, terminal_name
+from .base import Rule, register
+
+#: builders whose donate=True literal arms the returned program
+_BUILDERS = {"build_ksp_program", "build_ksp_program_many"}
+#: method names that dispatch a donated solve on any receiver
+_SOLVE_METHODS = {"solve", "solve_many"}
+#: copy wrappers that break the alias (a copied snapshot is safe)
+_COPY_CALLS = {"copy", "array", "asarray"}
+
+
+class _Env:
+    """Provenance state at one program point."""
+
+    __slots__ = ("progs", "servers", "aliases", "consumed")
+
+    def __init__(self):
+        self.progs = {}       # name -> builder line
+        self.servers = set()  # names holding a SolveServer
+        self.aliases = {}     # name -> owner expr string ("x" for x.data)
+        self.consumed = {}    # name -> reason string
+
+    def copy(self):
+        env = _Env()
+        env.progs = dict(self.progs)
+        env.servers = set(self.servers)
+        env.aliases = dict(self.aliases)
+        env.consumed = dict(self.consumed)
+        return env
+
+    def absorb(self, other):
+        self.progs.update(other.progs)
+        self.servers |= other.servers
+        self.aliases.update(other.aliases)
+        self.consumed.update(other.consumed)
+
+    def kill(self, name: str):
+        self.progs.pop(name, None)
+        self.servers.discard(name)
+        self.aliases.pop(name, None)
+        self.consumed.pop(name, None)
+
+
+_TERMINATORS = (ast.Raise, ast.Return, ast.Break, ast.Continue)
+
+
+@register
+class DonationSafetyRule(Rule):
+    id = "TPS013"
+    name = "use-after-donation"
+    description = ("reading a binding after it was donated into a "
+                   "donate=-armed solve program (build_ksp_program(..., "
+                   "donate=True) calls, KSP.solve/solve_many donated "
+                   "paths, SolveServer dispatch) without an intervening "
+                   "jnp.copy/rebind")
+
+    def check(self, module):
+        self._reported = set()
+        self._found = []
+        scopes = [module.tree]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if module.context_for(node) is None:
+                    scopes.append(node)
+        for scope in scopes:
+            body = scope.body if isinstance(scope.body, list) else []
+            self._walk_block(module, body, _Env())
+        yield from self._found
+
+    # ------------------------------------------------------------ walker
+    def _walk_block(self, module, stmts, env) -> bool:
+        """Returns True when the block terminates (raise/return/...)."""
+        for stmt in stmts:
+            if isinstance(stmt, _TERMINATORS):
+                for child in ast.iter_child_nodes(stmt):
+                    self._visit_expr(module, child, env)
+                return True
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # separate scope
+            if isinstance(stmt, ast.If):
+                self._visit_expr(module, stmt.test, env)
+                e_body, e_else = env.copy(), env.copy()
+                t_body = self._walk_block(module, stmt.body, e_body)
+                t_else = self._walk_block(module, stmt.orelse, e_else)
+                merged = _Env()
+                if not t_body:
+                    merged.absorb(e_body)
+                if not t_else:
+                    merged.absorb(e_else)
+                if t_body and t_else:
+                    return True
+                env.progs, env.servers = merged.progs, merged.servers
+                env.aliases, env.consumed = merged.aliases, merged.consumed
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._visit_expr(module, stmt.iter, env)
+                self._bind_targets(stmt.target, env, None, module)
+                pre = env.copy()
+                # two passes: state flowing around the back edge (a
+                # donation on iteration 1 poisons a read early in
+                # iteration 2 — the PR-6 fallback.py shape)
+                self._walk_block(module, stmt.body, env)
+                self._walk_block(module, stmt.body, env)
+                env.absorb(pre)
+                self._walk_block(module, stmt.orelse, env)
+                continue
+            if isinstance(stmt, ast.While):
+                self._visit_expr(module, stmt.test, env)
+                pre = env.copy()
+                self._walk_block(module, stmt.body, env)
+                self._walk_block(module, stmt.body, env)
+                env.absorb(pre)
+                self._walk_block(module, stmt.orelse, env)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_block(module, stmt.body, env)
+                for handler in stmt.handlers:
+                    e_h = env.copy()
+                    self._walk_block(module, handler.body, e_h)
+                    env.absorb(e_h)
+                self._walk_block(module, stmt.orelse, env)
+                self._walk_block(module, stmt.finalbody, env)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._visit_expr(module, item.context_expr, env)
+                    if item.optional_vars is not None:
+                        self._bind_targets(item.optional_vars, env, None,
+                                           module)
+                self._walk_block(module, stmt.body, env)
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._visit_expr(module, stmt.value, env)
+                for t in stmt.targets:
+                    self._bind_targets(t, env, stmt.value, module)
+                continue
+            if isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    self._visit_expr(module, stmt.value, env)
+                    self._bind_targets(stmt.target, env, stmt.value, module)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self._visit_expr(module, stmt.value, env)
+                self._visit_expr(module, stmt.target, env)
+                self._bind_targets(stmt.target, env, None, module)
+                continue
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        env.kill(t.id)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                self._visit_expr(module, child, env)
+        return False
+
+    # -------------------------------------------------- expression visit
+    def _visit_expr(self, module, expr, env):
+        """Report reads of consumed names, then apply donation events of
+        any calls inside ``expr`` (reads happen before the dispatch)."""
+        if expr is None or isinstance(expr, ast.expr_context):
+            return
+        for node in self._walk_no_lambda(expr):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in env.consumed):
+                self._report(node, env.consumed[node.id])
+        for node in self._walk_no_lambda(expr):
+            if isinstance(node, ast.Call):
+                self._apply_call(module, node, env)
+
+    @staticmethod
+    def _walk_no_lambda(expr):
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Lambda):
+                    continue        # deferred body
+                stack.append(child)
+
+    def _apply_call(self, module, call: ast.Call, env):
+        func = call.func
+        # --- a donate-armed program call consumes its donated operand
+        if isinstance(func, ast.Name) and func.id in env.progs:
+            donated = None
+            for kw in call.keywords:
+                if kw.arg in ("x0", "X0") and isinstance(kw.value, ast.Name):
+                    donated = kw.value
+            if donated is None:
+                names = [a for a in call.args if isinstance(a, ast.Name)]
+                if names:
+                    donated = names[-1]
+            if donated is not None:
+                env.consumed[donated.id] = (
+                    f"donated into `{func.id}(...)` (a donate=True "
+                    f"program built at line {env.progs[func.id]}) at "
+                    f"line {call.lineno}")
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        recv = terminal_name(func.value)
+        # --- SolveServer dispatch: bare .data aliases of vector args die
+        if recv in env.servers and func.attr in ("submit", "solve"):
+            arg_names = {a.id for a in call.args
+                         if isinstance(a, ast.Name)}
+            self._stale_aliases(env, arg_names, call,
+                                f"`{recv}.{func.attr}(...)` (SolveServer "
+                                "dispatch runs the donated solve paths)")
+            return
+        # --- KSP.solve(b, x) / solve_many(B, X): x.data is donated and
+        #     internally rebound; stale pre-call aliases of it die
+        if func.attr in _SOLVE_METHODS and len(call.args) >= 2 \
+                and isinstance(call.args[1], ast.Name):
+            self._stale_aliases(env, {call.args[1].id}, call,
+                                f"`{ast.unparse(func)}({ast.unparse(call.args[0])}, "
+                                f"{call.args[1].id})` (the donated solve "
+                                f"path consumes `{call.args[1].id}.data`)")
+
+    def _stale_aliases(self, env, owner_names, call, what):
+        for alias, owner in list(env.aliases.items()):
+            if owner in owner_names:
+                env.consumed[alias] = (
+                    f"a bare alias of `{owner}.data`, which was donated "
+                    f"by {what} at line {call.lineno}")
+                del env.aliases[alias]
+
+    # ----------------------------------------------------------- binding
+    def _bind_targets(self, target, env, value, module):
+        if isinstance(target, ast.Name):
+            env.kill(target.id)
+            state = self._provenance(value, env)
+            if state is not None:
+                kind, payload = state
+                if kind == "prog":
+                    env.progs[target.id] = payload
+                elif kind == "server":
+                    env.servers.add(target.id)
+                elif kind == "alias":
+                    env.aliases[target.id] = payload
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_targets(elt, env, None, module)
+        elif isinstance(target, ast.Starred):
+            self._bind_targets(target.value, env, None, module)
+        # Attribute/Subscript targets bind no local name
+
+    @staticmethod
+    def _provenance(value, env):
+        if value is None:
+            return None
+        if isinstance(value, ast.Call):
+            name = terminal_name(value.func)
+            if name in _BUILDERS:
+                donate = next((kw.value for kw in value.keywords
+                               if kw.arg == "donate"), None)
+                if (isinstance(donate, ast.Constant)
+                        and donate.value is True):
+                    return ("prog", value.lineno)
+            if name == "SolveServer":
+                return ("server", None)
+            return None
+        if isinstance(value, ast.Name):
+            if value.id in env.progs:
+                return ("prog", env.progs[value.id])
+            if value.id in env.servers:
+                return ("server", None)
+            return None
+        if (isinstance(value, ast.Attribute) and value.attr == "data"
+                and isinstance(value.ctx, ast.Load)):
+            return ("alias", ast.unparse(value.value))
+        return None
+
+    # --------------------------------------------------------- reporting
+    def _report(self, node, reason):
+        if id(node) in self._reported:
+            return
+        self._reported.add(id(node))
+        self._found.append(self.finding(
+            node,
+            f"read of `{node.id}` after donation — it is {reason}; the "
+            "buffer is deleted once the donated program dispatches. "
+            "Snapshot with `jnp.copy(...)` before the donating call, or "
+            "rebind the name from the program's output"))

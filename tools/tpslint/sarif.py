@@ -1,0 +1,147 @@
+"""SARIF 2.1.0 emitter — tpslint findings as GitHub code-scanning input.
+
+``tpslint --sarif out.sarif ...`` serializes an
+:class:`~tools.tpslint.engine.AnalysisResult` into a Static Analysis
+Results Interchange Format log (OASIS SARIF 2.1.0), the format GitHub's
+``codeql-action/upload-sarif`` turns into inline PR annotations.  Kept
+deliberately minimal — one run, one tool.driver, one result per
+finding — and strictly schema-shaped:
+
+* ``version``/``$schema`` pin 2.1.0;
+* every emitted ``ruleId`` has a matching ``tool.driver.rules`` entry
+  (GitHub requires the reporting descriptor to resolve);
+* levels map severity tiers: error-tier findings, bad suppressions and
+  parse errors -> ``error``; warn-tier (TPS011-style advisories) ->
+  ``warning``; stale suppressions -> ``note`` (informational — they
+  only fail ``--strict``);
+* locations use 1-based lines AND columns (SARIF convention; tpslint
+  columns are 0-based ast offsets) and forward-slash relative URIs.
+
+``tests/test_tpslint.py`` validates the output against the SARIF 2.1.0
+schema's structural requirements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: pseudo-rules the engine emits outside the registered rule set
+_PSEUDO_RULES = {
+    "TPS000": ("bad-suppression",
+               "a `# tpslint: disable=` comment without the required "
+               "justification"),
+    "TPS-STALE": ("stale-suppression",
+                  "a justified suppression that no longer fires "
+                  "(fails --strict)"),
+    "TPS-PARSE": ("parse-error", "the file does not parse"),
+    "TPS-READ": ("read-error", "the file cannot be read"),
+}
+
+
+def _uri(path: str, base_dir: str | None) -> str:
+    if base_dir:
+        try:
+            rel = os.path.relpath(path, base_dir)
+            if not rel.startswith(".."):
+                path = rel
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def _result(finding, level: str, base_dir) -> dict:
+    return {
+        "ruleId": finding.rule,
+        "level": level,
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": _uri(finding.path, base_dir)},
+                "region": {
+                    "startLine": max(1, finding.line),
+                    "startColumn": finding.col + 1,
+                },
+            },
+        }],
+    }
+
+
+def to_sarif(result, rules, base_dir: str | None = None) -> dict:
+    """Serialize an AnalysisResult (plus the rule registry metadata) to a
+    SARIF 2.1.0 log dict."""
+    results = []
+    for f in result.errors:
+        results.append(_result(f, "error", base_dir))
+    for f in result.findings:
+        results.append(_result(f, "error", base_dir))
+    for f in result.bad_suppressions:
+        results.append(_result(f, "error", base_dir))
+    for f in result.warnings:
+        results.append(_result(f, "warning", base_dir))
+    for s in result.unused_suppressions:
+        results.append({
+            "ruleId": "TPS-STALE",
+            "level": "note",
+            "message": {"text": (f"unused suppression of "
+                                 f"{', '.join(s.rules)} (nothing fires on "
+                                 "the guarded line)")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(s.path, base_dir)},
+                    "region": {"startLine": max(1, s.line),
+                               "startColumn": 1},
+                },
+            }],
+        })
+
+    driver_rules = []
+    for rid, rule in sorted(rules.items()):
+        driver_rules.append({
+            "id": rid,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description},
+            "defaultConfiguration": {
+                "level": "warning" if rule.severity == "warn" else "error"},
+        })
+    emitted = {r["ruleId"] for r in results}
+    for rid, (name, desc) in _PSEUDO_RULES.items():
+        if rid in emitted:
+            driver_rules.append({
+                "id": rid,
+                "name": name,
+                "shortDescription": {"text": desc},
+                "defaultConfiguration": {
+                    "level": "note" if rid == "TPS-STALE" else "error"},
+            })
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tpslint",
+                    "informationUri":
+                        "https://github.com/tpu-sparse-solve",
+                    "rules": driver_rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, result, rules, base_dir: str | None = None):
+    """Write the SARIF log atomically (CI uploads must never see a
+    truncated file)."""
+    doc = to_sarif(result, rules, base_dir=base_dir)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
