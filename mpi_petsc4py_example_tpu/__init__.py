@@ -62,6 +62,7 @@ from .core.shell import ShellMat
 from .core.nullspace import NullSpace
 from .solvers.pc import PC
 from .solvers.ksp import KSP
+from .solvers.refine import RefinedKSP
 from .utils.convergence import (BatchedSolveResult, ConvergedReason,
                                 RecoveryEvent, SolveResult)
 from .utils.errors import (DeadlineExceededError, DeviceExecutionError,
@@ -78,7 +79,8 @@ __all__ = [
     "init_multihost",
     "RowLayout", "row_partition", "ownership_range", "slice_csr_block",
     "partition_csr", "concat_csr_blocks",
-    "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "EPS", "ST", "SVD",
+    "Vec", "Mat", "ShellMat", "NullSpace", "PC", "KSP", "RefinedKSP",
+    "EPS", "ST", "SVD",
     "ConvergedReason", "RecoveryEvent", "SolveResult",
     "BatchedSolveResult",
     "DeviceExecutionError", "SilentCorruptionError",

@@ -19,8 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.spmv import (csr_diag, csr_find_diagonals, csr_to_dia,
-                        csr_to_ell, dia_spmv_local, dia_spmv_local_many,
+from ..ops.spmv import (accum_dtype as _accum, csr_diag,
+                        csr_find_diagonals, csr_to_dia, csr_to_ell,
+                        dia_spmv_local, dia_spmv_local_many,
                         ell_spmv_local, ell_spmv_local_many)
 from ..parallel.mesh import DeviceComm, as_comm
 from ..parallel.partition import RowLayout, concat_csr_blocks
@@ -103,7 +104,11 @@ class Mat:
                        -3: "indptr[-1] != nnz", -4: "column index out of range"}
             raise ValueError(f"malformed CSR: {reasons.get(err, err)}")
         t1 = _time.perf_counter()
-        if native.available() and len(data) > 1_000_000:
+        # the native C++ conversion handles the machine float families
+        # only; ml_dtypes storage (bfloat16, numpy kind 'V') takes the
+        # vectorized-numpy path, which is dtype-agnostic
+        if (native.available() and len(data) > 1_000_000
+                and data.dtype.kind in "fc"):
             cols, vals = native.csr_to_ell_native(indptr, indices, data)
             vals = vals.astype(dtype, copy=False)
         else:
@@ -149,6 +154,33 @@ class Mat:
         """Build from per-rank local CSR blocks (the reference's L5 output)."""
         indptr, indices, data = concat_csr_blocks(blocks)
         return cls.from_csr(comm, size, (indptr, indices, data), dtype=dtype)
+
+    def astype(self, dtype) -> "Mat":
+        """An assembled Mat holding the same values in another storage
+        dtype — the precision-plan constructor (``RefinedKSP`` builds its
+        bf16/f32 inner operator through this; PARITY.md "Mixed
+        precision"). Conversion runs from the retained host CSR when
+        available (one rounding step from the assembly-precision values,
+        not two), falling back to the fetched device layout. The null
+        space, if any, rides along.
+
+        NOTE: unlike ``ndarray.astype``, a matching dtype returns
+        ``self`` (no copy) — the device operands are immutable on the
+        hot paths and a same-dtype rebuild would only churn HBM; use
+        :meth:`duplicate` when an independent same-dtype Mat is
+        needed."""
+        dtype = np.dtype(dtype)
+        if dtype == np.dtype(self.dtype):
+            return self
+        if self.host_csr is not None:
+            m = Mat.from_csr(self.comm, self.shape, self.host_csr,
+                             dtype=dtype)
+        else:
+            m = Mat.from_scipy(self.comm, self.to_scipy(), dtype=dtype)
+        ns = self.get_nullspace()
+        if ns is not None:
+            m.set_nullspace(ns)
+        return m
 
     @classmethod
     def from_scipy(cls, comm, A, dtype=jnp.float64) -> "Mat":
@@ -429,15 +461,19 @@ class Mat:
 
                 def spmv(op_local, x_local):
                     (dia,) = op_local
+                    acc = _accum(dia.dtype)
+                    # the halo ppermutes move STORAGE-dtype rows — the
+                    # halved-byte budget the low-precision layouts buy
                     left = lax.ppermute(x_local[-halo:], axis, fwd)
                     right = lax.ppermute(x_local[:halo], axis, bwd)
                     ext = jnp.concatenate([left, x_local, right])
-                    y = jnp.zeros(lsize, dia.dtype)
+                    y = jnp.zeros(lsize, acc or dia.dtype)
                     for d, off in enumerate(offsets):
                         seg = lax.slice_in_dim(ext, halo + int(off),
                                                halo + int(off) + lsize)
-                        y = y + dia[:, d] * seg
-                    return y
+                        coeff = dia[:, d].astype(acc) if acc else dia[:, d]
+                        y = y + coeff * seg
+                    return y.astype(dia.dtype)
 
                 return spmv
 
@@ -482,15 +518,19 @@ class Mat:
 
                 def spmv(op_local, x_local):
                     (dia,) = op_local
+                    acc = _accum(dia.dtype)
                     left = lax.ppermute(x_local[-halo:], axis, fwd)
                     right = lax.ppermute(x_local[:halo], axis, bwd)
                     ext = jnp.concatenate([left, x_local, right])
-                    y = jnp.zeros((lsize, x_local.shape[1]), dia.dtype)
+                    y = jnp.zeros((lsize, x_local.shape[1]),
+                                  acc or dia.dtype)
                     for d, off in enumerate(offsets):
                         seg = lax.slice_in_dim(ext, halo + int(off),
                                                halo + int(off) + lsize)
-                        y = y + dia[:, d:d + 1] * seg
-                    return y
+                        coeff = (dia[:, d:d + 1].astype(acc) if acc
+                                 else dia[:, d:d + 1])
+                        y = y + coeff * seg
+                    return y.astype(dia.dtype)
 
                 return spmv
 

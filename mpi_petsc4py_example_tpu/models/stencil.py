@@ -117,13 +117,26 @@ class StencilPoisson3D:
     def _stencil7_jnp(u, halo_lo, halo_hi):
         """The pure-jnp 7-point apply on a 3D slab with given z-halo planes
         (x/y boundaries get zero neighbours from the pads) — the single
-        stencil-body definition every non-Pallas path uses."""
+        stencil-body definition every non-Pallas path uses.
+
+        Sub-f32 storage (bf16) accumulates the 7-term sum in fp32 and
+        casts the result back: the halo exchange and the HBM traffic move
+        storage-dtype planes (the halved-byte win), only the VPU
+        arithmetic widens."""
+        from ..ops.spmv import accum_dtype
+        acc = accum_dtype(u.dtype)
+        store = u.dtype
+        if acc is not None:
+            u = u.astype(acc)
+            halo_lo = halo_lo.astype(acc)
+            halo_hi = halo_hi.astype(acc)
         ext = jnp.concatenate([halo_lo[None], u, halo_hi[None]], axis=0)
         ym = jnp.pad(u[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
         yp = jnp.pad(u[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
         xm = jnp.pad(u[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
         xp = jnp.pad(u[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
-        return 6.0 * u - ext[:-2] - ext[2:] - ym - yp - xm - xp
+        y = 6.0 * u - ext[:-2] - ext[2:] - ym - yp - xm - xp
+        return y.astype(store) if acc is not None else y
 
     def local_spmv(self, comm: DeviceComm):
         nx, ny, lz = self.nx, self.ny, self.lz
@@ -194,8 +207,14 @@ class StencilPoisson3D:
                 y, part = stencil3d_dot_many_pallas(
                     u, halo_lo[:, None], halo_hi[:, None], lz, ny, nx, nrhs)
             else:
+                from ..ops.spmv import accum_dtype
                 y = jax.vmap(self._stencil7_jnp)(u, halo_lo, halo_hi)
-                part = jnp.sum(u * y, axis=(1, 2, 3))
+                acc = accum_dtype(u.dtype)
+                if acc is not None:   # the <p, Ap> partial rides the
+                    part = jnp.sum(u.astype(acc) * y.astype(acc),
+                                   axis=(1, 2, 3))   # REDUCE channel
+                else:
+                    part = jnp.sum(u * y, axis=(1, 2, 3))
             return y, lax.psum(part, axis)
 
         return matvec_dot
@@ -258,8 +277,13 @@ class StencilPoisson3D:
                 y, part = stencil3d_dot_pallas(u, halo_lo[None],
                                                halo_hi[None], lz, ny, nx)
             else:
+                from ..ops.spmv import accum_dtype
                 y = self._stencil7_jnp(u, halo_lo, halo_hi)
-                part = jnp.sum(u * y)
+                acc = accum_dtype(u.dtype)
+                if acc is not None:
+                    part = jnp.sum(u.astype(acc) * y.astype(acc))
+                else:
+                    part = jnp.sum(u * y)
             return y, lax.psum(part, axis)
 
         return matvec_dot

@@ -43,6 +43,30 @@ def _pipeline_depth() -> int:
     return min(max(depth, 2), 4)
 
 
+def _compute_dtype(dtype):
+    """VPU arithmetic dtype for a storage dtype: sub-f32 storage (bf16)
+    computes in f32 — loads upconvert for free, only the DMA'd bytes stay
+    half-width (the whole point of the bf16-storage pipeline) — while
+    f32 keeps today's path bit for bit."""
+    dt = jnp.dtype(dtype)
+    return jnp.dtype(jnp.float32) if dt.itemsize < 4 else dt
+
+
+def resident_zdepth(ny: int, nx: int, dtype, streams: int = 2,
+                    nbuf: int | None = None, ncols: int = 1) -> int:
+    """The deepest z-chunk the VMEM plan keeps resident for one
+    ``(ny, nx)`` plane geometry at a given STORAGE dtype — the
+    resident-size probe of the mixed-precision bench (cfg11): bf16
+    storage halves the plane bytes, so the planned depth (and with it
+    the largest grid that stays VMEM-resident) exactly doubles vs f32.
+    Mirrors :func:`_pick_chunk`'s budget arithmetic without the
+    divides-lz snapping."""
+    nbuf = nbuf or _pipeline_depth()
+    plane = ny * nx * jnp.dtype(dtype).itemsize * ncols
+    vmem_budget = _vmem_plan(_tpu_device_kind())[1]
+    return max(1, int((vmem_budget // plane - 2 * nbuf) // (streams * nbuf)))
+
+
 def _shift_x(u, step):
     """u shifted along the last (x) axis with zero fill."""
     if step == -1:
@@ -84,7 +108,8 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
     """
     def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out, fsc=None,
                 sem_f=None):
-        six = jnp.asarray(6.0, out_ref.dtype)
+        cdt = _compute_dtype(out_ref.dtype)
+        six = jnp.asarray(6.0, cdt)
         one = jnp.int32(1)
 
         # an interior chunk exists only at nchunks >= 3 — the wide-copy
@@ -184,7 +209,7 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                          lax_rem(c + jnp.int32(nbuf - 1)))
 
             wait_in(c, slot)
-            buf = sc[slot]
+            buf = sc[slot].astype(cdt)   # bf16 storage upconverts here
             u = buf[1:-1]          # (chunk, ny, nx) center planes
             zm = buf[:-2]
             zp = buf[2:]
@@ -199,7 +224,7 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                 pltpu.make_async_copy(
                     osc.at[slot], out_ref.at[pl.ds(0, chunk)],
                     sem_out.at[slot]).wait()
-            osc[slot] = out
+            osc[slot] = out.astype(out_ref.dtype)
             pltpu.make_async_copy(
                 osc.at[slot],
                 out_ref.at[pl.ds(c * jnp.int32(chunk), chunk)],
@@ -215,7 +240,7 @@ def _stencil_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             return jax.lax.rem(c, jnp.int32(nbuf))
 
         carry0 = (jnp.int32(0) if dot_ref is None
-                  else jnp.asarray(0.0, out_ref.dtype))
+                  else jnp.asarray(0.0, cdt))
         acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
                                 carry0)
         if dot_ref is not None:
@@ -390,7 +415,9 @@ def stencil3d_dot_pallas(u, halo_lo, halo_hi, lz: int, ny: int, nx: int,
     y, dot = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((lz, ny, nx), u.dtype),
-                   jax.ShapeDtypeStruct((1,), u.dtype)),
+                   # the fused <u, Au> partial is the REDUCE channel:
+                   # f32 accumulation under bf16 storage
+                   jax.ShapeDtypeStruct((1,), _compute_dtype(u.dtype))),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
@@ -414,7 +441,8 @@ def _stencil_many_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
     The chunk plan must be built with ``_pick_chunk(..., ncols=nrhs)``.
     """
     def process(sc, osc, sem_c, sem_lo, sem_hi, sem_out):
-        six = jnp.asarray(6.0, out_ref.dtype)
+        cdt = _compute_dtype(out_ref.dtype)
+        six = jnp.asarray(6.0, cdt)
         one = jnp.int32(1)
         has_interior = nchunks >= 3
 
@@ -504,7 +532,7 @@ def _stencil_many_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             wait_in(c, slot)
             parts = []
             for j in range(nrhs):
-                buf = sc[slot, j]
+                buf = sc[slot, j].astype(cdt)
                 u = buf[1:-1]
                 y = (six * u - buf[:-2] - buf[2:]
                      - _shift_y(u, -1) - _shift_y(u, +1)
@@ -515,7 +543,7 @@ def _stencil_many_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
                     pltpu.make_async_copy(
                         osc.at[slot, j], out_ref.at[j, pl.ds(0, chunk)],
                         sem_out.at[slot, j]).wait()
-                osc[slot, j] = y
+                osc[slot, j] = y.astype(out_ref.dtype)
                 pltpu.make_async_copy(
                     osc.at[slot, j],
                     out_ref.at[j, pl.ds(c * jnp.int32(chunk), chunk)],
@@ -527,7 +555,7 @@ def _stencil_many_kernel(u_ref, lo_ref, hi_ref, out_ref, chunk, nchunks,
             return carry + jnp.stack(parts)
 
         carry0 = (jnp.int32(0) if dot_ref is None
-                  else jnp.zeros((nrhs,), out_ref.dtype))
+                  else jnp.zeros((nrhs,), cdt))
         acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(nchunks), body,
                                 carry0)
         if dot_ref is not None:
@@ -610,7 +638,7 @@ def stencil3d_dot_many_pallas(u, halo_lo, halo_hi, lz: int, ny: int,
     y, dot = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((nrhs, lz, ny, nx), u.dtype),
-                   jax.ShapeDtypeStruct((nrhs,), u.dtype)),
+                   jax.ShapeDtypeStruct((nrhs,), _compute_dtype(u.dtype))),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
@@ -700,9 +728,16 @@ def pallas_supported(ny: int, nx: int, dtype, platform: str | None = None
     omitted, falls back to the process default backend."""
     if (platform or jax.default_backend()) != "tpu":
         return False
-    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),):
-        return False
-    return nx % 128 == 0 and ny % 8 == 0
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.float32):
+        return nx % 128 == 0 and ny % 8 == 0
+    if dt == jnp.dtype(jnp.bfloat16):
+        # bf16 VMEM tiles are (16, 128): the packed native tile — the
+        # bf16-STORAGE pipeline (same DMA geometry, half the bytes per
+        # plane, so _pick_chunk's resident z-depth doubles; arithmetic
+        # upconverts to f32 in VREGs, see _compute_dtype)
+        return nx % 128 == 0 and ny % 16 == 0
+    return False
 
 
 def _pick_chunk_zrestrict(lz: int, itemsize: int, ny: int, nx: int,

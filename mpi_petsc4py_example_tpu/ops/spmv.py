@@ -19,6 +19,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.dtypes import is_low_precision
+
+
+def accum_dtype(dtype):
+    """Accumulation dtype of a sub-f32-storage SpMV, or None when the
+    storage dtype accumulates natively (fp32/fp64/complex).
+
+    bf16 operand storage halves the gathered/ppermuted bytes — the whole
+    point of the low-precision layouts — but a row-sum ACCUMULATED in
+    bf16 (8 mantissa bits) would throw the win away numerically; the
+    kernels below contract into fp32 and cast the result back to the
+    storage dtype, which is exactly the MXU's native bf16-in/f32-acc
+    regime on TPU."""
+    return jnp.float32 if is_low_precision(dtype) else None
+
+
+def widened_einsum(spec, a, b):
+    """``jnp.einsum(spec, a, b)`` with the accumulation discipline of
+    :func:`accum_dtype` applied once: sub-f32 operand storage contracts
+    with ``preferred_element_type=f32`` and the result returns to the
+    first operand's storage dtype; everything else is the plain einsum.
+    The ONE definition the SpMV kernels and the PC factor applies
+    (solvers/pc.py bjacobi/lu, single- and multi-RHS) all share — a
+    future accumulation-policy change edits exactly one site."""
+    acc = accum_dtype(a.dtype)
+    if acc is None:
+        return jnp.einsum(spec, a, b)
+    return jnp.einsum(spec, a, b, preferred_element_type=acc).astype(a.dtype)
+
 
 def csr_to_ell(indptr, indices, data, ncols_pad_to: int | None = None):
     """Convert host CSR to ELL ``(cols, vals)`` of shape ``(nrows, K)``.
@@ -51,9 +80,10 @@ def ell_spmv_local(cols, vals, x_full):
 
     ``cols``/``vals`` are this shard's rows ``(lrows, K)``; ``x_full`` is the
     full (gathered) input vector. Pure jnp — jit/shard_map friendly, fused by
-    XLA into a single gather+fma pass.
+    XLA into a single gather+fma pass. Sub-f32 storage contracts in fp32
+    (:func:`accum_dtype`) and returns the storage dtype.
     """
-    return jnp.einsum("rk,rk->r", vals, x_full[cols])
+    return widened_einsum("rk,rk->r", vals, x_full[cols])
 
 
 def ell_spmv_local_many(cols, vals, x_full_many):
@@ -66,7 +96,7 @@ def ell_spmv_local_many(cols, vals, x_full_many):
     Krylov pays one collective per SpMV phase regardless of k).
     """
     # X[cols] is (lrows, K, nrhs); contract the ELL slot axis against vals
-    return jnp.einsum("rk,rkj->rj", vals, x_full_many[cols])
+    return widened_einsum("rk,rkj->rj", vals, x_full_many[cols])
 
 
 def dia_spmv_local_many(dia, offsets, x_full_many, row_offset, halo):
@@ -76,13 +106,15 @@ def dia_spmv_local_many(dia, offsets, x_full_many, row_offset, halo):
     (no gather at all); every slice simply carries the trailing RHS axis.
     """
     lrows = dia.shape[0]
+    acc = accum_dtype(dia.dtype)
     xp = jnp.pad(x_full_many, ((halo, halo), (0, 0)))
-    y = jnp.zeros((lrows, x_full_many.shape[1]), dia.dtype)
+    y = jnp.zeros((lrows, x_full_many.shape[1]), acc or dia.dtype)
     for d, off in enumerate(offsets):
         seg = jax.lax.dynamic_slice_in_dim(
             xp, row_offset + int(off) + halo, lrows)
-        y = y + dia[:, d:d + 1] * seg
-    return y
+        coeff = dia[:, d:d + 1].astype(acc) if acc else dia[:, d:d + 1]
+        y = y + coeff * seg
+    return y.astype(dia.dtype)
 
 
 def ell_diag_local(cols, vals, row_offset, lrows):
@@ -141,13 +173,15 @@ def dia_spmv_local(dia, offsets, x_full, row_offset, halo):
     contiguous slices — no gather.
     """
     lrows = dia.shape[0]
+    acc = accum_dtype(dia.dtype)
     xp = jnp.pad(x_full, (halo, halo))
-    y = jnp.zeros(lrows, dia.dtype)
+    y = jnp.zeros(lrows, acc or dia.dtype)
     for d, off in enumerate(offsets):
         seg = jax.lax.dynamic_slice_in_dim(
             xp, row_offset + int(off) + halo, lrows)
-        y = y + dia[:, d] * seg
-    return y
+        coeff = dia[:, d].astype(acc) if acc else dia[:, d]
+        y = y + coeff * seg
+    return y.astype(dia.dtype)
 
 
 def csr_diag(indptr, indices, data, n):

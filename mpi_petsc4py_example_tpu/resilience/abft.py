@@ -108,19 +108,31 @@ def _compute_checksum(operator) -> np.ndarray:
     own = getattr(operator, "column_checksum_host", None)
     if own is not None:                    # operator-provided (stencil)
         return np.asarray(own())
+    from ..utils.dtypes import host_dtype, is_low_precision
+
+    def _acc_dt(values):
+        # low-precision storage (bf16) must not ACCUMULATE the checksum
+        # in itself — the setup-time sum runs in host fp64 (the caller
+        # casts the placed vector back to the storage dtype; the bf16
+        # rounding of the finished sum is covered by the storage-eps
+        # threshold, the bf16 rounding of every PARTIAL sum would not be)
+        dt = np.asarray(values).dtype
+        return host_dtype(dt) if is_low_precision(dt) else dt
+
     n = operator.shape[1]
     host_csr = getattr(operator, "host_csr", None)
     if host_csr is not None:
         indptr, indices, data = host_csr
-        c = np.zeros(n, dtype=np.asarray(data).dtype)
-        np.add.at(c, np.asarray(indices), np.asarray(data))
+        c = np.zeros(n, dtype=_acc_dt(data))
+        np.add.at(c, np.asarray(indices),
+                  np.asarray(data).astype(c.dtype, copy=False))
         return c
     # device-only ELL shards: fetch once (setup-time, host-side)
     cols = operator.comm.host_fetch(operator.ell_cols)[: operator.shape[0]]
     vals = operator.comm.host_fetch(operator.ell_vals)[: operator.shape[0]]
-    c = np.zeros(n, dtype=vals.dtype)
+    c = np.zeros(n, dtype=_acc_dt(vals))
     # padding slots are (col 0, val 0.0) — they contribute exactly zero
-    np.add.at(c, cols.ravel(), vals.ravel())
+    np.add.at(c, cols.ravel(), vals.ravel().astype(c.dtype, copy=False))
     return c
 
 
@@ -146,5 +158,14 @@ def pc_checksum(pc, mat) -> np.ndarray | None:
 
 def checksum_tolerance_dtype(dtype) -> float:
     """Machine epsilon of the REAL scalar of ``dtype`` — the unit the
-    ``-ksp_abft_tol`` multiplier scales."""
-    return float(np.finfo(np.dtype(dtype).type(0).real.dtype).eps)
+    ``-ksp_abft_tol`` multiplier scales.
+
+    Under a mixed-precision plan the guarded kernels pass the STORAGE
+    dtype here even though the checksum partials accumulate in the f32
+    reduce channel: the benign error of a low-precision apply is set by
+    the storage rounding (bf16: eps ~7.8e-3), and a threshold scaled to
+    the accumulation epsilon would flag every healthy bf16 apply.
+    ``utils.dtypes.real_eps`` handles the ml_dtypes family np.finfo
+    rejects."""
+    from ..utils.dtypes import real_eps
+    return real_eps(dtype)

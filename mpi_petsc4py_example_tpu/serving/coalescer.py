@@ -49,6 +49,14 @@ class SolveRequest:
     atol: float
     max_it: int
     future: Any
+    # the PRECISION PLAN of the session the request targets (the storage
+    # dtype string, e.g. "float32"/"bfloat16") — part of the
+    # compatibility key: a block is ONE compiled program launch, and the
+    # precision plan is compiled into it, so requests against operators
+    # registered at different precisions must never share a block even
+    # if a future server aliases several precision variants of one
+    # operand set under related names.
+    precision: str = ""
     t_submit: float = field(default_factory=time.monotonic)
     # absolute time.monotonic() the request must have DISPATCHED by, or
     # None for no deadline (serving/server.py resolves expired requests
@@ -59,9 +67,10 @@ class SolveRequest:
 
     @property
     def key(self) -> tuple:
-        """Compatibility key: requests batch together iff keys match."""
-        return (self.op, float(self.rtol), float(self.atol),
-                int(self.max_it))
+        """Compatibility key: requests batch together iff keys match
+        (same operator, same tolerances, same precision plan)."""
+        return (self.op, str(self.precision), float(self.rtol),
+                float(self.atol), int(self.max_it))
 
     def expired(self, now: float) -> bool:
         """Whether the request's dispatch deadline has passed."""

@@ -333,6 +333,9 @@ class SolveServer:
             rtol=sess.rtol if rtol is None else float(rtol),
             atol=sess.atol if atol is None else float(atol),
             max_it=sess.max_it if max_it is None else int(max_it),
+            # the session's storage dtype IS its precision plan — part
+            # of the compatibility key (serving/coalescer.py)
+            precision=str(sess.dtype),
             future=fut)
         if budget > 0:
             req.t_deadline = req.t_submit + budget

@@ -32,8 +32,70 @@ reduction plan rather than a sixth kernel family.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# precision plans: the storage-vs-reduce dtype axis (PR 10)
+# ---------------------------------------------------------------------------
+
+
+class PrecisionPlan:
+    """The precision axis of a compute plan: ``storage`` is the
+    operator/PC/iterate channel's dtype (what the all-gathers, halo
+    ppermutes, and AXPY traffic move — halving it halves the bytes per
+    iterate), ``reduce`` the dot-product/norm/ABFT accumulation channel's
+    dtype (kept wider, the pipelined-Krylov reduction-channel discipline).
+
+    With ``storage == reduce`` (fp32/fp64/complex operators) every hook
+    is the identity and the assembled loop bodies are the pre-plan ones
+    bit for bit — the collective-volume and reduce-site gates see
+    identical programs. The MIXED case (bf16 storage, fp32 reduce) casts
+    each vector update back to storage (``store``) and lifts reduction
+    operands up (``up``); scalars (alpha/beta/rz/norms) live in the
+    reduce dtype throughout the carry.
+    """
+
+    def __init__(self, storage, reduce=None):
+        from ..utils import dtypes as _dtypes
+        self.storage = np.dtype(storage)
+        self.reduce = np.dtype(reduce if reduce is not None
+                               else _dtypes.reduce_dtype(self.storage))
+        self.mixed = self.reduce != self.storage
+
+    def store(self, v):
+        """Cast a vector update back to the storage channel (identity
+        for uniform-precision plans — no-op in the lowered HLO)."""
+        return v.astype(self.storage) if self.mixed else v
+
+    def up(self, v):
+        """Lift a reduction operand into the accumulation channel."""
+        return v.astype(self.reduce) if self.mixed else v
+
+    def key(self):
+        """The (storage, reduce) fingerprint compiled-program caches and
+        serving compatibility keys carry."""
+        return (str(self.storage), str(self.reduce))
+
+    def __repr__(self):
+        return f"PrecisionPlan(storage={self.storage}, reduce={self.reduce})"
+
+
+def precision_plan(storage, reduce=None) -> PrecisionPlan:
+    """Build the precision plan for an operator's storage dtype (the
+    reduce dtype defaults to utils.dtypes.reduce_dtype: fp32 for
+    sub-32-bit storage, the storage dtype itself otherwise)."""
+    return PrecisionPlan(storage, reduce)
+
+
+def _stc(prec):
+    """The store-channel cast of a plan (identity without one)."""
+    if prec is not None and prec.mixed:
+        return prec.store
+    return lambda v: v
 
 # ---------------------------------------------------------------------------
 # shared numeric helpers (moved here from krylov.py so both modules — and
@@ -202,7 +264,8 @@ def fuse_psum(parts, psum, axis, dtype):
 def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
                     A=None, M=None, Adot=None, inv_diag=None, M3=None,
                     pdot=None, pnorm=None, pduo=None, guard=None,
-                    bp=None, monitor=None, unroll=1, natural=False):
+                    bp=None, monitor=None, unroll=1, natural=False,
+                    prec=None):
     """Assemble and run the classic (two-phase) CG recurrence.
 
     Plan axes (module docstring): the operator plan is ``A`` or the fused
@@ -219,9 +282,17 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
     Returns the retired kernels' exact output tuples:
     ``(x, it, rnorm, reason, hist)`` and, guarded,
     ``(..., det, rrc, xv)``.
+
+    ``prec`` is the :class:`PrecisionPlan`: with a mixed plan the vector
+    carries (x/r/p/z) stay in the storage dtype — every update that
+    mixes in a reduce-dtype scalar is cast back through ``prec.store`` —
+    while the reduction closures (supplied by the program builder) lift
+    their operands into the reduce dtype, so alpha/beta/rz/norms travel
+    wide. Uniform plans leave the body untouched.
     """
     bp = bp or SingleBatch()
     g = guard
+    st_ = _stc(prec)
     stencil = Adot is not None
     carry_z = not stencil
 
@@ -231,7 +302,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
             r = b - Adot(x0)[0]
             bnorm, rnorm, badA0 = g.init(b, r, x0)
             rz = rnorm * rnorm * inv_diag
-            p = r * inv_diag
+            p = st_(r * inv_diag)
             badM0 = _false_like(rnorm)
         else:
             bnorm = pnorm(b)
@@ -240,7 +311,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
             rnorm = jnp.sqrt(rr0)
             if M3 is None:
                 rz = rr0 * inv_diag
-                p = r * inv_diag
+                p = st_(r * inv_diag)
             else:
                 z0 = M3(r)
                 rz = pdot(r, z0)
@@ -323,8 +394,8 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
         # by a zero gate: once a diverging active step has produced
         # inf/NaN, 0 * inf = NaN would destroy the preserved iterate
         al = bp.ex(alpha)
-        x = jnp.where(cm, x + al * p, x)
-        r = jnp.where(cm, r - al * Ap, r)
+        x = jnp.where(cm, st_(x + al * p), x)
+        r = jnp.where(cm, st_(r - al * Ap), r)
 
         # ---- PC apply + reduction phase 2 ----
         z = None
@@ -333,7 +404,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
             if g is not None:
                 rr, badA = g.p2_stencil(r, p, Ap)   # fused phase 2 + ABFT
                 rz_new = rr * inv_diag
-                zdir = r * inv_diag
+                zdir = st_(r * inv_diag)
                 rn_new = jnp.sqrt(rr)
             elif M3 is not None:
                 rr = pdot(r, r)
@@ -344,7 +415,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
             else:
                 rr = pdot(r, r)
                 rz_new = rr * inv_diag
-                zdir = r * inv_diag
+                zdir = st_(r * inv_diag)
                 rn_new = jnp.sqrt(rr)
         else:
             z = jnp.where(cm, M(r), st["z"])
@@ -361,7 +432,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
         if natural and g is None and not stencil:
             brk_new = brk_new | (cont & (jnp.real(rz_new) < 0))
         beta = jnp.where(rz == 0, 0.0, rz_new / jnp.where(rz == 0, 1.0, rz))
-        p = jnp.where(cm, zdir + bp.ex(beta) * p, p)
+        p = jnp.where(cm, st_(zdir + bp.ex(beta) * p), p)
         rz = jnp.where(cont, rz_new, rz)
         if rn_new is None:
             rn_new = _nat(rz_new) if natural else pnorm(r)
@@ -427,7 +498,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
                 # is promoted to the rollback target xv
                 r = jnp.where(okm, rt, r)
                 if stencil:
-                    p = jnp.where(okm, rt * inv_diag, p)
+                    p = jnp.where(okm, st_(rt * inv_diag), p)
                     rz = jnp.where(ok, rtn2 * inv_diag, rz)
                 else:
                     z = jnp.where(okm, zt, z)
@@ -480,7 +551,7 @@ def classic_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
 
 def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
                       A=None, M=None, pnorm=None, fused=None,
-                      guard=None, bp=None, monitor=None):
+                      guard=None, bp=None, monitor=None, prec=None):
     """Assemble and run the pipelined (single-reduction) CG recurrence.
 
     Ghysels–Vanroose pipelined CG ("Pipelined, Flexible Krylov Subspace
@@ -512,6 +583,10 @@ def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
     """
     bp = bp or SingleBatch()
     g = guard
+    st_ = _stc(prec)
+    # the scalar recurrences (gamma/alpha) and sgn live in the REDUCE
+    # dtype under a mixed plan — fused() returns wide scalars there
+    sdt = prec.reduce if (prec is not None and prec.mixed) else b.dtype
 
     r = b - A(x0)
     if g is not None:
@@ -524,7 +599,7 @@ def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
     rn0 = pnorm(r)
     dmax = _dmax(rn0, dtol)
     hist = _mon0(monitor, rn0, b.dtype)
-    sc0 = jnp.zeros(jnp.shape(rn0), b.dtype)
+    sc0 = jnp.zeros(jnp.shape(rn0), sdt)
 
     # STACKED carries: the state block S = [w, u, r, x] and the direction
     # block V = [z, q, s, p] each update in ONE fused AXPY kernel
@@ -534,7 +609,7 @@ def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
     # 8-virtual-device CPU mesh). ``sgn`` encodes the update directions
     # (w/u/r subtract, x adds).
     sgn = jnp.asarray([-1.0, -1.0, -1.0, 1.0],
-                      jnp.real(jnp.zeros((), b.dtype)).dtype
+                      jnp.real(jnp.zeros((), sdt)).dtype
                       ).reshape((4,) + (1,) * b.ndim)
     S0 = jnp.stack([w, u, r, x0])
     st0 = dict(it=_it0(rn0), S=S0, V=jnp.zeros_like(S0),
@@ -592,8 +667,9 @@ def pipelined_cg_loop(*, b, x0, rtol, atol, maxit, dtol=None,
         be, al = bp.ex(beta), bp.ex(alpha)
         # V = [z, q, s, p] <- [n, m, w, u] + beta V ; then the state rows
         # [w, u, r, x] -= / += alpha * V rows — two fused kernels total
-        V = jnp.where(cm, jnp.stack([n, m, w, u]) + be * st["V"], st["V"])
-        S = jnp.where(cm, S + al * (sgn * V), S)
+        V = jnp.where(cm, st_(jnp.stack([n, m, w, u]) + be * st["V"]),
+                      st["V"])
+        S = jnp.where(cm, st_(S + al * (sgn * V)), S)
         # rr = <r, r> is real by construction; take the real part so the
         # carried norm stays real-typed for complex operators
         rn_new = jnp.sqrt(jnp.maximum(jnp.real(rr), 0.0))

@@ -24,6 +24,7 @@ import types as _types
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -185,7 +186,7 @@ class _LiveMonitor(_HistMonitor):
 
 
 def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
-              dtol=None, unroll=1, natural=False):
+              dtol=None, unroll=1, natural=False, prec=None):
     """Preconditioned conjugate gradients (KSPCG equivalent).
 
     Assembled from the composable plans in :mod:`.cg_plans` (classic
@@ -213,11 +214,12 @@ def cg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, monitor=None,
     return _plans.classic_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pdot=pdot, pnorm=pnorm, monitor=monitor,
-        unroll=unroll, natural=natural)
+        unroll=unroll, natural=natural, prec=prec)
 
 
 def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
-                      monitor=None, dtol=None, grid3d=None, M3=None):
+                      monitor=None, dtol=None, grid3d=None, M3=None,
+                      prec=None):
     """CG fast path for uniform-diagonal stencil operators (the BASELINE
     cfg1/cfg5 hot loop, reference ``test.py:50``'s iterative analog).
 
@@ -253,7 +255,7 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
     out = _plans.classic_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         Adot=Adot, inv_diag=inv_diag, M3=M3, pdot=pdot, pnorm=pnorm,
-        monitor=monitor)
+        monitor=monitor, prec=prec)
     x = out[0].reshape(flat) if grid3d is not None else out[0]
     return (x,) + out[1:]
 
@@ -273,7 +275,7 @@ GUARDED_TYPES = ("cg", "pipecg")
 
 
 def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
-                tasum, cmul, no_bad, pdot, pnorm):
+                tasum, cmul, no_bad, pdot, pnorm, eps_dtype=None):
     """The guard closure bundle shared by the single-RHS and batched
     guarded kernels — ONE definition of the ABFT check algebra.
 
@@ -287,8 +289,13 @@ def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
     stacked (possibly faulted) psum per phase; ``vpair`` — the
     replacement VERIFIER — uses plain ``lax.psum`` on purpose (a
     corrupted verifier would lie about recovery).
+
+    Under a mixed precision plan ``dtype`` is the REDUCE dtype (the
+    stacked psum's accumulation channel) while ``eps_dtype`` carries the
+    STORAGE dtype whose rounding sets the detection threshold — a bf16
+    apply's benign error is bf16-sized however wide the accumulator is.
     """
-    eps = _abft.checksum_tolerance_dtype(dtype)
+    eps = _abft.checksum_tolerance_dtype(eps_dtype or dtype)
 
     def _stack_psum(parts):
         return _psum(jnp.stack([jnp.asarray(q, dtype) for q in parts]),
@@ -347,7 +354,8 @@ def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
 
 
 def _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
-                     tsum, tasum, cmul, no_bad, pdot, pnorm):
+                     tsum, tasum, cmul, no_bad, pdot, pnorm,
+                     eps_dtype=None):
     """The guard bundle for the PIPELINED reduction plan.
 
     Pipelined CG's one stacked psum per iteration reduces ``<r,u>``,
@@ -373,7 +381,7 @@ def _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
     """
     base = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, dot=dot,
                        tsum=tsum, tasum=tasum, cmul=cmul, no_bad=no_bad,
-                       pdot=pdot, pnorm=pnorm)
+                       pdot=pdot, pnorm=pnorm, eps_dtype=eps_dtype)
     eps = base.eps
     thr = lambda scale: abft_tol * eps * scale
 
@@ -435,7 +443,7 @@ def _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
 
 
 def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
-                      monitor=None, dtol=None):
+                      monitor=None, dtol=None, prec=None):
     """Preconditioned CG with the in-program silent-corruption guard.
 
     Per-iteration arithmetic matches :func:`cg_kernel` at unroll=1; the
@@ -462,12 +470,13 @@ def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
     """
     return _plans.classic_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
-        A=A, M=M, pdot=pdot, pnorm=pnorm, guard=g, monitor=monitor)
+        A=A, M=M, pdot=pdot, pnorm=pnorm, guard=g, monitor=monitor,
+        prec=prec)
 
 
 def cg_stencil_kernel_guarded(Adot, inv_diag, pdot3, pnorm3, b, x0, rtol,
                               atol, maxit, g, monitor=None, dtol=None,
-                              grid3d=None):
+                              grid3d=None, prec=None):
     """Guarded twin of :func:`cg_stencil_kernel` (uniform-diagonal stencil
     fast path, PC none/jacobi — the scalar Jacobi identities mean there is
     no in-program PC apply, so only the operator ABFT channel exists).
@@ -484,7 +493,7 @@ def cg_stencil_kernel_guarded(Adot, inv_diag, pdot3, pnorm3, b, x0, rtol,
     out = _plans.classic_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         Adot=Adot, inv_diag=inv_diag, pdot=pdot3, pnorm=pnorm3, guard=g,
-        monitor=monitor)
+        monitor=monitor, prec=prec)
     if grid3d is not None:
         out = ((out[0].reshape(flat),) + out[1:7]
                + (out[7].reshape(flat),))
@@ -963,7 +972,7 @@ def chebyshev_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 
 
 def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
-                  preduce=None, monitor=None, dtol=None):
+                  preduce=None, monitor=None, dtol=None, prec=None):
     """Pipelined single-reduction CG (Ghysels–Vanroose; KSPPIPECG slot).
 
     Standard CG needs three separate reductions per iteration ((p,Ap),
@@ -980,17 +989,20 @@ def pipecg_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
     (:func:`pipecg_kernel_guarded`) is the bound. PETSc's KSPPIPECG
     needs ``MPI_Iallreduce`` for the same overlap (PARITY.md).
     """
+    up = (prec.up if prec is not None and prec.mixed else (lambda v: v))
+
     def fused(r, u, w):
-        s = preduce(jnp.vdot(r, u), jnp.vdot(w, u), jnp.vdot(r, r))
+        ru, uu, wu = up(r), up(u), up(w)
+        s = preduce(jnp.vdot(ru, uu), jnp.vdot(wu, uu), jnp.vdot(ru, ru))
         return s[0], s[1], s[2]
 
     return _plans.pipelined_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
-        A=A, M=M, pnorm=pnorm, fused=fused, monitor=monitor)
+        A=A, M=M, pnorm=pnorm, fused=fused, monitor=monitor, prec=prec)
 
 
 def pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
-                          monitor=None, dtol=None):
+                          monitor=None, dtol=None, prec=None):
     """Guarded pipelined CG: the GV recurrences with the ABFT partials
     folded into the ONE stacked psum (:func:`_make_pipe_guard` — the
     guarded pipelined program keeps exactly one reduce site per
@@ -1001,11 +1013,12 @@ def pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
     return _plans.pipelined_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pnorm=pnorm, fused=g.fused, guard=g,
-        monitor=monitor)
+        monitor=monitor, prec=prec)
 
 
 def pipecg_stencil_kernel(A3, inv_diag, pnorm3, fused, b, x0, rtol, atol,
-                          maxit, monitor=None, dtol=None, grid3d=None):
+                          maxit, monitor=None, dtol=None, grid3d=None,
+                          prec=None):
     """Pipelined-CG fast path for uniform-diagonal stencil operators:
     grid-shaped carries (zero in-loop reshapes — the
     :func:`cg_stencil_kernel` traffic discipline), the 3D-native apply
@@ -1018,16 +1031,19 @@ def pipecg_stencil_kernel(A3, inv_diag, pnorm3, fused, b, x0, rtol, atol,
     if grid3d is not None:
         b = b.reshape(grid3d)
         x0 = x0.reshape(grid3d)
+    Mdiag = ((lambda r: (r * inv_diag).astype(prec.storage))
+             if prec is not None and prec.mixed
+             else (lambda r: r * inv_diag))
     out = _plans.pipelined_cg_loop(
         b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
-        A=A3, M=lambda r: r * inv_diag, pnorm=pnorm3, fused=fused,
-        monitor=monitor)
+        A=A3, M=Mdiag, pnorm=pnorm3, fused=fused,
+        monitor=monitor, prec=prec)
     x = out[0].reshape(flat) if grid3d is not None else out[0]
     return (x,) + out[1:]
 
 
 def pipecg_kernel_many(A, M, pdotc, pnormc, fused, B, X0, rtol, atol,
-                      maxit, monitor=None, dtol=None):
+                      maxit, monitor=None, dtol=None, prec=None):
     """Batched pipelined CG: ``nrhs`` GV recurrences in lockstep with
     per-column masked convergence (the :func:`cg_kernel_many`
     discipline); ``fused`` reduces every column's (gamma, delta, ||r||²)
@@ -1037,11 +1053,12 @@ def pipecg_kernel_many(A, M, pdotc, pnormc, fused, B, X0, rtol, atol,
     return _plans.pipelined_cg_loop(
         b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pnorm=pnormc, fused=fused,
-        bp=_plans.ManyBatch("cols"), monitor=monitor)
+        bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
 
 
 def pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol,
-                               maxit, g, monitor=None, dtol=None):
+                               maxit, g, monitor=None, dtol=None,
+                               prec=None):
     """Batched guarded pipelined CG: mask-aware per-column detection
     (sticky det codes, frozen columns keep verified state) with all
     guard partials riding the single stacked psum. Output contract
@@ -1049,7 +1066,7 @@ def pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol,
     return _plans.pipelined_cg_loop(
         b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pnorm=pnormc, fused=g.fused, guard=g,
-        bp=_plans.ManyBatch("cols"), monitor=monitor)
+        bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
 
 
 def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -2055,6 +2072,23 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    # the PRECISION PLAN: storage = the operator's dtype (what the
+    # gathers/halos/AXPYs move), reduce = the accumulation channel
+    # (utils.dtypes.reduce_dtype — fp32 under bf16 storage, identity
+    # otherwise). Mixed plans are assembled by the CG loop-body builder
+    # (cg_plans), so only the plan-built family (+ the loop-free
+    # preonly/richardson bodies, whose carries stay dtype-consistent)
+    # accepts sub-f32 storage.
+    prec = _plans.precision_plan(dtype)
+    mixed = prec.mixed
+    if mixed and ksp_type not in ("cg", "pipecg", "preonly", "richardson"):
+        raise ValueError(
+            f"sub-f32 storage ({np.dtype(dtype)}) solves are assembled by "
+            f"the mixed-precision CG plans; KSP {ksp_type!r} has no "
+            "precision-plan body — use cg/pipecg (typically under "
+            "RefinedKSP fp64 refinement), or f32 storage")
+    rdt = prec.reduce
+    _up = prec.up       # the ONE lift-to-reduce-channel definition
     guard_k = bool(abft or rr)
     abft_k = bool(abft)
     abft_pc_k = bool(abft and abft_pc)
@@ -2098,7 +2132,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # a corrupted comm.psum baked into the jaxpr) is never cached into —
     # or served from — the fault-free program set.
     donate_k = bool(donate) and donation_supported()
-    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
+    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k,
            true_res_k, abft_k, abft_pc_k, bool(rr), donate_k,
@@ -2177,7 +2211,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # functional in-program recorder (no host callbacks — see _HistMonitor);
     # callback-capable backends get the live-streaming variant
     mon_cls = _LiveMonitor if live_k else _HistMonitor
-    monitor = (mon_cls(dtype, cap_k or hist_capacity(10000, restart))
+    # the history buffer records REDUCE-channel norms (bf16 slots would
+    # quantize the monitored convergence curve to 8 mantissa bits)
+    monitor = (mon_cls(rdt if mixed else dtype,
+                       cap_k or hist_capacity(10000, restart))
                if monitored else None)
 
     def make_body(project):
@@ -2199,33 +2236,48 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             # vdot conjugates its first argument — the complex-correct inner
             # product; norms take the real part (vdot(u,u) carries a ~0
             # imaginary component for complex dtypes) so every kernel's
-            # convergence scalar stays real-typed
-            pdot = lambda u, v: _psum(jnp.vdot(u, v), axis)
-            pnorm = lambda u: jnp.sqrt(jnp.real(_psum(jnp.vdot(u, u),
+            # convergence scalar stays real-typed. Under a mixed plan the
+            # operands are lifted into the REDUCE dtype first (_up is the
+            # identity otherwise), so bf16 storage never accumulates a
+            # dot product in bf16.
+            pdot = lambda u, v: _psum(jnp.vdot(_up(u), _up(v)), axis)
+            pnorm = lambda u: jnp.sqrt(jnp.real(_psum(jnp.vdot(_up(u),
+                                                              _up(u)),
                                                       axis)))
             kw = {"monitor": monitor} if monitor is not None else {}
             kw["dtol"] = dtol
             if natural_k:
                 kw["natural"] = True
+            if mixed and ksp_type in ("cg", "pipecg"):
+                # only the plan-built family takes the plan object; the
+                # loop-free preonly/richardson bodies need no casts
+                kw["prec"] = prec
+            # the dtype every stacked-psum phase accumulates in — the
+            # plan's reduce channel (== the operator scalar for uniform
+            # plans, so existing programs are unchanged)
+            stack_dt = rdt
 
             def _stack_psum(parts):
                 # ONE fused (possibly faulted) psum for a whole phase's
                 # scalars — the pipecg/fbcgsr discipline the ABFT
                 # partials ride on (zero extra collectives)
-                return _psum(jnp.stack([jnp.asarray(q, dtype)
+                return _psum(jnp.stack([jnp.asarray(q, stack_dt)
                                         for q in parts]), axis)
 
             eps = _abft.checksum_tolerance_dtype(dtype)
 
             if stencil_cg:
-                inv_diag = (jnp.asarray(1.0, b.dtype) if pc.get_type() == "none"
+                idt = rdt if mixed else b.dtype
+                inv_diag = (jnp.asarray(1.0, idt) if pc.get_type() == "none"
                             else jnp.asarray(1.0 / operator.uniform_diagonal,
-                                             b.dtype))
+                                             idt))
                 # 3D-carry variant: the stencil path is real-dtype, so the
                 # reductions are plain sums (see cg_stencil_kernel docstring
-                # for why the grid shape is kept through the loop)
-                pdot3 = lambda u, v: _psum(jnp.sum(u * v), axis)
-                pnorm3 = lambda u: jnp.sqrt(_psum(jnp.sum(u * u), axis))
+                # for why the grid shape is kept through the loop); _up
+                # lifts bf16 operands into the f32 reduce channel
+                pdot3 = lambda u, v: _psum(jnp.sum(_up(u) * _up(v)), axis)
+                pnorm3 = lambda u: jnp.sqrt(_psum(jnp.sum(_up(u) * _up(u)),
+                                                  axis))
 
                 def Adot(v):
                     y, d = matvec_dot(op_arrays, v)
@@ -2239,11 +2291,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
                     if cs3 is not None:
                         def init3(b3, r3, x3):
-                            cx = cs3 * x3
+                            b3u, r3u = _up(b3), _up(r3)
+                            cx = _up(cs3) * _up(x3)
                             s = _stack_psum([
-                                jnp.sum(b3 * b3), jnp.sum(r3 * r3),
-                                jnp.sum(r3), jnp.sum(b3), jnp.sum(cx),
-                                jnp.sum(jnp.abs(r3)), jnp.sum(jnp.abs(b3)),
+                                jnp.sum(b3u * b3u), jnp.sum(r3u * r3u),
+                                jnp.sum(r3u), jnp.sum(b3u), jnp.sum(cx),
+                                jnp.sum(jnp.abs(r3u)),
+                                jnp.sum(jnp.abs(b3u)),
                                 jnp.sum(jnp.abs(cx))])
                             bad = (jnp.abs(s[2] - s[3] + s[4])
                                    > thr(s[5] + s[6] + s[7]))
@@ -2251,10 +2305,11 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                                     jnp.sqrt(jnp.maximum(s[1], 0.0)), bad)
 
                         def p2_stencil(r3, p3, Ap3):
-                            cp = cs3 * p3
+                            r3u, Apu = _up(r3), _up(Ap3)
+                            cp = _up(cs3) * _up(p3)
                             s = _stack_psum([
-                                jnp.sum(r3 * r3), jnp.sum(Ap3),
-                                jnp.sum(cp), jnp.sum(jnp.abs(Ap3)),
+                                jnp.sum(r3u * r3u), jnp.sum(Apu),
+                                jnp.sum(cp), jnp.sum(jnp.abs(Apu)),
                                 jnp.sum(jnp.abs(cp))])
                             bad = jnp.abs(s[1] - s[2]) > thr(s[3] + s[4])
                             return jnp.maximum(s[0], 0.0), bad
@@ -2267,7 +2322,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
                     g3 = _types.SimpleNamespace(
                         init=init3, p2_stencil=p2_stencil,
-                        vnorm2=lambda rt: lax.psum(jnp.sum(rt * rt), axis),
+                        vnorm2=lambda rt: lax.psum(
+                            jnp.sum(_up(rt) * _up(rt)), axis),
                         rr_n=rr_n, eps=eps)
                     return cg_stencil_kernel_guarded(
                         Adot, inv_diag, pdot3, pnorm3, b, x0, rtol, atol,
@@ -2282,18 +2338,21 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     grid3d=operator.grid3d, **kw)
 
             if stencil_pipe:
-                inv_diag = (jnp.asarray(1.0, b.dtype)
+                idt = rdt if mixed else b.dtype
+                inv_diag = (jnp.asarray(1.0, idt)
                             if pc.get_type() == "none"
                             else jnp.asarray(1.0 / operator.uniform_diagonal,
-                                             b.dtype))
+                                             idt))
                 A3 = lambda u: _abft.apply_silent_fault(
                     "spmv.result", apply3(op_arrays, u))
-                pnorm3 = lambda v: jnp.sqrt(_psum(jnp.sum(v * v), axis))
+                pnorm3 = lambda v: jnp.sqrt(_psum(jnp.sum(_up(v) * _up(v)),
+                                                  axis))
 
                 def fused3(r_, u_, w_):
+                    ru, uu, wu = _up(r_), _up(u_), _up(w_)
                     s = _plans.fuse_psum(
-                        [jnp.sum(r_ * u_), jnp.sum(w_ * u_),
-                         jnp.sum(r_ * r_)], _psum, axis, dtype)
+                        [jnp.sum(ru * uu), jnp.sum(wu * uu),
+                         jnp.sum(ru * ru)], _psum, axis, stack_dt)
                     return s[0], s[1], s[2]
 
                 return pipecg_stencil_kernel(
@@ -2302,18 +2361,23 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
 
             if guard_args is not None:
                 cs_l, csM_l, abft_tol, rr_n = guard_args
-                flavor = dict(dot=jnp.vdot, tsum=jnp.sum,
-                              tasum=lambda u: jnp.sum(jnp.abs(u)),
-                              cmul=lambda c, v: c * v,
+                # the guard's partial sums run in the REDUCE channel (_up
+                # lifts bf16 operands); the detection threshold stays
+                # scaled to the STORAGE epsilon (eps_dtype)
+                flavor = dict(dot=lambda u, v: jnp.vdot(_up(u), _up(v)),
+                              tsum=lambda u: jnp.sum(_up(u)),
+                              tasum=lambda u: jnp.sum(jnp.abs(_up(u))),
+                              cmul=lambda c, v: _up(c) * _up(v),
                               no_bad=lambda v: False,
-                              pdot=pdot, pnorm=pnorm)
+                              pdot=pdot, pnorm=pnorm,
+                              eps_dtype=dtype if mixed else None)
                 if ksp_type == "pipecg":
-                    gp = _make_pipe_guard(dtype, axis, cs_l, csM_l,
+                    gp = _make_pipe_guard(stack_dt, axis, cs_l, csM_l,
                                           abft_tol, rr_n, **flavor)
                     return pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0,
                                                  rtol, atol, maxit, gp,
                                                  **kw)
-                g = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+                g = _make_guard(stack_dt, axis, cs_l, csM_l, abft_tol, rr_n,
                                 **flavor)
                 return cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol,
                                          atol, maxit, g, **kw)
@@ -2340,7 +2404,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # the 1-reduce-site gate's injected-regression test can
                 # split it and prove the assert has teeth
                 kw["preduce"] = lambda *parts: _plans.fuse_psum(
-                    list(parts), _psum, axis, dtype)
+                    list(parts), _psum, axis, stack_dt)
             elif ksp_type in _NEEDS_TRANSPOSE:
                 # the adjoint of the projected operator v -> P(Av) is
                 # w -> A^T(Pw): project BEFORE the transpose product (P is
@@ -2371,17 +2435,23 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     def _true_res_tail(op_arrays, b, x):
         # epilogue: TRUE residual of the returned iterate against the RAW
         # rhs (matching the host-side oracle at reference test.py:148-149),
-        # fused into the solve program — see the true_res docstring note
-        r = b - spmv_local(op_arrays, x)
+        # fused into the solve program — see the true_res docstring note;
+        # the norms accumulate in the reduce channel (_up)
+        r = _up(b - spmv_local(op_arrays, x))
+        bu = _up(b)
         trn = jnp.sqrt(jnp.real(lax.psum(jnp.vdot(r, r), axis)))
-        bn = jnp.sqrt(jnp.real(lax.psum(jnp.vdot(b, b), axis)))
+        bn = jnp.sqrt(jnp.real(lax.psum(jnp.vdot(bu, bu), axis)))
         return trn, bn
 
     if nullspace_dim:
         def local_fn(op_arrays, pc_arrays, ns_q, b, x0, rtol, atol, dtol,
                      maxit):
             def project(v):
-                return v - lax.psum(ns_q @ v, axis) @ ns_q
+                # one psum either way; a mixed plan projects in the
+                # reduce channel and stores back (identity casts elide)
+                nq, vu = _up(ns_q), _up(v)
+                out = vu - lax.psum(nq @ vu, axis) @ nq
+                return out.astype(v.dtype) if mixed else out
             out = make_body(project)(op_arrays, pc_arrays, b, x0,
                                      rtol, atol, dtol, maxit)
             if true_res_k:
@@ -2466,7 +2536,7 @@ class _HistMonitorMany(_HistMonitor):
 
 
 def cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol, atol, maxit,
-                   monitor=None, dtol=None):
+                   monitor=None, dtol=None, prec=None):
     """Batched preconditioned CG: ``nrhs`` INDEPENDENT recurrences in
     lockstep over an ``(lsize, nrhs)`` RHS block (KSPMatSolve's hot-loop
     analog).
@@ -2489,11 +2559,12 @@ def cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol, atol, maxit,
     return _plans.classic_cg_loop(
         b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pdot=pdotc, pnorm=pnormc, pduo=pduo,
-        bp=_plans.ManyBatch("cols"), monitor=monitor)
+        bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
 
 
 def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
-                           maxit, monitor=None, dtol=None, grid3d=None):
+                           maxit, monitor=None, dtol=None, grid3d=None,
+                           prec=None):
     """Batched twin of :func:`cg_stencil_kernel` for uniform-diagonal
     stencil operators: state lives in ``(nrhs,) + grid3d`` slabs, the
     SpMV + per-column ``<p_j, A p_j>`` partials run in one fused pass
@@ -2511,13 +2582,13 @@ def cg_stencil_kernel_many(Adot, inv_diag, pdotc3, B, X0, rtol, atol,
         b=B3, x0=X3, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         Adot=Adot, inv_diag=inv_diag, pdot=pdotc3,
         pnorm=lambda U: jnp.sqrt(pdotc3(U, U)),
-        bp=_plans.ManyBatch("slabs"), monitor=monitor)
+        bp=_plans.ManyBatch("slabs"), monitor=monitor, prec=prec)
     X = out[0].reshape(nrhs, -1).T.reshape(flat)
     return (X,) + out[1:]
 
 
 def cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
-                           g, monitor=None, dtol=None):
+                           g, monitor=None, dtol=None, prec=None):
     """Batched guarded CG: :func:`cg_kernel_many`'s masked lockstep
     recurrences with PER-COLUMN silent-corruption detection.
 
@@ -2537,7 +2608,7 @@ def cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
     return _plans.classic_cg_loop(
         b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pdot=pdotc, pnorm=pnormc, guard=g,
-        bp=_plans.ManyBatch("cols"), monitor=monitor)
+        bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
 
 
 _PROGRAM_CACHE_MANY: dict = {}
@@ -2595,6 +2666,13 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    # precision plan (see build_ksp_program): batched storage channel in
+    # the operator dtype, reductions lifted into the reduce channel
+    prec = _plans.precision_plan(dtype)
+    mixed = prec.mixed
+    rdt = prec.reduce
+    _up = prec.up       # the ONE lift-to-reduce-channel definition
+    stack_dt = rdt      # == dtype for uniform plans
     cap_k = int(hist_cap) if monitored else 0
     guard_k = bool(abft or rr)
     abft_k = bool(abft)
@@ -2603,7 +2681,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     trace_nonce = _faults.trace_key()
     aot_on = aot.aot_enabled() and trace_nonce is None
     donate_k = bool(donate) and donation_supported()
-    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, str(dtype),
+    key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            int(nrhs), monitored, zero_guess, operator.program_key(),
            cap_k, abft_k, abft_pc_k, bool(rr), true_res_k, donate_k,
            trace_nonce, aot_on)
@@ -2629,16 +2707,18 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     matvec_dot = operator.local_matvec_dot_many(comm) if stencil_cg else None
     spmv_many = None if stencil_cg else operator.local_spmv_many(comm)
     op_specs = operator.op_specs(axis)
-    monitor = (_HistMonitorMany(dtype, cap_k or hist_capacity(10000, 0),
+    monitor = (_HistMonitorMany(rdt if mixed else dtype,
+                                cap_k or hist_capacity(10000, 0),
                                 nrhs) if monitored else None)
 
     def _tail_many(op_arrays, B, X):
         # batched true-residual epilogue (raw spmv + plain psum — the
         # verifier channel, exactly like the single-RHS _true_res_tail;
         # both per-column norm rows ride ONE stacked psum)
-        R = B - spmv_many(op_arrays, X)
+        R = _up(B - spmv_many(op_arrays, X))
+        Bu = _up(B)
         s = lax.psum(jnp.stack([jnp.real(jnp.sum(jnp.conj(R) * R, axis=0)),
-                                jnp.real(jnp.sum(jnp.conj(B) * B,
+                                jnp.real(jnp.sum(jnp.conj(Bu) * Bu,
                                                  axis=0))]), axis)
         return jnp.sqrt(s[0]), jnp.sqrt(s[1])
 
@@ -2646,7 +2726,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
              guard_args=None):
         if zero_guess:
             X0 = _consumed_zeros(X0) if donate_k else jnp.zeros_like(B)
-        cdot = lambda U, V: jnp.sum(jnp.conj(U) * V, axis=0)
+        cdot = lambda U, V: jnp.sum(jnp.conj(_up(U)) * _up(V), axis=0)
         pdotc = lambda U, V: _psum(cdot(U, V), axis)
         pnormc = lambda U: jnp.sqrt(jnp.real(_psum(cdot(U, U), axis)))
 
@@ -2658,11 +2738,15 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
 
         kw = {"monitor": monitor} if monitor is not None else {}
         kw["dtol"] = dtol
+        if mixed:
+            kw["prec"] = prec
         if stencil_cg:
-            inv_diag = (jnp.asarray(1.0, B.dtype) if pc.get_type() == "none"
+            idt = rdt if mixed else B.dtype
+            inv_diag = (jnp.asarray(1.0, idt) if pc.get_type() == "none"
                         else jnp.asarray(1.0 / operator.uniform_diagonal,
-                                         B.dtype))
-            pdotc3 = lambda U, V: _psum(jnp.sum(U * V, axis=(1, 2, 3)),
+                                         idt))
+            pdotc3 = lambda U, V: _psum(jnp.sum(_up(U) * _up(V),
+                                                axis=(1, 2, 3)),
                                         axis)
 
             def Adot3(U):
@@ -2679,25 +2763,27 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
         if guard_args is not None:
             cs_l, csM_l, abft_tol, rr_n = guard_args
             flavor = dict(
-                dot=cdot, tsum=lambda U: jnp.sum(U, axis=0),
-                tasum=lambda U: jnp.sum(jnp.abs(U), axis=0),
-                cmul=lambda c, V: c[:, None] * V,
+                dot=cdot, tsum=lambda U: jnp.sum(_up(U), axis=0),
+                tasum=lambda U: jnp.sum(jnp.abs(_up(U)), axis=0),
+                cmul=lambda c, V: _up(c)[:, None] * _up(V),
                 no_bad=lambda V: jnp.zeros(V.shape[1], bool),
-                pdot=pdotc, pnorm=pnormc)
+                pdot=pdotc, pnorm=pnormc,
+                eps_dtype=dtype if mixed else None)
             if ksp_type == "pipecg":
-                gp = _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol,
-                                      rr_n, **flavor)
+                gp = _make_pipe_guard(stack_dt, axis, cs_l, csM_l,
+                                      abft_tol, rr_n, **flavor)
                 return pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B,
                                                   X0, rtol, atol, maxit,
                                                   gp, **kw)
-            g = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n,
+            g = _make_guard(stack_dt, axis, cs_l, csM_l, abft_tol, rr_n,
                             **flavor)
             return cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0,
                                           rtol, atol, maxit, g, **kw)
         if ksp_type == "pipecg":
             def fusedc(Rb, U, W):
                 s = _plans.fuse_psum([cdot(Rb, U), cdot(W, U),
-                                      cdot(Rb, Rb)], _psum, axis, dtype)
+                                      cdot(Rb, Rb)], _psum, axis,
+                                     stack_dt)
                 return s[0], s[1], s[2]
             return pipecg_kernel_many(A, M, pdotc, pnormc, fusedc, B, X0,
                                       rtol, atol, maxit, **kw)

@@ -577,7 +577,11 @@ class KSP:
                 f"-ksp_true_residual_margin must be in (0, 1], got "
                 f"{margin!r}: 0 makes every gated target unreachable, "
                 ">1 would stop LOOSER than rtol and defeat the gate")
-        dt = np.dtype(op_dt.type(0).real.dtype)
+        # tolerance scalars travel in the REDUCE channel's real dtype
+        # (f32 under bf16 storage — a bf16 rtol would quantize the
+        # convergence target to 8 mantissa bits)
+        from ..utils.dtypes import tolerance_dtype
+        dt = tolerance_dtype(op_dt)
         ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
         # trailing runtime guard scalars (tolerance factor + replacement
@@ -1021,7 +1025,8 @@ class KSP:
         prog = build_ksp_program_many(
             comm, self._type, pc, mat, nrhs=k,
             zero_guess=not guess_nonzero, **build_kw)
-        dt = np.dtype(op_dt.type(0).real.dtype)
+        from ..utils.dtypes import tolerance_dtype
+        dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),
                           np.int32(self._effective_replacement()))
                          if guard else ())

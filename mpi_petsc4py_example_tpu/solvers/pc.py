@@ -49,7 +49,8 @@ from jax import lax
 
 from ..core.mat import Mat
 from ..parallel.mesh import DeviceComm
-from ..utils.dtypes import host_dtype, is_complex
+from ..ops.spmv import widened_einsum
+from ..utils.dtypes import host_dtype, is_complex, real_eps
 from jax.sharding import PartitionSpec as P
 
 PC_TYPES = ("none", "jacobi", "bjacobi", "lu", "cholesky", "mg",
@@ -269,7 +270,7 @@ class PC:
                 # tolerance scales with the operator dtype: fp32 assembly
                 # carries ~eps-relative accumulation asymmetry that must not
                 # reject a legitimately symmetric operator
-                rel = max(1e-10, 100 * float(np.finfo(np.dtype(mat.dtype)).eps))
+                rel = max(1e-10, 100 * real_eps(mat.dtype))
                 if D.nnz and abs(D).max() > rel * scale:
                     raise ValueError(
                         "PC 'cholesky' needs a symmetric (Hermitian) "
@@ -466,9 +467,11 @@ class PC:
             def apply(arrs, r):
                 binv = arrs[0]  # this device's (nb, bs, bs) block inverses
                 nb, bs = binv.shape[0], binv.shape[1]
-                # nb > 1 (-pc_bjacobi_blocks): one batched MXU matmul
-                return jnp.einsum("bij,bj->bi", binv,
-                                  r.reshape(nb, bs)).reshape(-1)
+                # nb > 1 (-pc_bjacobi_blocks): one batched MXU matmul.
+                # Low-precision factor STORAGE (bf16, the mixed-precision
+                # plan's PC channel) contracts in f32 via widened_einsum.
+                return widened_einsum("bij,bj->bi", binv,
+                                      r.reshape(nb, bs)).reshape(-1)
             return apply
         if k == "asm":
             ov = int(self.asm_overlap)
@@ -497,7 +500,7 @@ class PC:
             def apply(arrs, r):
                 minv = arrs[0]  # replicated (n_pad, n_pad) inverse
                 r_full = lax.all_gather(r, axis, tiled=True)
-                z_full = minv @ r_full
+                z_full = widened_einsum("ij,j->i", minv, r_full)
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(z_full, i * lsize, lsize)
             return apply
@@ -610,7 +613,9 @@ class PC:
                 binv = arrs[0]   # (nb, bs, bs) block inverses
                 nb, bs = binv.shape[0], binv.shape[1]
                 # one batched MXU matmul per apply, k columns at a time
-                return jnp.einsum(
+                # (bf16 factor storage contracts in f32, like the
+                # single-RHS apply)
+                return widened_einsum(
                     "bij,bjc->bic", binv,
                     R.reshape(nb, bs, R.shape[1])).reshape(-1, R.shape[1])
             return apply
@@ -618,7 +623,7 @@ class PC:
             def apply(arrs, R):
                 minv = arrs[0]   # replicated (n_pad, n_pad) inverse
                 R_full = lax.all_gather(R, axis, tiled=True)
-                Z_full = minv @ R_full
+                Z_full = widened_einsum("ij,jc->ic", minv, R_full)
                 i = lax.axis_index(axis)
                 return lax.dynamic_slice_in_dim(Z_full, i * lsize, lsize)
             return apply

@@ -1,13 +1,26 @@
 """Mixed-precision iterative refinement — the fp64 story on TPU.
 
 TPU v5e has no native f64 MXU; f64 arithmetic is emulated and slow
-(SURVEY.md §7.3). The TPU-native answer: run the Krylov iteration in fp32 on
-device (fast path) inside an fp64 outer refinement loop — the classic
-Wilkinson scheme. Each outer step computes the true fp64 residual
+(SURVEY.md §7.3). The TPU-native answer: run the Krylov iteration in a LOW
+precision on device (fast path) inside an fp64 outer refinement loop — the
+classic Wilkinson scheme. Each outer step computes the true fp64 residual
 ``r = b - A·x`` (host CSR via the native toolkit, or fp64 device SpMV),
-solves the fp32 correction system ``A δ = r`` with any KSP/PC combination,
-and accumulates ``x += δ`` in fp64. For well-conditioned systems a handful
-of corrections reach full fp64 backward error at fp32 speed.
+solves the low-precision correction system ``A δ = r`` with any KSP/PC
+combination, and accumulates ``x += δ`` in fp64. For well-conditioned
+systems a handful of corrections reach full fp64 backward error at
+low-precision speed.
+
+PR 10 makes the inner precision a first-class axis
+(``-ksp_inner_precision {bf16,f32,f64}``): the inner operator/PC/iterate
+channel is stored at the chosen precision — bf16 halves the bytes every
+inner iterate moves vs f32, and quarters them vs f64 — while the inner
+Krylov's reductions accumulate in f32 (the mixed-precision plans of
+solvers/cg_plans) and the OUTER loop stays fp64 end to end, so the final
+accuracy contract (``rtol`` against the fp64 residual) is unchanged. bf16
+inner solves converge to ~bf16 resolution per correction, so they take
+more (cheap) outer steps — the per-step ``inner_rtol`` is floored at a
+few storage epsilons to keep a too-tight target from spinning the inner
+loop against precision it cannot resolve.
 """
 
 from __future__ import annotations
@@ -20,14 +33,25 @@ from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import as_comm
 from ..utils.convergence import ConvergedReason, SolveResult
+from ..utils.dtypes import inner_precision_dtype, real_eps
+from ..utils.options import global_options
 from .ksp import KSP
+
+#: tightest per-correction inner target the storage precision can
+#: resolve: a handful of eps (bf16 ~3e-2, f32 ~5e-7)
+_INNER_RTOL_FLOOR_EPS = 4.0
 
 
 class RefinedKSP:
-    """KSP-shaped mixed-precision solver: fp32 inner Krylov, fp64 refinement.
+    """KSP-shaped mixed-precision solver: low-precision inner Krylov
+    (``-ksp_inner_precision`` — bf16/f32/f64, default f32), fp64 outer
+    refinement.
 
-    Usage matches KSP; ``set_operators`` takes the fp64 CSR (scipy matrix or
-    triple) so both precisions of the operator can be built.
+    Usage matches KSP; ``set_operators`` takes the fp64 CSR (scipy matrix
+    or triple) so both precisions of the operator can be built, plus an
+    optional pre-built device operator (``inner_op`` — e.g. a
+    ``StencilPoisson3D`` constructed at the inner dtype) for matrix-free
+    stencils, where the scipy matrix serves only the exact fp64 residual.
     """
 
     def __init__(self, comm=None):
@@ -37,8 +61,10 @@ class RefinedKSP:
         self.rtol = 1e-12
         self.atol = 0.0
         self.max_refine = 20
+        self.inner_precision = "f32"
         self._A_host = None
-        self._mat32: Mat | None = None
+        self._mat_lp: Mat | None = None
+        self._inner_op = None
         self.result = SolveResult()
 
     def create(self, comm=None):
@@ -46,14 +72,58 @@ class RefinedKSP:
         self.inner.create(self.comm)
         return self
 
-    def set_operators(self, A_scipy):
-        """``A_scipy``: fp64 scipy sparse matrix (kept for exact residuals)."""
+    # ---- precision axis ----------------------------------------------------
+    def set_inner_precision(self, precision: str):
+        """Choose the inner storage precision (``bf16``/``f32``/``f64``).
+        Must be called before :meth:`set_operators` (the inner operator is
+        built at this dtype), or re-call ``set_operators`` after."""
+        inner_precision_dtype(precision)     # validate the spelling
+        self.inner_precision = str(precision).lower()
+        return self
+
+    setInnerPrecision = set_inner_precision
+
+    @property
+    def inner_dtype(self) -> np.dtype:
+        """The inner storage dtype of the current precision setting."""
+        return inner_precision_dtype(self.inner_precision)
+
+    def set_from_options(self):
+        """Apply the options DB: ``-ksp_inner_precision``,
+        ``-ksp_refine_max`` (outer-step cap) and
+        ``-ksp_refine_inner_rtol`` (per-correction inner target), then the
+        inner KSP's own flags (``-ksp_type``, ``-pc_type``, ...)."""
+        opt = global_options()
+        p = self.inner._prefix
+        ip = opt.get_string(p + "ksp_inner_precision")
+        if ip:
+            self.set_inner_precision(ip)
+        self.max_refine = opt.get_int(p + "ksp_refine_max", self.max_refine)
+        self.inner_rtol = opt.get_real(p + "ksp_refine_inner_rtol",
+                                       self.inner_rtol)
+        self.inner.set_from_options()
+        return self
+
+    setFromOptions = set_from_options
+
+    def set_operators(self, A_scipy, inner_op=None):
+        """``A_scipy``: fp64 scipy sparse matrix (kept for exact
+        residuals). ``inner_op``: optional device operator already built
+        at the inner precision (matrix-free stencils); defaults to an
+        assembled Mat at :attr:`inner_dtype`."""
         A = A_scipy.tocsr()
         self._A_host = A
         if self.comm is None:
             self.create(None)
-        self._mat32 = Mat.from_scipy(self.comm, A, dtype=np.float32)
-        self.inner.set_operators(self._mat32)
+        if inner_op is not None:
+            self._inner_op = inner_op
+            self._mat_lp = None
+            self.inner.set_operators(inner_op)
+        else:
+            self._mat_lp = Mat.from_scipy(self.comm, A,
+                                          dtype=self.inner_dtype)
+            self._inner_op = self._mat_lp
+            self.inner.set_operators(self._mat_lp)
         return self
 
     def set_type(self, t):
@@ -75,6 +145,29 @@ class RefinedKSP:
             self.inner_rtol = float(inner_rtol)
         return self
 
+    # ---- the Wilkinson loop ------------------------------------------------
+    def _arm_inner_guards(self):
+        """Pipelined CG's u/w recurrence drift scales with the STORAGE
+        epsilon — at bf16 it can overwhelm the per-correction target
+        outright (measured: divergence on a 24² Poisson without the
+        bound). When the inner type is pipecg on sub-f32 storage and no
+        replacement is armed, default the designed drift bound
+        (``-ksp_pipeline_auto_replacement``) on the inner KSP."""
+        from ..utils.dtypes import is_low_precision
+        if (self.inner.get_type() == "pipecg"
+                and is_low_precision(self.inner_dtype)
+                and self.inner.residual_replacement == 0
+                and self.inner.pipeline_auto_replacement == 0):
+            self.inner.pipeline_auto_replacement = 25
+
+    def _effective_inner_rtol(self) -> float:
+        """The per-correction target the inner solve actually runs at:
+        ``inner_rtol`` floored at a few STORAGE epsilons — a bf16 inner
+        CG asked for 1e-6 would spin max_it against resolution it does
+        not have; the outer fp64 loop supplies the remaining digits."""
+        floor = _INNER_RTOL_FLOOR_EPS * real_eps(self.inner_dtype)
+        return max(self.inner_rtol, floor)
+
     def solve(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
         """Solve A x = b (fp64 in/out). Returns (x, result)."""
         A = self._A_host
@@ -84,39 +177,119 @@ class RefinedKSP:
         bnorm = np.linalg.norm(b)
         tol = max(self.rtol * bnorm, self.atol)
         x = np.zeros_like(b)
-        # fp32 inner solver on the correction equation
-        self.inner.set_tolerances(rtol=self.inner_rtol, max_it=20000)
-        dx, rv = self._mat32.get_vecs()
+        # low-precision inner solver on the correction equation
+        self.inner.set_tolerances(rtol=self._effective_inner_rtol(),
+                                  max_it=20000)
+        self._arm_inner_guards()
+        op_dt = np.dtype(self._inner_op.dtype)
+        dx, rv = self._inner_op.get_vecs()
 
         t0 = time.perf_counter()
         total_inner = 0
-        rnorm = bnorm
+        # ONE exact fp64 residual per outer step: the end-of-step
+        # residual both decides convergence/stagnation AND feeds the
+        # next correction (recomputing it at the loop top would double
+        # the dominant host-side SpMV cost of the outer loop)
+        r = b - A @ x
+        rnorm = np.linalg.norm(r)
         reason = ConvergedReason.DIVERGED_MAX_IT
         it = 0
-        for it in range(1, self.max_refine + 1):
-            r = b - A @ x                       # exact fp64 residual
-            rnorm = np.linalg.norm(r)
-            if rnorm <= tol:
-                reason = (ConvergedReason.CONVERGED_ATOL
-                          if rnorm <= self.atol
-                          else ConvergedReason.CONVERGED_RTOL)
-                break
-            rv.set_global(r.astype(np.float32))
-            res = self.inner.solve(rv, dx)
-            total_inner += res.iterations
-            x = x + dx.to_numpy().astype(np.float64)
-            # stagnation guard: fp32 can't represent corrections below
-            # ~1e-7 of the iterate; if the residual stops improving, stop.
-            r_new = np.linalg.norm(b - A @ x)
-            if r_new >= 0.9 * rnorm:
+
+        def _conv(rn):
+            return (ConvergedReason.CONVERGED_ATOL if rn <= self.atol
+                    else ConvergedReason.CONVERGED_RTOL)
+
+        if rnorm <= tol:
+            reason = _conv(rnorm)
+        else:
+            for it in range(1, self.max_refine + 1):
+                rv.set_global(r.astype(op_dt))
+                res = self.inner.solve(rv, dx)
+                total_inner += res.iterations
+                x = x + dx.to_numpy().astype(np.float64)
+                r = b - A @ x
+                r_new = np.linalg.norm(r)
+                # checked AFTER the correction, so a solve that lands on
+                # tolerance at the max_refine-th step reports CONVERGED
+                if r_new <= tol:
+                    rnorm = r_new
+                    reason = _conv(r_new)
+                    break
+                # stagnation guard: the inner precision can't represent
+                # corrections below ~eps of the iterate; if the residual
+                # stops improving, stop.
+                if r_new >= 0.9 * rnorm:
+                    rnorm = r_new
+                    reason = ConvergedReason.DIVERGED_BREAKDOWN
+                    break
                 rnorm = r_new
-                reason = (ConvergedReason.CONVERGED_RTOL if r_new <= tol
-                          else ConvergedReason.DIVERGED_BREAKDOWN)
-                break
         wall = time.perf_counter() - t0
-        # observability for the bench artifact (cfg6): how many fp64 outer
-        # corrections the inner-iteration total splits across
+        # observability for the bench artifact (cfg6/cfg11): how many fp64
+        # outer corrections the inner-iteration total splits across
         self.refine_steps = it
         self.result = SolveResult(total_inner, float(rnorm), int(reason),
                                   wall)
         return x, self.result
+
+    def solve_many(self, B: np.ndarray) -> tuple[np.ndarray, SolveResult]:
+        """Block refinement: solve ``A X = B`` for an fp64 ``(n, nrhs)``
+        block. Each outer step computes the whole block's exact fp64
+        residual and dispatches ONE low-precision ``KSP.solve_many``
+        correction launch (the PR-4/PR-6 batched CG kernels — collective
+        count independent of nrhs, all columns riding the inner precision
+        plan). Columns that already meet tolerance contribute zero
+        residual and freeze instantly under the masked batched kernel.
+        Returns ``(X, result)`` with aggregate inner-iteration count and
+        the worst column's final residual norm."""
+        A = self._A_host
+        if A is None:
+            raise RuntimeError("RefinedKSP.solve_many: no operators set")
+        B = np.asarray(B, dtype=np.float64)
+        if B.ndim != 2:
+            raise ValueError(f"solve_many needs an (n, nrhs) block, got "
+                             f"{B.shape}")
+        bnorm = np.linalg.norm(B, axis=0)
+        tol = np.maximum(self.rtol * bnorm, self.atol)
+        X = np.zeros_like(B)
+        self.inner.set_tolerances(rtol=self._effective_inner_rtol(),
+                                  max_it=20000)
+        self._arm_inner_guards()
+        op_dt = np.dtype(self._inner_op.dtype)
+
+        t0 = time.perf_counter()
+        total_inner = 0
+        # one fp64 block residual per outer step (see solve): it decides
+        # convergence/stagnation and feeds the next correction block
+        R = B - A @ X
+        rnorm = np.linalg.norm(R, axis=0)
+        reason = ConvergedReason.DIVERGED_MAX_IT
+        it = 0
+        if np.all(rnorm <= tol):
+            reason = ConvergedReason.CONVERGED_RTOL
+        else:
+            for it in range(1, self.max_refine + 1):
+                res = self.inner.solve_many(R.astype(op_dt))
+                total_inner += int(max(res.iterations, default=0))
+                X = X + np.asarray(res.X, dtype=np.float64)
+                R = B - A @ X
+                r_new = np.linalg.norm(R, axis=0)
+                if np.all(r_new <= tol):   # post-correction check: a
+                    rnorm = r_new          # last-step landing CONVERGES
+                    reason = ConvergedReason.CONVERGED_RTOL
+                    break
+                if np.all(r_new >= 0.9 * np.maximum(rnorm, 1e-300)):
+                    rnorm = r_new
+                    reason = ConvergedReason.DIVERGED_BREAKDOWN
+                    break
+                rnorm = r_new
+        wall = time.perf_counter() - t0
+        self.refine_steps = it
+        self.result = SolveResult(total_inner, float(rnorm.max(initial=0.0)),
+                                  int(reason), wall)
+        return X, self.result
+
+    # ---- legacy spelling ---------------------------------------------------
+    @property
+    def _mat32(self):
+        """The inner Mat (historical name from the fp32-only scheme)."""
+        return self._mat_lp

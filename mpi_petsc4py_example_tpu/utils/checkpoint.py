@@ -80,6 +80,26 @@ def _open_npz(path, want_kind: str):
         z.close()
 
 
+def _revive(arr, dtype):
+    """Rebind an array loaded from ``.npz`` to its recorded dtype.
+
+    numpy serializes ml_dtypes storage (bfloat16) as raw void bytes
+    (``|V2``) — the dtype identity survives only through the checkpoint's
+    own ``dtype`` field, so low-precision payloads are VIEWED back into
+    their recorded type (same itemsize, zero copy). Machine-float arrays
+    pass through the usual cast. This is what makes a checkpoint written
+    mid-bf16-solve restore with the inner dtype intact (the
+    mixed-precision round-trip contract, tests/test_mixed_precision.py).
+    """
+    dtype = np.dtype(dtype)
+    if arr.dtype.kind == "V":
+        _check(arr.dtype.itemsize == dtype.itemsize, "<payload>",
+               f"raw payload width {arr.dtype.itemsize} does not match "
+               f"recorded dtype {dtype}")
+        return arr.view(dtype)
+    return arr.astype(dtype, copy=False)
+
+
 def _checked_dtype(z, path) -> np.dtype:
     _check("dtype" in z.files, path, "missing 'dtype'")
     name = str(z["dtype"])
@@ -112,7 +132,9 @@ def _checked_csr(z, path):
 
 
 def save_vec(path: str, vec: Vec):
-    _atomic_savez(path, kind="vec", n=vec.n, data=vec.to_numpy())
+    data = vec.to_numpy()
+    _atomic_savez(path, kind="vec", n=vec.n, data=data,
+                  dtype=str(np.dtype(data.dtype)))
 
 
 def load_vec(path: str, comm=None) -> Vec:
@@ -120,8 +142,12 @@ def load_vec(path: str, comm=None) -> Vec:
     with _open_npz(path, "vec") as z:
         _check("data" in z.files and "n" in z.files, path, "missing data/n")
         data = z["data"]
+        if "dtype" in z.files:      # absent in pre-PR-10 checkpoints
+            data = _revive(data, _checked_dtype(z, path))
         _check(data.ndim == 1 and data.shape[0] == int(z["n"]), path,
                f"vector length {data.shape} does not match n={int(z['n'])}")
+        # from_global preserves the (possibly revived) payload dtype;
+        # passing dtype= explicitly would force a redundant full copy
         return Vec.from_global(comm, data)
 
 
@@ -137,8 +163,10 @@ def load_mat(path: str, comm=None) -> Mat:
     comm = as_comm(comm)
     with _open_npz(path, "mat") as z:
         dtype = _checked_dtype(z, path)
-        shape, csr = _checked_csr(z, path)
-        return Mat.from_csr(comm, shape, csr, dtype=dtype)
+        shape, (indptr, indices, data) = _checked_csr(z, path)
+        return Mat.from_csr(comm, shape,
+                            (indptr, indices, _revive(data, dtype)),
+                            dtype=dtype)
 
 
 def save_solve_state_many(path: str, mat: Mat, X, B, iteration: int = 0):
@@ -174,9 +202,12 @@ def load_solve_state_many(path: str, comm=None):
                f"iterate block {Xh.shape} does not match n={shape[0]}")
         _check(Bh.shape == Xh.shape, path,
                f"rhs block {Bh.shape} does not match iterate {Xh.shape}")
-        mat = Mat.from_csr(comm, shape, csr, dtype=dtype)
-        return (mat, Xh.astype(dtype, copy=False),
-                Bh.astype(dtype, copy=False), int(z["iteration"]))
+        indptr, indices, data = csr
+        mat = Mat.from_csr(comm, shape,
+                           (indptr, indices, _revive(data, dtype)),
+                           dtype=dtype)
+        return (mat, _revive(Xh, dtype), _revive(Bh, dtype),
+                int(z["iteration"]))
 
 
 def save_solve_state(path: str, mat: Mat, x: Vec, b: Vec, iteration: int = 0):
@@ -202,7 +233,10 @@ def load_solve_state(path: str, comm=None):
                f"iterate length {xh.shape} does not match n={shape[0]}")
         _check(bh.ndim == 1 and bh.shape[0] == shape[0], path,
                f"rhs length {bh.shape} does not match n={shape[0]}")
-        mat = Mat.from_csr(comm, shape, csr, dtype=dtype)
-        x = Vec.from_global(comm, xh, dtype=mat.dtype)
-        b = Vec.from_global(comm, bh, dtype=mat.dtype)
+        indptr, indices, data = csr
+        mat = Mat.from_csr(comm, shape,
+                           (indptr, indices, _revive(data, dtype)),
+                           dtype=dtype)
+        x = Vec.from_global(comm, _revive(xh, dtype), dtype=mat.dtype)
+        b = Vec.from_global(comm, _revive(bh, dtype), dtype=mat.dtype)
         return mat, x, b, int(z["iteration"])

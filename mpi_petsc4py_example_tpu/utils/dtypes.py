@@ -1,4 +1,14 @@
-"""Small dtype predicates shared across solver/object modules."""
+"""Small dtype predicates shared across solver/object modules.
+
+PR 10 adds the mixed-precision vocabulary: a solve has a STORAGE dtype
+(the operator/PC/iterate channel — what the all-gathers, halo ppermutes
+and AXPY traffic move) and a REDUCE dtype (the dot-product/norm/ABFT
+accumulation channel). For fp32/fp64/complex operators the two coincide
+and nothing changes; for sub-32-bit storage (bfloat16 — the TPU-native
+low-precision regime) the reduce channel is promoted to fp32, the
+"reduction channel in higher precision than the operator channel"
+discipline of the pipelined-Krylov literature (PAPERS.md).
+"""
 
 from __future__ import annotations
 
@@ -16,3 +26,63 @@ def host_dtype(dtype):
     float64 otherwise — the dtype host-side projected problems, fetches,
     and factorizations run in."""
     return np.complex128 if is_complex(dtype) else np.float64
+
+
+#: the ``-ksp_inner_precision`` spellings (solvers/refine.RefinedKSP) and
+#: their storage dtypes. bf16 resolves through jax's ml_dtypes (numpy has
+#: no native bfloat16); import is deferred so this module stays cheap for
+#: host-only consumers.
+def inner_precision_dtype(name: str):
+    """Map a ``-ksp_inner_precision`` spelling to a storage dtype."""
+    key = str(name).lower()
+    if key in ("bf16", "bfloat16"):
+        import jax.numpy as jnp
+        return np.dtype(jnp.bfloat16)
+    if key in ("f32", "fp32", "float32", "single"):
+        return np.dtype(np.float32)
+    if key in ("f64", "fp64", "float64", "double"):
+        return np.dtype(np.float64)
+    raise ValueError(
+        f"unknown inner precision {name!r}; choose from bf16/f32/f64")
+
+
+def is_low_precision(dtype) -> bool:
+    """Sub-32-bit float storage (bfloat16/float16): the precisions whose
+    reductions must accumulate in a wider dtype."""
+    dt = np.dtype(dtype)
+    return dt.itemsize < 4 and not np.issubdtype(dt, np.integer)
+
+
+def reduce_dtype(storage):
+    """The accumulation dtype of the reduction channel for a given
+    storage dtype: fp32 for sub-32-bit storage, the storage dtype itself
+    otherwise (fp32/fp64/complex solves keep today's behavior — their
+    compiled programs are bit-identical to the pre-plan ones)."""
+    dt = np.dtype(storage)
+    if is_low_precision(dt):
+        return np.dtype(np.float32)
+    return dt
+
+
+def tolerance_dtype(storage):
+    """The REAL scalar dtype solve tolerances/norms travel in: the real
+    counterpart of the reduce dtype (complex operators monitor real
+    norms; bf16 storage monitors fp32 norms)."""
+    rdt = reduce_dtype(storage)
+    return np.dtype(rdt.type(0).real.dtype)
+
+
+def real_eps(dtype) -> float:
+    """Machine epsilon of the REAL scalar of ``dtype``.
+
+    ``np.finfo`` rejects the ml_dtypes bfloat16 (not a native inexact
+    type); ``ml_dtypes.finfo`` covers both families, so route through it
+    when available."""
+    dt = np.dtype(dtype)
+    if is_complex(dt):
+        dt = np.dtype(dt.type(0).real.dtype)
+    try:
+        return float(np.finfo(dt).eps)
+    except ValueError:
+        import ml_dtypes
+        return float(ml_dtypes.finfo(dt).eps)

@@ -38,6 +38,10 @@ KNOWN_FLAGS = {
     "ksp_converged_reason": "print the converged reason after each solve",
     "ksp_divtol": "divergence tolerance (DIVERGED_DTOL trigger)",
     "ksp_gmres_restart": "restart length for gmres/fgmres/gcr/fcg/lgmres",
+    "ksp_inner_precision": "RefinedKSP inner storage precision "
+                           "(bf16/f32/f64): the operator/PC/iterate "
+                           "channel of the inner Krylov under fp64 "
+                           "outer refinement",
     "ksp_lgmres_augment": "LGMRES augmentation subspace size",
     "ksp_max_it": "maximum iterations",
     "ksp_monitor": "print the residual norm each iteration",
@@ -48,6 +52,9 @@ KNOWN_FLAGS = {
                                      "-ksp_residual_replacement is unset "
                                      "(bounds the pipelined recurrences' "
                                      "drift; 0 = off)",
+    "ksp_refine_inner_rtol": "RefinedKSP per-correction inner solve "
+                             "target (floored at a few storage epsilons)",
+    "ksp_refine_max": "RefinedKSP outer refinement step cap",
     "ksp_residual_replacement": "recompute/replace the true residual every "
                                 "N iterations with a drift gate (silent-"
                                 "corruption monitor; 0 = off)",

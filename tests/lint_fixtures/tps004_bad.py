@@ -29,3 +29,16 @@ def cast(x):
 @jax.jit
 def positional(x):
     return jnp.zeros(x.shape, jnp.float64)  # BAD: TPS004
+
+
+def precision_plan(storage, reduce=None):
+    return (storage, reduce)
+
+
+@jax.jit
+def drift_next_to_plan(x):
+    # a plan declaration does NOT whitewash the function: an unmediated
+    # wide cast beside it is still accidental drift
+    plan = precision_plan(jnp.bfloat16)
+    del plan
+    return x.astype(jnp.float64)  # BAD: TPS004

@@ -24,3 +24,25 @@ def recast(x, y):
 
 def host_setup(vals):
     return np.asarray(vals, dtype=np.float64)  # host path: fine
+
+
+def precision_plan(storage, reduce=None):
+    """Stand-in for solvers/cg_plans.precision_plan."""
+    return (storage, reduce)
+
+
+@jax.jit
+def plan_mediated(x):
+    # an INTENTIONAL precision-plan declaration: the wide dtype is the
+    # plan's reduce channel, threaded to cast sites via the plan object —
+    # never flagged (tps004 _PLAN_FUNCS)
+    plan = precision_plan(jnp.bfloat16, jnp.float64)
+    lo, hi = plan
+    return x.astype(lo).astype(x.dtype) + jnp.zeros((), dtype=hi).astype(
+        x.dtype)
+
+
+@jax.jit
+def plan_attr_cast(x, prec):
+    # casts threaded FROM a plan attribute carry no literal — fine
+    return x.astype(prec.reduce).astype(prec.storage)

@@ -47,6 +47,38 @@ def all_gather_volumes(stablehlo_text: str):
     return out
 
 
+#: StableHLO element-type -> bytes (the widths the byte gates price)
+_ELT_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+              "c64": 8, "c128": 16, "i32": 4, "i64": 8}
+
+
+def _collective_bytes(stablehlo_text: str, op_name: str):
+    """Per-site BYTE volume of every ``op_name`` collective in the
+    lowered module — the mixed-precision gates pin bytes, not element
+    counts: a bf16 program that gathered at full f32 width would pass an
+    element-count gate while silently forfeiting the entire bandwidth
+    win."""
+    out = []
+    for line in stablehlo_text.splitlines():
+        if op_name not in line:
+            continue
+        shapes = re.findall(r"tensor<([0-9x]+)x([a-z][a-z0-9]*)>", line)
+        assert shapes, f"unparseable {op_name} line: {line}"
+        dims, elt = shapes[-1]
+        assert elt in _ELT_BYTES, f"unknown element type {elt!r}: {line}"
+        out.append(int(np.prod([int(d) for d in dims.split("x")]))
+                   * _ELT_BYTES[elt])
+    return out
+
+
+def all_gather_bytes(stablehlo_text: str):
+    return _collective_bytes(stablehlo_text, "all_gather")
+
+
+def collective_permute_bytes(stablehlo_text: str):
+    return _collective_bytes(stablehlo_text, "collective_permute")
+
+
 def _ell_matrix(n: int):
     """Random sparsity — enough distinct diagonals that the DIA layout is
     rejected and the general ELL all_gather path is kept."""
@@ -557,3 +589,188 @@ def test_injected_regression_fails_the_gate(comm8):
     assert any(v > n_pad for v in vols), (vols, n_pad)
     with pytest.raises(AssertionError):
         assert all(v == n_pad for v in vols)
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision byte budgets (ISSUE 10): the low-precision programs must
+# ship HALF the gather/halo bytes of their f32 twins — pinned on the
+# lowered StableHLO, so the bandwidth win is enforced, not assumed
+# ---------------------------------------------------------------------------
+
+
+def _lower_cg_dtype(comm, A_scipy, dtype):
+    from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
+    M = tps.Mat.from_scipy(comm, A_scipy, dtype=dtype)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_up()
+    pc = ksp.get_pc()
+    prog = build_ksp_program(comm, "cg", pc, M)
+    x, b = M.get_vecs()
+    dt = tolerance_dtype(M.dtype)
+    return M, prog.lower(
+        M.device_arrays(), pc.device_arrays(), b.data, x.data,
+        dt.type(1e-2), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+
+
+class _FullWidthGatherEll:
+    """A Mat shim whose local SpMV upcasts the input vector to f32
+    BEFORE the all_gather — the injected full-width regression: the
+    element count is unchanged, the BYTES are back to full width, and
+    the entire low-precision bandwidth win silently evaporates. Exactly
+    what the byte gate (not an element-count gate) must catch."""
+
+    def __init__(self, M):
+        self._M = M
+        self.shape = M.shape
+        self.dtype = M.dtype
+        self.layout = M.layout
+        self.comm = M.comm
+
+    def device_arrays(self):
+        return self._M.device_arrays()
+
+    def op_specs(self, axis):
+        return self._M.op_specs(axis)
+
+    def program_key(self):
+        return ("ell-full-width-gather-regression",)
+
+    def get_vecs(self):
+        return self._M.get_vecs()
+
+    def local_spmv(self, comm):
+        from mpi_petsc4py_example_tpu.ops.spmv import ell_spmv_local
+        axis = comm.axis
+
+        def spmv(op_arrays, x_local):
+            cols, vals = op_arrays
+            x_full = jax.lax.all_gather(
+                x_local.astype(jnp.float32), axis, tiled=True)
+            return ell_spmv_local(
+                cols, vals.astype(jnp.float32),
+                x_full).astype(x_local.dtype)
+
+        return spmv
+
+
+class TestMixedPrecisionVolume:
+    """ISSUE 10 acceptance: halved all-gather/halo byte budgets for the
+    low-precision programs, pinned on lowered HLO; the reduce-site
+    schedules (3/2/1) survive every precision plan unchanged."""
+
+    def test_bf16_ell_gather_bytes_halved(self, comm8):
+        n = 512
+        A = _ell_matrix(n)
+        n_pad = comm8.padded_size(n)
+        _, txt32 = _lower_cg_dtype(comm8, A, jnp.float32)
+        _, txt16 = _lower_cg_dtype(comm8, A, jnp.bfloat16)
+        by32 = all_gather_bytes(txt32)
+        by16 = all_gather_bytes(txt16)
+        # same gather SITES, exactly half the bytes at each
+        assert len(by16) == len(by32), (by16, by32)
+        assert by32 and all(v == n_pad * 4 for v in by32), by32
+        assert all(v == n_pad * 2 for v in by16), by16
+
+    def test_bf16_dia_halo_bytes_halved(self, comm8):
+        """Banded operators: the open-chain ppermute halo ships bf16
+        boundary rows — half the f32 bytes, still zero all-gathers."""
+        A = tridiag_family(512)
+        _, txt32 = _lower_cg_dtype(comm8, A, jnp.float32)
+        _, txt16 = _lower_cg_dtype(comm8, A, jnp.bfloat16)
+        assert all_gather_bytes(txt16) == []
+        p32 = collective_permute_bytes(txt32)
+        p16 = collective_permute_bytes(txt16)
+        assert len(p16) == len(p32) and p32, (p16, p32)
+        assert sum(p16) * 2 == sum(p32), (p16, p32)
+
+    def test_bf16_stencil_halo_bytes_halved(self, comm8):
+        """The matrix-free stencil's z-plane halo exchange moves
+        storage-dtype planes."""
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
+
+        def lower(dtype):
+            op = StencilPoisson3D(comm8, 16, 16, 16, dtype=dtype)
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(op)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type("jacobi")
+            ksp.set_up()
+            pc = ksp.get_pc()
+            prog = build_ksp_program(comm8, "cg", pc, op)
+            x, b = op.get_vecs()
+            dt = tolerance_dtype(op.dtype)
+            return prog.lower(
+                op.device_arrays(), pc.device_arrays(), b.data, x.data,
+                dt.type(1e-2), dt.type(0.0), dt.type(0.0),
+                np.int32(50)).as_text()
+
+        p32 = collective_permute_bytes(lower(jnp.float32))
+        p16 = collective_permute_bytes(lower(jnp.bfloat16))
+        assert len(p16) == len(p32) and p32, (p16, p32)
+        assert sum(p16) * 2 == sum(p32), (p16, p32)
+
+    def test_bf16_batched_gather_bytes_halved(self, comm8, monkeypatch):
+        """The k=8 block program keeps the batched contract (gather op
+        count independent of k) AND the halved per-byte width."""
+        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+        from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
+        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+        krylov_mod._PROGRAM_CACHE_MANY.clear()
+        n, k = 512, 8
+        A = _ell_matrix(n)
+        n_pad = comm8.padded_size(n)
+
+        def lower_many(dtype):
+            M = tps.Mat.from_scipy(comm8, A, dtype=dtype)
+            ksp = tps.KSP().create(comm8)
+            ksp.set_operators(M)
+            ksp.set_type("cg")
+            ksp.get_pc().set_type("jacobi")
+            ksp.set_up()
+            pc = ksp.get_pc()
+            prog = build_ksp_program_many(comm8, "cg", pc, M, nrhs=k)
+            Bp = comm8.put_rows(np.zeros((n, k), np.dtype(dtype)))
+            X0 = comm8.put_rows(np.zeros((n, k), np.dtype(dtype)))
+            dt = tolerance_dtype(M.dtype)
+            return prog.lower(
+                M.device_arrays(), pc.device_arrays(), Bp, X0,
+                dt.type(1e-2), dt.type(0.0), dt.type(0.0),
+                np.int32(50)).as_text()
+
+        by32 = all_gather_bytes(lower_many(jnp.float32))
+        by16 = all_gather_bytes(lower_many(jnp.bfloat16))
+        assert len(by16) == len(by32) and by32, (by16, by32)
+        assert all(v == n_pad * k * 2 for v in by16), by16
+
+    def test_reduce_site_schedules_survive_the_plan(self, comm8):
+        """Zero new psum sites under the bf16 plan: plain CG keeps 3,
+        guarded CG keeps 2, pipecg (plain AND guarded) keeps 1 — the
+        pinned 3/2/1 schedules of ISSUE 5/7, re-pinned per precision."""
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        A = _ell_matrix(512)
+        M16 = tps.Mat.from_scipy(comm8, A, dtype=jnp.bfloat16)
+        assert solver_loop_reduce_sites(
+            _lower_cg_jacobi(comm8, M16)) == 3
+        assert solver_loop_reduce_sites(
+            _lower_cg_guard(comm8, M16, rr=True)) == 2
+        assert solver_loop_reduce_sites(_lower_pipecg(comm8, M16)) == 1
+        assert solver_loop_reduce_sites(
+            _lower_pipecg(comm8, M16, guard=True, rr=True)) == 1
+
+    def test_injected_full_width_regression_fails_gate(self, comm8):
+        """Teeth: an upcast-before-gather regression keeps the element
+        count but doubles the bytes — the byte gate must fail on it."""
+        n = 512
+        M16 = tps.Mat.from_scipy(comm8, _ell_matrix(n),
+                                 dtype=jnp.bfloat16)
+        txt = _lower_cg(comm8, _FullWidthGatherEll(M16))
+        by = all_gather_bytes(txt)
+        n_pad = comm8.padded_size(n)
+        assert by and any(v > n_pad * 2 for v in by), by
+        with pytest.raises(AssertionError):
+            assert all(v == n_pad * 2 for v in by)

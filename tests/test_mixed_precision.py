@@ -1,0 +1,396 @@
+"""Mixed-precision compute plans (PR 10): bf16/f32 storage under fp64
+iterative refinement.
+
+The acceptance contract (ISSUE 10): every storage precision × solver
+variant × layout combination reaches fp64 accuracy (rtol 1e-10) THROUGH
+refinement — the precision plan changes bytes per iterate, never the
+answer; the ABFT guard still catches real corruption in the low-precision
+channel without false-firing on benign storage rounding (threshold scaled
+to the STORAGE epsilon); and checkpoints round-trip the inner dtype.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import StencilPoisson3D, poisson3d_csr
+from mpi_petsc4py_example_tpu.solvers.cg_plans import precision_plan
+from mpi_petsc4py_example_tpu.solvers.refine import RefinedKSP
+from mpi_petsc4py_example_tpu.utils.dtypes import (inner_precision_dtype,
+                                                   reduce_dtype)
+from mpi_petsc4py_example_tpu.utils.errors import SilentCorruptionError
+
+RTOL = 1e-10
+PRECS = ["bf16", "f32"]
+
+
+def _ell_matrix(n=128, seed=5):
+    """Random sparsity (too many occupied diagonals for DIA) with a
+    dominant diagonal — well-conditioned, so even bf16 storage rounding
+    of the operator leaves the refinement iteration contractive."""
+    rng = np.random.default_rng(seed)
+    A = sp.random(n, n, density=0.05, random_state=rng, format="csr")
+    A = A + A.T + sp.eye(n, format="csr") * 8.0
+    return A.tocsr()
+
+
+def _banded_matrix(n=128):
+    """Constant-coefficient SPD tridiagonal: the DIA layout (open-chain
+    ppermute halo), condition number bounded by diagonal dominance."""
+    return sp.diags([-1.0, 4.0, -1.0], [-1, 0, 1], shape=(n, n)).tocsr()
+
+
+def _rel(A, x, b):
+    b64 = np.asarray(b, dtype=np.float64)
+    return float(np.linalg.norm(b64 - A @ np.asarray(x, np.float64))
+                 / np.linalg.norm(b64))
+
+
+def _refined(comm, A, precision, ksp_type="cg", pc_type="jacobi",
+             guard=False, inner_op=None):
+    rk = RefinedKSP().create(comm)
+    rk.set_inner_precision(precision)
+    rk.set_operators(A, inner_op=inner_op)
+    rk.set_type(ksp_type)
+    rk.get_pc().set_type(pc_type)
+    rk.set_tolerances(rtol=RTOL)
+    if guard:
+        rk.inner.abft = True
+        rk.inner.residual_replacement = 8
+    return rk
+
+
+# --------------------------------------------------------------- the plan
+class TestPrecisionPlan:
+    def test_uniform_plans_are_identity(self):
+        for dt in (np.float32, np.float64, np.complex128):
+            p = precision_plan(dt)
+            assert not p.mixed
+            assert p.reduce == np.dtype(dt)
+
+    def test_bf16_plan_reduces_in_f32(self):
+        p = precision_plan(jnp.bfloat16)
+        assert p.mixed
+        assert p.storage == np.dtype(jnp.bfloat16)
+        assert p.reduce == np.dtype(np.float32)
+        assert reduce_dtype(jnp.bfloat16) == np.dtype(np.float32)
+
+    def test_store_and_up_cast(self):
+        p = precision_plan(jnp.bfloat16)
+        v = jnp.ones(4, jnp.float32)
+        assert p.store(v).dtype == jnp.bfloat16
+        assert p.up(p.store(v)).dtype == jnp.float32
+
+    def test_unknown_spelling_raises(self):
+        with pytest.raises(ValueError):
+            inner_precision_dtype("fp8")
+
+    def test_mixed_non_cg_type_raises(self, comm8):
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(64), dtype=jnp.bfloat16)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("gmres")
+        x, b = M.get_vecs()
+        with pytest.raises(ValueError, match="mixed-precision CG plans"):
+            ksp.solve(b, x)
+
+
+# ------------------------------------------------- fp64 parity via refine
+class TestRefinedParity:
+    @pytest.mark.parametrize("precision", PRECS)
+    @pytest.mark.parametrize("fmt", ["ell", "dia"])
+    @pytest.mark.parametrize("ksp_type", ["cg", "pipecg"])
+    def test_layouts_reach_fp64(self, comm8, fmt, ksp_type, precision):
+        A = _ell_matrix() if fmt == "ell" else _banded_matrix()
+        rk = _refined(comm8, A, precision, ksp_type=ksp_type)
+        # the inner operator really is the low-precision layout asked for
+        assert np.dtype(rk._inner_op.dtype) == inner_precision_dtype(
+            precision)
+        if fmt == "dia":
+            assert rk._inner_op.dia_vals is not None
+        else:
+            assert rk._inner_op.dia_vals is None
+        b = A @ np.random.default_rng(1).random(A.shape[0])
+        x, res = rk.solve(b)
+        assert res.converged, (fmt, ksp_type, precision, res)
+        assert _rel(A, x, b) <= RTOL * 1.05
+
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_guarded_inner_reaches_fp64(self, comm8, precision):
+        """The ABFT+replacement guard rides the low-precision inner solve
+        with zero false positives (threshold scaled to storage eps)."""
+        A = _ell_matrix()
+        rk = _refined(comm8, A, precision, guard=True)
+        b = A @ np.random.default_rng(2).random(A.shape[0])
+        x, res = rk.solve(b)
+        assert res.converged, (precision, res)
+        assert _rel(A, x, b) <= RTOL * 1.05
+
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_solve_many_reaches_fp64(self, comm8, precision):
+        """Block refinement: one batched low-precision correction launch
+        per outer step, per-column fp64 parity."""
+        A = _ell_matrix()
+        k = 4
+        rk = _refined(comm8, A, precision)
+        B = np.asarray(A @ np.random.default_rng(3).random((A.shape[0], k)))
+        X, res = rk.solve_many(B)
+        assert res.converged, (precision, res)
+        for j in range(k):
+            assert _rel(A, X[:, j], B[:, j]) <= RTOL * 1.05, (precision, j)
+
+    @pytest.mark.parametrize("ndev", [1, 4, 8])
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_stencil_device_counts(self, ndev, precision):
+        """Matrix-free stencil inner operator (``inner_op``) at 1/4/8
+        devices: the z-slab halo ppermutes move storage-dtype planes."""
+        comm = tps.DeviceComm(n_devices=ndev)
+        nx = 8
+        A = poisson3d_csr(nx)
+        op = StencilPoisson3D(comm, nx, nx, nx,
+                              dtype=inner_precision_dtype(precision))
+        rk = _refined(comm, A, precision, inner_op=op)
+        b = A @ np.random.default_rng(4).random(nx ** 3)
+        x, res = rk.solve(b)
+        assert res.converged, (ndev, precision, res)
+        assert _rel(A, x, b) <= RTOL * 1.05
+
+    def test_f64_inner_is_direct(self, comm8):
+        """-ksp_inner_precision f64: the inner solve already meets the
+        target, so refinement settles in very few outer steps."""
+        A = _banded_matrix()
+        rk = _refined(comm8, A, "f64")
+        rk.set_tolerances(inner_rtol=1e-11)
+        b = A @ np.ones(A.shape[0])
+        x, res = rk.solve(b)
+        assert res.converged
+        assert rk.refine_steps <= 3
+        assert _rel(A, x, b) <= RTOL * 1.05
+
+
+# ------------------------------------------------------------- options DB
+class TestInnerPrecisionOptions:
+    def test_flags_apply(self, comm8):
+        opt = tps.global_options()
+        opt.set("ksp_inner_precision", "bf16")
+        opt.set("ksp_refine_max", 30)
+        opt.set("ksp_refine_inner_rtol", 1e-2)
+        rk = RefinedKSP().create(comm8)
+        rk.set_from_options()
+        assert rk.inner_precision == "bf16"
+        assert rk.max_refine == 30
+        assert rk.inner_rtol == 1e-2
+        A = _banded_matrix(64)
+        rk.set_operators(A)
+        assert np.dtype(rk._inner_op.dtype) == np.dtype(jnp.bfloat16)
+
+    def test_inner_rtol_floored_at_storage_eps(self, comm8):
+        rk = RefinedKSP().create(comm8)
+        rk.set_inner_precision("bf16")
+        rk.set_tolerances(inner_rtol=1e-12)
+        # a bf16 inner solve cannot resolve 1e-12; the effective target
+        # is floored at a few storage epsilons
+        assert rk._effective_inner_rtol() >= 0.01
+
+
+# ------------------------------------------------------- ABFT on bf16/f32
+class TestAbftLowPrecision:
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_bitflip_detected_in_low_precision_channel(self, comm8,
+                                                       precision):
+        """A real bitflip in the low-precision operator apply is VASTLY
+        above the storage-eps-scaled threshold — detection must fire."""
+        A = _ell_matrix()
+        dt = inner_precision_dtype(precision)
+        M = tps.Mat.from_scipy(comm8, A, dtype=dt)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-1, max_it=200)
+        ksp.abft = True
+        ksp.residual_replacement = 4
+        # at bf16 eps the default 256x multiplier leaves a ~2x-of-scale
+        # threshold; a handful of storage epsilons is the right bf16
+        # calibration (runtime scalar — no recompile)
+        ksp.abft_tol = 16.0
+        x, bv = M.get_vecs()
+        bv.set_global((A @ np.ones(A.shape[0])).astype(dt))
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+        # an exponent flip that SHRINKS the element evades the checksum
+        # magnitude test but not the invariant monitors — the guard
+        # contract is detection, whichever channel fires first
+        assert ei.value.detector in ("abft", "monotonic", "drift", "nan")
+
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_scale_corruption_fires_abft_channel(self, comm8, precision):
+        """A mis-scaled low-precision apply breaks the checksum identity
+        itself — the ABFT channel must be the detector (positive-entry
+        operator and RHS, so the corruption moves the sum)."""
+        A = _banded_matrix()
+        dt = inner_precision_dtype(precision)
+        # shift to strictly positive entries: Σ(Ap) tracks Σ|Ap|
+        A = (A + sp.eye(A.shape[0], format="csr") * 0.0).tocsr()
+        A.data = np.abs(A.data)
+        M = tps.Mat.from_scipy(comm8, A, dtype=dt)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-1, max_it=200)
+        ksp.abft = True
+        ksp.abft_tol = 16.0 if precision == "bf16" else 256.0
+        x, bv = M.get_vecs()
+        bv.set_global((A @ np.ones(A.shape[0])).astype(dt))
+        with tps.inject_faults("spmv.result=scale:mag=1e3:at=2:times=1"):
+            with pytest.raises(SilentCorruptionError) as ei:
+                ksp.solve(bv, x)
+        assert ei.value.detector == "abft"
+
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_clean_solve_no_false_positive(self, comm8, precision):
+        """Benign storage rounding must NOT trip the checksum (the
+        threshold scales with the storage epsilon, not the f32
+        accumulator's)."""
+        A = _ell_matrix()
+        dt = inner_precision_dtype(precision)
+        M = tps.Mat.from_scipy(comm8, A, dtype=dt)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        # a reachable target for the storage precision
+        ksp.set_tolerances(rtol=0.05 if precision == "bf16" else 1e-4,
+                           max_it=500)
+        ksp.abft = True
+        x, bv = M.get_vecs()
+        bv.set_global((A @ np.ones(A.shape[0])).astype(dt))
+        res = ksp.solve(bv, x)      # raises SilentCorruptionError on a
+        assert res.converged, res   # false positive
+
+
+# --------------------------------------------------- checkpoint round-trip
+class TestCheckpointInnerDtype:
+    @pytest.mark.parametrize("precision", PRECS)
+    def test_mat_roundtrip_preserves_dtype(self, comm8, tmp_path,
+                                           precision):
+        from mpi_petsc4py_example_tpu.utils import checkpoint as cp
+        dt = inner_precision_dtype(precision)
+        M = tps.Mat.from_scipy(comm8, _banded_matrix(64), dtype=dt)
+        p = str(tmp_path / "m.npz")
+        cp.save_mat(p, M)
+        M2 = cp.load_mat(p, comm8)
+        assert np.dtype(M2.dtype) == dt
+        S1, S2 = M.to_scipy(), M2.to_scipy()
+        # scipy cannot densify ml_dtypes payloads — compare the CSR
+        # triples (bit-exact round trip, including the bf16 values)
+        np.testing.assert_array_equal(S1.indptr, S2.indptr)
+        np.testing.assert_array_equal(S1.indices, S2.indices)
+        np.testing.assert_array_equal(np.asarray(S1.data, np.float64),
+                                      np.asarray(S2.data, np.float64))
+
+    def test_solve_state_roundtrip_bf16(self, comm8, tmp_path):
+        from mpi_petsc4py_example_tpu.utils import checkpoint as cp
+        dt = np.dtype(jnp.bfloat16)
+        M = tps.Mat.from_scipy(comm8, _banded_matrix(64), dtype=dt)
+        x, b = M.get_vecs()
+        b.set_global(np.arange(64, dtype=np.float64).astype(dt))
+        p = str(tmp_path / "s.npz")
+        cp.save_solve_state(p, M, x, b, iteration=7)
+        M2, x2, b2, it = cp.load_solve_state(p, comm8)
+        assert it == 7
+        assert np.dtype(M2.dtype) == dt
+        assert b2.to_numpy().dtype == dt
+        np.testing.assert_array_equal(b2.to_numpy(), b.to_numpy())
+
+    def test_vec_roundtrip_bf16(self, comm8, tmp_path):
+        from mpi_petsc4py_example_tpu.utils import checkpoint as cp
+        dt = np.dtype(jnp.bfloat16)
+        v = tps.Vec.from_global(comm8, np.linspace(0, 1, 48), dtype=dt)
+        p = str(tmp_path / "v.npz")
+        cp.save_vec(p, v)
+        v2 = cp.load_vec(p, comm8)
+        assert v2.to_numpy().dtype == dt
+        np.testing.assert_array_equal(v2.to_numpy(), v.to_numpy())
+
+
+# ------------------------------------------------ bf16 Pallas pipeline
+class TestPallasBf16Storage:
+    """The bf16-storage wide-DMA stencil pipeline, pinned OFF-TPU via
+    the Pallas interpreter (the CI discipline of tests/test_pallas.py):
+    storage stays bf16 (the DMA'd bytes), arithmetic runs f32 in VREGs,
+    and the fused <u, Au> dot rides the f32 reduce channel."""
+
+    def _slab(self, lz=8, ny=16, nx=128, seed=7):
+        rng = np.random.default_rng(seed)
+        dt = np.dtype(jnp.bfloat16)
+        u = rng.random((lz, ny, nx)).astype(dt)
+        halo = np.zeros((1, ny, nx), dt)
+        return u, halo
+
+    def test_apply_matches_jnp_reference(self):
+        from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+            stencil3d_apply_pallas)
+        u, halo = self._slab()
+        y = stencil3d_apply_pallas(jnp.asarray(u), jnp.asarray(halo),
+                                   jnp.asarray(halo), 8, 16, 128, True)
+        assert y.dtype == jnp.bfloat16
+        ref = StencilPoisson3D._stencil7_jnp(
+            jnp.asarray(u), jnp.asarray(halo[0]), jnp.asarray(halo[0]))
+        # both compute in f32 and round once to bf16 — bit-identical
+        np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                      np.asarray(ref, np.float32))
+
+    def test_fused_dot_is_f32_channel(self):
+        from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+            stencil3d_dot_pallas)
+        u, halo = self._slab()
+        y, d = stencil3d_dot_pallas(jnp.asarray(u), jnp.asarray(halo),
+                                    jnp.asarray(halo), 8, 16, 128, True)
+        assert y.dtype == jnp.bfloat16
+        assert d.dtype == jnp.float32
+        ref = np.sum(np.asarray(u, np.float32)
+                     * np.asarray(y, np.float32))
+        assert abs(float(d) - ref) <= 1e-4 * abs(ref)
+
+    def test_resident_zdepth_doubles_under_bf16(self):
+        from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+            resident_zdepth)
+        z32 = resident_zdepth(512, 512, np.float32)
+        z16 = resident_zdepth(512, 512, np.dtype(jnp.bfloat16))
+        # halved planes at least double the resident depth (the fixed
+        # halo-plane overhead amortizes slightly better on top)
+        assert z16 >= 2 * z32
+
+    def test_pallas_supported_gating(self):
+        from mpi_petsc4py_example_tpu.ops.pallas_stencil import (
+            pallas_supported)
+        # CPU platform never takes the Mosaic path
+        assert not pallas_supported(16, 128, jnp.bfloat16, "cpu")
+        # on TPU: bf16 wants the packed (16, 128) tile
+        assert pallas_supported(16, 128, jnp.bfloat16, "tpu")
+        assert not pallas_supported(8, 128, jnp.bfloat16, "tpu")
+        assert pallas_supported(8, 128, jnp.float32, "tpu")
+        assert not pallas_supported(16, 128, jnp.float64, "tpu")
+
+
+# -------------------------------------------------- serving compatibility
+class TestServingPrecisionKey:
+    def test_precision_splits_compatibility_groups(self):
+        from concurrent.futures import Future
+        from mpi_petsc4py_example_tpu.serving.coalescer import (
+            SolveRequest, coalesce)
+        mk = lambda prec: SolveRequest(op="p", b=None, rtol=1e-6, atol=0.0,
+                                       max_it=100, future=Future(),
+                                       precision=prec)
+        reqs = [mk("float32"), mk("bfloat16"), mk("float32")]
+        batches = coalesce(reqs, max_k=8)
+        # same op + tolerances, different precision: NEVER one block
+        assert len(batches) == 2
+        widths = sorted(len(b) for b in batches)
+        assert widths == [1, 2]

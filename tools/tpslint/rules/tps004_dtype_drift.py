@@ -17,6 +17,15 @@ from .base import Rule, register
 
 _WIDE = {"float64", "complex128"}
 
+#: precision-plan constructors/helpers (solvers/cg_plans.PrecisionPlan,
+#: utils/dtypes): a wide dtype handed to one of these is an INTENTIONAL
+#: plan-mediated choice — the plan object carries it as the reduce/storage
+#: channel and the cast sites downstream (`v.astype(prec.reduce)`) thread
+#: it from the plan, never from a literal. Calls to these names are
+#: exempt; a bare `.astype(jnp.float64)` next to one still fires.
+_PLAN_FUNCS = {"precision_plan", "PrecisionPlan", "reduce_dtype",
+               "tolerance_dtype", "inner_precision_dtype"}
+
 
 @register
 class DtypeDriftRule(Rule):
@@ -43,8 +52,22 @@ class DtypeDriftRule(Rule):
         return (isinstance(node, ast.Constant)
                 and isinstance(node.value, str) and node.value in _WIDE)
 
+    @staticmethod
+    def _is_plan_call(func) -> bool:
+        """``precision_plan(...)`` / ``PrecisionPlan(...)`` /
+        ``dtypes.reduce_dtype(...)`` — by bare name or attribute."""
+        if isinstance(func, ast.Name):
+            return func.id in _PLAN_FUNCS
+        if isinstance(func, ast.Attribute):
+            return func.attr in _PLAN_FUNCS
+        return False
+
     def _check_call(self, module, ctx, call: ast.Call):
         func = call.func
+        if self._is_plan_call(func):
+            # plan-mediated precision choice: the wide dtype is the
+            # plan's declared reduce/storage channel, not drift
+            return
         # np.float64(x) / jnp.complex128(x) scalar constructors
         if ((module.info.is_numpy_attr(func) or module.info.is_jnp_attr(func))
                 and func.attr in _WIDE):
