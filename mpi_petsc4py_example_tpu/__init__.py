@@ -70,6 +70,7 @@ from .utils.errors import (DeadlineExceededError, DeviceExecutionError,
 from .utils.options import Options, global_options, init, backend
 from .utils import petsc_io
 from . import resilience
+from . import telemetry
 from .resilience.faults import inject_faults
 
 __version__ = "0.1.0"
@@ -86,7 +87,8 @@ __all__ = [
     "DeviceExecutionError", "SilentCorruptionError",
     "DeadlineExceededError", "ServerOverloadedError",
     "Options", "global_options", "init", "backend", "petsc_io",
-    "resilience", "inject_faults", "RetryPolicy", "resilient_solve",
+    "resilience", "telemetry", "inject_faults", "RetryPolicy",
+    "resilient_solve",
     "resilient_solve_many", "ElasticPolicy", "HealthMonitor",
     "KSPFallbackChain",
     "SolveServer", "ServedSolveResult", "ServerClosedError",

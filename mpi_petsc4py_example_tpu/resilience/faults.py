@@ -163,6 +163,7 @@ class Fault:
                 and self.hits >= self.at + self.times - 1)
 
     def error(self) -> XlaRuntimeError:
+        self.flight_record()
         msg = _KIND_MESSAGES[self.kind].format(point=self.point)
         if self.device is not None:
             # name the device: HealthMonitor attributes repeated failures
@@ -175,6 +176,21 @@ class Fault:
         # the true progress (retry.py records/resumes the iteration)
         err.iteration = int(self.iter_k or 0)
         return err
+
+    def flight_record(self):
+        """Record this fault into the telemetry flight recorder (every
+        registered fault point has an event site — the coverage contract
+        ``telemetry/names.FLIGHT_FAULT_POINTS`` declares and tpslint
+        TPS014 enforces). Lazy + guarded: this module must stay
+        importable without the telemetry package (stdlib-only contract),
+        and recording must never mask the fault itself."""
+        try:
+            from ..telemetry import flight as _flight
+        except ImportError:
+            return
+        _flight.record_fault(self.point, self.kind, device=self.device,
+                             iteration=int(self.iter_k or 0),
+                             hits=self.hits)
 
     def __repr__(self):
         sched = (f"seed prob={self.prob}" if self._rng is not None else
@@ -304,10 +320,17 @@ def triggered(point: str):
     if plan is None:
         return None
     with _LOCK:
+        fired = None
         for fault in plan:
             if fault.point == point and fault.check():
-                return fault
-    return None
+                fired = fault
+                break
+    if fired is not None and fired.kind not in RAISING_KINDS:
+        # non-raising kinds (nan/inf poison, drops, silent corruption)
+        # never reach Fault.error() — record their flight event here;
+        # raising kinds record once inside error() itself
+        fired.flight_record()
+    return fired
 
 
 def check(point: str):

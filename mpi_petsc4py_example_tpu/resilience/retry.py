@@ -44,11 +44,27 @@ import tempfile
 import time
 from dataclasses import dataclass
 
+from ..telemetry import flight as _flight
+from ..telemetry import spans as _telemetry
 from ..utils.checkpoint import (load_solve_state, load_solve_state_many,
                                 save_solve_state, save_solve_state_many)
 from ..utils.convergence import (BatchedSolveResult, RecoveryEvent,
                                  SolveResult)
 from ..utils.errors import DeviceExecutionError, SilentCorruptionError
+
+
+def _push(events: list, e: RecoveryEvent) -> RecoveryEvent:
+    """Append one recovery event AND mirror it into the telemetry flight
+    recorder (when armed) — the post-mortem ring then holds the same
+    ladder the result's ``recovery_events`` trail reports."""
+    events.append(e)
+    if _telemetry.enabled():
+        _flight.recorder.record_event(
+            "recovery", stage=e.kind, attempt=e.attempt, detail=e.detail,
+            error_class=e.error_class, detector=e.detector,
+            iterations=e.iterations, old_devices=e.old_devices,
+            new_devices=e.new_devices, delay=e.delay)
+    return e
 
 
 @dataclass
@@ -244,7 +260,7 @@ class _ElasticEscalation:
             return False
         wall = time.perf_counter() - t0
         record_mesh_shrink(old_n, comm_new.size, wall)
-        events.append(RecoveryEvent(
+        _push(events, RecoveryEvent(
             kind="mesh_shrink", attempt=attempt,
             detail=(f"rebuilt {old_n} -> {comm_new.size} devices in "
                     f"{wall:.3f}s; resuming from iteration {it0}"),
@@ -291,6 +307,29 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
     Returns the converged attempt's :class:`SolveResult` with ``attempts``
     and the ``recovery_events`` trail filled in.
     """
+    sp = _telemetry.span("resilient.solve", many=False)
+    try:
+        with sp:
+            result = _resilient_solve_impl(ksp, b, x, policy,
+                                           checkpoint_path, elastic)
+            sp.set_attrs(attempts=result.attempts,
+                         recoveries=len(result.recovery_events),
+                         iterations=result.iterations,
+                         converged=result.converged)
+            return result
+    # tpslint: disable=TPS005 — dump-and-reraise: an error escaping the
+    # resilient wrapper is by definition unrecovered, and the flight-ring
+    # dump must fire for EVERY class of it; nothing is swallowed (the
+    # bare raise re-raises immediately). The dump runs AFTER the span
+    # context exited, so the failed solve's own span tree is already in
+    # the ring and lands in the post-mortem.
+    except Exception:  # noqa: BLE001
+        _flight.auto_dump("unrecovered resilient_solve failure")
+        raise
+
+
+def _resilient_solve_impl(ksp, b, x, policy, checkpoint_path,
+                          elastic) -> SolveResult:
     policy = policy or RetryPolicy()
     path = checkpoint_path or default_checkpoint_path(ksp)
     esc = _ElasticEscalation(elastic)
@@ -313,7 +352,7 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                     raise
                 detector = getattr(exc, "detector", "")
                 sdc = exc.failure_class == "detected_sdc"
-                events.append(RecoveryEvent(
+                _push(events, RecoveryEvent(
                     kind="fault", attempt=attempt, detail=str(exc),
                     error_class=exc.failure_class, detector=detector))
                 mat = ksp.get_operators()[0]
@@ -324,15 +363,25 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                     # checkpoint persists exactly that rollback target
                     save_solve_state(path, mat, x, b,
                                      iteration=_failure_iteration(exc))
-                    events.append(RecoveryEvent(
+                    _push(events, RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
                 if comm_new is not None:
                     # ELASTIC escalation: same-mesh retrying is futile —
                     # reshard the checkpointed (or in-memory) iterate
                     # onto the degraded mesh and resume from it
-                    if not esc.shrink(ksp, comm_new, events, attempt,
-                                      persisted=persisted, path=path,
-                                      b=b, x=x):
+                    with _telemetry.span(
+                            "resilient.shrink",
+                            old_devices=int(ksp.comm.size),
+                            new_devices=int(comm_new.size)) as shsp:
+                        ok = esc.shrink(ksp, comm_new, events, attempt,
+                                        persisted=persisted, path=path,
+                                        b=b, x=x)
+                        if ok:
+                            # the shrink event carries the checkpointed
+                            # iteration the resumed solve continues from
+                            shsp.set_attr("resumed_iteration",
+                                          events[-1].iterations)
+                    if not ok:
                         raise    # operator not rebuildable on that size
                     mesh_attempt = 0   # fresh budget on the new mesh
                 elif sdc:
@@ -340,34 +389,40 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
                     # from the verified iterate (retry.py's DETECTED_SDC
                     # escalation — the final answer is re-verified against
                     # the TRUE residual below before it is returned)
-                    events.append(RecoveryEvent(
-                        kind="rollback", attempt=attempt,
-                        detail="re-entering from verified iterate",
-                        detector=detector))
+                    with _telemetry.span("resilient.rollback",
+                                         detector=detector):
+                        _push(events, RecoveryEvent(
+                            kind="rollback", attempt=attempt,
+                            detail="re-entering from verified iterate",
+                            detector=detector))
                 else:
                     delay = policy.delay(mesh_attempt - 1)
-                    events.append(RecoveryEvent(
+                    _push(events, RecoveryEvent(
                         kind="backoff", attempt=attempt, delay=delay,
                         error_class=exc.failure_class))
-                    policy.sleep(delay)
+                    with _telemetry.span("resilient.backoff", delay=delay,
+                                         error_class=exc.failure_class):
+                        policy.sleep(delay)
                     if persisted:
                         # rebuild from the checkpoint: fresh device
                         # buffers (nothing from before the failure is
                         # trusted), iterate restored onto the CALLER's
                         # vector so x stays live
-                        try:
-                            mat2, x2, _b2, _it = load_solve_state(
-                                path, mat.comm)
-                        # tpslint: disable=TPS005 — classified and
-                        # re-raised by kind immediately below
-                        except Exception as rexc:  # noqa: BLE001
-                            _reraise_if_rebuild_failed(rexc, exc)
-                        ksp.set_operators(mat2)
-                        x.data = x2.data
+                        with _telemetry.span("resilient.rebuild",
+                                             checkpoint=path):
+                            try:
+                                mat2, x2, _b2, _it = load_solve_state(
+                                    path, mat.comm)
+                            # tpslint: disable=TPS005 — classified and
+                            # re-raised by kind immediately below
+                            except Exception as rexc:  # noqa: BLE001
+                                _reraise_if_rebuild_failed(rexc, exc)
+                            ksp.set_operators(mat2)
+                            x.data = x2.data
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
                 mesh_attempt += 1
-                events.append(RecoveryEvent(
+                _push(events, RecoveryEvent(
                     kind="resume", attempt=attempt,
                     detail="initial_guess_nonzero from restored iterate"))
     finally:
@@ -379,13 +434,15 @@ def resilient_solve(ksp, b, x, policy: RetryPolicy | None = None, *,
         # a silent corruption was recovered from: the answer must not be
         # taken on the recurrence's word — verify the TRUE residual
         # through an independent host-checked apply
-        ok, rres = _verify_true_residual(ksp, b, x)
+        with _telemetry.span("resilient.verify") as vsp:
+            ok, rres = _verify_true_residual(ksp, b, x)
+            vsp.set_attrs(ok=ok, rel_residual=float(rres))
         if not ok:
             raise SilentCorruptionError(
                 "resilient_solve", "verify", result.iterations,
                 detail=f"recovered solve's true relative residual "
                        f"{rres:.3e} misses the tolerance target")
-        events.append(RecoveryEvent(
+        _push(events, RecoveryEvent(
             kind="verify", attempt=attempt,
             detail=f"true relative residual {rres:.3e} meets target",
             detector="verify"))
@@ -411,6 +468,25 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
     iterate on the degraded mesh. Same zero-overhead contract: no
     failure means exactly one ``ksp.solve_many``.
     """
+    sp = _telemetry.span("resilient.solve", many=True)
+    try:
+        with sp:
+            result = _resilient_solve_many_impl(ksp, B, X, policy,
+                                                checkpoint_path, elastic)
+            sp.set_attrs(attempts=result.attempts,
+                         recoveries=len(result.recovery_events),
+                         nrhs=len(result.iterations),
+                         converged=result.converged)
+            return result
+    # tpslint: disable=TPS005 — dump-and-reraise after the span closed
+    # (see resilient_solve: the dump must include the failed span tree)
+    except Exception:  # noqa: BLE001
+        _flight.auto_dump("unrecovered resilient_solve_many failure")
+        raise
+
+
+def _resilient_solve_many_impl(ksp, B, X, policy, checkpoint_path,
+                               elastic) -> BatchedSolveResult:
     import numpy as np
     policy = policy or RetryPolicy()
     path = checkpoint_path or default_checkpoint_path(ksp)
@@ -452,7 +528,7 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
                     raise
                 detector = getattr(exc, "detector", "")
                 sdc = exc.failure_class == "detected_sdc"
-                events.append(RecoveryEvent(
+                _push(events, RecoveryEvent(
                     kind="fault", attempt=attempt, detail=str(exc),
                     error_class=exc.failure_class, detector=detector))
                 mat = ksp.get_operators()[0]
@@ -462,39 +538,54 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
                     # verified iterate block the solve boundary restored
                     save_solve_state_many(path, mat, X, B,
                                           iteration=_failure_iteration(exc))
-                    events.append(RecoveryEvent(
+                    _push(events, RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
                 if comm_new is not None:
-                    if not esc.shrink(ksp, comm_new, events, attempt,
-                                      persisted=persisted, path=path,
-                                      B=B, X=X, many=True):
+                    with _telemetry.span(
+                            "resilient.shrink",
+                            old_devices=int(ksp.comm.size),
+                            new_devices=int(comm_new.size)) as shsp:
+                        ok = esc.shrink(ksp, comm_new, events, attempt,
+                                        persisted=persisted, path=path,
+                                        B=B, X=X, many=True)
+                        if ok:
+                            shsp.set_attr("resumed_iteration",
+                                          events[-1].iterations)
+                    if not ok:
                         raise
                     mesh_attempt = 0
                 elif sdc:
-                    events.append(RecoveryEvent(
-                        kind="rollback", attempt=attempt,
-                        detail="re-entering from verified iterate block",
-                        detector=detector))
+                    with _telemetry.span("resilient.rollback",
+                                         detector=detector):
+                        _push(events, RecoveryEvent(
+                            kind="rollback", attempt=attempt,
+                            detail="re-entering from verified iterate "
+                                   "block",
+                            detector=detector))
                 else:
                     delay = policy.delay(mesh_attempt - 1)
-                    events.append(RecoveryEvent(
+                    _push(events, RecoveryEvent(
                         kind="backoff", attempt=attempt, delay=delay,
                         error_class=exc.failure_class))
-                    policy.sleep(delay)
+                    with _telemetry.span("resilient.backoff", delay=delay,
+                                         error_class=exc.failure_class):
+                        policy.sleep(delay)
                     if persisted:
-                        try:
-                            mat2, X2, _B2, _it = load_solve_state_many(
-                                path, mat.comm)
-                        # tpslint: disable=TPS005 — classified and
-                        # re-raised by kind immediately below
-                        except Exception as rexc:  # noqa: BLE001
-                            _reraise_if_rebuild_failed(rexc, exc)
-                        ksp.set_operators(mat2)
-                        X[...] = X2.astype(X.dtype, copy=False)
+                        with _telemetry.span("resilient.rebuild",
+                                             checkpoint=path):
+                            try:
+                                mat2, X2, _B2, _it = load_solve_state_many(
+                                    path, mat.comm)
+                            # tpslint: disable=TPS005 — classified and
+                            # re-raised by kind immediately below
+                            except Exception as rexc:  # noqa: BLE001
+                                _reraise_if_rebuild_failed(rexc, exc)
+                            ksp.set_operators(mat2)
+                            X[...] = X2.astype(X.dtype, copy=False)
                 ksp.set_initial_guess_nonzero(True)
                 attempt += 1
                 mesh_attempt += 1
-                events.append(RecoveryEvent(
+                _push(events, RecoveryEvent(
                     kind="resume", attempt=attempt,
                     detail="initial_guess_nonzero from restored "
                            "iterate block"))
@@ -504,14 +595,16 @@ def resilient_solve_many(ksp, B, X=None, policy: RetryPolicy | None = None,
     result.recovery_events = events
     sdc_faults = [e for e in events if e.kind == "fault" and e.detector]
     if sdc_faults:
-        ok, rres = _verify_true_residual_many(ksp, B, result.X)
+        with _telemetry.span("resilient.verify") as vsp:
+            ok, rres = _verify_true_residual_many(ksp, B, result.X)
+            vsp.set_attrs(ok=ok, rel_residual=float(rres))
         if not ok:
             raise SilentCorruptionError(
                 "resilient_solve_many", "verify",
                 max(result.iterations, default=0),
                 detail=f"recovered batch's worst true relative residual "
                        f"{rres:.3e} misses the tolerance target")
-        events.append(RecoveryEvent(
+        _push(events, RecoveryEvent(
             kind="verify", attempt=attempt,
             detail=f"worst per-column true relative residual {rres:.3e} "
                    "meets target",

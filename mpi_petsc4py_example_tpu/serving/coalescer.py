@@ -57,6 +57,12 @@ class SolveRequest:
     # if a future server aliases several precision variants of one
     # operand set under related names.
     precision: str = ""
+    # the request's telemetry span (telemetry.start_span("serving.request")
+    # — DETACHED: opened on the submitting client thread, finished on the
+    # dispatcher thread at resolution, linked to its batch's
+    # serving.dispatch span by the batch_span attribute). None/no-op when
+    # telemetry is disabled; NOT part of the compatibility key.
+    span: Any = None
     t_submit: float = field(default_factory=time.monotonic)
     # absolute time.monotonic() the request must have DISPATCHED by, or
     # None for no deadline (serving/server.py resolves expired requests

@@ -73,6 +73,9 @@ from ..core.mat import Mat
 from ..parallel.mesh import as_comm
 from ..resilience.retry import RetryPolicy, resilient_solve_many
 from ..solvers.ksp import KSP
+from ..telemetry import flight as _flight
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _telemetry
 from ..utils.convergence import SolveResult
 from ..utils.errors import DeadlineExceededError, ServerOverloadedError
 from ..utils.options import global_options
@@ -196,8 +199,15 @@ class SolveServer:
         self._thread: threading.Thread | None = None
         self._dispatch_hook = None       # test seam: called per batch
         self._stats = {"requests": 0, "batches": 0, "padded_cols": 0,
-                       "width_hist": {}, "queue_waits": [],
+                       "width_hist": {},
                        "rejected": 0, "expired": 0, "mesh_shrinks": []}
+        # per-server queue-wait histogram: the SAME Histogram type (and
+        # .summary percentile code path) the process-wide registry twin
+        # uses — SolveServer.stats() and profiling.serving_stats() can
+        # no longer drift in how they compute p50/p99
+        self._wait_hist = _metrics.Histogram(
+            "serving.queue_wait_seconds",
+            _metrics.QUEUE_WAIT_BUCKETS_S)
         self.set_from_options()
         if autostart:
             self.start()
@@ -347,7 +357,17 @@ class SolveServer:
                 record_admission(rejected=1)
                 raise ServerOverloadedError(len(self._pending),
                                             self.max_queue)
+            # the request's span is opened only for ADMITTED requests
+            # (rejections are counted by serving.rejected — a burst of
+            # ~flight_len rejected submissions must not flush the
+            # dispatch history out of the post-mortem ring), on the
+            # client thread; it is finished at resolution on the
+            # dispatcher thread and linked to the dispatch span it rode
+            # in (no-op singleton when disabled)
+            req.span = _telemetry.start_span("serving.request", op=op)
             self._pending.append(req)
+            _metrics.registry.gauge("serving.queue_depth").set(
+                len(self._pending))
             self._cv.notify_all()
         return fut
 
@@ -394,6 +414,8 @@ class SolveServer:
                         r.future.set_exception(
                             ServerClosedError("server shut down before "
                                               "dispatch"))
+                    if r.span is not None:
+                        r.span.set_attr("outcome", "closed").end()
                 self._pending.clear()
             pending = bool(self._pending)
         if self._thread is None and pending:
@@ -440,7 +462,11 @@ class SolveServer:
                 self._pending.clear()
                 self._inflight += len(taken)
             try:
-                for batch in coalesce(taken, self.max_k):
+                with _telemetry.span("serving.coalesce",
+                                     taken=len(taken)) as csp:
+                    batches = coalesce(taken, self.max_k)
+                    csp.set_attr("batches", len(batches))
+                for batch in batches:
                     self._dispatch(batch)
             finally:
                 with self._cv:
@@ -465,11 +491,17 @@ class SolveServer:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(DeadlineExceededError(
                         now - r.t_submit, r.t_deadline - r.t_submit))
+                self._end_request_span(r, "deadline_exceeded")
             reqs = [r for r in reqs if not r.expired(now)]
         # honor client-side cancellation (Future protocol): a request
         # cancelled before dispatch never reaches the device
-        reqs = [r for r in reqs
-                if r.future.set_running_or_notify_cancel()]
+        live = []
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                self._end_request_span(r, "cancelled")
+        reqs = live
         if not reqs:
             return
         sess = self._sessions[reqs[0].op]
@@ -477,54 +509,88 @@ class SolveServer:
         t0 = time.monotonic()
         waits = [t0 - r.t_submit for r in reqs]
         kpad = padded_width(k, self.max_k, self.pad_pow2)
-        B = np.zeros((sess.n, kpad), dtype=sess.dtype)
-        for j, r in enumerate(reqs):
-            B[:, j] = r.b
-        ksp = sess.ksp
-        ksp.set_tolerances(rtol=reqs[0].rtol, atol=reqs[0].atol,
-                           max_it=reqs[0].max_it)
-        try:
-            if self.resilient:
-                res = resilient_solve_many(ksp, B,
-                                           policy=self.retry_policy)
-            else:
-                res = ksp.solve_many(B)
-        # tpslint: disable=TPS005 — whatever the dispatch raised
-        # (exhausted retries, validation, a non-retriable device
-        # failure) must reach the WAITING CLIENT FUTURES, not kill the
-        # dispatcher thread; re-raising here would hang every later
-        # request
-        except Exception as exc:  # noqa: BLE001
-            for r in reqs:
-                r.future.set_exception(exc)
-            self._record(k, waits, kpad - k)
-            return
-        shrinks = [e for e in res.recovery_events
-                   if e.kind == "mesh_shrink"]
-        if shrinks:
-            # the resilient dispatch survived a persistent device loss
-            # by resharding THIS session onto a degraded mesh (its
-            # batch-mates replayed from the checkpointed block inside
-            # the retry loop) — adopt the new mesh server-wide
-            self._adopt_shrunk_mesh(sess, shrinks,
-                                    time.monotonic() - t0)
-        per = res.per_rhs()
-        for j, r in enumerate(reqs):
-            col = per[j]
-            out = ServedSolveResult(
-                iterations=col.iterations,
-                residual_norm=col.residual_norm,
-                reason=col.reason, wall_time=res.wall_time,
-                history=col.history,
-                attempts=res.attempts,
-                recovery_events=list(res.recovery_events),
-                abft_checks=res.abft_checks,
-                sdc_detections=res.sdc_detections,
-                residual_replacements=res.residual_replacements,
-                x=np.array(res.X[:, j]), op=r.op, batch_width=k,
-                queue_wait=waits[j])
-            r.future.set_result(out)
+        # the batch span: a ROOT span on the dispatcher thread; every
+        # request resolved out of this block links back to it
+        bsp = _telemetry.span("serving.dispatch", op=reqs[0].op,
+                              width=k, padded=kpad - k,
+                              precision=reqs[0].precision)
+        with bsp:
+            B = np.zeros((sess.n, kpad), dtype=sess.dtype)
+            for j, r in enumerate(reqs):
+                B[:, j] = r.b
+            ksp = sess.ksp
+            ksp.set_tolerances(rtol=reqs[0].rtol, atol=reqs[0].atol,
+                               max_it=reqs[0].max_it)
+            try:
+                if self.resilient:
+                    res = resilient_solve_many(ksp, B,
+                                               policy=self.retry_policy)
+                else:
+                    res = ksp.solve_many(B)
+            # tpslint: disable=TPS005 — whatever the dispatch raised
+            # (exhausted retries, validation, a non-retriable device
+            # failure) must reach the WAITING CLIENT FUTURES, not kill
+            # the dispatcher thread; re-raising here would hang every
+            # later request
+            except Exception as exc:  # noqa: BLE001
+                bsp.set_attr("error", type(exc).__name__)
+                # close the batch span FIRST (end() is idempotent; the
+                # with-exit becomes a no-op) so the dump below includes
+                # this failed dispatch's own span tree, then dump — the
+                # failure just became the clients' problem (no-op
+                # disarmed)
+                bsp.end()
+                _flight.auto_dump("serving dispatch failed: "
+                                  f"{type(exc).__name__}")
+                for r in reqs:
+                    r.future.set_exception(exc)
+                    self._end_request_span(r, "error", batch=bsp)
+                self._record(k, waits, kpad - k)
+                return
+            shrinks = [e for e in res.recovery_events
+                       if e.kind == "mesh_shrink"]
+            if shrinks:
+                # the resilient dispatch survived a persistent device
+                # loss by resharding THIS session onto a degraded mesh
+                # (its batch-mates replayed from the checkpointed block
+                # inside the retry loop) — adopt the new mesh
+                # server-wide
+                self._adopt_shrunk_mesh(sess, shrinks,
+                                        time.monotonic() - t0)
+            per = res.per_rhs()
+            for j, r in enumerate(reqs):
+                col = per[j]
+                out = ServedSolveResult(
+                    iterations=col.iterations,
+                    residual_norm=col.residual_norm,
+                    reason=col.reason, wall_time=res.wall_time,
+                    history=col.history,
+                    attempts=res.attempts,
+                    recovery_events=list(res.recovery_events),
+                    abft_checks=res.abft_checks,
+                    sdc_detections=res.sdc_detections,
+                    residual_replacements=res.residual_replacements,
+                    x=np.array(res.X[:, j]), op=r.op, batch_width=k,
+                    queue_wait=waits[j])
+                r.future.set_result(out)
+                self._end_request_span(r, "ok", batch=bsp,
+                                       iterations=col.iterations,
+                                       queue_wait=waits[j])
+            bsp.set_attrs(attempts=res.attempts,
+                          iterations=max(res.iterations, default=0))
         self._record(k, waits, kpad - k)
+
+    @staticmethod
+    def _end_request_span(req, outcome: str, batch=None, **attrs):
+        """Finish a request's detached serving.request span, linking it
+        to the batch span it was resolved out of."""
+        sp = req.span
+        if sp is None:
+            return
+        if batch is not None and batch.span_id:
+            sp.set_attr("batch_span", batch.span_id)
+        sp.set_attrs(outcome=outcome, **attrs)
+        sp.end()
 
     def _adopt_shrunk_mesh(self, shrunk_sess, shrink_events, dispatch_wall):
         """Adopt the degraded mesh a resilient dispatch landed on.
@@ -573,23 +639,24 @@ class SolveServer:
             self._stats["mesh_shrinks"].append(entry)
 
     def _record(self, width, waits, padded):
-        record_serving(width, waits, padded)
+        record_serving(width, waits, padded)   # the process-wide twin
+        for w in waits:
+            self._wait_hist.observe(float(w))
         with self._cv:
             st = self._stats
             st["requests"] += width
             st["batches"] += 1
             st["padded_cols"] += padded
             st["width_hist"][width] = st["width_hist"].get(width, 0) + 1
-            st["queue_waits"].extend(waits)
-            del st["queue_waits"][:-10000]     # bounded reservoir
 
     # ---- observability ------------------------------------------------------
     def stats(self) -> dict:
-        """Per-server coalescing statistics (the profiling module keeps
-        the process-wide twin printed by ``log_view``)."""
+        """Per-server coalescing statistics (profiling.serving_stats()
+        keeps the process-wide twin printed by ``log_view``; both views
+        compute their wait percentiles through the SAME registry
+        ``Histogram.summary`` helper)."""
         with self._cv:
             st = self._stats
-            waits = list(st["queue_waits"])
             out = {"requests": st["requests"], "batches": st["batches"],
                    "padded_cols": st["padded_cols"],
                    "width_hist": dict(st["width_hist"]),
@@ -598,13 +665,23 @@ class SolveServer:
                                     for e in st["mesh_shrinks"]]}
         out["mean_width"] = (out["requests"] / out["batches"]
                              if out["batches"] else 0.0)
-        if waits:
-            w = np.sort(np.asarray(waits))
-            out["queue_wait_mean_s"] = float(w.mean())
-            out["queue_wait_p50_s"] = float(np.percentile(w, 50))
-            out["queue_wait_p99_s"] = float(np.percentile(w, 99))
-            out["queue_wait_max_s"] = float(w[-1])
+        s = self._wait_hist.summary((50, 99))
+        if s["count"]:
+            out["queue_wait_mean_s"] = s["mean"]
+            out["queue_wait_p50_s"] = s["p50"]
+            out["queue_wait_p99_s"] = s["p99"]
+            out["queue_wait_max_s"] = s["max"]
         return out
+
+    def metrics_endpoint(self) -> str:
+        """The process-wide telemetry registry in Prometheus text
+        exposition format (content type ``text/plain; version=0.0.4``)
+        — mount it behind ``GET /metrics`` on whatever HTTP front-end
+        fronts this server (the framework deliberately ships the
+        PAYLOAD, not a web server)."""
+        return _metrics.registry.prometheus_text()
+
+    metricsEndpoint = metrics_endpoint
 
     def __repr__(self):
         return (f"SolveServer(ops={self.operators()}, "

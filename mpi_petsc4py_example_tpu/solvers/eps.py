@@ -58,6 +58,7 @@ from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import DeviceComm, as_comm
 from ..resilience import faults as _faults
+from ..telemetry import spans as _telemetry
 from ..utils import aot as _aot
 from ..utils.convergence import SolveResult
 from ..utils.errors import wrap_device_errors
@@ -1089,30 +1090,40 @@ class EPS:
                 and self.st.sigma == 0.0):
             self.st.set_shift(self._target)
         t0 = time.perf_counter()
-        if self._type == "lapack":
-            self._solve_lapack()
-        elif self._type == "power":
-            self._solve_power()
-        elif self._type == "subspace":
-            self._solve_subspace()
-        elif self._type == "lobpcg":
-            self._solve_lobpcg()
-        elif self._type == "gd":
-            self._solve_gd()
-        elif self._type == "arnoldi":
-            self._solve_arnoldi_explicit()
-        else:  # krylovschur / lanczos
-            if self._type == "lanczos" and self._problem_type not in (
-                    EPSProblemType.HEP, EPSProblemType.GHEP):
-                raise ValueError("EPS 'lanczos' needs a Hermitian problem "
-                                 "type (hep/ghep)")
-            self._solve_krylovschur()
-        wall = time.perf_counter() - t0
-        self.result = SolveResult(
-            self._its, float(self._residuals[0]) if len(self._residuals)
-            else 0.0,
-            # nev > n cannot "diverge": min(nev, n) pairs exist at all
-            2 if self._nconv >= min(self.nev, mat.shape[0]) else -3, wall)
+        with _telemetry.span("eps.solve", eps_type=self._type,
+                             problem=str(self._problem_type),
+                             nev=int(self.nev),
+                             n=int(mat.shape[0]),
+                             devices=int(getattr(mat.comm, "size", 0)
+                                         or 0)) as sp:
+            if self._type == "lapack":
+                self._solve_lapack()
+            elif self._type == "power":
+                self._solve_power()
+            elif self._type == "subspace":
+                self._solve_subspace()
+            elif self._type == "lobpcg":
+                self._solve_lobpcg()
+            elif self._type == "gd":
+                self._solve_gd()
+            elif self._type == "arnoldi":
+                self._solve_arnoldi_explicit()
+            else:  # krylovschur / lanczos
+                if self._type == "lanczos" and self._problem_type not in (
+                        EPSProblemType.HEP, EPSProblemType.GHEP):
+                    raise ValueError("EPS 'lanczos' needs a Hermitian "
+                                     "problem type (hep/ghep)")
+                self._solve_krylovschur()
+            wall = time.perf_counter() - t0
+            self.result = SolveResult(
+                self._its, float(self._residuals[0])
+                if len(self._residuals) else 0.0,
+                # nev > n cannot "diverge": min(nev, n) pairs exist at all
+                2 if self._nconv >= min(self.nev, mat.shape[0]) else -3,
+                wall)
+            sp.set_attrs(iterations=int(self._its),
+                         nconv=int(self._nconv),
+                         reason=self.result.reason)
         from ..utils.profiling import record_event
         record_event(
             f"EPSSolve({self._type},{self._problem_type},nev={self.nev})",

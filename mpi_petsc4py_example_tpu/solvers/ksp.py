@@ -29,6 +29,7 @@ from ..core.vec import Vec
 from ..parallel.mesh import as_comm
 from ..resilience import abft as _abft_defaults
 from ..resilience import faults as _faults
+from ..telemetry import spans as _telemetry
 from ..utils.convergence import (BatchedSolveResult, ConvergedReason,
                                  SolveResult)
 from ..utils.errors import SilentCorruptionError, wrap_device_errors
@@ -460,11 +461,51 @@ class KSP:
         self._abft_placed = (key, placed, csM is not None)
         return placed, csM is not None
 
+    # reduce sites per iteration of the CG-family compiled loops, keyed
+    # on (type, guarded) — pinned by tests/test_collective_volume.py's
+    # HLO gates; carried as a span attribute so a trace names the
+    # collective schedule a solve ran under (other types omit the attr)
+    _REDUCE_SITES = {("cg", False): 3, ("cg", True): 2,
+                     ("pipecg", False): 1, ("pipecg", True): 1}
+
     # ---- solve --------------------------------------------------------------
     @wrap_device_errors("KSPSolve")
     def solve(self, b: Vec, x: Vec, *, _rtol=None, _atol=None,
               _guess_nonzero=None, _no_reenter=False,
               _mon_offset=0) -> SolveResult:
+        """Solve ``A x = b`` (petsc4py ``KSPSolve`` shape). The body lives
+        in :meth:`_solve_impl`; this wrapper is the telemetry boundary —
+        one ``ksp.solve`` span per call (gate re-entries recurse through
+        here and nest as child ``ksp.solve`` spans), structured attributes
+        for operator/precision/mesh before and iterations/reason after."""
+        mat = self._mat
+        sp = _telemetry.span(
+            "ksp.solve", ksp_type=self._type,
+            pc=self._pc.get_type() if self._pc is not None else "",
+            operator=type(mat).__name__ if mat is not None else "",
+            n=int(mat.shape[0]) if mat is not None else 0,
+            precision=str(getattr(mat, "dtype", "")) if mat is not None
+            else "",
+            devices=int(getattr(self.comm, "size", 0) or 0),
+            reentry=bool(_no_reenter))
+        if sp is not _telemetry.NOOP:
+            sites = self._REDUCE_SITES.get(
+                (self._type, self._guard_requested()))
+            if sites is not None:
+                sp.set_attr("reduce_sites", sites)
+        with sp:
+            res = self._solve_impl(b, x, _rtol=_rtol, _atol=_atol,
+                                   _guess_nonzero=_guess_nonzero,
+                                   _no_reenter=_no_reenter,
+                                   _mon_offset=_mon_offset)
+            sp.set_attrs(iterations=res.iterations, reason=res.reason,
+                         converged=res.converged,
+                         rnorm=res.residual_norm)
+            return res
+
+    def _solve_impl(self, b: Vec, x: Vec, *, _rtol=None, _atol=None,
+                    _guess_nonzero=None, _no_reenter=False,
+                    _mon_offset=0) -> SolveResult:
         # The underscore kwargs are the re-entry plumbing of the
         # true-residual gate: a re-entered sub-solve overrides tolerances
         # and the initial-guess flag THROUGH PARAMETERS (never by mutating
@@ -477,7 +518,8 @@ class KSP:
         _faults.check("ksp.solve")    # injectable pre-solve device failure
         self._check_norm_type()
         self._check_guard()
-        self.set_up()
+        with _telemetry.span("ksp.setup"):
+            self.set_up()
         comm = mat.comm
         pc = self.get_pc()
         if pc.kind == "hostlu":
@@ -542,27 +584,27 @@ class KSP:
         cs_args, abft_pc_on = ((), False)
         if guard:
             cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
-        prog = build_ksp_program(comm, self._type, pc, mat,
-                                 restart=self.restart,
-                                 monitored=monitored,
-                                 zero_guess=not guess_nonzero,
-                                 nullspace_dim=(nullspace.dim if nullspace
-                                                else 0),
-                                 aug=self.lgmres_augment,
-                                 ell=self.bcgsl_ell,
-                                 unroll=self.unroll,
-                                 natural=self._norm_type == "natural",
-                                 hist_cap=hist_capacity(
-                                     self.max_it,
-                                     # bcgsl records at k+ell, so cover the
-                                     # larger of the cycle-granular strides
-                                     max(self.restart, self.bcgsl_ell)),
-                                 live=live, true_res=gate,
-                                 abft=guard and self.abft,
-                                 abft_pc=abft_pc_on,
-                                 rr=guard
-                                 and self._effective_replacement() > 0,
-                                 donate=True)
+        with _telemetry.span("ksp.setup"):
+            prog = build_ksp_program(
+                comm, self._type, pc, mat,
+                restart=self.restart,
+                monitored=monitored,
+                zero_guess=not guess_nonzero,
+                nullspace_dim=(nullspace.dim if nullspace else 0),
+                aug=self.lgmres_augment,
+                ell=self.bcgsl_ell,
+                unroll=self.unroll,
+                natural=self._norm_type == "natural",
+                hist_cap=hist_capacity(
+                    self.max_it,
+                    # bcgsl records at k+ell, so cover the
+                    # larger of the cycle-granular strides
+                    max(self.restart, self.bcgsl_ell)),
+                live=live, true_res=gate,
+                abft=guard and self.abft,
+                abft_pc=abft_pc_on,
+                rr=guard and self._effective_replacement() > 0,
+                donate=True)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the
@@ -667,12 +709,13 @@ class KSP:
         t0 = time.perf_counter()
         try:
             with live_ctx:
-                out = prog(
-                    mat.device_arrays(), pc.device_arrays(), *ns_args,
-                    *cs_args, b.data, x0d,
-                    dt.type(rtol * margin), dt.type(atol * margin),
-                    dt.type(divtol), np.int32(self.max_it),
-                    *guard_scalars)
+                with _telemetry.span("ksp.dispatch"):
+                    out = prog(
+                        mat.device_arrays(), pc.device_arrays(), *ns_args,
+                        *cs_args, b.data, x0d,
+                        dt.type(rtol * margin), dt.type(atol * margin),
+                        dt.type(divtol), np.int32(self.max_it),
+                        *guard_scalars)
                 xd, iters, rnorm, reason, hist = out[:5]
                 # rebind the caller's vector IMMEDIATELY: the donated x0
                 # buffer is gone, so any exit path from here on (a raising
@@ -711,7 +754,8 @@ class KSP:
             fetch += [det, rrc]
         if gate:
             fetch += [true_rn, bnorm]
-        fetch = jax.device_get(tuple(fetch))
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get(tuple(fetch))
         iters, rnorm, reason = fetch[:3]
         if monitored:
             hist = fetch[3]
@@ -810,49 +854,52 @@ class KSP:
         if not _no_reenter:
             self._last_reentries = 0   # gate re-entry count of this solve
         if gate and not _no_reenter and self.result.converged:
-            target = max(rtol * bnorm, atol)
-            trn_h = true_rn
-            last_mon_rn = float(rnorm)   # monitored-norm value at x
-            total_iters = self.result.iterations
-            total_wall = self.result.wall_time
-            attempts = 0
-            while trn_h > target:
-                if attempts == 3:
-                    # 3 re-entries couldn't close the drift: the gate's
-                    # contract is that "converged" means the TRUE residual
-                    # met the target, so report the failure honestly
-                    self.result = SolveResult(
-                        total_iters, trn_h,
-                        ConvergedReason.DIVERGED_MAX_IT, total_wall)
-                    break
-                attempts += 1
-                # the sub-solve's exit test runs in the KERNEL's monitored
-                # norm; for preconditioned/natural-norm kernels map the
-                # unpreconditioned target through the observed ratio at the
-                # current iterate so the sub-solve neither exits early nor
-                # over-iterates (the outer loop re-checks the TRUE residual
-                # either way)
-                sub_atol = target
-                mon_norm = self.get_norm_type()
-                if (mon_norm in ("preconditioned", "natural")
-                        and np.isfinite(last_mon_rn) and last_mon_rn > 0
-                        and trn_h > 0):
-                    sub_atol = target * last_mon_rn / trn_h
-                sub = self.solve(b, x, _rtol=0.0, _atol=sub_atol,
-                                 _guess_nonzero=True, _no_reenter=True,
-                                 _mon_offset=_mon_offset + total_iters)
-                total_iters += sub.iterations
-                total_wall += sub.wall_time
-                last_mon_rn = sub.residual_norm
-                trn_h = self._last_true_res[0]
-                # the re-entered sub-solve's own reason may be a margin
-                # stall; what decides is the TRUE residual the loop
-                # re-checks (CONVERGED_RTOL when it passes)
-                reason = (ConvergedReason.CONVERGED_RTOL
-                          if trn_h <= target else sub.reason)
-                self.result = SolveResult(total_iters, trn_h, reason,
-                                          total_wall)
-                self._last_reentries = attempts
+            with _telemetry.span("ksp.verify", true_rnorm=float(true_rn),
+                                   bnorm=float(bnorm)) as vsp:
+                target = max(rtol * bnorm, atol)
+                trn_h = true_rn
+                last_mon_rn = float(rnorm)   # monitored-norm value at x
+                total_iters = self.result.iterations
+                total_wall = self.result.wall_time
+                attempts = 0
+                while trn_h > target:
+                    if attempts == 3:
+                        # 3 re-entries couldn't close the drift: the gate's
+                        # contract is that "converged" means the TRUE residual
+                        # met the target, so report the failure honestly
+                        self.result = SolveResult(
+                            total_iters, trn_h,
+                            ConvergedReason.DIVERGED_MAX_IT, total_wall)
+                        break
+                    attempts += 1
+                    # the sub-solve's exit test runs in the KERNEL's monitored
+                    # norm; for preconditioned/natural-norm kernels map the
+                    # unpreconditioned target through the observed ratio at the
+                    # current iterate so the sub-solve neither exits early nor
+                    # over-iterates (the outer loop re-checks the TRUE residual
+                    # either way)
+                    sub_atol = target
+                    mon_norm = self.get_norm_type()
+                    if (mon_norm in ("preconditioned", "natural")
+                            and np.isfinite(last_mon_rn) and last_mon_rn > 0
+                            and trn_h > 0):
+                        sub_atol = target * last_mon_rn / trn_h
+                    sub = self.solve(b, x, _rtol=0.0, _atol=sub_atol,
+                                     _guess_nonzero=True, _no_reenter=True,
+                                     _mon_offset=_mon_offset + total_iters)
+                    total_iters += sub.iterations
+                    total_wall += sub.wall_time
+                    last_mon_rn = sub.residual_norm
+                    trn_h = self._last_true_res[0]
+                    # the re-entered sub-solve's own reason may be a margin
+                    # stall; what decides is the TRUE residual the loop
+                    # re-checks (CONVERGED_RTOL when it passes)
+                    reason = (ConvergedReason.CONVERGED_RTOL
+                              if trn_h <= target else sub.reason)
+                    self.result = SolveResult(total_iters, trn_h, reason,
+                                              total_wall)
+                    self._last_reentries = attempts
+                vsp.set_attrs(reentries=attempts, passed=trn_h <= target)
         return self.result
 
     def _solve_hostlu(self, b: Vec, x: Vec) -> SolveResult:
@@ -929,6 +976,23 @@ class KSP:
         k columns overflow the VMEM plan into ceil(k/limit) launches.
         """
         mat = self._mat
+        sp = _telemetry.span(
+            "ksp.solve_many", ksp_type=self._type,
+            pc=self._pc.get_type() if self._pc is not None else "",
+            operator=type(mat).__name__ if mat is not None else "",
+            n=int(mat.shape[0]) if mat is not None else 0,
+            precision=str(getattr(mat, "dtype", "")) if mat is not None
+            else "",
+            devices=int(getattr(self.comm, "size", 0) or 0))
+        with sp:
+            res = self._solve_many_impl(B, X)
+            its = res.iterations
+            sp.set_attrs(nrhs=len(its), iterations=max(its) if its else 0,
+                         converged=res.converged)
+            return res
+
+    def _solve_many_impl(self, B, X=None) -> BatchedSolveResult:
+        mat = self._mat
         if mat is None:
             raise RuntimeError("KSP.solve_many: no operators set")
         if isinstance(B, (list, tuple)):
@@ -976,7 +1040,8 @@ class KSP:
         _faults.check("ksp.solve")    # the one pre-solve fault point
         self._check_norm_type()
         self._check_guard()
-        self.set_up()
+        with _telemetry.span("ksp.setup"):
+            self.set_up()
         pc = self.get_pc()
         comm = mat.comm
         from .krylov import (batched_pc_supported, build_ksp_program_many,
@@ -1022,9 +1087,10 @@ class KSP:
                         abft=guard and self.abft, abft_pc=abft_pc_on,
                         rr=guard and self._effective_replacement() > 0,
                         true_res=gate, donate=True)
-        prog = build_ksp_program_many(
-            comm, self._type, pc, mat, nrhs=k,
-            zero_guess=not guess_nonzero, **build_kw)
+        with _telemetry.span("ksp.setup"):
+            prog = build_ksp_program_many(
+                comm, self._type, pc, mat, nrhs=k,
+                zero_guess=not guess_nonzero, **build_kw)
         from ..utils.dtypes import tolerance_dtype
         dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),
@@ -1075,18 +1141,21 @@ class KSP:
             return base, det, rrc, Xv, trn, bn
 
         t0 = time.perf_counter()
-        out = prog(mat.device_arrays(), pc.device_arrays(), *cs_args,
-                   Bd, Xd0,
-                   dt.type(rtol * margin), dt.type(atol * margin),
-                   dt.type(divtol), np.int32(self.max_it), *guard_scalars)
+        with _telemetry.span("ksp.dispatch"):
+            out = prog(mat.device_arrays(), pc.device_arrays(), *cs_args,
+                       Bd, Xd0,
+                       dt.type(rtol * margin), dt.type(atol * margin),
+                       dt.type(divtol), np.int32(self.max_it),
+                       *guard_scalars)
         (Xd, iters, rnorm, reason, hist), det, rrc, Xv, trn, bn = \
             _unpack(out)
         # one batched D2H fetch for the block and every per-column scalar
-        fetch = jax.device_get(
-            (Xd, iters, rnorm, reason)
-            + ((hist,) if monitored else ())
-            + ((det, rrc) if guard else ())
-            + ((trn, bn) if gate else ()))
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get(
+                (Xd, iters, rnorm, reason)
+                + ((hist,) if monitored else ())
+                + ((det, rrc) if guard else ())
+                + ((trn, bn) if gate else ()))
         wall = time.perf_counter() - t0
         from ..utils.profiling import record_event, record_sdc, record_sync
         record_sync("KSP solve_many result fetch")

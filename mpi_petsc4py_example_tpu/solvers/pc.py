@@ -235,6 +235,15 @@ class PC:
         build_key = (mat, getattr(mat, "_state", 0), self._tunables_key())
         if self._built_for == build_key:
             return self
+        from ..telemetry import spans as _telemetry
+        with _telemetry.span("pc.setup", pc_type=self._type,
+                             n=int(mat.shape[0])):
+            return self._set_up_build(mat, build_key)
+
+    def _set_up_build(self, mat, build_key):
+        """The actual factor build/placement (the ``pc.setup`` span body
+        — for 'mg'/'gamg' this is the multigrid hierarchy construction,
+        the MG entry point a trace itemizes)."""
         comm = mat.comm
         t = self._type
         # a rebuild must not pin a previous hostlu factorization (SuperLU

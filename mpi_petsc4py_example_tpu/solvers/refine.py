@@ -32,6 +32,7 @@ import numpy as np
 from ..core.mat import Mat
 from ..core.vec import Vec
 from ..parallel.mesh import as_comm
+from ..telemetry import spans as _telemetry
 from ..utils.convergence import ConvergedReason, SolveResult
 from ..utils.dtypes import inner_precision_dtype, real_eps
 from ..utils.options import global_options
@@ -173,6 +174,18 @@ class RefinedKSP:
         A = self._A_host
         if A is None:
             raise RuntimeError("RefinedKSP.solve: no operators set")
+        with _telemetry.span("refine.outer",
+                             inner_precision=self.inner_precision,
+                             ksp_type=self.inner.get_type(),
+                             n=int(A.shape[0]), rtol=self.rtol) as osp:
+            x, res = self._solve_impl(b)
+            osp.set_attrs(refine_steps=self.refine_steps,
+                          inner_iterations=res.iterations,
+                          reason=res.reason)
+            return x, res
+
+    def _solve_impl(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
+        A = self._A_host
         b = np.asarray(b, dtype=np.float64)
         bnorm = np.linalg.norm(b)
         tol = max(self.rtol * bnorm, self.atol)
@@ -203,12 +216,15 @@ class RefinedKSP:
             reason = _conv(rnorm)
         else:
             for it in range(1, self.max_refine + 1):
-                rv.set_global(r.astype(op_dt))
-                res = self.inner.solve(rv, dx)
-                total_inner += res.iterations
-                x = x + dx.to_numpy().astype(np.float64)
-                r = b - A @ x
-                r_new = np.linalg.norm(r)
+                with _telemetry.span("refine.step", step=it) as ssp:
+                    rv.set_global(r.astype(op_dt))
+                    res = self.inner.solve(rv, dx)
+                    total_inner += res.iterations
+                    x = x + dx.to_numpy().astype(np.float64)
+                    r = b - A @ x
+                    r_new = np.linalg.norm(r)
+                    ssp.set_attrs(inner_iterations=res.iterations,
+                                  rnorm=float(r_new))
                 # checked AFTER the correction, so a solve that lands on
                 # tolerance at the max_refine-th step reports CONVERGED
                 if r_new <= tol:
@@ -244,6 +260,18 @@ class RefinedKSP:
         A = self._A_host
         if A is None:
             raise RuntimeError("RefinedKSP.solve_many: no operators set")
+        with _telemetry.span("refine.outer",
+                             inner_precision=self.inner_precision,
+                             ksp_type=self.inner.get_type(),
+                             n=int(A.shape[0]), rtol=self.rtol) as osp:
+            X, res = self._solve_many_impl(B)
+            osp.set_attrs(refine_steps=self.refine_steps,
+                          inner_iterations=res.iterations,
+                          reason=res.reason, nrhs=int(X.shape[1]))
+            return X, res
+
+    def _solve_many_impl(self, B):
+        A = self._A_host
         B = np.asarray(B, dtype=np.float64)
         if B.ndim != 2:
             raise ValueError(f"solve_many needs an (n, nrhs) block, got "

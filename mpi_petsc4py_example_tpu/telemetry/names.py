@@ -1,0 +1,137 @@
+"""The telemetry NAME REGISTRY — every span and metric the framework emits.
+
+PETSc's ``-log_view`` works because every stage/event name is registered
+up front (``PetscLogStageRegister``); a typo'd name is impossible by
+construction. This module is that registry for the telemetry layer:
+``NAMES`` maps every span/counter/gauge/histogram name to its kind and a
+one-line description. The spans module and the metrics registry VALIDATE
+against it at runtime, and tpslint rule TPS014 (telemetry-coverage)
+parses this dict from the AST and flags any ``span("...")`` /
+``registry.counter("...")`` call site whose name literal is missing here
+— the TPS007/TPS012 registry pattern applied to observability, so a
+misspelled metric cannot silently record into a parallel universe.
+
+``FLIGHT_FAULT_POINTS`` is the declarative twin for the flight recorder:
+every fault point named in ``resilience/faults.FAULT_POINTS`` must be
+listed here (TPS014 checks the two ASTs against each other), recording
+the contract that a fault fired at ANY point produces a flight-recorder
+event (``resilience/faults.py`` routes every fired clause through
+``telemetry.flight.record_fault``).
+
+This module is stdlib-free-standing (not even stdlib imports): it is
+parsed by tpslint and imported by ``resilience/faults.py``'s lazy hook,
+both of which must stay framework-import-free.
+"""
+
+# name -> (kind, description); kind in {"span", "counter", "gauge",
+# "histogram"}. Keep entries grouped by subsystem, alphabetical within.
+NAMES = {
+    # ---- spans: KSP (solvers/ksp.py) ----
+    "ksp.solve": ("span", "one KSP.solve call: setup -> dispatch -> fetch "
+                          "(re-entries nest as child ksp.solve spans)"),
+    "ksp.solve_many": ("span", "one batched KSP.solve_many block launch"),
+    "ksp.setup": ("span", "PC set_up + solve-program build/AOT-load"),
+    "ksp.dispatch": ("span", "the compiled solve program's execute call"),
+    "ksp.fetch": ("span", "the batched D2H result fetch"),
+    "ksp.verify": ("span", "the true-residual gate decision + re-entries"),
+    # ---- spans: PC / EPS / refinement ----
+    "pc.setup": ("span", "preconditioner factor build/placement (covers "
+                         "the MG/GAMG hierarchy build — the MG entry)"),
+    "eps.solve": ("span", "one EPS.solve eigensolve"),
+    "refine.outer": ("span", "RefinedKSP outer fp64 refinement loop"),
+    "refine.step": ("span", "one outer correction step (inner solve + "
+                            "fp64 residual + accumulate)"),
+    # ---- spans: resilience (resilience/retry.py) ----
+    "resilient.solve": ("span", "resilient_solve/_many wrapper: children "
+                                "are the recovery-ladder stages"),
+    "resilient.backoff": ("span", "deterministic backoff wait before a "
+                                  "same-mesh retry"),
+    "resilient.rebuild": ("span", "operator rebuild from the checkpoint"),
+    "resilient.rollback": ("span", "DETECTED_SDC immediate re-entry from "
+                                   "the verified iterate"),
+    "resilient.shrink": ("span", "elastic mesh-shrink escalation (attrs: "
+                                 "old/new devices, resumed_iteration)"),
+    "resilient.verify": ("span", "post-recovery independent true-residual "
+                                 "verification"),
+    # ---- spans: serving (serving/server.py) ----
+    "serving.coalesce": ("span", "grouping one queue snapshot into "
+                                 "compatible batches"),
+    "serving.dispatch": ("span", "one coalesced block dispatch (root span "
+                                 "on the dispatcher thread)"),
+    "serving.request": ("span", "one request submit -> resolve, linked to "
+                                "its batch via the batch_span attr"),
+    # ---- counters ----
+    "solve.count": ("counter", "solves by event label (KSPSolve(...), "
+                               "EPSSolve(...), ...)"),
+    "solve.iterations": ("counter", "total solver iterations"),
+    "sync.count": ("counter", "host<->device sync points by kind"),
+    "fault.count": ("counter", "fired fault-injection clauses by point"),
+    "abft.checks": ("counter", "ABFT checksum checks performed"),
+    "abft.detections": ("counter", "silent-corruption detectors fired"),
+    "abft.replacements": ("counter", "in-program residual replacements"),
+    "serving.requests": ("counter", "real requests dispatched (padding "
+                                    "excluded)"),
+    "serving.batches": ("counter", "coalesced block dispatches"),
+    "serving.padded_cols": ("counter", "zero columns added by pow2 "
+                                       "padding"),
+    "serving.width": ("counter", "dispatched batches by real width "
+                                 "(the width histogram)"),
+    "serving.rejected": ("counter", "submissions rejected by the "
+                                    "admission queue bound"),
+    "serving.expired": ("counter", "requests expired by their dispatch "
+                                   "deadline"),
+    "elastic.mesh_shrinks": ("counter", "executed degraded-mesh rebuilds"),
+    "kernel.model_bytes": ("counter", "useful roofline-model bytes by "
+                                      "kernel"),
+    "kernel.seconds": ("counter", "measured device seconds by kernel"),
+    "kernel.episodes": ("counter", "delta-method episodes by kernel"),
+    "collective.per_iter_seconds": ("counter", "summed per-iteration wall "
+                                               "by solver-loop label"),
+    "collective.episodes": ("counter", "collective-latency episodes by "
+                                       "label"),
+    # ---- gauges ----
+    "collective.reduce_sites": ("gauge", "psum/all-reduce sites per "
+                                         "iteration by solver-loop label"),
+    "kernel.achieved_gbps": ("gauge", "achieved effective bandwidth by "
+                                      "kernel (model bytes / measured s)"),
+    "solve.programs": ("gauge", "jit-compiled solver programs held "
+                                "(KSP + EPS caches)"),
+    "serving.queue_depth": ("gauge", "pending requests at last submit"),
+    # ---- histograms (fixed buckets — metrics.py) ----
+    "solve.latency_seconds": ("histogram", "end-to-end wall per solve"),
+    "solve.per_iter_seconds": ("histogram", "wall per solver iteration "
+                                            "(the -log_view latency row)"),
+    "serving.queue_wait_seconds": ("histogram", "submit -> dispatch wait "
+                                                "per request"),
+}
+
+# Fault points the flight recorder records events for. MUST cover every
+# key of resilience/faults.FAULT_POINTS — tpslint TPS014 parses both
+# dicts and fails the lint when a fault point is missing here, so a new
+# fault point cannot land without its flight-recorder event site
+# (faults.Fault.error() / the silent-kind applicators route through
+# telemetry.flight.record_fault for every listed point).
+FLIGHT_FAULT_POINTS = (
+    "ksp.solve",
+    "ksp.program",
+    "ksp.result",
+    "eps.solve",
+    "comm.put",
+    "comm.fetch",
+    "comm.psum",
+    "spmv.result",
+    "pc.apply",
+    "device.lost",
+)
+
+
+def name_kind(name: str) -> str:
+    """The registered kind of ``name``; raises ``KeyError`` (with the
+    registration hint) for unknown names — the runtime twin of TPS014."""
+    try:
+        return NAMES[name][0]
+    except KeyError:
+        raise KeyError(
+            f"telemetry name {name!r} is not registered in "
+            "telemetry/names.NAMES — register it (kind + description) "
+            "before emitting it") from None

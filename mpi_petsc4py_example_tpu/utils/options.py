@@ -109,6 +109,14 @@ KNOWN_FLAGS = {
     "solve_server_retry_delay": "serving retry backoff base delay "
                                 "seconds",
     "solve_server_window": "request-coalescing batching window seconds",
+    # ---- telemetry (mpi_petsc4py_example_tpu/telemetry/) ----
+    "telemetry": "arm structured solve telemetry: spans + flight "
+                 "recorder + trace export (the metrics registry is "
+                 "always on)",
+    "telemetry_dump": "path for an at-exit JSON dump of the metrics "
+                      "snapshot + flight-recorder ring",
+    "telemetry_flight_len": "flight-recorder ring length (recent span "
+                            "trees + fault/recovery events)",
     # ---- EPS (solvers/eps.py) ----
     "eps_gd_blocksize": "generalized-Davidson block size",
     "eps_hermitian": "declare the problem Hermitian (HEP)",
@@ -256,6 +264,10 @@ def init(argv=None):
     global _initialized
     global_options().parse_argv(argv)
     _initialized = True
+    # apply the -telemetry* flags now that argv is parsed (lazy import:
+    # options must stay importable before the package finishes loading)
+    from ..telemetry import configure_from_options
+    configure_from_options()
 
 
 def is_initialized() -> bool:

@@ -12,6 +12,16 @@ through the options DB [external]; equivalents here:
   automatically at exit when ``-log_view`` is set;
 * device tracing — :func:`trace` wraps ``jax.profiler.trace`` so a solve can
   be captured for TensorBoard/XProf (``-tpu_profile <dir>``).
+
+Since the telemetry layer landed, this module is a COMPATIBILITY VIEW:
+every ``record_*`` function is a thin shim writing into the typed
+metrics registry (:mod:`..telemetry.metrics` — counters, gauges,
+fixed-bucket histograms), and ``log_view`` renders FROM that registry —
+one source of truth, so ``registry.snapshot()`` / the Prometheus
+exporter / ``log_view`` can never disagree. The only state kept here is
+the two event LOGS whose per-entry rows ``log_view`` prints (the solve
+event table and the mesh-shrink detail list); everything countable
+lives in the registry.
 """
 
 from __future__ import annotations
@@ -20,9 +30,14 @@ import atexit
 import contextlib
 import sys
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .options import global_options
+from ..telemetry import metrics as _metrics
+from ..telemetry import flight as _flight
+from ..telemetry import spans as _spans
+
+_REG = _metrics.registry
 
 
 @dataclass
@@ -35,10 +50,6 @@ class SolveEvent:
 
 
 _EVENTS: list[SolveEvent] = []
-_SYNCS: dict[str, int] = {}
-# kernel -> [model_bytes_total, seconds_total, episodes] (see
-# record_kernel_traffic)
-_KERNEL_TRAFFIC: dict[str, list] = {}
 _atexit_armed = False
 
 
@@ -46,62 +57,74 @@ def record_event(what: str, n: int, iterations: int, wall: float,
                  reason: int):
     global _atexit_armed
     _EVENTS.append(SolveEvent(what, n, iterations, wall, reason))
+    _REG.counter("solve.count").inc(label=what)
+    _REG.counter("solve.iterations").inc(int(iterations))
+    _REG.histogram("solve.latency_seconds").observe(float(wall))
+    if iterations > 0 and wall > 0:
+        _REG.histogram("solve.per_iter_seconds").observe(
+            float(wall) / int(iterations))
+    _REG.gauge("solve.programs").set(program_count())
     if not _atexit_armed and global_options().get_bool("log_view", False):
         _atexit_armed = True
         atexit.register(log_view)
-
-
-# silent-error detection totals: [abft_checks, detections, replacements]
-# (README "Silent-error detection"; filled by guarded KSP solves)
-_SDC = [0, 0, 0]
 
 
 def record_sdc(checks: int = 0, detections: int = 0, replacements: int = 0):
     """Accumulate silent-error-detection activity for the -log_view row:
     ABFT checksum checks performed, detectors fired, and true-residual
     replacements executed (solvers/ksp.py guarded solves)."""
-    _SDC[0] += int(checks)
-    _SDC[1] += int(detections)
-    _SDC[2] += int(replacements)
+    if checks:
+        _REG.counter("abft.checks").inc(int(checks))
+    if detections:
+        _REG.counter("abft.detections").inc(int(detections))
+    if replacements:
+        _REG.counter("abft.replacements").inc(int(replacements))
 
 
 def sdc_counts() -> dict:
-    return {"abft_checks": _SDC[0], "detections": _SDC[1],
-            "replacements": _SDC[2]}
+    return {"abft_checks": int(_REG.counter("abft.checks").total()),
+            "detections": int(_REG.counter("abft.detections").total()),
+            "replacements": int(
+                _REG.counter("abft.replacements").total())}
 
 
 # solve-server coalescing totals (serving/server.py): dispatched batch
 # widths (histogram), per-request queue waits, zero-padding columns —
-# the per-window observability ROADMAP item 1 asks for, printed as a
-# -log_view row
-_SERVING = {"requests": 0, "batches": 0, "padded_cols": 0,
-            "width_hist": {}, "wait_sum_s": 0.0, "wait_max_s": 0.0}
-
-
+# printed as a -log_view row. Process-wide twin of SolveServer.stats();
+# BOTH views compute their wait statistics through the registry
+# Histogram.summary helper, so they cannot drift.
 def record_serving(width: int, waits=(), padded: int = 0):
     """Accumulate one dispatched coalesced batch: ``width`` REAL
     requests (padding excluded), their queue waits in seconds, and the
     zero columns added by the pow2 padding policy."""
-    _SERVING["requests"] += int(width)
-    _SERVING["batches"] += 1
-    _SERVING["padded_cols"] += int(padded)
-    hist = _SERVING["width_hist"]
-    hist[int(width)] = hist.get(int(width), 0) + 1
+    _REG.counter("serving.requests").inc(int(width))
+    _REG.counter("serving.batches").inc()
+    if padded:
+        _REG.counter("serving.padded_cols").inc(int(padded))
+    _REG.counter("serving.width").inc(label=int(width))
+    h = _REG.histogram("serving.queue_wait_seconds")
     for w in waits:
-        _SERVING["wait_sum_s"] += float(w)
-        _SERVING["wait_max_s"] = max(_SERVING["wait_max_s"], float(w))
+        h.observe(float(w))
 
 
 def serving_stats() -> dict:
     """Process-wide coalescing stats: batch-width histogram + queue-wait
-    aggregates (per-server percentiles live on SolveServer.stats())."""
-    out = dict(_SERVING)
-    out["width_hist"] = dict(_SERVING["width_hist"])
-    out["mean_width"] = (out["requests"] / out["batches"]
-                         if out["batches"] else 0.0)
-    out["wait_mean_s"] = (out["wait_sum_s"] / out["requests"]
-                          if out["requests"] else 0.0)
-    return out
+    aggregates (per-server percentiles live on SolveServer.stats() —
+    same Histogram.summary code path)."""
+    h = _REG.histogram("serving.queue_wait_seconds")
+    s = h.summary((50, 99))
+    requests = int(_REG.counter("serving.requests").total())
+    batches = int(_REG.counter("serving.batches").total())
+    return {"requests": requests, "batches": batches,
+            "padded_cols": int(_REG.counter("serving.padded_cols").total()),
+            "width_hist": {int(k): int(v) for k, v in
+                           _REG.counter("serving.width").items().items()},
+            "wait_sum_s": float(h.sum),
+            "wait_max_s": s["max"],
+            "mean_width": (requests / batches) if batches else 0.0,
+            "wait_mean_s": s["mean"],
+            "wait_p50_s": s["p50"],
+            "wait_p99_s": s["p99"]}
 
 
 # elastic degraded-mesh recoveries (resilience/elastic.py + retry.py
@@ -116,35 +139,31 @@ def record_mesh_shrink(old_devices: int, new_devices: int,
     """Record one executed degraded-mesh rebuild: the mesh went from
     ``old_devices`` to ``new_devices`` and re-placing operands / PC
     factors / programs took ``rebuild_seconds``."""
-    _MESH_SHRINKS.append({"old_devices": int(old_devices),
-                          "new_devices": int(new_devices),
-                          "rebuild_s": float(rebuild_seconds)})
+    entry = {"old_devices": int(old_devices),
+             "new_devices": int(new_devices),
+             "rebuild_s": float(rebuild_seconds)}
+    _MESH_SHRINKS.append(entry)
+    _REG.counter("elastic.mesh_shrinks").inc()
+    if _spans.enabled():
+        _flight.recorder.record_event("mesh_shrink", **entry)
 
 
 def mesh_shrinks() -> list[dict]:
     return [dict(e) for e in _MESH_SHRINKS]
 
 
-# serving admission-control outcomes (serving/server.py hardening knobs):
-# requests rejected at submit (-solve_server_max_queue) and requests
-# expired before dispatch (-solve_server_deadline)
-_ADMISSION = {"rejected": 0, "expired": 0}
-
-
 def record_admission(rejected: int = 0, expired: int = 0):
     """Accumulate serving admission-control outcomes: submissions
     rejected by the queue bound, requests expired by their deadline."""
-    _ADMISSION["rejected"] += int(rejected)
-    _ADMISSION["expired"] += int(expired)
+    if rejected:
+        _REG.counter("serving.rejected").inc(int(rejected))
+    if expired:
+        _REG.counter("serving.expired").inc(int(expired))
 
 
 def admission_counts() -> dict:
-    return dict(_ADMISSION)
-
-
-# collective-latency itemization (the MULTICHIP weak-scaling bench):
-# label -> [reduce_sites_per_iter, per_iter_seconds_sum, episodes]
-_COLLECTIVES: dict[str, list] = {}
+    return {"rejected": int(_REG.counter("serving.rejected").total()),
+            "expired": int(_REG.counter("serving.expired").total())}
 
 
 def record_collective_latency(label: str, reduce_sites: int,
@@ -160,17 +179,22 @@ def record_collective_latency(label: str, reduce_sites: int,
     per iteration) instead of leaving it as benchmark prose."""
     if per_iter_seconds <= 0:
         return
-    entry = _COLLECTIVES.setdefault(label, [int(reduce_sites), 0.0, 0])
-    entry[1] += float(per_iter_seconds)
-    entry[2] += 1
+    _REG.counter("collective.per_iter_seconds").inc(
+        float(per_iter_seconds), label=str(label))
+    _REG.counter("collective.episodes").inc(label=str(label))
+    _REG.gauge("collective.reduce_sites").set(int(reduce_sites),
+                                              label=str(label))
 
 
 def collective_latency() -> dict[str, dict]:
     """label -> {reduce_sites, per_iter_s (mean), episodes}."""
+    sums = _REG.counter("collective.per_iter_seconds").items()
+    eps = _REG.counter("collective.episodes").items()
+    sites = _REG.gauge("collective.reduce_sites").items()
     out = {}
-    for k, (sites, tot, n) in _COLLECTIVES.items():
-        out[k] = {"reduce_sites": sites, "episodes": n,
-                  "per_iter_s": tot / n if n else 0.0}
+    for k, n in eps.items():
+        out[k] = {"reduce_sites": int(sites.get(k, 0)), "episodes": int(n),
+                  "per_iter_s": (sums.get(k, 0.0) / n) if n else 0.0}
     return out
 
 
@@ -183,11 +207,12 @@ def record_sync(kind: str, count: int = 1):
     EPS restarts fetch the projected matrix once per cycle, KSP solves
     fetch the (iters, rnorm, reason) triple once per solve.
     """
-    _SYNCS[kind] = _SYNCS.get(kind, 0) + count
+    _REG.counter("sync.count").inc(int(count), label=str(kind))
 
 
 def sync_counts() -> dict[str, int]:
-    return dict(_SYNCS)
+    return {k: int(v) for k, v in
+            _REG.counter("sync.count").items().items()}
 
 
 def record_kernel_traffic(kernel: str, model_bytes: float, seconds: float):
@@ -196,27 +221,32 @@ def record_kernel_traffic(kernel: str, model_bytes: float, seconds: float):
     stencil apply), ``seconds`` the measured device time for those bytes.
 
     The quotient is the kernel's ACHIEVED effective bandwidth — the number
-    BASELINE.md's pass decompositions argue from (the Pallas stencil's
-    block-DMA geometry sustains ~330 GB/s where XLA's fused elementwise
-    streams ~600 on the same chip). Recording it here makes the plateau a
-    first-class ``-log_view`` line instead of benchmark prose: the bench
+    BASELINE.md's pass decompositions argue from. Recording it here makes
+    the plateau a first-class ``-log_view`` line (and a registry gauge,
+    ``kernel.achieved_gbps``) instead of benchmark prose: the bench
     harnesses (bench.py, benchmarks/decompose_stencil.py) record each
-    delta-method measurement, so any run with ``-log_view`` on prints the
-    per-kernel GB/s table (round-6 VERDICT weak #4 observability).
+    delta-method measurement (round-6 VERDICT weak #4 observability).
     """
     if seconds <= 0 or model_bytes <= 0:
         return
-    entry = _KERNEL_TRAFFIC.setdefault(kernel, [0.0, 0.0, 0])
-    entry[0] += float(model_bytes)
-    entry[1] += float(seconds)
-    entry[2] += 1
+    k = str(kernel)
+    _REG.counter("kernel.model_bytes").inc(float(model_bytes), label=k)
+    _REG.counter("kernel.seconds").inc(float(seconds), label=k)
+    _REG.counter("kernel.episodes").inc(label=k)
+    b = _REG.counter("kernel.model_bytes").value(k)
+    s = _REG.counter("kernel.seconds").value(k)
+    _REG.gauge("kernel.achieved_gbps").set(b / s / 1e9, label=k)
 
 
 def kernel_traffic() -> dict[str, dict]:
     """kernel -> {model_bytes, seconds, episodes, achieved_gbps}."""
+    bts = _REG.counter("kernel.model_bytes").items()
+    secs = _REG.counter("kernel.seconds").items()
+    eps = _REG.counter("kernel.episodes").items()
     out = {}
-    for k, (b, s, n) in _KERNEL_TRAFFIC.items():
-        out[k] = {"model_bytes": b, "seconds": s, "episodes": n,
+    for k, n in eps.items():
+        b, s = bts.get(k, 0.0), secs.get(k, 0.0)
+        out[k] = {"model_bytes": b, "seconds": s, "episodes": int(n),
                   "achieved_gbps": (b / s / 1e9) if s > 0 else 0.0}
     return out
 
@@ -226,24 +256,30 @@ def events() -> list[SolveEvent]:
 
 
 def clear_events():
+    """Reset the process-wide observability state (event logs AND the
+    telemetry metrics registry — the single source of truth)."""
     _EVENTS.clear()
-    _SYNCS.clear()
-    _KERNEL_TRAFFIC.clear()
-    _COLLECTIVES.clear()
-    _SDC[:] = [0, 0, 0]
-    _SERVING.update(requests=0, batches=0, padded_cols=0,
-                    width_hist={}, wait_sum_s=0.0, wait_max_s=0.0)
     _MESH_SHRINKS.clear()
-    _ADMISSION.update(rejected=0, expired=0)
+    _REG.reset()
 
 
 def log_view(file=None):
-    """Print the accumulated solve log, -log_view style."""
+    """Print the accumulated solve log, -log_view style — rendered FROM
+    the telemetry metrics registry (plus the two per-entry event logs),
+    the same data ``telemetry.snapshot()`` and the Prometheus exporter
+    serve."""
     file = file or sys.stderr
-    if (not _EVENTS and not _KERNEL_TRAFFIC and not _SYNCS
-            and not any(_SDC) and not _SERVING["batches"]
-            and not _COLLECTIVES and not _MESH_SHRINKS
-            and not any(_ADMISSION.values())):
+    syncs = sync_counts()
+    sdc = sdc_counts()
+    serving = serving_stats()
+    admission = admission_counts()
+    collectives = collective_latency()
+    kernels = kernel_traffic()
+    per_iter = _REG.histogram("solve.per_iter_seconds")
+    if (not _EVENTS and not kernels and not syncs
+            and not any(sdc.values()) and not serving["batches"]
+            and not collectives and not _MESH_SHRINKS
+            and not any(admission.values())):
         print("log_view: no solve events recorded", file=file)
         return
     if _EVENTS:
@@ -259,26 +295,25 @@ def log_view(file=None):
         print("-" * 72, file=file)
         print(f"{len(_EVENTS)} solve(s), total wall {total:.4f} s",
               file=file)
-    if _SYNCS:
-        parts = ", ".join(f"{k}: {v}" for k, v in sorted(_SYNCS.items()))
+    if syncs:
+        parts = ", ".join(f"{k}: {v}" for k, v in sorted(syncs.items()))
         print(f"host-device sync points: {parts}", file=file)
-    if any(_SDC):
-        print(f"silent-error detection: {_SDC[0]} ABFT check(s), "
-              f"{_SDC[1]} detection(s), {_SDC[2]} residual "
-              f"replacement(s)", file=file)
-    if _SERVING["batches"]:
-        st = serving_stats()
+    if any(sdc.values()):
+        print(f"silent-error detection: {sdc['abft_checks']} ABFT "
+              f"check(s), {sdc['detections']} detection(s), "
+              f"{sdc['replacements']} residual replacement(s)", file=file)
+    if serving["batches"]:
         hist = ", ".join(f"k={k}: {v}"
-                         for k, v in sorted(st["width_hist"].items()))
-        print(f"solve server: {st['batches']} coalesced dispatch(es), "
-              f"{st['requests']} request(s), mean width "
-              f"{st['mean_width']:.1f} [{hist}], queue wait mean "
-              f"{st['wait_mean_s'] * 1e3:.1f} ms / max "
-              f"{st['wait_max_s'] * 1e3:.1f} ms, "
-              f"{st['padded_cols']} padded column(s)", file=file)
-    if any(_ADMISSION.values()):
-        print(f"serving admission control: {_ADMISSION['rejected']} "
-              f"rejected (queue bound), {_ADMISSION['expired']} "
+                         for k, v in sorted(serving["width_hist"].items()))
+        print(f"solve server: {serving['batches']} coalesced "
+              f"dispatch(es), {serving['requests']} request(s), mean "
+              f"width {serving['mean_width']:.1f} [{hist}], queue wait "
+              f"mean {serving['wait_mean_s'] * 1e3:.1f} ms / max "
+              f"{serving['wait_max_s'] * 1e3:.1f} ms, "
+              f"{serving['padded_cols']} padded column(s)", file=file)
+    if any(admission.values()):
+        print(f"serving admission control: {admission['rejected']} "
+              f"rejected (queue bound), {admission['expired']} "
               f"deadline-expired", file=file)
     if _MESH_SHRINKS:
         shr = ", ".join(f"{e['old_devices']}->{e['new_devices']} "
@@ -286,21 +321,34 @@ def log_view(file=None):
                         for e in _MESH_SHRINKS)
         print(f"elastic recovery: {len(_MESH_SHRINKS)} mesh shrink(s) "
               f"[{shr}]", file=file)
-    if _COLLECTIVES:
+    if collectives:
         print("collective latency itemization (reduce sites x per-iter "
               "wall):", file=file)
-        for k, info in sorted(collective_latency().items()):
+        for k, info in sorted(collectives.items()):
             print(f"  {k:36s} {info['reduce_sites']:2d} site(s) "
                   f"{info['per_iter_s'] * 1e6:10.1f} us/iter "
                   f"({info['episodes']} episode(s))", file=file)
-    if _KERNEL_TRAFFIC:
+    if kernels:
         print("kernel traffic (model bytes / measured time = achieved "
               "GB/s):", file=file)
-        for k, info in sorted(kernel_traffic().items()):
+        for k, info in sorted(kernels.items()):
             print(f"  {k:30s} {info['model_bytes'] / 1e9:10.3f} GB "
                   f"{info['seconds']:9.4f} s "
                   f"{info['achieved_gbps']:8.1f} GB/s "
                   f"({info['episodes']} episode(s))", file=file)
+    if per_iter.count:
+        # the fixed-bucket per-iteration latency histogram (cfg12's
+        # -log_view row): only occupied buckets, cumulative-free
+        s = per_iter.summary((50, 99))
+        occupied = [(b, c) for b, c in
+                    zip(list(per_iter.buckets) + [float("inf")],
+                        per_iter.bucket_counts()) if c]
+        cells = "  ".join(
+            (f">{per_iter.buckets[-1]:g}s: {c}" if b == float("inf")
+             else f"<={b:g}s: {c}") for b, c in occupied)
+        print(f"per-iteration latency histogram ({per_iter.count} "
+              f"solve(s), p50 {s['p50'] * 1e6:.1f} us, p99 "
+              f"{s['p99'] * 1e6:.1f} us): {cells}", file=file)
     print(f"compiled programs held: {program_count()}", file=file)
 
 

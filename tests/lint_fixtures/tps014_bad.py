@@ -1,0 +1,26 @@
+"""TPS014 fixtures: unregistered telemetry names at every hook shape."""
+
+from mpi_petsc4py_example_tpu.telemetry import spans as _telemetry
+from mpi_petsc4py_example_tpu.telemetry.metrics import registry
+
+
+def solve_with_typo_span():
+    with _telemetry.span("ksp.sovle"):  # BAD: TPS014
+        pass
+
+
+def detached_typo_span():
+    sp = _telemetry.start_span("serving.reqest")  # BAD: TPS014
+    sp.end()
+
+
+def typo_counter():
+    registry.counter("solve.cout").inc()  # BAD: TPS014
+
+
+def typo_gauge():
+    registry.gauge("serving.queue_dept").set(3)  # BAD: TPS014
+
+
+def typo_histogram():
+    registry.histogram("solve.latency_secs").observe(0.1)  # BAD: TPS014

@@ -1,0 +1,40 @@
+"""TPS014 fixtures: the repo's idiomatic telemetry patterns — silent."""
+
+from mpi_petsc4py_example_tpu.telemetry import spans as _telemetry
+from mpi_petsc4py_example_tpu.telemetry.metrics import registry
+
+
+def registered_names():
+    with _telemetry.span("ksp.solve", ksp_type="cg"):
+        with _telemetry.span("ksp.dispatch"):
+            pass
+    sp = _telemetry.start_span("serving.request", op="p")
+    sp.end()
+    registry.counter("solve.count").inc(label="KSPSolve(cg+none)")
+    registry.gauge("serving.queue_depth").set(0)
+    registry.histogram("serving.queue_wait_seconds").observe(0.001)
+
+
+def dynamic_name_is_not_checkable(name):
+    # a dynamic argument cannot be validated statically — stays silent
+    # (the runtime registry still validates it)
+    with _telemetry.span(name):
+        pass
+
+
+def unrelated_span_function():
+    # a bare call named span() with no telemetry receiver is somebody
+    # else's API — only module-qualified telemetry receivers are hooked
+    def span(n):
+        return n
+    span("not.a.telemetry.name")
+
+
+class Widget:
+    def counter(self, name):
+        return name
+
+
+def unrelated_counter_method():
+    # .counter() on a non-registry receiver is not a metrics hook
+    Widget().counter("definitely.not.registered")

@@ -29,7 +29,7 @@ REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006",
             "TPS007", "TPS008", "TPS009", "TPS010", "TPS011", "TPS012",
-            "TPS013")
+            "TPS013", "TPS014")
 #: current advisory (warn-tier) count over the repo's own packages — the
 #: CI --warn-budget. Raising it requires looking at the new advisory and
 #: deciding it is acceptable; that is the tier's whole contract.
@@ -332,6 +332,53 @@ def test_fault_registry_parses():
         registered_fault_points)
     pts = registered_fault_points()
     assert "ksp.solve" in pts and "comm.psum" in pts, pts
+
+
+def test_telemetry_registry_parses_nonempty():
+    """TPS014's AST parse of telemetry/names.py — a silently empty
+    registry would make the rule toothless."""
+    from tools.tpslint.rules.tps014_telemetry import (
+        flight_fault_points, registered_telemetry_names)
+    names = registered_telemetry_names()
+    assert "ksp.solve" in names and "solve.count" in names, names
+    assert "serving.queue_wait_seconds" in names
+    pts = flight_fault_points()
+    assert "device.lost" in pts and "spmv.result" in pts, pts
+
+
+def test_telemetry_name_coverage():
+    """The reverse direction of TPS014: every name registered in
+    telemetry/names.NAMES has at least one literal span/metric call site
+    in the framework — a registered-but-never-emitted name is dead
+    dashboard surface."""
+    import ast as _ast
+
+    from tools.tpslint.engine import iter_python_files
+    from tools.tpslint.rules.tps014_telemetry import (
+        registered_telemetry_names, telemetry_name_sites)
+    names = registered_telemetry_names()
+    assert names
+    seen = set()
+    for fname in iter_python_files([str(REPO / "mpi_petsc4py_example_tpu"),
+                                    str(REPO / "benchmarks"),
+                                    str(REPO / "tools")]):
+        tree = _ast.parse(Path(fname).read_text())
+        for name, _node in telemetry_name_sites(tree):
+            if name is not None:
+                seen.add(name)
+    missing = set(names) - seen
+    assert not missing, (
+        f"NAMES entries with no emit site: {sorted(missing)}")
+
+
+def test_flight_fault_points_mirror_fault_registry():
+    """FLIGHT_FAULT_POINTS and FAULT_POINTS must mirror exactly: a fault
+    point without a flight-recorder event site loses its post-mortem
+    trail (TPS014 enforces one direction in the lint; this pins both)."""
+    from tools.tpslint.rules.tps012_fault_registry import (
+        registered_fault_points)
+    from tools.tpslint.rules.tps014_telemetry import flight_fault_points
+    assert flight_fault_points() == registered_fault_points()
 
 
 def test_fault_registry_coverage():

@@ -33,6 +33,14 @@ Modes:
 * ``--evict``: the two device-eviction drills via ``inject_faults``;
 * neither: the builtin silent-corruption sweep over every silent fault
   kind at every injectable point (spmv.result / pc.apply / comm.psum).
+
+``--trace-out <path>`` (composable with every mode) arms the telemetry
+layer for the run and exports the Chrome/Perfetto trace afterwards,
+with the flight-recorder ring dumped next to it (``<path>.flight.json``)
+— then VALIDATES both: the trace must be non-empty and schema-clean,
+and (under ``--evict``) must contain the retry -> shrink span chain with
+the resumed iteration number as a span attribute, the ISSUE-11
+acceptance drill. Exit status stays nonzero on any validation miss.
 """
 
 from __future__ import annotations
@@ -269,12 +277,80 @@ def drill_evict_serving() -> list[str]:
     return [f"evict-serving: {p}" for p in problems]
 
 
+def validate_trace(trace_path: str, evict: bool) -> list[str]:
+    """Structural validation of the exported Perfetto trace + flight
+    dump — the CI telemetry job's schema gate."""
+    import json
+
+    from mpi_petsc4py_example_tpu import telemetry
+
+    problems: list[str] = []
+    with open(trace_path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", [])
+    if not evs:
+        return [f"trace {trace_path}: empty traceEvents"]
+    names = set()
+    for e in evs:
+        missing = [k for k in ("name", "ph", "pid") if k not in e]
+        if e.get("ph") == "X":
+            missing += [k for k in ("ts", "dur", "tid") if k not in e]
+            names.add(e["name"])
+        if missing:
+            problems.append(f"trace event {e.get('name')!r} missing "
+                            f"key(s) {missing}")
+            break
+    if evict:
+        # the acceptance drill: the eviction's retry -> shrink chain
+        # must be in the trace, shrink carrying the resumed iteration
+        for want in ("resilient.solve", "resilient.shrink", "ksp.solve"):
+            if want not in names:
+                problems.append(f"trace has no {want!r} span")
+        shrinks = [e for e in evs if e.get("ph") == "X"
+                   and e["name"] == "resilient.shrink"]
+        if not any(int(e.get("args", {}).get("resumed_iteration", 0)) > 0
+                   for e in shrinks):
+            problems.append("no resilient.shrink span carries a positive "
+                            "resumed_iteration attribute")
+        # the chain must also survive as a TREE in the flight ring: a
+        # resilient.solve root whose descendants include the shrink
+        def has_shrink(tree):
+            return (tree["name"] == "resilient.shrink"
+                    or any(has_shrink(c) for c in tree["children"]))
+        roots = telemetry.flight_recorder.spans()
+        if not any(t["name"] == "resilient.solve" and has_shrink(t)
+                   for t in roots):
+            problems.append("flight ring holds no resilient.solve tree "
+                            "containing the shrink span")
+    flight_path = trace_path + ".flight.json"
+    with open(flight_path) as f:
+        dump = json.load(f)
+    if not dump.get("entries"):
+        problems.append(f"flight dump {flight_path} is empty")
+    if evict and not any(e.get("type") == "event"
+                         and e.get("kind") == "fault"
+                         and e["data"].get("point") == "device.lost"
+                         for e in dump.get("entries", [])):
+        problems.append("flight dump records no device.lost fault event")
+    return [f"trace: {p}" for p in problems]
+
+
 def main() -> int:
     import contextlib
 
     import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu import telemetry
 
     failures: list[str] = []
+    argv = sys.argv[1:]
+    trace_out = None
+    if "--trace-out" in argv:
+        i = argv.index("--trace-out")
+        if i + 1 >= len(argv):
+            print("--trace-out needs a path", file=sys.stderr)
+            return 2
+        trace_out = argv[i + 1]
+        telemetry.enable()
     env_spec = os.environ.get("TPU_SOLVE_FAULTS", "").strip()
     if "--evict" in sys.argv[1:]:
         # ISSUE 8 acceptance: permanent device loss mid-solve AND
@@ -290,6 +366,13 @@ def main() -> int:
         for spec in BUILTIN_SPECS:
             failures += drill(spec, tps.inject_faults(spec))
         what = "silent-corruption"
+    if trace_out:
+        telemetry.export_trace(trace_out)
+        telemetry.flight_recorder.dump(trace_out + ".flight.json",
+                                       reason="chaos smoke")
+        failures += validate_trace(trace_out, "--evict" in sys.argv[1:])
+        print(f"[chaos] trace exported to {trace_out} "
+              f"(+ {trace_out}.flight.json)")
     if failures:
         print("[chaos] FAILURES:", file=sys.stderr)
         for f in failures:
