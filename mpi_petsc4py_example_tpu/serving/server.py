@@ -243,6 +243,7 @@ class SolveServer:
                           rtol: float = 1e-5, atol: float = 0.0,
                           max_it: int = 10000, abft: bool = False,
                           residual_replacement: int = 0,
+                          megasolve: bool = False,
                           warm_widths=()):
         """Register operator ``name`` and make its solve state resident.
 
@@ -264,10 +265,15 @@ class SolveServer:
         rolls the whole block back to the verified iterates and the
         resilient dispatch re-enters immediately — one poisoned request
         cannot contaminate its batch-mates (per-column detection +
-        independent final re-verification). The session KSP also
-        applies the options DB (``-ksp_*`` flags — abft, residual
-        replacement, true-residual gating — override these defaults at
-        runtime, the PETSc precedence).
+        independent final re-verification). ``megasolve`` routes the
+        session's coalesced dispatches through the FUSED whole-solve
+        program (solvers/megasolve.py): a served block — refinement
+        recurrence, true-residual verification and all — costs exactly
+        ONE compiled-program launch, the measurement the
+        ``serving.dispatch`` span's ``dispatches`` attribute reports.
+        The session KSP also applies the options DB (``-ksp_*`` flags —
+        abft, residual replacement, true-residual gating, megasolve —
+        override these defaults at runtime, the PETSc precedence).
         """
         if name in self._sessions:
             raise ValueError(f"operator {name!r} already registered")
@@ -282,6 +288,7 @@ class SolveServer:
         ksp.set_tolerances(rtol=rtol, atol=atol, max_it=max_it)
         ksp.abft = bool(abft)
         ksp.residual_replacement = int(residual_replacement)
+        ksp.megasolve = bool(megasolve)
         ksp.set_from_options()
         # the options DB keeps PETSc precedence, but a global -ksp_type/
         # -pc_type aimed at some OTHER solver in the process can silently

@@ -110,6 +110,25 @@ class KSP:
                                       # CG's u/w recurrence drift
                                       # (Ghysels-Vanroose); 0 = off.
                                       # Non-pipelined types ignore it.
+        self.megasolve = False        # -ksp_megasolve: route eligible
+                                      # cg/pipecg solves through the
+                                      # FUSED whole-solve program
+                                      # (solvers/megasolve.py): the
+                                      # outer verification/refinement
+                                      # recurrence runs as an in-program
+                                      # lax.while_loop wrapping the CG
+                                      # plan loop, so a solve (or a
+                                      # solve_many block) costs exactly
+                                      # ONE compiled-program launch and
+                                      # the returned iterate's TRUE
+                                      # residual met the target by
+                                      # construction (the gate's exit
+                                      # condition IS the convergence
+                                      # test). Ineligible
+                                      # configurations (non-CG types,
+                                      # nullspace, monitors, norm-type
+                                      # overrides, unroll>1) fall
+                                      # through to the unfused path.
         self._true_residual_check = False  # -ksp_true_residual_check
         self.true_residual_margin = 1.0    # -ksp_true_residual_margin: with
                                       # the gate on, the COMPILED program
@@ -357,6 +376,7 @@ class KSP:
         nt = opt.get_string(p + "ksp_norm_type")
         if nt:
             self.set_norm_type(nt)
+        self.megasolve = opt.get_bool(p + "ksp_megasolve", self.megasolve)
         self._true_residual_check = opt.get_bool(
             p + "ksp_true_residual_check", self._true_residual_check)
         self.true_residual_margin = opt.get_real(
@@ -541,6 +561,12 @@ class KSP:
                          else _guess_nonzero)
         if norm_none:
             rtol, atol, divtol = 0.0, 0.0, 0.0
+        # -ksp_megasolve: the fused whole-solve program — one launch,
+        # in-program verification/re-entry (solvers/megasolve.py);
+        # ineligible configurations continue on the unfused path below
+        if self._megasolve_eligible():
+            return self._solve_megasolve(b, x, rtol=rtol, atol=atol,
+                                         guess_nonzero=guess_nonzero)
         # the gate computes its true-residual scalars in the solve program's
         # epilogue (krylov true_res) — the honest case costs ZERO extra
         # program dispatches (round-4 re-dispatch tax: ~0.2-0.5 s/solve on
@@ -663,6 +689,7 @@ class KSP:
             fault = _faults.mesh_fault("device.lost", comm.device_ids)
         if fault is not None:
             if fault.iter_k:
+                _telemetry.record_program_dispatch("ksp")
                 part = prog(mat.device_arrays(), pc.device_arrays(),
                             *ns_args, *cs_args, b.data, x0d,
                             dt.type(0.0), dt.type(0.0), dt.type(divtol),
@@ -710,6 +737,7 @@ class KSP:
         try:
             with live_ctx:
                 with _telemetry.span("ksp.dispatch"):
+                    _telemetry.record_program_dispatch("ksp")
                     out = prog(
                         mat.device_arrays(), pc.device_arrays(), *ns_args,
                         *cs_args, b.data, x0d,
@@ -941,6 +969,279 @@ class KSP:
                   f"{ConvergedReason.name(self.result.reason)} iterations 1")
         return self.result
 
+    # ---- megasolve: the fused whole-solve fast path -------------------------
+    def _megasolve_eligible(self, many: bool = False) -> bool:
+        """Route this solve through the fused whole-solve program
+        (``-ksp_megasolve``, solvers/megasolve.py)? Conservative: any
+        configuration without a fused equivalent — non-CG types, a null
+        space, monitors/history (per-iteration records live in the
+        unfused programs), norm-type overrides, unroll>1 — falls
+        through to the unfused path silently."""
+        if not self.megasolve:
+            return False
+        mat = self._mat
+        if mat is None:
+            return False
+        nullspace = getattr(mat, "nullspace", None)
+        if nullspace is not None and getattr(nullspace, "dim", 0) > 0:
+            return False
+        if self._norm_type != "default" or self.unroll != 1:
+            return False
+        if self._monitors or self._monitor_flag or hasattr(self, "_history"):
+            return False
+        from .megasolve import megasolve_supported
+        return megasolve_supported(self._type, self.get_pc(), mat,
+                                   nrhs=2 if many else None)
+
+    def _solve_megasolve(self, b: Vec, x: Vec, *, rtol, atol,
+                         guess_nonzero) -> SolveResult:
+        """The ``-ksp_megasolve`` fast path: ONE fused program launch
+        for the whole solve. The in-program outer loop re-enters the CG
+        recurrence from the TRUE residual until ``max(rtol*||b||,
+        atol)`` passes (the unfused ``-ksp_true_residual_check`` gate's
+        semantics at zero re-entry dispatches), so the reported
+        ``rnorm`` is the verified ``||b - A x||``. Guard detection
+        surfaces the fused loop's verified-iterate carry: ``x`` is
+        rolled back to it before the DETECTED_SDC raise, exactly as the
+        unfused path does."""
+        from .megasolve import GATE_REFINE_MAX, build_megasolve_program
+        mat = self._mat
+        comm = mat.comm
+        pc = self.get_pc()
+        op_dt = np.dtype(mat.dtype)
+        guard = self._guard_requested() and self._type in GUARDED_TYPES
+        cs_args, abft_pc_on = ((), False)
+        if guard:
+            cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
+        with _telemetry.span("ksp.setup"):
+            prog = build_megasolve_program(
+                comm, self._type, pc, mat, None,
+                zero_guess=not guess_nonzero,
+                abft=guard and self.abft, abft_pc=abft_pc_on,
+                rr=guard and self._effective_replacement() > 0,
+                donate=True)
+        from ..utils.dtypes import tolerance_dtype
+        dt = tolerance_dtype(op_dt)
+        guard_scalars = ((dt.type(self.abft_tol),
+                          np.int32(self._effective_replacement()))
+                         if guard else ())
+        from ..parallel.mesh import is_placed
+        from .krylov import donation_supported
+        x0d = x.data
+        if donation_supported() and (x0d is b.data or is_placed(x0d)):
+            # aliasing/placement copy rule — see _solve_impl
+            x0d = jnp.array(x0d)
+        fault = _faults.triggered("ksp.program")
+        if fault is None:
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
+        if fault is not None:
+            if fault.iter_k:
+                # truncated re-run leaves the iteration-K iterate: zero
+                # targets + one outer step of iter_k inner iterations
+                _telemetry.record_program_dispatch("megasolve")
+                part = prog(mat.device_arrays(), pc.device_arrays(),
+                            *cs_args, b.data, x0d,
+                            dt.type(0.0), dt.type(0.0), dt.type(0.0),
+                            dt.type(self.divtol),
+                            np.int32(min(int(fault.iter_k), self.max_it)),
+                            np.int32(1),
+                            np.int32(ConvergedReason.DIVERGED_MAX_IT),
+                            *guard_scalars)
+                x.data = part[0]
+            raise fault.error()
+        t0 = time.perf_counter()
+        with _telemetry.span("ksp.dispatch"):
+            _telemetry.record_program_dispatch("megasolve")
+            out = prog(mat.device_arrays(), pc.device_arrays(), *cs_args,
+                       b.data, x0d,
+                       dt.type(rtol), dt.type(atol), dt.type(rtol),
+                       dt.type(self.divtol), np.int32(self.max_it),
+                       np.int32(GATE_REFINE_MAX),
+                       # drift-stall exit reports the unfused gate's
+                       # DIVERGED_MAX_IT (genuine inner breakdown still
+                       # surfaces as DIVERGED_BREAKDOWN in-program)
+                       np.int32(ConvergedReason.DIVERGED_MAX_IT),
+                       *guard_scalars)
+        xd, steps, iters, rnorm, reason = out[:5]
+        # rebind immediately: the donated x0 buffer is gone (see
+        # _solve_impl) — every exit path must see the program's output
+        x.data = xd
+        det = rrc = xv = None
+        if guard:
+            det, rrc, xv = out[5:8]
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get(
+                (steps, iters, rnorm, reason)
+                + ((det, rrc) if guard else ()))
+        from ..utils.profiling import record_sync
+        record_sync("KSP result fetch/solve")
+        steps, iters = int(fetch[0]), int(fetch[1])
+        rnorm, reason = float(fetch[2]), int(fetch[3])
+        wall = time.perf_counter() - t0
+        checks = 0
+        if guard:
+            det, rrc = int(fetch[4]), int(fetch[5])
+            # one init check per outer step + one per inner iteration
+            # per active channel (the unfused accounting, per step)
+            checks = ((steps + iters * (1 + int(abft_pc_on)))
+                      if self.abft else 0)
+            from ..utils.profiling import record_sdc
+            if det != SDC_NONE:
+                detector = SDC_DETECTOR_NAMES.get(det, f"det{det}")
+                record_sdc(checks, 1, rrc)
+                # rollback target: the last outer iterate whose fp64
+                # TRUE residual was measured by the fused exit gate
+                x.data = xv
+                raise SilentCorruptionError(
+                    "KSPSolve", detector, iters,
+                    detail=f"detected inside the fused megasolve loop "
+                           f"({rrc} residual replacement(s) passed "
+                           "before detection)")
+            record_sdc(checks, 0, rrc)
+        fault = _faults.triggered("ksp.result")
+        if fault is not None:
+            rnorm = float("nan") if fault.kind == "nan" else float("inf")
+            if fault.iter_k is not None:
+                iters = fault.iter_k
+        if not np.isfinite(rnorm):
+            reason = ConvergedReason.DIVERGED_NANORINF
+        self.result = SolveResult(iters, rnorm, int(reason), wall)
+        self.result.megasolve_steps = steps
+        self._last_reentries = 0      # in-program re-entries aren't
+        #                               host gate re-entries
+        if guard:
+            self.result.abft_checks = checks
+            self.result.residual_replacements = rrc
+        from ..utils.profiling import record_event
+        record_event(f"KSPSolve({self._type}+{pc.get_type()}+mega)",
+                     mat.shape[0], iters, wall, int(reason))
+        if self._view_flag:
+            self.view()
+        if self._reason_flag:
+            verb = ("converged" if self.result.converged else
+                    "did not converge")
+            print(f"Linear solve {verb} due to "
+                  f"{ConvergedReason.name(self.result.reason)} "
+                  f"iterations {self.result.iterations}")
+        return self.result
+
+    def _solve_many_megasolve(self, B, X) -> BatchedSolveResult:
+        """Fused batched fast path: the whole block's refinement/
+        verification recurrence in ONE launch — a coalesced serving
+        block costs exactly one dispatch (megasolve module doc).
+        Per-column results mirror the unfused batched path; guard
+        detection rolls the block back to the fused loop's verified
+        carry and raises, exactly like ``_solve_many_impl``."""
+        from .megasolve import (GATE_REFINE_MAX,
+                                build_megasolve_program_many)
+        mat = self._mat
+        comm = mat.comm
+        pc = self.get_pc()
+        k = int(B.shape[1])
+        op_dt = np.dtype(mat.dtype)
+        guard = self._guard_requested()
+        cs_args, abft_pc_on = ((), False)
+        if guard:
+            cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
+        with _telemetry.span("ksp.setup"):
+            prog = build_megasolve_program_many(
+                comm, self._type, pc, mat, None, nrhs=k,
+                zero_guess=not self._initial_guess_nonzero,
+                abft=guard and self.abft, abft_pc=abft_pc_on,
+                rr=guard and self._effective_replacement() > 0,
+                donate=True)
+        from ..utils.dtypes import tolerance_dtype
+        dt = tolerance_dtype(op_dt)
+        guard_scalars = ((dt.type(self.abft_tol),
+                          np.int32(self._effective_replacement()))
+                         if guard else ())
+        Bd, Xd0 = comm.put_rows_many([B.astype(op_dt, copy=False),
+                                      X.astype(op_dt, copy=False)])
+        from .krylov import donation_supported
+        if donation_supported():
+            Xd0 = jnp.array(Xd0)      # op output, donation-safe
+        fault = _faults.triggered("ksp.program")
+        if fault is None:
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
+        if fault is not None:
+            if fault.iter_k:
+                _telemetry.record_program_dispatch("megasolve_many")
+                part = prog(mat.device_arrays(), pc.device_arrays(),
+                            *cs_args, Bd, Xd0,
+                            dt.type(0.0), dt.type(0.0), dt.type(0.0),
+                            dt.type(self.divtol),
+                            np.int32(min(int(fault.iter_k), self.max_it)),
+                            np.int32(1),
+                            np.int32(ConvergedReason.DIVERGED_MAX_IT),
+                            *guard_scalars)
+                X[...] = np.asarray(
+                    jax.device_get(part[0]))[: mat.shape[0]].astype(
+                        X.dtype, copy=False)
+            raise fault.error()
+        t0 = time.perf_counter()
+        with _telemetry.span("ksp.dispatch"):
+            _telemetry.record_program_dispatch("megasolve_many")
+            out = prog(mat.device_arrays(), pc.device_arrays(), *cs_args,
+                       Bd, Xd0,
+                       dt.type(self.rtol), dt.type(self.atol),
+                       dt.type(self.rtol), dt.type(self.divtol),
+                       np.int32(self.max_it), np.int32(GATE_REFINE_MAX),
+                       np.int32(ConvergedReason.DIVERGED_MAX_IT),
+                       *guard_scalars)
+        Xd, steps, ii, rn, rs = out[:5]
+        det = rrc = Xv = None
+        if guard:
+            det, rrc, Xv = out[5:8]
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get((Xd, steps, ii, rn, rs)
+                                   + ((det, rrc) if guard else ()))
+        from ..utils.profiling import (record_event, record_sdc,
+                                       record_sync)
+        record_sync("KSP solve_many result fetch")
+        X[...] = np.asarray(fetch[0])[: mat.shape[0]].astype(
+            X.dtype, copy=False)
+        steps = int(fetch[1])
+        iters = [int(i) for i in np.asarray(fetch[2])]
+        rnorms = [float(v) for v in np.asarray(fetch[3])]
+        reasons = [int(v) for v in np.asarray(fetch[4])]
+        wall = time.perf_counter() - t0
+        checks = 0
+        if guard:
+            det_h = np.asarray(fetch[5])
+            rrc_h = np.asarray(fetch[6])
+            checks = ((k * steps + sum(iters) * (1 + int(abft_pc_on)))
+                      if self.abft else 0)
+            if int(det_h.max(initial=0)) != SDC_NONE:
+                bad = [j for j in range(k) if int(det_h[j]) != SDC_NONE]
+                detector = SDC_DETECTOR_NAMES.get(
+                    int(det_h[bad[0]]), str(int(det_h[bad[0]])))
+                record_sdc(checks, len(bad), int(rrc_h.sum()))
+                X[...] = np.asarray(
+                    jax.device_get(Xv))[: mat.shape[0]].astype(
+                        X.dtype, copy=False)
+                raise SilentCorruptionError(
+                    "KSPSolveMany", detector,
+                    int(max(iters[j] for j in bad)),
+                    detail=f"columns {bad} flagged inside the fused "
+                           "megasolve loop")
+            record_sdc(checks, 0, int(rrc_h.sum()))
+        for j in range(k):
+            if not np.isfinite(rnorms[j]):
+                reasons[j] = ConvergedReason.DIVERGED_NANORINF
+        res = BatchedSolveResult(iterations=iters, residual_norms=rnorms,
+                                 reasons=reasons, wall_time=wall, X=X,
+                                 histories=[[] for _ in range(k)])
+        res.megasolve_steps = steps
+        if guard:
+            res.abft_checks = checks
+            res.residual_replacements = int(rrc_h.sum())
+        self.result_many = res
+        record_event(f"KSPSolveMany({self._type}+{pc.get_type()}"
+                     f"+mega,k={k})", mat.shape[0],
+                     max(iters) if iters else 0, wall,
+                     max(reasons) if res.converged else min(reasons))
+        return res
+
     # ---- batched multi-RHS solve (PETSc KSPMatSolve analog) -----------------
     @wrap_device_errors("KSPSolveMany")
     def solve_many(self, B, X=None) -> BatchedSolveResult:
@@ -1053,6 +1354,8 @@ class KSP:
                    and self._norm_type in ("default", "none"))
         if not batched:
             return self._solve_many_sequential(B, X)
+        if self._megasolve_eligible(many=True):
+            return self._solve_many_megasolve(B, X)
 
         norm_none = self._norm_type == "none"
         rtol, atol, divtol = self.rtol, self.atol, self.divtol
@@ -1119,6 +1422,7 @@ class KSP:
             fault = _faults.mesh_fault("device.lost", comm.device_ids)
         if fault is not None:
             if fault.iter_k:
+                _telemetry.record_program_dispatch("ksp_many")
                 part = prog(mat.device_arrays(), pc.device_arrays(),
                             *cs_args, Bd, Xd0, dt.type(0.0), dt.type(0.0),
                             dt.type(divtol),
@@ -1142,6 +1446,7 @@ class KSP:
 
         t0 = time.perf_counter()
         with _telemetry.span("ksp.dispatch"):
+            _telemetry.record_program_dispatch("ksp_many")
             out = prog(mat.device_arrays(), pc.device_arrays(), *cs_args,
                        Bd, Xd0,
                        dt.type(rtol * margin), dt.type(atol * margin),
@@ -1270,6 +1575,7 @@ class KSP:
                     prog2 = build_ksp_program_many(
                         comm, self._type, pc, mat, nrhs=k,
                         zero_guess=False, **build_kw)
+                _telemetry.record_program_dispatch("ksp_many")
                 out = prog2(mat.device_arrays(), pc.device_arrays(),
                             *cs_args, Bd, Xd,
                             dt.type(rtol * margin), dt.type(atol * margin),

@@ -63,9 +63,23 @@ class RefinedKSP:
         self.atol = 0.0
         self.max_refine = 20
         self.inner_precision = "f32"
+        self.megasolve = False        # -ksp_megasolve: run the whole
+                                      # refinement recurrence — inner
+                                      # low-precision solve, fp64 true
+                                      # residual, correction AXPY, exit
+                                      # verification — as ONE fused
+                                      # device program
+                                      # (solvers/megasolve.py): one
+                                      # dispatch per solve instead of
+                                      # one launch per outer step (plus
+                                      # the per-step host round-trips:
+                                      # placements, fetches, the host
+                                      # fp64 residual SpMV)
         self._A_host = None
         self._mat_lp: Mat | None = None
         self._inner_op = None
+        self._outer_op = None         # explicit fp64 device operator
+        self._mat_outer: Mat | None = None   # lazily built from A_host
         self.result = SolveResult()
 
     def create(self, comm=None):
@@ -102,18 +116,31 @@ class RefinedKSP:
         self.max_refine = opt.get_int(p + "ksp_refine_max", self.max_refine)
         self.inner_rtol = opt.get_real(p + "ksp_refine_inner_rtol",
                                        self.inner_rtol)
+        self.megasolve = opt.get_bool(p + "ksp_megasolve", self.megasolve)
         self.inner.set_from_options()
+        # the inner KSP must NOT also route through megasolve: the
+        # refinement loop is fused HERE (a fused inner would nest two
+        # verification loops and double-count the outer recurrence)
+        self.inner.megasolve = False
         return self
 
     setFromOptions = set_from_options
 
-    def set_operators(self, A_scipy, inner_op=None):
+    def set_operators(self, A_scipy, inner_op=None, outer_op=None):
         """``A_scipy``: fp64 scipy sparse matrix (kept for exact
         residuals). ``inner_op``: optional device operator already built
         at the inner precision (matrix-free stencils); defaults to an
-        assembled Mat at :attr:`inner_dtype`."""
+        assembled Mat at :attr:`inner_dtype`. ``outer_op``: optional
+        fp64 DEVICE operator for the fused megasolve path's in-program
+        exact residual (must share ``inner_op``'s layout — e.g. the same
+        stencil built at fp64); without one, an fp64 device Mat is
+        assembled from ``A_scipy`` lazily when ``-ksp_megasolve`` routes
+        a solve through the fused program (custom inner operators with
+        no fp64 twin fall back to the unfused host loop)."""
         A = A_scipy.tocsr()
         self._A_host = A
+        self._mat_outer = None        # rebuilt lazily for the new A
+        self._outer_op = outer_op
         if self.comm is None:
             self.create(None)
         if inner_op is not None:
@@ -169,6 +196,272 @@ class RefinedKSP:
         floor = _INNER_RTOL_FLOOR_EPS * real_eps(self.inner_dtype)
         return max(self.inner_rtol, floor)
 
+    # ---- megasolve: the fused one-dispatch refinement path -----------------
+    #: inner per-correction iteration cap — the same 20000 the unfused
+    #: host loop sets on the inner KSP (set_tolerances in _solve_impl)
+    _INNER_MAX_IT = 20000
+
+    def _outer_operator(self):
+        """The fp64 DEVICE operator the fused program's exact-residual
+        channel applies: the explicit ``outer_op``, the inner Mat itself
+        when the inner precision is already fp64 (shared-operand
+        program), or an fp64 Mat assembled lazily from the host CSR.
+        ``None`` for custom inner operators without an fp64 twin — the
+        solve then falls back to the unfused host loop."""
+        if self._outer_op is not None:
+            return self._outer_op
+        if self._mat_lp is None:
+            return None
+        if self.inner_dtype == np.dtype(np.float64):
+            return self._mat_lp
+        if self._mat_outer is None:
+            self._mat_outer = Mat.from_scipy(self.comm, self._A_host,
+                                             dtype=np.float64)
+        return self._mat_outer
+
+    def _megasolve_available(self, many: bool = False) -> bool:
+        """Route through the fused whole-solve program? Mirrors
+        KSP._megasolve_eligible: configurations without a fused
+        equivalent — including, for the block form, PCs without a
+        batched apply — fall back to the unfused host loop silently."""
+        if not self.megasolve or self._inner_op is None:
+            return False
+        nullspace = getattr(self._inner_op, "nullspace", None)
+        if nullspace is not None and getattr(nullspace, "dim", 0) > 0:
+            return False              # no fused projection exists —
+            #                           the unfused inner solves project
+        ksp = self.inner
+        if ksp._monitors or ksp._monitor_flag or hasattr(ksp, "_history"):
+            return False
+        if ksp._norm_type != "default" or ksp.unroll != 1:
+            return False
+        from .megasolve import megasolve_supported
+        if not megasolve_supported(ksp.get_type(), ksp.get_pc(),
+                                   self._inner_op,
+                                   nrhs=2 if many else None):
+            return False
+        return self._outer_operator() is not None
+
+    def _solve_fused_impl(self, b):
+        """ONE dispatch from refinement loop to verified answer: the
+        whole Wilkinson recurrence — storage-eps-floored inner targets
+        preserved — runs as the fused program's outer ``while_loop``,
+        with the fp64 true residual as the exit gate
+        (solvers/megasolve.py). Results mirror :meth:`_solve_impl`."""
+        from ..resilience import faults as _faults
+        from ..utils.convergence import ConvergedReason as _CR
+        from ..utils.dtypes import tolerance_dtype
+        from .krylov import donation_supported
+        from .megasolve import build_megasolve_program
+        import jax
+        import jax.numpy as jnp
+        ksp = self.inner
+        op = self._inner_op
+        outer = self._outer_operator()
+        comm = op.comm
+        b = np.asarray(b, dtype=np.float64)
+        _faults.check("ksp.solve")
+        ksp._check_guard()
+        with _telemetry.span("ksp.setup"):
+            ksp.set_up()
+        pc = ksp.get_pc()
+        self._arm_inner_guards()
+        op_dt = np.dtype(op.dtype)
+        guard = ksp._guard_requested()
+        cs_args, abft_pc_on = ((), False)
+        if guard:
+            cs_args, abft_pc_on = ksp._guard_checksums(op, pc, op_dt)
+        with _telemetry.span("ksp.setup"):
+            prog = build_megasolve_program(
+                comm, ksp.get_type(), pc, op,
+                None if outer is op else outer, zero_guess=True,
+                abft=guard and ksp.abft, abft_pc=abft_pc_on,
+                rr=guard and ksp._effective_replacement() > 0,
+                donate=True)
+        dt_in = tolerance_dtype(op_dt)
+        dt_out = np.dtype(np.float64)
+        guard_scalars = ((dt_in.type(ksp.abft_tol),
+                          np.int32(ksp._effective_replacement()))
+                         if guard else ())
+        xvec = Vec.from_global(comm, np.zeros_like(b), dtype=np.float64,
+                               layout=outer.layout)
+        bvec = Vec.from_global(comm, b, dtype=np.float64,
+                               layout=outer.layout)
+        x0d = (jnp.array(xvec.data) if donation_supported()
+               else xvec.data)
+        op_args = (() if outer is op else (outer.device_arrays(),)) \
+            + (op.device_arrays(), pc.device_arrays()) + tuple(cs_args)
+        fault = _faults.triggered("ksp.program")
+        if fault is None:
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
+        if fault is not None:
+            raise fault.error()
+        t0 = time.perf_counter()
+        with _telemetry.span("ksp.dispatch"):
+            _telemetry.record_program_dispatch("megasolve")
+            out = prog(*op_args, bvec.data, x0d,
+                       dt_out.type(self.rtol), dt_out.type(self.atol),
+                       dt_in.type(self._effective_inner_rtol()),
+                       dt_in.type(ksp.divtol),
+                       np.int32(self._INNER_MAX_IT),
+                       np.int32(self.max_refine),
+                       # stagnation reports DIVERGED_BREAKDOWN — the
+                       # unfused Wilkinson loop's exact semantics
+                       np.int32(_CR.DIVERGED_BREAKDOWN), *guard_scalars)
+        xvec.data = out[0]
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get(tuple(out[1:5])
+                                   + (tuple(out[5:7]) if guard else ()))
+        from ..utils.profiling import record_sync
+        record_sync("KSP result fetch/solve")
+        steps, iters = int(fetch[0]), int(fetch[1])
+        rnorm, reason = float(fetch[2]), int(fetch[3])
+        wall = time.perf_counter() - t0
+        if guard:
+            det, rrc = int(fetch[4]), int(fetch[5])
+            checks = ((steps + iters * (1 + int(abft_pc_on)))
+                      if ksp.abft else 0)
+            from ..utils.profiling import record_sdc
+            from ..utils.errors import SilentCorruptionError
+            from .krylov import SDC_DETECTOR_NAMES, SDC_NONE
+            if det != SDC_NONE:
+                record_sdc(checks, 1, rrc)
+                raise SilentCorruptionError(
+                    "KSPSolve", SDC_DETECTOR_NAMES.get(det, f"det{det}"),
+                    iters,
+                    detail=f"detected inside the fused refinement loop "
+                           f"at outer step {steps} ({rrc} "
+                           "replacement(s) passed)")
+            record_sdc(checks, 0, rrc)
+        fault = _faults.triggered("ksp.result")
+        if fault is not None:
+            rnorm = float("nan") if fault.kind == "nan" else float("inf")
+        if not np.isfinite(rnorm):
+            reason = _CR.DIVERGED_NANORINF
+        self.refine_steps = steps
+        self.result = SolveResult(iters, rnorm, int(reason), wall)
+        from ..utils.profiling import record_event
+        record_event(f"RefinedKSP({ksp.get_type()}+{pc.get_type()}+mega,"
+                     f"{self.inner_precision})", op.shape[0], iters, wall,
+                     int(reason))
+        return xvec.to_numpy(), self.result
+
+    def _solve_many_fused_impl(self, B):
+        """Fused block refinement: the whole ``(n, nrhs)`` block's outer
+        recurrence in ONE launch, per-column masked freezing at both
+        loop levels. Results mirror :meth:`_solve_many_impl` (aggregate
+        inner-iteration count, worst column's final residual)."""
+        from ..resilience import faults as _faults
+        from ..utils.convergence import ConvergedReason as _CR
+        from ..utils.dtypes import tolerance_dtype
+        from .krylov import donation_supported
+        from .megasolve import build_megasolve_program_many
+        import jax
+        import jax.numpy as jnp
+        ksp = self.inner
+        op = self._inner_op
+        outer = self._outer_operator()
+        comm = op.comm
+        B = np.asarray(B, dtype=np.float64)
+        if B.ndim != 2:
+            raise ValueError(f"solve_many needs an (n, nrhs) block, got "
+                             f"{B.shape}")
+        k = int(B.shape[1])
+        _faults.check("ksp.solve")
+        ksp._check_guard()
+        with _telemetry.span("ksp.setup"):
+            ksp.set_up()
+        pc = ksp.get_pc()
+        self._arm_inner_guards()
+        op_dt = np.dtype(op.dtype)
+        guard = ksp._guard_requested()
+        cs_args, abft_pc_on = ((), False)
+        if guard:
+            cs_args, abft_pc_on = ksp._guard_checksums(op, pc, op_dt)
+        with _telemetry.span("ksp.setup"):
+            prog = build_megasolve_program_many(
+                comm, ksp.get_type(), pc, op,
+                None if outer is op else outer, nrhs=k, zero_guess=True,
+                abft=guard and ksp.abft, abft_pc=abft_pc_on,
+                rr=guard and ksp._effective_replacement() > 0,
+                donate=True)
+        dt_in = tolerance_dtype(op_dt)
+        dt_out = np.dtype(np.float64)
+        guard_scalars = ((dt_in.type(ksp.abft_tol),
+                          np.int32(ksp._effective_replacement()))
+                         if guard else ())
+        Bd, Xd0 = comm.put_rows_many([B, np.zeros_like(B)])
+        if donation_supported():
+            Xd0 = jnp.array(Xd0)
+        op_args = (() if outer is op else (outer.device_arrays(),)) \
+            + (op.device_arrays(), pc.device_arrays()) + tuple(cs_args)
+        fault = _faults.triggered("ksp.program")
+        if fault is None:
+            fault = _faults.mesh_fault("device.lost", comm.device_ids)
+        if fault is not None:
+            raise fault.error()
+        t0 = time.perf_counter()
+        with _telemetry.span("ksp.dispatch"):
+            _telemetry.record_program_dispatch("megasolve_many")
+            out = prog(*op_args, Bd, Xd0,
+                       dt_out.type(self.rtol), dt_out.type(self.atol),
+                       dt_in.type(self._effective_inner_rtol()),
+                       dt_in.type(ksp.divtol),
+                       np.int32(self._INNER_MAX_IT),
+                       np.int32(self.max_refine),
+                       np.int32(_CR.DIVERGED_BREAKDOWN), *guard_scalars)
+        with _telemetry.span("ksp.fetch"):
+            fetch = jax.device_get(tuple(out[:5])
+                                   + (tuple(out[5:7]) if guard else ()))
+        from ..utils.profiling import record_sync
+        record_sync("KSP solve_many result fetch")
+        n = op.shape[0]
+        X = np.asarray(fetch[0])[:n].astype(np.float64, copy=False)
+        steps = int(fetch[1])
+        iters = np.asarray(fetch[2])
+        rn = np.asarray(fetch[3], dtype=float)
+        reasons = np.asarray(fetch[4])
+        wall = time.perf_counter() - t0
+        if guard:
+            det_h = np.asarray(fetch[5])
+            rrc_h = np.asarray(fetch[6])
+            checks = ((k * steps + int(iters.sum())
+                       * (1 + int(abft_pc_on))) if ksp.abft else 0)
+            from ..utils.profiling import record_sdc
+            from ..utils.errors import SilentCorruptionError
+            from .krylov import SDC_DETECTOR_NAMES, SDC_NONE
+            if int(det_h.max(initial=0)) != SDC_NONE:
+                bad = [j for j in range(k) if int(det_h[j]) != SDC_NONE]
+                record_sdc(checks, len(bad), int(rrc_h.sum()))
+                raise SilentCorruptionError(
+                    "KSPSolveMany",
+                    SDC_DETECTOR_NAMES.get(int(det_h[bad[0]]),
+                                           str(int(det_h[bad[0]]))),
+                    int(iters.max(initial=0)),
+                    detail=f"columns {bad} flagged inside the fused "
+                           "refinement loop")
+            record_sdc(checks, 0, int(rrc_h.sum()))
+        conv = np.isfinite(rn) & np.asarray(
+            [int(r) > 0 for r in reasons])
+        if bool(conv.all()):
+            reason = _CR.CONVERGED_RTOL
+        elif not np.all(np.isfinite(rn)):
+            reason = _CR.DIVERGED_NANORINF
+        elif all(int(r) == _CR.DIVERGED_BREAKDOWN
+                 for r in reasons[~conv]):
+            reason = _CR.DIVERGED_BREAKDOWN
+        else:
+            reason = _CR.DIVERGED_MAX_IT
+        self.refine_steps = steps
+        self.result = SolveResult(int(iters.max(initial=0)),
+                                  float(rn.max(initial=0.0)),
+                                  int(reason), wall)
+        from ..utils.profiling import record_event
+        record_event(f"RefinedKSP({ksp.get_type()}+{pc.get_type()}+mega,"
+                     f"{self.inner_precision},k={k})", n,
+                     self.result.iterations, wall, int(reason))
+        return X, self.result
+
     def solve(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
         """Solve A x = b (fp64 in/out). Returns (x, result)."""
         A = self._A_host
@@ -185,6 +478,8 @@ class RefinedKSP:
             return x, res
 
     def _solve_impl(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
+        if self._megasolve_available():
+            return self._solve_fused_impl(b)
         A = self._A_host
         b = np.asarray(b, dtype=np.float64)
         bnorm = np.linalg.norm(b)
@@ -271,6 +566,8 @@ class RefinedKSP:
             return X, res
 
     def _solve_many_impl(self, B):
+        if self._megasolve_available(many=True):
+            return self._solve_many_fused_impl(B)
         A = self._A_host
         B = np.asarray(B, dtype=np.float64)
         if B.ndim != 2:

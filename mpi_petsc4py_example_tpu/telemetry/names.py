@@ -61,6 +61,13 @@ NAMES = {
     "serving.request": ("span", "one request submit -> resolve, linked to "
                                 "its batch via the batch_span attr"),
     # ---- counters ----
+    "dispatch.programs": ("counter", "compiled-program launches by "
+                                     "program kind (ksp/ksp_many/"
+                                     "megasolve/...); each launch also "
+                                     "increments the 'dispatches' attr "
+                                     "of the current root span — the "
+                                     "megasolve one-launch gate's "
+                                     "measurement"),
     "solve.count": ("counter", "solves by event label (KSPSolve(...), "
                                "EPSSolve(...), ...)"),
     "solve.iterations": ("counter", "total solver iterations"),

@@ -192,6 +192,24 @@ def current_span():
     return st[-1] if st else None
 
 
+def record_program_dispatch(kind: str, count: int = 1):
+    """Count one compiled-program launch (a ``prog(...)`` execute call).
+
+    Two sinks: the always-on metrics counter ``dispatch.programs``
+    (labeled by program kind — ksp / ksp_many / megasolve /
+    megasolve_many), and — when spans are armed — the ``dispatches``
+    attribute of THIS thread's current ROOT span, so every ``ksp.solve``
+    / ``serving.dispatch`` tree reports how many launches served the
+    request. That per-root attribute is the megasolve acceptance gate's
+    measurement: a fused solve must report exactly 1.
+    """
+    from .metrics import registry
+    registry.counter("dispatch.programs").inc(count, label=kind)
+    if _ENABLED and _tls.stack:
+        root = _tls.stack[0]
+        root.attrs["dispatches"] = root.attrs.get("dispatches", 0) + count
+
+
 def _finish_root(sp: Span):
     if not _ENABLED:
         # a span opened while armed may finish after disable() (e.g. a

@@ -82,7 +82,13 @@ def while_body_reduce_sites(stablehlo_text: str,
 def solver_loop_reduce_sites(stablehlo_text: str) -> int:
     """The reduce-site count of a solve program's MAIN loop: the while op
     with the largest body (the Krylov iteration — monitors/power
-    iterations/helper loops are smaller in every program this gates)."""
+    iterations/helper loops are smaller in every program this gates).
+
+    NOTE: the count INCLUDES sites inside nested while ops (a fused
+    megasolve program's outer loop body contains the whole inner Krylov
+    loop); use :func:`nested_loop_reduce_site_chain` to pin the
+    per-depth schedules of doubly-nested programs.
+    """
     lines = stablehlo_text.splitlines()
     best_len, best_sites = -1, 0
     for i, line in enumerate(lines):
@@ -92,3 +98,85 @@ def solver_loop_reduce_sites(stablehlo_text: str) -> int:
         if len(body) > best_len:
             best_len, best_sites = len(body), _count_sites(body)
     return best_sites
+
+
+# ---------------------------------------------------------------------------
+# doubly-nested while bodies (fused megasolve programs): the outer
+# refinement loop wraps the inner Krylov loop, so per-depth schedules
+# need nested-region-aware counting
+# ---------------------------------------------------------------------------
+
+
+def _nested_while_spans(body_lines) -> list[tuple[int, int]]:
+    """Half-open ``(start, end)`` line-index ranges of every top-level
+    nested ``stablehlo.while`` OP inside a body-region line list — the
+    whole op, cond and do regions both, by brace counting from the
+    header line."""
+    spans = []
+    i = 0
+    while i < len(body_lines):
+        if "stablehlo.while" not in body_lines[i]:
+            i += 1
+            continue
+        depth = 0
+        opened = False
+        j = i
+        while j < len(body_lines):
+            depth += (body_lines[j].count("{")
+                      - body_lines[j].count("}"))
+            if depth > 0:
+                opened = True
+            if opened and depth <= 0:
+                break
+            j += 1
+        spans.append((i, min(j + 1, len(body_lines))))
+        i = spans[-1][1]
+    return spans
+
+
+def _own_sites(body_lines, exclude_conditionals=True) -> int:
+    """Reduce sites of a loop body EXCLUDING nested while regions — the
+    body's own per-iteration schedule."""
+    spans = _nested_while_spans(body_lines)
+    skip = set()
+    for a, b in spans:
+        skip.update(range(a, b))
+    kept = [ln for idx, ln in enumerate(body_lines) if idx not in skip]
+    return _count_sites(kept, exclude_conditionals)
+
+
+def nested_loop_reduce_site_chain(stablehlo_text: str,
+                                  exclude_conditionals: bool = True
+                                  ) -> list[int]:
+    """Per-depth OWN reduce-site counts along the largest-body while
+    chain of a lowered program.
+
+    Element 0 is the outermost solver loop's own schedule (sites per
+    outer iteration, nested loops excluded), element 1 its largest
+    nested while's own schedule, and so on. A fused megasolve program
+    reports ``[outer refinement sites, inner Krylov sites]`` — the
+    collective-volume gates pin element 1 at the 3/2/1 schedules the
+    unfused programs honor (the fusion must not change the inner loop's
+    per-iteration communication), and element 0 at the outer recurrence's
+    fixed cost (the inner init reductions + the fp64 exit-gate psum).
+    Unfused (singly-nested) programs report a one-element chain.
+    """
+    lines = stablehlo_text.splitlines()
+    best_len, best_body = -1, []
+    for i, line in enumerate(lines):
+        if "stablehlo.while" not in line:
+            continue
+        body = _body_region(lines, i)
+        if len(body) > best_len:
+            best_len, best_body = len(body), body
+    if best_len < 0:
+        return []
+    chain = []
+    body = best_body
+    while True:
+        chain.append(_own_sites(body, exclude_conditionals))
+        spans = _nested_while_spans(body)
+        if not spans:
+            return chain
+        a, b = max(spans, key=lambda s: s[1] - s[0])
+        body = _body_region(body[a:b], 0)

@@ -44,6 +44,11 @@ KNOWN_FLAGS = {
                            "outer refinement",
     "ksp_lgmres_augment": "LGMRES augmentation subspace size",
     "ksp_max_it": "maximum iterations",
+    "ksp_megasolve": "route eligible cg/pipecg solves (and RefinedKSP "
+                     "refinement) through the FUSED whole-solve program: "
+                     "one compiled-program launch from the refinement "
+                     "loop to the verified answer "
+                     "(solvers/megasolve.py)",
     "ksp_monitor": "print the residual norm each iteration",
     "ksp_norm_type": "monitored norm (default/none/preconditioned/"
                      "unpreconditioned/natural)",

@@ -336,6 +336,16 @@ def log_view(file=None):
                   f"{info['seconds']:9.4f} s "
                   f"{info['achieved_gbps']:8.1f} GB/s "
                   f"({info['episodes']} episode(s))", file=file)
+    dispatches = dispatch_counts()
+    if dispatches:
+        # the megasolve measurement row: launches by program kind — a
+        # fused solve contributes exactly one 'megasolve' launch where
+        # the unfused refinement path pays one 'ksp' per outer step
+        parts = ", ".join(f"{k}: {int(v)}"
+                          for k, v in sorted(dispatches.items()))
+        total_d = int(sum(dispatches.values()))
+        print(f"compiled-program dispatches: {total_d} [{parts}]",
+              file=file)
     if per_iter.count:
         # the fixed-bucket per-iteration latency histogram (cfg12's
         # -log_view row): only occupied buckets, cumulative-free
@@ -352,10 +362,19 @@ def log_view(file=None):
     print(f"compiled programs held: {program_count()}", file=file)
 
 
+def dispatch_counts() -> dict[str, float]:
+    """Compiled-program launches by program kind (ksp / ksp_many /
+    megasolve / megasolve_many) — the ``dispatch.programs`` registry
+    counter the per-root-span ``dispatches`` attribute mirrors."""
+    return {str(k): v for k, v in
+            _REG.counter("dispatch.programs").items().items()}
+
+
 def program_count() -> int:
-    """Total jit-compiled solver programs cached this process (KSP + EPS)
-    — each costs one trace + compile-cache load per fresh process, the
-    dominant fixed cost of short driver runs on remote runtimes."""
+    """Total jit-compiled solver programs cached this process (KSP + EPS
+    + fused megasolve) — each costs one trace + compile-cache load per
+    fresh process, the dominant fixed cost of short driver runs on
+    remote runtimes."""
     n = 0
     try:
         from ..solvers.krylov import _PROGRAM_CACHE as kc
@@ -365,6 +384,12 @@ def program_count() -> int:
     try:
         from ..solvers.eps import _PROGRAM_CACHE as ec
         n += len(ec)
+    except (ImportError, AttributeError):
+        pass
+    try:
+        from ..solvers.megasolve import (_MEGASOLVE_CACHE as mc,
+                                         _MEGASOLVE_CACHE_MANY as mcm)
+        n += len(mc) + len(mcm)
     except (ImportError, AttributeError):
         pass
     return n

@@ -1,0 +1,68 @@
+"""TPS015 fixtures — host loops that dispatch a compiled program per
+iteration (each marked loop must be flagged)."""
+
+import numpy as np
+
+from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+
+
+def direct_program_in_loop(comm, pc, mat, b, x0):
+    # BAD: the reaching-defs provenance of `prog` is a program factory;
+    # every trip pays a full dispatch
+    prog = build_ksp_program(comm, "cg", pc, mat)
+    outs = []
+    for _ in range(8):  # BAD: TPS015
+        outs.append(prog(mat.device_arrays(), pc.device_arrays(), b, x0,
+                         1e-8, 0.0, 0.0, np.int32(50)))
+    return outs
+
+
+def immediate_builder_call_in_loop(comm, pc, mat, b, x0):
+    results = []
+    while len(results) < 4:  # BAD: TPS015
+        # BAD: build-and-invoke inside the loop body
+        results.append(build_ksp_program(comm, "cg", pc, mat)(
+            mat.device_arrays(), pc.device_arrays(), b, x0,
+            1e-8, 0.0, 0.0, np.int32(50)))
+    return results
+
+
+def _helper_dispatch(comm, pc, mat, args):
+    prog = build_ksp_program(comm, "cg", pc, mat)
+    return prog(*args)
+
+
+def dispatch_through_local_helper(comm, pc, mat, args):
+    def run_once():
+        return _helper_dispatch(comm, pc, mat, args)
+
+    total = []
+    for _ in range(3):  # BAD: TPS015
+        # BAD: resolves through the call graph (run_once ->
+        # _helper_dispatch), whose body invokes the program
+        total.append(run_once())
+    return total
+
+
+class Driver:
+    """The RefinedKSP shape: a host loop driving self.<attr>.solve."""
+
+    def __init__(self, comm, pc, mat):
+        self.prog = None
+        self.engine = Engine(comm, pc, mat)
+
+    def refine(self, r):
+        for _ in range(20):  # BAD: TPS015
+            # BAD: self.engine is an Engine by construction; its solve
+            # invokes a compiled program
+            r = self.engine.solve(r)
+        return r
+
+
+class Engine:
+    def __init__(self, comm, pc, mat):
+        self._comm, self._pc, self._mat = comm, pc, mat
+
+    def solve(self, r):
+        prog = build_ksp_program(self._comm, "cg", self._pc, self._mat)
+        return prog(r)

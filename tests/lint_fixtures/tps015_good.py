@@ -1,0 +1,57 @@
+"""TPS015 negative fixtures — loops that must NOT be flagged."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+
+
+def single_dispatch_no_loop(comm, pc, mat, b, x0):
+    # GOOD: one launch, no host loop
+    prog = build_ksp_program(comm, "cg", pc, mat)
+    return prog(mat.device_arrays(), pc.device_arrays(), b, x0,
+                1e-8, 0.0, 0.0, np.int32(50))
+
+
+def host_loop_over_host_work(values):
+    # GOOD: the loop body calls no compiled program
+    total = 0.0
+    for v in values:
+        total += float(np.linalg.norm(v))
+    return total
+
+
+def loop_builds_but_dispatches_once(comms, pc, mat, b, x0):
+    # GOOD: building/warming programs in a loop is a compile-time cost,
+    # not a per-iteration dispatch — only INVOCATIONS are flagged
+    progs = []
+    for comm in comms:
+        progs.append(build_ksp_program(comm, "cg", pc, mat))
+    return progs
+
+
+def fused_device_loop(b, x0):
+    # GOOD: the recurrence lives in lax.while_loop INSIDE the program —
+    # the megasolve discipline
+    @jax.jit
+    def prog(b, x):
+        def body(st):
+            x, k = st
+            return x * 0.5 + b, k + 1
+
+        def cond(st):
+            return st[1] < 10
+
+        return lax.while_loop(cond, body, (x, jnp.int32(0)))
+
+    return prog(b, x0)
+
+
+def deferred_closure_in_loop(prog, xs):
+    # GOOD: the loop only DEFINES closures; nothing dispatches here
+    thunks = []
+    for x in xs:
+        thunks.append(lambda x=x: prog(x))
+    return thunks

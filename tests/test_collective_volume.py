@@ -774,3 +774,139 @@ class TestMixedPrecisionVolume:
         assert by and any(v > n_pad * 2 for v in by), by
         with pytest.raises(AssertionError):
             assert all(v == n_pad * 2 for v in by)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: fused megasolve programs — doubly-nested while schedules
+# ---------------------------------------------------------------------------
+
+
+def _lower_megasolve(comm, ksp_type, pc_type="jacobi", guard=False,
+                     rr=False, nrhs=None):
+    import os
+    from mpi_petsc4py_example_tpu.resilience import abft
+    from mpi_petsc4py_example_tpu.solvers.megasolve import (
+        build_megasolve_program, build_megasolve_program_many)
+    # the AOT wrapper hides .lower(); build the raw jitted program (the
+    # TestBatchedProgramVolume discipline) — aot_on is part of the
+    # cache key, so this never pollutes the wrapped-program cache
+    prev = os.environ.get("TPU_SOLVE_AOT")
+    os.environ["TPU_SOLVE_AOT"] = "0"
+    try:
+        return _lower_megasolve_raw(comm, ksp_type, pc_type, guard, rr,
+                                    nrhs, abft, build_megasolve_program,
+                                    build_megasolve_program_many)
+    finally:
+        if prev is None:
+            os.environ.pop("TPU_SOLVE_AOT", None)
+        else:
+            os.environ["TPU_SOLVE_AOT"] = prev
+
+
+def _lower_megasolve_raw(comm, ksp_type, pc_type, guard, rr, nrhs, abft,
+                         build_megasolve_program,
+                         build_megasolve_program_many):
+    M = tps.Mat.from_scipy(comm, _ell_matrix(512))
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type(ksp_type)
+    ksp.get_pc().set_type(pc_type)
+    ksp.set_up()
+    pc = ksp.get_pc()
+    dt = np.dtype(np.float64)
+    from mpi_petsc4py_example_tpu.utils.convergence import ConvergedReason
+    scal = (dt.type(1e-10), dt.type(0.0), dt.type(1e-10), dt.type(0.0),
+            np.int32(50), np.int32(4),
+            np.int32(ConvergedReason.DIVERGED_MAX_IT))
+    cs_args = ()
+    if guard:
+        cs = abft.column_checksum(M)
+        csM = abft.pc_checksum(pc, M)
+        cs_args = tuple(comm.put_rows_many([cs, csM]))
+        scal = scal + (dt.type(256.0), np.int32(25 if rr else 0))
+    if nrhs is not None:
+        prog = build_megasolve_program_many(
+            comm, ksp_type, pc, M, None, nrhs=nrhs, abft=guard,
+            abft_pc=guard, rr=rr)
+        Bp = comm.put_rows(np.zeros((512, nrhs)))
+        X0 = comm.put_rows(np.zeros((512, nrhs)))
+        return prog.lower(M.device_arrays(), pc.device_arrays(), *cs_args,
+                          Bp, X0, *scal).as_text()
+    prog = build_megasolve_program(comm, ksp_type, pc, M, None,
+                                   abft=guard, abft_pc=guard, rr=rr)
+    x, b = M.get_vecs()
+    return prog.lower(M.device_arrays(), pc.device_arrays(), *cs_args,
+                      b.data, x.data, *scal).as_text()
+
+
+class TestMegasolveReduceSites:
+    """ISSUE 12 acceptance: the fused whole-solve programs keep the
+    UNFUSED inner schedules — 3 (classic plain) / 2 (guarded, and the
+    batched pduo plan) / 1 (pipelined) reduce sites per inner iteration
+    — pinned on the INNER while body via the nested-region-aware parser
+    (utils/hlo.nested_loop_reduce_site_chain), with the outer refinement
+    loop's own fixed cost (inner init reductions + the fp64 exit-gate
+    psum) pinned separately. Whole-body counts can't see this: the outer
+    body CONTAINS the inner loop, so the flat count is their sum."""
+
+    def test_fused_inner_schedules_3_2_1(self, comm8):
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            nested_loop_reduce_site_chain)
+        # classic CG inner: 3 sites; outer = 3 init reductions + 1 gate
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "cg")) == [4, 3]
+        # guarded CG inner keeps the 2-site stacked phases; outer init
+        # is the guard's 2 stacked psums + the gate
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "cg", guard=True, rr=True)) == [3, 2]
+        # pipelined inner keeps the ONE-site contract inside the fused
+        # loop; outer = bnorm + rn0 + the lag-correcting final true
+        # norm + the exit gate
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "pipecg")) == [4, 1]
+
+    def test_fused_batched_schedule(self, comm8):
+        """The batched fused inner keeps the 2-phase pduo plan's count
+        (the same schedule build_ksp_program_many pins), independent of
+        nrhs."""
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            nested_loop_reduce_site_chain)
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "cg", nrhs=8)) == [4, 2]
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "cg", nrhs=1)) == [4, 2]
+
+    def test_fused_gather_volume_unchanged(self, comm8):
+        """Collective-volume gate: every all-gather in the fused program
+        is one padded vector (the inner SpMV's x-gather) — fusion adds
+        the outer recurrence, not gather traffic."""
+        txt = _lower_megasolve(comm8, "cg")
+        vols = all_gather_volumes(txt)
+        n_pad = comm8.padded_size(512)
+        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+
+    def test_injected_extra_psum_fails_gate(self, comm8, monkeypatch):
+        """Teeth: splitting the pipelined plan's fuse_psum seam into two
+        collectives must show up as a 2-site INNER schedule in the fused
+        program — proving the nested gate catches a regression the flat
+        count would smear into the outer total."""
+        import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
+        import mpi_petsc4py_example_tpu.solvers.megasolve as mega_mod
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            nested_loop_reduce_site_chain)
+
+        def split_fuse(parts, psum, axis, dtype):
+            parts = [jnp.asarray(q, dtype) for q in parts]
+            head = psum(jnp.stack(parts[:1]), axis)
+            tail = psum(jnp.stack(parts[1:]), axis)
+            return jnp.concatenate([head, tail])
+
+        mega_mod._MEGASOLVE_CACHE.clear()
+        monkeypatch.setattr(cg_plans, "fuse_psum", split_fuse)
+        try:
+            chain = nested_loop_reduce_site_chain(
+                _lower_megasolve(comm8, "pipecg"))
+            assert chain[1] == 2, chain
+        finally:
+            monkeypatch.undo()
+            mega_mod._MEGASOLVE_CACHE.clear()

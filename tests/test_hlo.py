@@ -242,3 +242,69 @@ def test_parser_against_real_lowered_program(nsites):
     fn = jax.jit(comm.shard_map(local_fn, (P(axis),), P(axis)))
     text = fn.lower(jnp.ones(8)).as_text()
     assert solver_loop_reduce_sites(text) == nsites
+
+
+# --------------------------------------- doubly-nested chains (megasolve)
+def test_nested_chain_separates_outer_and_inner():
+    """nested_loop_reduce_site_chain splits the fused program's schedule
+    by depth: the outer body's OWN sites (nested while excluded) and the
+    inner loop's sites — the flat largest-body count smears them."""
+    from mpi_petsc4py_example_tpu.utils.hlo import (
+        nested_loop_reduce_site_chain)
+    lines = [
+        '%r0 = "stablehlo.all_reduce"(%p0) ({',
+        '  ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '    %s = stablehlo.add %a, %b : tensor<f64>',
+        '    stablehlo.return %s : tensor<f64>',
+        '}) : (tensor<8xf64>) -> tensor<8xf64>',
+        '%inner:2 = stablehlo.while(%jArg = %r0, %jArg_0 = %k) : '
+        'tensor<8xf64>, tensor<i32>',
+        ' cond {',
+        '  %ic = stablehlo.compare LT, %jArg_0, %m : tensor<i1>',
+        '  stablehlo.return %ic : tensor<i1>',
+        '} do {',
+        '  %ir = "stablehlo.all_reduce"(%jArg) ({',
+        '    ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '      %s = stablehlo.add %a, %b : tensor<f64>',
+        '      stablehlo.return %s : tensor<f64>',
+        '  }) : (tensor<8xf64>) -> tensor<8xf64>',
+        '  %ir2 = "stablehlo.all_reduce"(%ir) ({',
+        '    ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '      %s = stablehlo.add %a, %b : tensor<f64>',
+        '      stablehlo.return %s : tensor<f64>',
+        '  }) : (tensor<8xf64>) -> tensor<8xf64>',
+        '  stablehlo.return %ir2, %jArg_0 : tensor<8xf64>, tensor<i32>',
+        '}',
+        '%r1 = "stablehlo.all_reduce"(%inner#0) ({',
+        '  ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '    %s = stablehlo.add %a, %b : tensor<f64>',
+        '    stablehlo.return %s : tensor<f64>',
+        '}) : (tensor<8xf64>) -> tensor<8xf64>',
+        'stablehlo.return %r1, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ]
+    text = _while_program(lines)
+    # outer own: r0 (inner-init stand-in) + r1 (exit gate stand-in);
+    # inner: 2 per-iteration sites
+    assert nested_loop_reduce_site_chain(text) == [2, 2]
+    # the flat count on the same program includes the nested sites
+    assert solver_loop_reduce_sites(text) == 4
+
+
+def test_nested_chain_on_flat_program_is_one_element():
+    from mpi_petsc4py_example_tpu.utils.hlo import (
+        nested_loop_reduce_site_chain)
+    lines = [
+        '%ir = "stablehlo.all_reduce"(%iterArg) ({',
+        '  ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '    %s = stablehlo.add %a, %b : tensor<f64>',
+        '    stablehlo.return %s : tensor<f64>',
+        '}) : (tensor<8xf64>) -> tensor<8xf64>',
+        'stablehlo.return %ir, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ]
+    assert nested_loop_reduce_site_chain(_while_program(lines)) == [1]
+
+
+def test_nested_chain_empty_program():
+    from mpi_petsc4py_example_tpu.utils.hlo import (
+        nested_loop_reduce_site_chain)
+    assert nested_loop_reduce_site_chain("") == []

@@ -29,11 +29,16 @@ REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULE_IDS = ("TPS001", "TPS002", "TPS003", "TPS004", "TPS005", "TPS006",
             "TPS007", "TPS008", "TPS009", "TPS010", "TPS011", "TPS012",
-            "TPS013", "TPS014")
+            "TPS013", "TPS014", "TPS015")
 #: current advisory (warn-tier) count over the repo's own packages — the
 #: CI --warn-budget. Raising it requires looking at the new advisory and
 #: deciding it is acceptable; that is the tier's whole contract.
-REPO_WARN_BUDGET = 3
+#: 3 TPS011 adjacent-psum sites (round 6) + 10 TPS015 dispatch-in-host-
+#: loop sites (round 14: the EPS restart ladders, KSP's gate re-entry /
+#: batch-limit chunking / sequential fallback, and RefinedKSP's unfused
+#: host loops — all deliberate fallback/escalation paths; the fused
+#: megasolve programs are the non-loop route where one exists).
+REPO_WARN_BUDGET = 13
 
 _MARKER_RE = re.compile(r"#\s*BAD:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
 

@@ -120,6 +120,74 @@ def drill(label: str, ctx) -> list[str]:
     return [f"{label}: {p}" for p in problems]
 
 
+def drill_megasolve() -> list[str]:
+    """Silent corruption INSIDE the fused whole-solve loop
+    (``--megasolve``, ISSUE 12 satellite): with ``-ksp_megasolve`` the
+    entire refinement/verification recurrence is ONE compiled program —
+    a bitflip armed on the inner CG's operator apply must be detected by
+    the nested guarded plan loop's ABFT channel, freeze the fused outer
+    recurrence, surface the verified-iterate carry (the rollback
+    target), and recover through the resilient ladder to an fp64-parity
+    answer — at exactly ONE compiled-program launch per attempt, proven
+    from the telemetry dispatch counter (detection -> rollback ->
+    re-entry still costs one dispatch each way)."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.utils.profiling import dispatch_counts
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(12)
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=RTOL)
+    ksp.megasolve = True
+    ksp.abft = True
+    ksp.residual_replacement = 10
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+    before = dispatch_counts()
+    with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+        res = tps.resilient_solve(
+            ksp, bv, x, tps.RetryPolicy(sleep=lambda _d: None))
+    after = dispatch_counts()
+    mega = int(after.get("megasolve", 0) - before.get("megasolve", 0))
+    other = int(sum(after.values()) - sum(before.values())) - mega
+    detectors = [e.detector for e in res.recovery_events
+                 if e.kind == "fault" and e.detector]
+    if not detectors:
+        problems.append("fused-loop corruption went UNDETECTED")
+    if not any(e.kind == "rollback" for e in res.recovery_events):
+        problems.append("no rollback re-entry in the recovery trail")
+    if not any(e.kind == "verify" for e in res.recovery_events):
+        problems.append("no post-recovery true-residual verification ran")
+    if not res.converged:
+        problems.append(f"recovered fused solve did not converge: {res}")
+    if mega != res.attempts:
+        problems.append(
+            f"{mega} fused launches for {res.attempts} attempt(s) — the "
+            "one-dispatch-per-attempt contract broke under fire")
+    if other != 0:
+        problems.append(f"{other} UNFUSED program launch(es) on the "
+                        "megasolve path")
+    rtrue = (np.linalg.norm(b - A @ x.to_numpy()) / np.linalg.norm(b))
+    if not rtrue <= RTOL * 1.05:
+        problems.append(f"true relative residual {rtrue:.3e} misses rtol")
+    if not np.allclose(x.to_numpy(), x_true, atol=1e-7):
+        problems.append("recovered iterate differs from the manufactured "
+                        "solution")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] megasolve: {status} detectors={detectors} "
+          f"attempts={res.attempts} fused_launches={mega} "
+          f"true_rres={rtrue:.3e}")
+    return [f"megasolve: {p}" for p in problems]
+
+
 def drill_evict_solve() -> list[str]:
     """Permanent device loss MID-SOLVE: the elastic escalation must land
     the solve on a strictly smaller mesh, resumed from the checkpointed
@@ -358,6 +426,12 @@ def main() -> int:
         failures += drill_evict_solve()
         failures += drill_evict_serving()
         what = "device-eviction"
+    elif "--megasolve" in sys.argv[1:]:
+        # ISSUE 12 acceptance: a bitflip inside the FUSED whole-solve
+        # loop must detect -> rollback -> re-enter at one dispatch per
+        # attempt
+        failures += drill_megasolve()
+        what = "megasolve fused-loop corruption"
     elif env_spec:
         # env-armed: the plan is already active from the environment
         failures += drill(f"env:{env_spec}", contextlib.nullcontext())

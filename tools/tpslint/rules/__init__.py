@@ -5,7 +5,8 @@ from . import (tps001_host_sync, tps002_recompile, tps003_axis_name,
                tps004_dtype_drift, tps005_broad_except, tps006_pallas,
                tps007_options_registry, tps008_interproc_sync,
                tps009_sharding, tps010_grid_spec, tps011_psum_fusion,
-               tps012_fault_registry, tps013_donation, tps014_telemetry)
+               tps012_fault_registry, tps013_donation, tps014_telemetry,
+               tps015_dispatch_loop)
 
 
 def all_rules() -> dict:
