@@ -92,6 +92,7 @@ __all__ = [
     "resilient_solve_many", "ElasticPolicy", "HealthMonitor",
     "KSPFallbackChain",
     "SolveServer", "ServedSolveResult", "ServerClosedError",
+    "SolveRouter", "QoSClass", "AutoscalePolicy",
 ]
 
 
@@ -111,7 +112,8 @@ def __getattr__(name):
                 "resilient_solve_many", "KSPFallbackChain",
                 "ElasticPolicy", "HealthMonitor"):
         return getattr(resilience, name)
-    if name in ("SolveServer", "ServedSolveResult", "ServerClosedError"):
+    if name in ("SolveServer", "ServedSolveResult", "ServerClosedError",
+                "SolveRouter", "QoSClass", "AutoscalePolicy"):
         # the serving layer pulls in KSP + resilience machinery — lazy,
         # like the other solver-object imports above
         from . import serving as _serving

@@ -48,18 +48,31 @@ DEFAULT_ABFT_TOL = 256.0
 def _bitflip(y):
     """Flip a high exponent bit of element 0 — one localized, huge error
     (the single-event-upset model). Bitcast for real floats; complex
-    dtypes corrupt by sign+magnitude instead (no complex bitcast)."""
+    dtypes corrupt by sign+magnitude instead (no complex bitcast).
+
+    A ZERO word needs its own arm: the exponent-bit flip of 0.0 lands at
+    a denormal-scale value (2^-63 for f32) and ``x * -3`` keeps 0 at 0,
+    so a clause whose ``at=`` selected an apply of an all-zero operand —
+    the ``at=1`` init-residual site ``r = b - A(x0)`` under the default
+    zero guess — historically injected NOTHING and the one-shot window
+    was spent without a detectable fault ever firing. A real upset on a
+    zero word is as physical as any other, so zeros corrupt to unit
+    scale instead (regression: tests/test_resilience.py)."""
     import jax.numpy as jnp
     from jax import lax
     flat = y.ravel()
     if jnp.issubdtype(y.dtype, jnp.complexfloating):
-        flat = flat.at[0].multiply(-3.0)
+        hit = jnp.where(flat[0] == 0, jnp.asarray(1.0, y.dtype),
+                        flat[0] * -3.0)
+        flat = flat.at[0].set(hit)
     else:
         ibits = {2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[y.dtype.itemsize]
         bit = {2: 1 << 13, 4: 1 << 29, 8: 1 << 61}[y.dtype.itemsize]
         as_int = lax.bitcast_convert_type(flat, ibits)
         as_int = as_int.at[0].set(as_int[0] ^ bit)
-        flat = lax.bitcast_convert_type(as_int, y.dtype)
+        flipped = lax.bitcast_convert_type(as_int, y.dtype)
+        flat = flipped.at[0].set(
+            jnp.where(flat[0] == 0, jnp.asarray(1.0, y.dtype), flipped[0]))
     return flat.reshape(y.shape)
 
 

@@ -19,7 +19,12 @@ the escalation tier past same-mesh retries:
   mesh from surviving devices (8 -> 4 -> 2 -> 1 on the default
   power-of-two ladder, which keeps the compiled-program population
   bounded exactly like the serving layer's pad_pow2 policy) and
-  rebuilds operators / PC factors / solver sessions on it.
+  rebuilds operators / PC factors / solver sessions on it. Since the
+  fleet round the ladder also goes UP: :meth:`MeshRebuilder.grown_comm`
+  plans the largest viable strictly LARGER mesh over healed devices
+  (never past the mesh the caller originally provisioned) once a
+  :class:`~.faults.HealthMonitor` observes :func:`~.faults.heal` — a
+  repaired device is a capacity event, not permanent degradation.
 * helpers shared by retry.py's ``mesh_shrink`` escalation stage and the
   SolveServer's shrink adoption: :func:`rebuild_operator` (re-place the
   operand arrays on the new mesh — CSR matrices round-trip through
@@ -74,6 +79,11 @@ class ElasticPolicy:
         Allow a speculative halving when the repeated failures name no
         device (``-elastic_shrink_unattributed``, default off — see the
         module docstring).
+    ``regrow``
+        Arm the ladder's UPWARD direction (``-elastic_regrow``, default
+        on): once :func:`~.faults.heal` clears a lost device, a session
+        that previously shrank may be rebuilt onto the larger mesh —
+        never past the mesh the caller originally built it on.
     ``prefer_pow2``
         Land on power-of-two mesh sizes (the bounded-program-population
         ladder); False uses every surviving device.
@@ -82,6 +92,7 @@ class ElasticPolicy:
     max_same_mesh_retries: int = 2
     min_devices: int = 1
     shrink_unattributed: bool = False
+    regrow: bool = True
     prefer_pow2: bool = True
 
     @classmethod
@@ -95,6 +106,7 @@ class ElasticPolicy:
         p.min_devices = opt.get_int("elastic_min_devices", p.min_devices)
         p.shrink_unattributed = opt.get_bool(
             "elastic_shrink_unattributed", p.shrink_unattributed)
+        p.regrow = opt.get_bool("elastic_regrow", p.regrow)
         return p
 
 
@@ -140,6 +152,33 @@ class MeshRebuilder:
         if size < max(1, self.policy.min_devices) or size >= cur:
             return None
         return DeviceComm(devices=surv[:size], axis=comm.axis)
+
+    def grown_comm(self, comm, full_comm=None):
+        """The ladder's UPWARD direction: the largest viable STRICTLY
+        larger communicator over currently-HEALTHY members of
+        ``full_comm`` (the mesh the session was originally built on —
+        re-grow never exceeds what the caller provisioned; defaults to
+        the whole process device set), or None when no strictly larger
+        healthy mesh exists (nothing healed, the heal was partial below
+        the next pow2 rung, or the session never shrank). The symmetric
+        twin of :meth:`shrunk_comm`, consulted when a
+        :class:`~.faults.HealthMonitor` observes :func:`~.faults.heal`.
+        """
+        from ..parallel.mesh import DeviceComm
+        if not self.policy.regrow:
+            return None
+        if full_comm is None:
+            full_comm = DeviceComm()
+        healthy = self.survivors(full_comm)
+        n = len(healthy)
+        cur = comm.size
+        if n <= cur:
+            return None
+        size = _largest_pow2_at_most(n) if self.policy.prefer_pow2 else n
+        if size <= cur:
+            return None
+        return DeviceComm(devices=healthy[:size], axis=comm.axis)
+
 
 def rebuild_operator(mat, comm_new):
     """Re-place an operator's operands on another communicator.
@@ -280,3 +319,22 @@ def shrink_solve_session(ksp, comm_new, *, checkpoint_path=None, b=None,
         rebuild_ksp(ksp, mat2)
         replant_vectors(comm_new, mat2, x, b)
     return iteration
+
+
+def regrow_solve_session(ksp, comm_new, *, checkpoint_path=None, b=None,
+                         x=None, B=None, X=None, many=False):
+    """Reshard a solve session onto a LARGER mesh after a heal — the
+    upward twin of :func:`shrink_solve_session`, with the identical
+    resume contract: the iterate/RHS state moves through the elastic
+    checkpoint (mesh-portable in BOTH directions — the format never
+    encoded a device count) or the in-memory host round trip, the
+    operands / PC factors / ABFT checksums are re-placed on the grown
+    geometry, and the returned iteration is where the resumed solve
+    continues from (never 0 when a checkpoint carried progress).
+
+    The resharding machinery is direction-agnostic by construction, so
+    this delegates; the separate name keeps call sites honest about
+    which way the ladder moved (telemetry/event kinds differ)."""
+    return shrink_solve_session(ksp, comm_new,
+                                checkpoint_path=checkpoint_path,
+                                b=b, x=x, B=B, X=X, many=many)

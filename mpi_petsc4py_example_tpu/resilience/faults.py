@@ -380,6 +380,14 @@ def trace_key():
 # futile and only the elastic shrink (resilience/elastic.py) helps.
 _LOST: dict[int, str] = {}
 
+# Monotonic heal generation: bumped by every heal() that actually cleared
+# a lost mark. Consumers that want to react to 'hardware came back'
+# (the elastic RE-GROW path — resilience/elastic.py ladder-up, the
+# SolveServer's degraded-capacity recovery) poll this instead of the
+# registry itself: an empty registry cannot distinguish 'never lost'
+# from 'lost and repaired', the epoch can.
+_HEAL_EPOCH = 0
+
 
 def lost_devices() -> frozenset:
     """Device ids currently marked lost (sticky until :func:`heal`)."""
@@ -397,14 +405,31 @@ def mark_lost(device_id: int, reason: str = "marked via faults.mark_lost"):
 def heal(device_id: int | None = None) -> tuple:
     """Clear the lost mark from one device (or all, when ``device_id`` is
     None) — the explicit 'hardware was replaced/repaired' signal. Returns
-    the ids that were healed."""
+    the ids that were healed. A heal that actually cleared something
+    bumps the process heal epoch (:func:`heal_epoch`) — the signal the
+    elastic RE-GROW ladder (resilience/elastic.py + serving) keys on."""
+    global _HEAL_EPOCH
     with _LOCK:
         if device_id is None:
             healed = tuple(sorted(_LOST))
             _LOST.clear()
-            return healed
-        return ((int(device_id),)
-                if _LOST.pop(int(device_id), None) is not None else ())
+        else:
+            healed = ((int(device_id),)
+                      if _LOST.pop(int(device_id), None) is not None
+                      else ())
+        if healed:
+            _HEAL_EPOCH += 1
+        return healed
+
+
+def heal_epoch() -> int:
+    """Monotonic count of effective :func:`heal` calls this process.
+    Cheap to poll (one lock acquisition, no device work): the
+    HealthMonitor and the serving layer compare it against a remembered
+    value to detect 'devices came back since I last looked' without
+    scanning device state."""
+    with _LOCK:
+        return _HEAL_EPOCH
 
 
 def check_lost(device_ids):
@@ -494,6 +519,7 @@ class HealthMonitor:
         self.threshold = max(1, int(threshold))
         self._counts: dict = {}       # device id (or None) -> failures
         self.failures = 0             # total recorded since last healthy()
+        self._heal_epoch = heal_epoch()   # heal generation last observed
 
     def record(self, exc) -> int | None:
         """Count one unavailable failure; returns the attributed device
@@ -519,6 +545,20 @@ class HealthMonitor:
         bucket) has failed ``threshold`` times — the same-mesh-retries-
         are-futile classification that triggers the shrink escalation."""
         return any(c >= self.threshold for c in self._counts.values())
+
+    def heal_observed(self) -> bool:
+        """True when :func:`heal` cleared a lost device since this
+        monitor was constructed (or since this method last returned
+        True) — the classification that turns the elastic ladder UPWARD:
+        a previously shrunk session may re-grow onto the repaired
+        hardware (resilience/elastic.MeshRebuilder.grown_comm). The
+        observation is consuming, like the failure evidence: one heal
+        triggers one re-grow attempt, not a re-grow per retry."""
+        ep = heal_epoch()
+        if ep != self._heal_epoch:
+            self._heal_epoch = ep
+            return True
+        return False
 
     def __repr__(self):
         return (f"HealthMonitor(threshold={self.threshold}, "

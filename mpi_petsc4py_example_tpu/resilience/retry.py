@@ -212,6 +212,10 @@ class _ElasticEscalation:
         self.monitor = HealthMonitor(
             threshold=self.policy.max_same_mesh_retries)
         self.rebuilder = MeshRebuilder(self.policy)
+        # the mesh this solve STARTED on: the re-grow ceiling — a heal
+        # may rebuild a shrunk session back up, never past what the
+        # caller provisioned (None until a shrink actually happened)
+        self.orig_comm = None
 
     def record(self, exc):
         """Count one failure toward the persistent-loss classification
@@ -234,6 +238,18 @@ class _ElasticEscalation:
         if (not self.policy.enabled
                 or getattr(exc, "failure_class", "") != "unavailable"):
             return None
+        # RE-GROW rung (the ladder's upward direction): this solve
+        # previously shrank, a heal has been observed since, and the
+        # healed hardware supports a strictly larger mesh — reshard the
+        # checkpointed iterate UP and resume there instead of retrying
+        # on degraded capacity. Bounded by orig_comm: only a session
+        # this escalation shrank may grow, and never past its original
+        # provisioning.
+        if (self.policy.regrow and self.orig_comm is not None
+                and self.monitor.heal_observed()):
+            grown = self.rebuilder.grown_comm(ksp.comm, self.orig_comm)
+            if grown is not None:
+                return grown
         ids = set(getattr(ksp.comm, "device_ids", ()))
         registry_hit = any(d in ids for d in _faults.lost_devices())
         if not (registry_hit or self.monitor.persistent()
@@ -242,14 +258,17 @@ class _ElasticEscalation:
         return self.rebuilder.shrunk_comm(ksp.comm,
                                           self.monitor.lost_devices())
 
-    def shrink(self, ksp, comm_new, events, attempt, *, persisted, path,
-               b=None, x=None, B=None, X=None, many=False) -> bool:
-        """Execute the rebuild onto ``comm_new``; False when the operator
-        cannot be rebuilt there (callers fall through to the original
+    def reshard(self, ksp, comm_new, events, attempt, *, persisted, path,
+                b=None, x=None, B=None, X=None, many=False) -> bool:
+        """Execute the rebuild onto ``comm_new`` — DOWN (mesh_shrink) or
+        UP (mesh_regrow, after a heal); False when the operator cannot
+        be rebuilt there (callers fall through to the original
         failure)."""
         from .elastic import shrink_solve_session
-        from ..utils.profiling import record_mesh_shrink
-        old_n = ksp.comm.size
+        from ..utils.profiling import record_mesh_regrow, record_mesh_shrink
+        old_comm = ksp.comm
+        old_n = old_comm.size
+        growing = comm_new.size > old_n
         t0 = time.perf_counter()
         try:
             it0 = shrink_solve_session(
@@ -259,14 +278,22 @@ class _ElasticEscalation:
         except ValueError:
             return False
         wall = time.perf_counter() - t0
-        record_mesh_shrink(old_n, comm_new.size, wall)
+        if growing:
+            record_mesh_regrow(old_n, comm_new.size, wall)
+        else:
+            if self.orig_comm is None:
+                # the first shrink: remember the provisioned mesh — the
+                # re-grow ceiling a later heal may rebuild back up to
+                self.orig_comm = old_comm
+            record_mesh_shrink(old_n, comm_new.size, wall)
         _push(events, RecoveryEvent(
-            kind="mesh_shrink", attempt=attempt,
+            kind="mesh_regrow" if growing else "mesh_shrink",
+            attempt=attempt,
             detail=(f"rebuilt {old_n} -> {comm_new.size} devices in "
                     f"{wall:.3f}s; resuming from iteration {it0}"),
             error_class="unavailable", iterations=it0,
             old_devices=old_n, new_devices=comm_new.size))
-        # the degraded mesh gets fresh consecutive-failure evidence (the
+        # the resharded mesh gets fresh consecutive-failure evidence (the
         # sticky faults.lost_devices registry keeps the excluded devices
         # out of any FURTHER shrink planning either way)
         self.monitor.healthy()
@@ -368,14 +395,22 @@ def _resilient_solve_impl(ksp, b, x, policy, checkpoint_path,
                 if comm_new is not None:
                     # ELASTIC escalation: same-mesh retrying is futile —
                     # reshard the checkpointed (or in-memory) iterate
-                    # onto the degraded mesh and resume from it
-                    with _telemetry.span(
+                    # onto the degraded mesh (or, after a heal, back UP
+                    # onto the repaired one) and resume from it
+                    if comm_new.size > ksp.comm.size:
+                        shsp = _telemetry.span(
+                            "resilient.regrow",
+                            old_devices=int(ksp.comm.size),
+                            new_devices=int(comm_new.size))
+                    else:
+                        shsp = _telemetry.span(
                             "resilient.shrink",
                             old_devices=int(ksp.comm.size),
-                            new_devices=int(comm_new.size)) as shsp:
-                        ok = esc.shrink(ksp, comm_new, events, attempt,
-                                        persisted=persisted, path=path,
-                                        b=b, x=x)
+                            new_devices=int(comm_new.size))
+                    with shsp:
+                        ok = esc.reshard(ksp, comm_new, events, attempt,
+                                         persisted=persisted, path=path,
+                                         b=b, x=x)
                         if ok:
                             # the shrink event carries the checkpointed
                             # iteration the resumed solve continues from
@@ -541,13 +576,20 @@ def _resilient_solve_many_impl(ksp, B, X, policy, checkpoint_path,
                     _push(events, RecoveryEvent(
                         kind="checkpoint", attempt=attempt, detail=path))
                 if comm_new is not None:
-                    with _telemetry.span(
+                    if comm_new.size > ksp.comm.size:
+                        shsp = _telemetry.span(
+                            "resilient.regrow",
+                            old_devices=int(ksp.comm.size),
+                            new_devices=int(comm_new.size))
+                    else:
+                        shsp = _telemetry.span(
                             "resilient.shrink",
                             old_devices=int(ksp.comm.size),
-                            new_devices=int(comm_new.size)) as shsp:
-                        ok = esc.shrink(ksp, comm_new, events, attempt,
-                                        persisted=persisted, path=path,
-                                        B=B, X=X, many=True)
+                            new_devices=int(comm_new.size))
+                    with shsp:
+                        ok = esc.reshard(ksp, comm_new, events, attempt,
+                                         persisted=persisted, path=path,
+                                         B=B, X=X, many=True)
                         if ok:
                             shsp.set_attr("resumed_iteration",
                                           events[-1].iterations)

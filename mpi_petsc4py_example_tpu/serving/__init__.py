@@ -1,15 +1,23 @@
-"""Serving layer: persistent device-resident solve sessions.
+"""Serving layer: persistent device-resident solve sessions + the fleet.
 
-See :mod:`.server` (the SolveServer session + client APIs) and
-:mod:`.coalescer` (the pure request-grouping logic). README "Serving"
-documents the user surface; PARITY.md "Serving sessions" maps the
-session model onto PETSc's reuse-the-KSP-object idiom.
+See :mod:`.server` (the SolveServer session + client APIs),
+:mod:`.coalescer` (the pure request-grouping logic), :mod:`.qos`
+(priority/deadline classes, the deadline-weighted scheduler, overload
+shedding, the autoscale policy), and :mod:`.fleet` (the SolveRouter:
+consistent-hash session sharding across replicas, migration, heal-driven
+re-grow). README "Serving" / "Fleet serving" document the user surface;
+PARITY.md "Serving sessions" maps the session model onto PETSc's
+reuse-the-KSP-object idiom.
 """
 
 from .coalescer import SolveRequest, coalesce, padded_width
+from .fleet import HashRing, SolveRouter
+from .qos import AutoscalePolicy, QoSClass, ScaleDecision
 from .server import (ServedSolveResult, ServerClosedError, SolveServer)
 
 __all__ = [
     "SolveServer", "ServedSolveResult", "ServerClosedError",
     "SolveRequest", "coalesce", "padded_width",
+    "SolveRouter", "HashRing",
+    "QoSClass", "AutoscalePolicy", "ScaleDecision",
 ]

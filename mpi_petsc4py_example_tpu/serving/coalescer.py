@@ -57,6 +57,15 @@ class SolveRequest:
     # if a future server aliases several precision variants of one
     # operand set under related names.
     precision: str = ""
+    # QoS (serving/qos.py): the request's class label ("" = unlabeled)
+    # and its priority tier (LOWER is more urgent; unlabeled requests
+    # sit at qos.DEFAULT_PRIORITY between interactive and bulk). NOT
+    # part of the compatibility key: a block launch costs the same
+    # whoever rides it, so compatible mixed-priority requests may share
+    # one — the scheduler orders BATCHES by their most urgent member
+    # (qos.schedule), it never splits compatible work to enforce rank.
+    qos: str = ""
+    priority: int = 50
     # the request's telemetry span (telemetry.start_span("serving.request")
     # — DETACHED: opened on the submitting client thread, finished on the
     # dispatcher thread at resolution, linked to its batch's

@@ -1,0 +1,580 @@
+"""Solve fleet: a replica router in front of N SolveServers.
+
+One :class:`~.server.SolveServer` amortizes dispatch latency; a FLEET of
+them is the "millions of users" shape (ROADMAP item 2): sessions
+(registered operators) are SHARDED across replicas by consistent-hash
+placement, a replica loss or rebalance MIGRATES sessions through the
+mesh-portable elastic checkpoint format, and the per-replica queue-wait
+percentiles drive an autoscale policy (serving/qos.py) whose grow /
+shrink / rebalance decisions the router executes. The guiding idea is
+the stale-tolerant-replica framing of the two-stage multisplitting
+literature (PAPERS.md): a lost or degraded replica is a ROUTING event,
+not an outage — traffic re-flows, state re-places, capacity re-grows.
+
+* **Placement** — :class:`HashRing`: each replica contributes
+  ``-fleet_vnodes`` virtual points (stable md5 hashes — NEVER Python's
+  salted ``hash()``: placement must survive process restarts) and a
+  session lands on the first point clockwise of its own hash. Adding or
+  removing a replica moves only the sessions whose owning arc changed —
+  ~1/N of them — so scaling the fleet re-places the minimum state.
+* **Migration** — :meth:`SolveRouter.migrate`: drain the source
+  replica's in-flight blocks, checkpoint the session's operator state
+  through :mod:`..utils.checkpoint` (the SAME elastic format the
+  shrink/re-grow ladder reshards through — it never encoded a mesh
+  size, so source and destination replicas may run different
+  geometries), re-register on the destination, replay the submissions
+  that arrived mid-migration. Every held future resolves with its
+  replayed result — clients never observe the move beyond latency.
+* **QoS + autoscale** — submissions carry class labels straight through
+  to the owning replica's scheduler; :meth:`SolveRouter.autoscale_step`
+  feeds per-replica stats to the :class:`~.qos.AutoscalePolicy` and
+  executes the decision (span ``fleet.scale``).
+* **Heal** — :meth:`SolveRouter.heal_check` asks every degraded replica
+  to re-grow onto healed devices (the serving twin of the elastic
+  ladder's upward direction).
+
+The router is deliberately a PROCESS-LOCAL front-end object: replicas
+are in-process ``SolveServer`` instances (multi-host transports would
+wrap the same placement/migration logic around RPC stubs — the routing
+and state-movement semantics live here, not in a network layer).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import os
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _telemetry
+from ..utils.options import global_options
+from ..utils.profiling import record_migration
+from . import qos as _qos
+from .server import SolveServer
+
+
+def _stable_hash(key: str) -> int:
+    """64-bit stable hash — placement must be identical across processes
+    and restarts (Python's builtin ``hash`` is salted per process)."""
+    return int.from_bytes(
+        hashlib.md5(key.encode("utf-8")).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica names (pure, unit-testable).
+
+    ``vnodes`` virtual points per replica smooth the arc distribution;
+    lookup is a binary search over the sorted point list. The stability
+    contract the fleet tests pin: a membership change re-places ONLY the
+    keys whose owning arc the change touched."""
+
+    def __init__(self, replicas=(), vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._points: list[tuple[int, str]] = []
+        self._replicas: set[str] = set()
+        for r in replicas:
+            self.add(r)
+
+    def add(self, replica: str):
+        if replica in self._replicas:
+            raise ValueError(f"replica {replica!r} already on the ring")
+        self._replicas.add(replica)
+        for v in range(self.vnodes):
+            self._points.append((_stable_hash(f"{replica}#{v}"), replica))
+        self._points.sort()
+        return self
+
+    def remove(self, replica: str):
+        if replica not in self._replicas:
+            raise ValueError(f"replica {replica!r} not on the ring")
+        self._replicas.discard(replica)
+        self._points = [p for p in self._points if p[1] != replica]
+        return self
+
+    def replicas(self):
+        return sorted(self._replicas)
+
+    def owner(self, key: str) -> str:
+        """The replica owning ``key``: first ring point clockwise of the
+        key's hash (wrapping)."""
+        if not self._points:
+            raise ValueError("empty hash ring (no replicas)")
+        h = _stable_hash(str(key))
+        i = bisect.bisect_right(self._points, (h, "￿"))
+        if i >= len(self._points):
+            i = 0
+        return self._points[i][1]
+
+    def __len__(self):
+        return len(self._replicas)
+
+
+class SolveRouter:
+    """Shard solve sessions across N server replicas (module doc).
+
+    Parameters (``-fleet_*`` runtime flags override, PETSc precedence):
+
+    replicas
+        Initial replica count (``-fleet_replicas``).
+    vnodes
+        Virtual ring points per replica (``-fleet_vnodes``).
+    server_factory
+        Zero-arg callable building one :class:`SolveServer`; defaults
+        to ``SolveServer(comm, **server_kw)``. Process-local replicas
+        share the device mesh — a multi-host deployment supplies a
+        factory binding each replica to its own hosts.
+    autoscale
+        An :class:`~.qos.AutoscalePolicy` (default: the
+        ``-autoscale_*`` flags). Decisions only execute through
+        :meth:`autoscale_step` — the router never scales behind the
+        caller's back.
+    """
+
+    def __init__(self, replicas: int | None = None, comm=None, *,
+                 vnodes: int | None = None, server_factory=None,
+                 autoscale: _qos.AutoscalePolicy | None = None,
+                 **server_kw):
+        opt = global_options()
+        n = opt.get_int("fleet_replicas",
+                        2 if replicas is None else int(replicas))
+        self.vnodes = opt.get_int("fleet_vnodes",
+                                  64 if vnodes is None else int(vnodes))
+        self._factory = (server_factory
+                         or (lambda: SolveServer(comm, **server_kw)))
+        self.autoscale = autoscale or _qos.AutoscalePolicy.from_options()
+        self._lock = threading.RLock()
+        # serializes session MOVES and membership changes against each
+        # other (migrate vs add/remove_replica racing on one op) while
+        # the router lock stays free during a move's heavy steps —
+        # submissions keep flowing (held for the moving op, routed
+        # normally for the rest). Order: _move_lock before _lock, never
+        # the reverse.
+        self._move_lock = threading.Lock()
+        self._replicas: dict[str, SolveServer] = {}
+        self._ring = HashRing(vnodes=self.vnodes)
+        self._serial = 0
+        # op -> dict(operator=..., kwargs=...): the registration spec a
+        # migration replays on the destination replica
+        self._ops: dict[str, dict] = {}
+        # op -> replica name: where the session ACTUALLY lives — the
+        # authoritative routing table. The ring (+ overrides) only
+        # expresses DESIRED placement; keeping the two separate means a
+        # failed move leaves routing truthful (the session still serves
+        # where it is) instead of pointing at a replica that never got
+        # it.
+        self._placement: dict[str, str] = {}
+        # autoscale rebalance overrides: op -> replica name, consulted
+        # before the ring when computing desired placement
+        self._overrides: dict[str, str] = {}
+        self._migrating: set[str] = set()
+        self._held: dict[str, list] = {}
+        self._closed = False
+        for _ in range(max(1, n)):
+            self._add_replica_locked()
+
+    # ---- replica membership -------------------------------------------------
+    def _new_name(self) -> str:
+        name = f"r{self._serial}"
+        self._serial += 1
+        return name
+
+    def _add_replica_locked(self) -> str:
+        name = self._new_name()
+        self._replicas[name] = self._factory()
+        self._ring.add(name)
+        _metrics.registry.gauge("fleet.replicas").set(len(self._replicas))
+        return name
+
+    def replicas(self):
+        with self._lock:
+            return self._ring.replicas()
+
+    def replica(self, name: str) -> SolveServer:
+        with self._lock:
+            return self._replicas[name]
+
+    def owner(self, op: str) -> str:
+        """The replica ACTUALLY serving ``op`` (the placement table —
+        truthful even while a desired-placement move is pending or
+        failed)."""
+        with self._lock:
+            if op not in self._ops:
+                raise ValueError(f"unknown operator {op!r}; registered: "
+                                 f"{sorted(self._ops)}")
+            return self._placement[op]
+
+    def _desired(self, op: str) -> str:
+        """Where the ring (+ rebalance overrides) says ``op`` should
+        live (lock held)."""
+        return self._overrides.get(op) or self._ring.owner(op)
+
+    def _reconcile_locked(self):
+        """Move every session whose actual placement differs from its
+        desired placement (lock held). A per-op move failure propagates
+        AFTER the remaining ops were attempted — placement stays
+        truthful for every op either way."""
+        errors = []
+        for op in sorted(self._ops):
+            dst = self._desired(op)
+            src = self._placement[op]
+            if src == dst:
+                continue
+            try:
+                self._move_session(op, src, dst)
+            # tpslint: disable=TPS005 — one session that cannot move
+            # must not strand the others mid-membership-change; the
+            # collected error re-raises below with routing still
+            # truthful (the op keeps serving where it is)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((op, exc))
+        if errors:
+            raise RuntimeError(
+                f"fleet reconcile: {len(errors)} session move(s) failed "
+                f"({', '.join(op for op, _ in errors)}); routing remains "
+                "on the source replicas") from errors[0][1]
+
+    def add_replica(self) -> str:
+        """Grow the fleet by one replica; sessions whose owning arc the
+        new replica took over migrate to it (the consistent-hash
+        minimum — ~1/N of the sessions, the rest stay put)."""
+        with self._move_lock:
+            with self._lock:
+                name = self._add_replica_locked()
+                self._reconcile_locked()
+                return name
+
+    def remove_replica(self, name: str):
+        """Drain one replica out of the fleet: its sessions migrate to
+        their new ring owners, then the emptied server shuts down. A
+        failed move aborts the removal (ring membership restored) with
+        every session still routed where it actually lives."""
+        with self._move_lock:
+            with self._lock:
+                if len(self._replicas) <= 1:
+                    raise ValueError("cannot remove the last replica")
+                srv = self._replicas[name]   # KeyError: unknown replica
+                saved_overrides = dict(self._overrides)
+                self._ring.remove(name)
+                # overrides pinned to the leaving replica dissolve back
+                # to the ring
+                self._overrides = {op: r
+                                   for op, r in self._overrides.items()
+                                   if r != name}
+                try:
+                    self._reconcile_locked()
+                # tpslint: disable=TPS005 — rollback-and-reraise,
+                # nothing swallowed: whatever reconcile raised must
+                # abort the removal (ring membership restored first)
+                # and still reach the caller
+                except Exception:  # noqa: BLE001
+                    self._ring.add(name)
+                    self._overrides = saved_overrides
+                    raise
+                del self._replicas[name]
+                _metrics.registry.gauge("fleet.replicas").set(
+                    len(self._replicas))
+        srv.shutdown(wait=True)
+
+    # ---- session registry ---------------------------------------------------
+    def register_operator(self, name: str, A, **kw):
+        """Register ``name`` on its consistent-hash owner replica; the
+        registration spec is retained so migrations can re-register the
+        session elsewhere (same kwargs, checkpoint-reloaded operator)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SolveRouter is shut down")
+            if name in self._ops:
+                raise ValueError(f"operator {name!r} already registered")
+            owner = self._ring.owner(name)
+            sess = self._replicas[owner].register_operator(name, A, **kw)
+            # only now (registration succeeded) does the op enter the
+            # routing tables; retain the PLACED operator (not the
+            # caller's raw A): a migration checkpoint needs
+            # to_scipy/with_comm, which the framework operator has and
+            # a raw scipy matrix may not
+            self._ops[name] = {"kwargs": dict(kw),
+                               "operator": sess.operator}
+            self._placement[name] = owner
+            return sess
+
+    def operators(self):
+        with self._lock:
+            return sorted(self._ops)
+
+    # ---- client APIs --------------------------------------------------------
+    def submit(self, op: str, b, **kw) -> Future:
+        """Route one solve to ``op``'s owner replica (QoS/tolerance
+        kwargs pass straight through to ``SolveServer.submit``). While
+        ``op`` is mid-migration the submission is HELD and replayed on
+        the destination — the returned future resolves either way."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SolveRouter is shut down")
+            owner = self.owner(op)
+            if op in self._migrating:
+                fut: Future = Future()
+                self._held.setdefault(op, []).append((b, dict(kw), fut))
+                return fut
+            return self._replicas[owner].submit(op, b, **kw)
+
+    def solve(self, op: str, b, *, timeout: float | None = None, **kw):
+        """Synchronous client API: submit + wait."""
+        return self.submit(op, b, **kw).result(timeout)
+
+    # ---- migration ----------------------------------------------------------
+    def migrate(self, op: str, dst: str):
+        """Move session ``op`` to replica ``dst`` (drain -> checkpoint
+        -> re-register -> replay; module doc). Pins an override so the
+        placement survives ring lookups until membership changes it.
+
+        The source drain runs OUTSIDE the router lock, so submissions
+        arriving mid-migration are HELD (``submit`` queues them) and
+        replayed once the session lands — clients never observe the
+        move beyond latency. On failure the override rolls back, the
+        session keeps serving on the source, and every held future is
+        still replayed there — resolved, never orphaned."""
+        with self._move_lock:
+            self._migrate_impl(op, dst)
+
+    def _migrate_impl(self, op: str, dst: str):
+        with self._lock:
+            src = self.owner(op)
+            if src == dst:
+                return
+            if dst not in self._replicas:
+                raise ValueError(f"unknown replica {dst!r}")
+            prev = self._overrides.get(op)
+            self._overrides[op] = dst
+            self._migrating.add(op)
+            src_srv = self._replicas[src]
+        moved = False
+        try:
+            # drain THIS session's queue with the router lock RELEASED:
+            # new arrivals for it go to the held queue instead of
+            # blocking client threads, so its backlog strictly shrinks —
+            # and co-resident sessions' sustained traffic cannot
+            # livelock the move (drain_operator ignores them). The move
+            # itself also runs outside the router lock (the op is
+            # guarded by _migrating + the move lock): its
+            # session-lock wait for an in-flight source block must not
+            # stall submissions to every other session.
+            src_srv.drain_operator(op)
+            self._move_session(op, src, dst)
+            moved = True
+        finally:
+            with self._lock:
+                self._migrating.discard(op)
+                if not moved:
+                    # roll the desired placement back — the session
+                    # still serves on the source
+                    if prev is None:
+                        self._overrides.pop(op, None)
+                    else:
+                        self._overrides[op] = prev
+                landed = self._replicas[self._placement[op]]
+                held = self._held.pop(op, [])
+            # replay wherever the session actually lives now (the
+            # destination on success, the source on a rolled-back
+            # failure) — every held future resolves either way
+            for b, kw, outer in held:
+                try:
+                    _chain_future(landed.submit(op, b, **kw), outer)
+                # tpslint: disable=TPS005 — a replay that cannot even
+                # submit must still RESOLVE the held future (typed
+                # error), never leave a client hanging
+                except Exception as exc:  # noqa: BLE001
+                    if outer.set_running_or_notify_cancel():
+                        outer.set_exception(exc)
+
+    def _move_session(self, op: str, src: str, dst: str):
+        """The migration engine (move lock held; the ROUTER lock is
+        only taken for the brief table reads/writes, so a move's heavy
+        steps — checkpoint, destination compile, the session-lock wait
+        for an in-flight source block — never stall unrelated
+        submissions). Exception-safe ordering: the destination session
+        is fully registered BEFORE the source one is unregistered, so a
+        failure at any step leaves the session serving somewhere and
+        ``_placement`` truthful."""
+        from ..utils.checkpoint import (load_solve_state_many,
+                                        save_solve_state_many)
+        import numpy as np
+        with self._lock:
+            src_srv, dst_srv = self._replicas[src], self._replicas[dst]
+            spec = self._ops[op]
+        t0 = time.perf_counter()
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"tpu_solve_migrate_{os.getpid()}_{op}.npz")
+        try:
+            with _telemetry.span("fleet.migrate", op=op, src=src,
+                                 dst=dst) as msp:
+                # 1. drain this session's queue (idempotent if migrate()
+                # already drained outside the lock; membership changes
+                # hold the router lock so no new arrivals race it)
+                src_srv.drain_operator(op)
+                # 2. checkpoint through the elastic format: the operator
+                # state becomes mesh-portable bytes (a drained session
+                # has no live iterate block — the zero block below keeps
+                # the format's schema; a preemptive mid-solve migration
+                # would carry the real partial block the same way)
+                mat = spec["operator"]
+                n = int(mat.shape[0])
+                z = np.zeros((n, 1), dtype=np.dtype(mat.dtype))
+                save_solve_state_many(path, mat, z, z, iteration=0)
+                # 3. register on the destination from the reloaded
+                # (destination-mesh-placed) operator — the source
+                # session is still live: a failure up to here changes
+                # nothing
+                mat2, _X, _B, _it = load_solve_state_many(
+                    path, dst_srv.comm)
+                dst_srv.register_session(op, mat2, **spec["kwargs"])
+                # 4. the destination is live — only now depart the
+                # source and flip the authoritative placement. If the
+                # departure fails (an out-of-contract direct-to-server
+                # submission still pending), UNDO the destination
+                # registration: a failed move must leave exactly one
+                # live session, on the source, or the op can never be
+                # retried onto this replica ('already registered').
+                try:
+                    src_srv.unregister_operator(op)
+                # tpslint: disable=TPS005 — compensate-and-reraise:
+                # nothing swallowed, the dst orphan is removed and the
+                # original departure failure still reaches the caller
+                except Exception:  # noqa: BLE001
+                    dst_srv.unregister_operator(op)
+                    raise
+                with self._lock:
+                    spec["operator"] = mat2
+                    self._placement[op] = dst
+                msp.set_attrs(wall_s=time.perf_counter() - t0)
+        finally:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        record_migration(op, src, dst, time.perf_counter() - t0)
+
+    # ---- autoscale / heal ---------------------------------------------------
+    def autoscale_step(self) -> _qos.ScaleDecision:
+        """One policy evaluation + execution: collect per-replica stats,
+        ask the :class:`~.qos.AutoscalePolicy`, execute the decision
+        (grow -> :meth:`add_replica`; shrink -> :meth:`remove_replica`;
+        rebalance -> migrate ONE session from the busiest to the idlest
+        replica). Returns the decision (action 'hold' executes
+        nothing)."""
+        with self._lock:
+            stats = {name: srv.stats()
+                     for name, srv in self._replicas.items()}
+        decision = self.autoscale.decide(stats)
+        _metrics.registry.counter("fleet.scale_decisions").inc(
+            label=decision.action)
+        if decision.action == "hold":
+            return decision
+        with _telemetry.span("fleet.scale", action=decision.action,
+                             reason=decision.reason) as ssp:
+            if decision.action == "grow":
+                ssp.set_attr("replica", self.add_replica())
+            elif decision.action == "shrink":
+                self.remove_replica(decision.replica)
+                ssp.set_attr("replica", decision.replica)
+            elif decision.action == "rebalance":
+                busiest, idlest = decision.replica
+                moved = None
+                with self._lock:
+                    for op in sorted(self._ops):
+                        if self.owner(op) == busiest:
+                            moved = op
+                            break
+                if moved is not None:
+                    self.migrate(moved, idlest)
+                ssp.set_attrs(op=moved or "", src=busiest, dst=idlest)
+        return decision
+
+    def heal_check(self) -> int:
+        """Ask every degraded replica to re-grow onto healed devices
+        (:meth:`SolveServer.regrow`); returns how many re-grew. The
+        routing twin of the dispatcher's own heal-epoch check — a
+        driver that KNOWS a repair happened calls this for immediate
+        capacity instead of waiting for each replica's next window."""
+        with self._lock:
+            servers = list(self._replicas.values())
+        # regrow() is thread-safe: the server's session lock makes the
+        # rebuild wait out any in-flight dispatch instead of swapping
+        # operators under it
+        return sum(1 for srv in servers if srv.regrow())
+
+    # ---- observability / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        """Fleet-level aggregate + the per-replica stats() dicts."""
+        with self._lock:
+            per = {name: srv.stats()
+                   for name, srv in self._replicas.items()}
+            placement = {op: self.owner(op) for op in self._ops}
+        agg = {"replicas": len(per),
+               "requests": sum(s["requests"] for s in per.values()),
+               "batches": sum(s["batches"] for s in per.values()),
+               "shed": sum(s["shed"] for s in per.values()),
+               "rejected": sum(s["rejected"] for s in per.values()),
+               "mesh_shrinks": sum(len(s["mesh_shrinks"])
+                                   for s in per.values()),
+               "mesh_regrows": sum(len(s["mesh_regrows"])
+                                   for s in per.values()),
+               "placement": placement,
+               "per_replica": per}
+        return agg
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every replica's queue flushed; False on
+        timeout."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            servers = list(self._replicas.values())
+        for srv in servers:
+            rem = (None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+            if not srv.drain(rem):
+                return False
+        return True
+
+    def shutdown(self, wait: bool = True):
+        """Shut every replica down (``wait`` as in
+        :meth:`SolveServer.shutdown`: True flushes queues first)."""
+        with self._lock:
+            self._closed = True
+            servers = list(self._replicas.values())
+        for srv in servers:
+            srv.shutdown(wait=wait)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=exc == (None, None, None))
+        return False
+
+    def __repr__(self):
+        with self._lock:
+            return (f"SolveRouter(replicas={self._ring.replicas()}, "
+                    f"ops={sorted(self._ops)})")
+
+
+def _chain_future(inner: Future, outer: Future):
+    """Resolve ``outer`` with whatever ``inner`` resolves to — the
+    replay bridge for submissions held across a migration."""
+    def _done(f: Future):
+        if f.cancelled():
+            outer.cancel()
+            return
+        if not outer.set_running_or_notify_cancel():
+            return
+        exc = f.exception()
+        if exc is not None:
+            outer.set_exception(exc)
+        else:
+            outer.set_result(f.result())
+    inner.add_done_callback(_done)

@@ -1,0 +1,236 @@
+"""QoS-aware scheduling for the solve fleet (serving/server.py + fleet.py).
+
+Under healthy capacity FIFO coalescing is fine: every request dispatches
+within a window or two. Under DEGRADED capacity (a shrunk mesh after a
+device loss — resilience/elastic.py) one bulk batch job can starve a
+p99-sensitive request for seconds, which is exactly when the p99 matters
+most. This module adds the three degraded-mode disciplines, all as PURE
+host logic (the serving layer's coalescer.py convention — no threads, no
+device work, unit-testable in isolation):
+
+* **priority + deadline classes** — :class:`QoSClass` gives a request a
+  priority tier and a default dispatch deadline. Two classes ship
+  in-tree (``interactive``: tier 0, ``bulk``: tier 100); unlabeled
+  requests sit between them, so existing single-class traffic keeps its
+  exact FIFO behavior while labeled traffic sorts around it.
+* **deadline-weighted scheduling** — :func:`schedule` groups a queue
+  snapshot with the same compatibility semantics as
+  :func:`~.coalescer.coalesce` (same operator/tolerances/precision —
+  NEVER mixed), then orders the batches by urgency: priority tier
+  first, earliest effective deadline second, arrival third. The
+  dispatcher dispatches ONE batch per pass and re-snapshots, so a
+  high-priority arrival preempts the remaining bulk batches INTO THE
+  NEXT WINDOW — an in-flight block is never interrupted (preemption is
+  a scheduling decision, not a cancellation).
+* **priority shedding** — :func:`shed_victim`: with the admission queue
+  full, an arriving request may displace the LEAST urgent strictly-
+  lower-priority pending request; the victim's future RESOLVES with the
+  typed :class:`~..utils.errors.ServerOverloadedError` (``shed=True``)
+  — bulk sheds before interactive, and nothing is ever silently
+  dropped or left hanging.
+
+On top rides :class:`AutoscalePolicy`: the queue-wait percentiles
+``SolveServer.stats()`` already measures (the registry
+``Histogram.summary`` path) drive grow / shrink / rebalance decisions
+that the :class:`~.fleet.SolveRouter` executes — the policy only ever
+DECIDES (pure, testable on synthetic stats); the router owns execution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.options import global_options
+
+#: priority tier for requests submitted without a QoS class: between
+#: interactive (0) and bulk (100), so unlabeled traffic neither starves
+#: behind bulk nor outranks explicitly interactive requests
+DEFAULT_PRIORITY = 50
+
+
+@dataclass(frozen=True)
+class QoSClass:
+    """One service class: a priority tier (LOWER is more urgent) and a
+    default per-request dispatch deadline in seconds (0 = none) applied
+    when the submission names the class without its own deadline."""
+    name: str
+    priority: int
+    deadline: float = 0.0
+    description: str = ""
+
+
+def builtin_classes() -> dict[str, QoSClass]:
+    """The in-tree class table, with the per-class deadline defaults
+    overridable at runtime (``-qos_interactive_deadline`` /
+    ``-qos_bulk_deadline``)."""
+    opt = global_options()
+    return {
+        "interactive": QoSClass(
+            "interactive", 0,
+            deadline=opt.get_real("qos_interactive_deadline", 0.0),
+            description="p99-sensitive; preempts bulk at window "
+                        "boundaries, shed last"),
+        "bulk": QoSClass(
+            "bulk", 100,
+            deadline=opt.get_real("qos_bulk_deadline", 0.0),
+            description="throughput batch traffic; yields windows to "
+                        "interactive, shed first under overload"),
+    }
+
+
+def default_class_name() -> str:
+    """The class assumed for unlabeled submissions
+    (``-qos_default_class``; empty keeps them at the neutral
+    mid-priority tier)."""
+    return str(global_options().get_string("qos_default_class", "") or "")
+
+
+def resolve(qos: str | None,
+            classes: dict[str, QoSClass]) -> QoSClass | None:
+    """The :class:`QoSClass` for a submission's ``qos=`` label (or the
+    configured default class when unlabeled); None for neutral traffic.
+    Unknown labels raise — a typo'd class must not silently demote a
+    p99-sensitive request to the neutral tier."""
+    name = qos if qos is not None else default_class_name()
+    if not name:
+        return None
+    try:
+        return classes[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown QoS class {name!r}; known: {sorted(classes)}"
+        ) from None
+
+
+# --------------------------------------------------------------- scheduling
+def _batch_urgency(batch):
+    """Sort key of one compatible batch: (best priority tier of its
+    members, earliest effective deadline, oldest arrival). A single
+    urgent member promotes its whole batch — its batch-mates ride the
+    same launch for free, they never delay it."""
+    prio = min(r.priority for r in batch)
+    deadline = min((r.t_deadline for r in batch
+                    if r.t_deadline is not None), default=float("inf"))
+    return (prio, deadline, min(r.t_submit for r in batch))
+
+
+def schedule(requests, max_k: int):
+    """Group ``requests`` into dispatchable batches, urgency-ordered.
+
+    Grouping semantics are EXACTLY :func:`~.coalescer.coalesce` —
+    compatibility keys never mix, FIFO within a group, ``max_k``
+    chunking — the only change is the order BETWEEN batches:
+    deadline-weighted priority instead of oldest-member. With uniform
+    priorities and no deadlines the sort key degenerates to
+    oldest-member, so single-class traffic dispatches byte-identically
+    to the pre-QoS coalescer (the stability the serving tests pin).
+    """
+    from .coalescer import coalesce
+    batches = coalesce(requests, max_k)
+    batches.sort(key=_batch_urgency)
+    return batches
+
+
+def shed_victim(pending, priority: int):
+    """The pending request an arrival of ``priority`` may displace when
+    the admission queue is full: the LEAST urgent strictly-lower-
+    priority request (highest tier number; newest arrival breaks ties —
+    it has lost the least queueing investment). None when nothing
+    pending is strictly less urgent — equal-priority arrivals are
+    rejected, never each other's victims (no shed cascades)."""
+    worst = None
+    for r in pending:
+        if r.priority <= priority:
+            continue
+        if (worst is None or r.priority > worst.priority
+                or (r.priority == worst.priority
+                    and r.t_submit > worst.t_submit)):
+            worst = r
+    return worst
+
+
+# --------------------------------------------------------------- autoscale
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One autoscale verdict: ``action`` in {hold, grow, shrink,
+    rebalance}; ``replica`` names the shrink target or the
+    (busiest, idlest) rebalance pair; ``reason`` is the human-readable
+    evidence line the router logs."""
+    action: str
+    replica: object = None
+    reason: str = ""
+
+
+@dataclass
+class AutoscalePolicy:
+    """Queue-wait-driven replica scaling policy (decisions only).
+
+    Driven by the per-replica ``queue_wait_p99_s`` the servers already
+    measure: sustained p99 above ``high_p99_s`` on any replica asks for
+    a GROW (more replicas = fewer sessions per replica after the
+    consistent-hash re-spread); p99 below ``low_p99_s`` on EVERY
+    replica asks for a SHRINK down to ``min_replicas``; a busiest/idlest
+    p99 ratio above ``rebalance_ratio`` (with neither bound tripped)
+    asks for one session MIGRATION instead — placement skew, not
+    capacity, is the problem there. Replicas with no wait samples yet
+    are neutral: they neither trigger growth nor veto a shrink.
+    """
+    enabled: bool = True
+    high_p99_s: float = 0.5
+    low_p99_s: float = 0.01
+    min_replicas: int = 1
+    max_replicas: int = 8
+    rebalance_ratio: float = 10.0
+
+    @classmethod
+    def from_options(cls) -> "AutoscalePolicy":
+        """Policy from the runtime options DB (``-autoscale_*``)."""
+        opt = global_options()
+        p = cls()
+        p.enabled = opt.get_bool("autoscale_enable", p.enabled)
+        p.high_p99_s = opt.get_real("autoscale_high_p99", p.high_p99_s)
+        p.low_p99_s = opt.get_real("autoscale_low_p99", p.low_p99_s)
+        p.min_replicas = opt.get_int("autoscale_min_replicas",
+                                     p.min_replicas)
+        p.max_replicas = opt.get_int("autoscale_max_replicas",
+                                     p.max_replicas)
+        p.rebalance_ratio = opt.get_real("autoscale_rebalance_ratio",
+                                         p.rebalance_ratio)
+        return p
+
+    def decide(self, replica_stats: dict) -> ScaleDecision:
+        """``replica_stats``: replica name -> its ``SolveServer.stats()``
+        dict. Returns exactly one :class:`ScaleDecision`."""
+        if not self.enabled or not replica_stats:
+            return ScaleDecision("hold", reason="autoscale disabled"
+                                 if not self.enabled else "no replicas")
+        p99 = {name: st.get("queue_wait_p99_s")
+               for name, st in replica_stats.items()}
+        sampled = {n: v for n, v in p99.items() if v is not None}
+        n = len(replica_stats)
+        hot = [nm for nm, v in sampled.items() if v > self.high_p99_s]
+        if hot and n < self.max_replicas:
+            worst = max(hot, key=lambda nm: sampled[nm])
+            return ScaleDecision(
+                "grow", reason=f"replica {worst!r} queue-wait p99 "
+                f"{sampled[worst] * 1e3:.1f} ms > "
+                f"{self.high_p99_s * 1e3:.1f} ms high watermark")
+        if sampled and not hot:
+            busiest = max(sampled, key=sampled.get)
+            idlest = min(sampled, key=sampled.get)
+            if (sampled[idlest] > 0
+                    and sampled[busiest] / sampled[idlest]
+                    > self.rebalance_ratio):
+                return ScaleDecision(
+                    "rebalance", replica=(busiest, idlest),
+                    reason=f"p99 skew {sampled[busiest] * 1e3:.1f} ms "
+                    f"({busiest!r}) vs {sampled[idlest] * 1e3:.1f} ms "
+                    f"({idlest!r}) exceeds ratio {self.rebalance_ratio}")
+            if (n > self.min_replicas
+                    and all(v < self.low_p99_s for v in sampled.values())):
+                return ScaleDecision(
+                    "shrink", replica=idlest,
+                    reason=f"every replica under the "
+                    f"{self.low_p99_s * 1e3:.1f} ms low watermark "
+                    f"(idlest: {idlest!r})")
+        return ScaleDecision("hold", reason="within watermarks")

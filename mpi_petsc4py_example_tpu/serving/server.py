@@ -58,6 +58,20 @@ server-side dispatch deadline (expired requests resolve with
 :class:`~..utils.errors.DeadlineExceededError` rather than occupying a
 batch column). Every pending future always resolves — a result, a
 typed rejection, or the dispatch error — never a hang.
+
+The fleet round adds the QoS tier (serving/qos.py) on top: requests
+carry priority + deadline CLASSES (``submit(qos="interactive")``), the
+dispatcher runs a deadline-weighted scheduling pass per window and
+dispatches ONE batch at a time — a p99-sensitive arrival preempts
+queued bulk batches into the next pass, never an in-flight block — and
+under overload the admission tier sheds the least-urgent pending bulk
+request (typed resolution) before rejecting interactive arrivals. The
+PR-8 shrink adoption also gained its inverse: when
+:func:`resilience.faults.heal` restores devices, the dispatcher adopts
+the largest viable LARGER mesh (``-elastic_regrow``), rebuilding every
+resident session on it — lost capacity comes back without restarting
+the server. Multi-replica deployments front N of these servers with
+:class:`~.fleet.SolveRouter`.
 """
 
 from __future__ import annotations
@@ -71,6 +85,7 @@ import numpy as np
 
 from ..core.mat import Mat
 from ..parallel.mesh import as_comm
+from ..resilience import faults as _faults
 from ..resilience.retry import RetryPolicy, resilient_solve_many
 from ..solvers.ksp import KSP
 from ..telemetry import flight as _flight
@@ -79,8 +94,10 @@ from ..telemetry import spans as _telemetry
 from ..utils.convergence import SolveResult
 from ..utils.errors import DeadlineExceededError, ServerOverloadedError
 from ..utils.options import global_options
-from ..utils.profiling import record_admission, record_serving
-from .coalescer import SolveRequest, coalesce, padded_width
+from ..utils.profiling import (record_admission, record_qos,
+                               record_serving)
+from . import qos as _qos
+from .coalescer import SolveRequest, padded_width
 
 
 class ServerClosedError(RuntimeError):
@@ -183,6 +200,11 @@ class SolveServer:
                  max_queue: int = 0, deadline: float = 0.0,
                  autostart: bool = True):
         self.comm = as_comm(comm)
+        # the mesh this server was PROVISIONED on: the re-grow ceiling
+        # (shrink adoption moves self.comm down the ladder; a heal may
+        # move it back up, never past this)
+        self._full_comm = self.comm
+        self._heal_epoch_seen = _faults.heal_epoch()
         self.window = float(window)
         self.max_k = int(max_k)
         self.pad_pow2 = bool(pad_pow2)
@@ -190,17 +212,30 @@ class SolveServer:
         self.retry_policy = retry_policy or RetryPolicy.serving()
         self.max_queue = int(max_queue)
         self.deadline = float(deadline)
+        self.qos_classes = _qos.builtin_classes()
         self._sessions: dict[str, _OperatorSession] = {}
         self._pending: list[SolveRequest] = []
+        # batches left over from the last scheduling pass, valid while
+        # _pending is untouched by submit/shed: draining an N-request
+        # backlog then costs ONE schedule, not one per dispatched batch
+        self._sched_cache: list | None = None
         self._inflight = 0
         self._stop = False
         self._closed = False
         self._cv = threading.Condition()
+        # serializes SESSION MUTATION (regrow/adopt rebuilds, operator
+        # un/registration) against in-flight dispatches: the dispatcher
+        # holds it across _dispatch, so a public regrow()/unregister
+        # from another thread waits for the current block instead of
+        # swapping operators under it (RLock: the dispatcher's own
+        # shrink-adoption path re-enters)
+        self._session_lock = threading.RLock()
         self._thread: threading.Thread | None = None
         self._dispatch_hook = None       # test seam: called per batch
         self._stats = {"requests": 0, "batches": 0, "padded_cols": 0,
-                       "width_hist": {},
-                       "rejected": 0, "expired": 0, "mesh_shrinks": []}
+                       "width_hist": {}, "qos_hist": {},
+                       "rejected": 0, "expired": 0, "shed": 0,
+                       "mesh_shrinks": [], "mesh_regrows": []}
         # per-server queue-wait histogram: the SAME Histogram type (and
         # .summary percentile code path) the process-wide registry twin
         # uses — SolveServer.stats() and profiling.serving_stats() can
@@ -307,13 +342,45 @@ class SolveServer:
                 "-ksp_type/-pc_type options)", stacklevel=2)
         ksp.set_up()                  # PC factors placed NOW, once
         sess = _OperatorSession(name, op, ksp)
-        self._sessions[name] = sess
-        for w in warm_widths:
-            w = padded_width(int(w), self.max_k, self.pad_pow2)
-            ksp.solve_many(np.zeros((sess.n, w), sess.dtype))
+        with self._session_lock:
+            # under the session lock: a concurrent regrow/adoption must
+            # not iterate the registry while it grows
+            self._sessions[name] = sess
+            for w in warm_widths:
+                w = padded_width(int(w), self.max_k, self.pad_pow2)
+                ksp.solve_many(np.zeros((sess.n, w), sess.dtype))
         return sess
 
     registerOperator = register_operator
+
+    def register_session(self, name: str, operator, *,
+                         ksp_type: str = "cg", pc_type: str = "jacobi",
+                         **kw):
+        """Register an operator that is ALREADY a framework Mat/stencil
+        resident on (or rebuildable for) this server's mesh — the
+        migration landing pad (serving/fleet.py): the router reloads the
+        elastic checkpoint onto the destination comm and hands the
+        re-placed operator here, so a migrated session never round-trips
+        through scipy again. Same contract as
+        :meth:`register_operator`."""
+        return self.register_operator(name, operator, ksp_type=ksp_type,
+                                      pc_type=pc_type, **kw)
+
+    def unregister_operator(self, name: str):
+        """Remove a resident session (the migration departure hook —
+        serving/fleet.py). Refuses while requests for it are queued:
+        callers drain first so no future can be orphaned; its device
+        buffers are released with the session object."""
+        with self._session_lock, self._cv:
+            if any(r.op == name for r in self._pending):
+                raise RuntimeError(
+                    f"unregister_operator({name!r}): requests still "
+                    "pending — drain() first")
+            sess = self._sessions.pop(name, None)
+        if sess is None:
+            raise ValueError(f"unknown operator {name!r}; registered: "
+                             f"{self.operators()}")
+        return sess
 
     def operators(self):
         return sorted(self._sessions)
@@ -321,15 +388,25 @@ class SolveServer:
     # ---- client APIs --------------------------------------------------------
     def submit(self, op: str, b, *, rtol: float | None = None,
                atol: float | None = None, max_it: int | None = None,
-               deadline: float | None = None) -> Future:
+               deadline: float | None = None, qos: str | None = None,
+               priority: int | None = None) -> Future:
         """Enqueue one solve; returns a Future of ServedSolveResult.
 
         Tolerance overrides narrow the request's compatibility group —
         requests with different tolerances never share a block.
-        ``deadline`` overrides the server's default per-request dispatch
-        deadline in seconds (0 = none). With the queue at
-        ``max_queue``, raises :class:`ServerOverloadedError` instead of
-        enqueueing (admission control — the caller sheds load).
+        ``deadline`` overrides the per-request dispatch deadline in
+        seconds (0 = none; default: the named QoS class's deadline, else
+        the server's). ``qos`` names a service class
+        (``interactive``/``bulk`` — serving/qos.py): it sets the
+        request's priority tier and default deadline; ``priority``
+        overrides the tier directly (LOWER is more urgent). With the
+        queue at ``max_queue``, an arrival first tries to SHED the
+        least-urgent strictly-lower-priority pending request (its future
+        resolves with the typed overload error — bulk sheds before
+        interactive, nothing hangs); when nothing pending is less
+        urgent, the arrival itself is rejected with
+        :class:`ServerOverloadedError` (admission control — the caller
+        sheds load).
         """
         sess = self._sessions.get(op)
         if sess is None:
@@ -339,7 +416,16 @@ class SolveServer:
         if b.shape != (sess.n,):
             raise ValueError(f"submit({op!r}): b must be ({sess.n},), "
                              f"got {b.shape}")
-        budget = self.deadline if deadline is None else float(deadline)
+        cls = _qos.resolve(qos, self.qos_classes)
+        prio = (int(priority) if priority is not None
+                else cls.priority if cls is not None
+                else _qos.DEFAULT_PRIORITY)
+        if deadline is not None:
+            budget = float(deadline)
+        elif cls is not None and cls.deadline > 0:
+            budget = cls.deadline
+        else:
+            budget = self.deadline
         fut: Future = Future()
         req = SolveRequest(
             # a COPY of the caller's RHS: the request sits in the
@@ -353,17 +439,43 @@ class SolveServer:
             # the session's storage dtype IS its precision plan — part
             # of the compatibility key (serving/coalescer.py)
             precision=str(sess.dtype),
+            qos=cls.name if cls is not None else "",
+            priority=prio,
             future=fut)
         if budget > 0:
             req.t_deadline = req.t_submit + budget
         with self._cv:
             if self._closed:
                 raise ServerClosedError("SolveServer is shut down")
+            if self._sessions.get(op) is not sess:
+                # the session was unregistered (a fleet migration's
+                # departure) between validation above and this enqueue:
+                # reject now rather than queue a request no dispatch
+                # can serve
+                raise ValueError(f"operator {op!r} was unregistered "
+                                 "while submitting")
             if self.max_queue > 0 and len(self._pending) >= self.max_queue:
-                self._stats["rejected"] += 1
-                record_admission(rejected=1)
-                raise ServerOverloadedError(len(self._pending),
-                                            self.max_queue)
+                victim = _qos.shed_victim(self._pending, prio)
+                if victim is None:
+                    self._stats["rejected"] += 1
+                    record_admission(rejected=1)
+                    raise ServerOverloadedError(len(self._pending),
+                                                self.max_queue)
+                # QoS shedding: the less-urgent victim gives its queue
+                # slot to this arrival; its future RESOLVES with the
+                # typed error (shed=True) — resolved, never dropped.
+                # Removal by IDENTITY: dataclass equality would compare
+                # the ndarray RHS payloads
+                self._pending = [r for r in self._pending
+                                 if r is not victim]
+                self._stats["shed"] += 1
+                record_admission(shed=1)
+                if victim.future.set_running_or_notify_cancel():
+                    victim.future.set_exception(ServerOverloadedError(
+                        len(self._pending) + 1, self.max_queue,
+                        shed=True))
+                self._end_request_span(victim, "shed")
+            record_qos(req.qos)
             # the request's span is opened only for ADMITTED requests
             # (rejections are counted by serving.rejected — a burst of
             # ~flight_len rejected submissions must not flush the
@@ -373,6 +485,10 @@ class SolveServer:
             # in (no-op singleton when disabled)
             req.span = _telemetry.start_span("serving.request", op=op)
             self._pending.append(req)
+            # the queue changed (appended here, possibly shed above):
+            # the dispatcher must re-schedule — a new arrival may
+            # preempt the cached batch order
+            self._sched_cache = None
             _metrics.registry.gauge("serving.queue_depth").set(
                 len(self._pending))
             self._cv.notify_all()
@@ -406,6 +522,26 @@ class SolveServer:
                 self._cv.wait(rem if rem is not None else 0.5)
         return True
 
+    def drain_operator(self, name: str,
+                       timeout: float | None = None) -> bool:
+        """Block until no request for ``name`` sits in the pending
+        queue; False on timeout. Unlike :meth:`drain` this does NOT
+        wait out traffic to co-resident sessions — the migration path
+        (serving/fleet.py) uses it so moving one session off a busy
+        replica cannot livelock behind the others' sustained load.
+        An in-flight block for the session may still be executing;
+        session swaps serialize on the session lock, which waits it
+        out."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while any(r.op == name for r in self._pending):
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(rem if rem is not None else 0.5)
+        return True
+
     def shutdown(self, wait: bool = True):
         """Stop the server. ``wait=True`` (default) FLUSHES the queue —
         every pending future resolves (the drain-on-shutdown contract) —
@@ -424,6 +560,7 @@ class SolveServer:
                     if r.span is not None:
                         r.span.set_attr("outcome", "closed").end()
                 self._pending.clear()
+                self._sched_cache = None
             pending = bool(self._pending)
         if self._thread is None and pending:
             # never-started server (autostart=False): flush inline so
@@ -452,10 +589,18 @@ class SolveServer:
                 if not self._pending and self._stop:
                     return
                 t_open = self._pending[0].t_submit
+            # a heal may have restored capacity while the server sat
+            # degraded — adopt the larger mesh BEFORE dispatching this
+            # window's traffic (cheap epoch check when nothing healed)
+            self._maybe_regrow()
             # batching window: hold the oldest pending request at most
             # `window` seconds so concurrent arrivals ride its block;
             # shutdown flushes immediately. Requests arriving after the
-            # snapshot below land in the NEXT window by construction.
+            # scheduling pass below land in a LATER pass by construction
+            # — and the window is only charged once per backlog: a
+            # request requeued by the one-batch-per-pass discipline is
+            # older than the window, so the next pass dispatches it
+            # immediately.
             while True:
                 with self._cv:
                     if self._stop:
@@ -464,20 +609,42 @@ class SolveServer:
                     if rem <= 0:
                         break
                     self._cv.wait(timeout=rem)
+            # QoS scheduling pass (serving/qos.py): group the snapshot
+            # into compatible batches ordered by deadline-weighted
+            # priority and dispatch ONE — the rest stay pending, so a
+            # high-priority arrival during this batch's launch preempts
+            # the remaining bulk batches into the next pass (never the
+            # in-flight block: preemption is scheduling, not
+            # cancellation). The remaining batch order is CACHED and
+            # reused while nothing touches the queue (submit/shed
+            # invalidate), so draining a quiet backlog schedules once,
+            # not once per batch.
             with self._cv:
-                taken = list(self._pending)
-                self._pending.clear()
-                self._inflight += len(taken)
+                if self._sched_cache:
+                    batch = self._sched_cache.pop(0)
+                else:
+                    with _telemetry.span(
+                            "serving.coalesce",
+                            taken=len(self._pending)) as csp:
+                        batches = _qos.schedule(self._pending,
+                                                self.max_k)
+                        csp.set_attrs(batches=len(batches))
+                    if not batches:
+                        continue
+                    batch = batches[0]
+                    self._sched_cache = batches[1:]
+                chosen = {id(r) for r in batch}
+                self._pending = [r for r in self._pending
+                                 if id(r) not in chosen]
+                self._inflight += len(batch)
+                _metrics.registry.gauge("serving.queue_depth").set(
+                    len(self._pending))
             try:
-                with _telemetry.span("serving.coalesce",
-                                     taken=len(taken)) as csp:
-                    batches = coalesce(taken, self.max_k)
-                    csp.set_attr("batches", len(batches))
-                for batch in batches:
+                with self._session_lock:
                     self._dispatch(batch)
             finally:
                 with self._cv:
-                    self._inflight -= len(taken)
+                    self._inflight -= len(batch)
                     self._cv.notify_all()
 
     def _dispatch(self, reqs):
@@ -511,10 +678,26 @@ class SolveServer:
         reqs = live
         if not reqs:
             return
-        sess = self._sessions[reqs[0].op]
+        sess = self._sessions.get(reqs[0].op)
+        if sess is None:
+            # the session vanished after these requests were queued (an
+            # out-of-contract unregister without a drain): resolve the
+            # futures with the typed error — the dispatcher must NEVER
+            # die on a bad batch, every later request depends on it
+            exc = ValueError(f"operator {reqs[0].op!r} is no longer "
+                             "registered")
+            for r in reqs:
+                r.future.set_exception(exc)
+                self._end_request_span(r, "error")
+            return
         k = len(reqs)
         t0 = time.monotonic()
         waits = [t0 - r.t_submit for r in reqs]
+        with self._cv:
+            qh = self._stats["qos_hist"]
+            for r in reqs:
+                key = r.qos or "default"
+                qh[key] = qh.get(key, 0) + 1
         kpad = padded_width(k, self.max_k, self.pad_pow2)
         # the batch span: a ROOT span on the dispatcher thread; every
         # request resolved out of this block links back to it
@@ -599,30 +782,24 @@ class SolveServer:
         sp.set_attrs(outcome=outcome, **attrs)
         sp.end()
 
-    def _adopt_shrunk_mesh(self, shrunk_sess, shrink_events, dispatch_wall):
-        """Adopt the degraded mesh a resilient dispatch landed on.
-
-        ``shrunk_sess``'s KSP was already rebuilt by the elastic retry
-        stage; every OTHER resident operator is re-registered here —
-        operands re-placed, PC factors re-set-up, base (and previously
-        seen block-width) programs re-warmed/AOT-loaded on the new
-        geometry — so the next dispatch of any session runs on surviving
-        hardware instead of failing on the lost device. Runs on the
-        dispatcher thread (the only place sessions are mutated
-        mid-flight)."""
+    def _rebuild_sessions_on(self, comm_new, skip=None) -> dict:
+        """Re-place every resident session on ``comm_new`` (operands,
+        PC factors, ABFT checksums; base + previously seen block-width
+        programs re-warmed/AOT-loaded) — the shared rebuild step of the
+        shrink adoption AND the re-grow. ``skip`` excludes a session the
+        elastic retry stage already rebuilt. Per-session failures are
+        recorded, never raised: a session that cannot live on the new
+        geometry must not abort adoption for the sessions that can —
+        its next dispatch surfaces the recorded error on client
+        futures. Runs on the dispatcher thread (the only place sessions
+        are mutated mid-flight)."""
         from ..resilience import elastic as _elastic
-        comm_new = shrunk_sess.ksp.comm
-        if comm_new is self.comm or comm_new.size >= self.comm.size:
-            return
-        old_n = self.comm.size
-        t0 = time.monotonic()
-        shrunk_sess.operator = shrunk_sess.ksp.get_operators()[0]
         with self._cv:
             widths = sorted(padded_width(w, self.max_k, self.pad_pow2)
                             for w in self._stats["width_hist"])
         failures = {}
         for s in self._sessions.values():
-            if s is shrunk_sess:
+            if s is skip:
                 continue
             try:
                 mat2 = _elastic.rebuild_operator(s.operator, comm_new)
@@ -630,12 +807,35 @@ class SolveServer:
                 s.operator = mat2
                 _elastic.warm(s.ksp, widths)
             # tpslint: disable=TPS005 — a session whose operator cannot
-            # be rebuilt on the smaller mesh must not abort adoption for
+            # be rebuilt on the new mesh must not abort adoption for
             # the sessions that CAN: record it, keep going; its next
             # dispatch surfaces the recorded error on client futures
             except Exception as exc:  # noqa: BLE001
                 failures[s.name] = repr(exc)
+        return failures
+
+    def _adopt_shrunk_mesh(self, shrunk_sess, shrink_events, dispatch_wall):
+        """Adopt the degraded mesh a resilient dispatch landed on.
+
+        ``shrunk_sess``'s KSP was already rebuilt by the elastic retry
+        stage; every OTHER resident operator is re-registered via
+        :meth:`_rebuild_sessions_on` so the next dispatch of any session
+        runs on surviving hardware instead of failing on the lost
+        device."""
+        comm_new = shrunk_sess.ksp.comm
+        if comm_new is self.comm or comm_new.size >= self.comm.size:
+            return
+        old_n = self.comm.size
+        t0 = time.monotonic()
+        shrunk_sess.operator = shrunk_sess.ksp.get_operators()[0]
+        failures = self._rebuild_sessions_on(comm_new, skip=shrunk_sess)
         self.comm = comm_new
+        # deliberately do NOT touch _heal_epoch_seen here: a heal that
+        # landed WHILE this degraded dispatch was running must still
+        # trigger _maybe_regrow on the next pass (resetting to the
+        # current epoch would swallow it); a stale pre-degradation heal
+        # costs one harmless grown_comm plan that the still-lost
+        # registry rejects
         entry = {"old_devices": old_n, "new_devices": comm_new.size,
                  "dispatch_wall_s": float(dispatch_wall),
                  "adopt_wall_s": time.monotonic() - t0,
@@ -644,6 +844,57 @@ class SolveServer:
                  "rebuild_failures": failures}
         with self._cv:
             self._stats["mesh_shrinks"].append(entry)
+
+    def _maybe_regrow(self) -> bool:
+        """Cheap hot-loop check: when the server sits DEGRADED and
+        :func:`resilience.faults.heal` ran since, plan and adopt the
+        largest viable larger mesh (never past the provisioned one).
+        Returns True when a re-grow was executed."""
+        if self.comm.size >= self._full_comm.size:
+            return False
+        ep = _faults.heal_epoch()
+        if ep == self._heal_epoch_seen:
+            return False
+        self._heal_epoch_seen = ep
+        return self.regrow()
+
+    def regrow(self) -> bool:
+        """Rebuild every resident session onto the largest viable
+        larger mesh over healed devices (the elastic ladder's upward
+        direction — ``-elastic_regrow``); no-op (False) when the server
+        is not degraded, the policy disarms re-growing, or the healed
+        hardware does not support a strictly larger rung. The public
+        twin of the dispatcher's heal-epoch check, for drivers that
+        know a repair happened (a fleet router, an operator console) —
+        safe from any thread: the session lock makes the rebuild wait
+        out an in-flight dispatch instead of swapping operators under
+        it."""
+        from ..resilience import elastic as _elastic
+        from ..utils.profiling import record_mesh_regrow
+        policy = _elastic.ElasticPolicy.from_options()
+        if not (policy.enabled and policy.regrow):
+            return False
+        with self._session_lock:
+            grown = _elastic.MeshRebuilder(policy).grown_comm(
+                self.comm, self._full_comm)
+            if grown is None:
+                return False
+            old_n = self.comm.size
+            t0 = time.monotonic()
+            with _telemetry.span("serving.regrow", old_devices=old_n,
+                                 new_devices=int(grown.size)) as gsp:
+                failures = self._rebuild_sessions_on(grown)
+                self.comm = grown
+                wall = time.monotonic() - t0
+                record_mesh_regrow(old_n, grown.size, wall)
+                gsp.set_attrs(
+                    rebuilt=len(self._sessions) - len(failures),
+                    failures=len(failures))
+        entry = {"old_devices": old_n, "new_devices": grown.size,
+                 "adopt_wall_s": wall, "rebuild_failures": failures}
+        with self._cv:
+            self._stats["mesh_regrows"].append(entry)
+        return True
 
     def _record(self, width, waits, padded):
         record_serving(width, waits, padded)   # the process-wide twin
@@ -667,9 +918,15 @@ class SolveServer:
             out = {"requests": st["requests"], "batches": st["batches"],
                    "padded_cols": st["padded_cols"],
                    "width_hist": dict(st["width_hist"]),
+                   "qos_hist": dict(st["qos_hist"]),
                    "rejected": st["rejected"], "expired": st["expired"],
+                   "shed": st["shed"],
+                   "pending": len(self._pending),
+                   "devices": int(self.comm.size),
                    "mesh_shrinks": [dict(e)
-                                    for e in st["mesh_shrinks"]]}
+                                    for e in st["mesh_shrinks"]],
+                   "mesh_regrows": [dict(e)
+                                    for e in st["mesh_regrows"]]}
         out["mean_width"] = (out["requests"] / out["batches"]
                              if out["batches"] else 0.0)
         s = self._wait_hist.summary((50, 99))

@@ -51,15 +51,26 @@ NAMES = {
                                    "the verified iterate"),
     "resilient.shrink": ("span", "elastic mesh-shrink escalation (attrs: "
                                  "old/new devices, resumed_iteration)"),
+    "resilient.regrow": ("span", "elastic mesh RE-GROW escalation after a "
+                                 "heal (attrs: old/new devices, "
+                                 "resumed_iteration)"),
     "resilient.verify": ("span", "post-recovery independent true-residual "
                                  "verification"),
-    # ---- spans: serving (serving/server.py) ----
-    "serving.coalesce": ("span", "grouping one queue snapshot into "
-                                 "compatible batches"),
+    # ---- spans: serving (serving/server.py + serving/fleet.py) ----
+    "serving.coalesce": ("span", "QoS-scheduling one queue snapshot into "
+                                 "urgency-ordered compatible batches"),
     "serving.dispatch": ("span", "one coalesced block dispatch (root span "
                                  "on the dispatcher thread)"),
     "serving.request": ("span", "one request submit -> resolve, linked to "
                                 "its batch via the batch_span attr"),
+    "serving.regrow": ("span", "server-wide adoption of a re-grown mesh "
+                               "after a heal (every resident session "
+                               "rebuilt on the larger geometry)"),
+    "fleet.migrate": ("span", "one session migration between replicas: "
+                              "drain -> checkpoint -> re-register -> "
+                              "replay"),
+    "fleet.scale": ("span", "one executed autoscale decision "
+                            "(grow/shrink/rebalance)"),
     # ---- counters ----
     "dispatch.programs": ("counter", "compiled-program launches by "
                                      "program kind (ksp/ksp_many/"
@@ -87,7 +98,18 @@ NAMES = {
                                     "admission queue bound"),
     "serving.expired": ("counter", "requests expired by their dispatch "
                                    "deadline"),
+    "serving.shed": ("counter", "bulk requests shed (resolved with the "
+                                "typed overload error) to admit more "
+                                "urgent traffic"),
+    "qos.requests": ("counter", "admitted requests by QoS class "
+                                "('default' for unlabeled)"),
+    "fleet.migrations": ("counter", "executed session migrations between "
+                                    "replicas"),
+    "fleet.scale_decisions": ("counter", "autoscale decisions by action "
+                                         "(grow/shrink/rebalance/hold)"),
     "elastic.mesh_shrinks": ("counter", "executed degraded-mesh rebuilds"),
+    "elastic.mesh_regrows": ("counter", "executed mesh RE-GROW rebuilds "
+                                        "(healed capacity re-adopted)"),
     "kernel.model_bytes": ("counter", "useful roofline-model bytes by "
                                       "kernel"),
     "kernel.seconds": ("counter", "measured device seconds by kernel"),
@@ -104,6 +126,7 @@ NAMES = {
     "solve.programs": ("gauge", "jit-compiled solver programs held "
                                 "(KSP + EPS caches)"),
     "serving.queue_depth": ("gauge", "pending requests at last submit"),
+    "fleet.replicas": ("gauge", "live server replicas behind the router"),
     # ---- histograms (fixed buckets — metrics.py) ----
     "solve.latency_seconds": ("histogram", "end-to-end wall per solve"),
     "solve.per_iter_seconds": ("histogram", "wall per solver iteration "

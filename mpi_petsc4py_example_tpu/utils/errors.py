@@ -131,15 +131,29 @@ class ServerOverloadedError(RuntimeError):
     unbounded client latency and memory growth — a typed, immediate
     rejection lets callers shed or redirect load instead. Carries
     ``pending`` (queue depth at rejection) and ``limit``.
+
+    The same type RESOLVES a pending bulk request that was SHED by the
+    QoS admission tier (``shed=True``): under overload a less-urgent
+    queued request gives its slot to a more-urgent arrival, and its
+    future resolves with this error — resolved, never dropped or hung
+    (serving/qos.py).
     """
 
-    def __init__(self, pending: int, limit: int):
+    def __init__(self, pending: int, limit: int, shed: bool = False):
         self.pending = int(pending)
         self.limit = int(limit)
-        super().__init__(
-            f"solve server overloaded: {pending} request(s) pending, "
-            f"admission limit {limit} (-solve_server_max_queue) — "
-            "shed load, raise the limit, or add capacity")
+        self.shed = bool(shed)
+        if shed:
+            msg = (f"solve server overloaded: this request was shed from "
+                   f"the queue ({pending} pending, admission limit "
+                   f"{limit}) to admit a more urgent arrival — resubmit, "
+                   "or raise its QoS class")
+        else:
+            msg = (f"solve server overloaded: {pending} request(s) "
+                   f"pending, admission limit {limit} "
+                   "(-solve_server_max_queue) — shed load, raise the "
+                   "limit, or add capacity")
+        super().__init__(msg)
 
 
 class DeadlineExceededError(RuntimeError):

@@ -93,9 +93,37 @@ KNOWN_FLAGS = {
                                      "the HealthMonitor loss-"
                                      "classification threshold)",
     "elastic_min_devices": "smallest mesh the shrink ladder may land on",
+    "elastic_regrow": "arm the ladder's UPWARD direction: re-grow a "
+                      "previously shrunk session onto healed devices "
+                      "(never past its original mesh; default on)",
     "elastic_shrink_unattributed": "allow a speculative halving when "
                                    "repeated failures name no device "
                                    "(default off)",
+    # ---- fleet router (serving/fleet.py) ----
+    "fleet_replicas": "SolveRouter replica count (consistent-hash "
+                      "session sharding across N SolveServers)",
+    "fleet_vnodes": "virtual nodes per replica on the consistent-hash "
+                    "ring (placement smoothness vs ring size)",
+    # ---- QoS scheduling (serving/qos.py) ----
+    "qos_bulk_deadline": "default dispatch deadline seconds for the "
+                         "'bulk' class (0 = none)",
+    "qos_default_class": "QoS class assumed for unlabeled submissions "
+                         "(interactive/bulk; empty = neutral "
+                         "mid-priority)",
+    "qos_interactive_deadline": "default dispatch deadline seconds for "
+                                "the 'interactive' class (0 = none)",
+    # ---- autoscale policy (serving/qos.py AutoscalePolicy) ----
+    "autoscale_enable": "arm the queue-wait-driven replica autoscale "
+                        "policy",
+    "autoscale_high_p99": "queue-wait p99 seconds above which the "
+                          "policy asks for a replica GROW",
+    "autoscale_low_p99": "queue-wait p99 seconds below which (on every "
+                         "replica) the policy asks for a SHRINK",
+    "autoscale_max_replicas": "replica ceiling for grow decisions",
+    "autoscale_min_replicas": "replica floor for shrink decisions",
+    "autoscale_rebalance_ratio": "busiest/idlest queue-wait p99 ratio "
+                                 "above which one session migrates to "
+                                 "the idlest replica",
     # ---- SolveServer (serving/server.py) ----
     "solve_server_deadline": "default per-request server-side dispatch "
                              "deadline seconds (expired requests resolve "

@@ -152,18 +152,72 @@ def mesh_shrinks() -> list[dict]:
     return [dict(e) for e in _MESH_SHRINKS]
 
 
-def record_admission(rejected: int = 0, expired: int = 0):
+# the ladder's upward twin (resilience/elastic.py grown_comm + the
+# serving re-grow adoption): one entry per executed re-grow — recovered
+# capacity is as operator-relevant as lost capacity
+_MESH_REGROWS: list[dict] = []
+
+
+def record_mesh_regrow(old_devices: int, new_devices: int,
+                       rebuild_seconds: float):
+    """Record one executed mesh RE-GROW: healed hardware brought the
+    mesh from ``old_devices`` back up to ``new_devices``; re-placing
+    operands / PC factors / programs took ``rebuild_seconds``."""
+    entry = {"old_devices": int(old_devices),
+             "new_devices": int(new_devices),
+             "rebuild_s": float(rebuild_seconds)}
+    _MESH_REGROWS.append(entry)
+    _REG.counter("elastic.mesh_regrows").inc()
+    if _spans.enabled():
+        _flight.recorder.record_event("mesh_regrow", **entry)
+
+
+def mesh_regrows() -> list[dict]:
+    return [dict(e) for e in _MESH_REGROWS]
+
+
+def record_admission(rejected: int = 0, expired: int = 0, shed: int = 0):
     """Accumulate serving admission-control outcomes: submissions
-    rejected by the queue bound, requests expired by their deadline."""
+    rejected by the queue bound, requests expired by their deadline,
+    and bulk requests SHED (resolved with the typed overload error) to
+    admit more urgent traffic (serving/qos.py)."""
     if rejected:
         _REG.counter("serving.rejected").inc(int(rejected))
     if expired:
         _REG.counter("serving.expired").inc(int(expired))
+    if shed:
+        _REG.counter("serving.shed").inc(int(shed))
 
 
 def admission_counts() -> dict:
     return {"rejected": int(_REG.counter("serving.rejected").total()),
-            "expired": int(_REG.counter("serving.expired").total())}
+            "expired": int(_REG.counter("serving.expired").total()),
+            "shed": int(_REG.counter("serving.shed").total())}
+
+
+def record_qos(qos_class: str):
+    """Count one admitted request by its QoS class (serving/qos.py —
+    'default' for unlabeled submissions)."""
+    _REG.counter("qos.requests").inc(label=str(qos_class or "default"))
+
+
+def qos_counts() -> dict[str, int]:
+    return {str(k): int(v) for k, v in
+            _REG.counter("qos.requests").items().items()}
+
+
+def record_migration(op: str, src: str, dst: str, seconds: float):
+    """Record one fleet session migration (serving/fleet.py): operator
+    ``op`` moved from replica ``src`` to ``dst`` in ``seconds``."""
+    _REG.counter("fleet.migrations").inc()
+    if _spans.enabled():
+        _flight.recorder.record_event("fleet_migration", op=str(op),
+                                      src=str(src), dst=str(dst),
+                                      seconds=float(seconds))
+
+
+def migration_count() -> int:
+    return int(_REG.counter("fleet.migrations").total())
 
 
 def record_collective_latency(label: str, reduce_sites: int,
@@ -260,6 +314,7 @@ def clear_events():
     telemetry metrics registry — the single source of truth)."""
     _EVENTS.clear()
     _MESH_SHRINKS.clear()
+    _MESH_REGROWS.clear()
     _REG.reset()
 
 
@@ -279,6 +334,7 @@ def log_view(file=None):
     if (not _EVENTS and not kernels and not syncs
             and not any(sdc.values()) and not serving["batches"]
             and not collectives and not _MESH_SHRINKS
+            and not _MESH_REGROWS and not migration_count()
             and not any(admission.values())):
         print("log_view: no solve events recorded", file=file)
         return
@@ -314,13 +370,27 @@ def log_view(file=None):
     if any(admission.values()):
         print(f"serving admission control: {admission['rejected']} "
               f"rejected (queue bound), {admission['expired']} "
-              f"deadline-expired", file=file)
+              f"deadline-expired, {admission['shed']} shed (QoS)",
+              file=file)
+    qos = qos_counts()
+    if qos:
+        parts = ", ".join(f"{k}: {v}" for k, v in sorted(qos.items()))
+        print(f"QoS classes served: {parts}", file=file)
     if _MESH_SHRINKS:
         shr = ", ".join(f"{e['old_devices']}->{e['new_devices']} "
                         f"({e['rebuild_s'] * 1e3:.0f} ms)"
                         for e in _MESH_SHRINKS)
         print(f"elastic recovery: {len(_MESH_SHRINKS)} mesh shrink(s) "
               f"[{shr}]", file=file)
+    if _MESH_REGROWS:
+        gr = ", ".join(f"{e['old_devices']}->{e['new_devices']} "
+                       f"({e['rebuild_s'] * 1e3:.0f} ms)"
+                       for e in _MESH_REGROWS)
+        print(f"elastic recovery: {len(_MESH_REGROWS)} mesh re-grow(s) "
+              f"[{gr}]", file=file)
+    if migration_count():
+        print(f"fleet: {migration_count()} session migration(s)",
+              file=file)
     if collectives:
         print("collective latency itemization (reduce sites x per-iter "
               "wall):", file=file)

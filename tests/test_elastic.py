@@ -199,9 +199,12 @@ class TestMeshRebuilder:
         opt.set("elastic_max_same_mesh_retries", "7")
         opt.set("elastic_min_devices", "2")
         opt.set("elastic_shrink_unattributed", "1")
+        opt.set("elastic_regrow", "0")
         p = elastic.ElasticPolicy.from_options()
         assert (p.enabled, p.max_same_mesh_retries, p.min_devices,
                 p.shrink_unattributed) == (False, 7, 2, True)
+        assert p.regrow is False
+        assert elastic.ElasticPolicy().regrow is True   # default on
 
     def test_rebuild_operator_requires_a_hook(self, comm8):
         class Opaque:
@@ -463,6 +466,60 @@ class TestServingElastic:
                                      t_deadline=dl)
         batches = coalesce([mk(None), mk(12345.0)], max_k=8)
         assert len(batches) == 1 and len(batches[0]) == 2
+
+
+class TestRegrowSession:
+    """The ladder's upward direction at the session level: the elastic
+    checkpoint format is mesh-portable in BOTH directions, and
+    regrow_solve_session keeps the identical resume-from-checkpointed-
+    iterate contract as the shrink (the fleet round's symmetry close;
+    the live retry/serving re-grow paths are pinned in test_fleet.py)."""
+
+    def test_checkpoint_on_2_regrows_to_8(self, comm8, tmp_path):
+        A = poisson2d_csr(16)
+        small = tps.DeviceComm(n_devices=2)
+        M = tps.Mat.from_scipy(small, A)
+        ksp = tps.KSP().create(small)
+        ksp.set_operators(M)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=1e-10)
+        x_true = np.random.default_rng(3).random(A.shape[0])
+        bh = A @ x_true
+        x, b = M.get_vecs()
+        b.set_global(bh)
+        cold = ksp.solve(b, x)
+        # run a partial solve to iteration 30, persist it, then re-grow
+        ksp.set_tolerances(rtol=0.0, atol=0.0, max_it=30)
+        x.zero()
+        ksp.solve(b, x)
+        path = str(tmp_path / "regrow_ckpt.npz")
+        save_solve_state(path, M, x, b, iteration=30)
+        it = elastic.regrow_solve_session(ksp, comm8, b=b, x=x,
+                                          checkpoint_path=path)
+        assert it == 30
+        assert ksp.comm.size == 8
+        # the resumed solve continues from the restored iterate: fewer
+        # remaining iterations than the cold start, same answer
+        ksp.set_tolerances(rtol=1e-10, atol=0.0, max_it=10000)
+        ksp.set_initial_guess_nonzero(True)
+        res = ksp.solve(b, x)
+        assert res.converged and res.iterations < cold.iterations
+        rres = (np.linalg.norm(bh - A @ x.to_numpy())
+                / np.linalg.norm(bh))
+        assert rres <= 1e-10 * 1.05
+
+    def test_grown_comm_needs_strictly_larger_rung(self, comm8):
+        """7 healthy devices over a 4-mesh: pow2 rung is 4 — not
+        strictly larger, no re-grow (partial heals wait for the next
+        rung)."""
+        rb = elastic.MeshRebuilder(elastic.ElasticPolicy())
+        four = tps.DeviceComm(n_devices=4)
+        faults.mark_lost(comm8.device_ids[-1])
+        assert rb.grown_comm(four, comm8) is None
+        faults.heal()
+        grown = rb.grown_comm(four, comm8)
+        assert grown is not None and grown.size == 8
 
 
 class TestElasticExports:

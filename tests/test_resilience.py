@@ -457,6 +457,49 @@ class TestReviewRegressions:
         assert chain._lo_cache is not None
 
 
+class TestFirstHitFaults:
+    """Regression (fleet round): an ``at=1`` one-shot spec must FIRE
+    observably on the first hit of its point. The silent-corruption
+    kinds had a first-hit blind spot: the first traced ``spmv.result``
+    site is ``r = b - A(x0)``, and under the default ZERO guess the
+    bitflip of an all-zero apply landed at denormal scale (2^-63) — the
+    clause's window was spent without any detectable corruption ever
+    being injected, so every at=1 drill silently tested nothing and the
+    repo convention had to be 'use at=2'. abft._bitflip now corrupts a
+    zero word to unit scale."""
+
+    def test_at1_bitflip_fires_under_zero_guess(self, comm8):
+        ksp, M, x, b = _setup(comm8)
+        ksp.abft = True
+        with tps.inject_faults("spmv.result=bitflip:at=1:times=1") as plan:
+            with pytest.raises(tps.SilentCorruptionError) as ei:
+                ksp.solve(b, x)
+            assert plan[0].fired == 1
+        assert ei.value.detector in ("abft", "drift")
+
+    def test_at1_bitflip_recovers_end_to_end(self, comm8):
+        """Through the resilient ladder: detect -> rollback -> re-enter
+        -> verified answer, exactly like the at=2 drills."""
+        ksp, M, x, b = _setup(comm8)
+        ksp.abft = True
+        with tps.inject_faults("spmv.result=bitflip:at=1:times=1"):
+            res = resilient_solve(ksp, b, x,
+                                  RetryPolicy(sleep=lambda _d: None))
+        assert res.converged and res.sdc_detections == 1
+        kinds = [e.kind for e in res.recovery_events]
+        assert "rollback" in kinds and "verify" in kinds
+        np.testing.assert_allclose(x.to_numpy(), 1.0, atol=1e-7)
+
+    def test_at1_schedule_fires_exactly_once(self):
+        """The schedule itself (no off-by-one): at=1 fires on hit 1 and
+        only hit 1; the default ``at`` is 1."""
+        f = faults.parse_spec("ksp.solve=unavailable:at=1")[0]
+        assert [f.check(), f.check(), f.check()] == [True, False, False]
+        assert f.fired == 1 and f.spent()
+        g = faults.parse_spec("ksp.solve=unavailable")[0]
+        assert g.check() and g.at == 1
+
+
 class TestResilienceExports:
     def test_package_surface(self):
         assert tps.RetryPolicy is RetryPolicy

@@ -31,6 +31,11 @@ Modes:
 * ``TPU_SOLVE_FAULTS`` set in the environment: ONE corruption drill
   under exactly that spec (the env-activation route);
 * ``--evict``: the two device-eviction drills via ``inject_faults``;
+* ``--fleet`` (ISSUE 13): the loss -> shrink -> heal -> RE-GROW round
+  trip — a retry-ladder drill proving the re-grown mesh RESUMES the
+  solve past iteration 0, and a mixed-QoS router drill with one
+  injected ``device.lost`` AND one ``heal()`` mid-load, exiting nonzero
+  unless every future resolves and post-heal capacity returns;
 * neither: the builtin silent-corruption sweep over every silent fault
   kind at every injectable point (spmv.result / pc.apply / comm.psum).
 
@@ -345,6 +350,186 @@ def drill_evict_serving() -> list[str]:
     return [f"evict-serving: {p}" for p in problems]
 
 
+def drill_fleet_regrow() -> list[str]:
+    """Loss -> shrink -> heal -> RE-GROW in one resilient solve
+    (``--fleet``, the elastic ladder's round trip): a sticky device loss
+    shrinks the session (resuming past iteration 0), the heal lands
+    mid-backoff, and the next transient failure re-grows it onto the
+    repaired full mesh — where the solve again RESUMES from the
+    checkpointed iterate, never iteration 0. The deterministic proof of
+    the acceptance line 'solve resumes past iteration 0 on the re-grown
+    mesh'."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    if comm.size < 2:
+        return ["fleet-regrow: needs a multi-device mesh "
+                f"(got {comm.size} device[s])"]
+    A = poisson2d_csr(16)
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("cg")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=RTOL)
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+    healed = []
+
+    def sleep_heals(_d):
+        # the repair arrives while the session runs degraded: the
+        # backoff of the first post-shrink transient failure is the
+        # deterministic host-side moment to apply it
+        if not healed:
+            healed.append(_faults.heal())
+
+    victim = comm.device_ids[-1]
+    spec = (f"device.lost=unavailable:device={victim}:at=1:iter=10,"
+            "ksp.program=unavailable:at=2:times=2:iter=20")
+    try:
+        with tps.inject_faults(spec):
+            res = tps.resilient_solve(ksp, bv, x,
+                                      tps.RetryPolicy(sleep=sleep_heals))
+        shrinks = [e for e in res.recovery_events
+                   if e.kind == "mesh_shrink"]
+        regrows = [e for e in res.recovery_events
+                   if e.kind == "mesh_regrow"]
+        if not shrinks:
+            problems.append("no mesh_shrink recovery event")
+        elif shrinks[0].iterations <= 0:
+            problems.append("shrink resumed from iteration 0")
+        if not regrows:
+            problems.append("no mesh_regrow recovery event (heal was "
+                            f"{healed})")
+        else:
+            g = regrows[0]
+            if not g.new_devices > g.old_devices:
+                problems.append(f"re-grow did not grow: {g}")
+            if g.iterations <= 0:
+                problems.append("solve did NOT resume past iteration 0 "
+                                "on the re-grown mesh")
+        if ksp.comm.size != comm.size:
+            problems.append(f"capacity did not return: "
+                            f"{ksp.comm.size}/{comm.size} devices")
+        if not res.converged:
+            problems.append(f"recovered solve did not converge: {res}")
+        rtrue = (np.linalg.norm(b - A @ x.to_numpy())
+                 / np.linalg.norm(b))
+        if not rtrue <= RTOL * 1.05:
+            problems.append(f"true relative residual {rtrue:.3e} misses "
+                            "rtol")
+        print(f"[chaos] fleet-regrow: "
+              f"{'OK' if not problems else 'FAIL'} ladder "
+              f"{comm.size}->{shrinks[0].new_devices if shrinks else '?'}"
+              f"->{ksp.comm.size} devices, resumed at "
+              f"{shrinks[0].iterations if shrinks else '?'} then "
+              f"{regrows[0].iterations if regrows else '?'}, "
+              f"true_rres={rtrue:.3e}")
+    finally:
+        _faults.heal()
+    return [f"fleet-regrow: {p}" for p in problems]
+
+
+def drill_fleet_serving() -> list[str]:
+    """Mixed-QoS load on a router fleet with ONE injected device loss
+    AND one heal mid-load (``--fleet``): every future must resolve (a
+    converged fp64-parity result or a typed QoS error), the replica must
+    shrink then RE-GROW, and post-heal capacity must return to the
+    provisioned mesh with post-recovery traffic still served."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.serving import SolveRouter
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    if comm.size < 2:
+        return ["fleet-serving: needs a multi-device mesh "
+                f"(got {comm.size} device[s])"]
+    A = poisson2d_csr(12)
+    n = A.shape[0]
+    rng = np.random.default_rng(14)
+    R = 16
+    Xt = rng.random((n, R))
+    B = np.asarray(A @ Xt)
+    victim = comm.device_ids[-1]
+    rt = SolveRouter(2, comm, window=0.004, max_k=4, deadline=120.0,
+                     retry_policy=tps.RetryPolicy(sleep=lambda _d: None))
+    try:
+        rt.register_operator("poisson", A, pc_type="jacobi", rtol=RTOL)
+        # mixed-QoS Poisson-ish load: alternating classes, bursty gaps
+        classes = ["interactive" if j % 2 else "bulk" for j in range(R)]
+        futs = []
+        # the loss fires at the 1st dispatched block under the armed
+        # plan, with real partial state
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:at=1:iter=6"):
+            for j in range(R // 2):
+                futs.append(rt.submit("poisson", B[:, j],
+                                      qos=classes[j]))
+            if not rt.drain(600):
+                problems.append("drain timed out during the loss phase")
+        st = rt.stats()
+        if st["mesh_shrinks"] != 1:
+            problems.append(f"expected 1 mesh shrink, saw "
+                            f"{st['mesh_shrinks']}")
+        # ONE heal mid-load: capacity must come back for the second half
+        _faults.heal()
+        regrown = rt.heal_check()
+        for j in range(R // 2, R):
+            futs.append(rt.submit("poisson", B[:, j], qos=classes[j]))
+        if not rt.drain(600):
+            problems.append("drain timed out during the heal phase")
+        st = rt.stats()
+        if regrown < 1 or st["mesh_regrows"] < 1:
+            problems.append(f"no replica re-grew after the heal "
+                            f"(regrown={regrown}, stats="
+                            f"{st['mesh_regrows']})")
+        sizes = [s["devices"] for s in st["per_replica"].values()]
+        if any(sz != comm.size for sz in sizes):
+            problems.append(f"post-heal capacity did not return: "
+                            f"replica sizes {sizes} != {comm.size}")
+        answered = converged = typed = 0
+        for j, f in enumerate(futs):
+            if not f.done():
+                problems.append(f"request {j} future never resolved")
+                continue
+            answered += 1
+            exc = f.exception(0)
+            if exc is None:
+                r = f.result(0)
+                rres = (np.linalg.norm(B[:, j] - A @ r.x)
+                        / np.linalg.norm(B[:, j]))
+                if not (r.converged and rres <= RTOL * 1.05):
+                    problems.append(
+                        f"request {j} ({classes[j]}): "
+                        f"reason={r.reason_name} true_rres={rres:.3e} "
+                        "(parity miss)")
+                else:
+                    converged += 1
+            elif isinstance(exc, (tps.DeadlineExceededError,
+                                  tps.ServerOverloadedError)):
+                typed += 1
+            else:
+                problems.append(f"request {j}: untyped failure {exc!r}")
+        if converged == 0:
+            problems.append("no request converged across the ladder")
+        print(f"[chaos] fleet-serving: "
+              f"{'OK' if not problems else 'FAIL'} {answered}/{R} "
+              f"answered ({converged} converged, {typed} typed), "
+              f"shrinks={st['mesh_shrinks']} regrows={st['mesh_regrows']} "
+              f"replica sizes back to {sizes}")
+    finally:
+        rt.shutdown(wait=False)
+        _faults.heal()
+    return [f"fleet-serving: {p}" for p in problems]
+
+
 def validate_trace(trace_path: str, evict: bool) -> list[str]:
     """Structural validation of the exported Perfetto trace + flight
     dump — the CI telemetry job's schema gate."""
@@ -420,7 +605,15 @@ def main() -> int:
         trace_out = argv[i + 1]
         telemetry.enable()
     env_spec = os.environ.get("TPU_SOLVE_FAULTS", "").strip()
-    if "--evict" in sys.argv[1:]:
+    if "--fleet" in sys.argv[1:]:
+        # ISSUE 13 acceptance: loss -> shrink -> heal -> RE-GROW end to
+        # end — the solve resumes past iteration 0 on the re-grown
+        # mesh, every mixed-QoS future resolves, and post-heal capacity
+        # returns to the provisioned mesh
+        failures += drill_fleet_regrow()
+        failures += drill_fleet_serving()
+        what = "fleet loss/shrink/heal/re-grow"
+    elif "--evict" in sys.argv[1:]:
         # ISSUE 8 acceptance: permanent device loss mid-solve AND
         # mid-serving-load must recover onto a strictly smaller mesh
         failures += drill_evict_solve()
