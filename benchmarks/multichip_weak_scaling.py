@@ -1,28 +1,36 @@
-"""MULTICHIP weak-scaling bench — ROADMAP item 2's dry-run promotion.
+"""MULTICHIP weak-scaling bench — the reduction-plan ranking story.
 
 Every BENCH_r0x number to date is ``devices: 1`` and MULTICHIP_r0x was a
 correctness dry-run only; this bench is the scale-out story: 3D Poisson
-stencil CG vs PIPELINED CG (the 1-reduce-site reduction plan,
-solvers/cg_plans.py) across sub-meshes of 2/4/8 devices at
-128³/256³/512³, published as MULTICHIP bench JSON with
+stencil CG vs PIPELINED CG (1 reduce site/iteration) vs S-STEP CA-CG
+(1 site per s iterations, s ∈ {2, 4, 8} — solvers/cg_plans.py) across
+sub-meshes of 2/4/8 devices, published as MULTICHIP bench JSON with
 
 * ``iters_per_s`` — the lockstep loop rate (ideal weak scaling keeps it
   flat as devices and problem grow together);
 * ``iters_per_s_per_chip`` — per-chip useful throughput, local-dof
   iterations per second per chip ``(n/ndev)·iters/wall`` (constant under
   ideal weak scaling);
-* psum-latency itemization — a chained-psum probe measures the mesh's
-  per-reduce-site latency directly, and each solver's per-iteration wall
-  is recorded against its reduce-site count
-  (``utils/profiling.record_collective_latency`` -> the ``-log_view``
-  row), so the site-count reduction (3 -> 2 -> 1) is itemized in
-  seconds, not prose.
+* psum-latency itemization — the chained-psum probe
+  (solvers/autoselect.measure_psum_latency_us — ONE definition shared
+  with the auto-selector) measures the mesh's per-reduce-site latency,
+  and each solver's per-iteration wall is recorded against its
+  reduce-site count (``utils/profiling.record_collective_latency`` ->
+  the ``-log_view`` row), so the site-count reduction (3 -> 2 -> 1 ->
+  1/s) is itemized in seconds, not prose;
+* the per-method CROSSOVER model — for each 1-site plan, the per-site
+  latency L* above which it beats classic CG (``crossover_us``), and
+  the measured-latency winner — plus the auto-selector's own choice
+  (``-ksp_reduction_auto``, solvers/autoselect.py) reported verbatim:
+  on the CPU mesh psum latency is µs-scale and the report honestly says
+  so.
 
-Both solvers run FIXED-ITERATION (``-ksp_norm_type none``) so the
-compared walls cover identical iteration counts; a converged
-rtol-mode parity pair at the smallest point checks correctness, and the
-one-reduce-site gate (utils/hlo.solver_loop_reduce_sites) asserts the
-pipelined program's schedule before any timing is believed.
+All solvers run FIXED-ITERATION (``-ksp_norm_type none``) so the
+compared walls cover identical iteration counts; a converged rtol-mode
+parity sweep at the smallest point checks correctness, and the
+reduce-site gates (utils/hlo.solver_loop_reduce_sites: pipecg == 1,
+sstep == 1 per s-block) assert the schedules before any timing is
+believed.
 
 CLI::
 
@@ -32,8 +40,9 @@ CLI::
 
 ``--smoke`` is the CI / dryrun configuration: small sizes, few
 iterations, perf numbers informational, correctness + schedule gates
-enforced. The full 128³..512³ sweep is sized for real accelerator
-meshes; on the CPU host mesh use the smoke sizes.
+enforced. The full sweep is sized for real accelerator meshes; on the
+CPU host mesh use the smoke sizes (the s-step bases hold 4s+3 resident
+n-vectors, so the largest grids want real HBM).
 """
 
 from __future__ import annotations
@@ -56,48 +65,49 @@ def _mesh_comm(ndev):
 
 
 def psum_per_site_us(comm, chain=256) -> float:
-    """Measured per-reduce-site latency of the mesh: one program running
-    ``chain`` DEPENDENT scalar psums (each divides by the mesh size, so
-    the value is preserved and the chain cannot be collapsed), timed
-    best-of-3. This is the latency each removed reduce site saves per
-    iteration — the quantity the pipelined plan's 3->1 site reduction is
-    buying back."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    axis = comm.axis
-    ndev = comm.size
-
-    def local(v):
-        s = jnp.sum(v)
-
-        def body(_i, a):
-            return lax.psum(a, axis) / ndev
-
-        return lax.fori_loop(0, chain, body, s)
-
-    prog = jax.jit(comm.shard_map(local, (P(axis),), P()))
-    v = comm.put_rows(np.ones(8 * ndev))
-    jax.block_until_ready(prog(v))          # compile
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(prog(v))
-        best = min(best, time.perf_counter() - t0)
-    return best / chain * 1e6
+    """Measured per-reduce-site latency of the mesh — delegates to the
+    shared probe (solvers/autoselect.measure_psum_latency_us) so the
+    bench and ``-ksp_reduction_auto`` price latency with ONE
+    definition."""
+    from mpi_petsc4py_example_tpu.solvers.autoselect import (
+        measure_psum_latency_us)
+    return measure_psum_latency_us(comm, chain=chain)
 
 
-def run_point(comm, size, iters, repeats, dtype, parity=False):
-    """One (mesh, size) weak-scaling point: fixed-iteration CG and
-    pipelined CG walls + optional converged parity pair."""
+#: the ranked method set: label -> (ksp_type, sstep_s or None)
+METHODS = {"cg": ("cg", None), "pipecg": ("pipecg", None),
+           "sstep2": ("sstep", 2), "sstep4": ("sstep", 4),
+           "sstep8": ("sstep", 8)}
+
+
+def _method_sites(label):
+    """Reduce sites PER ITERATION of each compiled schedule on the
+    stencil operator: the stencil CG fast path fuses <p,Ap> into the
+    apply (2 sites), pipecg is the 1-site contract, sstep amortizes its
+    one Gram psum over s iterations (1/s)."""
+    if label == "cg":
+        return 2.0
+    if label == "pipecg":
+        return 1.0
+    return 1.0 / METHODS[label][1]
+
+
+def run_point(comm, size, iters, repeats, dtype, parity=False,
+              methods=None):
+    """One (mesh, size) weak-scaling point: fixed-iteration walls for
+    every ranked method + per-method crossover latency + the
+    auto-selector's choice (+ optional converged parity sweep).
+    ``methods`` restricts the ranked set (must keep "cg", the crossover
+    baseline) — the graft dry-run trims it for wall budget."""
     import jax
     import mpi_petsc4py_example_tpu as tps
     from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+    from mpi_petsc4py_example_tpu.solvers import autoselect
     from mpi_petsc4py_example_tpu.utils.profiling import (
         record_collective_latency)
 
+    mmap = ({lb: METHODS[lb] for lb in methods} if methods else METHODS)
+    assert "cg" in mmap
     ndev = comm.size
     nx = ny = size
     nz = ((size + ndev - 1) // ndev) * ndev
@@ -106,89 +116,116 @@ def run_point(comm, size, iters, repeats, dtype, parity=False):
     rng = np.random.default_rng(7)
     b = rng.standard_normal(n).astype(dtype)
 
-    # reduce-site counts of the two compiled schedules: the stencil CG
-    # fast path fuses <p,Ap> into the Pallas/jnp apply (2 sites), the
-    # pipelined plan is the 1-site contract the gate below pins
-    sites = {"cg": 2, "pipecg": 1}
     point = {"devices": ndev, "grid": [nx, ny, nz], "n": n,
              "iters": int(iters), "dtype": str(np.dtype(dtype))}
 
     solvers = {}
-    for tp in ("cg", "pipecg"):
+    for label, (tp, s) in mmap.items():
         ksp = tps.KSP().create(comm)
         ksp.set_operators(op)
         ksp.set_type(tp)
+        if s is not None:
+            ksp.sstep_s = s
         ksp.get_pc().set_type("jacobi")
         ksp.set_norm_type("none")           # fixed-iteration timing mode
         ksp.set_tolerances(max_it=int(iters))
         x, bv = op.get_vecs()
         bv.set_global(b)
         res = ksp.solve(bv, x)              # compile + warm
-        assert res.iterations == int(iters), (tp, res)
-        solvers[tp] = (ksp, x, bv)
+        assert res.iterations == int(iters), (label, res)
+        solvers[label] = (ksp, x, bv)
     # INTERLEAVED repeats: the shared-host CPU mesh's scheduling noise
-    # swings per-solve walls by 2-3x, so cg/pipecg alternate within each
-    # repeat (systematic drift hits both) and best-of-N is reported
-    best = {"cg": float("inf"), "pipecg": float("inf")}
+    # swings per-solve walls by 2-3x, so the methods alternate within
+    # each repeat (systematic drift hits all) and best-of-N is reported
+    best = {label: float("inf") for label in mmap}
     for _ in range(max(1, repeats)):
-        for tp in ("cg", "pipecg"):
-            ksp, x, bv = solvers[tp]
+        for label in mmap:
+            ksp, x, bv = solvers[label]
             x.set_global(np.zeros(n, dtype))
             t0 = time.perf_counter()
             ksp.solve(bv, x)
             jax.block_until_ready(x.data)
-            best[tp] = min(best[tp], time.perf_counter() - t0)
-    for tp in ("cg", "pipecg"):
-        per_iter = best[tp] / iters
+            best[label] = min(best[label], time.perf_counter() - t0)
+    for label in mmap:
+        per_iter = best[label] / iters
         record_collective_latency(
-            f"{tp}[{ndev}dev,{size}^3]", sites[tp], per_iter)
-        point[tp] = {
-            "wall_s": best[tp],
+            f"{label}[{ndev}dev,{size}^3]", _method_sites(label),
+            per_iter)
+        point[label] = {
+            "wall_s": best[label],
             "per_iter_us": per_iter * 1e6,
-            "iters_per_s": iters / best[tp],
+            "iters_per_s": iters / best[label],
             # per-chip useful throughput: local-dof iterations/s/chip —
             # flat under ideal weak scaling
-            "iters_per_s_per_chip": (n / ndev) * iters / best[tp],
-            "reduce_sites": sites[tp],
+            "iters_per_s_per_chip": (n / ndev) * iters / best[label],
+            "reduce_sites_per_iter": _method_sites(label),
         }
 
     psum_us = psum_per_site_us(comm)
     record_collective_latency(f"psum-probe[{ndev}dev]", 1, psum_us / 1e6)
     point["psum_per_site_us"] = psum_us
-    point["pipecg_speedup"] = (point["cg"]["per_iter_us"]
-                               / point["pipecg"]["per_iter_us"])
-    point["pipecg_ge_cg"] = (point["pipecg"]["iters_per_s"]
-                             >= point["cg"]["iters_per_s"])
+    if "pipecg" in mmap:
+        point["pipecg_speedup"] = (point["cg"]["per_iter_us"]
+                                   / point["pipecg"]["per_iter_us"])
+        point["pipecg_ge_cg"] = (point["pipecg"]["iters_per_s"]
+                                 >= point["cg"]["iters_per_s"])
     # latency crossover model: per-iter wall = compute + sites * L. With
     # the measured psum latency L subtracted out, the non-collective
-    # residue of each solver gives the per-site latency L* above which
-    # the 1-site pipelined schedule beats the 2-site classic one:
-    # L* = compute_pipecg - compute_cg. On a single-host virtual mesh the
-    # "latency" is a thread rendezvous (tiny, noisy); on a real
-    # multi-chip interconnect L is the dominant term — this is the
-    # number that says when the pipelining pays on a given mesh.
-    comp_cg = point["cg"]["per_iter_us"] - 2 * psum_us
-    comp_pipe = point["pipecg"]["per_iter_us"] - psum_us
-    point["pipecg_crossover_us"] = max(0.0, comp_pipe - comp_cg)
-    point["pipecg_wins_at_measured_latency"] = (
-        psum_us >= point["pipecg_crossover_us"])
+    # residue of each method gives the per-site latency L* above which
+    # its schedule beats classic CG's:
+    # L* = (compute_m - compute_cg) / (sites_cg - sites_m). On a
+    # single-host virtual mesh the "latency" is a thread rendezvous
+    # (tiny, noisy); on a real multi-chip interconnect L dominates —
+    # crossover_us is the number that says when each plan pays off on a
+    # given mesh, and the bench reports it PER METHOD so the plans rank
+    # as a function of latency, not anecdote.
+    s_cg = _method_sites("cg")
+    comp_cg = point["cg"]["per_iter_us"] - s_cg * psum_us
+    point["crossover_us"] = {}
+    winners = []
+    for label in mmap:
+        if label == "cg":
+            continue
+        s_m = _method_sites(label)
+        comp_m = point[label]["per_iter_us"] - s_m * psum_us
+        lstar = max(0.0, (comp_m - comp_cg) / (s_cg - s_m))
+        point["crossover_us"][label] = lstar
+        if psum_us >= lstar:
+            winners.append(label)
+    if "pipecg" in mmap:
+        point["pipecg_crossover_us"] = point["crossover_us"]["pipecg"]
+        point["pipecg_wins_at_measured_latency"] = "pipecg" in winners
+    point["wins_at_measured_latency"] = winners
+    # fastest measured method at this point — the honest ranking
+    point["fastest_measured"] = min(
+        mmap, key=lambda lb: point[lb]["per_iter_us"])
+    # the auto-selector's own decision for this mesh+operator, verbatim
+    # (its additive model + the 25% displacement margin — on the CPU
+    # mesh it keeps classic CG unless the measured latency genuinely
+    # dominates)
+    sel = autoselect.select_reduction_plan(
+        comm, op, solvers["cg"][0].get_pc())
+    point["autoselect"] = sel.as_dict()
 
     if parity:
-        # converged-mode parity: both solvers must reach the same answer
+        # converged-mode parity: every method must reach the same answer
         xs = {}
-        for tp in ("cg", "pipecg"):
+        for label, (tp, s) in mmap.items():
             ksp = tps.KSP().create(comm)
             ksp.set_operators(op)
             ksp.set_type(tp)
+            if s is not None:
+                ksp.sstep_s = s
             ksp.get_pc().set_type("jacobi")
             ksp.set_tolerances(rtol=1e-8, max_it=5000)
             x, bv = op.get_vecs()
             bv.set_global(b)
             res = ksp.solve(bv, x)
-            assert res.converged, (tp, res)
-            xs[tp] = x.to_numpy()
-        rel = (np.linalg.norm(xs["pipecg"] - xs["cg"])
-               / np.linalg.norm(xs["cg"]))
+            assert res.converged, (label, res)
+            xs[label] = x.to_numpy()
+        rel = max(np.linalg.norm(xs[lb] - xs["cg"])
+                  / np.linalg.norm(xs["cg"]) for lb in mmap
+                  if lb != "cg")
         assert rel <= 1e-6, rel
         point["parity_rel_diff"] = float(rel)
     return point
@@ -197,7 +234,8 @@ def run_point(comm, size, iters, repeats, dtype, parity=False):
 def one_reduce_site_gate(comm, size, dtype):
     """The schedule gate: the pipelined program's main loop must lower
     to exactly ONE reduce site per iteration (vs 2 for the fused stencil
-    CG path) — no timing is meaningful if the schedule regressed."""
+    CG path), and the s-step program to ONE site per s-BLOCK — no
+    timing is meaningful if a schedule regressed."""
     import mpi_petsc4py_example_tpu as tps
     from mpi_petsc4py_example_tpu.models import StencilPoisson3D
     from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
@@ -212,19 +250,25 @@ def one_reduce_site_gate(comm, size, dtype):
     ksp.get_pc().set_type("jacobi")
     ksp.set_up()
     pc = ksp.get_pc()
-    prog = build_ksp_program(comm, "pipecg", pc, op)
     x, b = op.get_vecs()
     dt = np.dtype(dtype).type
-    txt = prog.lower(op.device_arrays(), pc.device_arrays(), b.data,
-                     x.data, dt(1e-8), dt(0.0), dt(0.0),
-                     np.int32(8)).as_text()
-    sites = solver_loop_reduce_sites(txt)
+
+    def lower(tp, **kw):
+        prog = build_ksp_program(comm, tp, pc, op, **kw)
+        return prog.lower(op.device_arrays(), pc.device_arrays(), b.data,
+                          x.data, dt(1e-8), dt(0.0), dt(0.0),
+                          np.int32(8)).as_text()
+
+    sites = solver_loop_reduce_sites(lower("pipecg"))
     assert sites == 1, f"pipelined program has {sites} reduce sites"
+    for s in (2, 4, 8):
+        ss = solver_loop_reduce_sites(lower("sstep", sstep_s=s))
+        assert ss == 1, f"sstep s={s} block has {ss} reduce sites"
     return sites
 
 
 def run(devices=(2, 4, 8), sizes=(128, 256, 512), iters=200, repeats=3,
-        dtype=np.float64, out=None, smoke=False):
+        dtype=np.float64, out=None, smoke=False, methods=None):
     """``iters`` may be a single count for every size or a sequence
     zipped against ``sizes`` — fixed-iteration timing means the
     per-iteration numbers stay comparable while the wall budget of the
@@ -250,18 +294,19 @@ def run(devices=(2, 4, 8), sizes=(128, 256, 512), iters=200, repeats=3,
                 comm, min(sizes), dtype)
         for size in sizes:
             pt = run_point(comm, size, iters_by_size[size], repeats,
-                           dtype, parity=first)
+                           dtype, parity=first, methods=methods)
             first = False
             results["points"].append(pt)
-            print(f"  weak-scaling {ndev}dev {size}^3: "
-                  f"cg {pt['cg']['iters_per_s']:.1f} it/s, "
-                  f"pipecg {pt['pipecg']['iters_per_s']:.1f} it/s "
-                  f"(x{pt['pipecg_speedup']:.2f}), "
-                  f"psum {pt['psum_per_site_us']:.1f} us/site",
+            rates = " ".join(f"{lb} {pt[lb]['iters_per_s']:.1f}"
+                             for lb in METHODS if lb in pt)
+            print(f"  weak-scaling {ndev}dev {size}^3 it/s: {rates}; "
+                  f"psum {pt['psum_per_site_us']:.1f} us/site, "
+                  f"fastest {pt['fastest_measured']}, "
+                  f"autoselect {pt['autoselect']['choice']}",
                   flush=True)
     results["pipecg_ge_cg_everywhere"] = all(
-        p["pipecg_ge_cg"] for p in results["points"]) if results["points"] \
-        else False
+        p.get("pipecg_ge_cg", False)
+        for p in results["points"]) if results["points"] else False
     if out:
         os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
         with open(out, "w", encoding="utf-8") as fh:
@@ -307,9 +352,14 @@ def main(argv=None):
             {"devices": p["devices"], "n": p["n"],
              "cg_it_s": round(p["cg"]["iters_per_s"], 1),
              "pipecg_it_s": round(p["pipecg"]["iters_per_s"], 1),
+             "sstep4_it_s": round(p["sstep4"]["iters_per_s"], 1),
              "it_s_per_chip": round(
                  p["pipecg"]["iters_per_s_per_chip"], 1),
-             "psum_us": round(p["psum_per_site_us"], 1)}
+             "psum_us": round(p["psum_per_site_us"], 1),
+             "fastest": p["fastest_measured"],
+             "autoselect": p["autoselect"]["choice"],
+             "crossover_us": {k: round(v, 1) for k, v
+                              in p["crossover_us"].items()}}
             for p in res["points"]]}))
     return 0
 

@@ -57,6 +57,15 @@ class SolveRequest:
     # if a future server aliases several precision variants of one
     # operand set under related names.
     precision: str = ""
+    # the REDUCTION-PLAN SCHEDULE of the session ("cg" / "pipecg" /
+    # "sstep:<s>") — part of the compatibility key for the same reason
+    # as precision: the schedule (and sstep's s, which sizes the traced
+    # basis) is compiled into the block program, so requests solved
+    # under different schedules must never share one. Today a session
+    # name maps to exactly one KSP configuration, but a re-registered
+    # session (the fleet-migration landing path) or a future
+    # multi-schedule alias must not be able to batch across schedules.
+    schedule: str = ""
     # QoS (serving/qos.py): the request's class label ("" = unlabeled)
     # and its priority tier (LOWER is more urgent; unlabeled requests
     # sit at qos.DEFAULT_PRIORITY between interactive and bulk). NOT
@@ -83,9 +92,10 @@ class SolveRequest:
     @property
     def key(self) -> tuple:
         """Compatibility key: requests batch together iff keys match
-        (same operator, same tolerances, same precision plan)."""
-        return (self.op, str(self.precision), float(self.rtol),
-                float(self.atol), int(self.max_it))
+        (same operator, same tolerances, same precision plan, same
+        reduction-plan schedule)."""
+        return (self.op, str(self.precision), str(self.schedule),
+                float(self.rtol), float(self.atol), int(self.max_it))
 
     def expired(self, now: float) -> bool:
         """Whether the request's dispatch deadline has passed."""

@@ -149,6 +149,15 @@ class _OperatorSession:
         self.atol = float(ksp.atol)
         self.max_it = int(ksp.max_it)
 
+    @property
+    def schedule(self) -> str:
+        """The session's reduction-plan schedule ("cg" / "pipecg" /
+        "sstep:<s>") — part of every request's compatibility key
+        (serving/coalescer.py): the schedule is compiled into the block
+        program, so blocks never mix schedules."""
+        tp = self.ksp.get_type()
+        return f"{tp}:{int(self.ksp.sstep_s)}" if tp == "sstep" else tp
+
 
 class SolveServer:
     """Long-lived solve session with request coalescing (module doc).
@@ -331,7 +340,7 @@ class SolveServer:
         # sequential solves (KSP.solve_many's fallback routing) — results
         # stay correct, the serving throughput win evaporates. Say so.
         from ..solvers.krylov import batched_pc_supported
-        if (ksp.get_type() not in ("cg", "pipecg")
+        if (ksp.get_type() not in ("cg", "pipecg", "sstep")
                 or not batched_pc_supported(ksp.get_pc())):
             import warnings
             warnings.warn(
@@ -437,8 +446,10 @@ class SolveServer:
             atol=sess.atol if atol is None else float(atol),
             max_it=sess.max_it if max_it is None else int(max_it),
             # the session's storage dtype IS its precision plan — part
-            # of the compatibility key (serving/coalescer.py)
+            # of the compatibility key (serving/coalescer.py), as is
+            # the reduction-plan schedule (cg/pipecg/sstep:<s>)
             precision=str(sess.dtype),
+            schedule=sess.schedule,
             qos=cls.name if cls is not None else "",
             priority=prio,
             future=fut)

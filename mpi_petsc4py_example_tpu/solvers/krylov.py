@@ -39,7 +39,7 @@ from . import cg_plans as _plans
 # assemblies and this module's non-CG kernels read ONE definition);
 # re-imported here so every existing import site keeps working
 from .cg_plans import (SDC_NONE, SDC_ABFT, SDC_ABFT_PC, SDC_DRIFT, SDC_NAN,
-                       SDC_MONO, SDC_DETECTOR_NAMES, _det4,
+                       SDC_MONO, SDC_DEMOTE, SDC_DETECTOR_NAMES, _det4,
                        _SDC_MONO_FACTOR, _SDC_DRIFT_REL,
                        _SDC_DRIFT_FLOOR_EPS, _dmax, _tol, _nat, _reason,
                        _no_hist, _hist0, _mon0)
@@ -270,8 +270,9 @@ def cg_stencil_kernel(Adot, inv_diag, pdot, pnorm, b, x0, rtol, atol, maxit,
 
 # KSP types with a guarded (ABFT + invariant-monitor) kernel variant:
 # cg's two-phase plan folds the checksums into its stacked psums, pipecg's
-# single-reduction plan folds them into its ONE stacked psum
-GUARDED_TYPES = ("cg", "pipecg")
+# single-reduction plan folds them into its ONE stacked psum, and sstep's
+# basis-build checksums ride its one stacked Gram psum per s-block
+GUARDED_TYPES = ("cg", "pipecg", "sstep")
 
 
 def _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot, tsum,
@@ -440,6 +441,35 @@ def _make_pipe_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
                                   chk_parts=chk_parts, chk_init=chk_init,
                                   vnorm2=vnorm2, vpair2=vpair2,
                                   rr_n=rr_n, eps=eps)
+
+
+def _make_sstep_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, *, dot,
+                      tsum, tasum, cmul, no_bad, pdot, pnorm,
+                      eps_dtype=None):
+    """The guard bundle for the S-STEP reduction plan.
+
+    The s-step loop checks its basis-build applies itself — every chain
+    apply's checksum partials (``Σ(A v) ≈ ⟨c, v⟩`` per basis column,
+    ``Σ(M w) ≈ ⟨c_M, w⟩`` per PC pair) are column sums the loop folds
+    into its one stacked Gram psum (:func:`cg_plans.fuse_gram_psum`), so
+    the per-s-block collective count stays at ONE. This bundle therefore
+    carries the raw checksum shards (``cs``/``csM``) and the threshold
+    inputs for the loop's in-body algebra, plus the shared init check and
+    the plain-psum replacement verifier from :func:`_make_guard` — the
+    verifier must never ride the injectable psum. The drift gate's
+    CA-CG-specific semantics (basis restart, demotion budget) live in
+    :func:`cg_plans.sstep_cg_loop`."""
+    base = _make_guard(dtype, axis, cs_l, csM_l, abft_tol, rr_n, dot=dot,
+                       tsum=tsum, tasum=tasum, cmul=cmul, no_bad=no_bad,
+                       pdot=pdot, pnorm=pnorm, eps_dtype=eps_dtype)
+
+    def vnorm2(rt):
+        return jnp.real(lax.psum(jnp.asarray(dot(rt, rt), dtype), axis))
+
+    return _types.SimpleNamespace(init=base.init, vpair=base.vpair,
+                                  vnorm2=vnorm2, rr_n=rr_n, eps=base.eps,
+                                  cs=cs_l, csM=csM_l, abft_tol=abft_tol,
+                                  no_bad=no_bad)
 
 
 def cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
@@ -1067,6 +1097,69 @@ def pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol,
         b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol,
         A=A, M=M, pnorm=pnormc, fused=g.fused, guard=g,
         bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
+
+
+def sstep_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, *, s,
+                 greduce, monitor=None, dtol=None, prec=None):
+    """s-step communication-avoiding CG (CA-CG; no PETSc KSP analog —
+    KSPPIPECG is the nearest, PARITY.md round 16).
+
+    Advances CG s iterations per ``while_loop`` body around ONE stacked
+    psum — the tall-skinny Gram matrix of the block's monomial Krylov
+    bases — with the s iterations run as host-free coefficient
+    recurrences in basis coordinates (:func:`cg_plans.sstep_cg_loop`).
+    The per-iteration reduction count drops to 1/s at the cost of
+    ~2x the operator applies (the two-basis monomial CA-CG trade): the
+    win is real exactly where per-reduction latency dominates per-apply
+    cost — the high-latency-interconnect regime the weak-scaling bench's
+    crossover model prices per method."""
+    return _plans.sstep_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol, s=s,
+        greduce=greduce, A=A, M=M, pnorm=pnorm, monitor=monitor,
+        prec=prec)
+
+
+def sstep_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol, atol, maxit, g,
+                         *, s, greduce, max_repl, monitor=None, dtol=None,
+                         prec=None):
+    """Guarded s-step CG: basis-build ABFT partials folded into the one
+    stacked Gram psum (:func:`_make_sstep_guard`), NaN/monotonicity
+    sentinels at block ends, and the periodic true-residual gate with
+    CA-CG semantics — drift restarts the basis from the true residual,
+    and past ``max_repl`` restarts (``-ksp_sstep_max_replacements``)
+    the loop exits with the ``SDC_DEMOTE`` code so KSP demotes the solve
+    to classic CG. Output contract matches :func:`cg_kernel_guarded`."""
+    return _plans.sstep_cg_loop(
+        b=b, x0=x0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol, s=s,
+        greduce=greduce, A=A, M=M, pnorm=pnorm, guard=g,
+        max_repl=max_repl, monitor=monitor, prec=prec)
+
+
+def sstep_kernel_many(A, M, pdotc, pnormc, B, X0, rtol, atol, maxit, *, s,
+                      greduce, monitor=None, dtol=None, prec=None):
+    """Batched s-step CG: ``nrhs`` lockstep CA-CG recurrences with
+    per-column bases and per-column masked convergence — the one stacked
+    Gram psum reduces every column's ``(2m+1)²`` block in a single
+    collective, so the per-s-block collective count is ONE independent
+    of nrhs."""
+    return _plans.sstep_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol, s=s,
+        greduce=greduce, A=A, M=M, pnorm=pnormc,
+        bp=_plans.ManyBatch("cols"), monitor=monitor, prec=prec)
+
+
+def sstep_kernel_many_guarded(A, M, pdotc, pnormc, B, X0, rtol, atol,
+                              maxit, g, *, s, greduce, max_repl,
+                              monitor=None, dtol=None, prec=None):
+    """Batched guarded s-step CG: mask-aware per-column detection (sticky
+    det codes, frozen columns keep verified state) with every guard
+    partial riding the single stacked Gram psum. Output contract matches
+    :func:`cg_kernel_many_guarded`."""
+    return _plans.sstep_cg_loop(
+        b=B, x0=X0, rtol=rtol, atol=atol, maxit=maxit, dtol=dtol, s=s,
+        greduce=greduce, A=A, M=M, pnorm=pnormc, guard=g,
+        max_repl=max_repl, bp=_plans.ManyBatch("cols"), monitor=monitor,
+        prec=prec)
 
 
 def fgmres_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
@@ -1906,6 +1999,7 @@ def bcgsl_kernel(A, M, pdot, pnorm, b, x0, rtol, atol, maxit,
 KSP_KERNELS = {
     "cg": cg_kernel,
     "pipecg": pipecg_kernel,
+    "sstep": sstep_kernel,
     "bcgs": bcgs_kernel,
     "gmres": gmres_kernel,
     "fgmres": fgmres_kernel,
@@ -2001,7 +2095,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                       natural: bool = False, hist_cap: int = 0,
                       live: bool = False, true_res: bool = False,
                       abft: bool = False, abft_pc: bool = False,
-                      rr: bool = False, donate: bool = False):
+                      rr: bool = False, donate: bool = False,
+                      sstep_s: int = 4):
     """Build (or fetch cached) the jitted SPMD solve program.
 
     Signature of the returned callable::
@@ -2081,11 +2176,12 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     # accepts sub-f32 storage.
     prec = _plans.precision_plan(dtype)
     mixed = prec.mixed
-    if mixed and ksp_type not in ("cg", "pipecg", "preonly", "richardson"):
+    if mixed and ksp_type not in ("cg", "pipecg", "sstep", "preonly",
+                                  "richardson"):
         raise ValueError(
             f"sub-f32 storage ({np.dtype(dtype)}) solves are assembled by "
             f"the mixed-precision CG plans; KSP {ksp_type!r} has no "
-            "precision-plan body — use cg/pipecg (typically under "
+            "precision-plan body — use cg/pipecg/sstep (typically under "
             "RefinedKSP fp64 refinement), or f32 storage")
     rdt = prec.reduce
     _up = prec.up       # the ONE lift-to-reduce-channel definition
@@ -2116,6 +2212,10 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                                         "lgmres") else 0
     aug_k = aug if ksp_type == "lgmres" else 0
     ell_k = ell if ksp_type == "bcgsl" else 0
+    # s-step block size: part of the traced body (the basis build and the
+    # coordinate recurrences unroll statically over s), so it keys the
+    # program; normalized to 0 for every other type
+    sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
     # unrolling trades wasted masked steps for fewer loop dispatches; with a
     # monitor attached every sub-step would re-fire the callback, so
     # monitored programs stay at 1
@@ -2135,7 +2235,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            restart_k, monitored, zero_guess, operator.program_key(),
            nullspace_dim, aug_k, ell_k, unroll_k, natural_k, cap_k, live_k,
-           true_res_k, abft_k, abft_pc_k, bool(rr), donate_k,
+           true_res_k, abft_k, abft_pc_k, bool(rr), donate_k, sstep_k,
            _faults.trace_key())
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
@@ -2248,7 +2348,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             kw["dtol"] = dtol
             if natural_k:
                 kw["natural"] = True
-            if mixed and ksp_type in ("cg", "pipecg"):
+            if mixed and ksp_type in ("cg", "pipecg", "sstep"):
                 # only the plan-built family takes the plan object; the
                 # loop-free preonly/richardson bodies need no casts
                 kw["prec"] = prec
@@ -2360,7 +2460,7 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     maxit, grid3d=operator.grid3d, **kw)
 
             if guard_args is not None:
-                cs_l, csM_l, abft_tol, rr_n = guard_args
+                cs_l, csM_l, abft_tol, rr_n = guard_args[:4]
                 # the guard's partial sums run in the REDUCE channel (_up
                 # lifts bf16 operands); the detection threshold stays
                 # scaled to the STORAGE epsilon (eps_dtype)
@@ -2377,6 +2477,15 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                     return pipecg_kernel_guarded(A, M, pdot, pnorm, b, x0,
                                                  rtol, atol, maxit, gp,
                                                  **kw)
+                if ksp_type == "sstep":
+                    gs = _make_sstep_guard(stack_dt, axis, cs_l, csM_l,
+                                           abft_tol, rr_n, **flavor)
+                    return sstep_kernel_guarded(
+                        A, M, pdot, pnorm, b, x0, rtol, atol, maxit, gs,
+                        s=sstep_k,
+                        greduce=lambda parts: _plans.fuse_gram_psum(
+                            parts, _psum, axis, stack_dt),
+                        max_repl=guard_args[4], **kw)
                 g = _make_guard(stack_dt, axis, cs_l, csM_l, abft_tol, rr_n,
                                 **flavor)
                 return cg_kernel_guarded(A, M, pdot, pnorm, b, x0, rtol,
@@ -2405,6 +2514,13 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
                 # split it and prove the assert has teeth
                 kw["preduce"] = lambda *parts: _plans.fuse_psum(
                     list(parts), _psum, axis, stack_dt)
+            elif ksp_type == "sstep":
+                # the s-block's ONE collective: Gram matrix + guard
+                # partials through the cg_plans.fuse_gram_psum seam (the
+                # 1-site-per-s-block gate's injected-regression splits it)
+                kw["s"] = sstep_k
+                kw["greduce"] = lambda parts: _plans.fuse_gram_psum(
+                    parts, _psum, axis, stack_dt)
             elif ksp_type in _NEEDS_TRANSPOSE:
                 # the adjoint of the projected operator v -> P(Av) is
                 # w -> A^T(Pw): project BEFORE the transpose product (P is
@@ -2464,7 +2580,8 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
     elif guard_k:
         # guard signature: leading checksum vectors (present per flag),
         # trailing runtime guard scalars (tolerance factor + replacement
-        # interval — runtime, so tuning them never recompiles)
+        # interval — runtime, so tuning them never recompiles; sstep
+        # appends its basis-restart budget -ksp_sstep_max_replacements)
         def local_fn(op_arrays, pc_arrays, *args):
             i = 0
             cs = csM = None
@@ -2474,17 +2591,24 @@ def build_ksp_program(comm: DeviceComm, ksp_type: str, pc, operator,
             if abft_pc_k:
                 csM = args[i]
                 i += 1
-            b, x0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+            if ksp_type == "sstep":
+                (b, x0, rtol, atol, dtol, maxit, abft_tol, rr_n,
+                 max_repl) = args[i:]
+                ga = (cs, csM, abft_tol, rr_n, max_repl)
+            else:
+                b, x0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+                ga = (cs, csM, abft_tol, rr_n)
             out = make_body(lambda v: v)(
                 op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit,
-                guard_args=(cs, csM, abft_tol, rr_n))
+                guard_args=ga)
             if true_res_k:
                 out = out + _true_res_tail(op_arrays, b, out[0])
             return out
 
         in_specs = (op_specs, pc.in_specs(axis)) \
             + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
-            + (P(axis), P(axis), P(), P(), P(), P(), P(), P())
+            + (P(axis), P(axis), P(), P(), P(), P(), P(), P()) \
+            + ((P(),) if ksp_type == "sstep" else ())
         x0_idx = 3 + abft_k + abft_pc_k
     else:
         def local_fn(op_arrays, pc_arrays, b, x0, rtol, atol, dtol, maxit):
@@ -2626,7 +2750,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                            zero_guess: bool = False, hist_cap: int = 0,
                            abft: bool = False, abft_pc: bool = False,
                            rr: bool = False, true_res: bool = False,
-                           donate: bool = False):
+                           donate: bool = False, sstep_s: int = 4):
     """Build (or fetch cached) the batched multi-RHS solve program.
 
     Signature of the returned callable::
@@ -2657,15 +2781,16 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     except while a fault plan with live trace-time faults is armed
     (a program traced under injection must never be persisted).
     """
-    if ksp_type not in ("cg", "pipecg"):
+    if ksp_type not in ("cg", "pipecg", "sstep"):
         raise ValueError(
-            f"batched multi-RHS programs support KSP 'cg'/'pipecg' (the "
-            f"block-CG plans); {ksp_type!r} solves route through the "
-            "sequential fallback (KSP.solve_many)")
+            f"batched multi-RHS programs support KSP 'cg'/'pipecg'/"
+            f"'sstep' (the block-CG plans); {ksp_type!r} solves route "
+            "through the sequential fallback (KSP.solve_many)")
     from ..utils import aot
     axis = comm.axis
     n = operator.shape[0]
     dtype = operator.dtype
+    sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
     # precision plan (see build_ksp_program): batched storage channel in
     # the operator dtype, reductions lifted into the reduce channel
     prec = _plans.precision_plan(dtype)
@@ -2684,7 +2809,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            int(nrhs), monitored, zero_guess, operator.program_key(),
            cap_k, abft_k, abft_pc_k, bool(rr), true_res_k, donate_k,
-           trace_nonce, aot_on)
+           sstep_k, trace_nonce, aot_on)
     cached = _PROGRAM_CACHE_MANY.get(key)
     if cached is not None:
         return cached
@@ -2761,7 +2886,7 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
         M = lambda R: _abft.apply_silent_fault(
             "pc.apply", pc_apply(pc_arrays, R))
         if guard_args is not None:
-            cs_l, csM_l, abft_tol, rr_n = guard_args
+            cs_l, csM_l, abft_tol, rr_n = guard_args[:4]
             flavor = dict(
                 dot=cdot, tsum=lambda U: jnp.sum(_up(U), axis=0),
                 tasum=lambda U: jnp.sum(jnp.abs(_up(U)), axis=0),
@@ -2775,6 +2900,15 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                 return pipecg_kernel_many_guarded(A, M, pdotc, pnormc, B,
                                                   X0, rtol, atol, maxit,
                                                   gp, **kw)
+            if ksp_type == "sstep":
+                gs = _make_sstep_guard(stack_dt, axis, cs_l, csM_l,
+                                       abft_tol, rr_n, **flavor)
+                return sstep_kernel_many_guarded(
+                    A, M, pdotc, pnormc, B, X0, rtol, atol, maxit, gs,
+                    s=sstep_k,
+                    greduce=lambda parts: _plans.fuse_gram_psum(
+                        parts, _psum, axis, stack_dt, batched=True),
+                    max_repl=guard_args[4], **kw)
             g = _make_guard(stack_dt, axis, cs_l, csM_l, abft_tol, rr_n,
                             **flavor)
             return cg_kernel_many_guarded(A, M, pdotc, pnormc, B, X0,
@@ -2787,6 +2921,12 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
                 return s[0], s[1], s[2]
             return pipecg_kernel_many(A, M, pdotc, pnormc, fusedc, B, X0,
                                       rtol, atol, maxit, **kw)
+        if ksp_type == "sstep":
+            return sstep_kernel_many(
+                A, M, pdotc, pnormc, B, X0, rtol, atol, maxit,
+                s=sstep_k,
+                greduce=lambda parts: _plans.fuse_gram_psum(
+                    parts, _psum, axis, stack_dt, batched=True), **kw)
         return cg_kernel_many(A, M, pdotc, pnormc, pduo, B, X0, rtol,
                               atol, maxit, **kw)
 
@@ -2800,16 +2940,24 @@ def build_ksp_program_many(comm: DeviceComm, ksp_type: str, pc, operator,
             if abft_pc_k:
                 csM = args[i]
                 i += 1
-            B, X0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+            if ksp_type == "sstep":
+                (B, X0, rtol, atol, dtol, maxit, abft_tol, rr_n,
+                 max_repl) = args[i:]
+                ga = (cs, csM, abft_tol, rr_n, max_repl)
+            else:
+                B, X0, rtol, atol, dtol, maxit, abft_tol, rr_n = args[i:]
+                ga = (cs, csM, abft_tol, rr_n)
             out = body(op_arrays, pc_arrays, B, X0, rtol, atol, dtol,
-                       maxit, guard_args=(cs, csM, abft_tol, rr_n))
+                       maxit, guard_args=ga)
             if true_res_k:
                 out = out + _tail_many(op_arrays, B, out[0])
             return out
 
         in_specs = (op_specs, pc.in_specs(axis)) \
             + tuple(P(axis) for _ in range(abft_k + abft_pc_k)) \
-            + (P(axis, None), P(axis, None), P(), P(), P(), P(), P(), P())
+            + (P(axis, None), P(axis, None), P(), P(), P(), P(), P(),
+               P()) \
+            + ((P(),) if ksp_type == "sstep" else ())
         x0_idx = 3 + abft_k + abft_pc_k
     else:
         def local_fn(op_arrays, pc_arrays, B, X0, rtol, atol, dtol, maxit):

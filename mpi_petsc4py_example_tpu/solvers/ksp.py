@@ -110,6 +110,30 @@ class KSP:
                                       # CG's u/w recurrence drift
                                       # (Ghysels-Vanroose); 0 = off.
                                       # Non-pipelined types ignore it.
+        self.sstep_s = 4              # -ksp_sstep_s: s-step CG block size
+                                      # (iterations per stacked Gram psum;
+                                      # compiled into the program — part
+                                      # of the cache key)
+        self.sstep_max_replacements = 3  # -ksp_sstep_max_replacements:
+                                      # CA-CG drift-restart budget — past
+                                      # this many basis restarts the
+                                      # solve DEMOTES to classic CG from
+                                      # the current iterate (runtime
+                                      # scalar, no recompile)
+        self.sstep_auto_replacement = 0  # -ksp_sstep_auto_replacement N:
+                                      # sstep only — arm the drift gate
+                                      # every N iterations when
+                                      # -ksp_residual_replacement is
+                                      # unset (the CA-CG basis
+                                      # ill-conditioning bound); 0 = off
+        self.reduction_auto = False   # -ksp_reduction_auto: at setUp,
+                                      # pick the reduction plan (cg /
+                                      # pipecg / sstep + s) from the
+                                      # MEASURED per-reduce-site latency
+                                      # probe (solvers/autoselect.py)
+        self.reduction_probe_refresh = False  # -ksp_reduction_probe_
+                                      # refresh: ignore the on-disk
+                                      # probe cache and re-measure
         self.megasolve = False        # -ksp_megasolve: route eligible
                                       # cg/pipecg solves through the
                                       # FUSED whole-solve program
@@ -388,6 +412,16 @@ class KSP:
         self.pipeline_auto_replacement = opt.get_int(
             p + "ksp_pipeline_auto_replacement",
             self.pipeline_auto_replacement)
+        self.sstep_s = opt.get_int(p + "ksp_sstep_s", self.sstep_s)
+        self.sstep_max_replacements = opt.get_int(
+            p + "ksp_sstep_max_replacements", self.sstep_max_replacements)
+        self.sstep_auto_replacement = opt.get_int(
+            p + "ksp_sstep_auto_replacement", self.sstep_auto_replacement)
+        self.reduction_auto = opt.get_bool(p + "ksp_reduction_auto",
+                                           self.reduction_auto)
+        self.reduction_probe_refresh = opt.get_bool(
+            p + "ksp_reduction_probe_refresh",
+            self.reduction_probe_refresh)
         self._monitor_flag = opt.get_bool(p + "ksp_monitor", False)
         self._view_flag = opt.get_bool(p + "ksp_view", False)
         self._reason_flag = opt.get_bool(p + "ksp_converged_reason", False)
@@ -430,9 +464,44 @@ class KSP:
         if self._mat is None:
             raise RuntimeError("KSP.set_up: no operators set")
         self.get_pc().set_up(self.get_pc()._mat or self._mat)
+        if self.reduction_auto:
+            # after PC set_up: the apply-cost probe runs the REAL
+            # operator+PC apply on the placed factors
+            self._autoselect_reduction()
         return self
 
     setUp = set_up
+
+    def _autoselect_reduction(self):
+        """``-ksp_reduction_auto``: pick the reduction plan — classic CG,
+        pipelined CG, or s-step CG with its s — from the MEASURED
+        per-reduce-site latency of this mesh (solvers/autoselect.py).
+        Runs once per (operator, mesh); only CG-family starting types are
+        re-routed (an explicit gmres/minres choice is an operator-class
+        statement auto-selection must not override)."""
+        if self._type not in ("cg", "pipecg", "sstep"):
+            return
+        mat = self._mat
+        key = (id(mat), getattr(mat, "_state", 0),
+               getattr(mat.comm, "mesh", None))
+        if getattr(self, "_autoselect_key", None) == key:
+            return
+        from . import autoselect
+        sp = _telemetry.span("ksp.autoselect",
+                             starting_type=self._type)
+        with sp:
+            report = autoselect.select_reduction_plan(
+                mat.comm, mat, self.get_pc(),
+                refresh=self.reduction_probe_refresh)
+            self._type = report.ksp_type
+            if report.ksp_type == "sstep":
+                self.sstep_s = int(report.s)
+            self._reduction_report = report
+            self._autoselect_key = key
+            sp.set_attrs(choice=report.ksp_type, s=int(report.s or 0),
+                         psum_us=float(report.psum_us),
+                         apply_us=float(report.apply_us),
+                         probe_cached=bool(report.probe_cached))
 
     # ---- silent-corruption guard plumbing -----------------------------------
     def _effective_replacement(self) -> int:
@@ -444,6 +513,8 @@ class KSP:
             return int(self.residual_replacement)
         if self._type == "pipecg":
             return int(self.pipeline_auto_replacement)
+        if self._type == "sstep":
+            return int(self.sstep_auto_replacement)
         return 0
 
     def _guard_requested(self) -> bool:
@@ -486,7 +557,9 @@ class KSP:
     # HLO gates; carried as a span attribute so a trace names the
     # collective schedule a solve ran under (other types omit the attr)
     _REDUCE_SITES = {("cg", False): 3, ("cg", True): 2,
-                     ("pipecg", False): 1, ("pipecg", True): 1}
+                     ("pipecg", False): 1, ("pipecg", True): 1,
+                     # per s-BLOCK (the per-iteration count is 1/s)
+                     ("sstep", False): 1, ("sstep", True): 1}
 
     # ---- solve --------------------------------------------------------------
     @wrap_device_errors("KSPSolve")
@@ -630,7 +703,7 @@ class KSP:
                 abft=guard and self.abft,
                 abft_pc=abft_pc_on,
                 rr=guard and self._effective_replacement() > 0,
-                donate=True)
+                donate=True, sstep_s=self.sstep_s)
         # host scalars travel with the execute call — no extra device
         # round-trips (the remote-TPU dispatch latency is ~100ms each).
         # Tolerances are always REAL-typed: for complex operators the
@@ -653,10 +726,13 @@ class KSP:
         ns_args = ((nullspace.device_array(comm, mat.shape[0], op_dt),)
                    if nullspace else ())
         # trailing runtime guard scalars (tolerance factor + replacement
-        # interval) — runtime args, so tuning them never recompiles
+        # interval; sstep appends its basis-restart budget) — runtime
+        # args, so tuning them never recompiles
         guard_scalars = ((dt.type(self.abft_tol),
                           np.int32(self._effective_replacement()))
                          if guard else ())
+        if guard and self._type == "sstep":
+            guard_scalars += (np.int32(self.sstep_max_replacements),)
         # fault point 'ksp.program': a simulated worker crash DURING the
         # compiled solve. With iter=K the crash leaves real partial state —
         # the same cached program truncated to K iterations (max_it is a
@@ -811,6 +887,17 @@ class KSP:
             checks = ((1 + int(iters) * (1 + int(abft_pc_on)))
                       if self.abft else 0)
             from ..utils.profiling import record_sdc
+            from .krylov import SDC_DEMOTE
+            if int(det) == SDC_DEMOTE:
+                # NOT corruption: the s-step drift gate exhausted its
+                # basis-restart budget (-ksp_sstep_max_replacements) —
+                # the CA-CG basis cannot hold this operator at this s.
+                # The iterate is trusted (the gate just measured its
+                # true residual); continue as classic CG from it.
+                record_sdc(checks, 0, int(rrc))
+                return self._demote_sstep(
+                    b, x, rtol=rtol, atol=atol, iters=int(iters),
+                    rrc=int(rrc), checks=checks, t0=t0)
             if int(det) != SDC_NONE:
                 # detection: the iterate is NOT trusted — roll the
                 # caller's vector back to the last VERIFIED iterate and
@@ -969,6 +1056,98 @@ class KSP:
                   f"{ConvergedReason.name(self.result.reason)} iterations 1")
         return self.result
 
+    # ---- s-step demotion: CA-CG basis-restart budget exhausted --------------
+    def _demote_clone(self) -> "KSP":
+        """A classic-CG twin sharing the operator and the already-set-up
+        PC — the continuation solver a demoted s-step solve finishes on
+        (never mutates ``self``: a monitor observing this KSP mid-solve
+        keeps seeing the user's configuration)."""
+        k2 = KSP()
+        k2.comm = self.comm
+        k2._mat = self._mat
+        k2._pc = self._pc
+        k2._type = "cg"
+        k2.rtol, k2.atol = self.rtol, self.atol
+        k2.divtol, k2.max_it = self.divtol, self.max_it
+        k2.abft = self.abft
+        k2.abft_tol = self.abft_tol
+        # deliberately NOT inherited: the sstep-tuned replacement
+        # interval (small, to catch basis stall early) would restart
+        # classic CG's direction chain every few iterations and cripple
+        # its superlinear convergence — the continuation runs plain
+        # (ABFT-checked when armed) classic CG
+        k2.residual_replacement = 0
+        k2._monitors = list(self._monitors)
+        k2._monitor_flag = self._monitor_flag
+        k2._initial_guess_nonzero = True
+        return k2
+
+    def _demote_sstep(self, b, x, *, rtol, atol, iters, rrc, checks,
+                      t0) -> SolveResult:
+        """The ``SDC_DEMOTE`` exit of a guarded s-step solve: the drift
+        gate restarted the basis ``-ksp_sstep_max_replacements`` times
+        and the coordinate recurrences still drift — the monomial basis
+        cannot hold this operator at this ``s``. The current iterate IS
+        trusted (the gate measured its true residual), so the solve
+        CONTINUES as classic CG from it, and the demotion is recorded as
+        a :class:`RecoveryEvent` on the merged result."""
+        from ..telemetry.metrics import registry
+        from ..utils.convergence import RecoveryEvent
+        registry.counter("sstep.demotions").inc()
+        sub_ksp = self._demote_clone()
+        sub_ksp.max_it = max(self.max_it - iters, 1)
+        sub = sub_ksp.solve(b, x, _rtol=rtol, _atol=atol,
+                            _guess_nonzero=True, _mon_offset=iters)
+        res = SolveResult(iters + sub.iterations, sub.residual_norm,
+                          sub.reason, time.perf_counter() - t0)
+        res.abft_checks = checks + getattr(sub, "abft_checks", 0)
+        res.residual_replacements = (rrc + getattr(
+            sub, "residual_replacements", 0))
+        res.recovery_events = [RecoveryEvent(
+            "sstep_demote", 1,
+            detail=(f"s={self.sstep_s}: {self.sstep_max_replacements} "
+                    "basis restart(s) exhausted; demoted to classic cg"),
+            iterations=iters, detector="drift")] \
+            + list(sub.recovery_events)
+        self.result = res
+        return res
+
+    def _demote_sstep_many(self, B, X, *, iters, rrc, checks, t0,
+                           demoted) -> BatchedSolveResult:
+        """Batched twin of :meth:`_demote_sstep`: any column hitting the
+        basis-restart budget demotes the WHOLE block to classic CG from
+        the current iterates — already-converged columns freeze at
+        iteration 0 under the masked block kernel, so only the drifting
+        stragglers pay."""
+        from ..telemetry.metrics import registry
+        from ..utils.convergence import RecoveryEvent
+        registry.counter("sstep.demotions").inc(len(demoted))
+        sub_ksp = self._demote_clone()
+        # the continuation spends only the REMAINING iteration budget
+        # (capped against the furthest column, so no column's total can
+        # exceed max_it — the single-RHS twin's contract)
+        sub_ksp.max_it = max(self.max_it - (max(iters) if iters else 0),
+                             1)
+        sub = sub_ksp.solve_many(B, X)
+        res = BatchedSolveResult(
+            iterations=[int(a) + int(c) for a, c in
+                        zip(iters, sub.iterations)],
+            residual_norms=sub.residual_norms, reasons=sub.reasons,
+            wall_time=time.perf_counter() - t0, X=sub.X,
+            histories=sub.histories)
+        res.abft_checks = checks + getattr(sub, "abft_checks", 0)
+        res.residual_replacements = (rrc + getattr(
+            sub, "residual_replacements", 0))
+        res.recovery_events = [RecoveryEvent(
+            "sstep_demote", 1,
+            detail=(f"s={self.sstep_s}: columns {sorted(demoted)} "
+                    "exhausted the basis-restart budget; block demoted "
+                    "to classic cg"),
+            iterations=max(iters) if iters else 0, detector="drift")] \
+            + list(sub.recovery_events)
+        self.result_many = res
+        return res
+
     # ---- megasolve: the fused whole-solve fast path -------------------------
     def _megasolve_eligible(self, many: bool = False) -> bool:
         """Route this solve through the fused whole-solve program
@@ -1019,12 +1198,14 @@ class KSP:
                 zero_guess=not guess_nonzero,
                 abft=guard and self.abft, abft_pc=abft_pc_on,
                 rr=guard and self._effective_replacement() > 0,
-                donate=True)
+                donate=True, sstep_s=self.sstep_s)
         from ..utils.dtypes import tolerance_dtype
         dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),
                           np.int32(self._effective_replacement()))
                          if guard else ())
+        if guard and self._type == "sstep":
+            guard_scalars += (np.int32(self.sstep_max_replacements),)
         from ..parallel.mesh import is_placed
         from .krylov import donation_supported
         x0d = x.data
@@ -1086,6 +1267,15 @@ class KSP:
             checks = ((steps + iters * (1 + int(abft_pc_on)))
                       if self.abft else 0)
             from ..utils.profiling import record_sdc
+            from .krylov import SDC_DEMOTE
+            if det == SDC_DEMOTE:
+                # CA-CG demotion surfaced through the fused loop: the
+                # outer carry is the last gate-verified iterate —
+                # continue as classic CG from it (see _demote_sstep)
+                record_sdc(checks, 0, rrc)
+                return self._demote_sstep(
+                    b, x, rtol=rtol, atol=atol, iters=iters, rrc=rrc,
+                    checks=checks, t0=t0)
             if det != SDC_NONE:
                 detector = SDC_DETECTOR_NAMES.get(det, f"det{det}")
                 record_sdc(checks, 1, rrc)
@@ -1149,12 +1339,14 @@ class KSP:
                 zero_guess=not self._initial_guess_nonzero,
                 abft=guard and self.abft, abft_pc=abft_pc_on,
                 rr=guard and self._effective_replacement() > 0,
-                donate=True)
+                donate=True, sstep_s=self.sstep_s)
         from ..utils.dtypes import tolerance_dtype
         dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),
                           np.int32(self._effective_replacement()))
                          if guard else ())
+        if guard and self._type == "sstep":
+            guard_scalars += (np.int32(self.sstep_max_replacements),)
         Bd, Xd0 = comm.put_rows_many([B.astype(op_dt, copy=False),
                                       X.astype(op_dt, copy=False)])
         from .krylov import donation_supported
@@ -1211,8 +1403,10 @@ class KSP:
             rrc_h = np.asarray(fetch[6])
             checks = ((k * steps + sum(iters) * (1 + int(abft_pc_on)))
                       if self.abft else 0)
-            if int(det_h.max(initial=0)) != SDC_NONE:
-                bad = [j for j in range(k) if int(det_h[j]) != SDC_NONE]
+            from .krylov import SDC_DEMOTE
+            bad = [j for j in range(k)
+                   if int(det_h[j]) not in (SDC_NONE, SDC_DEMOTE)]
+            if bad:
                 detector = SDC_DETECTOR_NAMES.get(
                     int(det_h[bad[0]]), str(int(det_h[bad[0]])))
                 record_sdc(checks, len(bad), int(rrc_h.sum()))
@@ -1224,6 +1418,13 @@ class KSP:
                     int(max(iters[j] for j in bad)),
                     detail=f"columns {bad} flagged inside the fused "
                            "megasolve loop")
+            demoted = [j for j in range(k)
+                       if int(det_h[j]) == SDC_DEMOTE]
+            if demoted:
+                record_sdc(checks, 0, int(rrc_h.sum()))
+                return self._demote_sstep_many(
+                    B, X, iters=iters, rrc=int(rrc_h.sum()),
+                    checks=checks, t0=t0, demoted=demoted)
             record_sdc(checks, 0, int(rrc_h.sum()))
         for j in range(k):
             if not np.isfinite(rnorms[j]):
@@ -1348,7 +1549,7 @@ class KSP:
         from .krylov import (batched_pc_supported, build_ksp_program_many,
                              hist_capacity)
         nullspace = getattr(mat, "nullspace", None)
-        batched = (self._type in ("cg", "pipecg")
+        batched = (self._type in ("cg", "pipecg", "sstep")
                    and batched_pc_supported(pc)
                    and (nullspace is None or nullspace.dim == 0)
                    and self._norm_type in ("default", "none"))
@@ -1389,7 +1590,8 @@ class KSP:
                         hist_cap=hist_capacity(self.max_it, 0),
                         abft=guard and self.abft, abft_pc=abft_pc_on,
                         rr=guard and self._effective_replacement() > 0,
-                        true_res=gate, donate=True)
+                        true_res=gate, donate=True,
+                        sstep_s=self.sstep_s)
         with _telemetry.span("ksp.setup"):
             prog = build_ksp_program_many(
                 comm, self._type, pc, mat, nrhs=k,
@@ -1399,6 +1601,8 @@ class KSP:
         guard_scalars = ((dt.type(self.abft_tol),
                           np.int32(self._effective_replacement()))
                          if guard else ())
+        if guard and self._type == "sstep":
+            guard_scalars += (np.int32(self.sstep_max_replacements),)
         # ONE batched placement for both blocks (the PR-3 put_rows_many
         # discipline: sequential put_rows would pay the runtime's fixed
         # dispatch twice and fire the comm.put fault point twice)
@@ -1478,14 +1682,15 @@ class KSP:
             # (the single-RHS '1 + iters*(1+pc)' accounting, per column)
             checks = ((k + sum(iters) * (1 + int(abft_pc_on)))
                       if self.abft else 0)
-            if int(det_h.max(initial=0)) != SDC_NONE:
+            from .krylov import SDC_DEMOTE
+            bad = [j for j in range(k)
+                   if int(det_h[j]) not in (SDC_NONE, SDC_DEMOTE)]
+            if bad:
                 # per-column detection: roll the whole block back to the
                 # last VERIFIED iterates and raise DETECTED_SDC — clean
                 # columns' verified state is preserved, the resilient
                 # wrapper re-solves (frozen-instantly for already-good
                 # columns under the masked kernel)
-                bad = [j for j in range(k)
-                       if int(det_h[j]) != SDC_NONE]
                 detector = SDC_DETECTOR_NAMES.get(
                     int(det_h[bad[0]]), str(int(det_h[bad[0]])))
                 record_sdc(checks, len(bad), int(rrc_h.sum()))
@@ -1496,6 +1701,15 @@ class KSP:
                     "KSPSolveMany", detector,
                     int(max(iters[j] for j in bad)),
                     detail=f"columns {bad} flagged")
+            demoted = [j for j in range(k)
+                       if int(det_h[j]) == SDC_DEMOTE]
+            if demoted:
+                # CA-CG demotion (see _demote_sstep): trusted iterates,
+                # classic-CG continuation for the whole block
+                record_sdc(checks, 0, int(rrc_h.sum()))
+                return self._demote_sstep_many(
+                    B, X, iters=iters, rrc=int(rrc_h.sum()),
+                    checks=checks, t0=t0, demoted=demoted)
             record_sdc(checks, 0, int(rrc_h.sum()))
         if gate:
             trn_h = np.asarray(fetch[i_extra], dtype=float)
@@ -1589,10 +1803,12 @@ class KSP:
                 X[...] = np.asarray(f2[0])[: mat.shape[0]].astype(
                     X.dtype, copy=False)
                 if guard:
+                    from .krylov import SDC_DEMOTE
                     det2_h = np.asarray(f2[4])
-                    if int(det2_h.max(initial=0)) != SDC_NONE:
-                        bad2 = [j for j in range(k)
-                                if int(det2_h[j]) != SDC_NONE]
+                    bad2 = [j for j in range(k)
+                            if int(det2_h[j]) not in (SDC_NONE,
+                                                      SDC_DEMOTE)]
+                    if bad2:
                         record_sdc(0, len(bad2), int(np.asarray(
                             f2[5]).sum()))
                         X[...] = np.asarray(
@@ -1605,6 +1821,23 @@ class KSP:
                             int(np.asarray(f2[1]).max(initial=0)),
                             detail=f"columns {bad2} flagged on gate "
                                    "re-entry")
+                    dem2 = [j for j in range(k)
+                            if int(det2_h[j]) == SDC_DEMOTE]
+                    if dem2:
+                        X[...] = np.asarray(f2[0])[: mat.shape[0]].astype(
+                            X.dtype, copy=False)
+                        # merge the re-entry pass's counters BEFORE the
+                        # demoted continuation — the first-pass values
+                        # alone would under-report exactly the solves
+                        # that needed re-entry
+                        it_re = np.asarray(f2[1])
+                        return self._demote_sstep_many(
+                            B, X,
+                            iters=[iters[j] + int(it_re[j])
+                                   for j in range(k)],
+                            rrc=int(rrc_h.sum())
+                            + int(np.asarray(f2[5]).sum()),
+                            checks=checks, t0=t0, demoted=dem2)
                 it2 = np.asarray(f2[1])
                 rn2 = np.asarray(f2[2])
                 rs2 = np.asarray(f2[3])

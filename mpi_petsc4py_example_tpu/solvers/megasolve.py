@@ -71,11 +71,11 @@ from ..resilience import faults as _faults
 from ..utils.convergence import ConvergedReason as CR
 from ..utils.dtypes import is_complex
 from . import cg_plans as _plans
-from .krylov import (_consumed_zeros, _make_guard, _make_pipe_guard, _psum,
-                     donation_supported)
+from .krylov import (_consumed_zeros, _make_guard, _make_pipe_guard,
+                     _make_sstep_guard, _psum, donation_supported)
 
 #: KSP types with a fused whole-solve program (the plan-built CG family)
-MEGASOLVE_TYPES = ("cg", "pipecg")
+MEGASOLVE_TYPES = ("cg", "pipecg", "sstep")
 
 #: outer refinement-step cap the uniform-precision (gate-fusion) path
 #: runs at: the first full solve + the unfused gate's 3 re-entries
@@ -146,7 +146,8 @@ def _aot_code():
 def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
                             outer_op=None, *, zero_guess: bool = True,
                             abft: bool = False, abft_pc: bool = False,
-                            rr: bool = False, donate: bool = False):
+                            rr: bool = False, donate: bool = False,
+                            sstep_s: int = 4):
     """Build (or fetch cached) the fused whole-solve program.
 
     Signature of the returned callable::
@@ -196,10 +197,11 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
     from ..utils import aot
     aot_on = aot.aot_enabled() and trace_nonce is None
     donate_k = bool(donate) and donation_supported()
+    sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            str(out_dt), shared, inner_op.program_key(),
            out_op.program_key(), bool(zero_guess), abft_k, abft_pc_k,
-           bool(rr), donate_k, trace_nonce, aot_on)
+           bool(rr), donate_k, sstep_k, trace_nonce, aot_on)
     cached = _MEGASOLVE_CACHE.get(key)
     if cached is not None:
         return cached
@@ -215,7 +217,7 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
 
     def run(outer_arrays, inner_arrays, pc_arrays, cs, csM, b, x0, rtol,
             atol, inner_rtol, dtol, maxit, refine_max, stag_reason,
-            abft_tol, rr_n):
+            abft_tol, rr_n, max_repl):
         if zero_guess:
             x0 = _consumed_zeros(x0) if donate_k else jnp.zeros_like(b)
         # inner plan closures: the SOLVER channel — injectable silent
@@ -260,6 +262,7 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
                           pdot=pdot, pnorm=pnorm,
                           eps_dtype=in_dt if mixed else None)
             mk = (_make_pipe_guard if ksp_type == "pipecg"
+                  else _make_sstep_guard if ksp_type == "sstep"
                   else _make_guard)
             g = mk(stack_dt, axis, cs, csM, abft_tol, rr_n, **flavor)
 
@@ -268,6 +271,14 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
             kw = dict(dtol=dtol)
             if mixed:
                 kw["prec"] = prec
+            if ksp_type == "sstep":
+                return _plans.sstep_cg_loop(
+                    b=r_lp, x0=x0_lp, rtol=inner_rtol, atol=inner_atol,
+                    maxit=maxit, s=sstep_k,
+                    greduce=lambda parts: _plans.fuse_gram_psum(
+                        parts, _psum, axis, stack_dt),
+                    A=A_in, M=M_in, pnorm=pnorm, guard=g,
+                    max_repl=max_repl, **kw)
             if ksp_type == "pipecg":
                 if g is not None:
                     return _plans.pipelined_cg_loop(
@@ -345,7 +356,9 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
             out = out + (st["det"], st["rrc"], st["xv"])
         return out
 
-    nsc = 7 + (2 if guard_k else 0)    # trailing runtime scalars
+    # trailing runtime scalars: the sstep guard appends its
+    # basis-restart budget (-ksp_sstep_max_replacements)
+    nsc = 7 + ((3 if ksp_type == "sstep" else 2) if guard_k else 0)
     ncs = abft_k + abft_pc_k
 
     def local_fn(*args):
@@ -365,7 +378,11 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
             i += 1
         b, x0 = args[i], args[i + 1]
         scal = args[i + 2:]
-        if guard_k:
+        max_repl = None
+        if guard_k and ksp_type == "sstep":
+            (rtol, atol, inner_rtol, dtol, maxit, refine_max,
+             stag_reason, abft_tol, rr_n, max_repl) = scal
+        elif guard_k:
             (rtol, atol, inner_rtol, dtol, maxit, refine_max,
              stag_reason, abft_tol, rr_n) = scal
         else:
@@ -374,7 +391,7 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
             abft_tol = rr_n = None
         return run(outer_arrays, inner_arrays, pc_arrays, cs, csM, b, x0,
                    rtol, atol, inner_rtol, dtol, maxit, refine_max,
-                   stag_reason, abft_tol, rr_n)
+                   stag_reason, abft_tol, rr_n, max_repl)
 
     in_specs = (() if shared else (in_specs_outer,)) \
         + (in_specs_inner, pc.in_specs(axis)) \
@@ -398,7 +415,8 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
                                  inner_op, outer_op=None, *, nrhs: int,
                                  zero_guess: bool = True,
                                  abft: bool = False, abft_pc: bool = False,
-                                 rr: bool = False, donate: bool = False):
+                                 rr: bool = False, donate: bool = False,
+                                 sstep_s: int = 4):
     """Batched fused whole-solve program: ``nrhs`` refinement recurrences
     in lockstep over an ``(n_pad, nrhs)`` block, each outer step
     dispatching ONE nested batched CG plan loop — a served ``solve_many``
@@ -439,10 +457,11 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
     from ..utils import aot
     aot_on = aot.aot_enabled() and trace_nonce is None
     donate_k = bool(donate) and donation_supported()
+    sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            str(out_dt), shared, int(nrhs), inner_op.program_key(),
            out_op.program_key(), bool(zero_guess), abft_k, abft_pc_k,
-           bool(rr), donate_k, trace_nonce, aot_on)
+           bool(rr), donate_k, sstep_k, trace_nonce, aot_on)
     cached = _MEGASOLVE_CACHE_MANY.get(key)
     if cached is not None:
         return cached
@@ -462,7 +481,7 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
 
     def run(outer_arrays, inner_arrays, pc_arrays, cs, csM, B, X0, rtol,
             atol, inner_rtol, dtol, maxit, refine_max, stag_reason,
-            abft_tol, rr_n):
+            abft_tol, rr_n, max_repl):
         if zero_guess:
             X0 = _consumed_zeros(X0) if donate_k else jnp.zeros_like(B)
         A_in = lambda V: _abft.apply_silent_fault(
@@ -504,6 +523,7 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
                 pdot=pdotc, pnorm=pnormc,
                 eps_dtype=in_dt if mixed else None)
             mk = (_make_pipe_guard if ksp_type == "pipecg"
+                  else _make_sstep_guard if ksp_type == "sstep"
                   else _make_guard)
             g = mk(stack_dt, axis, cs, csM, abft_tol, rr_n, **flavor)
 
@@ -512,6 +532,14 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
             kw = dict(dtol=dtol, bp=_plans.ManyBatch("cols"))
             if mixed:
                 kw["prec"] = prec
+            if ksp_type == "sstep":
+                return _plans.sstep_cg_loop(
+                    b=R_lp, x0=X0_lp, rtol=inner_rtol, atol=inner_atol,
+                    maxit=maxit, s=sstep_k,
+                    greduce=lambda parts: _plans.fuse_gram_psum(
+                        parts, _psum, axis, stack_dt, batched=True),
+                    A=A_in, M=M_in, pnorm=pnormc, guard=g,
+                    max_repl=max_repl, **kw)
             if ksp_type == "pipecg":
                 if g is not None:
                     return _plans.pipelined_cg_loop(
@@ -590,7 +618,7 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
             out = out + (st["det"], st["rrc"], st["Xv"])
         return out
 
-    nsc = 7 + (2 if guard_k else 0)
+    nsc = 7 + ((3 if ksp_type == "sstep" else 2) if guard_k else 0)
     ncs = abft_k + abft_pc_k
 
     def local_fn(*args):
@@ -610,7 +638,11 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
             i += 1
         B, X0 = args[i], args[i + 1]
         scal = args[i + 2:]
-        if guard_k:
+        max_repl = None
+        if guard_k and ksp_type == "sstep":
+            (rtol, atol, inner_rtol, dtol, maxit, refine_max,
+             stag_reason, abft_tol, rr_n, max_repl) = scal
+        elif guard_k:
             (rtol, atol, inner_rtol, dtol, maxit, refine_max,
              stag_reason, abft_tol, rr_n) = scal
         else:
@@ -619,7 +651,7 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
             abft_tol = rr_n = None
         return run(outer_arrays, inner_arrays, pc_arrays, cs, csM, B, X0,
                    rtol, atol, inner_rtol, dtol, maxit, refine_max,
-                   stag_reason, abft_tol, rr_n)
+                   stag_reason, abft_tol, rr_n, max_repl)
 
     in_specs = (() if shared else (in_specs_outer,)) \
         + (in_specs_inner, pc.in_specs(axis)) \

@@ -187,6 +187,18 @@ class RefinedKSP:
                 and self.inner.residual_replacement == 0
                 and self.inner.pipeline_auto_replacement == 0):
             self.inner.pipeline_auto_replacement = 25
+        if (self.inner.get_type() == "sstep"
+                and self.inner.residual_replacement == 0
+                and self.inner.sstep_auto_replacement == 0):
+            # the CA-CG basis-stall gate, armed at EVERY inner
+            # precision: the monomial basis' conditioning (~kappa^(s/2))
+            # can exceed the inner storage resolution outright, stalling
+            # the correction solves — the gate restarts the basis from
+            # the true residual and, past -ksp_sstep_max_replacements,
+            # demotes the inner solve to classic CG so refinement always
+            # completes (measured: f32 inner sstep on the kappa~n^2
+            # tridiagonal family stalls without it)
+            self.inner.sstep_auto_replacement = 25
 
     def _effective_inner_rtol(self) -> float:
         """The per-correction target the inner solve actually runs at:
@@ -277,12 +289,14 @@ class RefinedKSP:
                 None if outer is op else outer, zero_guess=True,
                 abft=guard and ksp.abft, abft_pc=abft_pc_on,
                 rr=guard and ksp._effective_replacement() > 0,
-                donate=True)
+                donate=True, sstep_s=ksp.sstep_s)
         dt_in = tolerance_dtype(op_dt)
         dt_out = np.dtype(np.float64)
         guard_scalars = ((dt_in.type(ksp.abft_tol),
                           np.int32(ksp._effective_replacement()))
                          if guard else ())
+        if guard and ksp.get_type() == "sstep":
+            guard_scalars += (np.int32(ksp.sstep_max_replacements),)
         xvec = Vec.from_global(comm, np.zeros_like(b), dtype=np.float64,
                                layout=outer.layout)
         bvec = Vec.from_global(comm, b, dtype=np.float64,
@@ -323,7 +337,14 @@ class RefinedKSP:
                       if ksp.abft else 0)
             from ..utils.profiling import record_sdc
             from ..utils.errors import SilentCorruptionError
-            from .krylov import SDC_DETECTOR_NAMES, SDC_NONE
+            from .krylov import SDC_DEMOTE, SDC_DETECTOR_NAMES, SDC_NONE
+            if det == SDC_DEMOTE:
+                # CA-CG demotion inside the fused refinement: not
+                # corruption — rerun through the UNFUSED loop, whose
+                # inner solves demote to classic CG per correction
+                # (KSP._demote_sstep)
+                record_sdc(checks, 0, rrc)
+                return self._solve_impl(b, _no_fuse=True)
             if det != SDC_NONE:
                 record_sdc(checks, 1, rrc)
                 raise SilentCorruptionError(
@@ -384,12 +405,14 @@ class RefinedKSP:
                 None if outer is op else outer, nrhs=k, zero_guess=True,
                 abft=guard and ksp.abft, abft_pc=abft_pc_on,
                 rr=guard and ksp._effective_replacement() > 0,
-                donate=True)
+                donate=True, sstep_s=ksp.sstep_s)
         dt_in = tolerance_dtype(op_dt)
         dt_out = np.dtype(np.float64)
         guard_scalars = ((dt_in.type(ksp.abft_tol),
                           np.int32(ksp._effective_replacement()))
                          if guard else ())
+        if guard and ksp.get_type() == "sstep":
+            guard_scalars += (np.int32(ksp.sstep_max_replacements),)
         Bd, Xd0 = comm.put_rows_many([B, np.zeros_like(B)])
         if donation_supported():
             Xd0 = jnp.array(Xd0)
@@ -429,9 +452,10 @@ class RefinedKSP:
                        * (1 + int(abft_pc_on))) if ksp.abft else 0)
             from ..utils.profiling import record_sdc
             from ..utils.errors import SilentCorruptionError
-            from .krylov import SDC_DETECTOR_NAMES, SDC_NONE
-            if int(det_h.max(initial=0)) != SDC_NONE:
-                bad = [j for j in range(k) if int(det_h[j]) != SDC_NONE]
+            from .krylov import SDC_DEMOTE, SDC_DETECTOR_NAMES, SDC_NONE
+            bad = [j for j in range(k)
+                   if int(det_h[j]) not in (SDC_NONE, SDC_DEMOTE)]
+            if bad:
                 record_sdc(checks, len(bad), int(rrc_h.sum()))
                 raise SilentCorruptionError(
                     "KSPSolveMany",
@@ -440,6 +464,11 @@ class RefinedKSP:
                     int(iters.max(initial=0)),
                     detail=f"columns {bad} flagged inside the fused "
                            "refinement loop")
+            if any(int(det_h[j]) == SDC_DEMOTE for j in range(k)):
+                # CA-CG demotion: rerun the block unfused (see the
+                # single-RHS twin)
+                record_sdc(checks, 0, int(rrc_h.sum()))
+                return self._solve_many_impl(B, _no_fuse=True)
             record_sdc(checks, 0, int(rrc_h.sum()))
         conv = np.isfinite(rn) & np.asarray(
             [int(r) > 0 for r in reasons])
@@ -477,8 +506,10 @@ class RefinedKSP:
                           reason=res.reason)
             return x, res
 
-    def _solve_impl(self, b: np.ndarray) -> tuple[np.ndarray, SolveResult]:
-        if self._megasolve_available():
+    def _solve_impl(self, b: np.ndarray,
+                    _no_fuse: bool = False) -> tuple[np.ndarray,
+                                                     SolveResult]:
+        if not _no_fuse and self._megasolve_available():
             return self._solve_fused_impl(b)
         A = self._A_host
         b = np.asarray(b, dtype=np.float64)
@@ -565,8 +596,8 @@ class RefinedKSP:
                           reason=res.reason, nrhs=int(X.shape[1]))
             return X, res
 
-    def _solve_many_impl(self, B):
-        if self._megasolve_available(many=True):
+    def _solve_many_impl(self, B, _no_fuse=False):
+        if not _no_fuse and self._megasolve_available(many=True):
             return self._solve_many_fused_impl(B)
         A = self._A_host
         B = np.asarray(B, dtype=np.float64)

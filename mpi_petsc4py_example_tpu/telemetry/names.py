@@ -34,6 +34,9 @@ NAMES = {
     "ksp.dispatch": ("span", "the compiled solve program's execute call"),
     "ksp.fetch": ("span", "the batched D2H result fetch"),
     "ksp.verify": ("span", "the true-residual gate decision + re-entries"),
+    "ksp.autoselect": ("span", "-ksp_reduction_auto: measured-latency "
+                               "reduction-plan selection at KSP.setUp "
+                               "(solvers/autoselect.py)"),
     # ---- spans: PC / EPS / refinement ----
     "pc.setup": ("span", "preconditioner factor build/placement (covers "
                          "the MG/GAMG hierarchy build — the MG entry)"),
@@ -87,6 +90,10 @@ NAMES = {
     "abft.checks": ("counter", "ABFT checksum checks performed"),
     "abft.detections": ("counter", "silent-corruption detectors fired"),
     "abft.replacements": ("counter", "in-program residual replacements"),
+    "sstep.demotions": ("counter", "s-step solves demoted to classic CG "
+                                   "(CA-CG basis-restart budget "
+                                   "-ksp_sstep_max_replacements "
+                                   "exhausted)"),
     "serving.requests": ("counter", "real requests dispatched (padding "
                                     "excluded)"),
     "serving.batches": ("counter", "coalesced block dispatches"),
@@ -127,6 +134,9 @@ NAMES = {
                                 "(KSP + EPS caches)"),
     "serving.queue_depth": ("gauge", "pending requests at last submit"),
     "fleet.replicas": ("gauge", "live server replicas behind the router"),
+    "autoselect.psum_latency_us": ("gauge", "measured (or probe-cached) "
+                                           "per-reduce-site latency of "
+                                           "the mesh, microseconds"),
     # ---- histograms (fixed buckets — metrics.py) ----
     "solve.latency_seconds": ("histogram", "end-to-end wall per solve"),
     "solve.per_iter_seconds": ("histogram", "wall per solver iteration "

@@ -57,6 +57,12 @@ KNOWN_FLAGS = {
                                      "-ksp_residual_replacement is unset "
                                      "(bounds the pipelined recurrences' "
                                      "drift; 0 = off)",
+    "ksp_reduction_auto": "at KSP.setUp, pick the reduction plan (cg/"
+                          "pipecg/sstep + s) from the MEASURED "
+                          "per-reduce-site latency probe "
+                          "(solvers/autoselect.py)",
+    "ksp_reduction_probe_refresh": "ignore the on-disk collective-latency "
+                                   "probe cache and re-measure",
     "ksp_refine_inner_rtol": "RefinedKSP per-correction inner solve "
                              "target (floored at a few storage epsilons)",
     "ksp_refine_max": "RefinedKSP outer refinement step cap",
@@ -64,6 +70,16 @@ KNOWN_FLAGS = {
                                 "N iterations with a drift gate (silent-"
                                 "corruption monitor; 0 = off)",
     "ksp_rtol": "relative convergence tolerance",
+    "ksp_sstep_auto_replacement": "sstep only: arm the true-residual "
+                                  "drift gate every N iterations when "
+                                  "-ksp_residual_replacement is unset "
+                                  "(the CA-CG basis ill-conditioning "
+                                  "bound; 0 = off)",
+    "ksp_sstep_max_replacements": "s-step drift-restart budget: past "
+                                  "this many basis restarts the solve "
+                                  "demotes to classic CG",
+    "ksp_sstep_s": "s-step CG block size (iterations amortized per "
+                   "stacked Gram psum; compiled into the program)",
     "ksp_true_residual_check": "gate convergence on the TRUE residual",
     "ksp_true_residual_margin": "in-program target tightening under the "
                                 "true-residual gate (0 < m <= 1)",

@@ -220,11 +220,13 @@ def migration_count() -> int:
     return int(_REG.counter("fleet.migrations").total())
 
 
-def record_collective_latency(label: str, reduce_sites: int,
+def record_collective_latency(label: str, reduce_sites: float,
                               per_iter_seconds: float):
     """Record one measured collective-latency episode: a solver loop with
-    ``reduce_sites`` psum/all-reduce sites per iteration that ran at
-    ``per_iter_seconds`` per iteration on the mesh.
+    ``reduce_sites`` psum/all-reduce sites per iteration — FRACTIONAL for
+    the s-step plans, whose one Gram psum amortizes over s iterations
+    (1/s sites per iteration) — that ran at ``per_iter_seconds`` per
+    iteration on the mesh.
 
     The MULTICHIP weak-scaling bench records each (solver, mesh, size)
     point — classic CG's multi-site loop vs pipelined CG's 1-site loop
@@ -236,7 +238,7 @@ def record_collective_latency(label: str, reduce_sites: int,
     _REG.counter("collective.per_iter_seconds").inc(
         float(per_iter_seconds), label=str(label))
     _REG.counter("collective.episodes").inc(label=str(label))
-    _REG.gauge("collective.reduce_sites").set(int(reduce_sites),
+    _REG.gauge("collective.reduce_sites").set(float(reduce_sites),
                                               label=str(label))
 
 
@@ -247,7 +249,8 @@ def collective_latency() -> dict[str, dict]:
     sites = _REG.gauge("collective.reduce_sites").items()
     out = {}
     for k, n in eps.items():
-        out[k] = {"reduce_sites": int(sites.get(k, 0)), "episodes": int(n),
+        out[k] = {"reduce_sites": float(sites.get(k, 0)),
+                  "episodes": int(n),
                   "per_iter_s": (sums.get(k, 0.0) / n) if n else 0.0}
     return out
 
@@ -395,7 +398,7 @@ def log_view(file=None):
         print("collective latency itemization (reduce sites x per-iter "
               "wall):", file=file)
         for k, info in sorted(collectives.items()):
-            print(f"  {k:36s} {info['reduce_sites']:2d} site(s) "
+            print(f"  {k:36s} {info['reduce_sites']:4.2f} site(s) "
                   f"{info['per_iter_s'] * 1e6:10.1f} us/iter "
                   f"({info['episodes']} episode(s))", file=file)
     if kernels:

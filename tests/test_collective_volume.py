@@ -543,6 +543,138 @@ class TestPipelinedReduceSites:
             krylov_mod._PROGRAM_CACHE.clear()
 
 
+def _lower_sstep(comm, M, s=4, guard=False, rr=False, nrhs=None,
+                 monkeypatch=None):
+    from mpi_petsc4py_example_tpu.resilience import abft
+    import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("sstep")
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_up()
+    pc = ksp.get_pc()
+    dt = np.dtype(np.float64)
+    if nrhs is not None:
+        assert monkeypatch is not None
+        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
+        krylov_mod._PROGRAM_CACHE_MANY.clear()
+        prog = build_ksp_program_many(comm, "sstep", pc, M, nrhs=nrhs,
+                                      sstep_s=s)
+        n = M.shape[0]
+        Bp = comm.put_rows(np.zeros((n, nrhs)))
+        X0 = comm.put_rows(np.zeros((n, nrhs)))
+        return prog.lower(
+            M.device_arrays(), pc.device_arrays(), Bp, X0,
+            dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+            np.int32(50)).as_text()
+    x, b = M.get_vecs()
+    if guard:
+        cs = abft.column_checksum(M)
+        csM = abft.pc_checksum(pc, M)
+        placed = comm.put_rows_many([cs, csM])
+        prog = build_ksp_program(comm, "sstep", pc, M, abft=True,
+                                 abft_pc=True, rr=rr, sstep_s=s)
+        return prog.lower(
+            M.device_arrays(), pc.device_arrays(), *placed, b.data,
+            x.data, dt.type(1e-8), dt.type(0.0), dt.type(0.0),
+            np.int32(50), dt.type(256.0), np.int32(24 if rr else 0),
+            np.int32(3)).as_text()
+    prog = build_ksp_program(comm, "sstep", pc, M, sstep_s=s)
+    return prog.lower(
+        M.device_arrays(), pc.device_arrays(), b.data, x.data,
+        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+
+
+class TestSstepReduceSites:
+    """ISSUE 15 acceptance: the s-step programs lower to exactly ONE own
+    reduce site per s-BLOCK — the stacked Gram psum — for the plain,
+    guarded, and batched forms, and the megasolve-nested form keeps
+    ``[4, 1]`` per-depth own schedules; an injected split of the
+    fuse_gram_psum seam proves the gate has teeth."""
+
+    @pytest.mark.parametrize("s", [2, 4, 8])
+    def test_one_site_per_block(self, comm8, s):
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
+        assert solver_loop_reduce_sites(_lower_sstep(comm8, M, s=s)) == 1
+
+    def test_guarded_keeps_one_site(self, comm8):
+        """The ABFT basis-build partials ride the SAME stacked Gram
+        psum; the replacement/stall verifier lives in the every-N
+        conditional branch."""
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
+        assert solver_loop_reduce_sites(
+            _lower_sstep(comm8, M, guard=True, rr=True)) == 1
+
+    def test_batched_one_site_and_gather_count(self, comm8, monkeypatch):
+        """The batched s-step program keeps ONE reduce site per block
+        with the same gather op count as k=1 (bytes x k) — the batched
+        comm contract."""
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+        n, k = 512, 8
+        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
+        txt1 = _lower_sstep(comm8, M, nrhs=1, monkeypatch=monkeypatch)
+        txtk = _lower_sstep(comm8, M, nrhs=k, monkeypatch=monkeypatch)
+        assert solver_loop_reduce_sites(txtk) == 1
+        vols1 = all_gather_volumes(txt1)
+        volsk = all_gather_volumes(txtk)
+        n_pad = comm8.padded_size(n)
+        assert len(volsk) == len(vols1), (volsk, vols1)
+        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
+
+    def test_gathers_stay_vector_sized(self, comm8):
+        """The basis build gathers one padded vector per operator apply
+        — never a basis-block-sized gather (that replication would be
+        the O(s·n)-bytes regression)."""
+        txt = _lower_sstep(comm8, tps.Mat.from_scipy(comm8,
+                                                     _ell_matrix(512)))
+        vols = all_gather_volumes(txt)
+        n_pad = comm8.padded_size(512)
+        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+
+    def test_megasolve_nested_chain_4_1(self, comm8):
+        """The fused whole-solve sstep program pins [outer-own, inner] =
+        [4, 1]: bnorm + rn0 + the final exact norm + the fp64 exit gate
+        outside, ONE Gram psum per s-block inside."""
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            nested_loop_reduce_site_chain)
+        assert nested_loop_reduce_site_chain(
+            _lower_megasolve(comm8, "sstep")) == [4, 1]
+
+    def test_injected_split_gram_regression_fails_gate(self, comm8,
+                                                       monkeypatch):
+        """Teeth: split the fuse_gram_psum seam into TWO psums (the
+        regression a careless Gram-plan edit would introduce) — the
+        lowered s-block must show 2 sites and the ==1 gate must fail."""
+        import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
+        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
+        from mpi_petsc4py_example_tpu.utils.hlo import (
+            solver_loop_reduce_sites)
+
+        orig = cg_plans.fuse_gram_psum
+
+        def split_gram(parts, psum, axis, dtype, batched=False):
+            head = orig(parts[:1], psum, axis, dtype, batched=batched)
+            tail = (orig(parts[1:], psum, axis, dtype, batched=batched)
+                    if len(parts) > 1 else [])
+            return head + tail
+
+        krylov_mod._PROGRAM_CACHE.clear()
+        monkeypatch.setattr(cg_plans, "fuse_gram_psum", split_gram)
+        try:
+            M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
+            sites = solver_loop_reduce_sites(
+                _lower_sstep(comm8, M, guard=True, rr=True))
+            assert sites == 2, sites
+        finally:
+            monkeypatch.undo()
+            krylov_mod._PROGRAM_CACHE.clear()
+
+
 class _RegressedEll:
     """A Mat shim whose local SpMV all-gathers the ELL value matrix —
     the injected volume regression the gates must catch."""

@@ -31,6 +31,13 @@ Modes:
 * ``TPU_SOLVE_FAULTS`` set in the environment: ONE corruption drill
   under exactly that spec (the env-activation route);
 * ``--evict``: the two device-eviction drills via ``inject_faults``;
+* ``--sstep`` (ISSUE 15): a bitflip armed INSIDE an s-step block (the
+  basis-build applies checked by the one stacked Gram psum's ABFT
+  partials) must detect -> roll back to the verified carry -> re-enter
+  to an fp64-parity answer, and the ill-conditioned-monomial-basis
+  drill must restart, exhaust ``-ksp_sstep_max_replacements``, and
+  DEMOTE to classic CG (a ``sstep_demote`` RecoveryEvent) while still
+  converging;
 * ``--fleet`` (ISSUE 13): the loss -> shrink -> heal -> RE-GROW round
   trip — a retry-ladder drill proving the re-grown mesh RESUMES the
   solve past iteration 0, and a mixed-QoS router drill with one
@@ -191,6 +198,95 @@ def drill_megasolve() -> list[str]:
           f"attempts={res.attempts} fused_launches={mega} "
           f"true_rres={rtrue:.3e}")
     return [f"megasolve: {p}" for p in problems]
+
+
+def drill_sstep() -> list[str]:
+    """Silent corruption INSIDE an s-step block (``--sstep``, ISSUE 15
+    satellite): a bitflip armed on a basis-build operator apply must be
+    detected by the ABFT partials riding the block's ONE stacked Gram
+    psum, roll the iterate back to the VERIFIED carry, and recover
+    through the resilient ladder (rollback -> re-entry -> independent
+    re-verification) to an fp64-parity answer — the PR-5 chain proven
+    inside the communication-avoiding schedule."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(12)
+    M = tps.Mat.from_scipy(comm, A)
+    ksp = tps.KSP().create(comm)
+    ksp.set_operators(M)
+    ksp.set_type("sstep")
+    ksp.sstep_s = 4
+    ksp.get_pc().set_type("jacobi")
+    ksp.set_tolerances(rtol=RTOL)
+    ksp.abft = True
+    ksp.residual_replacement = 12
+    x_true = np.random.default_rng(0).random(A.shape[0])
+    b = A @ x_true
+    x, bv = M.get_vecs()
+    bv.set_global(b)
+    # at=2 lands on the FIRST block's P-chain basis apply (the init
+    # residual is spmv site 1) — corruption inside the s-block proper
+    with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+        res = tps.resilient_solve(
+            ksp, bv, x, tps.RetryPolicy(sleep=lambda _d: None))
+    detectors = [e.detector for e in res.recovery_events
+                 if e.kind == "fault" and e.detector]
+    if not detectors:
+        problems.append("s-block corruption went UNDETECTED")
+    if not any(e.kind == "rollback" for e in res.recovery_events):
+        problems.append("no rollback to the verified carry in the "
+                        "recovery trail")
+    if not any(e.kind == "verify" for e in res.recovery_events):
+        problems.append("no post-recovery true-residual verification ran")
+    if not res.converged:
+        problems.append(f"recovered s-step solve did not converge: {res}")
+    if any(e.kind == "sstep_demote" for e in res.recovery_events):
+        problems.append("healthy-basis drill DEMOTED to classic cg")
+    rtrue = (np.linalg.norm(b - A @ x.to_numpy()) / np.linalg.norm(b))
+    if not rtrue <= RTOL * 1.05:
+        problems.append(f"true relative residual {rtrue:.3e} misses rtol")
+    if not np.allclose(x.to_numpy(), x_true, atol=1e-7):
+        problems.append("recovered iterate differs from the manufactured "
+                        "solution")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] sstep: {status} detectors={detectors} "
+          f"attempts={res.attempts} true_rres={rtrue:.3e}")
+    failures = [f"sstep: {p}" for p in problems]
+
+    # ---- the demotion half: an ill-conditioned monomial basis at
+    # large s must restart, exhaust -ksp_sstep_max_replacements, and
+    # DEMOTE to classic CG with a RecoveryEvent — and still converge
+    from mpi_petsc4py_example_tpu.models import tridiag_family
+    A2 = tridiag_family(384)
+    M2 = tps.Mat.from_scipy(comm, A2)
+    b2 = np.asarray(A2 @ np.random.default_rng(5).random(384))
+    k2 = tps.KSP().create(comm)
+    k2.set_operators(M2)
+    k2.set_type("sstep")
+    k2.sstep_s = 12
+    k2.get_pc().set_type("none")
+    k2.set_tolerances(rtol=1e-12, max_it=8000)
+    k2.residual_replacement = 24
+    k2.sstep_max_replacements = 1
+    x2, bv2 = M2.get_vecs()
+    bv2.set_global(b2)
+    res2 = k2.solve(bv2, x2)
+    dem = [e for e in res2.recovery_events if e.kind == "sstep_demote"]
+    problems2: list[str] = []
+    if not dem:
+        problems2.append("ill-conditioned basis never demoted")
+    if not res2.converged:
+        problems2.append(f"demoted solve did not converge: {res2}")
+    r2 = (np.linalg.norm(b2 - A2 @ x2.to_numpy()) / np.linalg.norm(b2))
+    if not r2 <= 1e-11:
+        problems2.append(f"demoted answer residual {r2:.3e} misses rtol")
+    status2 = "OK" if not problems2 else "FAIL"
+    print(f"[chaos] sstep-demote: {status2} demotions={len(dem)} "
+          f"iters={res2.iterations} true_rres={r2:.3e}")
+    return failures + [f"sstep-demote: {p}" for p in problems2]
 
 
 def drill_evict_solve() -> list[str]:
@@ -625,6 +721,12 @@ def main() -> int:
         # attempt
         failures += drill_megasolve()
         what = "megasolve fused-loop corruption"
+    elif "--sstep" in sys.argv[1:]:
+        # ISSUE 15 acceptance: a bitflip inside an s-block must detect
+        # -> rollback to the verified carry -> re-enter, and the
+        # ill-conditioned-basis demotion chain must land on classic CG
+        failures += drill_sstep()
+        what = "s-step block corruption + demotion"
     elif env_spec:
         # env-armed: the plan is already active from the environment
         failures += drill(f"env:{env_spec}", contextlib.nullcontext())
