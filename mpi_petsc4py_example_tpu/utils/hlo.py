@@ -12,9 +12,35 @@ Purely textual (brace matching on the ``stablehlo.while`` body region) —
 no MLIR bindings required; the text shape is pinned by the jax version
 the repo runs, and the tests exercising this parser fail loudly if a
 version bump changes it.
+
+Round 16 generalizes the module beyond reduce-site counting into the
+parsing layer of the ``tpscheck`` contract verifier (tools/tpscheck):
+per-site shape/dtype/byte extraction for any collective
+(:func:`collective_sites`), reduce-channel dtype classification
+(:func:`reduce_site_dtypes`), and donation/alias inspection of the
+lowered entry point (:func:`donated_args`,
+:func:`input_output_aliases`).
 """
 
 from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+#: StableHLO element-type -> bytes (the widths the byte gates price)
+ELT_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+             "c64": 8, "c128": 16, "i32": 4, "i64": 8,
+             "i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui32": 4}
+
+#: ``%r = "stablehlo.all_reduce"`` / ``%r:3 = stablehlo.all_reduce`` —
+#: one match per op DEFINITION, keyed by its result tuple, so stacked
+#: psums printed on one line count as distinct sites
+_REDUCE_DEF_RE = re.compile(
+    r"(%[A-Za-z0-9_.$-]+(?::\d+)?)\s*=\s*\"?stablehlo\.all_reduce\b")
+
+#: ``tensor<8x64xf32>`` / ``tensor<f64>`` — dims (possibly empty) + elt
+_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([a-z][a-z0-9]*)>")
 
 
 def _body_region(lines, start):
@@ -57,9 +83,25 @@ def _count_sites(body_lines, exclude_conditionals=True) -> int:
             cond_depth = bl.count("{") - bl.count("}")
             in_cond = cond_depth > 0
             continue
-        if "all_reduce" in bl:
-            count += 1
+        count += _line_reduce_defs(bl)
     return count
+
+
+def _line_reduce_defs(line: str) -> int:
+    """Number of DISTINCT ``all_reduce`` ops opening on one source line.
+
+    Dedupes by result tuple: two stacked psums the printer emits on a
+    single line (which happens for fused same-site reductions of
+    DIFFERENT dtypes, where variadic stacking is illegal) are two
+    sites, while the old one-increment-per-line counting conflated
+    them into one. A line mentioning ``all_reduce`` with no parseable
+    result definition (defensive: an unexpected print shape) still
+    counts once rather than silently dropping the site.
+    """
+    defs = _REDUCE_DEF_RE.findall(line)
+    if defs:
+        return len(dict.fromkeys(defs))
+    return 1 if "all_reduce" in line else 0
 
 
 def while_body_reduce_sites(stablehlo_text: str,
@@ -180,3 +222,155 @@ def nested_loop_reduce_site_chain(stablehlo_text: str,
             return chain
         a, b = max(spans, key=lambda s: s[1] - s[0])
         body = _body_region(body[a:b], 0)
+
+
+# ---------------------------------------------------------------------------
+# collective-site classification (tpscheck's measurement layer): per-site
+# result shape / element type / byte volume for any collective op, plus
+# donation/alias inspection of the lowered entry point
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    """One collective op site in a lowered program: the op kind, the
+    result shape, and the element type — enough to price its bytes."""
+
+    op: str                  # "all_gather" | "collective_permute" | ...
+    dims: tuple              # result tensor dims, () for scalars
+    elt: str                 # StableHLO element type, e.g. "f32"
+
+    @property
+    def elems(self) -> int:
+        return math.prod(self.dims) if self.dims else 1
+
+    @property
+    def bytes(self) -> int:
+        return self.elems * ELT_BYTES.get(self.elt, 0)
+
+
+def collective_sites(stablehlo_text: str, op_name: str
+                     ) -> list[CollectiveSite]:
+    """Every ``stablehlo.<op_name>`` site in the program, with result
+    shape and element type parsed from the LAST ``tensor<...>`` on the
+    op's header line (the result type — operand types precede it).
+
+    Works for the single-line collectives (``all_gather``,
+    ``collective_permute``); use :func:`reduce_site_dtypes` for the
+    region-carrying ``all_reduce``, whose result types print on its
+    CLOSING line instead.
+    """
+    needle = f"stablehlo.{op_name}"
+    sites = []
+    for line in stablehlo_text.splitlines():
+        if needle not in line:
+            continue
+        matches = _TENSOR_RE.findall(line)
+        if not matches:
+            continue
+        dims_s, elt = matches[-1]
+        dims = tuple(int(d) for d in dims_s.split("x") if d)
+        sites.append(CollectiveSite(op=op_name, dims=dims, elt=elt))
+    return sites
+
+
+def reduce_site_dtypes(stablehlo_text: str) -> list[tuple[str, ...]]:
+    """Per-``all_reduce``-site result element types, one tuple per site
+    in program order (variadic stacked reductions report one tuple with
+    several entries).
+
+    ``all_reduce`` carries a region, so its result types print on the
+    op's CLOSING ``}) : (...) -> ...`` line — found by brace counting
+    from the header. The reduce-channel dtype contracts pin these: a
+    plan whose fp64 exit-gate psum silently becomes f32 changes the
+    convergence semantics without changing any site count.
+    """
+    lines = stablehlo_text.splitlines()
+    out: list[tuple[str, ...]] = []
+    i = 0
+    while i < len(lines):
+        n_defs = _line_reduce_defs(lines[i])
+        if not n_defs:
+            i += 1
+            continue
+        depth = 0
+        opened = False
+        j = i
+        while j < len(lines):
+            depth += lines[j].count("{") - lines[j].count("}")
+            if depth > 0:
+                opened = True
+            if opened and depth <= 0:
+                break
+            j += 1
+        close = lines[min(j, len(lines) - 1)]
+        tail = close.rsplit("->", 1)[-1] if "->" in close else close
+        elts = tuple(elt for _dims, elt in _TENSOR_RE.findall(tail))
+        if n_defs > 1 and len(elts) == n_defs:
+            # stacked same-line ops: one single-result tuple each
+            out.extend((e,) for e in elts)
+        else:
+            out.append(elts)
+        i = j + 1
+    return out
+
+
+def _main_signature(stablehlo_text: str) -> str:
+    """The argument list of the ``@main`` entry point, paren-matched
+    from ``@main(`` (signatures can span lines). Empty when absent."""
+    idx = stablehlo_text.find("@main(")
+    if idx < 0:
+        return ""
+    start = idx + len("@main(")
+    depth = 1
+    for pos in range(start, len(stablehlo_text)):
+        ch = stablehlo_text[pos]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return stablehlo_text[start:pos]
+    return stablehlo_text[start:]
+
+
+_ARG_SPLIT_RE = re.compile(r"%arg(\d+):")
+
+
+def _main_arg_attrs(stablehlo_text: str) -> dict[int, str]:
+    """Per-argument attribute text of the ``@main`` signature (the
+    ``{...}`` trailing each ``%argN: tensor<...>`` declaration)."""
+    sig = _main_signature(stablehlo_text)
+    if not sig:
+        return {}
+    parts = _ARG_SPLIT_RE.split(sig)
+    # parts = [prefix, idx0, decl0, idx1, decl1, ...]
+    out = {}
+    for k in range(1, len(parts) - 1, 2):
+        out[int(parts[k])] = parts[k + 1]
+    if len(parts) % 2 == 0:        # trailing idx with no decl text
+        out[int(parts[-1])] = ""
+    return out
+
+
+def donated_args(stablehlo_text: str) -> tuple[int, ...]:
+    """Indices of ``@main`` arguments marked ``jax.buffer_donor = true``
+    — buffers jax may reuse for outputs (donation requested but not yet
+    bound to a specific output)."""
+    return tuple(sorted(
+        i for i, attrs in _main_arg_attrs(stablehlo_text).items()
+        if "jax.buffer_donor = true" in attrs))
+
+
+def input_output_aliases(stablehlo_text: str) -> dict[int, int]:
+    """``{arg_index: output_index}`` for ``@main`` arguments carrying a
+    ``tf.aliasing_output`` attribute — donations XLA has committed to
+    alias onto a specific result. A donated solve program losing its
+    alias silently doubles its residency; the donation contracts pin
+    this."""
+    out = {}
+    for i, attrs in _main_arg_attrs(stablehlo_text).items():
+        m = re.search(r"tf\.aliasing_output\s*=\s*(\d+)", attrs)
+        if m:
+            out[i] = int(m.group(1))
+    return out

@@ -1,0 +1,77 @@
+"""TPS016 fixtures — lock-order inversions and bare thread-body writes.
+
+Each marked line must produce exactly one finding.
+"""
+
+import threading
+
+
+class AbbaRouter:
+    """Direct two-lock inversion: move_lock -> lock established first,
+    then the reverse nesting."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._move_lock = threading.Lock()
+        self._sessions = {}
+
+    def migrate(self, sid):
+        # establishes the order: _move_lock before _lock
+        with self._move_lock:
+            with self._lock:
+                self._sessions.pop(sid, None)
+
+    def snapshot(self, sid):
+        with self._lock:
+            with self._move_lock:  # BAD: TPS016
+                return dict(self._sessions)
+
+
+class TransitiveServer:
+    """A -> B -> C established pairwise; C -> A contradicts through the
+    chain even though the pair was never nested directly."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def first(self):
+        with self._a, self._b:
+            pass
+
+    def second(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def third(self):
+        with self._c:
+            with self._a:  # BAD: TPS016
+                pass
+
+
+class RacyDispatcher:
+    """The dispatcher thread publishes queue state bare while the
+    submit path reads it under the condition variable."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending = []
+        self._stats = {"dispatched": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def submit(self, req):
+        with self._cv:
+            self._pending.append(req)
+            self._cv.notify_all()
+
+    def stats(self):
+        with self._cv:
+            return dict(self._stats)
+
+    def _loop(self):
+        while True:
+            batch = list(self._pending)
+            self._pending = []  # BAD: TPS016
+            self._stats["dispatched"] += len(batch)  # BAD: TPS016

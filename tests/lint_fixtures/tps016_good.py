@@ -1,0 +1,80 @@
+"""TPS016 good fixtures — the serving tier's idiomatic thread shapes.
+
+None of these may fire: consistent lock order everywhere (including the
+multi-item ``with a, b:`` spelling), RLock re-entry, thread bodies that
+take the lock around shared writes, thread-local state, and ``__init__``
+construction writes (the thread has not started yet).
+"""
+
+import threading
+
+
+class OrderedRouter:
+    """One nesting direction everywhere: _move_lock before _lock."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._move_lock = threading.Lock()
+        self._sessions = {}
+
+    def migrate(self, sid):
+        with self._move_lock:
+            with self._lock:
+                self._sessions.pop(sid, None)
+
+    def admit(self, sid):
+        # the same direction, multi-item spelling
+        with self._move_lock, self._lock:
+            self._sessions[sid] = object()
+
+    def reenter(self, sid):
+        # RLock re-entry is not an ordering edge
+        with self._lock:
+            with self._lock:
+                return self._sessions.get(sid)
+
+    def read(self):
+        with self._lock:
+            return dict(self._sessions)
+
+
+class CleanDispatcher:
+    """The dispatcher thread takes the condition variable around every
+    shared write; its scratch state is thread-local."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending = []
+        self._stats = {"dispatched": 0}
+        self._scratch = None          # only the loop thread touches it
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def submit(self, req):
+        with self._cv:
+            self._pending.append(req)
+            self._cv.notify_all()
+
+    def stats(self):
+        with self._cv:
+            return dict(self._stats)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                batch = list(self._pending)
+                self._pending = []
+                self._stats["dispatched"] += len(batch)
+            # never read under a lock anywhere: not evidently shared
+            self._scratch = batch
+
+
+class NoLocks:
+    """Non-lock context managers nest freely."""
+
+    def __init__(self):
+        self._log = open("/dev/null", "w")
+
+    def run(self, a, b):
+        with a:
+            with b:
+                self._log.write("ok\n")

@@ -1,0 +1,36 @@
+"""TPS017 fixtures — storage-channel values mixed into the reduce
+channel by bare arithmetic instead of a plan hook."""
+
+import jax.numpy as jnp
+
+from mpi_petsc4py_example_tpu.solvers.cg_plans import precision_plan
+
+
+def direct_hooks(prec, r0, p0):
+    ru = prec.up(r0)
+    ps = prec.store(p0)
+    return ru + ps  # BAD: TPS017
+
+
+def aliased_hooks(prec, r0, p0, alpha):
+    up = prec.up
+    store = prec.store
+    r = up(r0)
+    p = store(p0)
+    q = alpha * (p * r)  # BAD: TPS017
+    return q
+
+
+def conditional_alias(prec, w0, v0):
+    # the identity-fallback idiom still defines the channel
+    up = (prec.up if prec is not None and prec.mixed else (lambda v: v))
+    wu = up(w0)
+    vs = w0.astype(prec.storage)
+    return jnp.vdot(wu, wu) + jnp.sum(wu - vs)  # BAD: TPS017
+
+
+def constructed_plan(storage, r0, p0):
+    plan = precision_plan(storage)
+    a = plan.up(r0)
+    b = plan.store(p0)
+    return a - b  # BAD: TPS017

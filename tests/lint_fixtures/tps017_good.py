@@ -1,0 +1,47 @@
+"""TPS017 good fixtures — the plan-mediated channel idioms.
+
+None of these may fire: same-channel arithmetic, mixes routed through
+the plan's hooks (the ``store(x + alpha * p)`` cast-back spelling),
+and plan-free functions."""
+
+import jax.numpy as jnp
+
+from mpi_petsc4py_example_tpu.solvers.cg_plans import precision_plan
+
+
+def same_channel(prec, r0, u0, w0):
+    # the pipelined-CG fused-dot idiom: every operand lifted first
+    up = prec.up
+    ru, uu, wu = up(r0), up(u0), up(w0)
+    return jnp.vdot(ru, uu) + jnp.vdot(wu, uu) + jnp.vdot(ru, ru)
+
+
+def mediated_mix(prec, x, p0, alpha):
+    # mixing INSIDE the store(...) argument is the documented idiom:
+    # the cast-back makes the promotion intentional
+    store = prec.store
+    p = store(p0)
+    r = prec.up(x)
+    return store(r + alpha * p)
+
+
+def lifted_operand(prec, r0, p0):
+    up = prec.up
+    r = up(r0)
+    p = prec.store(p0)
+    return r + up(p)
+
+
+def storage_only(prec, p0, q0, beta):
+    p = prec.store(p0)
+    q = prec.store(q0)
+    return p + beta * q
+
+
+def no_plan(x, y):
+    return x + y
+
+
+def plan_key_only(storage):
+    plan = precision_plan(storage)
+    return plan.key()

@@ -1,247 +1,113 @@
-"""Lowered-HLO collective-volume regression gates beyond MG (round 6).
+"""Collective-schedule gates, round 16: thin ``tpscheck`` invocations.
 
-`tests/test_mg_slab.py::TestSlabHaloVolume` pins the V-cycle's comm
-volume; until now it was the ONLY lowered-HLO byte assert, so an
-accidental all-gather or replication in the ELL SpMV solve path or the
-fused EPS programs would land silently (round-5 VERDICT missing #4 —
-the VecScatter-volume analog, reference N8). These tests lower the
-programs on the 8-device mesh to StableHLO and assert their collective
-byte budgets:
+Every reduce-site / byte / gather pin that used to live here as ~1,000
+lines of hand-written asserts is now DECLARED in the contract registry
+(``mpi_petsc4py_example_tpu/contracts.py``) and verified by the
+``tpscheck`` checker core (``tools/tpscheck``).  These tests invoke the
+checker on the registry entries — the same code path CI's ``contracts``
+job runs — so a pin that regresses fails BOTH here and in ``tpscheck
+--strict``, from one declaration.
 
-* ELL all_gather CG program — every all-gather is exactly ONE vector
-  (n_pad elements): the SpMV's x-gather, nothing matrix- or basis-sized;
-* DIA banded CG program — NO all-gather at all (the open-chain ppermute
-  halo exchange is the whole VecScatter);
-* fused EPS programs (seed+facto and the whole-solve HEP loop) — the
-  basis V stays sharded; only vector-sized spmv gathers appear.
+The injected-regression tests are the checker's teeth: each
+deliberately broken operator/plan (value-matrix replication, per-column
+gathers, full-width upcast before a bf16 gather, split psum/Gram-psum
+seams) rides the SAME contract builders, and the assertion is that
+``tpscheck`` — not a bespoke assert — produces the finding.
 
-A deliberately-regressed operator (its local_spmv all-gathers the ELL
-value matrix) proves the gate actually fails on an injected volume
-regression.
+The test classes keep their historical names; CI job filters select on
+them.
 """
 
-import re
+import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
-import scipy.sparse as sp
 
-import mpi_petsc4py_example_tpu as tps
-from mpi_petsc4py_example_tpu.models import tridiag_family
-from mpi_petsc4py_example_tpu.solvers.krylov import (build_ksp_program,
-                                                     build_ksp_program_many)
+from mpi_petsc4py_example_tpu import contracts as contracts_mod
+from mpi_petsc4py_example_tpu.contracts import (get_contracts, lower_ksp,
+                                                lower_megasolve)
+from tools.tpscheck import checker
 
+#: drift-clean acceptance: tests compare against the committed baseline
+#: too, so an unpinned metric change fails here until the baseline is
+#: consciously regenerated
+_BASELINE = checker.load_baseline()
 
-def all_gather_volumes(stablehlo_text: str):
-    """Output element count of every all_gather in the lowered module
-    (the TestSlabHaloVolume parsing pattern)."""
-    out = []
-    for line in stablehlo_text.splitlines():
-        if "all_gather" not in line:
-            continue
-        shapes = re.findall(r"tensor<([0-9x]+)x[a-z]", line)
-        assert shapes, f"unparseable all_gather line: {line}"
-        out.append(int(np.prod([int(d) for d in shapes[-1].split("x")])))
-    return out
+#: healthy-contract results, memoized per test session — several test
+#: classes gate on the same program class, and one lowering is enough
+_checked: dict = {}
 
 
-#: StableHLO element-type -> bytes (the widths the byte gates price)
-_ELT_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
-              "c64": 8, "c128": 16, "i32": 4, "i64": 8}
+def _check(comm, *names):
+    """Assert the named contracts verify clean through tpscheck."""
+    for name in names:
+        if name not in _checked:
+            (c,) = get_contracts(names=[name])
+            findings, measured = checker.check_contract(
+                c, comm, baseline=_BASELINE)
+            assert measured is not None, [f.format() for f in findings]
+            _checked[name] = findings
+        bad = _checked[name]
+        assert not bad, [f.format() for f in bad]
 
 
-def _collective_bytes(stablehlo_text: str, op_name: str):
-    """Per-site BYTE volume of every ``op_name`` collective in the
-    lowered module — the mixed-precision gates pin bytes, not element
-    counts: a bf16 program that gathered at full f32 width would pass an
-    element-count gate while silently forfeiting the entire bandwidth
-    win."""
-    out = []
-    for line in stablehlo_text.splitlines():
-        if op_name not in line:
-            continue
-        shapes = re.findall(r"tensor<([0-9x]+)x([a-z][a-z0-9]*)>", line)
-        assert shapes, f"unparseable {op_name} line: {line}"
-        dims, elt = shapes[-1]
-        assert elt in _ELT_BYTES, f"unknown element type {elt!r}: {line}"
-        out.append(int(np.prod([int(d) for d in dims.split("x")]))
-                   * _ELT_BYTES[elt])
-    return out
+def _contract(name):
+    (c,) = get_contracts(names=[name])
+    return c
 
 
-def all_gather_bytes(stablehlo_text: str):
-    return _collective_bytes(stablehlo_text, "all_gather")
-
-
-def collective_permute_bytes(stablehlo_text: str):
-    return _collective_bytes(stablehlo_text, "collective_permute")
-
-
-def _ell_matrix(n: int):
-    """Random sparsity — enough distinct diagonals that the DIA layout is
-    rejected and the general ELL all_gather path is kept."""
-    rng = np.random.default_rng(11)
-    A = sp.random(n, n, density=0.02, random_state=rng, format="csr")
-    A = A + sp.eye(n, format="csr") * n      # diagonally dominant
-    return A.tocsr()
-
-
-def _lower_cg(comm, M, x0=None):
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("cg")
-    ksp.get_pc().set_type("none")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    prog = build_ksp_program(comm, "cg", pc, M)
-    x, b = M.get_vecs()
-    dt = np.dtype(np.float64)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), b.data, x.data,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-        np.int32(50)).as_text()
+def _rules(findings):
+    return {f.rule for f in findings}
 
 
 class TestEllSpmvVolume:
     def test_cg_ell_gathers_one_vector_only(self, comm8):
-        n = 512
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        assert M.dia_vals is None, "test needs the general ELL path"
-        txt = _lower_cg(comm8, M)
-        vols = all_gather_volumes(txt)
-        n_pad = comm8.padded_size(n)
-        # the SpMV's x-gather is the ONLY all-gather shape: one padded
-        # vector. Anything larger (ELL values: n_pad*K; a Krylov basis)
-        # is a replication regression.
-        assert vols, "expected the SpMV x-gather in the lowered program"
-        assert all(v == n_pad for v in vols), (vols, n_pad)
-        # initial residual + loop body (+ none-PC epilogue sites): the
-        # program must not accumulate per-iteration gather SITES either
-        assert len(vols) <= 4, vols
+        _check(comm8, "ksp/cg/ell")
 
     def test_cg_dia_has_no_gather_at_all(self, comm8):
-        """Banded operators ride the open-chain ppermute VecScatter —
-        an all_gather here is the O(n)-bytes regression the round-4
-        banded path removed."""
-        n = 512
-        M = tps.Mat.from_scipy(comm8, tridiag_family(n))
-        assert M.dia_vals is not None
-        txt = _lower_cg(comm8, M)
-        assert all_gather_volumes(txt) == []
-        assert txt.count("collective_permute") >= 2   # halo each way
+        _check(comm8, "ksp/cg/dia")
 
 
 class TestFusedEpsVolume:
-    def test_seed_facto_program_volume(self, comm8, monkeypatch):
-        import mpi_petsc4py_example_tpu.solvers.eps as eps_mod
-        from mpi_petsc4py_example_tpu.solvers.eps import (
-            _build_seed_facto_program)
-        # the AOT wrapper (utils/aot) hides .lower — build the raw
-        # traced program for the volume assert
-        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-        eps_mod._PROGRAM_CACHE.clear()
-        n, ncv = 512, 16
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        prog = _build_seed_facto_program(comm8, M, ncv)
-        v0 = comm8.put_rows(np.zeros(n))
-        txt = prog.lower(M.device_arrays(), (), v0).as_text()
-        vols = all_gather_volumes(txt)
-        n_pad = comm8.padded_size(n)
-        # the factorization's only gather is the spmv x-gather; the
-        # (ncv+1, n_pad) basis V must stay sharded (a V gather is
-        # (ncv+1)x the budget and the exact regression this pins)
-        assert all(v == n_pad for v in vols), (vols, n_pad)
-        assert len(vols) <= 2, vols
+    def test_seed_facto_program_volume(self, comm8):
+        _check(comm8, "seedfacto/ell")
+
+    def test_restart_facto_program_volume(self, comm8):
+        _check(comm8, "restartfacto/ell")
 
     def test_hep_loop_program_volume(self, comm8):
-        from mpi_petsc4py_example_tpu.solvers.eps import (
-            _build_hep_loop_program)
-        n, ncv, k_keep, nev = 512, 16, 8, 1
-        M = tps.Mat.from_scipy(comm8, tridiag_family(n))
-        prog = _build_hep_loop_program(comm8, M, ncv, k_keep, nev,
-                                       which="largest_magnitude",
-                                       st_type="shift")
-        v0 = comm8.put_rows(np.zeros(n))
-        dt = np.dtype(np.float64)
-        txt = prog.lower(M.device_arrays(), (), v0, dt.type(1e-8),
-                         dt.type(0.0), dt.type(0.0),
-                         np.int32(10)).as_text()
-        vols = all_gather_volumes(txt)
-        n_pad = comm8.padded_size(n)
-        # DIA tridiagonal spmv needs no gather; whatever gathers remain
-        # must be at most vector-sized (never the basis/projected blocks
-        # — the whole point of the O(1)-sync fused loop)
-        assert all(v <= n_pad for v in vols), (vols, n_pad)
-        assert len(vols) <= 3, vols
-
-
-def _lower_cg_many(comm, M, k, monkeypatch):
-    """Lower the batched multi-RHS CG program (AOT wrap disabled so the
-    raw traced program's .lower is reachable)."""
-    import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-    monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-    krylov_mod._PROGRAM_CACHE_MANY.clear()
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("cg")
-    ksp.get_pc().set_type("none")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    prog = build_ksp_program_many(comm, "cg", pc, M, nrhs=k)
-    n = M.shape[0]
-    Bp = comm.put_rows(np.zeros((n, k)))
-    X0 = comm.put_rows(np.zeros((n, k)))
-    dt = np.dtype(np.float64)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), Bp, X0,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-        np.int32(50)).as_text()
+        _check(comm8, "heploop/dia")
 
 
 class TestBatchedProgramVolume:
     """The batched-solve comm contract (ISSUE 4 acceptance): the k=8
     block-CG program contains the SAME NUMBER of all-gather ops as the
-    k=1 program — the per-iteration gather ships the whole RHS block in
-    ONE collective whose BYTES scale with k while the op count does not."""
+    k=1 program — declared via a shared registry constant, so the two
+    entries cannot drift apart independently."""
 
-    def test_k8_gather_op_count_equals_k1(self, comm8, monkeypatch):
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        assert M.dia_vals is None, "test needs the general ELL path"
-        vols_1 = all_gather_volumes(_lower_cg(comm8, M))
-        vols_k = all_gather_volumes(_lower_cg_many(comm8, M, k,
-                                                   monkeypatch))
-        n_pad = comm8.padded_size(n)
-        # op COUNT equal; each batched gather is exactly the k-wide block
-        assert len(vols_k) == len(vols_1), (vols_k, vols_1)
-        assert all(v == n_pad * k for v in vols_k), (vols_k, n_pad, k)
+    def test_k8_gather_op_count_equals_k1(self, comm8):
+        k1 = _contract("ksp_many/cg/ell/k1")
+        k8 = _contract("ksp_many/cg/ell/k8")
+        # the cross-program pin is a shared declaration...
+        assert k1.gather_sites == k8.gather_sites is not None
+        assert k8.gather_elems == k1.gather_elems * contracts_mod.NRHS
+        # ...and both sides verify against their lowerings
+        _check(comm8, "ksp_many/cg/ell/k1", "ksp_many/cg/ell/k8")
 
-    def test_k8_dia_still_gather_free(self, comm8, monkeypatch):
-        """Banded operators keep the zero-gather ppermute VecScatter in
-        the batched program too."""
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, tridiag_family(n))
-        assert M.dia_vals is not None
-        txt = _lower_cg_many(comm8, M, k, monkeypatch)
-        assert all_gather_volumes(txt) == []
-        assert txt.count("collective_permute") >= 2
+    def test_k8_dia_still_gather_free(self, comm8):
+        _check(comm8, "ksp_many/cg/dia/k8")
 
-    def test_per_column_gather_regression_fails_gate(self, comm8,
-                                                     monkeypatch):
+    def test_per_column_gather_regression_fails_gate(self, comm8):
         """Teeth: an operator whose batched SpMV gathers each column
-        SEPARATELY multiplies the all-gather op count by k — exactly the
-        regression the op-count gate must catch."""
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        vols_1 = all_gather_volumes(_lower_cg(comm8, M))
-        txt = _lower_cg_many(comm8, _PerColumnGatherEll(M), k, monkeypatch)
-        vols_bad = all_gather_volumes(txt)
-        # the regression emits k vector-sized gathers per SpMV site
-        assert len(vols_bad) > len(vols_1), (vols_bad, vols_1)
-        with pytest.raises(AssertionError):
-            assert len(vols_bad) == len(vols_1)
+        SEPARATELY multiplies the all-gather op count by k — tpscheck's
+        site-count diff (TPC003) must catch it."""
+        bad = dataclasses.replace(
+            _contract("ksp_many/cg/ell/k8"),
+            build=lambda comm: lower_ksp(comm, nrhs=contracts_mod.NRHS,
+                                         wrap_op=_PerColumnGatherEll))
+        findings, _ = checker.check_contract(bad, comm8)
+        assert "TPC003" in _rules(findings), [f.format() for f in findings]
 
 
 class _PerColumnGatherEll:
@@ -254,6 +120,9 @@ class _PerColumnGatherEll:
         self.dtype = M.dtype
         self.layout = M.layout
         self.comm = M.comm
+
+    def __getattr__(self, name):
+        return getattr(self._M, name)
 
     def device_arrays(self):
         return self._M.device_arrays()
@@ -286,243 +155,68 @@ class _PerColumnGatherEll:
         return spmv_many
 
 
-def _lower_cg_guard(comm, M, abft_pc=True, rr=False, monkeypatch=None):
-    """Lower the guarded (ABFT/replacement) CG program."""
-    from mpi_petsc4py_example_tpu.resilience import abft
-    from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("cg")
-    ksp.get_pc().set_type("jacobi")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    cs = abft.column_checksum(M)
-    csM = abft.pc_checksum(pc, M)
-    placed = comm.put_rows_many([cs] + ([csM] if abft_pc else []))
-    prog = build_ksp_program(comm, "cg", pc, M, abft=True,
-                             abft_pc=abft_pc, rr=rr)
-    x, b = M.get_vecs()
-    dt = np.dtype(np.float64)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), *placed, b.data, x.data,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50),
-        dt.type(256.0), np.int32(50 if rr else 0)).as_text()
-
-
-def _lower_cg_jacobi(comm, M):
-    from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("cg")
-    ksp.get_pc().set_type("jacobi")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    prog = build_ksp_program(comm, "cg", pc, M)
-    x, b = M.get_vecs()
-    dt = np.dtype(np.float64)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), b.data, x.data,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
-
-
 class TestAbftGuardVolume:
     """ISSUE 5 acceptance: the ABFT/monitor path adds ZERO extra psum
-    sites per CG iteration — every checksum partial folds into an
-    existing reduction phase as one stacked psum. The guarded program in
-    fact has FEWER reduce sites than the plain kernel (the plain phase-2
-    psums rz and ||r|| separately; the guard stacks them)."""
+    sites — the old guarded<=plain and rr-on==rr-off comparisons are
+    now absolute total-reduce declarations sharing registry constants."""
 
     def test_abft_program_reduce_count_not_larger(self, comm8):
-        n = 512
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        plain = _lower_cg_jacobi(comm8, M)
-        guarded = _lower_cg_guard(comm8, M, abft_pc=True, rr=False)
-        assert guarded.count("all_reduce") <= plain.count("all_reduce"), (
-            guarded.count("all_reduce"), plain.count("all_reduce"))
+        assert (contracts_mod.ELL_GUARD_TOTAL_REDUCES
+                <= contracts_mod.ELL_CG_JACOBI_TOTAL_REDUCES)
+        _check(comm8, "ksp/cg/ell-jacobi", "ksp/cg-guard/ell")
 
     def test_replacement_adds_no_per_iteration_reduces(self, comm8):
-        """The periodic replacement's verifier psums live inside the
-        every-N conditional branch — enabling it must not add reduce
-        SITES beyond that branch (compare rr on/off: identical counts,
-        the branch is traced either way)."""
-        n = 512
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        on = _lower_cg_guard(comm8, M, rr=True)
-        off = _lower_cg_guard(comm8, M, rr=False)
-        assert on.count("all_reduce") == off.count("all_reduce")
+        """rr on/off: both contracts declare the SAME total (the
+        verifier lives in the every-N conditional branch, traced either
+        way)."""
+        on = _contract("ksp/cg-guard-rr/ell")
+        off = _contract("ksp/cg-guard/ell")
+        assert on.total_reduce_sites == off.total_reduce_sites is not None
+        _check(comm8, "ksp/cg-guard/ell", "ksp/cg-guard-rr/ell")
 
     def test_abft_gathers_stay_vector_sized(self, comm8):
-        """The checksum vectors ride as sharded ARGUMENTS — no gather may
-        grow beyond one padded vector (a checksum replication would be
-        the regression)."""
-        n = 512
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        vols = all_gather_volumes(_lower_cg_guard(comm8, M, rr=True))
-        n_pad = comm8.padded_size(n)
-        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+        assert _contract("ksp/cg-guard-rr/ell").gather_elems == \
+            contracts_mod.N
+        _check(comm8, "ksp/cg-guard-rr/ell")
 
-    def test_batched_guard_gather_count_matches_k1(self, comm8,
-                                                   monkeypatch):
-        """Mask-aware per-column guarding keeps the batched comm
-        contract: gather op count independent of k, bytes scaling
-        with k."""
-        from mpi_petsc4py_example_tpu.resilience import abft
-        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-        krylov_mod._PROGRAM_CACHE_MANY.clear()
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        ksp = tps.KSP().create(comm8)
-        ksp.set_operators(M)
-        ksp.set_type("cg")
-        ksp.get_pc().set_type("jacobi")
-        ksp.set_up()
-        pc = ksp.get_pc()
-        cs = abft.column_checksum(M)
-        csM = abft.pc_checksum(pc, M)
-        dt = np.dtype(np.float64)
-
-        def lower_many(nrhs):
-            placed = comm8.put_rows_many([cs, csM])
-            prog = build_ksp_program_many(comm8, "cg", pc, M, nrhs=nrhs,
-                                          abft=True, abft_pc=True, rr=True)
-            Bp = comm8.put_rows(np.zeros((n, nrhs)))
-            X0 = comm8.put_rows(np.zeros((n, nrhs)))
-            return prog.lower(
-                M.device_arrays(), pc.device_arrays(), *placed, Bp, X0,
-                dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50),
-                dt.type(256.0), np.int32(25)).as_text()
-
-        txt1, txtk = lower_many(1), lower_many(k)
-        vols1 = all_gather_volumes(txt1)
-        volsk = all_gather_volumes(txtk)
-        n_pad = comm8.padded_size(n)
-        assert len(volsk) == len(vols1), (volsk, vols1)
-        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
-        assert txtk.count("all_reduce") == txt1.count("all_reduce")
-
-
-def _lower_pipecg(comm, M, pc_type="jacobi", guard=False, rr=False):
-    from mpi_petsc4py_example_tpu.resilience import abft
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("pipecg")
-    ksp.get_pc().set_type(pc_type)
-    ksp.set_up()
-    pc = ksp.get_pc()
-    x, b = M.get_vecs()
-    dt = np.dtype(np.float64)
-    if guard:
-        cs = abft.column_checksum(M)
-        csM = abft.pc_checksum(pc, M)
-        placed = comm.put_rows_many([cs, csM])
-        prog = build_ksp_program(comm, "pipecg", pc, M, abft=True,
-                                 abft_pc=True, rr=rr)
-        return prog.lower(
-            M.device_arrays(), pc.device_arrays(), *placed, b.data,
-            x.data, dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-            np.int32(50), dt.type(256.0),
-            np.int32(25 if rr else 0)).as_text()
-    prog = build_ksp_program(comm, "pipecg", pc, M)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), b.data, x.data,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+    def test_batched_guard_gather_count_matches_k1(self, comm8):
+        k1 = _contract("ksp_many/cg-guard-rr/ell/k1")
+        k8 = _contract("ksp_many/cg-guard-rr/ell/k8")
+        assert k1.gather_sites == k8.gather_sites is not None
+        assert k1.total_reduce_sites == k8.total_reduce_sites is not None
+        _check(comm8, "ksp_many/cg-guard-rr/ell/k1",
+               "ksp_many/cg-guard-rr/ell/k8")
 
 
 class TestPipelinedReduceSites:
-    """ISSUE 7 acceptance: the pipelined program lowers to exactly ONE
-    psum/reduce site per iteration — vs 2 for the guarded classic loop
-    and 3 for plain CG — pinned on the WHILE BODY of the lowered
-    StableHLO (utils/hlo.solver_loop_reduce_sites; whole-program counts
-    can't tell init/epilogue reductions from per-iteration ones)."""
+    """ISSUE 7 acceptance: the 3 / 2 / 1 per-iteration reduce-site
+    schedules, declared per contract and pinned on the WHILE BODY of
+    the lowered StableHLO by the checker."""
 
     def test_site_schedule_3_2_1(self, comm8):
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
-        assert solver_loop_reduce_sites(_lower_cg_jacobi(comm8, M)) == 3
-        assert solver_loop_reduce_sites(
-            _lower_cg_guard(comm8, M, rr=True)) == 2
-        assert solver_loop_reduce_sites(_lower_pipecg(comm8, M)) == 1
-        # the guarded pipelined program KEEPS the 1-site schedule: ABFT
-        # partials ride the same stacked psum, the replacement verifier
-        # lives in the every-N conditional branch
-        assert solver_loop_reduce_sites(
-            _lower_pipecg(comm8, M, guard=True, rr=True)) == 1
+        assert _contract("ksp/cg/ell-jacobi").reduce_site_chain == (3,)
+        assert _contract("ksp/cg-guard-rr/ell").reduce_site_chain == (2,)
+        assert _contract("ksp/pipecg/ell").reduce_site_chain == (1,)
+        assert _contract(
+            "ksp/pipecg-guard-rr/ell").reduce_site_chain == (1,)
+        _check(comm8, "ksp/cg/ell-jacobi", "ksp/cg-guard-rr/ell",
+               "ksp/pipecg/ell", "ksp/pipecg-guard-rr/ell")
 
     def test_stencil_pipelined_one_site(self, comm8):
-        """The grid-carry stencil fast path (pipecg_stencil_kernel) also
-        honors the 1-site contract; classic stencil CG has 2 (the fused
-        matvec+dot psum + the residual-norm psum)."""
-        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        op = StencilPoisson3D(comm8, 16, 16, 16)
-        ksp = tps.KSP().create(comm8)
-        ksp.set_operators(op)
-        ksp.set_type("pipecg")
-        ksp.get_pc().set_type("jacobi")
-        ksp.set_up()
-        pc = ksp.get_pc()
-        dt = np.dtype(np.float64)
-        x, b = op.get_vecs()
+        _check(comm8, "ksp/pipecg/stencil", "ksp/cg/stencil")
 
-        def lower(tp):
-            prog = build_ksp_program(comm8, tp, pc, op)
-            return prog.lower(
-                op.device_arrays(), pc.device_arrays(), b.data, x.data,
-                dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-                np.int32(50)).as_text()
-
-        assert solver_loop_reduce_sites(lower("pipecg")) == 1
-        assert solver_loop_reduce_sites(lower("cg")) == 2
-
-    def test_batched_pipelined_one_site_and_gather_count(self, comm8,
-                                                         monkeypatch):
-        """The batched pipelined program keeps ONE reduce site per
-        iteration with the same gather op count as k=1 (bytes x k)."""
-        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-        krylov_mod._PROGRAM_CACHE_MANY.clear()
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        ksp = tps.KSP().create(comm8)
-        ksp.set_operators(M)
-        ksp.set_type("pipecg")
-        ksp.get_pc().set_type("jacobi")
-        ksp.set_up()
-        pc = ksp.get_pc()
-        dt = np.dtype(np.float64)
-
-        def lower_many(nrhs):
-            prog = build_ksp_program_many(comm8, "pipecg", pc, M,
-                                          nrhs=nrhs)
-            Bp = comm8.put_rows(np.zeros((n, nrhs)))
-            X0 = comm8.put_rows(np.zeros((n, nrhs)))
-            return prog.lower(
-                M.device_arrays(), pc.device_arrays(), Bp, X0,
-                dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-                np.int32(50)).as_text()
-
-        txt1, txtk = lower_many(1), lower_many(k)
-        assert solver_loop_reduce_sites(txtk) == 1
-        vols1 = all_gather_volumes(txt1)
-        volsk = all_gather_volumes(txtk)
-        n_pad = comm8.padded_size(n)
-        assert len(volsk) == len(vols1), (volsk, vols1)
-        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
+    def test_batched_pipelined_one_site_and_gather_count(self, comm8):
+        k1 = _contract("ksp_many/pipecg/ell/k1")
+        k8 = _contract("ksp_many/pipecg/ell/k8")
+        assert k1.gather_sites == k8.gather_sites is not None
+        assert k8.reduce_site_chain == (1,)
+        _check(comm8, "ksp_many/pipecg/ell/k1", "ksp_many/pipecg/ell/k8")
 
     def test_injected_two_site_regression_fails_gate(self, comm8,
                                                      monkeypatch):
-        """Teeth: split the fuse_psum seam into TWO psums (the regression
-        a careless reduction-plan edit would introduce) — the lowered
-        body must show 2 sites and the ==1 gate must fail."""
+        """Teeth: split the fuse_psum seam into TWO psums — tpscheck's
+        chain diff (TPC001) must fail the ==1 declaration."""
         import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
-        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
 
         def split_fuse(parts, psum, axis, dtype):
             parts = [jnp.asarray(q, dtype) for q in parts]
@@ -530,130 +224,44 @@ class TestPipelinedReduceSites:
             tail = psum(jnp.stack(parts[1:]), axis)
             return jnp.concatenate([head, tail])
 
-        # the regression program would cache under the SAME key as the
-        # healthy pipelined program — clear around the experiment
-        krylov_mod._PROGRAM_CACHE.clear()
         monkeypatch.setattr(cg_plans, "fuse_psum", split_fuse)
-        try:
-            M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
-            sites = solver_loop_reduce_sites(_lower_pipecg(comm8, M))
-            assert sites == 2, sites
-        finally:
-            monkeypatch.undo()
-            krylov_mod._PROGRAM_CACHE.clear()
-
-
-def _lower_sstep(comm, M, s=4, guard=False, rr=False, nrhs=None,
-                 monkeypatch=None):
-    from mpi_petsc4py_example_tpu.resilience import abft
-    import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("sstep")
-    ksp.get_pc().set_type("jacobi")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    dt = np.dtype(np.float64)
-    if nrhs is not None:
-        assert monkeypatch is not None
-        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-        krylov_mod._PROGRAM_CACHE_MANY.clear()
-        prog = build_ksp_program_many(comm, "sstep", pc, M, nrhs=nrhs,
-                                      sstep_s=s)
-        n = M.shape[0]
-        Bp = comm.put_rows(np.zeros((n, nrhs)))
-        X0 = comm.put_rows(np.zeros((n, nrhs)))
-        return prog.lower(
-            M.device_arrays(), pc.device_arrays(), Bp, X0,
-            dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-            np.int32(50)).as_text()
-    x, b = M.get_vecs()
-    if guard:
-        cs = abft.column_checksum(M)
-        csM = abft.pc_checksum(pc, M)
-        placed = comm.put_rows_many([cs, csM])
-        prog = build_ksp_program(comm, "sstep", pc, M, abft=True,
-                                 abft_pc=True, rr=rr, sstep_s=s)
-        return prog.lower(
-            M.device_arrays(), pc.device_arrays(), *placed, b.data,
-            x.data, dt.type(1e-8), dt.type(0.0), dt.type(0.0),
-            np.int32(50), dt.type(256.0), np.int32(24 if rr else 0),
-            np.int32(3)).as_text()
-    prog = build_ksp_program(comm, "sstep", pc, M, sstep_s=s)
-    return prog.lower(
-        M.device_arrays(), pc.device_arrays(), b.data, x.data,
-        dt.type(1e-8), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+        findings, _ = checker.check_contract(
+            _contract("ksp/pipecg/ell"), comm8)
+        assert "TPC001" in _rules(findings), [f.format() for f in findings]
 
 
 class TestSstepReduceSites:
-    """ISSUE 15 acceptance: the s-step programs lower to exactly ONE own
-    reduce site per s-BLOCK — the stacked Gram psum — for the plain,
-    guarded, and batched forms, and the megasolve-nested form keeps
-    ``[4, 1]`` per-depth own schedules; an injected split of the
-    fuse_gram_psum seam proves the gate has teeth."""
+    """ISSUE 15 acceptance: ONE own reduce site (the stacked Gram psum)
+    per s-block for the plain, guarded, and batched s-step programs;
+    the megasolve-nested form keeps the [4, 1] chain."""
 
     @pytest.mark.parametrize("s", [2, 4, 8])
     def test_one_site_per_block(self, comm8, s):
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
-        assert solver_loop_reduce_sites(_lower_sstep(comm8, M, s=s)) == 1
+        _check(comm8, f"ksp/sstep-s{s}/ell")
 
     def test_guarded_keeps_one_site(self, comm8):
-        """The ABFT basis-build partials ride the SAME stacked Gram
-        psum; the replacement/stall verifier lives in the every-N
-        conditional branch."""
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
-        assert solver_loop_reduce_sites(
-            _lower_sstep(comm8, M, guard=True, rr=True)) == 1
+        _check(comm8, "ksp/sstep-guard-rr/ell")
 
-    def test_batched_one_site_and_gather_count(self, comm8, monkeypatch):
-        """The batched s-step program keeps ONE reduce site per block
-        with the same gather op count as k=1 (bytes x k) — the batched
-        comm contract."""
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        n, k = 512, 8
-        M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-        txt1 = _lower_sstep(comm8, M, nrhs=1, monkeypatch=monkeypatch)
-        txtk = _lower_sstep(comm8, M, nrhs=k, monkeypatch=monkeypatch)
-        assert solver_loop_reduce_sites(txtk) == 1
-        vols1 = all_gather_volumes(txt1)
-        volsk = all_gather_volumes(txtk)
-        n_pad = comm8.padded_size(n)
-        assert len(volsk) == len(vols1), (volsk, vols1)
-        assert all(v == n_pad * k for v in volsk), (volsk, n_pad, k)
+    def test_batched_one_site_and_gather_count(self, comm8):
+        k1 = _contract("ksp_many/sstep/ell/k1")
+        k8 = _contract("ksp_many/sstep/ell/k8")
+        assert k1.gather_sites == k8.gather_sites is not None
+        _check(comm8, "ksp_many/sstep/ell/k1", "ksp_many/sstep/ell/k8")
 
     def test_gathers_stay_vector_sized(self, comm8):
-        """The basis build gathers one padded vector per operator apply
-        — never a basis-block-sized gather (that replication would be
-        the O(s·n)-bytes regression)."""
-        txt = _lower_sstep(comm8, tps.Mat.from_scipy(comm8,
-                                                     _ell_matrix(512)))
-        vols = all_gather_volumes(txt)
-        n_pad = comm8.padded_size(512)
-        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+        assert _contract("ksp/sstep-s4/ell").gather_elems == \
+            contracts_mod.N
+        _check(comm8, "ksp/sstep-s4/ell")
 
     def test_megasolve_nested_chain_4_1(self, comm8):
-        """The fused whole-solve sstep program pins [outer-own, inner] =
-        [4, 1]: bnorm + rn0 + the final exact norm + the fp64 exit gate
-        outside, ONE Gram psum per s-block inside."""
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            nested_loop_reduce_site_chain)
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "sstep")) == [4, 1]
+        assert _contract("megasolve/sstep").reduce_site_chain == (4, 1)
+        _check(comm8, "megasolve/sstep")
 
     def test_injected_split_gram_regression_fails_gate(self, comm8,
                                                        monkeypatch):
-        """Teeth: split the fuse_gram_psum seam into TWO psums (the
-        regression a careless Gram-plan edit would introduce) — the
-        lowered s-block must show 2 sites and the ==1 gate must fail."""
+        """Teeth: split the fuse_gram_psum seam into TWO psums —
+        tpscheck's chain diff must fail the ==1 declaration."""
         import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
-        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
 
         orig = cg_plans.fuse_gram_psum
 
@@ -663,16 +271,10 @@ class TestSstepReduceSites:
                     if len(parts) > 1 else [])
             return head + tail
 
-        krylov_mod._PROGRAM_CACHE.clear()
         monkeypatch.setattr(cg_plans, "fuse_gram_psum", split_gram)
-        try:
-            M = tps.Mat.from_scipy(comm8, _ell_matrix(512))
-            sites = solver_loop_reduce_sites(
-                _lower_sstep(comm8, M, guard=True, rr=True))
-            assert sites == 2, sites
-        finally:
-            monkeypatch.undo()
-            krylov_mod._PROGRAM_CACHE.clear()
+        findings, _ = checker.check_contract(
+            _contract("ksp/sstep-guard-rr/ell"), comm8)
+        assert "TPC001" in _rules(findings), [f.format() for f in findings]
 
 
 class _RegressedEll:
@@ -685,6 +287,9 @@ class _RegressedEll:
         self.dtype = M.dtype
         self.layout = M.layout
         self.comm = M.comm
+
+    def __getattr__(self, name):
+        return getattr(self._M, name)
 
     def device_arrays(self):
         return self._M.device_arrays()
@@ -711,40 +316,15 @@ class _RegressedEll:
 
 
 def test_injected_regression_fails_the_gate(comm8):
-    """Prove the byte assert has teeth: an operator that accidentally
-    replicates its (n_pad, K) ELL values trips the vector-size budget."""
-    n = 512
-    M = tps.Mat.from_scipy(comm8, _ell_matrix(n))
-    txt = _lower_cg(comm8, _RegressedEll(M))
-    vols = all_gather_volumes(txt)
-    n_pad = comm8.padded_size(n)
-    assert any(v > n_pad for v in vols), (vols, n_pad)
-    with pytest.raises(AssertionError):
-        assert all(v == n_pad for v in vols)
-
-
-# ---------------------------------------------------------------------------
-# mixed-precision byte budgets (ISSUE 10): the low-precision programs must
-# ship HALF the gather/halo bytes of their f32 twins — pinned on the
-# lowered StableHLO, so the bandwidth win is enforced, not assumed
-# ---------------------------------------------------------------------------
-
-
-def _lower_cg_dtype(comm, A_scipy, dtype):
-    from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
-    M = tps.Mat.from_scipy(comm, A_scipy, dtype=dtype)
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type("cg")
-    ksp.get_pc().set_type("jacobi")
-    ksp.set_up()
-    pc = ksp.get_pc()
-    prog = build_ksp_program(comm, "cg", pc, M)
-    x, b = M.get_vecs()
-    dt = tolerance_dtype(M.dtype)
-    return M, prog.lower(
-        M.device_arrays(), pc.device_arrays(), b.data, x.data,
-        dt.type(1e-2), dt.type(0.0), dt.type(0.0), np.int32(50)).as_text()
+    """Prove the volume gate has teeth: an operator that accidentally
+    replicates its (n_pad, K) ELL values trips the contract's
+    one-vector element budget (TPC002) — and a site-count drift rides
+    along (TPC003)."""
+    bad = dataclasses.replace(
+        _contract("ksp/cg/ell"),
+        build=lambda comm: lower_ksp(comm, wrap_op=_RegressedEll))
+    findings, _ = checker.check_contract(bad, comm8)
+    assert "TPC002" in _rules(findings), [f.format() for f in findings]
 
 
 class _FullWidthGatherEll:
@@ -752,7 +332,7 @@ class _FullWidthGatherEll:
     BEFORE the all_gather — the injected full-width regression: the
     element count is unchanged, the BYTES are back to full width, and
     the entire low-precision bandwidth win silently evaporates. Exactly
-    what the byte gate (not an element-count gate) must catch."""
+    what the byte pin (not an element-count pin) must catch."""
 
     def __init__(self, M):
         self._M = M
@@ -760,6 +340,9 @@ class _FullWidthGatherEll:
         self.dtype = M.dtype
         self.layout = M.layout
         self.comm = M.comm
+
+    def __getattr__(self, name):
+        return getattr(self._M, name)
 
     def device_arrays(self):
         return self._M.device_arrays()
@@ -790,242 +373,96 @@ class _FullWidthGatherEll:
 
 class TestMixedPrecisionVolume:
     """ISSUE 10 acceptance: halved all-gather/halo byte budgets for the
-    low-precision programs, pinned on lowered HLO; the reduce-site
-    schedules (3/2/1) survive every precision plan unchanged."""
+    low-precision programs — declared as f32/bf16 contract twins whose
+    byte budgets share one element-count constant, priced at each
+    storage width."""
 
     def test_bf16_ell_gather_bytes_halved(self, comm8):
-        n = 512
-        A = _ell_matrix(n)
-        n_pad = comm8.padded_size(n)
-        _, txt32 = _lower_cg_dtype(comm8, A, jnp.float32)
-        _, txt16 = _lower_cg_dtype(comm8, A, jnp.bfloat16)
-        by32 = all_gather_bytes(txt32)
-        by16 = all_gather_bytes(txt16)
-        # same gather SITES, exactly half the bytes at each
-        assert len(by16) == len(by32), (by16, by32)
-        assert by32 and all(v == n_pad * 4 for v in by32), by32
-        assert all(v == n_pad * 2 for v in by16), by16
+        f32 = _contract("ksp/cg/ell-jacobi/f32")
+        b16 = _contract("ksp/cg/ell-jacobi/bf16")
+        assert f32.gather_sites == b16.gather_sites is not None
+        assert f32.gather_bytes == 2 * b16.gather_bytes
+        _check(comm8, "ksp/cg/ell-jacobi/f32", "ksp/cg/ell-jacobi/bf16")
 
     def test_bf16_dia_halo_bytes_halved(self, comm8):
-        """Banded operators: the open-chain ppermute halo ships bf16
-        boundary rows — half the f32 bytes, still zero all-gathers."""
-        A = tridiag_family(512)
-        _, txt32 = _lower_cg_dtype(comm8, A, jnp.float32)
-        _, txt16 = _lower_cg_dtype(comm8, A, jnp.bfloat16)
-        assert all_gather_bytes(txt16) == []
-        p32 = collective_permute_bytes(txt32)
-        p16 = collective_permute_bytes(txt16)
-        assert len(p16) == len(p32) and p32, (p16, p32)
-        assert sum(p16) * 2 == sum(p32), (p16, p32)
+        f32 = _contract("ksp/cg/dia/f32")
+        b16 = _contract("ksp/cg/dia/bf16")
+        assert f32.ppermute_sites == b16.ppermute_sites is not None
+        assert f32.ppermute_total_bytes == 2 * b16.ppermute_total_bytes
+        assert b16.forbid_gathers
+        _check(comm8, "ksp/cg/dia/f32", "ksp/cg/dia/bf16")
 
     def test_bf16_stencil_halo_bytes_halved(self, comm8):
-        """The matrix-free stencil's z-plane halo exchange moves
-        storage-dtype planes."""
-        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
-        from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
+        f32 = _contract("ksp/cg/stencil/f32")
+        b16 = _contract("ksp/cg/stencil/bf16")
+        assert f32.ppermute_total_bytes == 2 * b16.ppermute_total_bytes
+        _check(comm8, "ksp/cg/stencil/f32", "ksp/cg/stencil/bf16")
 
-        def lower(dtype):
-            op = StencilPoisson3D(comm8, 16, 16, 16, dtype=dtype)
-            ksp = tps.KSP().create(comm8)
-            ksp.set_operators(op)
-            ksp.set_type("cg")
-            ksp.get_pc().set_type("jacobi")
-            ksp.set_up()
-            pc = ksp.get_pc()
-            prog = build_ksp_program(comm8, "cg", pc, op)
-            x, b = op.get_vecs()
-            dt = tolerance_dtype(op.dtype)
-            return prog.lower(
-                op.device_arrays(), pc.device_arrays(), b.data, x.data,
-                dt.type(1e-2), dt.type(0.0), dt.type(0.0),
-                np.int32(50)).as_text()
-
-        p32 = collective_permute_bytes(lower(jnp.float32))
-        p16 = collective_permute_bytes(lower(jnp.bfloat16))
-        assert len(p16) == len(p32) and p32, (p16, p32)
-        assert sum(p16) * 2 == sum(p32), (p16, p32)
-
-    def test_bf16_batched_gather_bytes_halved(self, comm8, monkeypatch):
-        """The k=8 block program keeps the batched contract (gather op
-        count independent of k) AND the halved per-byte width."""
-        import mpi_petsc4py_example_tpu.solvers.krylov as krylov_mod
-        from mpi_petsc4py_example_tpu.utils.dtypes import tolerance_dtype
-        monkeypatch.setenv("TPU_SOLVE_AOT", "0")
-        krylov_mod._PROGRAM_CACHE_MANY.clear()
-        n, k = 512, 8
-        A = _ell_matrix(n)
-        n_pad = comm8.padded_size(n)
-
-        def lower_many(dtype):
-            M = tps.Mat.from_scipy(comm8, A, dtype=dtype)
-            ksp = tps.KSP().create(comm8)
-            ksp.set_operators(M)
-            ksp.set_type("cg")
-            ksp.get_pc().set_type("jacobi")
-            ksp.set_up()
-            pc = ksp.get_pc()
-            prog = build_ksp_program_many(comm8, "cg", pc, M, nrhs=k)
-            Bp = comm8.put_rows(np.zeros((n, k), np.dtype(dtype)))
-            X0 = comm8.put_rows(np.zeros((n, k), np.dtype(dtype)))
-            dt = tolerance_dtype(M.dtype)
-            return prog.lower(
-                M.device_arrays(), pc.device_arrays(), Bp, X0,
-                dt.type(1e-2), dt.type(0.0), dt.type(0.0),
-                np.int32(50)).as_text()
-
-        by32 = all_gather_bytes(lower_many(jnp.float32))
-        by16 = all_gather_bytes(lower_many(jnp.bfloat16))
-        assert len(by16) == len(by32) and by32, (by16, by32)
-        assert all(v == n_pad * k * 2 for v in by16), by16
+    def test_bf16_batched_gather_bytes_halved(self, comm8):
+        f32 = _contract("ksp_many/cg/ell-jacobi/k8/f32")
+        b16 = _contract("ksp_many/cg/ell-jacobi/k8/bf16")
+        assert f32.gather_sites == b16.gather_sites is not None
+        assert f32.gather_bytes == 2 * b16.gather_bytes
+        _check(comm8, "ksp_many/cg/ell-jacobi/k8/f32",
+               "ksp_many/cg/ell-jacobi/k8/bf16")
 
     def test_reduce_site_schedules_survive_the_plan(self, comm8):
-        """Zero new psum sites under the bf16 plan: plain CG keeps 3,
-        guarded CG keeps 2, pipecg (plain AND guarded) keeps 1 — the
-        pinned 3/2/1 schedules of ISSUE 5/7, re-pinned per precision."""
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            solver_loop_reduce_sites)
-        A = _ell_matrix(512)
-        M16 = tps.Mat.from_scipy(comm8, A, dtype=jnp.bfloat16)
-        assert solver_loop_reduce_sites(
-            _lower_cg_jacobi(comm8, M16)) == 3
-        assert solver_loop_reduce_sites(
-            _lower_cg_guard(comm8, M16, rr=True)) == 2
-        assert solver_loop_reduce_sites(_lower_pipecg(comm8, M16)) == 1
-        assert solver_loop_reduce_sites(
-            _lower_pipecg(comm8, M16, guard=True, rr=True)) == 1
+        """Zero new psum sites under the bf16 plan: 3 / 2 / 1 / 1, and
+        the reduce channel stays f32 even at bf16 storage."""
+        assert _contract(
+            "ksp/cg/ell-jacobi/bf16").reduce_site_chain == (3,)
+        assert _contract(
+            "ksp/cg-guard-rr/ell/bf16").reduce_site_chain == (2,)
+        assert _contract("ksp/pipecg/ell/bf16").reduce_site_chain == (1,)
+        assert _contract(
+            "ksp/pipecg-guard-rr/ell/bf16").reduce_site_chain == (1,)
+        _check(comm8, "ksp/cg/ell-jacobi/bf16",
+               "ksp/cg-guard-rr/ell/bf16", "ksp/pipecg/ell/bf16",
+               "ksp/pipecg-guard-rr/ell/bf16")
 
     def test_injected_full_width_regression_fails_gate(self, comm8):
         """Teeth: an upcast-before-gather regression keeps the element
-        count but doubles the bytes — the byte gate must fail on it."""
-        n = 512
-        M16 = tps.Mat.from_scipy(comm8, _ell_matrix(n),
-                                 dtype=jnp.bfloat16)
-        txt = _lower_cg(comm8, _FullWidthGatherEll(M16))
-        by = all_gather_bytes(txt)
-        n_pad = comm8.padded_size(n)
-        assert by and any(v > n_pad * 2 for v in by), by
-        with pytest.raises(AssertionError):
-            assert all(v == n_pad * 2 for v in by)
-
-
-# ---------------------------------------------------------------------------
-# ISSUE 12: fused megasolve programs — doubly-nested while schedules
-# ---------------------------------------------------------------------------
-
-
-def _lower_megasolve(comm, ksp_type, pc_type="jacobi", guard=False,
-                     rr=False, nrhs=None):
-    import os
-    from mpi_petsc4py_example_tpu.resilience import abft
-    from mpi_petsc4py_example_tpu.solvers.megasolve import (
-        build_megasolve_program, build_megasolve_program_many)
-    # the AOT wrapper hides .lower(); build the raw jitted program (the
-    # TestBatchedProgramVolume discipline) — aot_on is part of the
-    # cache key, so this never pollutes the wrapped-program cache
-    prev = os.environ.get("TPU_SOLVE_AOT")
-    os.environ["TPU_SOLVE_AOT"] = "0"
-    try:
-        return _lower_megasolve_raw(comm, ksp_type, pc_type, guard, rr,
-                                    nrhs, abft, build_megasolve_program,
-                                    build_megasolve_program_many)
-    finally:
-        if prev is None:
-            os.environ.pop("TPU_SOLVE_AOT", None)
-        else:
-            os.environ["TPU_SOLVE_AOT"] = prev
-
-
-def _lower_megasolve_raw(comm, ksp_type, pc_type, guard, rr, nrhs, abft,
-                         build_megasolve_program,
-                         build_megasolve_program_many):
-    M = tps.Mat.from_scipy(comm, _ell_matrix(512))
-    ksp = tps.KSP().create(comm)
-    ksp.set_operators(M)
-    ksp.set_type(ksp_type)
-    ksp.get_pc().set_type(pc_type)
-    ksp.set_up()
-    pc = ksp.get_pc()
-    dt = np.dtype(np.float64)
-    from mpi_petsc4py_example_tpu.utils.convergence import ConvergedReason
-    scal = (dt.type(1e-10), dt.type(0.0), dt.type(1e-10), dt.type(0.0),
-            np.int32(50), np.int32(4),
-            np.int32(ConvergedReason.DIVERGED_MAX_IT))
-    cs_args = ()
-    if guard:
-        cs = abft.column_checksum(M)
-        csM = abft.pc_checksum(pc, M)
-        cs_args = tuple(comm.put_rows_many([cs, csM]))
-        scal = scal + (dt.type(256.0), np.int32(25 if rr else 0))
-    if nrhs is not None:
-        prog = build_megasolve_program_many(
-            comm, ksp_type, pc, M, None, nrhs=nrhs, abft=guard,
-            abft_pc=guard, rr=rr)
-        Bp = comm.put_rows(np.zeros((512, nrhs)))
-        X0 = comm.put_rows(np.zeros((512, nrhs)))
-        return prog.lower(M.device_arrays(), pc.device_arrays(), *cs_args,
-                          Bp, X0, *scal).as_text()
-    prog = build_megasolve_program(comm, ksp_type, pc, M, None,
-                                   abft=guard, abft_pc=guard, rr=rr)
-    x, b = M.get_vecs()
-    return prog.lower(M.device_arrays(), pc.device_arrays(), *cs_args,
-                      b.data, x.data, *scal).as_text()
+        count but doubles the bytes — the BYTE pin (TPC002) must fail
+        on it."""
+        bad = dataclasses.replace(
+            _contract("ksp/cg/ell-jacobi/bf16"),
+            build=lambda comm: lower_ksp(comm, pc_type="jacobi",
+                                         dtype=jnp.bfloat16,
+                                         wrap_op=_FullWidthGatherEll))
+        findings, _ = checker.check_contract(bad, comm8)
+        assert "TPC002" in _rules(findings), [f.format() for f in findings]
 
 
 class TestMegasolveReduceSites:
     """ISSUE 12 acceptance: the fused whole-solve programs keep the
-    UNFUSED inner schedules — 3 (classic plain) / 2 (guarded, and the
-    batched pduo plan) / 1 (pipelined) reduce sites per inner iteration
-    — pinned on the INNER while body via the nested-region-aware parser
-    (utils/hlo.nested_loop_reduce_site_chain), with the outer refinement
-    loop's own fixed cost (inner init reductions + the fp64 exit-gate
-    psum) pinned separately. Whole-body counts can't see this: the outer
-    body CONTAINS the inner loop, so the flat count is their sum."""
+    UNFUSED inner schedules — [4, 3] / [3, 2] / [4, 1] / [4, 2] chains
+    declared per contract and diffed by the nested-region-aware
+    parser."""
 
     def test_fused_inner_schedules_3_2_1(self, comm8):
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            nested_loop_reduce_site_chain)
-        # classic CG inner: 3 sites; outer = 3 init reductions + 1 gate
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "cg")) == [4, 3]
-        # guarded CG inner keeps the 2-site stacked phases; outer init
-        # is the guard's 2 stacked psums + the gate
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "cg", guard=True, rr=True)) == [3, 2]
-        # pipelined inner keeps the ONE-site contract inside the fused
-        # loop; outer = bnorm + rn0 + the lag-correcting final true
-        # norm + the exit gate
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "pipecg")) == [4, 1]
+        assert _contract("megasolve/cg").reduce_site_chain == (4, 3)
+        assert _contract(
+            "megasolve/cg-guard-rr/ell").reduce_site_chain == (3, 2)
+        assert _contract("megasolve/pipecg").reduce_site_chain == (4, 1)
+        _check(comm8, "megasolve/cg", "megasolve/cg-guard-rr/ell",
+               "megasolve/pipecg")
 
     def test_fused_batched_schedule(self, comm8):
-        """The batched fused inner keeps the 2-phase pduo plan's count
-        (the same schedule build_ksp_program_many pins), independent of
-        nrhs."""
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            nested_loop_reduce_site_chain)
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "cg", nrhs=8)) == [4, 2]
-        assert nested_loop_reduce_site_chain(
-            _lower_megasolve(comm8, "cg", nrhs=1)) == [4, 2]
+        k1 = _contract("megasolve_many/cg/k1")
+        k8 = _contract("megasolve_many/cg/k8")
+        assert k1.reduce_site_chain == k8.reduce_site_chain == (4, 2)
+        _check(comm8, "megasolve_many/cg/k1", "megasolve_many/cg/k8")
 
     def test_fused_gather_volume_unchanged(self, comm8):
-        """Collective-volume gate: every all-gather in the fused program
-        is one padded vector (the inner SpMV's x-gather) — fusion adds
-        the outer recurrence, not gather traffic."""
-        txt = _lower_megasolve(comm8, "cg")
-        vols = all_gather_volumes(txt)
-        n_pad = comm8.padded_size(512)
-        assert vols and all(v == n_pad for v in vols), (vols, n_pad)
+        assert _contract("megasolve/cg").gather_elems == contracts_mod.N
+        _check(comm8, "megasolve/cg")
 
     def test_injected_extra_psum_fails_gate(self, comm8, monkeypatch):
-        """Teeth: splitting the pipelined plan's fuse_psum seam into two
-        collectives must show up as a 2-site INNER schedule in the fused
-        program — proving the nested gate catches a regression the flat
-        count would smear into the outer total."""
+        """Teeth: splitting the pipelined plan's fuse_psum seam must
+        show up as a 2-site INNER schedule in the fused program —
+        tpscheck's chain diff catches what a flat count would smear
+        into the outer total."""
         import mpi_petsc4py_example_tpu.solvers.cg_plans as cg_plans
-        import mpi_petsc4py_example_tpu.solvers.megasolve as mega_mod
-        from mpi_petsc4py_example_tpu.utils.hlo import (
-            nested_loop_reduce_site_chain)
 
         def split_fuse(parts, psum, axis, dtype):
             parts = [jnp.asarray(q, dtype) for q in parts]
@@ -1033,12 +470,12 @@ class TestMegasolveReduceSites:
             tail = psum(jnp.stack(parts[1:]), axis)
             return jnp.concatenate([head, tail])
 
-        mega_mod._MEGASOLVE_CACHE.clear()
         monkeypatch.setattr(cg_plans, "fuse_psum", split_fuse)
-        try:
-            chain = nested_loop_reduce_site_chain(
-                _lower_megasolve(comm8, "pipecg"))
-            assert chain[1] == 2, chain
-        finally:
-            monkeypatch.undo()
-            mega_mod._MEGASOLVE_CACHE.clear()
+        findings, _ = checker.check_contract(
+            _contract("megasolve/pipecg"), comm8)
+        assert "TPC001" in _rules(findings), [f.format() for f in findings]
+
+
+class TestDonationContract:
+    def test_donated_program_keeps_its_marker(self, comm8):
+        _check(comm8, "ksp/cg/ell-donated")

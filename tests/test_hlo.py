@@ -105,6 +105,26 @@ def test_stacked_psum_with_multiple_dtypes_is_one_site():
     assert while_body_reduce_sites(text) == [1]
 
 
+def test_two_psums_printed_on_one_line_are_two_sites():
+    """Round-16 fix: the compact printer can emit TWO all_reduce defs on
+    a single source line (stacked same-site reductions of DIFFERENT
+    dtypes, where variadic stacking is illegal).  The old
+    one-increment-per-line count conflated them into one site; the
+    parser now counts distinct result defs per line."""
+    inline = ('{ ^bb0(%a: tensor<f64>, %b: tensor<f64>): '
+              '%s = stablehlo.add %a, %b : tensor<f64> '
+              'stablehlo.return %s : tensor<f64> }')
+    text = _while_program([
+        f'%r0 = "stablehlo.all_reduce"(%p0) ({inline}) : '
+        '(tensor<4xf64>) -> tensor<4xf64>  '
+        f'%r1 = "stablehlo.all_reduce"(%p1) ({inline}) : '
+        '(tensor<4xf32>) -> tensor<4xf32>',
+        'stablehlo.return %r0, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ])
+    assert while_body_reduce_sites(text) == [2]
+    assert solver_loop_reduce_sites(text) == 2
+
+
 def test_two_separate_sites_count_two():
     site = [
         '%r{i} = "stablehlo.all_reduce"(%p{i}) ({{',

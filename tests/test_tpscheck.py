@@ -1,0 +1,323 @@
+"""Tests for tools.tpscheck — the program-contract verifier.
+
+Four layers:
+
+* checker unit tests on SYNTHETIC StableHLO: a hand-built program with
+  one known metric of every kind drives ``measure()`` and each TPC rule
+  through contracts that declare the WRONG value — every rule must fire
+  on its own violation and stay silent on the truth;
+* reverse-coverage meta-tests (the TPS012/TPS014 discipline): every AOT
+  program kind has a contract, every contract kind/dep/baseline entry
+  is real — so a NEW program kind cannot ship without a declaration;
+* SARIF: a tpscheck result serializes to a schema-valid 2.1.0 log
+  (validated by the same checker the tpslint suite uses);
+* CLI: changed-files dependency selection, index-cache hits, baseline
+  drift (TPC008) and the --strict exit codes.
+
+The synthetic-text tests never lower anything; the CLI round-trip
+lowers ONE cheap contract and then rides the cache.
+"""
+
+import ast
+import dataclasses
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from mpi_petsc4py_example_tpu import contracts as registry
+from mpi_petsc4py_example_tpu.contracts import (PROGRAM_KINDS,
+                                                ProgramContract, contracts,
+                                                get_contracts)
+from tools.tpscheck import checker
+from tools.tpscheck.cli import GLOBAL_DEPS
+from tools.tpscheck.cli import main as tpscheck_main
+
+REPO = Path(__file__).resolve().parents[1]
+
+# --------------------------------------------------------------- synthetic
+#: one of everything: a donated+aliased @main, one all_gather (8xf64),
+#: one f32 halo ppermute, and a single-site f64 loop-body psum
+SYNTH = textwrap.dedent("""
+    module @jit_prog {
+      func.func public @main(%arg0: tensor<8xf64> {jax.buffer_donor = true}, \
+%arg1: tensor<8xf64> {tf.aliasing_output = 0 : i32}) -> tensor<8xf64> {
+        %g = "stablehlo.all_gather"(%arg0) <{all_gather_dim = 0 : i64}> : \
+(tensor<1xf64>) -> tensor<8xf64>
+        %p = "stablehlo.collective_permute"(%g) <{channel = 1}> : \
+(tensor<2xf32>) -> tensor<2xf32>
+        %w:2 = stablehlo.while(%iterArg = %arg0, %iterArg_0 = %c) : \
+tensor<8xf64>, tensor<i32>
+         cond {
+          %c0 = stablehlo.compare LT, %iterArg_0, %n : tensor<i1>
+          stablehlo.return %c0 : tensor<i1>
+        } do {
+          %r = "stablehlo.all_reduce"(%iterArg) ({
+            ^bb0(%a: tensor<f64>, %b: tensor<f64>):
+              %s = stablehlo.add %a, %b : tensor<f64>
+              stablehlo.return %s : tensor<f64>
+          }) : (tensor<8xf64>) -> tensor<8xf64>
+          stablehlo.return %r, %iterArg_0 : tensor<8xf64>, tensor<i32>
+        }
+        return %w#0 : tensor<8xf64>
+      }
+    }
+""").strip("\n")
+
+#: the truth about SYNTH, in measure()'s shape
+SYNTH_METRICS = {
+    "reduce_site_chain": [1],
+    "total_reduce_sites": 1,
+    "reduce_dtypes": ["f64"],
+    "gather_sites": 1,
+    "gather_elems": [8],
+    "gather_bytes": [64],
+    "ppermute_sites": 1,
+    "ppermute_total_bytes": 8,
+    "donated_args": [0],
+    "aliased_outputs": 1,
+}
+
+
+def _contract(**pins):
+    """A synthetic contract whose program IS the text above."""
+    base = dict(name="synth/prog", kind="ksp",
+                description="synthetic checker-unit contract",
+                build=lambda comm: SYNTH)
+    base.update(pins)
+    return ProgramContract(**base)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_measure_reads_every_channel():
+    assert checker.measure(SYNTH) == SYNTH_METRICS
+
+
+def test_true_declaration_is_clean():
+    c = _contract(reduce_site_chain=(1,), total_reduce_sites=1,
+                  reduce_dtypes=frozenset({"f64"}), gather_sites=1,
+                  gather_elems=8, gather_bytes=64, ppermute_sites=1,
+                  ppermute_total_bytes=8, min_donated_args=1,
+                  min_aliased_outputs=1)
+    findings, m = checker.check_contract(c, comm=None)
+    assert findings == []
+    assert m == SYNTH_METRICS
+
+
+@pytest.mark.parametrize("pins,rule", [
+    (dict(reduce_site_chain=(2,)), "TPC001"),
+    (dict(total_reduce_sites=3), "TPC007"),
+    (dict(reduce_dtypes=frozenset({"f32"})), "TPC005"),
+    (dict(gather_sites=2), "TPC003"),
+    (dict(gather_sites_max=0), "TPC003"),
+    (dict(gather_elems=4), "TPC002"),
+    (dict(gather_elems_max=4), "TPC002"),
+    (dict(gather_bytes=32), "TPC002"),
+    (dict(forbid_gathers=True), "TPC004"),
+    (dict(ppermute_sites=0), "TPC004"),
+    (dict(ppermute_sites_min=3), "TPC004"),
+    (dict(ppermute_total_bytes=16), "TPC004"),
+    (dict(min_donated_args=2), "TPC006"),
+    (dict(min_aliased_outputs=2), "TPC006"),
+])
+def test_each_rule_fires_on_its_violation(pins, rule):
+    findings, m = checker.check_contract(_contract(**pins), comm=None)
+    assert _rules(findings) == {rule}, [f.format() for f in findings]
+    assert m == SYNTH_METRICS
+    # findings anchor at the registry file with the contract named
+    assert all(f.path == checker.CONTRACTS_REL for f in findings)
+    assert all("[synth/prog]" in f.message for f in findings)
+
+
+def test_exact_elems_pin_requires_the_gather_to_exist():
+    """The old `assert vols and all(...)` shape: a program with NO
+    gathers must fail an exact element pin, not vacuously pass."""
+    gather_free = SYNTH.replace('%g = "stablehlo.all_gather"'
+                                '(%arg0) <{all_gather_dim = 0 : i64}> : '
+                                '(tensor<1xf64>) -> tensor<8xf64>',
+                                "%g = stablehlo.add %arg0, %arg0 : "
+                                "tensor<8xf64>")
+    c = _contract(build=lambda comm: gather_free, gather_elems=8)
+    findings, _ = checker.check_contract(c, comm=None)
+    assert _rules(findings) == {"TPC002"}
+
+
+def test_lowering_failure_is_a_gate_finding():
+    def boom(comm):
+        raise RuntimeError("no such program")
+
+    findings, m = checker.check_contract(_contract(build=boom), comm=None)
+    assert m is None
+    assert _rules(findings) == {checker.LOWER_ERROR}
+    assert "RuntimeError" in findings[0].message
+
+
+def test_baseline_drift_is_a_warning():
+    baseline = {"synth/prog": dict(SYNTH_METRICS, gather_bytes=[32])}
+    findings, _ = checker.check_contract(_contract(), comm=None,
+                                         baseline=baseline)
+    assert _rules(findings) == {"TPC008"}
+    assert findings[0].severity == "warn"
+    assert "gather_bytes" in findings[0].message
+    # ...and an exact baseline match is silent
+    findings, _ = checker.check_contract(
+        _contract(), comm=None, baseline={"synth/prog": SYNTH_METRICS})
+    assert findings == []
+
+
+def test_check_contracts_routes_tiers():
+    """errors <- TPC-LOWER, warnings <- TPC008, findings <- the rest."""
+    def boom(comm):
+        raise ValueError("gone")
+
+    batch = (
+        _contract(name="synth/bad-chain", reduce_site_chain=(9,)),
+        _contract(name="synth/broken", build=boom),
+        _contract(name="synth/drifted"),
+    )
+    baseline = {"synth/drifted": dict(SYNTH_METRICS, ppermute_sites=7)}
+    result = checker.check_contracts(batch, comm=None, baseline=baseline)
+    assert _rules(result.findings) == {"TPC001"}
+    assert _rules(result.errors) == {checker.LOWER_ERROR}
+    assert _rules(result.warnings) == {"TPC008"}
+    assert result.files_linted == 2          # the broken one never measured
+    assert set(result.measured) == {"synth/bad-chain", "synth/drifted"}
+    assert result.exit_code(strict=False) == 1
+    assert result.exit_code(strict=True, warn_budget=1) == 1
+
+
+# ---------------------------------------------------------- reverse coverage
+def test_every_program_kind_has_a_contract():
+    """The TPS012/TPS014 discipline: the AOT program-kind vocabulary is
+    the coverage floor — a new kind cannot ship uncontracted."""
+    covered = {c.kind for c in contracts()}
+    assert covered == set(PROGRAM_KINDS)
+
+
+def test_every_contract_kind_is_a_known_kind():
+    for c in contracts():
+        assert c.kind in PROGRAM_KINDS, c.name
+
+
+def test_program_kinds_match_the_solver_sources():
+    """Every kind literal actually appears in the solvers package (the
+    aot.wrap first-arg / dispatch-telemetry spellings) — the registry
+    vocabulary cannot drift from the code."""
+    seen = set()
+    for path in (REPO / "mpi_petsc4py_example_tpu" / "solvers").glob("*.py"):
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                seen.add(node.value)
+    missing = set(PROGRAM_KINDS) - seen
+    assert not missing, (f"program kind(s) {sorted(missing)} not found as "
+                         "string literals in the solvers package")
+
+
+def test_contract_names_unique_and_deps_exist():
+    names = [c.name for c in contracts()]
+    assert len(names) == len(set(names))
+    for c in contracts():
+        assert c.deps, f"{c.name} declares no dependency modules"
+        for dep in c.deps:
+            assert (REPO / dep).is_file(), f"{c.name}: missing dep {dep}"
+    for dep in GLOBAL_DEPS:
+        assert (REPO / dep).is_file()
+
+
+def test_baseline_covers_the_registry_exactly():
+    """Committed drift baseline <-> registry, both directions: every
+    contract has a snapshot, no orphan snapshots linger."""
+    baseline = checker.load_baseline()
+    assert set(baseline) == {c.name for c in contracts()}
+    for name, entry in baseline.items():
+        assert set(entry) == set(SYNTH_METRICS), name
+
+
+def test_get_contracts_rejects_unknown_names():
+    with pytest.raises(KeyError):
+        get_contracts(names=["no/such/contract"])
+
+
+# -------------------------------------------------------------------- SARIF
+def test_findings_serialize_to_valid_sarif(tmp_path):
+    from test_tpslint import _validate_sarif_210
+
+    from tools.tpslint.sarif import to_sarif
+    batch = (_contract(name="synth/bad-chain", reduce_site_chain=(9,)),
+             _contract(name="synth/drifted"))
+    baseline = {"synth/drifted": dict(SYNTH_METRICS, gather_sites=5)}
+    result = checker.check_contracts(batch, comm=None, baseline=baseline)
+    doc = to_sarif(result, checker.RULES, base_dir=str(REPO))
+    _validate_sarif_210(doc)
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(checker.RULES) <= rule_ids
+    levels = {r["level"] for r in run["results"]}
+    assert levels == {"error", "warning"}
+
+
+# ---------------------------------------------------------------------- CLI
+def test_cli_unknown_kind_exits_2():
+    assert tpscheck_main(["--kinds", "nope"]) == 2
+
+
+def test_cli_unknown_select_exits_2():
+    assert tpscheck_main(["--select", "no/such/contract"]) == 2
+
+
+def test_cli_list_contracts(capsys):
+    assert tpscheck_main(["--list-contracts"]) == 0
+    out = capsys.readouterr().out
+    assert "ksp/cg/ell" in out and "megasolve/cg" in out
+
+
+def test_cli_changed_files_selects_by_dependency(capsys, tmp_path):
+    """A serving-tier change touches no contract: clean exit without a
+    single lowering, and the SARIF log is a valid empty run."""
+    from test_tpslint import _validate_sarif_210
+    sarif = tmp_path / "contracts.sarif"
+    code = tpscheck_main([
+        "--changed-files", "mpi_petsc4py_example_tpu/serving/server.py",
+        "--sarif", str(sarif)])
+    assert code == 0
+    assert "no contract depends" in capsys.readouterr().err
+    doc = json.loads(sarif.read_text())
+    _validate_sarif_210(doc)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_cache_baseline_roundtrip(comm8, tmp_path, capsys):
+    """One real lowering, then: cache hit, baseline update, injected
+    baseline drift -> TPC008 warn -> --strict failure."""
+    cache = tmp_path / "contracts.json"
+    baseline = tmp_path / "baseline.json"
+    sel = ["--select", "ksp/cg/ell", "--index-cache", str(cache)]
+
+    # cold: lowers once, caches, snapshots the baseline
+    assert tpscheck_main(sel + ["--baseline", str(baseline),
+                                "--update-baseline"]) == 0
+    capsys.readouterr()
+    entry = json.loads(cache.read_text())["ksp/cg/ell"]
+    assert entry["measured"]["gather_sites"] == 2
+    snap = json.loads(baseline.read_text())
+    assert set(snap) == {"ksp/cg/ell"}
+
+    # warm: same key -> no lowering, clean against its own snapshot
+    assert tpscheck_main(sel + ["--baseline", str(baseline)]) == 0
+    assert "1 cached" in capsys.readouterr().err
+
+    # drift an UNPINNED metric in the snapshot: warn tier -> exit 0
+    # loose, nonzero under --strict
+    snap["ksp/cg/ell"]["ppermute_sites"] = 9
+    baseline.write_text(json.dumps(snap))
+    assert tpscheck_main(sel + ["--baseline", str(baseline)]) == 0
+    out = capsys.readouterr()
+    assert "TPC008" in out.out
+    assert tpscheck_main(sel + ["--baseline", str(baseline),
+                                "--strict"]) == 1

@@ -1,0 +1,12 @@
+"""tpscheck — the lowered-StableHLO program-contract verifier.
+
+The second static-analysis backend (round 16): where ``tools/tpslint``
+reads Python ASTs, tpscheck lowers every program class registered in
+``mpi_petsc4py_example_tpu/contracts.py`` over a small host device grid,
+parses the StableHLO with ``mpi_petsc4py_example_tpu/utils/hlo.py``, and
+diffs the observed communication schedule against the declared contract
+— reduce-site chains, collective byte budgets, gather-op counts,
+reduce-channel dtypes, donation markers. Findings ride the tpslint
+``Finding``/SARIF pipeline, so CI annotations and ``--strict`` gating
+work identically across both backends.
+"""

@@ -1,0 +1,332 @@
+"""tpscheck core: lower each registered contract, measure, diff.
+
+Check rules (the TPC numbering space is disjoint from tpslint's TPS so
+one SARIF run can carry both):
+
+* TPC001 — reduce-site chain: per-depth own ``all_reduce`` counts along
+  the largest while chain differ from the declared schedule;
+* TPC002 — gather volume: an ``all_gather`` site's element/byte volume
+  is off budget (replication or full-width regressions);
+* TPC003 — gather site count: the ``all_gather`` op count drifted (the
+  k-independence and per-iteration-site pins);
+* TPC004 — channel shape: a gather appeared in a gather-free (banded)
+  program, or the ppermute halo sites/bytes are off;
+* TPC005 — reduce dtype: an ``all_reduce`` result dtype left the
+  declared reduce channel;
+* TPC006 — donation: the donated-argument/alias markers are missing;
+* TPC007 — total reduce sites: the whole-program ``all_reduce`` count
+  drifted (the absolute form of guarded-vs-plain / rr-on-off pins);
+* TPC008 — baseline drift: an UNPINNED measured metric changed vs the
+  committed ``baseline.json`` (run ``tpscheck --update-baseline`` after
+  auditing the change);
+* TPC-LOWER — the contract's program failed to lower at all.
+
+Findings anchor at the contract's ``name="..."`` line in
+``contracts.py`` — the file a reviewer edits to change the declaration.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from tools.tpslint.engine import AnalysisResult
+from tools.tpslint.findings import Finding
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+CONTRACTS_REL = "mpi_petsc4py_example_tpu/contracts.py"
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+LOWER_ERROR = "TPC-LOWER"
+
+
+@dataclass(frozen=True)
+class CheckRule:
+    """SARIF-compatible rule descriptor (same attribute shape the
+    tpslint registry exposes to ``tools.tpslint.sarif``)."""
+
+    id: str
+    name: str
+    description: str
+    severity: str = "error"
+
+
+_RULES = (
+    CheckRule("TPC001", "reduce-site-chain",
+              "per-depth own all_reduce counts of the lowered program "
+              "must match the contract's declared schedule"),
+    CheckRule("TPC002", "gather-volume",
+              "every all_gather site's element/byte volume must match "
+              "the contract's budget (a larger gather is replication; "
+              "same elems at more bytes is a full-width upcast)"),
+    CheckRule("TPC003", "gather-site-count",
+              "the all_gather op count must match the declaration — "
+              "batched programs must not grow sites with the RHS block "
+              "width"),
+    CheckRule("TPC004", "channel-shape",
+              "gather-free (banded/stencil) programs must stay "
+              "gather-free, and the ppermute halo site count / byte "
+              "total must match the declaration"),
+    CheckRule("TPC005", "reduce-dtype",
+              "all_reduce result dtypes must stay inside the declared "
+              "reduce channel (a silently narrowed exit-gate psum "
+              "changes convergence semantics)"),
+    CheckRule("TPC006", "donation",
+              "donated programs must carry the declared buffer-donor / "
+              "aliasing markers (a pruned donation doubles solve "
+              "residency)"),
+    CheckRule("TPC007", "total-reduce-sites",
+              "the whole-program all_reduce count must match the "
+              "declaration (init + loop + epilogue)"),
+    CheckRule("TPC008", "baseline-drift",
+              "a measured metric not pinned by the contract changed "
+              "against the committed baseline — audit, then "
+              "`tpscheck --update-baseline`", "warn"),
+)
+
+#: rule registry in the shape ``tools.tpslint.sarif.to_sarif`` expects
+RULES = {r.id: r for r in _RULES}
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def measure(stablehlo_text: str) -> dict:
+    """The full observed metric set of one lowered program — the shape
+    both the contract diff and the committed baseline use."""
+    from mpi_petsc4py_example_tpu.utils import hlo
+    gathers = hlo.collective_sites(stablehlo_text, "all_gather")
+    perms = hlo.collective_sites(stablehlo_text, "collective_permute")
+    reduce_dtypes = hlo.reduce_site_dtypes(stablehlo_text)
+    return {
+        "reduce_site_chain": list(
+            hlo.nested_loop_reduce_site_chain(stablehlo_text)),
+        "total_reduce_sites": len(reduce_dtypes),
+        "reduce_dtypes": sorted({e for t in reduce_dtypes for e in t}),
+        "gather_sites": len(gathers),
+        "gather_elems": sorted({s.elems for s in gathers}),
+        "gather_bytes": sorted({s.bytes for s in gathers}),
+        "ppermute_sites": len(perms),
+        "ppermute_total_bytes": sum(s.bytes for s in perms),
+        "donated_args": list(hlo.donated_args(stablehlo_text)),
+        "aliased_outputs": len(
+            hlo.input_output_aliases(stablehlo_text)),
+    }
+
+
+@functools.lru_cache(maxsize=1)
+def _contract_lines() -> dict:
+    """``contract name -> 1-based line`` of its ``name="..."`` literal
+    in contracts.py, so findings anchor where the declaration lives."""
+    out = {}
+    try:
+        src = (REPO_ROOT / CONTRACTS_REL).read_text(encoding="utf-8")
+    except OSError:
+        return out
+    for i, line in enumerate(src.splitlines(), 1):
+        m = re.search(r"name=\"([^\"]+)\"", line)
+        if m and m.group(1) not in out:
+            out[m.group(1)] = i
+    return out
+
+
+def _finding(rule_id: str, contract, message: str,
+             severity: str | None = None) -> Finding:
+    sev = severity or RULES.get(rule_id, CheckRule("", "", "")).severity
+    return Finding(rule=rule_id,
+                   message=f"[{contract.name}] {message}",
+                   line=_contract_lines().get(contract.name, 1),
+                   col=0, path=CONTRACTS_REL,
+                   severity=sev or "error")
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+
+
+def _diff(contract, m: dict):
+    """Yield findings for every declared expectation the measured
+    metrics ``m`` violate."""
+    c = contract
+    if (c.reduce_site_chain is not None
+            and tuple(m["reduce_site_chain"]) != tuple(
+                c.reduce_site_chain)):
+        yield _finding(
+            "TPC001", c,
+            f"reduce-site chain {m['reduce_site_chain']} != declared "
+            f"{list(c.reduce_site_chain)} — the per-iteration psum "
+            "schedule changed")
+    if (c.total_reduce_sites is not None
+            and m["total_reduce_sites"] != c.total_reduce_sites):
+        yield _finding(
+            "TPC007", c,
+            f"whole-program all_reduce count {m['total_reduce_sites']} "
+            f"!= declared {c.total_reduce_sites}")
+    if c.reduce_dtypes is not None:
+        extra = set(m["reduce_dtypes"]) - set(c.reduce_dtypes)
+        if extra:
+            yield _finding(
+                "TPC005", c,
+                f"all_reduce result dtype(s) {sorted(extra)} outside "
+                f"the declared reduce channel "
+                f"{sorted(c.reduce_dtypes)}")
+    # --- gather channel ---
+    if c.forbid_gathers and m["gather_sites"]:
+        yield _finding(
+            "TPC004", c,
+            f"{m['gather_sites']} all_gather site(s) in a declared "
+            "gather-free program (the halo-exchange VecScatter must "
+            "carry the whole traffic)")
+    if c.gather_sites is not None and m["gather_sites"] != c.gather_sites:
+        yield _finding(
+            "TPC003", c,
+            f"all_gather op count {m['gather_sites']} != declared "
+            f"{c.gather_sites}")
+    if (c.gather_sites_max is not None
+            and m["gather_sites"] > c.gather_sites_max):
+        yield _finding(
+            "TPC003", c,
+            f"all_gather op count {m['gather_sites']} exceeds the "
+            f"declared maximum {c.gather_sites_max}")
+    if c.gather_elems is not None:
+        bad = [v for v in m["gather_elems"] if v != c.gather_elems]
+        if bad or not m["gather_elems"]:
+            # an exact-elems pin implies the gather must EXIST — the
+            # old `assert vols and all(v == n_pad ...)` shape
+            yield _finding(
+                "TPC002", c,
+                f"all_gather element volumes {m['gather_elems']} != "
+                f"declared {c.gather_elems} per site")
+    if c.gather_elems_max is not None:
+        bad = [v for v in m["gather_elems"] if v > c.gather_elems_max]
+        if bad:
+            yield _finding(
+                "TPC002", c,
+                f"all_gather element volume(s) {bad} exceed the "
+                f"declared maximum {c.gather_elems_max} (a gather "
+                "larger than one padded vector is replication)")
+    if c.gather_bytes is not None:
+        bad = [v for v in m["gather_bytes"] if v != c.gather_bytes]
+        if bad:
+            yield _finding(
+                "TPC002", c,
+                f"all_gather byte volumes {m['gather_bytes']} != "
+                f"declared {c.gather_bytes} per site — same elements "
+                "at more bytes is the full-width-upcast regression")
+    # --- halo channel ---
+    if (c.ppermute_sites is not None
+            and m["ppermute_sites"] != c.ppermute_sites):
+        yield _finding(
+            "TPC004", c,
+            f"collective_permute site count {m['ppermute_sites']} != "
+            f"declared {c.ppermute_sites}")
+    if (c.ppermute_sites_min is not None
+            and m["ppermute_sites"] < c.ppermute_sites_min):
+        yield _finding(
+            "TPC004", c,
+            f"collective_permute site count {m['ppermute_sites']} "
+            f"below the declared minimum {c.ppermute_sites_min} — the "
+            "halo exchange is missing")
+    if (c.ppermute_total_bytes is not None
+            and m["ppermute_total_bytes"] != c.ppermute_total_bytes):
+        yield _finding(
+            "TPC004", c,
+            f"collective_permute total bytes "
+            f"{m['ppermute_total_bytes']} != declared "
+            f"{c.ppermute_total_bytes} (the storage-width halo "
+            "budget)")
+    # --- donation ---
+    if (c.min_donated_args is not None
+            and len(m["donated_args"]) < c.min_donated_args):
+        yield _finding(
+            "TPC006", c,
+            f"{len(m['donated_args'])} buffer-donor argument(s) < "
+            f"declared minimum {c.min_donated_args} — the donation "
+            "was pruned or dropped")
+    if (c.min_aliased_outputs is not None
+            and m["aliased_outputs"] < c.min_aliased_outputs):
+        yield _finding(
+            "TPC006", c,
+            f"{m['aliased_outputs']} committed input/output alias(es) "
+            f"< declared minimum {c.min_aliased_outputs}")
+
+
+def _baseline_drift(contract, m: dict, baseline: dict):
+    entry = baseline.get(contract.name)
+    if entry is None:
+        return
+    changed = sorted(k for k in entry if m.get(k) != entry[k])
+    if changed:
+        yield _finding(
+            "TPC008", contract,
+            f"unpinned metric(s) drifted vs the committed baseline: "
+            + ", ".join(f"{k}: {entry[k]!r} -> {m.get(k)!r}"
+                        for k in changed)
+            + " — audit, then run `tpscheck --update-baseline`")
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path=BASELINE_PATH) -> dict:
+    """The committed observed-metrics snapshot; empty when absent (a
+    fresh checkout before the first --update-baseline)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def check_contract(contract, comm, baseline=None):
+    """Lower one contract and return ``(findings, measured_or_None)``.
+
+    A lowering failure is itself a finding (TPC-LOWER) — a contract
+    whose program no longer builds must fail the gate, not vanish
+    from it.
+    """
+    try:
+        text = contract.build(comm)
+    # tpslint: disable=TPS005 — ANY build failure must surface as a
+    # TPC-LOWER gate finding (with the exception type in the message),
+    # never escape the checker and take the whole run down with it
+    except Exception as exc:   # noqa: BLE001
+        msg = f"{type(exc).__name__}: {exc}"
+        return [_finding(LOWER_ERROR, contract,
+                         f"program failed to lower: {msg[:500]}",
+                         severity="error")], None
+    m = measure(text)
+    findings = list(_diff(contract, m))
+    if baseline:
+        findings.extend(_baseline_drift(contract, m, baseline))
+    return findings, m
+
+
+def check_contracts(contracts, comm, baseline=None) -> AnalysisResult:
+    """Check a contract collection into a tpslint-shaped
+    :class:`AnalysisResult` (so ``--strict`` semantics, SARIF emission
+    and exit codes are shared with the AST backend). The measured
+    metrics of every successfully lowered contract land in
+    ``result.measured`` for baseline writing."""
+    result = AnalysisResult()
+    result.measured = {}
+    for contract in contracts:
+        findings, m = check_contract(contract, comm, baseline=baseline)
+        if m is not None:
+            result.measured[contract.name] = m
+            result.files_linted += 1
+        for f in findings:
+            if f.rule == LOWER_ERROR:
+                result.errors.append(f)
+            elif f.severity == "warn":
+                result.warnings.append(f)
+            else:
+                result.findings.append(f)
+    return result

@@ -1,0 +1,256 @@
+"""``tpscheck`` console entry point.
+
+Usage::
+
+    tpscheck                         # check every registered contract
+    tpscheck --strict --sarif contracts.sarif
+    tpscheck --select megasolve/cg,ksp/pipecg/ell
+    tpscheck --kinds ksp_many,megasolve
+    tpscheck --changed-files $(git diff --name-only base... -- '*.py')
+    tpscheck --index-cache .tpslint-cache/contracts.json
+    tpscheck --update-baseline       # snapshot observed metrics
+    tpscheck --list-contracts
+
+Lowers each registered program class (``mpi_petsc4py_example_tpu/
+contracts.py``) over 8 forced host CPU devices, measures the
+communication schedule from the StableHLO, and diffs it against the
+declaration.  ``--changed-files`` re-checks only contracts whose
+declared dependency modules (or the registry/parser/checker themselves)
+changed; ``--index-cache`` persists measured metrics keyed on a
+dependency content hash — the tpslint index-cache discipline applied to
+lowerings, so an unchanged contract costs a hash, not a trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+#: sources that invalidate EVERY contract when they change — the
+#: registry, the HLO parser, and the checker itself
+GLOBAL_DEPS = (
+    "mpi_petsc4py_example_tpu/contracts.py",
+    "mpi_petsc4py_example_tpu/utils/hlo.py",
+    "tools/tpscheck/checker.py",
+)
+
+
+def _bootstrap_env():
+    """Force the 8-device host platform BEFORE jax initializes — the
+    grid every contract's budgets are declared against."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = f"{xf} {flag}".strip()
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpscheck",
+        description=("program-contract verifier: lowers every "
+                     "registered solver program class to StableHLO and "
+                     "diffs its communication schedule against the "
+                     "declarative contract registry"))
+    p.add_argument("--strict", action="store_true",
+                   help="fail on baseline drift too (warn-tier "
+                        "findings behave as under --warn-budget 0)")
+    p.add_argument("--warn-budget", type=int, default=None, metavar="N",
+                   help="fail when warn-tier findings (baseline drift) "
+                        "exceed N")
+    p.add_argument("--select", default=None, metavar="NAME,NAME",
+                   help="comma-separated contract names to check")
+    p.add_argument("--kinds", default=None, metavar="KIND,KIND",
+                   help="comma-separated program kinds to check")
+    p.add_argument("--changed-files", nargs="+", default=None,
+                   metavar="PATH",
+                   help="check only contracts whose declared dependency "
+                        "modules intersect these files (registry/parser"
+                        "/checker changes re-check everything)")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write findings as a SARIF 2.1.0 log")
+    p.add_argument("--index-cache", default=None, metavar="PATH",
+                   help="JSON cache of measured metrics keyed on a "
+                        "dependency content hash; an unchanged "
+                        "contract skips its lowering")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="drift baseline to compare against (default: "
+                        "the committed tools/tpscheck/baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write the observed metrics of the checked "
+                        "contracts into the baseline and exit by the "
+                        "contract findings alone")
+    p.add_argument("--list-contracts", action="store_true",
+                   help="print the contract table and exit")
+    return p
+
+
+def _repo_rel(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    return rel.replace(os.sep, "/")
+
+
+def _dep_files(contract) -> tuple:
+    return tuple(contract.deps) + GLOBAL_DEPS
+
+
+def _dep_hash(contract, root: str) -> str:
+    h = hashlib.sha256()
+    for rel in sorted(set(_dep_files(contract))):
+        h.update(rel.encode())
+        try:
+            with open(os.path.join(root, rel), "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"<missing>")
+    h.update(".".join(map(str, sys.version_info[:2])).encode())
+    return h.hexdigest()
+
+
+def _load_cache(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_cache(path, cache):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(cache, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    _bootstrap_env()
+
+    from tools.tpscheck import checker
+    root = str(checker.REPO_ROOT)
+
+    from mpi_petsc4py_example_tpu import contracts as registry
+
+    if args.list_contracts:
+        for c in registry.contracts():
+            print(f"{c.name}  [{c.kind}]")
+            print(f"        {c.description}")
+        return 0
+
+    names = kinds = None
+    if args.select:
+        names = [s.strip() for s in args.select.split(",") if s.strip()]
+    if args.kinds:
+        kinds = [s.strip() for s in args.kinds.split(",") if s.strip()]
+        unknown = set(kinds) - set(registry.PROGRAM_KINDS)
+        if unknown:
+            print(f"tpscheck: error: unknown kind(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+    try:
+        selected = registry.get_contracts(names=names, kinds=kinds)
+    except KeyError as exc:
+        print(f"tpscheck: error: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    # ---- changed-files scope: dependency-driven selection ----
+    if args.changed_files is not None:
+        changed = {_repo_rel(p, root) for p in args.changed_files}
+        if changed & set(GLOBAL_DEPS) or any(
+                c.startswith("tools/tpscheck/") for c in changed):
+            pass        # registry/parser/checker changed: keep them all
+        else:
+            selected = tuple(c for c in selected
+                             if set(c.deps) & changed)
+        if not selected:
+            print("tpscheck: clean (no contract depends on the changed "
+                  "files)", file=sys.stderr)
+            if args.sarif:
+                from tools.tpslint.engine import AnalysisResult
+                from tools.tpslint.sarif import write_sarif
+                write_sarif(args.sarif, AnalysisResult(), checker.RULES,
+                            base_dir=root)
+            return 0
+
+    baseline = {}
+    if not args.update_baseline:
+        baseline = checker.load_baseline(
+            args.baseline or checker.BASELINE_PATH)
+
+    cache = _load_cache(args.index_cache) if args.index_cache else {}
+
+    # ---- check: cached measurements skip their lowering ----
+    from tools.tpslint.engine import AnalysisResult
+    result = AnalysisResult()
+    result.measured = {}
+    comm = None
+    hits = 0
+    for contract in selected:
+        key = _dep_hash(contract, root)
+        entry = cache.get(contract.name)
+        if entry is not None and entry.get("key") == key:
+            m = entry["measured"]
+            findings = list(checker._diff(contract, m))
+            if baseline:
+                findings.extend(
+                    checker._baseline_drift(contract, m, baseline))
+            hits += 1
+        else:
+            if comm is None:
+                import mpi_petsc4py_example_tpu as tps
+                comm = tps.DeviceComm()
+            findings, m = checker.check_contract(contract, comm,
+                                                 baseline=baseline)
+        if m is not None:
+            result.measured[contract.name] = m
+            result.files_linted += 1
+            cache[contract.name] = {"key": key, "measured": m}
+        for f in findings:
+            if f.rule == checker.LOWER_ERROR:
+                result.errors.append(f)
+            elif f.severity == "warn":
+                result.warnings.append(f)
+            else:
+                result.findings.append(f)
+
+    if args.index_cache:
+        _save_cache(args.index_cache, cache)
+
+    if args.update_baseline:
+        path = args.baseline or checker.BASELINE_PATH
+        merged = checker.load_baseline(path)
+        merged.update(result.measured)
+        _save_cache(str(path), merged)
+        print(f"tpscheck: baseline updated "
+              f"({len(result.measured)} contract(s))", file=sys.stderr)
+
+    if args.sarif:
+        from tools.tpslint.sarif import write_sarif
+        write_sarif(args.sarif, result, checker.RULES, base_dir=root)
+
+    for f in result.errors + result.findings + result.warnings:
+        print(f.format())
+
+    n = len(result.findings) + len(result.errors)
+    nw = len(result.warnings)
+    warn_budget = args.warn_budget
+    if args.strict and warn_budget is None:
+        warn_budget = 0
+    code = result.exit_code(strict=args.strict, warn_budget=warn_budget)
+    cached = f", {hits} cached" if hits else ""
+    if n or nw:
+        print(f"tpscheck: {n} finding(s), {nw} drift warning(s) over "
+              f"{result.files_linted} contract(s){cached}",
+              file=sys.stderr)
+    else:
+        print(f"tpscheck: clean ({result.files_linted} contract(s)"
+              f"{cached})", file=sys.stderr)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""TPS016 — lock-order and dispatcher-thread shared-state discipline.
+
+The serving tier is the one place the repo runs real threads: the
+server's dispatcher loop (serving/server.py), the fleet router's
+migration path (serving/fleet.py), and whatever the elastic-mesh
+helpers grow next.  Two invariants keep it deadlock- and race-free, and
+both are stated only in comments today (fleet.py: "Order: _move_lock
+before _lock, never the reverse"):
+
+* **Lock order** — when two of a class's locks nest, they must nest in
+  ONE direction everywhere.  The rule collects the class's lock
+  attributes (``self.x = threading.Lock()/RLock()/Condition()``), reads
+  every syntactic ``with self.x:`` nesting (including the item order of
+  ``with self.a, self.b:``), lets the FIRST nesting seen in source
+  order establish the partial order, and flags any later acquisition
+  that contradicts it — the classic ABBA deadlock shape.
+* **Thread shared state** — a method a ``threading.Thread(target=
+  self._loop)`` runs concurrently with the public API.  A field the
+  class elsewhere touches under one of its locks is evidently
+  lock-protected; a bare ``self.field = ...`` write to it inside the
+  thread body is a race (the dispatcher publishing state the submit
+  path reads under the condition variable).
+
+Both checks are lexical and per-class: nesting through a method call
+(``with self._session_lock: self._dispatch(...)`` where the callee
+takes ``self._cv``) is invisible, as is a lock passed between objects —
+conservative by design, like TPS008's dynamic-callee silence.  Error
+tier: a finding is either a deadlock waiting for the right interleaving
+or a torn read.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES, terminal_name
+from .base import Rule, register
+
+#: constructors whose product participates in ``with`` lock discipline
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+
+
+def _self_attr(node) -> str | None:
+    """``X`` for an ``self.X`` expression, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _assign_target_attr(target) -> str | None:
+    """The ``self.X`` base of an assignment target, unwrapping
+    subscripts (``self._stats["expired"] += 1`` writes ``_stats``)."""
+    while isinstance(target, ast.Subscript):
+        target = target.value
+    return _self_attr(target)
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set:
+    """Attributes assigned from a threading lock constructor anywhere in
+    the class body (canonically ``__init__``)."""
+    out = set()
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+            continue
+        if terminal_name(n.value.func) in _LOCK_CTORS:
+            for t in n.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _thread_targets(cls: ast.ClassDef) -> set:
+    """Method names passed as ``threading.Thread(target=self.X)`` — the
+    class's concurrent entry points."""
+    out = set()
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Call)
+                and terminal_name(n.func) == "Thread"):
+            continue
+        for kw in n.keywords:
+            if kw.arg == "target":
+                attr = _self_attr(kw.value)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _class_methods(cls: ast.ClassDef):
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt
+
+
+def _walk_withs(func, locks):
+    """Yield ``(held_tuple, lock_name, item_node)`` for every lock
+    acquisition in ``func``, with the stack of locks already held at
+    that point — syntactic nesting plus same-``with`` item order."""
+
+    def visit(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNCTION_NODES + (ast.ClassDef,)):
+                continue                       # separate execution context
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in child.items:
+                    name = _self_attr(item.context_expr)
+                    if name is not None and name in locks:
+                        yield tuple(inner), name, item.context_expr
+                        inner.append(name)
+                yield from visit(child, inner)
+            else:
+                yield from visit(child, held)
+
+    yield from visit(func, [])
+
+
+def _locked_accesses(cls: ast.ClassDef, locks) -> set:
+    """Every ``self.X`` attribute touched inside a ``with self.<lock>:``
+    block anywhere in the class — the evidently lock-protected fields."""
+    protected = set()
+    for func in _class_methods(cls):
+        for node in ast.walk(func):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_self_attr(i.context_expr) in locks
+                       for i in node.items):
+                continue
+            for sub in ast.walk(node):
+                attr = _self_attr(sub)
+                if attr is not None and attr not in locks:
+                    protected.add(attr)
+    return protected
+
+
+def _unlocked_writes(func, locks):
+    """``(attr, node)`` for every ``self.X`` write in ``func`` made with
+    NO class lock held (lexically)."""
+
+    def visit(node, depth):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNCTION_NODES + (ast.ClassDef,)):
+                continue
+            d = depth
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                if any(_self_attr(i.context_expr) in locks
+                       for i in child.items):
+                    d = depth + 1
+            elif depth == 0:
+                targets = []
+                if isinstance(child, ast.Assign):
+                    targets = child.targets
+                elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [child.target]
+                for t in targets:
+                    attr = _assign_target_attr(t)
+                    if attr is not None:
+                        yield attr, child
+            yield from visit(child, d)
+
+    yield from visit(func, 0)
+
+
+@register
+class LockOrderRule(Rule):
+    id = "TPS016"
+    name = "lock-order"
+    description = ("serving-tier thread discipline: every pair of a "
+                   "class's locks must nest in one direction only, and "
+                   "a Thread-target body must not write lock-protected "
+                   "fields bare")
+    severity = "error"
+
+    def check(self, module):
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(cls)
+
+    # ------------------------------------------------------------ lock order
+    def _check_class(self, cls):
+        locks = _lock_attrs(cls)
+        if not locks:
+            return
+        # established partial order: edge a -> b means "a held while
+        # acquiring b"; first sighting (source order) wins, recorded
+        # with its location so the inversion message can cite it
+        order: dict = {}
+        for func in _class_methods(cls):
+            for held, name, node in _walk_withs(func, locks):
+                for outer in held:
+                    if outer == name:
+                        continue              # RLock re-entry: not an edge
+                    if self._reaches(order, name, outer):
+                        first = order[(name, outer)] if (name, outer) \
+                            in order else None
+                        where = (f" (order established at line "
+                                 f"{first.lineno})") if first is not None \
+                            else " (by a chain of earlier nestings)"
+                        yield self.finding(
+                            node,
+                            f"lock-order inversion in {cls.name}: "
+                            f"self.{name} acquired while holding "
+                            f"self.{outer}, but the established order "
+                            f"is self.{name} before "
+                            f"self.{outer}{where} — an ABBA deadlock "
+                            f"under the right interleaving")
+                    else:
+                        order.setdefault((outer, name), node)
+        yield from self._check_thread_writes(cls, locks)
+
+    @staticmethod
+    def _reaches(order, src, dst) -> bool:
+        """Is there a path src -> ... -> dst in the established order?"""
+        seen, stack = set(), [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(b for (a, b) in order if a == cur)
+        return False
+
+    # --------------------------------------------------- thread shared state
+    def _check_thread_writes(self, cls, locks):
+        bodies = _thread_targets(cls)
+        if not bodies:
+            return
+        protected = _locked_accesses(cls, locks)
+        for func in _class_methods(cls):
+            if func.name not in bodies:
+                continue
+            for attr, node in _unlocked_writes(func, locks):
+                if attr in protected and attr not in locks:
+                    yield self.finding(
+                        node,
+                        f"thread-body write without a lock: "
+                        f"{cls.name}.{func.name} runs on its own "
+                        f"thread and assigns self.{attr} bare, but "
+                        f"self.{attr} is accessed under a lock "
+                        f"elsewhere in the class — take the lock "
+                        f"around the write")

@@ -1,0 +1,202 @@
+"""TPS017 — precision-channel mixing advisory (warn tier).
+
+A :class:`~mpi_petsc4py_example_tpu.solvers.cg_plans.PrecisionPlan`
+splits a solve into two dtype channels: ``storage`` (what the iterate
+vectors, gathers and halos move — bf16 under the mixed plans) and
+``reduce`` (the dot-product/norm/ABFT accumulation channel, kept
+wider).  The channel boundary is crossed ONLY through the plan's own
+hooks — ``plan.up(v)`` lifts into the reduce channel, ``plan.store(v)``
+casts back — so the lowered program's reduce-channel dtype is exactly
+what the plan declares (the property the TPC005 contract pin and the
+collective-byte budgets rest on).
+
+This rule flags arithmetic that mixes a storage-channel value into a
+reduce-channel value DIRECTLY: ``ru + p`` where ``ru = up(r)`` and
+``p = plan.store(p0)`` promotes through jnp's implicit type promotion
+instead of the plan — the result dtype is whatever the promotion
+lattice says, not what the plan declares, and the drift surfaces three
+layers up as a contract/volume-gate failure.  The fix is always to
+route the operand through the plan (``up(p)``, or move the mix inside
+the ``store(...)`` argument, where the cast-back makes the promotion
+intentional — that spelling is exempt).
+
+Value provenance is one assignment deep (names assigned from
+``up(...)``/``store(...)``/``.astype(plan.storage)`` calls, including
+the ``_up = prec.up`` aliasing idiom and tuple-unpacked casts); plan
+objects are recognized by TPS004's ``_PLAN_FUNCS`` constructor set
+plus the canonical ``prec``/``plan`` parameter names.  Deeper flow is
+invisible — conservative, like TPS008.  Advisory tier: uniform-
+precision plans make every hook the identity, so a flagged mix is only
+WRONG under a mixed plan the call site may never see; the warn budget
+makes each one a conscious choice.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES
+from .base import Rule, register
+from .tps004_dtype_drift import _PLAN_FUNCS
+
+#: canonical plan-object parameter spellings in the solver kernels
+_PLAN_PARAM_NAMES = frozenset({"prec", "plan", "pplan", "precision"})
+
+_CHANNEL_BY_HOOK = {"up": "reduce", "store": "storage"}
+_CHANNEL_BY_ATTR = {"reduce": "reduce", "storage": "storage"}
+
+
+def _is_top_level_function(module, func) -> bool:
+    node = module.parents.get(func)
+    while node is not None:
+        if isinstance(node, FUNCTION_NODES):
+            return False
+        node = module.parents.get(node)
+    return True
+
+
+class _Scope:
+    """One closure's channel facts: plan names, caster aliases
+    (``_up = prec.up``), and channel-tagged value names."""
+
+    def __init__(self, func):
+        self.plans = set()
+        self.casters = {}           # alias name -> "up" | "store"
+        self.tags = {}              # value name -> "reduce" | "storage"
+        args = getattr(func, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg in _PLAN_PARAM_NAMES:
+                    self.plans.add(a.arg)
+        self._collect(func)
+
+    # ------------------------------------------------------------- helpers
+    def _plan_hook(self, node) -> str | None:
+        """``"up"``/``"store"`` for a ``<plan>.up`` attribute expr."""
+        if (isinstance(node, ast.Attribute)
+                and node.attr in _CHANNEL_BY_HOOK
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.plans):
+            return node.attr
+        return None
+
+    def _hook_in_expr(self, node) -> str | None:
+        """A plan hook possibly wrapped in the conditional-identity
+        idiom ``up = (prec.up if prec.mixed else (lambda v: v))``."""
+        hook = self._plan_hook(node)
+        if hook is not None:
+            return hook
+        if isinstance(node, ast.IfExp):
+            return (self._hook_in_expr(node.body)
+                    or self._hook_in_expr(node.orelse))
+        return None
+
+    def call_channel(self, node) -> str | None:
+        """The channel a value expression lands in, or None: a call
+        through a plan hook / caster alias, or ``.astype(plan.<chan>)``."""
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        hook = self._plan_hook(f)
+        if hook is not None:
+            return _CHANNEL_BY_HOOK[hook]
+        if isinstance(f, ast.Name) and f.id in self.casters:
+            return _CHANNEL_BY_HOOK[self.casters[f.id]]
+        if (isinstance(f, ast.Attribute) and f.attr == "astype"
+                and node.args):
+            arg = node.args[0]
+            if (isinstance(arg, ast.Attribute)
+                    and arg.attr in _CHANNEL_BY_ATTR
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id in self.plans):
+                return _CHANNEL_BY_ATTR[arg.attr]
+        return None
+
+    @staticmethod
+    def _is_plan_ctor(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return name in _PLAN_FUNCS
+
+    # ------------------------------------------------------------ collection
+    def _collect(self, func):
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt, val = node.targets[0], node.value
+            pairs = []
+            if isinstance(tgt, ast.Name):
+                pairs = [(tgt, val)]
+            elif (isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple)
+                  and len(tgt.elts) == len(val.elts)):
+                pairs = [(t, v) for t, v in zip(tgt.elts, val.elts)
+                         if isinstance(t, ast.Name)]
+            for t, v in pairs:
+                if self._is_plan_ctor(v):
+                    self.plans.add(t.id)
+                    continue
+                hook = self._hook_in_expr(v)
+                if hook is not None:
+                    self.casters[t.id] = hook
+                    continue
+                chan = self.call_channel(v)
+                if chan is not None:
+                    self.tags[t.id] = chan
+
+
+@register
+class ChannelMixRule(Rule):
+    id = "TPS017"
+    name = "channel-mix"
+    description = ("arithmetic mixing a PrecisionPlan storage-channel "
+                   "value into the reduce channel without a plan-"
+                   "mediated cast — implicit promotion decides the "
+                   "dtype, not the plan")
+    severity = "warn"
+
+    def check(self, module):
+        for func in ast.walk(module.tree):
+            if not isinstance(func, FUNCTION_NODES):
+                continue
+            if not _is_top_level_function(module, func):
+                continue
+            scope = _Scope(func)
+            if not (scope.plans or scope.casters):
+                continue
+            yield from self._check_scope(module, func, scope)
+
+    def _check_scope(self, module, func, scope):
+        for node in ast.walk(func):
+            if not isinstance(node, ast.BinOp):
+                continue
+            chans = {}
+            for side in (node.left, node.right):
+                if isinstance(side, ast.Name) and side.id in scope.tags:
+                    chans[scope.tags[side.id]] = side.id
+            if len(chans) < 2:
+                continue
+            if self._plan_mediated(module, scope, node):
+                continue
+            yield self.finding(
+                node,
+                f"`{chans['storage']}` (storage channel) mixed into "
+                f"`{chans['reduce']}` (reduce channel) by bare "
+                f"arithmetic — implicit promotion, not the plan, "
+                f"decides the result dtype; lift the operand with the "
+                f"plan's up()/store() hooks instead")
+
+    def _plan_mediated(self, module, scope, node) -> bool:
+        """Is this expression inside an argument to a plan hook / caster
+        call (``store(x + alpha * p)`` — the documented idiom)?"""
+        cur = module.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, FUNCTION_NODES + (ast.stmt,)):
+            if (isinstance(cur, ast.Call)
+                    and (scope.call_channel(cur) is not None
+                         or scope._is_plan_ctor(cur))):
+                return True
+            cur = module.parents.get(cur)
+        return False
