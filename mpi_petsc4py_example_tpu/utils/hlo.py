@@ -274,16 +274,49 @@ def collective_sites(stablehlo_text: str, op_name: str
     return sites
 
 
+def _reduce_region_close(lines, start: int) -> int:
+    """Index of the line on which the region(s) of the ``all_reduce``
+    op(s) opening at ``lines[start]`` close — CHARACTER-level brace
+    tracking, so a region that opens and closes on its header line (the
+    compact printer's inline shape) resolves to ``start`` itself.  The
+    old per-line net count (``count('{') - count('}')``) never saw such
+    a region open and scanned forward into the NEXT op's closing line,
+    attributing that op's result dtype to the inline site and skipping
+    every all_reduce in between."""
+    depth = 0
+    opened = False
+    for j in range(start, len(lines)):
+        for ch in lines[j]:
+            if ch == "{":
+                depth += 1
+                opened = True
+            elif ch == "}":
+                depth -= 1
+        if opened and depth <= 0:
+            return j
+    return len(lines) - 1
+
+
+def _tail_elts(text: str) -> tuple:
+    """Result element types from the portion of ``text`` after its last
+    ``->`` (the result type of a ``}) : (...) -> ...`` trailer)."""
+    tail = text.rsplit("->", 1)[-1] if "->" in text else text
+    return tuple(elt for _dims, elt in _TENSOR_RE.findall(tail))
+
+
 def reduce_site_dtypes(stablehlo_text: str) -> list[tuple[str, ...]]:
     """Per-``all_reduce``-site result element types, one tuple per site
     in program order (variadic stacked reductions report one tuple with
     several entries).
 
     ``all_reduce`` carries a region, so its result types print on the
-    op's CLOSING ``}) : (...) -> ...`` line — found by brace counting
-    from the header. The reduce-channel dtype contracts pin these: a
-    plan whose fp64 exit-gate psum silently becomes f32 changes the
-    convergence semantics without changing any site count.
+    op's CLOSING ``}) : (...) -> ...`` line — the header line itself
+    when the printer emits the region inline, including the stacked
+    several-defs-on-one-line shape, where each def's types come from
+    its own line segment so the site list stays in lockstep with
+    :func:`_line_reduce_defs`.  The reduce-channel dtype contracts pin
+    these: a plan whose fp64 exit-gate psum silently becomes f32
+    changes the convergence semantics without changing any site count.
     """
     lines = stablehlo_text.splitlines()
     out: list[tuple[str, ...]] = []
@@ -293,24 +326,33 @@ def reduce_site_dtypes(stablehlo_text: str) -> list[tuple[str, ...]]:
         if not n_defs:
             i += 1
             continue
-        depth = 0
-        opened = False
-        j = i
-        while j < len(lines):
-            depth += lines[j].count("{") - lines[j].count("}")
-            if depth > 0:
-                opened = True
-            if opened and depth <= 0:
-                break
-            j += 1
-        close = lines[min(j, len(lines) - 1)]
-        tail = close.rsplit("->", 1)[-1] if "->" in close else close
-        elts = tuple(elt for _dims, elt in _TENSOR_RE.findall(tail))
-        if n_defs > 1 and len(elts) == n_defs:
-            # stacked same-line ops: one single-result tuple each
-            out.extend((e,) for e in elts)
+        if "{" not in lines[i]:
+            # defensive: a region-less mention can't anchor a brace
+            # scan — read what types the line itself offers
+            out.append(_tail_elts(lines[i]))
+            i += 1
+            continue
+        j = _reduce_region_close(lines, i)
+        if j == i:
+            # fully inline op(s): result types live on the header line,
+            # one `}) : (...) -> type` trailer per def — parse each
+            # def's own segment so stacked same-line psums of different
+            # dtypes report one tuple each
+            starts = [m.start()
+                      for m in _REDUCE_DEF_RE.finditer(lines[i])]
+            if starts:
+                bounds = starts[1:] + [len(lines[i])]
+                out.extend(_tail_elts(lines[i][a:b])
+                           for a, b in zip(starts, bounds))
+            else:       # defensive print shape with no parseable def
+                out.append(_tail_elts(lines[i]))
         else:
-            out.append(elts)
+            elts = _tail_elts(lines[j])
+            if n_defs > 1 and len(elts) == n_defs:
+                # stacked same-line ops: one single-result tuple each
+                out.extend((e,) for e in elts)
+            else:
+                out.append(elts)
         i = j + 1
     return out
 

@@ -231,6 +231,70 @@ def test_conditional_sites_included_on_request():
                                    exclude_conditionals=False) == [2]
 
 
+# ------------------------------------------------- reduce-channel dtypes
+_INLINE_REGION = ('{ ^bb0(%a: tensor<f64>, %b: tensor<f64>): '
+                  '%s = stablehlo.add %a, %b : tensor<f64> '
+                  'stablehlo.return %s : tensor<f64> }')
+
+
+def test_reduce_site_dtypes_inline_region_then_multiline_op():
+    """An all_reduce whose region opens AND closes on its header line:
+    the old per-line brace count never saw the region open, so the scan
+    ran forward to the NEXT op's closing line — reporting one site with
+    the WRONG dtype and swallowing every all_reduce in between, which
+    silently corrupted the TPC005 dtype gate and undercounted TPC007.
+    Each site must report its own dtype, in lockstep with the site
+    counter."""
+    from mpi_petsc4py_example_tpu.utils.hlo import reduce_site_dtypes
+    text = _while_program([
+        f'%r0 = "stablehlo.all_reduce"(%p0) ({_INLINE_REGION}) : '
+        '(tensor<4xf64>) -> tensor<4xf64>',
+        '%r1 = "stablehlo.all_reduce"(%p1) ({',
+        '  ^bb0(%a: tensor<f32>, %b: tensor<f32>):',
+        '    %s = stablehlo.add %a, %b : tensor<f32>',
+        '    stablehlo.return %s : tensor<f32>',
+        '}) : (tensor<4xf32>) -> tensor<4xf32>',
+        'stablehlo.return %r1, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ])
+    assert reduce_site_dtypes(text) == [("f64",), ("f32",)]
+    assert while_body_reduce_sites(text) == [2]
+
+
+def test_reduce_site_dtypes_stacked_one_line_matches_site_count():
+    """The stacked two-defs-on-one-line print shape (the round-16
+    _line_reduce_defs fixture): one single-dtype tuple PER def, so
+    total_reduce_sites (TPC007, len of this list) agrees with the
+    while-body counter and TPC005 sees both dtypes — not [()] from
+    parsing only the last `->` and discarding the rest."""
+    from mpi_petsc4py_example_tpu.utils.hlo import reduce_site_dtypes
+    text = _while_program([
+        f'%r0 = "stablehlo.all_reduce"(%p0) ({_INLINE_REGION}) : '
+        '(tensor<4xf64>) -> tensor<4xf64>  '
+        f'%r1 = "stablehlo.all_reduce"(%p1) ({_INLINE_REGION}) : '
+        '(tensor<4xf32>) -> tensor<4xf32>',
+        'stablehlo.return %r0, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ])
+    dtypes = reduce_site_dtypes(text)
+    assert dtypes == [("f64",), ("f32",)]
+    assert len(dtypes) == sum(while_body_reduce_sites(text))
+
+
+def test_reduce_site_dtypes_variadic_is_one_tuple():
+    """A variadic stacked psum is ONE site reporting one tuple with all
+    its result dtypes — the single-psum krylov idiom."""
+    from mpi_petsc4py_example_tpu.utils.hlo import reduce_site_dtypes
+    text = _while_program([
+        '%r:3 = "stablehlo.all_reduce"(%p0, %p1, %p2) ({',
+        '  ^bb0(%a: tensor<f64>, %b: tensor<f64>):',
+        '    %s = stablehlo.add %a, %b : tensor<f64>',
+        '    stablehlo.return %s : tensor<f64>',
+        '}) : (tensor<4xf64>, tensor<4xf32>, tensor<i32>)'
+        ' -> (tensor<4xf64>, tensor<4xf32>, tensor<i32>)',
+        'stablehlo.return %r#0, %iterArg_0 : tensor<8xf64>, tensor<i32>',
+    ])
+    assert reduce_site_dtypes(text) == [("f64", "f32", "i32")]
+
+
 # ----------------------------------------------- against a real lowering
 @pytest.mark.parametrize("nsites", [1, 2])
 def test_parser_against_real_lowered_program(nsites):

@@ -292,7 +292,53 @@ def test_cli_changed_files_selects_by_dependency(capsys, tmp_path):
     assert doc["runs"][0]["results"] == []
 
 
-def test_cli_cache_baseline_roundtrip(comm8, tmp_path, capsys):
+def test_cli_changed_files_paths_are_repo_root_relative(monkeypatch):
+    """``--changed-files`` takes the paths ``git diff --name-only``
+    emits: repo-ROOT-relative, whatever the CWD.  Resolving them
+    against the CWD (the old ``os.path.abspath``) from a subdirectory
+    garbled every path, deselected all contracts, and exited 0 — a
+    silent false pass of the gate."""
+    from tools.tpscheck.cli import _repo_rel
+    root = str(REPO)
+    rel = "mpi_petsc4py_example_tpu/utils/hlo.py"
+    monkeypatch.chdir(REPO / "tests")
+    assert _repo_rel(rel, root) == rel
+    assert _repo_rel(str(REPO / rel), root) == rel
+    monkeypatch.chdir(REPO)
+    assert _repo_rel(rel, root) == rel
+
+
+def test_cli_changed_files_selects_from_a_subdirectory(capsys,
+                                                       monkeypatch,
+                                                       tmp_path):
+    """The dependency-selection CLI path itself must be CWD-proof: the
+    same no-contract-depends outcome and, for a path that IS a contract
+    dep, a nonempty selection — from inside a subdirectory."""
+    monkeypatch.chdir(REPO / "tests")
+    code = tpscheck_main([
+        "--changed-files", "mpi_petsc4py_example_tpu/serving/server.py",
+        "--select", "ksp/cg/ell"])
+    assert code == 0
+    assert "no contract depends" in capsys.readouterr().err
+
+    # dep-positive from the same subdir: prime the index cache with the
+    # committed-baseline truth so the selected contract rides the cache
+    # (no lowering) — the old CWD-resolution would have deselected it
+    # and printed the no-contract-depends clean line instead
+    from tools.tpscheck.cli import _dep_hash
+    c = get_contracts(names=["ksp/cg/ell"])[0]
+    measured = checker.load_baseline(checker.BASELINE_PATH)["ksp/cg/ell"]
+    cache = tmp_path / "contracts.json"
+    cache.write_text(json.dumps(
+        {c.name: {"key": _dep_hash(c, str(REPO)),
+                  "measured": measured}}))
+    code = tpscheck_main([
+        "--changed-files", list(c.deps)[0],
+        "--select", c.name, "--index-cache", str(cache)])
+    err = capsys.readouterr().err
+    assert code == 0
+    assert "no contract depends" not in err
+    assert "1 cached" in err
     """One real lowering, then: cache hit, baseline update, injected
     baseline drift -> TPC008 warn -> --strict failure."""
     cache = tmp_path / "contracts.json"

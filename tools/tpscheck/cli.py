@@ -89,7 +89,17 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def _repo_rel(path: str, root: str) -> str:
-    rel = os.path.relpath(os.path.abspath(path), root)
+    """Normalize a ``--changed-files`` path to repo-root-relative form.
+
+    Relative paths are taken as repo-ROOT-relative — the form
+    ``git diff --name-only`` emits — not CWD-relative, so invoking
+    tpscheck from a subdirectory cannot silently deselect every
+    contract and false-pass the gate.  Absolute paths are relativized
+    against the root.
+    """
+    if not os.path.isabs(path):
+        path = os.path.join(root, path)
+    rel = os.path.relpath(path, root)
     return rel.replace(os.sep, "/")
 
 
