@@ -286,6 +286,11 @@ _REQUIRED_FIELDS = {
         "wall_s", "methods", "psum_per_site_us", "crossover_us",
         "autoselect", "schedule_gate_ok", "refined_rel_residual",
         "demote_events", "residual_parity"),
+    "cfg16_multisplit": (
+        "wall_s", "sync", "sync_modeled_wall_s", "async_measured",
+        "jitter_grid_us", "straggler_model", "cpu_mesh_caveat",
+        "jitter_crossover_us", "async_wins_at_jitter",
+        "refined_rel_residual", "residual_parity"),
 }
 
 
@@ -1895,6 +1900,180 @@ def config15(comm, quick):
                 residual_parity=parity)
 
 
+def config16(comm, quick):
+    """cfg16_multisplit: the asynchronous tier's weak-scaling jitter
+    point — where bounded staleness beats every synchronous plan.
+
+    The async claim is about STRAGGLERS, not collective latency:
+    seeded exponential jitter (mean J per step, every device —
+    resilience/faults ``comm.delay``) is injected into the multisplit
+    solve and its wall MEASURED; each synchronous plan's jittered wall
+    is MODELED as its measured fault-free wall plus, per iteration, the
+    expected MAX of the per-device draws (a lockstep iteration cannot
+    complete before its slowest device: E[max of d Exp(J)] = J*H_d).
+    Communication-avoiding schedules amortize collective LATENCY, not
+    straggler delay — s-step still gets a CLT credit (its s sequential
+    inner iterations average the draws: charge J*(1+(H_d-1)/sqrt(s))),
+    the most favorable defensible model for the competition. The async
+    tier pays only the per-block MEAN, because staleness absorbs
+    independent per-step fluctuations instead of propagating them
+    through a barrier. ``jitter_crossover_us`` is the per-step jitter
+    above which the measured async wall beats the BEST modeled
+    synchronous plan; ``async_wins_at_jitter`` gates the top of the
+    measured grid. Strict fp64 residual parity is enforced on every
+    solve, jittered or not. CPU-mesh caveats in the committed JSON:
+    sleeps cannot be injected INSIDE a compiled synchronous while_loop,
+    hence the model; and the async tier's host-thread orchestration
+    overhead (~0.3 s here) is being compared against µs-scale compiled
+    sync walls, so the ZERO-jitter async column loses by design — the
+    crossover is the honest headline, not the base wall."""
+    import time as _time
+    import scipy.sparse as sp
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.solvers.krylov import build_ksp_program
+    from mpi_petsc4py_example_tpu.solvers.multisplit import MultisplitSolver
+    from mpi_petsc4py_example_tpu.utils.hlo import solver_loop_reduce_sites
+
+    n = 1024 if quick else 4096
+    nblocks = 4
+    inner_rtol = 1e-4
+    rtol = 1e-10
+    grid_us = (0, 5_000, 20_000) if quick else (0, 5_000, 20_000, 50_000)
+    ndev = comm.size
+    h_d = float(sum(1.0 / k for k in range(1, ndev + 1)))
+    t_cfg = _time.perf_counter()
+
+    A = sp.diags([-1.0, 4.0, -1.0], [-1, 0, 1], shape=(n, n),
+                 format="csr")
+    x_true, b = manufactured(A, seed=16)
+    bnorm = float(np.linalg.norm(b))
+
+    # ---- synchronous baselines on the SAME operator: converged walls,
+    # iteration counts, and the per-iteration reduce-site count pinned
+    # on the lowered HLO (the latency-amortization story the straggler
+    # model deliberately does NOT credit) ----
+    M = tps.Mat.from_scipy(comm, A)
+    dt = np.dtype(np.float64)
+    sync = {}
+    parity_ok = True
+    for label, (tp, s) in (("cg", ("cg", None)),
+                           ("pipecg", ("pipecg", None)),
+                           ("sstep4", ("sstep", 4))):
+        ksp = tps.KSP().create(comm)
+        ksp.set_operators(M)
+        ksp.set_type(tp)
+        if s is not None:
+            ksp.sstep_s = s
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=rtol)
+        x, bv = M.get_vecs()
+        bv.set_global(b)
+        res = ksp.solve(bv, x)             # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            x.set_global(np.zeros(n))
+            t0 = _time.perf_counter()
+            res = ksp.solve(bv, x)
+            best = min(best, _time.perf_counter() - t0)
+        pkw = {} if s is None else {"sstep_s": s}
+        txt = build_ksp_program(comm, tp, ksp.get_pc(), M, **pkw).lower(
+            M.device_arrays(), ksp.get_pc().device_arrays(),
+            bv.data, x.data, dt.type(rtol), dt.type(0.0), dt.type(0.0),
+            np.int32(8)).as_text()
+        sites = solver_loop_reduce_sites(txt) / (s or 1)
+        # straggler charge per iteration: max-of-draws for a per-
+        # iteration barrier; CLT credit for s-step's s-deep work chain
+        factor = h_d if s is None else 1.0 + (h_d - 1.0) / float(s) ** 0.5
+        parity_ok &= bool(res.converged
+                          and res.residual_norm <= rtol * bnorm * 10)
+        sync[label] = {"wall_s": best, "iters": int(res.iterations),
+                       "per_iter_us": best / res.iterations * 1e6,
+                       "reduce_sites_per_iter": sites,
+                       "straggler_factor": factor}
+
+    # ---- the async tier: fault-free parity gate, then the MEASURED
+    # jitter sweep (real seeded sleeps in every block worker) ----
+    ms = MultisplitSolver(nblocks=nblocks, rtol=rtol,
+                          inner_rtol=inner_rtol)
+    ms.set_operator(A)
+    async_rows = {}
+    refined_rel = float("inf")
+    for j_us in grid_us:
+        mean_s = j_us / 1e6
+        spec = f"comm.delay=delay:times=*:mean={mean_s}:seed=16"
+        try:
+            if j_us:
+                with tps.inject_faults(spec):
+                    t0 = _time.perf_counter()
+                    r = ms.solve(b)
+                    wall = _time.perf_counter() - t0
+            else:
+                t0 = _time.perf_counter()
+                r = ms.solve(b)
+                wall = _time.perf_counter() - t0
+        finally:
+            _faults.heal()
+        rres = float(np.linalg.norm(b - A @ r.x) / bnorm)
+        parity_ok &= bool(r.converged and rres <= rtol)
+        if j_us == 0:
+            refined_rel = rres
+        async_rows[str(j_us)] = {
+            "wall_s": wall, "cut": int(r.cut_version),
+            "outer_steps": list(r.block_steps),
+            "resyncs": int(r.resyncs),
+            "max_stale_seen": int(r.max_stale_seen),
+            "rel_residual": rres}
+
+    # ---- modeled synchronous walls over the same grid + crossover ----
+    sync_modeled = {
+        label: {str(j_us): row["wall_s"] + row["iters"]
+                * row["straggler_factor"] * j_us / 1e6
+                for j_us in grid_us}
+        for label, row in sync.items()}
+    diffs = []
+    for j_us in grid_us:
+        best_sync = min(m[str(j_us)] for m in sync_modeled.values())
+        diffs.append((j_us, async_rows[str(j_us)]["wall_s"] - best_sync))
+    crossover = None
+    for (j0, d0), (j1, d1) in zip(diffs, diffs[1:]):
+        if d0 > 0 >= d1:          # async overtakes between j0 and j1
+            crossover = j0 + (j1 - j0) * d0 / (d0 - d1)
+            break
+    if crossover is None and diffs and diffs[0][1] <= 0:
+        crossover = 0.0           # async already wins jitter-free
+    async_wins = diffs[-1][1] <= 0 if diffs else False
+
+    return dict(
+        config="cfg16_multisplit", n=n, nblocks=nblocks, devices=ndev,
+        inner_rtol=inner_rtol,
+        wall_s=_time.perf_counter() - t_cfg,
+        sync=sync, sync_modeled_wall_s=sync_modeled,
+        async_measured=async_rows,
+        jitter_grid_us=list(grid_us),
+        straggler_model=(
+            "sync jittered wall MODELED: fault-free wall + iters * "
+            f"charge * J; charge = H({ndev}) = {h_d:.3f} (expected max "
+            "of per-device Exp(J) draws at a lockstep barrier) for "
+            "cg/pipecg, 1 + (H-1)/sqrt(s) for s-step (CLT credit: its "
+            "s-deep sequential chain averages draws). Async wall "
+            "MEASURED with the same seeded draws injected as real "
+            "sleeps (comm.delay) — it pays the per-block MEAN because "
+            "bounded staleness absorbs independent fluctuations."),
+        cpu_mesh_caveat=(
+            "single-host virtual mesh: sleeps cannot be injected inside "
+            "a compiled synchronous while_loop, hence the modeled sync "
+            "column; the async tier's host-thread orchestration "
+            "overhead is compared against ms-scale compiled sync walls, "
+            "so the zero-jitter async column loses by design and "
+            "jitter_crossover_us is the honest headline. On a real "
+            "multi-chip mesh the sync walls gain a per-site latency "
+            "term the CPU mesh does not charge."),
+        jitter_crossover_us=crossover,
+        async_wins_at_jitter=bool(async_wins),
+        refined_rel_residual=refined_rel,
+        residual_parity=bool(parity_ok))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1914,7 +2093,8 @@ def main():
                 "cfg4": config4, "cfg5": config5, "cfg6": config6,
                 "cfg7": config7, "cfg8": config8, "cfg9": config9,
                 "cfg10": config10, "cfg11": config11, "cfg12": config12,
-                "cfg13": config13, "cfg14": config14, "cfg15": config15}
+                "cfg13": config13, "cfg14": config14, "cfg15": config15,
+                "cfg16": config16}
     if opts.configs:
         names = [s.strip() for s in opts.configs.split(",") if s.strip()]
         bad = [s for s in names if s not in all_cfgs]

@@ -93,6 +93,7 @@ __all__ = [
     "KSPFallbackChain",
     "SolveServer", "ServedSolveResult", "ServerClosedError",
     "SolveRouter", "QoSClass", "AutoscalePolicy",
+    "MultisplitSolver", "MultisplitResult", "StaleExchange",
 ]
 
 
@@ -118,4 +119,10 @@ def __getattr__(name):
         # like the other solver-object imports above
         from . import serving as _serving
         return getattr(_serving, name)
+    if name in ("MultisplitSolver", "MultisplitResult"):
+        from .solvers import multisplit as _multisplit
+        return getattr(_multisplit, name)
+    if name == "StaleExchange":
+        from .parallel.exchange import StaleExchange
+        return StaleExchange
     raise AttributeError(name)

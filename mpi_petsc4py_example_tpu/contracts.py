@@ -46,7 +46,8 @@ import scipy.sparse as sp
 #: the reverse-coverage meta-test pins registry kinds == this vocabulary
 #: and greps the solver sources for each literal's use site
 PROGRAM_KINDS = ("ksp", "ksp_many", "megasolve", "megasolve_many",
-                 "seedfacto", "restartfacto", "heploop")
+                 "seedfacto", "restartfacto", "heploop",
+                 "multisplit_block", "multisplit_residual")
 
 #: problem geometry every contract lowers at (8 host devices; 512 % 8
 #: == 0, so n_pad == n and the budgets below are exact, not padded)
@@ -317,6 +318,47 @@ def lower_heploop(comm):
         return prog.lower(M.device_arrays(), (), v0, dt.type(1e-8),
                           dt.type(0.0), dt.type(0.0),
                           np.int32(10)).as_text()
+
+
+def lower_multisplit_block(comm, ksp_type="cg", pc_type="jacobi"):
+    """Lower the inner-block program of the async multisplit tier: the
+    block's own KSP on a **1-device sub-communicator** over its diagonal
+    block ``A_ii`` — the program one ``multisplit.step`` dispatches.
+    Every all_reduce/all_gather in it is a singleton-group no-op on the
+    wire: ZERO outer (cross-device) collectives per async step, the
+    tier's defining contract (the only cross-device collective lives in
+    ``multisplit_residual``, paid per convergence check)."""
+    import jax
+    from .parallel.mesh import DeviceComm
+    from .solvers.krylov import build_ksp_program
+    with _raw_programs():
+        sub = DeviceComm(devices=[jax.devices()[0]])
+        import mpi_petsc4py_example_tpu as tps
+        nb = len(jax.devices())
+        blk = _ell_scipy()[: N // nb, : N // nb].tocsr()
+        M = tps.Mat.from_scipy(sub, blk)
+        pc = _ksp_pc(sub, M, ksp_type, pc_type)
+        prog = build_ksp_program(sub, ksp_type, pc, M)
+        x, b = M.get_vecs()
+        dt = np.dtype(np.float64)
+        return prog.lower(
+            M.device_arrays(), pc.device_arrays(), b.data, x.data,
+            dt.type(1e-2), dt.type(0.0), dt.type(0.0),
+            np.int32(50)).as_text()
+
+
+def lower_multisplit_residual(comm):
+    """Lower the consistent-cut residual program of the async tier:
+    ``||b - A x||^2`` over the FULL mesh — the tier's ONLY cross-device
+    collective, one psum per convergence check (solvers/multisplit.py
+    ``build_multisplit_residual_program``)."""
+    from .solvers.multisplit import build_multisplit_residual_program
+    with _raw_programs():
+        M = _mat(comm, "ell")
+        prog = build_multisplit_residual_program(comm, M)
+        b = comm.put_rows(np.zeros(N))
+        x = comm.put_rows(np.zeros(N))
+        return prog.lower(*M.device_arrays(), b, x).as_text()
 
 
 # ---------------------------------------------------------------------------
@@ -751,6 +793,32 @@ def _contracts():
           build=lower_restartfacto,
           gather_elems_max=n, gather_sites_max=2,
           deps=_EPS_DEPS),
+        # ----- asynchronous multisplitting programs -----
+        C(name="multisplit_block/cg/ell", kind="multisplit_block",
+          description="inner-block program of the async tier: the "
+                      "block KSP's full 3-site CG schedule rides a "
+                      "1-DEVICE subcomm, so every collective is a "
+                      "singleton-group no-op — ZERO outer (cross-"
+                      "device) collectives per async step; a lowering "
+                      "that picks up the global mesh axis would "
+                      "reintroduce the synchronous stall the tier "
+                      "exists to remove",
+          build=lambda comm: lower_multisplit_block(comm),
+          reduce_site_chain=(3,),
+          total_reduce_sites=ELL_CG_JACOBI_TOTAL_REDUCES,
+          gather_elems=N // 8, reduce_dtypes=_F64,
+          deps=_KSP_DEPS + (f"{_PKG}/solvers/multisplit.py",)),
+        C(name="multisplit_residual/ell", kind="multisplit_residual",
+          description="consistent-cut residual check: the async "
+                      "tier's ONLY cross-device collective — exactly "
+                      "ONE fp64 psum over the full mesh, paid per "
+                      "convergence CHECK (never per iteration), plus "
+                      "the one vector-sized SpMV x-gather",
+          build=lower_multisplit_residual,
+          total_reduce_sites=1, reduce_dtypes=_F64,
+          gather_sites=1, gather_elems=n,
+          deps=(f"{_PKG}/solvers/multisplit.py",
+                f"{_PKG}/ops/spmv.py")),
         C(name="heploop/dia", kind="heploop",
           description="whole-solve HEP loop on the banded operator: "
                       "at most vector-sized gathers, never the "

@@ -39,10 +39,16 @@ Spec grammar (comma-separated clauses)::
     point  := one of FAULT_POINTS
     kind   := unavailable | oom | nan | inf | drop | corrupt
             | bitflip | scale                  (silent corruption)
+            | delay | partition                (timing / stale exchange)
     params := at=N      trigger on the Nth hit of the point (default 1)
               device=D  device id to lose ('device.lost' clauses; default:
-                        the highest device id in the checked mesh)
+                        the highest device id in the checked mesh) — or
+                        the device/block a 'delay'/'partition' clause
+                        targets (default: every device)
               mag=M     relative error of 'scale' corruption (default 1e-3)
+              mean=T    mean injected latency in seconds ('comm.delay'
+                        clauses; with seed= the delay is drawn
+                        exponential(mean), else exactly T; default 0.01)
               times=M   stay armed for M consecutive hits ('*' = forever)
               iter=K    simulated crash/poison iteration (ksp.program /
                         ksp.result: the partial iterate of K real device
@@ -101,6 +107,22 @@ FAULT_POINTS = {
     # (solvers/ksp.py mesh_fault site), so at=N picks the Nth solve and
     # iter=K leaves K iterations of real partial state, like ksp.program.
     "device.lost": ("unavailable",),         # permanent worker/chip loss
+    # TIMING faults (the first in the registry): 'comm.delay' injects
+    # per-device latency into host-side communication paths — the async
+    # multisplitting tier (solvers/multisplit.py) sleeps the returned
+    # seconds before publishing a boundary exchange, which is how a slow
+    # or jittery device is SIMULATED rather than crashed. 'delay' with
+    # device=D + times=* is a sticky slow device; seed=S draws
+    # reproducible exponential jitter around mean= (seconds) instead of
+    # a fixed delay. Consumed via delay_seconds(), never check().
+    "comm.delay":  ("delay",),               # per-device latency jitter
+    # Stale-exchange boundary (parallel/exchange.py StaleExchange):
+    # 'drop' discards one publish (the reader keeps serving the previous
+    # version — staleness grows by one); 'partition' with device=D
+    # discards every publish FROM block/device D while armed (times=* =
+    # a partitioned peer), the network-split model the bounded-staleness
+    # supervisor must resync or degrade around.
+    "exchange.put": ("drop", "partition"),   # stale-exchange publish
 }
 
 RAISING_KINDS = ("unavailable", "oom")
@@ -130,7 +152,8 @@ class Fault:
     def __init__(self, point: str, kind: str, at: int = 1, times: int = 1,
                  forever: bool = False, iter_k: int | None = None,
                  seed: int | None = None, prob: float = 1.0,
-                 mag: float = 1e-3, device: int | None = None):
+                 mag: float = 1e-3, device: int | None = None,
+                 mean: float = 0.01):
         self.point = point
         self.kind = kind
         self.at = at
@@ -139,7 +162,8 @@ class Fault:
         self.iter_k = iter_k
         self.prob = prob
         self.mag = mag       # relative magnitude of 'scale' corruption
-        self.device = device  # device id ('device.lost' clauses)
+        self.mean = mean     # mean latency in seconds ('delay' clauses)
+        self.device = device  # device id (device.lost/delay/partition)
         self._rng = random.Random(seed) if seed is not None else None
         self.hits = 0      # times the point was reached
         self.fired = 0     # times this fault actually triggered
@@ -236,12 +260,15 @@ def _parse_clause(clause: str) -> Fault:
                 kw["prob"] = float(value)
             elif key == "mag":
                 kw["mag"] = float(value)
+            elif key == "mean":
+                kw["mean"] = float(value)
             elif key == "device":
                 kw["device"] = int(value)
             else:
                 raise FaultSpecError(
                     f"fault clause {clause!r}: unknown parameter {key!r} "
-                    "(have: at, times, iter, seed, prob, mag, device)")
+                    "(have: at, times, iter, seed, prob, mag, mean, "
+                    "device)")
         except ValueError as e:
             if isinstance(e, FaultSpecError):
                 raise
@@ -309,12 +336,15 @@ def inject_faults(spec: str):
             _PLAN = saved
 
 
-def triggered(point: str):
+def triggered(point: str, device: int | None = None):
     """Hot-path hook: count a hit of ``point`` against the active plan.
 
     Returns the :class:`Fault` that fired (the call site applies its
     effect — raise, poison, drop) or None. Near-no-op when no plan is
-    armed.
+    armed. ``device`` identifies WHO hit the point (the publishing
+    block/device id at ``exchange.put``): a clause carrying ``device=D``
+    then only counts — and only fires — for that id, the sticky
+    partitioned-peer model; clauses without ``device=`` match everyone.
     """
     plan = _active_plan()
     if plan is None:
@@ -322,7 +352,12 @@ def triggered(point: str):
     with _LOCK:
         fired = None
         for fault in plan:
-            if fault.point == point and fault.check():
+            if fault.point != point:
+                continue
+            if (device is not None and fault.device is not None
+                    and fault.device != int(device)):
+                continue
+            if fault.check():
                 fired = fault
                 break
     if fired is not None and fired.kind not in RAISING_KINDS:
@@ -339,6 +374,46 @@ def check(point: str):
     fault = triggered(point)
     if fault is not None and fault.kind in RAISING_KINDS:
         raise fault.error()
+
+
+def delay_seconds(point: str, device: int | None = None) -> float:
+    """Hot-path hook for TIMING fault points (``comm.delay``): seconds
+    of injected latency the caller must sleep before its communication
+    step — 0.0 with no armed delay clause (near-no-op, like
+    :func:`triggered`).
+
+    ``device`` is the id doing the communicating; a clause with
+    ``device=D:times=*`` is a STICKY slow device (only D's hits count,
+    every one fires), the straggler model asynchronous multisplitting
+    (solvers/multisplit.py) is built to absorb. A seeded clause draws
+    each delay from an exponential distribution with mean ``mean=``
+    seconds (``random.Random(seed).expovariate`` — reproducible jitter);
+    an unseeded clause injects exactly ``mean`` seconds. Hit windows
+    (``at``/``times``/``prob``) gate each draw like any other fault.
+    Multiple matching clauses add up.
+    """
+    plan = _active_plan()
+    if plan is None:
+        return 0.0
+    total = 0.0
+    fired = []
+    with _LOCK:
+        for fault in plan:
+            if fault.point != point or fault.kind != "delay":
+                continue
+            if (device is not None and fault.device is not None
+                    and fault.device != int(device)):
+                continue
+            if not fault.check():
+                continue
+            if fault._rng is not None and fault.mean > 0:
+                total += fault._rng.expovariate(1.0 / fault.mean)
+            else:
+                total += max(0.0, fault.mean)
+            fired.append(fault)
+    for fault in fired:
+        fault.flight_record()
+    return total
 
 
 # fault points whose effect applies while a program is being TRACED (and

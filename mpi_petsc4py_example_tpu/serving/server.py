@@ -137,9 +137,9 @@ class _OperatorSession:
     ``register_operator`` documented."""
 
     __slots__ = ("name", "operator", "ksp", "dtype", "n",
-                 "rtol", "atol", "max_it")
+                 "rtol", "atol", "max_it", "multisplit")
 
-    def __init__(self, name, operator, ksp):
+    def __init__(self, name, operator, ksp, multisplit=None):
         self.name = name
         self.operator = operator
         self.ksp = ksp
@@ -148,13 +148,19 @@ class _OperatorSession:
         self.rtol = float(ksp.rtol)
         self.atol = float(ksp.atol)
         self.max_it = int(ksp.max_it)
+        self.multisplit = multisplit   # async-tier solver, or None
 
     @property
     def schedule(self) -> str:
         """The session's reduction-plan schedule ("cg" / "pipecg" /
-        "sstep:<s>") — part of every request's compatibility key
-        (serving/coalescer.py): the schedule is compiled into the block
-        program, so blocks never mix schedules."""
+        "sstep:<s>" / "multisplit") — part of every request's
+        compatibility key (serving/coalescer.py): the schedule is
+        compiled into the block program, so blocks never mix schedules.
+        "multisplit" is the ASYNC schedule class: jittery-mesh sessions
+        route to the stale-tolerant tier (solvers/multisplit.py) and
+        never coalesce with synchronous-plan sessions."""
+        if self.multisplit is not None:
+            return "multisplit"
         tp = self.ksp.get_type()
         return f"{tp}:{int(self.ksp.sstep_s)}" if tp == "sstep" else tp
 
@@ -288,6 +294,7 @@ class SolveServer:
                           max_it: int = 10000, abft: bool = False,
                           residual_replacement: int = 0,
                           megasolve: bool = False,
+                          multisplit: bool = False,
                           warm_widths=()):
         """Register operator ``name`` and make its solve state resident.
 
@@ -318,6 +325,17 @@ class SolveServer:
         The session KSP also applies the options DB (``-ksp_*`` flags —
         abft, residual replacement, true-residual gating, megasolve —
         override these defaults at runtime, the PETSc precedence).
+
+        ``multisplit`` routes the session to the ASYNCHRONOUS tier
+        (solvers/multisplit.py): requests dispatch per-column through
+        the stale-tolerant outer iteration instead of a coalesced
+        synchronous block — the schedule class for jittery or degrading
+        meshes, where any synchronous plan pays max-of-device latency
+        per reduction. QoS-``interactive`` batches ride FRESHER
+        exchanges: their staleness bound tightens to
+        ``-multisplit_urgent_stale`` (default: half the session bound).
+        ``ksp_type``/``pc_type`` then configure the per-block INNER
+        solves (with ``-multisplit_inner_*`` flags taking precedence).
         """
         if name in self._sessions:
             raise ValueError(f"operator {name!r} already registered")
@@ -340,8 +358,9 @@ class SolveServer:
         # sequential solves (KSP.solve_many's fallback routing) — results
         # stay correct, the serving throughput win evaporates. Say so.
         from ..solvers.krylov import batched_pc_supported
-        if (ksp.get_type() not in ("cg", "pipecg", "sstep")
-                or not batched_pc_supported(ksp.get_pc())):
+        if (not multisplit
+                and (ksp.get_type() not in ("cg", "pipecg", "sstep")
+                     or not batched_pc_supported(ksp.get_pc()))):
             import warnings
             warnings.warn(
                 f"SolveServer operator {name!r}: configuration "
@@ -350,7 +369,24 @@ class SolveServer:
                 "per-column sequential solves (check for stray global "
                 "-ksp_type/-pc_type options)", stacklevel=2)
         ksp.set_up()                  # PC factors placed NOW, once
-        sess = _OperatorSession(name, op, ksp)
+        ms = None
+        if multisplit:
+            from ..solvers.multisplit import MultisplitSolver
+            if not hasattr(op, "to_scipy"):
+                raise ValueError(
+                    f"operator {name!r}: the multisplit schedule class "
+                    "needs a host-reconstructible operator (Mat) — "
+                    "matrix-free stencils have no row splitting")
+            # the session's ksp_type/pc_type seed the per-block inner
+            # solves — unless -multisplit_inner_type is set (PETSc
+            # precedence: runtime flags beat programmatic defaults)
+            inner = (None if global_options().has("multisplit_inner_type")
+                     else ksp.get_type())
+            ms = MultisplitSolver(self.comm, inner_type=inner,
+                                  pc_type=ksp.get_pc().get_type(),
+                                  rtol=rtol, atol=atol, dtype=dtype)
+            ms.set_operator(op)
+        sess = _OperatorSession(name, op, ksp, multisplit=ms)
         with self._session_lock:
             # under the session lock: a concurrent regrow/adoption must
             # not iterate the registry while it grows
@@ -723,7 +759,9 @@ class SolveServer:
             ksp.set_tolerances(rtol=reqs[0].rtol, atol=reqs[0].atol,
                                max_it=reqs[0].max_it)
             try:
-                if self.resilient:
+                if sess.multisplit is not None:
+                    res = self._multisplit_solve_many(sess, reqs, B, k)
+                elif self.resilient:
                     res = resilient_solve_many(ksp, B,
                                                policy=self.retry_policy)
                 else:
@@ -780,6 +818,37 @@ class SolveServer:
             bsp.set_attrs(attempts=res.attempts,
                           iterations=max(res.iterations, default=0))
         self._record(k, waits, kpad - k)
+
+    def _multisplit_solve_many(self, sess, reqs, B, k):
+        """Dispatch one batch through the ASYNCHRONOUS tier: per-column
+        stale-tolerant outer solves (solvers/multisplit.py) instead of a
+        coalesced synchronous block program — the "multisplit" schedule
+        class. QoS-URGENT batches ride fresher exchanges: when any
+        member is ``interactive``, the staleness bound tightens to
+        ``-multisplit_urgent_stale`` (default: half the session's
+        bound), trading straggler tolerance for iterate freshness on
+        the traffic that is actually waiting."""
+        from ..utils.convergence import BatchedSolveResult
+        ms = sess.multisplit
+        bound = None
+        if any(r.qos == "interactive" for r in reqs):
+            bound = global_options().get_int(
+                "multisplit_urgent_stale", max(1, ms.max_stale // 2))
+        t0 = time.monotonic()
+        X = np.zeros((sess.n, k), dtype=sess.dtype)
+        iters, rnorms, reasons, hists = [], [], [], []
+        for j, r in enumerate(reqs):
+            res = ms.solve(B[:, j], rtol=r.rtol, atol=r.atol,
+                           max_stale=bound)
+            X[:, j] = res.x
+            iters.append(int(res.iterations))
+            rnorms.append(float(res.residual_norm))
+            reasons.append(int(res.reason))
+            hists.append([rn for _v, rn in res.history])
+        return BatchedSolveResult(iterations=iters, residual_norms=rnorms,
+                                  reasons=reasons,
+                                  wall_time=time.monotonic() - t0, X=X,
+                                  histories=hists)
 
     @staticmethod
     def _end_request_span(req, outcome: str, batch=None, **attrs):

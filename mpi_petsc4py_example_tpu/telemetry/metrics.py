@@ -40,12 +40,17 @@ LATENCY_BUCKETS_S = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0,
 PER_ITER_BUCKETS_S = (1e-7, 3e-7, 1e-6, 3e-6, 1e-5, 3e-5, 1e-4, 3e-4,
                       1e-3, 3e-3, 1e-2, 0.1)
 QUEUE_WAIT_BUCKETS_S = LATENCY_BUCKETS_S
+#: staleness ages are small integers (versions behind the reader), not
+#: seconds — integer bucket bounds up to the largest plausible
+#: -multisplit_max_stale, then +Inf for runaway staleness
+STALE_AGE_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
 
 #: default buckets by histogram name (callers may still pass their own)
 DEFAULT_BUCKETS = {
     "solve.latency_seconds": LATENCY_BUCKETS_S,
     "solve.per_iter_seconds": PER_ITER_BUCKETS_S,
     "serving.queue_wait_seconds": QUEUE_WAIT_BUCKETS_S,
+    "multisplit.stale_age": STALE_AGE_BUCKETS,
 }
 
 #: bounded reservoir size per histogram — the exact-percentile window

@@ -74,6 +74,11 @@ NAMES = {
                               "replay"),
     "fleet.scale": ("span", "one executed autoscale decision "
                             "(grow/shrink/rebalance)"),
+    # ---- spans: async multisplitting (solvers/multisplit.py) ----
+    "multisplit.solve": ("span", "one asynchronous two-stage multisplit "
+                                 "solve: block threads + bounded-staleness "
+                                 "supervisor to the consistent-cut "
+                                 "convergence decision"),
     # ---- counters ----
     "dispatch.programs": ("counter", "compiled-program launches by "
                                      "program kind (ksp/ksp_many/"
@@ -114,6 +119,14 @@ NAMES = {
                                     "replicas"),
     "fleet.scale_decisions": ("counter", "autoscale decisions by action "
                                          "(grow/shrink/rebalance/hold)"),
+    "multisplit.step": ("counter", "completed async outer steps (inner "
+                                   "solve + publish) by block"),
+    "multisplit.resyncs": ("counter", "bounded-staleness re-syncs: a block "
+                                      "waited for a partner over the "
+                                      "-multisplit_max_stale bound"),
+    "multisplit.block_lost": ("counter", "blocks degraded to frozen-stale "
+                                         "after a device loss (each later "
+                                         "re-homed by the elastic path)"),
     "elastic.mesh_shrinks": ("counter", "executed degraded-mesh rebuilds"),
     "elastic.mesh_regrows": ("counter", "executed mesh RE-GROW rebuilds "
                                         "(healed capacity re-adopted)"),
@@ -143,6 +156,10 @@ NAMES = {
                                             "(the -log_view latency row)"),
     "serving.queue_wait_seconds": ("histogram", "submit -> dispatch wait "
                                                 "per request"),
+    "multisplit.stale_age": ("histogram", "staleness age (versions behind "
+                                          "the reader) of every boundary "
+                                          "read — the -log_view staleness "
+                                          "row"),
 }
 
 # Fault points the flight recorder records events for. MUST cover every
@@ -162,6 +179,8 @@ FLIGHT_FAULT_POINTS = (
     "spmv.result",
     "pc.apply",
     "device.lost",
+    "comm.delay",
+    "exchange.put",
 )
 
 

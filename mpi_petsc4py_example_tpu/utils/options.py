@@ -101,6 +101,32 @@ KNOWN_FLAGS = {
     "pc_setup_device": "where block inversions run (host/device/auto)",
     "pc_sor_omega": "SOR/SSOR relaxation factor",
     "pc_type": "preconditioner type",
+    # ---- asynchronous multisplitting (solvers/multisplit.py) ----
+    "multisplit_blocks": "row blocks of the two-stage splitting (default: "
+                         "one per device; each runs its own inner solve "
+                         "thread against stale boundaries)",
+    "multisplit_inner_max_it": "inner-solve iteration cap per async outer "
+                               "step (keeps steps short so exchanges stay "
+                               "fresh)",
+    "multisplit_inner_rtol": "inner-solve relative tolerance per outer "
+                             "step (loose: the outer iteration absorbs "
+                             "the slack)",
+    "multisplit_inner_type": "inner KSP type per block (any registered "
+                             "plan — cg/pipecg/sstep/...; the whole "
+                             "PC/precision/ABFT zoo applies)",
+    "multisplit_max_outer": "outer async step cap per block before "
+                            "DIVERGED_MAX_IT",
+    "multisplit_max_stale": "bounded-staleness limit: versions a partner "
+                            "may trail before the reader re-syncs "
+                            "(convergence itself is only ever declared "
+                            "at a consistent version cut)",
+    "multisplit_resync_timeout": "seconds a re-syncing block waits for a "
+                                 "lagging partner before treating it as "
+                                 "lost-in-progress and continuing stale",
+    "multisplit_urgent_stale": "effective staleness bound for QoS-urgent "
+                               "(interactive) serving sessions — tighter "
+                               "than -multisplit_max_stale, so urgent "
+                               "requests ride fresher exchanges",
     # ---- elastic degraded-mesh recovery (resilience/elastic.py) ----
     "elastic_enable": "arm the mesh-shrink escalation past same-mesh "
                       "retries on persistent device loss",

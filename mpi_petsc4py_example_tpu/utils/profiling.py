@@ -432,6 +432,26 @@ def log_view(file=None):
         print(f"per-iteration latency histogram ({per_iter.count} "
               f"solve(s), p50 {s['p50'] * 1e6:.1f} us, p99 "
               f"{s['p99'] * 1e6:.1f} us): {cells}", file=file)
+    stale = _REG.histogram("multisplit.stale_age")
+    if stale.count:
+        # the async-tier staleness row: the age (versions behind the
+        # reader) of every exchange read the multisplit block workers
+        # consumed, plus the bound enforcement counters — the tier's
+        # degradation budget made visible
+        s = stale.summary((50, 99))
+        occupied = [(b, c) for b, c in
+                    zip(list(stale.buckets) + [float("inf")],
+                        stale.bucket_counts()) if c]
+        cells = "  ".join(
+            (f">{stale.buckets[-1]:g}: {c}" if b == float("inf")
+             else f"<={b:g}: {c}") for b, c in occupied)
+        resyncs = int(_REG.counter("multisplit.resyncs").total())
+        lost = int(_REG.counter("multisplit.block_lost").total())
+        steps = int(_REG.counter("multisplit.step").total())
+        print(f"multisplit staleness histogram ({stale.count} read(s), "
+              f"{steps} step(s), p50 age {s['p50']:.1f}, p99 "
+              f"{s['p99']:.1f}, {resyncs} resync(s), {lost} block(s) "
+              f"lost): {cells}", file=file)
     print(f"compiled programs held: {program_count()}", file=file)
 
 

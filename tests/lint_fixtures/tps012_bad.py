@@ -27,3 +27,7 @@ def unregistered_new_point():
 
 def mistyped_loss_point(device_ids):
     return _faults.mesh_fault("device.los", device_ids)  # BAD: TPS012
+
+
+def mistyped_delay_point(block):
+    return _faults.delay_seconds("comm.dely", device=block)  # BAD: TPS012

@@ -26,6 +26,14 @@ def program_boundary(device_ids):
     return _faults.mesh_fault("device.lost", device_ids)
 
 
+def jittered_exchange(block):
+    # the async-tier points: the timing hook's point literal and the
+    # exchange-publish drop/partition point are both registered
+    delay = _faults.delay_seconds("comm.delay", device=block)
+    fault = _faults.triggered("exchange.put", device=block)
+    return delay, fault
+
+
 def dynamic_point(point):
     # not a string literal: the rule cannot verify it (the coverage
     # meta-test pins the registry from the literal sites instead)

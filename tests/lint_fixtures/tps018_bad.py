@@ -1,0 +1,35 @@
+"""TPS018 fixtures — convergence decisions on raw stale-exchange reads.
+
+Each marked line must produce exactly one finding.
+"""
+
+import numpy as np
+
+
+def stale_norm_convergence(exchange, rtol, bnorm):
+    """Compares a norm derived from an unbounded read against the
+    tolerance — the stale-local-norm anti-pattern."""
+    r = exchange.read(0, 10)
+    rnorm = np.linalg.norm(r.payload)
+    if rnorm <= rtol * bnorm:  # BAD: TPS018
+        return True
+    return False
+
+
+def stale_reads_set_reason(exch, target):
+    """Assigns the convergence outcome from unbounded read_all data."""
+    reads = exch.read_all(1, 7)
+    norms = [np.linalg.norm(r.payload) for r in reads.values()]
+    worst = max(norms)
+    converged = worst < target  # BAD: TPS018
+    return converged
+
+
+def stale_latest_tolerance_check(self_exchange, atol):
+    """.latest() is just as stale-tolerant as .read() — the frozen
+    payload of a lost block may be arbitrarily old."""
+    last = self_exchange.latest(2)
+    err = abs(float(last.payload[0]))
+    while err > atol:  # BAD: TPS018
+        err *= 0.5
+    return err

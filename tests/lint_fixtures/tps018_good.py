@@ -1,0 +1,46 @@
+"""TPS018 good fixtures — bounded or cut-based convergence, and
+non-convergence exchange reads. Zero findings expected."""
+
+import numpy as np
+
+from mpi_petsc4py_example_tpu.parallel.exchange import check_staleness_bound
+
+
+def bounded_convergence(exchange, rtol, bnorm, max_stale):
+    """The read flows through check_staleness_bound before the
+    tolerance comparison — the sanctioned pattern."""
+    reads = exchange.read_all(0, 10)
+    over = check_staleness_bound(reads, max_stale)
+    if over:
+        return False
+    rnorm = max(np.linalg.norm(r.payload) for r in reads.values())
+    return rnorm <= rtol * bnorm
+
+
+def cut_convergence(exch, target):
+    """Convergence declared at a consistent cut — the supervisor's
+    pattern."""
+    cut = exch.consistent_cut()
+    if cut is None:
+        return False
+    _version, payloads = cut
+    rnorm = np.linalg.norm(np.concatenate(list(payloads.values())))
+    return rnorm < target
+
+
+def relaxation_step(exchange, x_local, a_off):
+    """Exchange reads feeding the NEXT relaxation step (not a
+    convergence decision) are exactly what the tier is for — no
+    bound check required here."""
+    reads = exchange.read_all(3, 5)
+    x_stale = np.zeros_like(x_local)
+    for _nb, r in reads.items():
+        if r.payload is not None:
+            x_stale += r.payload
+    return x_local - a_off.dot(x_stale)
+
+
+def tolerance_without_reads(rtol, bnorm, rnorm):
+    """Tolerance comparisons with no exchange read in sight stay
+    silent."""
+    return rnorm <= rtol * bnorm

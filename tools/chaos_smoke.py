@@ -43,6 +43,14 @@ Modes:
   solve past iteration 0, and a mixed-QoS router drill with one
   injected ``device.lost`` AND one ``heal()`` mid-load, exiting nonzero
   unless every future resolves and post-heal capacity returns;
+* ``--multisplit`` (ISSUE 17): the asynchronous-tier drills — a sticky
+  slow device (``comm.delay`` timing fault) must be absorbed as bounded
+  staleness (resyncs fire, the solve converges to strict fp64 parity);
+  a mid-solve ``device.lost`` must degrade to ONE stale block and
+  re-home it, with every block's published version sequence strictly
+  increasing across the loss (survivors provably never revisit
+  iteration 0); and an ``exchange.put`` drop/partition must only ever
+  cost staleness, never correctness;
 * neither: the builtin silent-corruption sweep over every silent fault
   kind at every injectable point (spmv.result / pc.apply / comm.psum).
 
@@ -626,6 +634,177 @@ def drill_fleet_serving() -> list[str]:
     return [f"fleet-serving: {p}" for p in problems]
 
 
+def _multisplit_problem(n=256, nblocks=4, seed=3):
+    """Block-diagonally-dominant model problem (the Frommer–Szyld
+    convergence condition the async tier documents) + manufactured
+    solution."""
+    import scipy.sparse as sp
+
+    A = sp.diags([-1.0, 4.0, -1.0], [-1, 0, 1], shape=(n, n),
+                 format="csr")
+    x_true = np.random.default_rng(seed).random(n)
+    b = np.asarray(A @ x_true)
+    return A, b, x_true, nblocks
+
+
+def drill_multisplit_jitter() -> list[str]:
+    """Sticky slow device under the async tier (``--multisplit``): a
+    seeded ``comm.delay`` timing fault pins one block's device at +20 ms
+    per step. The bounded-staleness supervisor must absorb it — resyncs
+    fire, observed staleness stays within the bound — and the solve must
+    still land at strict fp64 parity. Every synchronous plan pays this
+    straggler at every reduction; cfg16 measures that crossover, this
+    drill proves the tolerance machinery."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.solvers.multisplit import MultisplitSolver
+
+    problems: list[str] = []
+    A, b, _x_true, nblocks = _multisplit_problem()
+    bound = 3
+    ms = MultisplitSolver(nblocks=nblocks, max_stale=bound, rtol=RTOL)
+    ms.set_operator(A)
+    slow = ms._blocks[1].device_id
+    spec = f"comm.delay=delay:device={slow}:times=*:mean=0.02:seed=7"
+    try:
+        with tps.inject_faults(spec):
+            res = ms.solve(b)
+    finally:
+        _faults.heal()
+    if not res.converged:
+        problems.append(f"jittered solve did not converge: {res}")
+    if res.resyncs == 0:
+        problems.append("sticky slow device never forced a resync — the "
+                        "staleness bound is not being enforced")
+    # ages grow by at most 1 per outer step, so the FIRST over-bound
+    # read — the one that triggers the resync — records bound+1; any
+    # age past that means a resync failed to pull the partner back
+    if res.max_stale_seen > bound + 1:
+        problems.append(f"observed staleness {res.max_stale_seen} "
+                        f"exceeds the enforced bound {bound}+1")
+    rtrue = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+    if not rtrue <= RTOL:
+        problems.append(f"true relative residual {rtrue:.3e} misses the "
+                        "strict tolerance")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] multisplit-jitter: {status} cut={res.cut_version} "
+          f"resyncs={res.resyncs} max_stale_seen={res.max_stale_seen} "
+          f"true_rres={rtrue:.3e}")
+    return [f"multisplit-jitter: {p}" for p in problems]
+
+
+def drill_multisplit_lost() -> list[str]:
+    """Mid-solve ``device.lost`` under the async tier (``--multisplit``,
+    the ISSUE 17 acceptance drill): the solve must degrade to ONE stale
+    block (survivors iterate against its frozen last-exchanged version),
+    re-home the lost block onto a survivor, and converge to strict fp64
+    tolerance — with every block's published version sequence strictly
+    increasing across the loss. A restart-from-iteration-0 anywhere
+    would publish a version at or below one already seen; the recorded
+    sequences make 'survivors never revisit iteration 0' a checked
+    property, not prose."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.parallel import exchange as _ex
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.solvers.multisplit import MultisplitSolver
+
+    problems: list[str] = []
+    A, b, _x_true, nblocks = _multisplit_problem()
+    published: dict[int, list[int]] = {}
+    rehomes: list[tuple[int, int]] = []
+    orig_pub = _ex.StaleExchange.publish
+    orig_repub = _ex.StaleExchange.republish
+
+    def pub(self, block, payload):
+        v = orig_pub(self, block, payload)
+        if v is not None:
+            published.setdefault(block, []).append(v)
+        return v
+
+    def repub(self, block, payload, *, version=None):
+        orig_repub(self, block, payload, version=version)
+        rehomes.append((block, self.version(block)))
+
+    ms = MultisplitSolver(nblocks=nblocks, rtol=RTOL)
+    ms.set_operator(A)
+    victim = ms._blocks[2].device_id
+    _ex.StaleExchange.publish = pub
+    _ex.StaleExchange.republish = repub
+    try:
+        with tps.inject_faults(
+                f"device.lost=unavailable:device={victim}:at=5"):
+            res = ms.solve(b)
+    finally:
+        _ex.StaleExchange.publish = orig_pub
+        _ex.StaleExchange.republish = orig_repub
+        _faults.heal()
+    if not res.converged:
+        problems.append(f"degraded solve did not converge: {res}")
+    if res.blocks_lost < 1:
+        problems.append("the armed device.lost never cost a block")
+    if not rehomes:
+        problems.append("the lost block was never re-homed")
+    if min(res.block_steps) <= 0:
+        problems.append(f"a block reports zero outer steps: "
+                        f"{res.block_steps}")
+    for blk, seq in sorted(published.items()):
+        if any(b2 <= a for a, b2 in zip(seq, seq[1:])):
+            problems.append(
+                f"block {blk} published a non-increasing version "
+                f"sequence {seq[:12]}... — somebody revisited "
+                "iteration 0")
+    for blk, frozen in rehomes:
+        later = [v for v in published.get(blk, []) if v > frozen]
+        if not later and res.converged:
+            problems.append(
+                f"re-homed block {blk} never published past its frozen "
+                f"version {frozen} — re-home did not resume progress")
+    rtrue = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+    if not rtrue <= RTOL:
+        problems.append(f"true relative residual {rtrue:.3e} misses the "
+                        "strict tolerance")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] multisplit-lost: {status} cut={res.cut_version} "
+          f"blocks_lost={res.blocks_lost} steps={res.block_steps} "
+          f"rehomes={rehomes} true_rres={rtrue:.3e}")
+    return [f"multisplit-lost: {p}" for p in problems]
+
+
+def drill_multisplit_partition() -> list[str]:
+    """Exchange partition under the async tier (``--multisplit``): an
+    armed ``exchange.put`` drop fault discards a block's publishes — its
+    peers see a frozen version and its staleness grows — yet the solve
+    may only pay TIME (extra outer steps / resyncs), never correctness:
+    strict fp64 parity at a consistent cut."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.solvers.multisplit import MultisplitSolver
+
+    problems: list[str] = []
+    A, b, _x_true, nblocks = _multisplit_problem()
+    ms = MultisplitSolver(nblocks=nblocks, rtol=RTOL)
+    ms.set_operator(A)
+    try:
+        with tps.inject_faults("exchange.put=drop:device=3:at=3:times=6"):
+            res = ms.solve(b)
+    finally:
+        _faults.heal()
+    drops = ms._exchange.drops
+    if not res.converged:
+        problems.append(f"partitioned solve did not converge: {res}")
+    if drops < 1:
+        problems.append("the armed exchange.put fault never dropped a "
+                        "publish")
+    rtrue = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+    if not rtrue <= RTOL:
+        problems.append(f"true relative residual {rtrue:.3e} misses the "
+                        "strict tolerance")
+    status = "OK" if not problems else "FAIL"
+    print(f"[chaos] multisplit-partition: {status} cut={res.cut_version} "
+          f"drops={drops} resyncs={res.resyncs} true_rres={rtrue:.3e}")
+    return [f"multisplit-partition: {p}" for p in problems]
+
+
 def validate_trace(trace_path: str, evict: bool) -> list[str]:
     """Structural validation of the exported Perfetto trace + flight
     dump — the CI telemetry job's schema gate."""
@@ -721,6 +900,15 @@ def main() -> int:
         # attempt
         failures += drill_megasolve()
         what = "megasolve fused-loop corruption"
+    elif "--multisplit" in sys.argv[1:]:
+        # ISSUE 17 acceptance: the async tier must absorb a sticky slow
+        # device as bounded staleness, degrade a mid-solve device.lost
+        # to ONE stale block (survivors provably never revisit
+        # iteration 0), and pay an exchange partition only in staleness
+        failures += drill_multisplit_jitter()
+        failures += drill_multisplit_lost()
+        failures += drill_multisplit_partition()
+        what = "asynchronous-multisplit staleness/loss"
     elif "--sstep" in sys.argv[1:]:
         # ISSUE 15 acceptance: a bitflip inside an s-block must detect
         # -> rollback to the verified carry -> re-enter, and the

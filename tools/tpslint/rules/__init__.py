@@ -6,7 +6,8 @@ from . import (tps001_host_sync, tps002_recompile, tps003_axis_name,
                tps007_options_registry, tps008_interproc_sync,
                tps009_sharding, tps010_grid_spec, tps011_psum_fusion,
                tps012_fault_registry, tps013_donation, tps014_telemetry,
-               tps015_dispatch_loop, tps016_lock_order, tps017_channel_mix)
+               tps015_dispatch_loop, tps016_lock_order, tps017_channel_mix,
+               tps018_staleness_bound)
 
 
 def all_rules() -> dict:

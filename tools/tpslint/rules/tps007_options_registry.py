@@ -37,7 +37,8 @@ _GETTERS = ("get", "get_string", "get_int", "get_real", "get_bool", "has")
 #: recovery's -elastic_* family, and the -telemetry* observability
 #: family — whose master switch is the bare flag 'telemetry')
 _FLAG_RE = re.compile(
-    r"^((ksp|eps|pc|svd|st|solve_server|elastic|fleet|qos|autoscale)"
+    r"^((ksp|eps|pc|svd|st|solve_server|elastic|fleet|qos|autoscale"
+    r"|multisplit)"
     r"_[a-z0-9_]+"
     r"|telemetry(_[a-z0-9_]+)?)$")
 

@@ -30,8 +30,10 @@ from .base import Rule, register
 #: (apply_silent_fault is resilience/abft.py's trace-time applicator for
 #: the silent kinds — its point argument names FAULT_POINTS entries too;
 #: mesh_fault is the persistent-device-loss hook at the solve-program
-#: boundary, point-name first, device ids second)
-_HOOKS = ("check", "triggered", "apply_silent_fault", "mesh_fault")
+#: boundary, point-name first, device ids second; delay_seconds is the
+#: timing hook — 'comm.delay' latency injection, point-name first)
+_HOOKS = ("check", "triggered", "apply_silent_fault", "mesh_fault",
+          "delay_seconds")
 #: module aliases the repo binds resilience.faults / resilience.abft to
 _MODULE_NAMES = ("faults", "_faults", "abft", "_abft")
 

@@ -1,0 +1,198 @@
+"""TPS018 — staleness-bound discipline for stale-exchange reads.
+
+The asynchronous multisplit tier (solvers/multisplit.py) reads neighbor
+iterates from a stale-tolerant exchange buffer
+(parallel/exchange.StaleExchange): reads NEVER block and may be
+arbitrarily old.  That is fine for the relaxation itself — bounded
+staleness still contracts — but it is catastrophic for CONVERGENCE
+decisions: a stale per-block norm routinely undershoots the true
+residual, so a solve that compares exchange-read data against a
+tolerance declares victory on an iterate nobody ever assembled.  The
+repo's contract (module docs of both files) is that convergence is
+declared ONLY through the bounded-staleness machinery:
+
+* :func:`parallel.exchange.check_staleness_bound` — the explicit bound
+  check every convergence-feeding read must flow through, or
+* :meth:`StaleExchange.consistent_cut` — the matching-version cut the
+  supervisor assembles the residual check from.
+
+This rule enforces the call-site half of that contract, lexically and
+per-function: a function that (a) reads from a stale exchange
+(``.read()`` / ``.read_all()`` / ``.latest()`` on a receiver whose name
+contains ``exch``), and (b) lets a read-derived value flow into a
+convergence decision — a comparison against a tolerance/target name, or
+an assignment to a ``*converged*``/``*reason*`` name — must (c) also
+call one of the sanitizers above in the same function.  Functions that
+read the exchange for non-convergence purposes (assembling the stale
+boundary for the next relaxation step) are untouched: only the
+convergence-shaped sinks trigger.
+
+Like every tpslint rule this is conservative and syntactic: taint does
+not flow through helper calls or containers, and a sanitizer anywhere
+in the function clears it (the resync/bound structure is not checked —
+only that the author engaged the bounded-staleness machinery at all).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES, terminal_name
+from .base import Rule, register
+
+#: exchange-read methods whose results are stale-tolerant data
+_SOURCE_METHODS = frozenset({"read", "read_all", "latest"})
+#: a receiver counts as a stale exchange when its terminal name contains
+#: this fragment (exchange / exch / _exchange / self._exchange ...)
+_RECEIVER_FRAGMENT = "exch"
+#: calls that clear a function: the bounded-staleness check or the
+#: consistent-cut assembly (either terminal spelling — function or
+#: method)
+_SANITIZERS = frozenset({"check_staleness_bound", "consistent_cut"})
+#: name fragments that mark the comparison partner of a convergence
+#: decision (rtol/atol/tol/target thresholds)
+_TOL_FRAGMENTS = ("tol", "target", "threshold")
+#: assignment-target fragments that mark a convergence outcome
+_DECISION_FRAGMENTS = ("converg", "reason")
+
+
+def _is_exchange_read(node) -> bool:
+    """``<exch>.read(...)`` / ``.read_all(...)`` / ``.latest(...)``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SOURCE_METHODS):
+        return False
+    recv = terminal_name(node.func.value)
+    return recv is not None and _RECEIVER_FRAGMENT in recv.lower()
+
+
+def _walk_local(func):
+    """Walk a function's OWN body, not descending into nested function
+    definitions (each gets analyzed as its own context)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FUNCTION_NODES + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _mentions_fragment(node, fragments) -> bool:
+    """Any Name/Attribute identifier in ``node`` containing one of the
+    lowercase ``fragments``."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is not None:
+            low = name.lower()
+            if any(f in low for f in fragments):
+                return True
+    return False
+
+
+def _assign_name(target) -> str | None:
+    while isinstance(target, (ast.Subscript, ast.Starred)):
+        target = target.value
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+@register
+class StalenessBoundRule(Rule):
+    id = "TPS018"
+    name = "staleness-bound"
+    description = ("stale-exchange reads feeding a convergence decision "
+                   "must flow through check_staleness_bound() or "
+                   "consistent_cut() — a stale local norm is never a "
+                   "convergence basis")
+    severity = "error"
+
+    def check(self, module):
+        for func in ast.walk(module.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(func)
+
+    def _check_function(self, func):
+        has_source = False
+        sanitized = False
+        for node in _walk_local(func):
+            if isinstance(node, ast.Call):
+                if terminal_name(node.func) in _SANITIZERS:
+                    sanitized = True
+                if _is_exchange_read(node):
+                    has_source = True
+        if sanitized or not has_source:
+            return
+        # taint: names assigned (transitively) from an exchange read,
+        # grown to a fixpoint — source order is irrelevant
+        tainted = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in _walk_local(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _contains_source_or_taint(node.value, tainted):
+                    continue
+                for tgt in node.targets:
+                    name = _assign_name(tgt)
+                    if name is not None and name not in tainted:
+                        tainted.add(name)
+                        changed = True
+        yield from self._sinks(func, tainted)
+
+    def _sinks(self, func, tainted):
+        for node in _walk_local(func):
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                t_side = any(_contains_source_or_taint(s, tainted)
+                             for s in sides)
+                tol_side = any(
+                    _mentions_fragment(s, _TOL_FRAGMENTS) for s in sides)
+                if t_side and tol_side:
+                    yield self.finding(
+                        node,
+                        "convergence decision on a raw stale-exchange "
+                        "read: the compared value derives from "
+                        ".read()/.read_all()/.latest() with no "
+                        "check_staleness_bound()/consistent_cut() in "
+                        "this function — a stale local norm "
+                        "undershoots the true residual; bound the "
+                        "staleness or declare at a consistent cut")
+            elif isinstance(node, ast.Assign):
+                if not _contains_source_or_taint(node.value, tainted):
+                    continue
+                for tgt in node.targets:
+                    name = _assign_name(tgt)
+                    if name is None:
+                        continue
+                    low = name.lower()
+                    if any(f in low for f in _DECISION_FRAGMENTS):
+                        yield self.finding(
+                            node,
+                            f"convergence outcome {name!r} assigned "
+                            "from a raw stale-exchange read with no "
+                            "check_staleness_bound()/consistent_cut() "
+                            "in this function — stale data is never a "
+                            "convergence basis")
+
+
+def _contains_source_or_taint(node, tainted) -> bool:
+    """Does ``node``'s subtree hold an exchange read or a tainted name?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if _is_exchange_read(sub):
+            return True
+    return False
