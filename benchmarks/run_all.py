@@ -60,6 +60,16 @@ Configs (BASELINE.json `configs` + the round-6 reference-precision row):
      property), and one injected device loss AND one heal mid-load
      with the strict per-request fp64 residual-parity gate applied
      across BOTH the shrink and re-grow boundaries
+ 17. persistent serving: sustained Poisson-arrival load where every
+     request carries a UNIQUE rtol (the coalescer can never group two),
+     served by a persistent device-resident session (per-slot
+     tolerances, cross-batch staging) vs the per-batch megasolve
+     session — sustained solves/s, p50/p99 latency, and the measured
+     ``dispatch.programs`` per request: the per-batch tier pays one
+     launch per request on this workload, the persistent tier
+     amortizes to < 1 (the ISSUE-18 acceptance gate), with the strict
+     per-request fp64 residual-parity gate against each request's OWN
+     rtol
 
 CPU baselines use scipy (fp64) where a matching algorithm exists; scipy is
 the only CPU oracle available (SURVEY.md §4).
@@ -291,6 +301,11 @@ _REQUIRED_FIELDS = {
         "jitter_grid_us", "straggler_model", "cpu_mesh_caveat",
         "jitter_crossover_us", "async_wins_at_jitter",
         "refined_rel_residual", "residual_parity"),
+    "cfg17_persistent": (
+        "wall_s", "requests", "slots", "persistent", "per_batch",
+        "dispatches_per_request_persistent",
+        "dispatches_per_request_batch", "amortization_ok",
+        "solves_per_s_ratio", "cpu_mesh_caveat", "residual_parity"),
 }
 
 
@@ -2074,6 +2089,145 @@ def config16(comm, quick):
         residual_parity=bool(parity_ok))
 
 
+def config17(comm, quick):
+    """cfg17_persistent: the device-resident request queue under
+    sustained load — amortized dispatch vs the per-batch tier.
+
+    The workload isolates exactly the structural difference ISSUE 18
+    names: every request carries a UNIQUE rtol, so the coalescer's
+    compatibility grouping can never put two of them in one block and
+    the per-batch megasolve tier pays one ``megasolve_many`` launch per
+    request. The persistent tier takes ``(Q,)``-shaped per-slot
+    tolerance operands, so those same incompatible requests STAGE
+    ACROSS batches into shared launches — the measured
+    ``dispatch.programs`` per request drops below 1 (the acceptance
+    gate; at full slot occupancy it approaches 1/Q). Arrivals are
+    Poisson (seeded exponential gaps), identical in both modes; both
+    modes run a warm pre-burst first so program compiles are mostly
+    outside the measured window. Per-request strict parity: each
+    answer's fp64 TRUE relative residual must meet that request's OWN
+    rtol.
+
+    CPU-mesh caveats (committed into the JSON): dispatch here costs
+    microseconds, so the WALL-clock win from removing launches is
+    noise on this host — ``dispatches_per_request_*`` is the honest
+    headline, and the solves/s ratio is reported, not gated. On the
+    ~100 ms/launch tunnel runtime every launch the persistent tier
+    removes is worth its full dispatch latency. Occasional mid-run
+    retraces (a pow2 slot width first seen during the measured burst)
+    add wall noise the warm pre-burst cannot fully remove."""
+    from mpi_petsc4py_example_tpu.serving import SolveServer
+    from mpi_petsc4py_example_tpu.utils.profiling import dispatch_counts
+
+    rtol0 = 1e-8
+    nx = 12 if quick else 24
+    A = poisson3d_csr(nx)
+    n = A.shape[0]
+    R = 32 if quick else 96
+    Q = 8
+    rng = np.random.default_rng(17)
+    Xt = rng.random((n, R))
+    B = np.asarray(A @ Xt)
+    bn = np.linalg.norm(B, axis=0)
+    # every request a UNIQUE rtol: same tolerance CLASS, never the same
+    # compatibility group (floats differ) — the per-batch tier cannot
+    # coalesce, the persistent tier does not need to
+    rtols = [rtol0 * (1.0 + j / (2.0 * R)) for j in range(R)]
+    gaps = rng.exponential(0.0005, size=R)
+    t_cfg = time.perf_counter()
+
+    def run(persistent):
+        parity = True
+        with SolveServer(comm, window=0.002, max_k=Q,
+                         autostart=True) as srv:
+            srv.register_operator("p", A, ksp_type="cg",
+                                  pc_type="jacobi", rtol=rtol0,
+                                  megasolve=not persistent,
+                                  persistent=persistent)
+            # warm pre-burst: touch the pow2 slot widths (persistent)
+            # / the width-1 block (per-batch) so compiles land before
+            # the measured window
+            for w in (Q, 3, 1):
+                ws = [srv.submit("p", B[:, j % R], rtol=rtols[j % R])
+                      for j in range(w)]
+                [f.result(600) for f in ws]
+                srv.drain(600)
+            mid = dispatch_counts()
+            t_sub, t_done, futs = {}, {}, []
+            t0 = time.perf_counter()
+            for j in range(R):
+                time.sleep(gaps[j])
+                t_sub[j] = time.perf_counter()
+                f = srv.submit("p", B[:, j], rtol=rtols[j])
+                f.add_done_callback(
+                    lambda _f, i=j: t_done.__setitem__(
+                        i, time.perf_counter()))
+                futs.append(f)
+            served = [f.result(600) for f in futs]
+            srv.drain(600)
+            wall = time.perf_counter() - t0
+            stats = srv.stats()
+            after = dispatch_counts()
+        for j, r in enumerate(served):
+            rres = float(np.linalg.norm(B[:, j] - A @ r.x)
+                         / max(bn[j], 1e-300))
+            parity = parity and bool(r.converged
+                                     and rres <= rtols[j] * 1.05)
+        # TOTAL compiled-program launches across the measured burst
+        # (every kind): the denominator a per-request launch budget is
+        # honestly charged against
+        disp = int(sum(after.values()) - sum(mid.values()))
+        lat = sorted(t_done[j] - t_sub[j] for j in range(R))
+        row = dict(
+            requests=R, wall_s=round(wall, 4),
+            solves_per_s=round(R / wall, 1),
+            p50_latency_ms=round(lat[len(lat) // 2] * 1e3, 2),
+            p99_latency_ms=round(lat[min(len(lat) - 1,
+                                         int(len(lat) * 0.99))] * 1e3,
+                                 2),
+            dispatches=disp,
+            dispatches_per_request=round(disp / R, 4),
+            batches=int(stats["batches"]),
+            residual_parity=bool(parity))
+        if persistent:
+            pst = stats.get("persistent", {}).get("p", {})
+            row.update(launches=int(pst.get("launches", 0)),
+                       mean_requests_per_launch=round(
+                           pst.get("requests", 0)
+                           / max(pst.get("launches", 1), 1), 2),
+                       padded_slots=int(pst.get("padded_slots", 0)),
+                       turnovers=int(pst.get("turnovers", 0)),
+                       fallbacks=int(pst.get("fallbacks", 0)))
+        return row
+
+    per_batch = run(persistent=False)
+    pers = run(persistent=True)
+    dpr_p = pers["dispatches_per_request"]
+    dpr_b = per_batch["dispatches_per_request"]
+    return dict(
+        config="cfg17_persistent", n=n, devices=int(comm.size),
+        requests=R, slots=Q,
+        wall_s=round(time.perf_counter() - t_cfg, 4),
+        persistent=pers, per_batch=per_batch,
+        dispatches_per_request_persistent=dpr_p,
+        dispatches_per_request_batch=dpr_b,
+        amortization_ok=bool(dpr_p < 1.0 <= dpr_b),
+        solves_per_s_ratio=round(pers["solves_per_s"]
+                                 / max(per_batch["solves_per_s"],
+                                       1e-12), 3),
+        cpu_mesh_caveat=(
+            "single-host virtual mesh: dispatch costs microseconds, so "
+            "the wall/solves_per_s columns mostly measure host "
+            "orchestration and occasional mid-burst retraces, not the "
+            "launch amortization — dispatches_per_request_* is the "
+            "honest headline (gated < 1 persistent, >= 1 per-batch on "
+            "this unique-rtol workload). On the ~100 ms/launch tunnel "
+            "runtime each launch the persistent tier removes is worth "
+            "its full dispatch latency."),
+        residual_parity=bool(pers["residual_parity"]
+                             and per_batch["residual_parity"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -2094,7 +2248,7 @@ def main():
                 "cfg7": config7, "cfg8": config8, "cfg9": config9,
                 "cfg10": config10, "cfg11": config11, "cfg12": config12,
                 "cfg13": config13, "cfg14": config14, "cfg15": config15,
-                "cfg16": config16}
+                "cfg16": config16, "cfg17": config17}
     if opts.configs:
         names = [s.strip() for s in opts.configs.split(",") if s.strip()]
         bad = [s for s in names if s not in all_cfgs]

@@ -46,6 +46,7 @@ import scipy.sparse as sp
 #: the reverse-coverage meta-test pins registry kinds == this vocabulary
 #: and greps the solver sources for each literal's use site
 PROGRAM_KINDS = ("ksp", "ksp_many", "megasolve", "megasolve_many",
+                 "persistent_serve",
                  "seedfacto", "restartfacto", "heploop",
                  "multisplit_block", "multisplit_residual")
 
@@ -157,6 +158,8 @@ def _raw_programs():
         krylov_mod._PROGRAM_CACHE.clear()
         krylov_mod._PROGRAM_CACHE_MANY.clear()
         mega_mod._MEGASOLVE_CACHE.clear()
+        mega_mod._MEGASOLVE_CACHE_MANY.clear()
+        mega_mod._PERSISTENT_CACHE.clear()
         eps_mod._PROGRAM_CACHE.clear()
 
     prev = os.environ.get("TPU_SOLVE_AOT")
@@ -252,14 +255,16 @@ def lower_ksp(comm, ksp_type="cg", pc_type="none", operator="ell",
 
 
 def lower_megasolve(comm, ksp_type="cg", pc_type="jacobi", guard=False,
-                    rr=False, nrhs=None):
+                    rr=False, nrhs=None, operator="ell",
+                    stencil_fastpath=False):
     """Lower a fused whole-solve (megasolve) program to StableHLO
     text."""
     from .solvers.megasolve import (build_megasolve_program,
                                     build_megasolve_program_many)
     from .utils.convergence import ConvergedReason
     with _raw_programs():
-        M = _mat(comm, "ell")
+        M = _mat(comm, operator)
+        n = int(M.shape[0])
         pc = _ksp_pc(comm, M, ksp_type, pc_type)
         dt = np.dtype(np.float64)
         scal = (dt.type(1e-10), dt.type(0.0), dt.type(1e-10),
@@ -272,16 +277,44 @@ def lower_megasolve(comm, ksp_type="cg", pc_type="jacobi", guard=False,
         if nrhs is not None:
             prog = build_megasolve_program_many(
                 comm, ksp_type, pc, M, None, nrhs=nrhs, abft=guard,
-                abft_pc=guard, rr=rr)
-            Bp = comm.put_rows(np.zeros((N, nrhs)))
-            X0 = comm.put_rows(np.zeros((N, nrhs)))
+                abft_pc=guard, rr=rr,
+                stencil_fastpath=stencil_fastpath)
+            Bp = comm.put_rows(np.zeros((n, nrhs)))
+            X0 = comm.put_rows(np.zeros((n, nrhs)))
             return prog.lower(M.device_arrays(), pc.device_arrays(),
                               *cs_args, Bp, X0, *scal).as_text()
         prog = build_megasolve_program(comm, ksp_type, pc, M, None,
-                                       abft=guard, abft_pc=guard, rr=rr)
+                                       abft=guard, abft_pc=guard, rr=rr,
+                                       stencil_fastpath=stencil_fastpath)
         x, b = M.get_vecs()
         return prog.lower(M.device_arrays(), pc.device_arrays(),
                           *cs_args, b.data, x.data, *scal).as_text()
+
+
+def lower_persistent(comm, ksp_type="cg", pc_type="jacobi", nrhs=NRHS,
+                     operator="ell", stencil_fastpath=False):
+    """Lower the persistent-serving multi-request program
+    (serving/persistent.py) to StableHLO text: the megasolve_many body
+    fed PER-SLOT ``(nrhs,)``-shaped rtol/atol operands, with the X0
+    slot buffer donated (the double-buffered launch discipline)."""
+    from .solvers.megasolve import build_megasolve_program_many
+    from .utils.convergence import ConvergedReason
+    with _raw_programs():
+        M = _mat(comm, operator)
+        n = int(M.shape[0])
+        pc = _ksp_pc(comm, M, ksp_type, pc_type)
+        dt = np.dtype(np.float64)
+        rt = np.full(nrhs, 1e-10)
+        at = np.zeros(nrhs)
+        scal = (rt, at, rt.copy(), dt.type(0.0), np.int32(50),
+                np.int32(4), np.int32(ConvergedReason.DIVERGED_MAX_IT))
+        prog = build_megasolve_program_many(
+            comm, ksp_type, pc, M, None, nrhs=nrhs, donate=True,
+            stencil_fastpath=stencil_fastpath, persistent=True)
+        Bp = comm.put_rows(np.zeros((n, nrhs)))
+        X0 = comm.put_rows(np.zeros((n, nrhs)))
+        return prog.lower(M.device_arrays(), pc.device_arrays(),
+                          Bp, X0, *scal).as_text()
 
 
 def lower_seedfacto(comm):
@@ -419,6 +452,9 @@ _DIA_DEPS = _KSP_DEPS + (f"{_PKG}/models/generators.py",)
 _STENCIL_DEPS = _KSP_DEPS + (f"{_PKG}/models/stencil.py",
                              f"{_PKG}/ops/pallas_stencil.py")
 _MEGA_DEPS = _KSP_DEPS + (f"{_PKG}/solvers/megasolve.py",)
+_MEGA_STENCIL_DEPS = _MEGA_DEPS + (f"{_PKG}/models/stencil.py",
+                                   f"{_PKG}/ops/pallas_stencil.py")
+_PERSISTENT_DEPS = _MEGA_DEPS + (f"{_PKG}/serving/persistent.py",)
 _EPS_DEPS = (f"{_PKG}/solvers/eps.py", f"{_PKG}/ops/spmv.py")
 
 _F64 = frozenset({"f64"})
@@ -777,6 +813,48 @@ def _contracts():
           build=lambda comm: lower_megasolve(comm, "cg", nrhs=NRHS),
           reduce_site_chain=(4, 2),
           deps=_MEGA_DEPS),
+        C(name="megasolve/cg-stencil", kind="megasolve",
+          description="fused megasolve with the stencil fused-dot "
+                      "inner fast path: the Pallas kernel folds "
+                      "<p, Ap> into the SpMV pass, so the inner chain "
+                      "drops from the flat-apply plan's 3 sites to 2, "
+                      "and the halo channel replaces every gather",
+          build=lambda comm: lower_megasolve(
+              comm, "cg", operator="stencil", stencil_fastpath=True),
+          reduce_site_chain=(4, 2), forbid_gathers=True,
+          deps=_MEGA_STENCIL_DEPS),
+        C(name="megasolve_many/cg-stencil/k8", kind="megasolve_many",
+          description="batched stencil fast path at nrhs=8 keeps the "
+                      "[4, 2] chain with zero gathers — per-column "
+                      "fused dots ride the same kernel pass",
+          build=lambda comm: lower_megasolve(
+              comm, "cg", nrhs=NRHS, operator="stencil",
+              stencil_fastpath=True),
+          reduce_site_chain=(4, 2), forbid_gathers=True,
+          deps=_MEGA_STENCIL_DEPS),
+        # ----- persistent serving programs -----
+        C(name="persistent_serve/cg/k8", kind="persistent_serve",
+          description="the resident multi-request serving program: "
+                      "megasolve_many's [4, 2] schedule under per-slot "
+                      "(Q,)-shaped tolerance operands, with the X0 "
+                      "slot buffer donated (the double-buffer launch "
+                      "discipline) — a lost donation doubles the "
+                      "resident slot memory every launch",
+          build=lambda comm: lower_persistent(comm),
+          reduce_site_chain=(4, 2), min_donated_args=1,
+          deps=_PERSISTENT_DEPS),
+        C(name="persistent_serve/cg-stencil/k8",
+          kind="persistent_serve",
+          description="persistent serving over the stencil fused-dot "
+                      "fast path: per-slot tolerances + donation + "
+                      "the gather-free halo channel in one program — "
+                      "the full cfg17 serving configuration",
+          build=lambda comm: lower_persistent(
+              comm, operator="stencil", stencil_fastpath=True),
+          reduce_site_chain=(4, 2), min_donated_args=1,
+          forbid_gathers=True,
+          deps=_PERSISTENT_DEPS + (f"{_PKG}/models/stencil.py",
+                                   f"{_PKG}/ops/pallas_stencil.py")),
         # ----- fused EPS programs -----
         C(name="seedfacto/ell", kind="seedfacto",
           description="seed+factorization: the only gather is the "

@@ -12,12 +12,14 @@ reuse-the-KSP-object idiom.
 
 from .coalescer import SolveRequest, coalesce, padded_width
 from .fleet import HashRing, SolveRouter
+from .persistent import PersistentRunner
 from .qos import AutoscalePolicy, QoSClass, ScaleDecision
 from .server import (ServedSolveResult, ServerClosedError, SolveServer)
 
 __all__ = [
     "SolveServer", "ServedSolveResult", "ServerClosedError",
     "SolveRequest", "coalesce", "padded_width",
+    "PersistentRunner",
     "SolveRouter", "HashRing",
     "QoSClass", "AutoscalePolicy", "ScaleDecision",
 ]

@@ -137,9 +137,10 @@ class _OperatorSession:
     ``register_operator`` documented."""
 
     __slots__ = ("name", "operator", "ksp", "dtype", "n",
-                 "rtol", "atol", "max_it", "multisplit")
+                 "rtol", "atol", "max_it", "multisplit", "persistent")
 
-    def __init__(self, name, operator, ksp, multisplit=None):
+    def __init__(self, name, operator, ksp, multisplit=None,
+                 persistent=None):
         self.name = name
         self.operator = operator
         self.ksp = ksp
@@ -149,6 +150,7 @@ class _OperatorSession:
         self.atol = float(ksp.atol)
         self.max_it = int(ksp.max_it)
         self.multisplit = multisplit   # async-tier solver, or None
+        self.persistent = persistent   # PersistentRunner, or None
 
     @property
     def schedule(self) -> str:
@@ -295,6 +297,7 @@ class SolveServer:
                           residual_replacement: int = 0,
                           megasolve: bool = False,
                           multisplit: bool = False,
+                          persistent: bool = False,
                           warm_widths=()):
         """Register operator ``name`` and make its solve state resident.
 
@@ -325,6 +328,18 @@ class SolveServer:
         The session KSP also applies the options DB (``-ksp_*`` flags —
         abft, residual replacement, true-residual gating, megasolve —
         override these defaults at runtime, the PETSc precedence).
+
+        ``persistent`` (or ``-solve_server_persistent``) registers the
+        session in PERSISTENT serving mode (serving/persistent.py):
+        dispatched batches stage into a double-buffered device-resident
+        multi-request program — one ``persistent_serve`` launch drains
+        up to ``max_k`` request slots, each a full megasolve with
+        per-slot masked independence and per-slot tolerances — so
+        sustained traffic pays amortized ≪ 1 program dispatch per
+        request. Requires a megasolve-eligible configuration without
+        the ABFT guard (ineligible sessions warn and fall back to
+        per-batch dispatch); implies ``megasolve`` for the resilient
+        fallback path.
 
         ``multisplit`` routes the session to the ASYNCHRONOUS tier
         (solvers/multisplit.py): requests dispatch per-column through
@@ -386,7 +401,35 @@ class SolveServer:
                                   pc_type=ksp.get_pc().get_type(),
                                   rtol=rtol, atol=atol, dtype=dtype)
             ms.set_operator(op)
+        persistent = global_options().get_bool("solve_server_persistent",
+                                               persistent)
+        pr_wanted = bool(persistent) and ms is None
+        if persistent and ms is not None:
+            raise ValueError(
+                f"operator {name!r}: persistent and multisplit are "
+                "mutually exclusive schedule classes — the async tier "
+                "has no coalesced block program to keep resident")
+        if pr_wanted:
+            from ..solvers.megasolve import megasolve_supported
+            guard = bool(ksp.abft) or int(ksp.residual_replacement) > 0
+            if guard or not megasolve_supported(ksp.get_type(),
+                                                ksp.get_pc(), op, nrhs=2):
+                import warnings
+                warnings.warn(
+                    f"SolveServer operator {name!r}: persistent serving "
+                    "needs a megasolve-eligible configuration without "
+                    "the ABFT guard — falling back to per-batch "
+                    "dispatch", stacklevel=2)
+                pr_wanted = False
+            else:
+                # the recovery path (serving/persistent.py fallback)
+                # dispatches through the session KSP: keep it on the
+                # fused per-batch program
+                ksp.megasolve = True
         sess = _OperatorSession(name, op, ksp, multisplit=ms)
+        if pr_wanted:
+            from .persistent import PersistentRunner
+            sess.persistent = PersistentRunner(self, sess)
         with self._session_lock:
             # under the session lock: a concurrent regrow/adoption must
             # not iterate the registry while it grows
@@ -561,7 +604,8 @@ class SolveServer:
         timeout. The server stays open for new submissions."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while self._pending or self._inflight:
+            while (self._pending or self._inflight
+                   or self._persistent_unresolved()):
                 rem = (None if deadline is None
                        else deadline - time.monotonic())
                 if rem is not None and rem <= 0:
@@ -631,11 +675,25 @@ class SolveServer:
     def _loop(self):
         while True:
             with self._cv:
-                while not self._pending and not self._stop:
+                while (not self._pending and not self._stop
+                       and not self._persistent_unresolved()):
                     self._cv.wait()
-                if not self._pending and self._stop:
+                stopping = not self._pending and self._stop
+                idle = not self._pending
+                t_open = (self._pending[0].t_submit if self._pending
+                          else 0.0)
+            if idle:
+                # the queue went quiet (or we are stopping) with
+                # persistent launches outstanding: resolve them NOW —
+                # staged futures must never wait on the next arrival.
+                # Outside _cv (resolution blocks on device results and
+                # notifies the condvar) under the session lock, the
+                # established lock order.
+                with self._session_lock:
+                    self._flush_persistent()
+                if stopping:
                     return
-                t_open = self._pending[0].t_submit
+                continue
             # a heal may have restored capacity while the server sat
             # degraded — adopt the larger mesh BEFORE dispatching this
             # window's traffic (cheap epoch check when nothing healed)
@@ -745,6 +803,15 @@ class SolveServer:
             for r in reqs:
                 key = r.qos or "default"
                 qh[key] = qh.get(key, 0) + 1
+        if sess.persistent is not None:
+            # persistent serving: stage this batch's slots into the
+            # resident program's NEXT launch (double-buffered;
+            # serving/persistent.py) and return to coalescing
+            # immediately — resolution happens at buffer turnover or
+            # the idle flush, never here
+            sess.persistent.enqueue(reqs, waits)
+            self._record(k, waits, 0)
+            return
         kpad = padded_width(k, self.max_k, self.pad_pow2)
         # the batch span: a ROOT span on the dispatcher thread; every
         # request resolved out of this block links back to it
@@ -819,6 +886,24 @@ class SolveServer:
                           iterations=max(res.iterations, default=0))
         self._record(k, waits, kpad - k)
 
+    def _persistent_unresolved(self) -> int:
+        """Requests staged into (or riding) persistent launches — the
+        drain/shutdown and idle-flush accounting. Lock-free snapshot:
+        a stale count only costs one extra condvar lap."""
+        n = 0
+        for s in list(self._sessions.values()):
+            if s.persistent is not None:
+                n += s.persistent.unresolved
+        return n
+
+    def _flush_persistent(self):
+        """Resolve every outstanding persistent launch and drain the
+        staged backlogs (serving/persistent.py). Caller holds the
+        session lock (the runners' concurrency contract)."""
+        for s in list(self._sessions.values()):
+            if s.persistent is not None:
+                s.persistent.flush()
+
     def _multisplit_solve_many(self, sess, reqs, B, k):
         """Dispatch one batch through the ASYNCHRONOUS tier: per-column
         stale-tolerant outer solves (solvers/multisplit.py) instead of a
@@ -874,6 +959,14 @@ class SolveServer:
         futures. Runs on the dispatcher thread (the only place sessions
         are mutated mid-flight)."""
         from ..resilience import elastic as _elastic
+        # persistent launches hold device buffers on the OLD mesh:
+        # consume them first (quiesce resolves the in-flight launch,
+        # leaving host-side staged slots to launch on the new geometry;
+        # inside our own fallback's shrink adoption the record is
+        # already detached — a no-op)
+        for s in list(self._sessions.values()):
+            if s.persistent is not None:
+                s.persistent.quiesce()
         with self._cv:
             widths = sorted(padded_width(w, self.max_k, self.pad_pow2)
                             for w in self._stats["width_hist"])
@@ -1007,6 +1100,11 @@ class SolveServer:
                                     for e in st["mesh_shrinks"]],
                    "mesh_regrows": [dict(e)
                                     for e in st["mesh_regrows"]]}
+            per = {s.name: dict(s.persistent.stats)
+                   for s in self._sessions.values()
+                   if s.persistent is not None}
+            if per:
+                out["persistent"] = per
         out["mean_width"] = (out["requests"] / out["batches"]
                              if out["batches"] else 0.0)
         s = self._wait_hist.summary((50, 99))

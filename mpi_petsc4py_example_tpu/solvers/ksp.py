@@ -153,6 +153,16 @@ class KSP:
                                       # nullspace, monitors, norm-type
                                       # overrides, unroll>1) fall
                                       # through to the unfused path.
+        self.megasolve_stencil_fastpath = False  # -ksp_megasolve_
+                                      # stencil_fastpath: inside the
+                                      # fused program, route the INNER
+                                      # loop of an eligible stencil
+                                      # operator (cg, PC none/jacobi,
+                                      # real dtype, unguarded) through
+                                      # the Pallas fused-dot kernel
+                                      # path (local_matvec_dot) instead
+                                      # of the general flat-apply plan
+                                      # (megasolve_stencil_supported)
         self._true_residual_check = False  # -ksp_true_residual_check
         self.true_residual_margin = 1.0    # -ksp_true_residual_margin: with
                                       # the gate on, the COMPILED program
@@ -401,6 +411,9 @@ class KSP:
         if nt:
             self.set_norm_type(nt)
         self.megasolve = opt.get_bool(p + "ksp_megasolve", self.megasolve)
+        self.megasolve_stencil_fastpath = opt.get_bool(
+            p + "ksp_megasolve_stencil_fastpath",
+            self.megasolve_stencil_fastpath)
         self._true_residual_check = opt.get_bool(
             p + "ksp_true_residual_check", self._true_residual_check)
         self.true_residual_margin = opt.get_real(
@@ -1183,7 +1196,8 @@ class KSP:
         surfaces the fused loop's verified-iterate carry: ``x`` is
         rolled back to it before the DETECTED_SDC raise, exactly as the
         unfused path does."""
-        from .megasolve import GATE_REFINE_MAX, build_megasolve_program
+        from .megasolve import (GATE_REFINE_MAX, build_megasolve_program,
+                                megasolve_stencil_supported)
         mat = self._mat
         comm = mat.comm
         pc = self.get_pc()
@@ -1192,13 +1206,17 @@ class KSP:
         cs_args, abft_pc_on = ((), False)
         if guard:
             cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
+        sf = (self.megasolve_stencil_fastpath
+              and megasolve_stencil_supported(self._type, pc, mat,
+                                              guard=guard))
         with _telemetry.span("ksp.setup"):
             prog = build_megasolve_program(
                 comm, self._type, pc, mat, None,
                 zero_guess=not guess_nonzero,
                 abft=guard and self.abft, abft_pc=abft_pc_on,
                 rr=guard and self._effective_replacement() > 0,
-                donate=True, sstep_s=self.sstep_s)
+                donate=True, sstep_s=self.sstep_s,
+                stencil_fastpath=sf)
         from ..utils.dtypes import tolerance_dtype
         dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),
@@ -1323,7 +1341,8 @@ class KSP:
         detection rolls the block back to the fused loop's verified
         carry and raises, exactly like ``_solve_many_impl``."""
         from .megasolve import (GATE_REFINE_MAX,
-                                build_megasolve_program_many)
+                                build_megasolve_program_many,
+                                megasolve_stencil_supported)
         mat = self._mat
         comm = mat.comm
         pc = self.get_pc()
@@ -1333,13 +1352,17 @@ class KSP:
         cs_args, abft_pc_on = ((), False)
         if guard:
             cs_args, abft_pc_on = self._guard_checksums(mat, pc, op_dt)
+        sf = (self.megasolve_stencil_fastpath
+              and megasolve_stencil_supported(self._type, pc, mat,
+                                              nrhs=k, guard=guard))
         with _telemetry.span("ksp.setup"):
             prog = build_megasolve_program_many(
                 comm, self._type, pc, mat, None, nrhs=k,
                 zero_guess=not self._initial_guess_nonzero,
                 abft=guard and self.abft, abft_pc=abft_pc_on,
                 rr=guard and self._effective_replacement() > 0,
-                donate=True, sstep_s=self.sstep_s)
+                donate=True, sstep_s=self.sstep_s,
+                stencil_fastpath=sf)
         from ..utils.dtypes import tolerance_dtype
         dt = tolerance_dtype(op_dt)
         guard_scalars = ((dt.type(self.abft_tol),

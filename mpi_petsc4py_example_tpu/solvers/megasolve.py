@@ -72,7 +72,8 @@ from ..utils.convergence import ConvergedReason as CR
 from ..utils.dtypes import is_complex
 from . import cg_plans as _plans
 from .krylov import (_consumed_zeros, _make_guard, _make_pipe_guard,
-                     _make_sstep_guard, _psum, donation_supported)
+                     _make_sstep_guard, _psum, cg_stencil_kernel,
+                     cg_stencil_kernel_many, donation_supported)
 
 #: KSP types with a fused whole-solve program (the plan-built CG family)
 MEGASOLVE_TYPES = ("cg", "pipecg", "sstep")
@@ -83,6 +84,10 @@ GATE_REFINE_MAX = 4
 
 _MEGASOLVE_CACHE: dict = {}
 _MEGASOLVE_CACHE_MANY: dict = {}
+#: the persistent-serving variants (serving/persistent.py): same traced
+#: body as the batched program but AOT-labeled "persistent_serve" and
+#: fed PER-SLOT (nrhs,)-shaped tolerance scalars
+_PERSISTENT_CACHE: dict = {}
 
 
 def megasolve_supported(ksp_type: str, pc, operator,
@@ -104,6 +109,34 @@ def megasolve_supported(ksp_type: str, pc, operator,
         if not batched_pc_supported(pc):
             return False
     return True
+
+
+def megasolve_stencil_supported(ksp_type: str, pc, operator,
+                                nrhs: int | None = None,
+                                guard: bool = False) -> bool:
+    """Whether the fused megasolve INNER loop can take the stencil
+    fused-dot fast path (``-ksp_megasolve_stencil_fastpath``): the
+    uniform-diagonal stencil operator's Pallas ``local_matvec_dot``
+    family replaces the general flat-apply plan, so the SpMV and the
+    ``<p, Ap>`` reduction run in one VMEM-resident pass inside the
+    fusion. Mirrors krylov's ``stencil_cg`` gate minus the guarded and
+    MG flavors: the megasolve guard namespaces carry no stencil phases,
+    and the slab V-cycle stays on the general plan."""
+    if ksp_type != "cg" or guard:
+        return False
+    if is_complex(np.dtype(operator.dtype)):
+        return False
+    if pc.get_type() not in ("none", "jacobi"):
+        return False
+    if (pc.get_type() == "jacobi"
+            and getattr(pc, "_mat", None) is not operator):
+        return False
+    need = ["local_matvec_dot", "grid3d"]
+    if nrhs is not None:
+        need.append("local_matvec_dot_many")
+    if not all(hasattr(operator, h) for h in need):
+        return False
+    return getattr(operator, "uniform_diagonal", None) is not None
 
 
 def _operators_compatible(inner_op, outer_op) -> None:
@@ -147,7 +180,8 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
                             outer_op=None, *, zero_guess: bool = True,
                             abft: bool = False, abft_pc: bool = False,
                             rr: bool = False, donate: bool = False,
-                            sstep_s: int = 4):
+                            sstep_s: int = 4,
+                            stencil_fastpath: bool = False):
     """Build (or fetch cached) the fused whole-solve program.
 
     Signature of the returned callable::
@@ -198,10 +232,17 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
     aot_on = aot.aot_enabled() and trace_nonce is None
     donate_k = bool(donate) and donation_supported()
     sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
+    stencil_k = bool(stencil_fastpath)
+    if stencil_k and not megasolve_stencil_supported(ksp_type, pc, inner_op,
+                                                     guard=guard_k):
+        raise ValueError(
+            "megasolve: stencil fast path requested for an ineligible "
+            "(type, PC, operator) configuration — gate the routing on "
+            "megasolve_stencil_supported")
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            str(out_dt), shared, inner_op.program_key(),
            out_op.program_key(), bool(zero_guess), abft_k, abft_pc_k,
-           bool(rr), donate_k, sstep_k, trace_nonce, aot_on)
+           bool(rr), donate_k, sstep_k, stencil_k, trace_nonce, aot_on)
     cached = _MEGASOLVE_CACHE.get(key)
     if cached is not None:
         return cached
@@ -209,6 +250,7 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
     inner_spmv = inner_op.local_spmv(comm)
     outer_spmv = inner_spmv if shared else out_op.local_spmv(comm)
     pc_apply = pc.local_apply(comm, n)
+    matvec_dot = inner_op.local_matvec_dot(comm) if stencil_k else None
     in_specs_inner = inner_op.op_specs(axis)
     in_specs_outer = None if shared else out_op.op_specs(axis)
     mixed = prec.mixed
@@ -251,6 +293,22 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
         inner_atol = tol.astype(itol_dt)   # floor: never solve a
         #                                    correction deeper than the
         #                                    outer target itself
+
+        if stencil_k:
+            # fused-dot stencil fast path (krylov.cg_stencil_kernel):
+            # SpMV + <p, Ap> in one VMEM-resident Pallas pass; jacobi
+            # collapses to the scalar uniform-diagonal multiply
+            idt = stack_dt if mixed else in_dt
+            inv_diag = (jnp.asarray(1.0, idt) if pc.get_type() == "none"
+                        else jnp.asarray(1.0 / inner_op.uniform_diagonal,
+                                         idt))
+            pdot3 = lambda u, v: _psum(jnp.sum(_up(u) * _up(v)), axis)
+            pnorm3 = lambda u: jnp.sqrt(_psum(jnp.sum(_up(u) * _up(u)),
+                                              axis))
+
+            def Adot3(v):
+                y, d = matvec_dot(inner_arrays, v)
+                return _abft.apply_silent_fault("spmv.result", y), d
 
         g = None
         if guard_k:
@@ -297,6 +355,12 @@ def build_megasolve_program(comm: DeviceComm, ksp_type: str, pc, inner_op,
                     b=r_lp, x0=x0_lp, rtol=inner_rtol, atol=inner_atol,
                     maxit=maxit, A=A_in, M=M_in, pnorm=pnorm, fused=fused,
                     **kw)
+            if stencil_k:
+                return cg_stencil_kernel(
+                    Adot3, inv_diag, pdot3, pnorm3, r_lp, x0_lp,
+                    inner_rtol, inner_atol, maxit, dtol=dtol,
+                    grid3d=inner_op.grid3d,
+                    prec=prec if mixed else None)
             return _plans.classic_cg_loop(
                 b=r_lp, x0=x0_lp, rtol=inner_rtol, atol=inner_atol,
                 maxit=maxit, A=A_in, M=M_in, pdot=pdot, pnorm=pnorm,
@@ -416,7 +480,9 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
                                  zero_guess: bool = True,
                                  abft: bool = False, abft_pc: bool = False,
                                  rr: bool = False, donate: bool = False,
-                                 sstep_s: int = 4):
+                                 sstep_s: int = 4,
+                                 stencil_fastpath: bool = False,
+                                 persistent: bool = False):
     """Batched fused whole-solve program: ``nrhs`` refinement recurrences
     in lockstep over an ``(n_pad, nrhs)`` block, each outer step
     dispatching ONE nested batched CG plan loop — a served ``solve_many``
@@ -458,17 +524,31 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
     aot_on = aot.aot_enabled() and trace_nonce is None
     donate_k = bool(donate) and donation_supported()
     sstep_k = max(1, int(sstep_s)) if ksp_type == "sstep" else 0
+    stencil_k = bool(stencil_fastpath)
+    if stencil_k and not megasolve_stencil_supported(
+            ksp_type, pc, inner_op, nrhs=nrhs, guard=guard_k):
+        raise ValueError(
+            "megasolve: stencil fast path requested for an ineligible "
+            "(type, PC, operator) configuration — gate the routing on "
+            "megasolve_stencil_supported")
+    # the persistent-serving variant is the SAME traced body fed
+    # (nrhs,)-shaped per-slot tolerance scalars — a distinct aval
+    # signature, so it lives in its own cache under its own AOT kind
+    kind = "persistent_serve" if persistent else "megasolve_many"
+    cache = _PERSISTENT_CACHE if persistent else _MEGASOLVE_CACHE_MANY
     key = (comm.mesh, axis, ksp_type, pc.program_key(), n, prec.key(),
            str(out_dt), shared, int(nrhs), inner_op.program_key(),
            out_op.program_key(), bool(zero_guess), abft_k, abft_pc_k,
-           bool(rr), donate_k, sstep_k, trace_nonce, aot_on)
-    cached = _MEGASOLVE_CACHE_MANY.get(key)
+           bool(rr), donate_k, sstep_k, stencil_k, trace_nonce, aot_on)
+    cached = cache.get(key)
     if cached is not None:
         return cached
 
     inner_spmv = inner_op.local_spmv_many(comm)
     outer_spmv = inner_spmv if shared else out_op.local_spmv_many(comm)
     pc_apply = pc.local_apply_many(comm, n)
+    matvec_dot_many = (inner_op.local_matvec_dot_many(comm)
+                       if stencil_k else None)
     if pc_apply is None:
         raise ValueError(
             f"pc {pc.get_type()!r} has no batched apply — batched "
@@ -513,6 +593,21 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
         itol_dt = jnp.real(jnp.zeros((), stack_dt)).dtype
         inner_atol = tol.astype(itol_dt)
 
+        if stencil_k:
+            # batched fused-dot stencil fast path: state in
+            # (nrhs,) + grid3d slabs, SpMV + per-column <p_j, A p_j>
+            # in one fused pass (krylov.cg_stencil_kernel_many)
+            idt = stack_dt if mixed else in_dt
+            inv_diag = (jnp.asarray(1.0, idt) if pc.get_type() == "none"
+                        else jnp.asarray(1.0 / inner_op.uniform_diagonal,
+                                         idt))
+            pdotc3 = lambda U, V: _psum(
+                jnp.sum(_up(U) * _up(V), axis=(1, 2, 3)), axis)
+
+            def Adot3(V):
+                Y, d = matvec_dot_many(inner_arrays, V)
+                return _abft.apply_silent_fault("spmv.result", Y), d
+
         g = None
         if guard_k:
             flavor = dict(
@@ -556,6 +651,12 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
                     b=R_lp, x0=X0_lp, rtol=inner_rtol, atol=inner_atol,
                     maxit=maxit, A=A_in, M=M_in, pnorm=pnormc,
                     fused=fusedc, **kw)
+            if stencil_k:
+                return cg_stencil_kernel_many(
+                    Adot3, inv_diag, pdotc3, R_lp, X0_lp,
+                    inner_rtol, inner_atol, maxit, dtol=dtol,
+                    grid3d=inner_op.grid3d,
+                    prec=prec if mixed else None)
             return _plans.classic_cg_loop(
                 b=R_lp, x0=X0_lp, rtol=inner_rtol, atol=inner_atol,
                 maxit=maxit, A=A_in, M=M_in, pdot=pdotc, pnorm=pnormc,
@@ -666,7 +767,7 @@ def build_megasolve_program_many(comm: DeviceComm, ksp_type: str, pc,
     prog = jax.jit(comm.shard_map(local_fn, in_specs, out_specs),
                    donate_argnums=dn)
     if aot_on:
-        prog = aot.wrap("megasolve_many", comm, key[1:], prog,
+        prog = aot.wrap(kind, comm, key[1:], prog,
                         code=_aot_code(), donate_argnums=dn)
-    _MEGASOLVE_CACHE_MANY[key] = prog
+    cache[key] = prog
     return prog

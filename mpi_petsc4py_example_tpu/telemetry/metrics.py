@@ -44,6 +44,10 @@ QUEUE_WAIT_BUCKETS_S = LATENCY_BUCKETS_S
 #: seconds — integer bucket bounds up to the largest plausible
 #: -multisplit_max_stale, then +Inf for runaway staleness
 STALE_AGE_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+#: requests riding one persistent launch are small integers bounded by
+#: the slot capacity (-solve_server_max_k), not seconds
+REQUESTS_PER_LAUNCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                               128.0)
 
 #: default buckets by histogram name (callers may still pass their own)
 DEFAULT_BUCKETS = {
@@ -51,6 +55,7 @@ DEFAULT_BUCKETS = {
     "solve.per_iter_seconds": PER_ITER_BUCKETS_S,
     "serving.queue_wait_seconds": QUEUE_WAIT_BUCKETS_S,
     "multisplit.stale_age": STALE_AGE_BUCKETS,
+    "dispatch.requests_per_launch": REQUESTS_PER_LAUNCH_BUCKETS,
 }
 
 #: bounded reservoir size per histogram — the exact-percentile window

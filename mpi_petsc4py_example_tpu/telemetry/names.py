@@ -69,6 +69,11 @@ NAMES = {
     "serving.regrow": ("span", "server-wide adoption of a re-grown mesh "
                                "after a heal (every resident session "
                                "rebuilt on the larger geometry)"),
+    "serving.persistent_launch": ("span", "one persistent_serve launch: "
+                                          "up to Q staged request slots "
+                                          "resolved out of one resident "
+                                          "multi-request program "
+                                          "(serving/persistent.py)"),
     "fleet.migrate": ("span", "one session migration between replicas: "
                               "drain -> checkpoint -> re-register -> "
                               "replay"),
@@ -160,6 +165,12 @@ NAMES = {
                                           "the reader) of every boundary "
                                           "read — the -log_view staleness "
                                           "row"),
+    "dispatch.requests_per_launch": ("histogram",
+                                     "requests amortized into one "
+                                     "persistent_serve launch — the "
+                                     "-log_view requests-per-launch row "
+                                     "(≫1 means the resident program is "
+                                     "paying ≪1 dispatch/request)"),
 }
 
 # Fault points the flight recorder records events for. MUST cover every

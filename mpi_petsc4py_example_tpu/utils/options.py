@@ -49,6 +49,12 @@ KNOWN_FLAGS = {
                      "one compiled-program launch from the refinement "
                      "loop to the verified answer "
                      "(solvers/megasolve.py)",
+    "ksp_megasolve_stencil_fastpath": "route the fused megasolve INNER "
+                                      "loop through the Pallas fused-dot "
+                                      "stencil kernel for eligible "
+                                      "uniform-diagonal stencil operators "
+                                      "(SpMV + <p,Ap> in one VMEM-resident "
+                                      "pass inside the fusion)",
     "ksp_monitor": "print the residual norm each iteration",
     "ksp_norm_type": "monitored norm (default/none/preconditioned/"
                      "unpreconditioned/natural)",
@@ -178,6 +184,13 @@ KNOWN_FLAGS = {
     "solve_server_pad_pow2": "round coalesced block widths up to powers "
                              "of two (bounds the compiled-program "
                              "population)",
+    "solve_server_persistent": "register operators in PERSISTENT serving "
+                               "mode: batches stage into a double-"
+                               "buffered device-resident multi-request "
+                               "program (one persistent_serve launch "
+                               "drains up to max_k slots — amortized "
+                               "<1 dispatch/request; "
+                               "serving/persistent.py)",
     "solve_server_resilient": "dispatch coalesced blocks through "
                               "resilient_solve_many (retry/rollback "
                               "per block)",

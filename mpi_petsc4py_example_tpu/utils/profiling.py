@@ -107,6 +107,13 @@ def record_serving(width: int, waits=(), padded: int = 0):
         h.observe(float(w))
 
 
+def record_requests_per_launch(width: int):
+    """Accumulate one persistent_serve launch: ``width`` REAL request
+    slots riding it (pow2 slot padding excluded) — the -log_view
+    requests-per-launch row (serving/persistent.py)."""
+    _REG.histogram("dispatch.requests_per_launch").observe(float(width))
+
+
 def serving_stats() -> dict:
     """Process-wide coalescing stats: batch-width histogram + queue-wait
     aggregates (per-server percentiles live on SolveServer.stats() —
@@ -419,6 +426,21 @@ def log_view(file=None):
         total_d = int(sum(dispatches.values()))
         print(f"compiled-program dispatches: {total_d} [{parts}]",
               file=file)
+    rpl = _REG.histogram("dispatch.requests_per_launch")
+    if rpl.count:
+        # the persistent-serving amortization row: requests riding each
+        # persistent_serve launch — mean > 1 is the measured
+        # ≪1-dispatch-per-request claim (serving/persistent.py)
+        s = rpl.summary((50, 99))
+        occupied = [(b, c) for b, c in
+                    zip(list(rpl.buckets) + [float("inf")],
+                        rpl.bucket_counts()) if c]
+        cells = "  ".join(
+            (f">{rpl.buckets[-1]:g}: {c}" if b == float("inf")
+             else f"<={b:g}: {c}") for b, c in occupied)
+        print(f"persistent requests-per-launch histogram ({rpl.count} "
+              f"launch(es), mean {s['mean']:.2f}, p50 {s['p50']:.1f}, "
+              f"p99 {s['p99']:.1f}): {cells}", file=file)
     if per_iter.count:
         # the fixed-bucket per-iteration latency histogram (cfg12's
         # -log_view row): only occupied buckets, cumulative-free
@@ -481,8 +503,9 @@ def program_count() -> int:
         pass
     try:
         from ..solvers.megasolve import (_MEGASOLVE_CACHE as mc,
-                                         _MEGASOLVE_CACHE_MANY as mcm)
-        n += len(mc) + len(mcm)
+                                         _MEGASOLVE_CACHE_MANY as mcm,
+                                         _PERSISTENT_CACHE as mcp)
+        n += len(mc) + len(mcm) + len(mcp)
     except (ImportError, AttributeError):
         pass
     return n

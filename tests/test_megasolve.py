@@ -448,3 +448,127 @@ class TestFusedServing:
         # and no unfused block launches leaked onto the hot path
         assert int(after.get("ksp_many", 0)
                    - before.get("ksp_many", 0)) == 0
+
+
+class TestStencilFastPath:
+    """-ksp_megasolve_stencil_fastpath: the megasolve INNER loop's CG
+    plan routes SpMV + <p, Ap> through the stencil operator's fused
+    Pallas dot kernel — one fewer reduce site per inner iteration, same
+    iterates."""
+
+    def _stencil(self, comm, nx=8):
+        from mpi_petsc4py_example_tpu.models import StencilPoisson3D
+        return StencilPoisson3D(comm, nx, dtype=np.float64)
+
+    def test_fastpath_parity_and_iterations_single(self, comm8):
+        """Fast path on/off produce the SAME iterate sequence: equal
+        iteration counts and answers at matched tolerance."""
+        op = self._stencil(comm8)
+        b = np.random.default_rng(20).standard_normal(op.shape[0])
+        outs = []
+        for fast in (False, True):
+            ksp = _ksp(comm8, op, megasolve_stencil_fastpath=fast)
+            x, bv = op.get_vecs()
+            bv.set_global(b)
+            res = ksp.solve(bv, x)
+            assert res.converged, (fast, res)
+            outs.append((res.iterations, x.to_numpy()))
+        assert outs[0][0] == outs[1][0] > 0
+        assert (np.linalg.norm(outs[0][1] - outs[1][1])
+                <= 1e-12 * np.linalg.norm(outs[0][1]))
+
+    def test_fastpath_parity_solve_many(self, comm8):
+        """Batched twin: per-column masked iteration counts match the
+        flat-apply plan column for column."""
+        op = self._stencil(comm8)
+        B = np.random.default_rng(21).standard_normal((op.shape[0], 4))
+        B[:, 2] *= 1e3                     # mixed difficulty/scale
+        outs = []
+        for fast in (False, True):
+            ksp = _ksp(comm8, op, megasolve_stencil_fastpath=fast)
+            res = ksp.solve_many(B)
+            assert all(r > 0 for r in res.reasons), (fast, res.reasons)
+            outs.append(res)
+        assert list(outs[0].iterations) == list(outs[1].iterations)
+        assert (np.linalg.norm(outs[0].X - outs[1].X)
+                <= 1e-12 * np.linalg.norm(outs[0].X))
+
+    def test_fastpath_is_one_launch(self, comm8):
+        op = self._stencil(comm8)
+        b = np.random.default_rng(22).standard_normal(op.shape[0])
+        ksp = _ksp(comm8, op, megasolve_stencil_fastpath=True)
+        x, bv = op.get_vecs()
+        bv.set_global(b)
+        ksp.solve(bv, x)               # compile outside the count
+        before = dispatch_counts()
+        ksp.solve(bv, x)
+        after = dispatch_counts()
+        assert int(sum(after.values()) - sum(before.values())) == 1
+        assert int(after.get("megasolve", 0)
+                   - before.get("megasolve", 0)) == 1
+
+    def test_eligibility_gate(self, comm8):
+        """The gate mirrors krylov's stencil_cg gate minus the guarded
+        flavors: CG + none/jacobi + a uniform-diagonal stencil operator,
+        never a flat ELL Mat, never under the ABFT guard."""
+        from mpi_petsc4py_example_tpu.solvers.megasolve import (
+            megasolve_stencil_supported)
+        op = self._stencil(comm8)
+        M = tps.Mat.from_scipy(comm8, _spd(128))
+        pc = _ksp(comm8, op).get_pc()
+        ksp = _ksp(comm8, op)
+        x, bv = op.get_vecs()
+        bv.set_global(np.ones(op.shape[0]))
+        ksp.solve(bv, x)               # binds pc._mat to the operator
+        pc = ksp.get_pc()
+        assert megasolve_stencil_supported("cg", pc, op)
+        assert megasolve_stencil_supported("cg", pc, op, nrhs=4)
+        assert not megasolve_stencil_supported("bicgstab", pc, op)
+        assert not megasolve_stencil_supported("cg", pc, op, guard=True)
+        assert not megasolve_stencil_supported("cg", pc, M)
+
+    def test_forced_fastpath_on_flat_operator_raises(self, comm8):
+        from mpi_petsc4py_example_tpu.solvers.megasolve import (
+            build_megasolve_program)
+        M = tps.Mat.from_scipy(comm8, _spd(128))
+        ksp = _ksp(comm8, M)
+        x, bv = M.get_vecs()
+        bv.set_global(np.ones(128))
+        ksp.solve(bv, x)               # sets up the jacobi PC
+        with pytest.raises(ValueError, match="stencil"):
+            build_megasolve_program(comm8, "cg", ksp.get_pc(), M, M,
+                                    stencil_fastpath=True)
+
+    def test_options_flag_wires_fastpath(self, comm8):
+        """-ksp_megasolve_stencil_fastpath flows options -> KSP ->
+        builder: the flagged solve matches the unflagged one exactly."""
+        op = self._stencil(comm8)
+        b = np.random.default_rng(23).standard_normal(op.shape[0])
+        ref = _ksp(comm8, op)
+        x0, bv0 = op.get_vecs()
+        bv0.set_global(b)
+        r0 = ref.solve(bv0, x0)
+        tps.global_options().set("ksp_megasolve_stencil_fastpath",
+                                 "true")
+        ksp = _ksp(comm8, op)
+        ksp.set_from_options()
+        assert ksp.megasolve_stencil_fastpath is True
+        x1, bv1 = op.get_vecs()
+        bv1.set_global(b)
+        r1 = ksp.solve(bv1, x1)
+        assert r1.converged and r1.iterations == r0.iterations
+
+    def test_fastpath_reduce_site_contract(self, comm8):
+        """The measured fact the tpscheck contract pins: the fused-dot
+        inner loop carries 2 reduce sites (flat-apply: 3) inside the
+        same (outer, inner) nesting, and the stencil halo exchange
+        introduces no all_gather."""
+        from mpi_petsc4py_example_tpu import contracts as C
+        from mpi_petsc4py_example_tpu.utils import hlo
+        fast = C.lower_megasolve(comm8, "cg", operator="stencil",
+                                 stencil_fastpath=True)
+        flat = C.lower_megasolve(comm8, "cg", operator="stencil",
+                                 stencil_fastpath=False)
+        assert list(hlo.nested_loop_reduce_site_chain(fast)) == [4, 2]
+        assert list(hlo.nested_loop_reduce_site_chain(flat)) == [4, 3]
+        assert "all_gather" not in fast

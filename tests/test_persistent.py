@@ -1,0 +1,342 @@
+"""Persistent serving: the device-resident request queue
+(serving/persistent.py + the ``persistent_serve`` program kind).
+
+These tests pin the host-side contracts the persistent tier promises:
+slot-masked per-request independence inside one launch (parity vs the
+direct megasolve KSP), ragged final launches, the double-buffer
+turnover under a staged backlog, heterogeneous tolerance groups riding
+ONE launch (the amortization a per-batch dispatch cannot reach), QoS
+ordering, and the resilience contract — a fault inside the persistent
+loop resolves EVERY slot future, and a device loss shrinks the mesh
+and rebuilds the resident program on the surviving geometry.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import mpi_petsc4py_example_tpu as tps
+from mpi_petsc4py_example_tpu.models import poisson2d_csr
+from mpi_petsc4py_example_tpu.resilience import faults as _faults
+from mpi_petsc4py_example_tpu.serving import SolveServer
+from mpi_petsc4py_example_tpu.utils.profiling import dispatch_counts
+
+RTOL = 1e-8
+NX = 10                      # 100-dof 2D Poisson: compile-light
+
+
+def _problem(k=4, seed=0):
+    A = poisson2d_csr(NX)
+    rng = np.random.default_rng(seed)
+    Xt = rng.random((A.shape[0], k))
+    return A, Xt, np.asarray(A @ Xt)
+
+
+def _fast_policy():
+    return tps.RetryPolicy(sleep=lambda d: None, base_delay=0.0)
+
+
+def _pstats(srv, op="p"):
+    return srv.stats()["persistent"][op]
+
+
+def _register(srv, A, **kw):
+    kw.setdefault("pc_type", "jacobi")
+    kw.setdefault("rtol", RTOL)
+    kw.setdefault("persistent", True)
+    return srv.register_operator("p", A, **kw)
+
+
+# ---------------------------------------------------------------- basics
+class TestPersistentBasics:
+    def test_burst_rides_one_launch_with_slot_parity(self, comm8):
+        """A burst within one window costs ONE persistent_serve
+        dispatch, and every slot's answer matches the direct per-column
+        megasolve solve (the masked slots are independent)."""
+        A, Xt, B = _problem(k=6)
+        srv = SolveServer(comm8, window=0.0, max_k=8, autostart=False)
+        _register(srv, A)
+        d0 = dispatch_counts().get("persistent_serve", 0)
+        futs = [srv.submit("p", B[:, j]) for j in range(6)]
+        srv.start()
+        res = [f.result(300) for f in futs]
+        srv.shutdown()
+        assert dispatch_counts().get("persistent_serve", 0) - d0 == 1
+        st = _pstats(srv)
+        assert st["launches"] == 1 and st["requests"] == 6
+        assert st["padded_slots"] == 2          # 6 -> pow2 pad 8
+        assert st["fallbacks"] == 0
+        # parity: direct (non-served) megasolve KSP, column by column
+        mat = tps.Mat.from_scipy(comm8, A)
+        ksp = tps.KSP().create(comm8)
+        ksp.set_operators(mat)
+        ksp.set_type("cg")
+        ksp.get_pc().set_type("jacobi")
+        ksp.set_tolerances(rtol=RTOL, max_it=100)
+        ksp.megasolve = True
+        for j, r in enumerate(res):
+            assert r.converged and r.batch_width == 6
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+            x, bv = mat.get_vecs()
+            bv.set_global(B[:, j])
+            ksp.solve(bv, x)
+            ref = x.to_numpy()
+            err = (np.linalg.norm(r.x - ref)
+                   / max(np.linalg.norm(ref), 1e-300))
+            assert err < 1e-10, (j, err)
+
+    def test_ragged_final_launch_resolves_everything(self, comm8):
+        """7 requests at capacity 4: a full launch plus a ragged one —
+        the ragged tail pads (3 -> 4) and still resolves every
+        future."""
+        A, Xt, B = _problem(k=7)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        _register(srv, A)
+        futs = [srv.submit("p", B[:, j]) for j in range(7)]
+        srv.start()
+        res = [f.result(300) for f in futs]
+        srv.shutdown()
+        st = _pstats(srv)
+        assert st["launches"] == 2 and st["requests"] == 7
+        assert st["padded_slots"] == 1          # 4+4(pad 0), 3->4(pad 1)
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+
+    def test_mixed_tolerance_groups_share_one_launch(self, comm8):
+        """Per-slot (Q,)-shaped tolerances let requests from DIFFERENT
+        coalescer compatibility groups ride one launch — the per-batch
+        dispatcher structurally cannot do this. Each slot must meet its
+        OWN tolerance, and the tight slots iterate further than the
+        loose ones inside the same launch."""
+        A, _, B = _problem(k=6, seed=2)
+        srv = SolveServer(comm8, window=0.0, max_k=8, autostart=False)
+        _register(srv, A)
+        d0 = dispatch_counts().get("persistent_serve", 0)
+        rtols = [1e-4, 1e-4, 1e-6, 1e-6, 1e-10, 1e-10]
+        futs = [srv.submit("p", B[:, j], rtol=rtols[j])
+                for j in range(6)]
+        srv.start()
+        res = [f.result(300) for f in futs]
+        srv.shutdown()
+        # 3 tolerance groups, yet only 2 launches: the first batch
+        # opens launch 1 alone; groups 2+3 stage into launch 2 TOGETHER
+        assert dispatch_counts().get("persistent_serve", 0) - d0 == 2
+        st = _pstats(srv)
+        assert st["launches"] == 2 and st["requests"] == 6
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            rel = (np.linalg.norm(B[:, j] - A @ r.x)
+                   / np.linalg.norm(B[:, j]))
+            assert rel <= rtols[j] * 1.05, (j, rel, rtols[j])
+        # slot masking inside launch 2: the 1e-10 slots kept iterating
+        # after the 1e-6 slots froze at their verified exit
+        assert min(r.iterations for r in res[4:]) > \
+            max(r.iterations for r in res[2:4])
+
+    def test_mixed_difficulty_slots_each_meet_tolerance(self, comm8):
+        """Columns of wildly different scale in one launch: each slot
+        converges against its OWN rhs norm (relative criterion), so a
+        hard slot never borrows an easy slot's exit."""
+        A, _, B = _problem(k=4, seed=3)
+        B = B.copy()
+        B[:, 1] *= 1e6
+        B[:, 3] *= 1e-6
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        _register(srv, A)
+        futs = [srv.submit("p", B[:, j]) for j in range(4)]
+        srv.start()
+        res = [f.result(300) for f in futs]
+        srv.shutdown()
+        assert _pstats(srv)["launches"] == 1
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            rel = (np.linalg.norm(B[:, j] - A @ r.x)
+                   / np.linalg.norm(B[:, j]))
+            assert rel <= RTOL * 1.05, (j, rel)
+
+    def test_options_flag_enables_persistent(self, comm8):
+        tps.global_options().set("solve_server_persistent", "true")
+        A, Xt, B = _problem(k=1)
+        srv = SolveServer(comm8, window=0.0, autostart=False)
+        srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        assert srv._sessions["p"].persistent is not None
+        f = srv.submit("p", B[:, 0])
+        srv.start()
+        r = f.result(300)
+        srv.shutdown()
+        assert r.converged
+        np.testing.assert_allclose(r.x, Xt[:, 0], atol=1e-6)
+
+    def test_guarded_session_falls_back_to_per_batch(self, comm8):
+        """ABFT-guarded sessions are not megasolve-eligible: the
+        registration warns and serves per-batch instead of silently
+        dropping the guard."""
+        A, Xt, B = _problem(k=1)
+        srv = SolveServer(comm8, window=0.0, autostart=False)
+        with pytest.warns(UserWarning, match="falling back"):
+            srv.register_operator("p", A, pc_type="jacobi", rtol=RTOL,
+                                  abft=True, persistent=True)
+        assert srv._sessions["p"].persistent is None
+        f = srv.submit("p", B[:, 0])
+        srv.start()
+        r = f.result(300)
+        srv.shutdown()
+        assert r.converged
+        np.testing.assert_allclose(r.x, Xt[:, 0], atol=1e-6)
+
+    def test_persistent_multisplit_mutually_exclusive(self, comm8):
+        A, _, _ = _problem(k=1)
+        srv = SolveServer(comm8, window=0.0, autostart=False)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            srv.register_operator("p", A, persistent=True,
+                                  multisplit=True)
+        srv.shutdown()
+
+
+# ------------------------------------------------------ overlap / ordering
+class TestPersistentOverlap:
+    def test_double_buffer_turnover_under_backlog(self, comm8):
+        """8 staged requests at capacity 4: the second batch forces an
+        inline buffer turnover — launch 2 is opened BEFORE launch 1 is
+        resolved (the dispatch-hook seam observes launch 1 still
+        unresolved while batch 2 stages), and 8 requests cost 2
+        dispatches: amortized 0.25 launches/request."""
+        A, Xt, B = _problem(k=8)
+        overlap = []
+        futs = []
+
+        def hook(reqs):
+            if len(overlap) == 1:
+                # batch 2 staging while launch 1 is still in flight
+                overlap.append(all(not f.done() for f in futs[:4]))
+            elif not overlap:
+                overlap.append(True)
+
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        _register(srv, A)
+        srv._dispatch_hook = hook
+        d0 = dispatch_counts().get("persistent_serve", 0)
+        futs.extend(srv.submit("p", B[:, j]) for j in range(8))
+        srv.start()
+        res = [f.result(300) for f in futs]
+        srv.shutdown()
+        assert overlap == [True, True]
+        st = _pstats(srv)
+        assert st["launches"] == 2 and st["turnovers"] >= 1
+        launches = dispatch_counts().get("persistent_serve", 0) - d0
+        assert launches == 2
+        assert launches / len(res) < 1.0        # the amortization claim
+        for j, r in enumerate(res):
+            assert r.converged, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+
+    def test_qos_order_fills_slots_interactive_first(self, comm8):
+        """The deadline-weighted scheduler's batch order IS the slot
+        fill order: interactive requests launch (and resolve) ahead of
+        the earlier-submitted bulk ones."""
+        A, _, B = _problem(k=4)
+        order = []
+        done_order = []
+
+        def hook(reqs):
+            order.append([r.qos for r in reqs])
+
+        srv = SolveServer(comm8, window=0.0, max_k=2, autostart=False)
+        _register(srv, A)
+        srv._dispatch_hook = hook
+        fb = [srv.submit("p", B[:, j], qos="bulk") for j in range(2)]
+        fi = [srv.submit("p", B[:, j + 2], qos="interactive")
+              for j in range(2)]
+        for tag, fs in (("bulk", fb), ("interactive", fi)):
+            for f in fs:
+                f.add_done_callback(
+                    lambda _f, tag=tag: done_order.append(tag))
+        srv.start()
+        [f.result(300) for f in fb + fi]
+        srv.shutdown()
+        assert order[0] == ["interactive", "interactive"]
+        assert done_order[:2] == ["interactive", "interactive"]
+        assert _pstats(srv)["requests"] == 4
+
+
+# -------------------------------------------------------------- resilience
+class TestPersistentResilience:
+    def test_fault_resolves_every_slot_future(self, comm8):
+        """A fault plan armed across a persistent launch routes the
+        whole launch through the resilient per-batch path: the fault
+        FIRES at the program boundary, the retry tier recovers, and
+        every slot future resolves converged — nothing hangs."""
+        A, Xt, B = _problem(k=4, seed=3)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False,
+                          retry_policy=_fast_policy())
+        _register(srv, A)
+        with tps.inject_faults("ksp.program=unavailable:at=1:iter=4"):
+            futs = [srv.submit("p", B[:, j]) for j in range(4)]
+            srv.start()
+            res = [f.result(300) for f in futs]
+        srv.shutdown()
+        st = _pstats(srv)
+        assert st["fallbacks"] == 1 and st["launches"] == 1
+        for j, r in enumerate(res):
+            assert r.converged and r.attempts == 2, (j, r)
+            np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+        kinds = [e.kind for e in res[0].recovery_events]
+        assert kinds == ["fault", "checkpoint", "backoff", "resume"]
+
+    def test_device_loss_shrinks_then_rebuilds_resident_program(
+            self, comm8):
+        """A device loss mid-launch resolves every slot future through
+        the elastic tier, the server adopts the shrunk mesh, and the
+        NEXT launch rebuilds the persistent program on the surviving
+        geometry (stats['rebuilds'])."""
+        A, Xt, B = _problem(k=3, seed=5)
+        victim = comm8.device_ids[-1]
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False,
+                          retry_policy=_fast_policy())
+        _register(srv, A)
+        try:
+            spec = (f"device.lost=unavailable:device={victim}"
+                    ":at=1:iter=10")
+            with tps.inject_faults(spec):
+                futs = [srv.submit("p", B[:, j]) for j in range(2)]
+                srv.start()
+                res = [f.result(600) for f in futs]
+            for j, r in enumerate(res):
+                assert r.converged, (j, r)
+                assert r.iterations > 0      # resumed past iteration 0
+                np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+            kinds = {e.kind for e in res[0].recovery_events}
+            assert "mesh_shrink" in kinds
+            assert srv.comm.size < comm8.size
+            st = _pstats(srv)
+            assert st["fallbacks"] >= 1
+            # the registry still holds the victim (heal hasn't run),
+            # but the adopted mesh excludes it: the next launch takes
+            # the DIRECT path and transparently rebuilds the resident
+            # program for the shrunk geometry
+            r2 = srv.solve("p", B[:, 2], timeout=600)
+            assert r2.converged
+            np.testing.assert_allclose(r2.x, Xt[:, 2], atol=1e-6)
+            st = _pstats(srv)
+            assert st["rebuilds"] == 1
+            assert st["fallbacks"] == 1      # no second fallback
+        finally:
+            srv.shutdown()
+            _faults.heal()
+
+    def test_drain_flushes_staged_and_inflight(self, comm8):
+        """drain() counts staged + in-flight persistent slots: it only
+        returns once every future is resolved."""
+        A, _, B = _problem(k=5)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False)
+        _register(srv, A)
+        futs = [srv.submit("p", B[:, j]) for j in range(5)]
+        srv.start()
+        assert srv.drain(timeout=300)
+        assert all(f.done() for f in futs)
+        assert all(f.result(0).converged for f in futs)
+        # server still open after drain
+        assert srv.solve("p", B[:, 0], timeout=300).converged
+        srv.shutdown()

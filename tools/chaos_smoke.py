@@ -51,6 +51,15 @@ Modes:
   increasing across the loss (survivors provably never revisit
   iteration 0); and an ``exchange.put`` drop/partition must only ever
   cost staleness, never correctness;
+* ``--persistent`` (ISSUE 18): the device-resident request-queue
+  drills — a silent bitflip armed across a fully-staged persistent
+  launch must resolve EVERY slot future with no silently-wrong answer
+  (a slot either converges to strict fp64 parity or honestly reports
+  non-convergence — the verified-residual exit gate is the detector),
+  and a mid-launch ``device.lost`` must resolve every slot through the
+  elastic tier (resuming past iteration 0), shrink the server's mesh,
+  and REBUILD the resident program on the surviving geometry for
+  post-recovery traffic;
 * neither: the builtin silent-corruption sweep over every silent fault
   kind at every injectable point (spmv.result / pc.apply / comm.psum).
 
@@ -452,6 +461,177 @@ def drill_evict_serving() -> list[str]:
         srv.shutdown(wait=False)
         _faults.heal()
     return [f"evict-serving: {p}" for p in problems]
+
+
+def _persistent_server(tps, comm, A, rtol):
+    from mpi_petsc4py_example_tpu.serving import SolveServer
+    srv = SolveServer(
+        comm, window=0.005, max_k=8, autostart=False,
+        retry_policy=tps.RetryPolicy(sleep=lambda _d: None))
+    srv.register_operator("poisson", A, ksp_type="cg", pc_type="jacobi",
+                          rtol=rtol, persistent=True)
+    return srv
+
+
+def drill_persistent_bitflip() -> list[str]:
+    """Silent bitflip across a fully-staged persistent launch
+    (``--persistent``, ISSUE 18): with a fault plan armed the runner
+    routes the whole launch through the resilient per-batch path, the
+    flip corrupts ONE slot's inner recurrence, and the megasolve
+    verified-residual exit gate is the detector — the poisoned slot
+    must either converge to strict fp64 parity (the fp64 refinement
+    outer absorbed the flip) or honestly report non-convergence; a
+    CONVERGED slot that misses parity is the silent lie this drill
+    exists to catch. Every one of the Q slot futures must resolve, and
+    the resident program must serve post-fault traffic on the direct
+    path."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.utils.profiling import dispatch_counts
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(12)
+    n = A.shape[0]
+    rng = np.random.default_rng(9)
+    Q = 8
+    Xt = rng.random((n, Q))
+    B = np.asarray(A @ Xt)
+    srv = _persistent_server(tps, comm, A, RTOL)
+    try:
+        with tps.inject_faults("spmv.result=bitflip:at=2:times=1"):
+            futs = [srv.submit("poisson", B[:, j]) for j in range(Q)]
+            srv.start()
+            if not srv.drain(600):
+                problems.append("drain timed out — hung slot future(s)")
+        answered = parity = honest = 0
+        for j, f in enumerate(futs):
+            if not f.done():
+                problems.append(f"slot {j} future never resolved")
+                continue
+            answered += 1
+            exc = f.exception(0)
+            if exc is not None:
+                problems.append(f"slot {j}: untyped failure {exc!r}")
+                continue
+            r = f.result(0)
+            rres = (np.linalg.norm(B[:, j] - A @ r.x)
+                    / np.linalg.norm(B[:, j]))
+            if r.converged:
+                if rres <= RTOL * 1.05:
+                    parity += 1
+                else:
+                    problems.append(
+                        f"slot {j}: CONVERGED with true_rres "
+                        f"{rres:.3e} — a silently wrong answer")
+            else:
+                honest += 1        # the gate refused to lie
+        st = srv.stats().get("persistent", {}).get("poisson", {})
+        if st.get("fallbacks", 0) < 1:
+            problems.append("armed plan never routed the launch through "
+                            "the resilient fallback")
+        # the plan is disarmed: post-fault traffic rides the DIRECT
+        # resident program again, at ≤ one dispatch for the request
+        before = dispatch_counts()
+        post = srv.solve("poisson", B[:, 0], timeout=300)
+        after = dispatch_counts()
+        direct = int(after.get("persistent_serve", 0)
+                     - before.get("persistent_serve", 0))
+        rres = (np.linalg.norm(B[:, 0] - A @ post.x)
+                / np.linalg.norm(B[:, 0]))
+        if not (post.converged and rres <= RTOL * 1.05):
+            problems.append(f"post-fault request failed parity "
+                            f"({post.reason_name}, {rres:.3e})")
+        if direct != 1:
+            problems.append(f"post-fault request cost {direct} "
+                            "persistent_serve dispatch(es), wanted 1")
+        print(f"[chaos] persistent-bitflip: "
+              f"{'OK' if not problems else 'FAIL'} {answered}/{Q} "
+              f"answered ({parity} fp64-parity, {honest} honestly "
+              f"non-converged), fallbacks={st.get('fallbacks')}")
+    finally:
+        srv.shutdown(wait=False)
+    return [f"persistent-bitflip: {p}" for p in problems]
+
+
+def drill_persistent_lost() -> list[str]:
+    """Mid-launch device loss on a persistent session (``--persistent``,
+    ISSUE 18): the loss fires at the resilient fallback's program
+    boundary, the elastic tier shrinks the mesh RESUMING past iteration
+    0, every slot future resolves converged at fp64 parity, the server
+    adopts the shrunk mesh, and the NEXT launch transparently rebuilds
+    the resident program on the surviving geometry
+    (``stats['rebuilds']``)."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    if comm.size < 2:
+        return ["persistent-lost: needs a multi-device mesh "
+                f"(got {comm.size} device[s])"]
+    A = poisson2d_csr(12)
+    n = A.shape[0]
+    rng = np.random.default_rng(10)
+    Q = 6
+    Xt = rng.random((n, Q))
+    B = np.asarray(A @ Xt)
+    victim = comm.device_ids[-1]
+    srv = _persistent_server(tps, comm, A, RTOL)
+    try:
+        spec = f"device.lost=unavailable:device={victim}:at=1:iter=10"
+        with tps.inject_faults(spec):
+            futs = [srv.submit("poisson", B[:, j]) for j in range(Q)]
+            srv.start()
+            if not srv.drain(600):
+                problems.append("drain timed out — hung slot future(s)")
+        for j, f in enumerate(futs):
+            if not f.done():
+                problems.append(f"slot {j} future never resolved")
+                continue
+            exc = f.exception(0)
+            if exc is not None:
+                problems.append(f"slot {j}: untyped failure {exc!r}")
+                continue
+            r = f.result(0)
+            rres = (np.linalg.norm(B[:, j] - A @ r.x)
+                    / np.linalg.norm(B[:, j]))
+            if not (r.converged and rres <= RTOL * 1.05):
+                problems.append(f"slot {j}: reason={r.reason_name} "
+                                f"true_rres={rres:.3e} (parity miss)")
+        st = srv.stats()
+        if not st["mesh_shrinks"]:
+            problems.append("server never adopted a shrunk mesh")
+        elif st["mesh_shrinks"][0]["resumed_iteration"] <= 0:
+            problems.append("shrunk solve restarted from iteration 0 — "
+                            "the checkpoint carry was lost")
+        if srv.comm.size >= comm.size:
+            problems.append(f"server still on {srv.comm.size} devices")
+        # the registry still holds the victim, but the adopted mesh
+        # excludes it: the next launch must take the DIRECT path and
+        # rebuild the resident program for the shrunk geometry
+        post = srv.solve("poisson", B[:, 0], timeout=600)
+        rres = (np.linalg.norm(B[:, 0] - A @ post.x)
+                / np.linalg.norm(B[:, 0]))
+        if not (post.converged and rres <= RTOL * 1.05):
+            problems.append(f"post-shrink request failed parity "
+                            f"({post.reason_name}, {rres:.3e})")
+        pst = srv.stats().get("persistent", {}).get("poisson", {})
+        if pst.get("rebuilds", 0) != 1:
+            problems.append(
+                f"{pst.get('rebuilds', 0)} resident-program rebuild(s) "
+                "after the shrink, wanted exactly 1")
+        print(f"[chaos] persistent-lost: "
+              f"{'OK' if not problems else 'FAIL'} "
+              f"{comm.size}->{srv.comm.size} devices, "
+              f"resumed_iter="
+            f"{st['mesh_shrinks'][0]['resumed_iteration'] if st['mesh_shrinks'] else '-'}, "
+              f"rebuilds={pst.get('rebuilds')}")
+    finally:
+        srv.shutdown(wait=False)
+        _faults.heal()
+    return [f"persistent-lost: {p}" for p in problems]
 
 
 def drill_fleet_regrow() -> list[str]:
@@ -909,6 +1089,14 @@ def main() -> int:
         failures += drill_multisplit_lost()
         failures += drill_multisplit_partition()
         what = "asynchronous-multisplit staleness/loss"
+    elif "--persistent" in sys.argv[1:]:
+        # ISSUE 18 acceptance: a bitflip across a fully-staged
+        # persistent launch must resolve every slot with no silently-
+        # wrong answer, and a mid-launch device loss must shrink,
+        # resume past iteration 0, and rebuild the resident program
+        failures += drill_persistent_bitflip()
+        failures += drill_persistent_lost()
+        what = "persistent-serving corruption/loss"
     elif "--sstep" in sys.argv[1:]:
         # ISSUE 15 acceptance: a bitflip inside an s-block must detect
         # -> rollback to the verified carry -> re-enter, and the
