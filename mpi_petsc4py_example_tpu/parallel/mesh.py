@@ -147,6 +147,16 @@ class DeviceComm:
     def __repr__(self):
         return f"DeviceComm(size={self.size}, axis={self.axis!r})"
 
+    def fingerprint(self) -> dict:
+        """Plain-data mesh descriptor for cross-host exchange (the
+        transport hello/stats payload — serving/remote.py): platform,
+        shard count and member device ids. Deliberately carries NO
+        device handles, so it pickles across processes; the elastic
+        checkpoint format never encodes a mesh size, and this is how a
+        peer still learns (and reports) what geometry is serving."""
+        return {"platform": self.platform, "size": int(self.size),
+                "device_ids": list(self.device_ids)}
+
     # ---- shardings ---------------------------------------------------------
     @property
     def row_sharding(self) -> NamedSharding:

@@ -40,6 +40,7 @@ Spec grammar (comma-separated clauses)::
     kind   := unavailable | oom | nan | inf | drop | corrupt
             | bitflip | scale                  (silent corruption)
             | delay | partition                (timing / stale exchange)
+            | duplicate | reorder              (rpc.* delivery faults)
     params := at=N      trigger on the Nth hit of the point (default 1)
               device=D  device id to lose ('device.lost' clauses; default:
                         the highest device id in the checked mesh) — or
@@ -123,6 +124,23 @@ FAULT_POINTS = {
     # a partitioned peer), the network-split model the bounded-staleness
     # supervisor must resync or degrade around.
     "exchange.put": ("drop", "partition"),   # stale-exchange publish
+    # RPC transport boundaries (serving/transport.py): 'rpc.send' is
+    # the CLIENT send side (device= is the destination host index) —
+    # 'drop' loses the request in flight (the client's per-attempt
+    # timeout fires and the retry tier re-sends under the SAME
+    # idempotency key), 'duplicate' delivers the request twice (the
+    # host-side idempotency cache must collapse them to one execution),
+    # 'delay'/'reorder' hold the message (reorder long enough for a
+    # concurrent later message to overtake — non-FIFO delivery), and
+    # 'partition' with device=H:times=* makes host H unreachable while
+    # armed (the network-split model the epoch-numbered placement
+    # reconcile heals without split-braining). 'rpc.recv' is the HOST
+    # side, applied AFTER the handler ran and BEFORE the reply leaves:
+    # a 'drop'/'partition' here means the work WAS done but the client
+    # never hears — the canonical duplicate-generating failure the
+    # idempotent-retry contract exists for.
+    "rpc.send": ("drop", "delay", "duplicate", "reorder", "partition"),
+    "rpc.recv": ("drop", "delay", "duplicate", "reorder", "partition"),
 }
 
 RAISING_KINDS = ("unavailable", "oom")

@@ -3,9 +3,12 @@
 See :mod:`.server` (the SolveServer session + client APIs),
 :mod:`.coalescer` (the pure request-grouping logic), :mod:`.qos`
 (priority/deadline classes, the deadline-weighted scheduler, overload
-shedding, the autoscale policy), and :mod:`.fleet` (the SolveRouter:
+shedding, the autoscale policy), :mod:`.fleet` (the SolveRouter:
 consistent-hash session sharding across replicas, migration, heal-driven
-re-grow). README "Serving" / "Fleet serving" document the user surface;
+re-grow), :mod:`.transport` (the deadline/retry/idempotency RPC layer)
+and :mod:`.remote` (remote replicas, the lease failure detector, and
+partition-tolerant failover — the multi-host fleet). README "Serving" /
+"Fleet serving" / "Multi-host transport" document the user surface;
 PARITY.md "Serving sessions" maps the session model onto PETSc's
 reuse-the-KSP-object idiom.
 """
@@ -14,7 +17,13 @@ from .coalescer import SolveRequest, coalesce, padded_width
 from .fleet import HashRing, SolveRouter
 from .persistent import PersistentRunner
 from .qos import AutoscalePolicy, QoSClass, ScaleDecision
+from .remote import (FailoverEvent, FleetManager, RemoteReplica,
+                     ReplicaHost)
 from .server import (ServedSolveResult, ServerClosedError, SolveServer)
+from .transport import (LoopbackTransport, Message, RpcClient,
+                        RpcDeadlineError, RpcHost, SocketHostServer,
+                        SocketTransport, TransportError,
+                        TransportUnreachableError)
 
 __all__ = [
     "SolveServer", "ServedSolveResult", "ServerClosedError",
@@ -22,4 +31,8 @@ __all__ = [
     "PersistentRunner",
     "SolveRouter", "HashRing",
     "QoSClass", "AutoscalePolicy", "ScaleDecision",
+    "Message", "RpcHost", "RpcClient",
+    "LoopbackTransport", "SocketTransport", "SocketHostServer",
+    "TransportError", "TransportUnreachableError", "RpcDeadlineError",
+    "ReplicaHost", "RemoteReplica", "FleetManager", "FailoverEvent",
 ]

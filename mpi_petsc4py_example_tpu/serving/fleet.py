@@ -458,6 +458,22 @@ class SolveRouter:
                 pass
         record_migration(op, src, dst, time.perf_counter() - t0)
 
+    def rehome(self, op: str, dst: str):
+        """Out-of-band placement flip (serving/remote.py): a failover
+        or post-partition reconcile has ALREADY re-registered ``op`` on
+        ``dst`` — from its last elastic checkpoint, outside the
+        router's own migration engine — so only the routing tables
+        move. Pins an override so ring lookups keep the session where
+        the failure detector put it until membership changes it."""
+        with self._lock:
+            if op not in self._ops:
+                raise ValueError(f"unknown operator {op!r}; registered: "
+                                 f"{sorted(self._ops)}")
+            if dst not in self._replicas:
+                raise ValueError(f"unknown replica {dst!r}")
+            self._placement[op] = dst
+            self._overrides[op] = dst
+
     # ---- autoscale / heal ---------------------------------------------------
     def autoscale_step(self) -> _qos.ScaleDecision:
         """One policy evaluation + execution: collect per-replica stats,

@@ -118,6 +118,10 @@ class PersistentRunner:
         # build/compile), letting a concurrent drain exit early
         self._live = 0
         self._mesh = server.comm.mesh  # last launch's mesh (rebuild det.)
+        # the ABFT-guard demotion warns ONCE per session registration —
+        # under sustained traffic a per-dispatch warning is pure noise
+        # (stats["fallbacks"] counts every demoted launch regardless)
+        self._guard_warned = False
         self.stats = {"launches": 0, "requests": 0, "padded_slots": 0,
                       "fallbacks": 0, "rebuilds": 0, "turnovers": 0}
 
@@ -210,7 +214,24 @@ class PersistentRunner:
         # does not force the fallback: the registry stays populated
         # until heal, but the surviving mesh is healthy.
         mesh_devs = set(sess.ksp.get_operators()[0].comm.device_ids)
-        if _faults.active() or (set(_faults.lost_devices()) & mesh_devs):
+        # a silent-corruption guard acquired AFTER registration
+        # (ksp.abft / residual replacement toggled on the live session,
+        # e.g. by a runtime -ksp_* flag) disqualifies the persistent
+        # program — it carries no in-program detectors. Demote to the
+        # resilient per-batch path, warning once per registration
+        guard = (bool(sess.ksp.abft)
+                 or int(sess.ksp.residual_replacement) > 0)
+        if guard and not self._guard_warned:
+            self._guard_warned = True
+            import warnings
+            warnings.warn(
+                f"persistent session {sess.name!r}: the ABFT/"
+                "residual-replacement guard was enabled after "
+                "registration — launches fall back to per-batch "
+                "dispatch (counted in stats['fallbacks']; this warns "
+                "once per registration)", stacklevel=2)
+        if (guard or _faults.active()
+                or (set(_faults.lost_devices()) & mesh_devs)):
             rec.fallback = True
             self._rec = rec
             return

@@ -1,0 +1,789 @@
+"""Remote replicas: the fleet router spanning hosts over the RPC layer.
+
+Three pieces turn the process-local fleet (serving/fleet.py) into a
+multi-host one WITHOUT changing the router's placement/migration logic:
+
+* :class:`ReplicaHost` — the host-process side: one
+  :class:`~.server.SolveServer` behind an :class:`~.transport.RpcHost`
+  handler table. Besides the obvious verbs (register/solve/drain/stats)
+  it keeps a per-session **elastic checkpoint** — refreshed after every
+  resolved solve with the session's CUMULATIVE iteration count — and
+  piggybacks ``{op: iteration}`` on every lease ping, so the client side
+  always knows which checkpoints advanced and pulls only those.
+* :class:`RemoteReplica` — the client stub implementing the replica
+  interface ``SolveRouter`` already speaks (``register_operator`` /
+  ``submit`` / ``drain`` / ``stats`` / ``shutdown`` / ``.comm``), so a
+  router built with a stub factory shards sessions across hosts
+  unchanged; migration ships the mesh-portable checkpoint bytes over the
+  wire (the format never encoded a mesh size — PR 6's elastic property
+  is what makes cross-geometry failover possible at all). A submit whose
+  RPC fails past its deadline consults the ``failover`` hook and replays
+  the SAME idempotency key on the session's new home — the in-flight
+  future fails over instead of hanging.
+* :class:`FleetManager` — hosts + stubs + router + the **lease-based
+  failure detector**: ``lease_step()`` pings every host; a host missing
+  ``-fleet_transport_suspect_after`` consecutive renewals is SUSPECTED
+  (degraded routing: its stub shrinks per-call deadlines so in-flight
+  work fails over quickly), ``-fleet_transport_confirm_after`` misses
+  CONFIRMS the loss and re-homes its sessions onto survivors from their
+  last pulled checkpoint — resumed past iteration 0, never from scratch
+  (the ``fleet.failover`` span records ``resumed_iteration`` as the
+  proof). Placement changes carry monotonic **epochs**; after a
+  partition heals, :meth:`FleetManager.reconcile` gathers every live
+  host's resident table and keeps exactly one registration per session
+  (the router's authoritative owner when alive, else the highest epoch),
+  unregistering orphans — a healed partition reconciles routing instead
+  of split-braining.
+
+The deliberate asymmetry with MPI (PARITY round 19): the reference gets
+exactly-once and membership from the communicator world — and pays for
+it by dying whole when a rank does. This tier earns the same guarantees
+per-message (idempotency keys, leases, epochs) so the fleet outlives any
+single host, the ULFM revoke/shrink story at serving granularity.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import tempfile
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _telemetry
+from ..utils.options import global_options
+from .fleet import SolveRouter
+from .server import ServedSolveResult, SolveServer
+from .transport import (LoopbackTransport, RpcClient, RpcHost,
+                        SocketHostServer, SocketTransport, TransportError)
+
+__all__ = ["ReplicaHost", "RemoteReplica", "RemoteSession",
+           "FleetManager", "FailoverEvent"]
+
+
+def _ckpt_to_bytes(mat, X, B, iteration: int = 0) -> bytes:
+    """The elastic checkpoint as wire bytes (the npz format is already
+    mesh-portable; this only lifts it off the filesystem)."""
+    from ..utils.checkpoint import save_solve_state_many
+    fd, path = tempfile.mkstemp(suffix=".npz", prefix="tpu_fleet_ckpt_")
+    os.close(fd)
+    try:
+        save_solve_state_many(path, mat, X, B, iteration=int(iteration))
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def _ckpt_from_bytes(blob: bytes, comm):
+    """(mat, X, B, iteration) reloaded onto ``comm``'s mesh."""
+    from ..utils.checkpoint import load_solve_state_many
+    fd, path = tempfile.mkstemp(suffix=".npz", prefix="tpu_fleet_ckpt_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        return load_solve_state_many(path, comm)
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+class ReplicaHost:
+    """Host-process side of one remote replica (module doc).
+
+    ``server`` may be supplied (socket drills reuse one built
+    elsewhere); otherwise one is constructed from ``comm`` +
+    ``server_kw``. The handler table lives behind the transport's
+    idempotency cache, so every verb here may be delivered twice and
+    must only OBSERVE that via the cache — none of them are re-run."""
+
+    def __init__(self, server: SolveServer | None = None, *, comm=None,
+                 host_index: int = 0, **server_kw):
+        self.server = (server if server is not None
+                       else SolveServer(comm, **server_kw))
+        self.host_index = int(host_index)
+        self._lock = threading.RLock()
+        # op -> {"bytes", "iteration", "epoch", "kwargs"}: the freshest
+        # elastic checkpoint of every resident session, refreshed after
+        # each resolved solve with the CUMULATIVE iteration count — what
+        # a confirmed-loss failover on some OTHER host resumes from
+        self._ckpt: dict[str, dict] = {}
+        self.rpc = RpcHost({
+            "hello": self._h_hello,
+            "ping": self._h_ping,
+            "register": self._h_register,
+            "unregister": self._h_unregister,
+            "solve": self._h_solve,
+            "drain": self._h_drain,
+            "drain_operator": self._h_drain_operator,
+            "stats": self._h_stats,
+            "operators": self._h_operators,
+            "resident": self._h_resident,
+            "checkpoint": self._h_checkpoint,
+            "regrow": self._h_regrow,
+            "shutdown": self._h_shutdown,
+        }, host_index=host_index)
+
+    # ---- handlers (payload dict -> picklable reply) -------------------------
+
+    def _h_hello(self, p):
+        return {"host": self.host_index,
+                "mesh": self.server.comm.fingerprint()}
+
+    def _h_ping(self, p):
+        with self._lock:
+            its = {op: e["iteration"] for op, e in self._ckpt.items()}
+        return {"host": self.host_index, "iterations": its}
+
+    def _h_register(self, p):
+        """Land a session from checkpoint bytes. ``resume=True`` with a
+        checkpoint past iteration 0 warm-restarts the carried iterate
+        block (``set_initial_guess_nonzero`` — the failover path's
+        "never iteration 0" contract); the reply's ``resumed_iteration``
+        is the checkpointed count the solve continued from."""
+        op = p["op"]
+        kwargs = dict(p.get("kwargs") or {})
+        epoch = int(p.get("epoch", 0))
+        mat, X, B, it = _ckpt_from_bytes(p["ckpt"], self.server.comm)
+        sess = self.server.register_session(op, mat, **kwargs)
+        resumed = 0
+        iteration = int(it)
+        if p.get("resume") and it > 0:
+            resumed = int(it)
+            sess.ksp.set_initial_guess_nonzero(True)
+            try:
+                res = sess.ksp.solve_many(np.asarray(B), np.asarray(X))
+            finally:
+                sess.ksp.set_initial_guess_nonzero(False)
+            iteration = int(it) + int(max(res.iterations or [0]))
+            X = np.asarray(res.X)
+        with self._lock:
+            self._ckpt[op] = {
+                "bytes": _ckpt_to_bytes(sess.operator, np.asarray(X),
+                                        np.asarray(B), iteration),
+                "iteration": iteration, "epoch": epoch, "kwargs": kwargs}
+        return {"host": self.host_index, "epoch": epoch,
+                "resumed_iteration": resumed, "iteration": iteration,
+                "mesh": self.server.comm.fingerprint()}
+
+    def _h_unregister(self, p):
+        op = p["op"]
+        self.server.drain_operator(op)
+        self.server.unregister_operator(op)
+        with self._lock:
+            self._ckpt.pop(op, None)
+        return True
+
+    def _h_solve(self, p):
+        op = p["op"]
+        b = np.asarray(p["b"])
+        kw = dict(p.get("kw") or {})
+        budget = float(p.get("timeout") or 120.0)
+        res = self.server.submit(op, b, **kw).result(timeout=budget)
+        self._refresh_ckpt(op, b, res)
+        return {"op": op, "x": np.asarray(res.x),
+                "iterations": int(res.iterations),
+                "residual_norm": float(res.residual_norm),
+                "reason": int(res.reason),
+                "wall_time": float(res.wall_time),
+                "batch_width": int(res.batch_width),
+                "queue_wait": float(res.queue_wait)}
+
+    def _refresh_ckpt(self, op: str, b, res):
+        """Advance ``op``'s checkpoint past the solve that just
+        resolved: the iterate block becomes the solution, the session
+        iteration count accumulates — so a later failover provably
+        resumes PAST iteration 0."""
+        with self._lock:
+            entry = self._ckpt.get(op)
+            if entry is None:
+                return
+            sess = self.server._sessions.get(op)
+            if sess is None:
+                return
+            n = int(sess.n)
+            X = np.asarray(res.x, dtype=sess.dtype).reshape(n, -1)
+            B = np.asarray(b, dtype=sess.dtype).reshape(n, -1)
+            entry["iteration"] = (int(entry["iteration"])
+                                  + int(res.iterations))
+            entry["bytes"] = _ckpt_to_bytes(sess.operator, X, B,
+                                            entry["iteration"])
+
+    def _h_drain(self, p):
+        return bool(self.server.drain(p.get("timeout")))
+
+    def _h_drain_operator(self, p):
+        self.server.drain_operator(p["op"])
+        return True
+
+    def _h_stats(self, p):
+        return self.server.stats()
+
+    def _h_operators(self, p):
+        return self.server.operators()
+
+    def _h_resident(self, p):
+        with self._lock:
+            return {op: int(e["epoch"]) for op, e in self._ckpt.items()}
+
+    def _h_checkpoint(self, p):
+        with self._lock:
+            e = self._ckpt[p["op"]]
+            return {"bytes": e["bytes"], "iteration": int(e["iteration"]),
+                    "epoch": int(e["epoch"]),
+                    "kwargs": dict(e["kwargs"])}
+
+    def _h_regrow(self, p):
+        return bool(self.server.regrow())
+
+    def _h_shutdown(self, p):
+        self.server.shutdown(wait=bool(p.get("wait", True)))
+        return True
+
+
+class RemoteSession:
+    """What :meth:`RemoteReplica.register_operator` returns: the
+    client-side placed operator (the router retains ``.operator`` for
+    migration checkpoints) plus the host's registration reply."""
+
+    __slots__ = ("name", "operator", "info")
+
+    def __init__(self, name, operator, info=None):
+        self.name = name
+        self.operator = operator
+        self.info = dict(info or {})
+
+
+class RemoteReplica:
+    """Client stub speaking the replica interface over one RpcClient.
+
+    ``comm`` is the CLIENT-side device comm checkpoints are placed on
+    when the router reloads one for migration (``.comm`` property — the
+    stub's mesh stand-in; the host may run a different geometry, which
+    the elastic format absorbs). ``failover`` is an optional
+    ``callable(op, replica_name) -> RemoteReplica | None`` consulted
+    when a solve RPC dies past its deadline: the SAME idempotency key
+    replays on the returned stub, so the in-flight future fails over —
+    exactly once — instead of hanging. ``epoch_source`` supplies the
+    monotonic placement epochs (the FleetManager's counter; standalone
+    stubs default to a private one)."""
+
+    def __init__(self, client: RpcClient, *, name: str = "remote",
+                 comm=None, failover=None, epoch_source=None,
+                 solve_timeout: float = 120.0, max_workers: int = 4):
+        self.client = client
+        self.name = str(name)
+        self._comm = comm
+        self.failover = failover
+        self.degraded = False       # set by the failure detector
+        self.solve_timeout = float(solve_timeout)
+        self._counter = itertools.count(1)
+        self._epoch = epoch_source or (lambda c=itertools.count(1):
+                                       next(c))
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, int(max_workers)),
+            thread_name_prefix=f"rpc-{name}")
+        self._ops: dict[str, dict] = {}
+
+    @property
+    def comm(self):
+        return self._comm
+
+    def _deadline(self) -> float:
+        """Per-call budget: a SUSPECTED host gets a quarter of the
+        normal deadline — degraded routing means in-flight work fails
+        over fast instead of burning the full budget on a host the
+        lease detector already distrusts."""
+        d = self.client.deadline
+        return d * 0.25 if self.degraded else d
+
+    def hello(self) -> dict:
+        return self.client.call("hello", {}, deadline=self._deadline())
+
+    # ---- replica interface (what SolveRouter calls) -------------------------
+
+    def register_operator(self, name: str, A, **kw):
+        mat = A
+        if not hasattr(mat, "device_arrays"):
+            import scipy.sparse as sp
+            from ..core.mat import Mat
+            mat = Mat.from_scipy(self._comm, sp.csr_matrix(A),
+                                 dtype=kw.get("dtype"))
+        return self.register_session(name, mat, **kw)
+
+    def register_session(self, name: str, operator, **kw):
+        n = int(operator.shape[0])
+        z = np.zeros((n, 1), dtype=np.dtype(operator.dtype))
+        epoch = int(self._epoch())
+        info = self.client.call(
+            "register",
+            {"op": name, "ckpt": _ckpt_to_bytes(operator, z, z, 0),
+             "kwargs": dict(kw), "epoch": epoch, "resume": False},
+            deadline=self.client.deadline,
+            idem_key=f"{self.name}.register.{name}.{epoch}")
+        self._ops[name] = dict(kw)
+        return RemoteSession(name, operator, info)
+
+    def unregister_operator(self, name: str):
+        self.client.call("unregister", {"op": name},
+                         deadline=self._deadline())
+        self._ops.pop(name, None)
+
+    def submit(self, op: str, b, **kw) -> Future:
+        """One solve as a Future. The RPC is synchronous per call, so a
+        small pool carries it off-thread; the idempotency key is fixed
+        per LOGICAL submit — retries and failover replays reuse it, and
+        the host-side cache makes the solve run exactly once no matter
+        which host finally answers."""
+        fut: Future = Future()
+        idem = f"{self.name}.solve.{op}.{next(self._counter)}"
+        payload = {"op": op, "b": np.asarray(b), "kw": dict(kw),
+                   "timeout": self.solve_timeout}
+        self._pool.submit(self._solve_task, op, payload, idem, fut)
+        return fut
+
+    def _solve_task(self, op, payload, idem, fut: Future):
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            try:
+                reply = self.client.call("solve", payload,
+                                         deadline=self._deadline(),
+                                         idem_key=idem)
+            except TransportError:
+                target = (self.failover(op, self.name)
+                          if self.failover is not None else None)
+                if target is None:
+                    raise
+                # replay the SAME key on the session's new home: if the
+                # dead host actually ran the solve, nobody can ask it —
+                # the survivor executes from the re-homed checkpoint and
+                # its own cache dedupes OUR retries from here on
+                reply = target.client.call(
+                    "solve", payload, deadline=target.client.deadline,
+                    idem_key=idem)
+            fut.set_result(_result_from_reply(reply))
+        # tpslint: disable=TPS005 — the future boundary: every failure
+        # (transport, typed serving error, handler crash) RESOLVES the
+        # future; swallowing would mean a hung client
+        except Exception as exc:  # noqa: BLE001
+            fut.set_exception(exc)
+
+    def solve(self, op: str, b, *, timeout: float | None = None, **kw):
+        return self.submit(op, b, **kw).result(
+            timeout if timeout is not None else self.solve_timeout)
+
+    def operators(self):
+        return self.client.call("operators", {},
+                                deadline=self._deadline())
+
+    def drain(self, timeout: float | None = None) -> bool:
+        budget = (timeout if timeout is not None
+                  else self.solve_timeout) + self.client.deadline
+        return bool(self.client.call("drain", {"timeout": timeout},
+                                     deadline=budget))
+
+    def drain_operator(self, name: str):
+        return self.client.call(
+            "drain_operator", {"op": name},
+            deadline=self.solve_timeout + self.client.deadline)
+
+    def stats(self) -> dict:
+        """The host server's stats dict — or an explicit `unreachable`
+        skeleton when the host is gone, so fleet-wide aggregation keeps
+        working across a loss (the router sums these keys)."""
+        try:
+            return self.client.call("stats", {},
+                                    deadline=self._deadline())
+        except TransportError:
+            return {"requests": 0, "batches": 0, "padded_cols": 0,
+                    "width_hist": {}, "qos_hist": {}, "rejected": 0,
+                    "expired": 0, "shed": 0, "pending": 0, "devices": 0,
+                    "mesh_shrinks": [], "mesh_regrows": [],
+                    "mean_width": 0.0, "unreachable": True}
+
+    def regrow(self) -> bool:
+        try:
+            return bool(self.client.call("regrow", {},
+                                         deadline=self._deadline()))
+        except TransportError:
+            return False
+
+    def shutdown(self, wait: bool = True):
+        try:
+            self.client.call("shutdown", {"wait": bool(wait)},
+                             deadline=self._deadline())
+        except TransportError:
+            pass        # a dead host is, definitionally, shut down
+        self._pool.shutdown(wait=False)
+
+    def __repr__(self):
+        return (f"RemoteReplica({self.name!r}, "
+                f"host={self.client.host_index}, "
+                f"degraded={self.degraded})")
+
+
+def _result_from_reply(reply: dict) -> ServedSolveResult:
+    return ServedSolveResult(
+        iterations=int(reply["iterations"]),
+        residual_norm=float(reply["residual_norm"]),
+        reason=int(reply["reason"]),
+        wall_time=float(reply["wall_time"]),
+        x=np.asarray(reply["x"]),
+        op=str(reply["op"]),
+        batch_width=int(reply["batch_width"]),
+        queue_wait=float(reply["queue_wait"]))
+
+
+@dataclass(frozen=True)
+class FailoverEvent:
+    """One confirmed host loss re-homed: which sessions moved where,
+    and the checkpointed iteration the resumed solve continued from —
+    ``resumed_iteration > 0`` is the drill's provable "never from
+    scratch" evidence."""
+    host: str
+    dst: str
+    sessions: tuple
+    resumed_iteration: int
+    wall_s: float
+
+
+class FleetManager:
+    """Hosts + transports + stubs + router + the failure detector.
+
+    ``transport`` (or ``-fleet_transport``) picks ``loopback``
+    (in-process, deterministic — CI and chaos drills) or ``socket``
+    (localhost TCP — every frame really pickles and crosses a socket).
+    Lease knobs come from the options DB: ``-fleet_transport_lease_s``
+    between renewal rounds (only the monitor thread uses it —
+    :meth:`lease_step` is manual and deterministic for drills),
+    ``-fleet_transport_suspect_after`` / ``_confirm_after`` the
+    consecutive-miss thresholds for the suspected/confirmed ladder.
+
+    ``client_sleep`` is handed to every RpcClient (drills pass a no-op
+    so retries don't wall-wait); ``monitor=True`` starts a daemon
+    thread running the lease loop for real deployments."""
+
+    def __init__(self, hosts: int = 2, comm=None, *,
+                 transport: str | None = None, monitor: bool = False,
+                 client_sleep=time.sleep, vnodes: int | None = None,
+                 rpc_deadline: float | None = None,
+                 rpc_retry_max: int | None = None, **server_kw):
+        opt = global_options()
+        self.transport_kind = opt.get_string(
+            "fleet_transport", transport or "loopback")
+        self.lease_s = opt.get_real("fleet_transport_lease_s", 0.5)
+        self.suspect_after = opt.get_int("fleet_transport_suspect_after",
+                                         2)
+        self.confirm_after = opt.get_int("fleet_transport_confirm_after",
+                                         4)
+        self._epochs = itertools.count(1)
+        self._lock = threading.RLock()
+        self.hosts: dict[str, ReplicaHost] = {}
+        self.stubs: dict[str, RemoteReplica] = {}
+        self.transports: dict[str, object] = {}
+        self._socket_servers: list[SocketHostServer] = []
+        stubs = []
+        for i in range(max(1, int(hosts))):
+            name = f"r{i}"
+            host = ReplicaHost(comm=comm, host_index=i, **server_kw)
+            if self.transport_kind == "socket":
+                srv = SocketHostServer(host.rpc)
+                self._socket_servers.append(srv)
+                tr = SocketTransport(srv.address, i)
+            else:
+                tr = LoopbackTransport(host.rpc)
+            client = RpcClient(tr, deadline=rpc_deadline,
+                               retry_max=rpc_retry_max, seed=i,
+                               sleep=client_sleep)
+            stub = RemoteReplica(client, name=name,
+                                 comm=host.server.comm,
+                                 failover=self.failover_target,
+                                 epoch_source=self._next_epoch)
+            self.hosts[name] = host
+            self.stubs[name] = stub
+            self.transports[name] = tr
+            stubs.append(stub)
+        pool = list(stubs)
+        # the router names replicas r0, r1, ... in factory-call order —
+        # popping in order keeps stub names and router names aligned
+        self.router = SolveRouter(len(stubs), comm,
+                                  vnodes=vnodes,
+                                  server_factory=lambda: pool.pop(0))
+        self._lease = {name: {"misses": 0, "status": "live"}
+                       for name in self.stubs}
+        # op -> {"bytes","iteration","kwargs","epoch","host"}: the
+        # client-side checkpoint cache failover re-homes from — seeded
+        # at registration, refreshed by lease_step whenever a ping shows
+        # a session's iteration advanced
+        self._ckpt: dict[str, dict] = {}
+        self.failovers: list[FailoverEvent] = []
+        self._closed = False
+        self._monitor = None
+        if monitor:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="fleet-lease",
+                daemon=True)
+            self._monitor.start()
+
+    def _next_epoch(self) -> int:
+        with self._lock:
+            return next(self._epochs)
+
+    # ---- session front-end --------------------------------------------------
+
+    def register_operator(self, name: str, A, **kw):
+        """Router registration + an immediate checkpoint pull, so the
+        failover cache covers the session from birth (a host lost
+        before the first lease round is still re-homeable)."""
+        sess = self.router.register_operator(name, A, **kw)
+        owner = self.router.owner(name)
+        self._pull_ckpt(name, owner)
+        return sess
+
+    def submit(self, op: str, b, **kw) -> Future:
+        return self.router.submit(op, b, **kw)
+
+    def solve(self, op: str, b, *, timeout: float | None = None, **kw):
+        return self.router.solve(op, b, timeout=timeout, **kw)
+
+    def _pull_ckpt(self, op: str, owner: str):
+        stub = self.stubs[owner]
+        try:
+            ck = stub.client.call("checkpoint", {"op": op},
+                                  deadline=stub.client.deadline)
+        except TransportError:
+            return
+        with self._lock:
+            self._ckpt[op] = {"bytes": ck["bytes"],
+                              "iteration": int(ck["iteration"]),
+                              "kwargs": dict(ck["kwargs"]),
+                              "epoch": int(ck["epoch"]), "host": owner}
+
+    # ---- lease/heartbeat failure detector -----------------------------------
+
+    def lease_step(self) -> dict:
+        """One renewal round over every non-dead host: a reachable host
+        resets its miss counter and reports per-session iterations (the
+        checkpoint-freshness piggyback — advanced sessions get their
+        checkpoint bytes pulled); an unreachable one climbs the
+        suspected -> confirmed ladder. Deterministic and synchronous —
+        drills call it directly; the monitor thread just loops it."""
+        with self._lock:
+            live = 0
+            for name, stub in self.stubs.items():
+                st = self._lease[name]
+                if st["status"] == "dead":
+                    continue
+                try:
+                    reply = stub.client.call(
+                        "ping", {}, deadline=max(self.lease_s, 0.05))
+                except TransportError:
+                    st["misses"] += 1
+                    _metrics.registry.counter("fleet.lease_misses").inc(
+                        label=name)
+                    if st["misses"] >= self.confirm_after:
+                        self._confirm_loss(name)
+                    elif st["misses"] >= self.suspect_after:
+                        st["status"] = "suspected"
+                        stub.degraded = True
+                    continue
+                st["misses"] = 0
+                st["status"] = "live"
+                stub.degraded = False
+                live += 1
+                for op, it in reply["iterations"].items():
+                    cached = self._ckpt.get(op)
+                    if (cached is None or cached["host"] != name
+                            or int(it) > int(cached["iteration"])):
+                        self._pull_ckpt(op, name)
+            _metrics.registry.gauge("fleet.live_hosts").set(live)
+            return {name: dict(st)
+                    for name, st in self._lease.items()}
+
+    def _monitor_loop(self):
+        while not self._closed:
+            try:
+                self.lease_step()
+            # tpslint: disable=TPS005 — the background lease loop must
+            # outlive any single bad round (a host racing shutdown);
+            # every per-host failure is already counted as a lease miss
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(self.lease_s)
+
+    def _survivor(self, dead: str) -> str | None:
+        """The re-home destination: a live host, else a merely
+        suspected one (better a distrusted host than no host)."""
+        with self._lock:
+            for want in ("live", "suspected"):
+                for name, st in self._lease.items():
+                    if name != dead and st["status"] == want:
+                        return name
+        return None
+
+    def _confirm_loss(self, name: str):
+        """CONFIRMED host loss: kill its transport (no zombie replies),
+        re-home every session it owned onto a survivor from the cached
+        checkpoint — resumed at its checkpointed iteration, never 0 —
+        and flip the router's placement (``rehome``). Idempotent: a
+        second confirmation finds status already dead and returns."""
+        with self._lock:
+            st = self._lease[name]
+            if st["status"] == "dead":
+                return
+            st["status"] = "dead"
+            self.stubs[name].degraded = True
+            tr = self.transports[name]
+            if hasattr(tr, "kill"):
+                tr.kill()
+            t0 = time.perf_counter()
+            owned = [op for op in self.router.operators()
+                     if self.router.owner(op) == name]
+            dst = self._survivor(name)
+            moved = []
+            resumed_max = 0
+            with _telemetry.span("fleet.failover", host=name) as sp:
+                if dst is not None:
+                    for op in owned:
+                        ck = self._ckpt.get(op)
+                        if ck is None:
+                            continue    # never seen a checkpoint: the
+                            # session is lost with its host — reported
+                            # below by its absence from `sessions`
+                        stub = self.stubs[dst]
+                        epoch = self._next_epoch()
+                        reply = stub.client.call(
+                            "register",
+                            {"op": op, "ckpt": ck["bytes"],
+                             "kwargs": ck["kwargs"], "epoch": epoch,
+                             "resume": True},
+                            deadline=stub.client.deadline,
+                            idem_key=f"failover.{op}.{epoch}")
+                        self.router.rehome(op, dst)
+                        self._ckpt[op].update(
+                            host=dst, epoch=epoch,
+                            iteration=int(reply["iteration"]))
+                        moved.append(op)
+                        resumed_max = max(
+                            resumed_max,
+                            int(reply["resumed_iteration"]))
+                sp.set_attrs(sessions=len(moved),
+                             resumed_iteration=resumed_max)
+            _metrics.registry.counter("fleet.failovers").inc(label=name)
+            self.failovers.append(FailoverEvent(
+                host=name, dst=dst or "", sessions=tuple(moved),
+                resumed_iteration=resumed_max,
+                wall_s=time.perf_counter() - t0))
+
+    def failover_target(self, op: str, src_name: str):
+        """The RemoteReplica failover hook: an in-flight solve RPC to
+        ``src_name`` died past its deadline. Treat that as confirmation
+        evidence (the retry budget IS a probe burst), re-home
+        synchronously if nobody has yet, and return the stub now
+        serving ``op`` — or None when no survivor exists (the caller's
+        transport error then surfaces, typed, to the future)."""
+        with self._lock:
+            owner = self.router.owner(op)
+            if (owner != src_name
+                    and self._lease[owner]["status"] != "dead"):
+                return self.stubs[owner]    # already re-homed
+            self._confirm_loss(src_name)
+            owner = self.router.owner(op)
+            if (owner == src_name
+                    or self._lease[owner]["status"] == "dead"):
+                return None
+            return self.stubs[owner]
+
+    # ---- partition healing --------------------------------------------------
+
+    def reconcile(self) -> dict:
+        """Post-partition placement reconciliation (module doc): gather
+        ``resident()`` from every reachable host; for each session keep
+        exactly ONE registration — the router's authoritative owner
+        when it is alive and actually resident, else the highest
+        placement epoch — unregister the orphans, and point the router
+        at the winner. Returns what moved, for drills to assert the
+        single-truthful-placement property on."""
+        with self._lock, _telemetry.span("fleet.reconcile") as sp:
+            resident = {}
+            for name, stub in self.stubs.items():
+                if self._lease[name]["status"] == "dead":
+                    continue
+                try:
+                    resident[name] = stub.client.call(
+                        "resident", {}, deadline=stub.client.deadline)
+                except TransportError:
+                    continue        # still partitioned: next round
+            orphans = []
+            rehomed = []
+            for op in self.router.operators():
+                holders = {name: int(eps[op])
+                           for name, eps in resident.items()
+                           if op in eps}
+                if not holders:
+                    continue
+                auth = self.router.owner(op)
+                winner = (auth if auth in holders
+                          else max(holders, key=holders.get))
+                for name in sorted(holders):
+                    if name == winner:
+                        continue
+                    self.stubs[name].client.call(
+                        "unregister", {"op": op},
+                        deadline=self.stubs[name].client.deadline)
+                    orphans.append((op, name))
+                if winner != auth:
+                    self.router.rehome(op, winner)
+                    self._pull_ckpt(op, winner)
+                    rehomed.append((op, winner))
+            sp.set_attrs(orphans=len(orphans), rehomed=len(rehomed))
+            return {"orphans_removed": orphans, "rehomed": rehomed,
+                    "resident": resident}
+
+    # ---- drill/observability helpers ----------------------------------------
+
+    def kill_host(self, name: str):
+        """Abrupt host loss (drills): the transport dies NOW; discovery
+        still flows through the lease ladder or an in-flight call's
+        failover — exactly like a real host dropping off the network."""
+        tr = self.transports[name]
+        if hasattr(tr, "kill"):
+            tr.kill()
+
+    def lease_table(self) -> dict:
+        with self._lock:
+            return {name: dict(st) for name, st in self._lease.items()}
+
+    def stats(self) -> dict:
+        out = self.router.stats()
+        out["lease"] = self.lease_table()
+        out["failovers"] = [
+            {"host": e.host, "dst": e.dst, "sessions": list(e.sessions),
+             "resumed_iteration": e.resumed_iteration,
+             "wall_s": e.wall_s}
+            for e in self.failovers]
+        return out
+
+    def shutdown(self, wait: bool = True):
+        self._closed = True
+        self.router.shutdown(wait=wait)
+        for srv in self._socket_servers:
+            srv.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=exc == (None, None, None))
+        return False

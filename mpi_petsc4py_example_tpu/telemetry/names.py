@@ -79,6 +79,19 @@ NAMES = {
                               "replay"),
     "fleet.scale": ("span", "one executed autoscale decision "
                             "(grow/shrink/rebalance)"),
+    # ---- spans: multi-host transport (serving/transport.py + remote.py) ----
+    "rpc.call": ("span", "one client RPC call end to end: every send "
+                         "attempt, backoff and idempotent retry under "
+                         "one deadline (attrs: method, host, attempts)"),
+    "fleet.failover": ("span", "one confirmed-host-loss re-home: every "
+                               "session re-registered on a survivor "
+                               "from its last shipped checkpoint "
+                               "(attrs: host, sessions, "
+                               "resumed_iteration)"),
+    "fleet.reconcile": ("span", "one post-partition placement "
+                                "reconcile: resident tables gathered, "
+                                "highest-epoch/authoritative winner "
+                                "kept, orphan registrations removed"),
     # ---- spans: async multisplitting (solvers/multisplit.py) ----
     "multisplit.solve": ("span", "one asynchronous two-stage multisplit "
                                  "solve: block threads + bounded-staleness "
@@ -124,6 +137,20 @@ NAMES = {
                                     "replicas"),
     "fleet.scale_decisions": ("counter", "autoscale decisions by action "
                                          "(grow/shrink/rebalance/hold)"),
+    "rpc.retries": ("counter", "RPC send attempts beyond the first "
+                               "(same idempotency key re-sent after a "
+                               "drop/timeout) by method"),
+    "rpc.duplicates": ("counter", "duplicate deliveries collapsed by the "
+                                  "host-side idempotency cache (joined "
+                                  "in-flight or served from the result "
+                                  "cache — never re-executed)"),
+    "fleet.failovers": ("counter", "confirmed host losses re-homed onto "
+                                   "survivors"),
+    "fleet.lease_misses": ("counter", "lease renewals that found a host "
+                                      "unreachable (suspected after "
+                                      "-fleet_transport_suspect_after, "
+                                      "confirmed dead after "
+                                      "-fleet_transport_confirm_after)"),
     "multisplit.step": ("counter", "completed async outer steps (inner "
                                    "solve + publish) by block"),
     "multisplit.resyncs": ("counter", "bounded-staleness re-syncs: a block "
@@ -152,6 +179,9 @@ NAMES = {
                                 "(KSP + EPS caches)"),
     "serving.queue_depth": ("gauge", "pending requests at last submit"),
     "fleet.replicas": ("gauge", "live server replicas behind the router"),
+    "fleet.live_hosts": ("gauge", "transport hosts currently holding a "
+                                  "fresh lease (suspected/confirmed "
+                                  "hosts excluded)"),
     "autoselect.psum_latency_us": ("gauge", "measured (or probe-cached) "
                                            "per-reduce-site latency of "
                                            "the mesh, microseconds"),
@@ -171,6 +201,11 @@ NAMES = {
                                      "-log_view requests-per-launch row "
                                      "(≫1 means the resident program is "
                                      "paying ≪1 dispatch/request)"),
+    "rpc.call_seconds": ("histogram", "client RPC call wall including "
+                                      "every retry and backoff under "
+                                      "the call deadline — the retry "
+                                      "tail is the interesting bucket "
+                                      "mass"),
 }
 
 # Fault points the flight recorder records events for. MUST cover every
@@ -192,6 +227,8 @@ FLIGHT_FAULT_POINTS = (
     "device.lost",
     "comm.delay",
     "exchange.put",
+    "rpc.send",
+    "rpc.recv",
 )
 
 

@@ -152,6 +152,33 @@ KNOWN_FLAGS = {
                       "session sharding across N SolveServers)",
     "fleet_vnodes": "virtual nodes per replica on the consistent-hash "
                     "ring (placement smoothness vs ring size)",
+    # ---- multi-host transport (serving/transport.py + remote.py) ----
+    "fleet_transport": "replica transport for the multi-host fleet: "
+                       "'loopback' (in-process, deterministic CI) or "
+                       "'socket' (localhost TCP, real two-process "
+                       "framing)",
+    "fleet_transport_confirm_after": "consecutive missed lease renewals "
+                                     "before a suspected host is "
+                                     "CONFIRMED dead and its sessions "
+                                     "re-home from their last shipped "
+                                     "checkpoint",
+    "fleet_transport_lease_s": "lease renewal (heartbeat) interval "
+                               "seconds per transport host",
+    "fleet_transport_suspect_after": "consecutive missed lease renewals "
+                                     "before a host is SUSPECTED "
+                                     "(degraded routing: no new "
+                                     "placements, traffic drains)",
+    # ---- RPC client (serving/transport.py RpcClient) ----
+    "rpc_backoff_base_s": "base delay seconds of the capped exponential "
+                          "retry backoff (doubled per attempt, seeded "
+                          "jitter added)",
+    "rpc_backoff_cap_s": "ceiling seconds any single retry backoff may "
+                         "reach",
+    "rpc_deadline_s": "default per-call RPC deadline seconds (every "
+                      "send attempt, backoff and retry must fit inside "
+                      "it; per-submit deadlines override)",
+    "rpc_retry_max": "max send attempts per RPC call under one "
+                     "idempotency key (first try included)",
     # ---- QoS scheduling (serving/qos.py) ----
     "qos_bulk_deadline": "default dispatch deadline seconds for the "
                          "'bulk' class (0 = none)",

@@ -1,0 +1,39 @@
+"""TPS019 fixtures — RPC/transport waits without a deadline.
+
+Each marked line must produce exactly one finding.
+"""
+
+
+def bare_transport_send(transport, msg):
+    """A transport send with no budget — blocks forever on the first
+    lost reply."""
+    return transport.send(msg)  # BAD: TPS019
+
+
+def bare_rpc_call(rpc, payload):
+    """The client verb without a deadline: the exact hang the retry
+    ladder exists to remove."""
+    reply = rpc.call("solve", payload)  # BAD: TPS019
+    return reply
+
+
+def bare_stub_recv(stub):
+    """Receiving on a stub with no bound."""
+    return stub.recv()  # BAD: TPS019
+
+
+def unbounded_future_wait(client, payload):
+    """A network-backed future waited on with zero arguments — the
+    stdlib default is 'wait forever'."""
+    fut = client.submit("a", payload, deadline=1.0)
+    out = fut.result()  # BAD: TPS019
+    return out
+
+
+def unbounded_exception_probe(remote_replica, b):
+    """.exception() with no timeout is the same unbounded wait."""
+    f = remote_replica.call_async("solve", b, timeout=2.0)
+    pending = f
+    if pending.exception() is None:  # BAD: TPS019
+        return pending
+    return None

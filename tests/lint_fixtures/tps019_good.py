@@ -1,0 +1,41 @@
+"""TPS019 good fixtures — deadline-carrying RPC waits, bounded future
+results, and non-transport receivers. Zero findings expected."""
+
+
+def deadline_call(client, payload, deadline):
+    """The sanctioned pattern: every blocking verb carries a budget."""
+    return client.call("solve", payload, deadline=deadline)
+
+
+def timeout_kw_send(transport, msg):
+    return transport.send(msg, timeout=5.0)
+
+
+def positional_budget(transport, msg, remaining):
+    """A positional argument MENTIONING a budget name counts — the
+    rule checks engagement, not the exact signature."""
+    return transport.call_once(msg, remaining)
+
+
+def bounded_future(stub, b, timeout):
+    """result(timeout) is the bounded wait the transport contract
+    wants."""
+    fut = stub.submit("a", b, deadline=2.0)
+    return fut.result(timeout)
+
+
+def non_transport_receivers(comm, sock, pool, fn):
+    """send/recv/submit on non-RPC receivers (MPI comms, raw sockets,
+    thread pools) are out of scope — their blocking semantics are their
+    own modules' business."""
+    comm.send({"n": 1}, dest=1)
+    data = comm.recv(source=0)
+    chunk = sock.recv(4096)
+    fut = pool.submit(fn, data)
+    return fut.result(), chunk
+
+
+def plain_future_result(make_future):
+    """A future that never came from an RPC submit is untainted."""
+    fut = make_future()
+    return fut.result()

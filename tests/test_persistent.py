@@ -186,6 +186,37 @@ class TestPersistentBasics:
         assert r.converged
         np.testing.assert_allclose(r.x, Xt[:, 0], atol=1e-6)
 
+    def test_late_guard_fallback_warns_once_per_registration(self,
+                                                             comm8):
+        """A guard enabled AFTER registration (ksp.abft toggled on the
+        live session) demotes every launch to the per-batch path — but
+        warns exactly ONCE per registration; repeat launches count
+        silently in stats['fallbacks']."""
+        import warnings
+        A, Xt, B = _problem(k=2)
+        srv = SolveServer(comm8, window=0.0, max_k=4, autostart=False,
+                          retry_policy=_fast_policy())
+        _register(srv, A)
+        srv._sessions["p"].ksp.abft = True     # the late guard
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                f0 = srv.submit("p", B[:, 0])
+                srv.start()
+                r0 = f0.result(300)
+                r1 = srv.solve("p", B[:, 1], timeout=300)
+            guard_warns = [w for w in caught
+                           if "guard was enabled after registration"
+                           in str(w.message)]
+            assert len(guard_warns) == 1       # once, not per launch
+            st = _pstats(srv)
+            assert st["fallbacks"] == 2        # both still counted
+            for j, r in enumerate((r0, r1)):
+                assert r.converged
+                np.testing.assert_allclose(r.x, Xt[:, j], atol=1e-6)
+        finally:
+            srv.shutdown()
+
     def test_persistent_multisplit_mutually_exclusive(self, comm8):
         A, _, _ = _problem(k=1)
         srv = SolveServer(comm8, window=0.0, autostart=False)

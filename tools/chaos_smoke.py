@@ -51,6 +51,16 @@ Modes:
   increasing across the loss (survivors provably never revisit
   iteration 0); and an ``exchange.put`` drop/partition must only ever
   cost staleness, never correctness;
+* ``--transport`` (ISSUE 20): the multi-host RPC drills — killing the
+  owning replica host mid-load must fail every in-flight future over
+  to the survivor with the re-homed solve RESUMING past iteration 0
+  from the shipped elastic checkpoint; injected duplicate delivery
+  (a lost reply forcing a retry, and a doubled request) must execute
+  each logical call exactly once — the idempotency cache absorbs the
+  duplicate, the future never double-resolves; and a partition struck
+  during a live migration must leave a truthful placement (src still
+  serves at parity) and, once healed, ``reconcile()`` must converge the
+  fleet to a single owner with the orphaned copy unregistered;
 * ``--persistent`` (ISSUE 18): the device-resident request-queue
   drills — a silent bitflip armed across a fully-staged persistent
   launch must resolve EVERY slot future with no silently-wrong answer
@@ -985,6 +995,203 @@ def drill_multisplit_partition() -> list[str]:
     return [f"multisplit-partition: {p}" for p in problems]
 
 
+def _transport_fleet(tps, comm, hosts, **kw):
+    """A drill-speed FleetManager: zero batching window, no retry/client
+    sleeps (backoff math still runs, the drill just doesn't wait)."""
+    from mpi_petsc4py_example_tpu.serving.remote import FleetManager
+
+    return FleetManager(hosts, comm, window=0.0, max_k=4,
+                        retry_policy=tps.RetryPolicy(sleep=lambda _d: None),
+                        client_sleep=lambda _d: None, **kw)
+
+
+def drill_transport_loss() -> list[str]:
+    """Host loss mid-load (``--transport``): kill the owning replica
+    host AFTER a warm solve + lease step cached its elastic checkpoint,
+    then submit again — the in-flight future must fail over to the
+    survivor, the re-homed solve must RESUME past iteration 0 (the
+    FailoverEvent carries the warm-start iteration), and the answer must
+    hold strict fp64 residual parity across the failover boundary."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(10)
+    xt = np.random.default_rng(0).random(A.shape[0])
+    b = np.asarray(A @ xt)
+    mgr = _transport_fleet(tps, comm, 2)
+    try:
+        mgr.register_operator("a", A, pc_type="jacobi", rtol=RTOL)
+        res = mgr.submit("a", b).result(timeout=120)
+        r0 = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+        if not r0 <= RTOL * 1.05:
+            problems.append(f"pre-loss residual {r0:.3e} misses rtol")
+        mgr.lease_step()  # pulls the post-solve checkpoint client-side
+        owner = mgr.router.owner("a")
+        mgr.kill_host(owner)
+        res2 = mgr.submit("a", b).result(timeout=120)
+        r2 = np.linalg.norm(b - A @ res2.x) / np.linalg.norm(b)
+        if not r2 <= RTOL * 1.05:
+            problems.append(f"post-loss residual {r2:.3e} misses rtol "
+                            "(parity broke across the failover boundary)")
+        new_owner = mgr.router.owner("a")
+        if new_owner == owner:
+            problems.append(f"session never re-homed off the dead host "
+                            f"{owner}")
+        if not mgr.failovers:
+            problems.append("no FailoverEvent was recorded")
+        resumed = mgr.failovers[0].resumed_iteration if mgr.failovers \
+            else 0
+        if resumed <= 0:
+            problems.append(f"re-homed solve restarted from iteration 0 "
+                            f"(resumed_iteration={resumed}) — the "
+                            "checkpoint never shipped")
+        status = "OK" if not problems else "FAIL"
+        print(f"[chaos] transport-loss: {status} {owner}->{new_owner} "
+              f"resumed_iteration={resumed} true_rres={r2:.3e}")
+    finally:
+        mgr.shutdown(wait=False)
+        _faults.heal()
+    return [f"transport-loss: {p}" for p in problems]
+
+
+def drill_transport_duplicate() -> list[str]:
+    """Duplicate delivery under retry (``--transport``): a reply
+    dropped AFTER the handler ran forces the client to retry the same
+    idempotency key (phase A), and an injected request duplication
+    delivers one logical call twice (phase B) — in both, the host must
+    execute the solve EXACTLY once per logical request (no
+    double-solve, no double-resolved future) while serving the
+    duplicate from its idempotency cache."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(10)
+    xt = np.random.default_rng(1).random(A.shape[0])
+    b = np.asarray(A @ xt)
+    mgr = _transport_fleet(tps, comm, 1)
+    try:
+        mgr.register_operator("a", A, pc_type="jacobi", rtol=RTOL)
+        host = mgr.hosts["r0"]
+        # phase A: the reply is lost once — the retry must JOIN the
+        # already-executed call, not re-run it
+        calls0 = host.rpc.stats["calls"]
+        with tps.inject_faults("rpc.recv=drop:at=1:times=1"):
+            res = mgr.submit("a", b).result(timeout=120)
+        ra = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+        calls_a = host.rpc.stats["calls"] - calls0
+        dups_a = host.rpc.stats["duplicates"]
+        if calls_a != 1:
+            problems.append(f"lost-reply retry re-executed the handler "
+                            f"({calls_a} executions for 1 logical call)")
+        if dups_a < 1:
+            problems.append("the retried delivery never hit the "
+                            "idempotency cache")
+        if not ra <= RTOL * 1.05:
+            problems.append(f"phase-A residual {ra:.3e} misses rtol")
+        # phase B: the request itself is delivered twice
+        calls1 = host.rpc.stats["calls"]
+        with tps.inject_faults("rpc.send=duplicate:at=1:times=1"):
+            res2 = mgr.submit("a", b).result(timeout=120)
+        rb = np.linalg.norm(b - A @ res2.x) / np.linalg.norm(b)
+        calls_b = host.rpc.stats["calls"] - calls1
+        if calls_b != 1:
+            problems.append(f"duplicated request double-solved "
+                            f"({calls_b} executions for 1 logical call)")
+        if not rb <= RTOL * 1.05:
+            problems.append(f"phase-B residual {rb:.3e} misses rtol")
+        st = mgr.stubs["r0"].stats()
+        if st["requests"] != 2:
+            problems.append(f"server saw {st['requests']} requests for "
+                            "2 logical solves — duplicates leaked "
+                            "through to the solve queue")
+        status = "OK" if not problems else "FAIL"
+        print(f"[chaos] transport-duplicate: {status} "
+              f"executions={calls_a}+{calls_b} "
+              f"cache_hits={host.rpc.stats['duplicates']} "
+              f"server_requests={st['requests']}")
+    finally:
+        mgr.shutdown(wait=False)
+        _faults.heal()
+    return [f"transport-duplicate: {p}" for p in problems]
+
+
+def drill_transport_partition() -> list[str]:
+    """Partition during live migration (``--transport``): a sticky
+    partition of the migration DESTINATION makes the move fail after
+    the dst may already hold a registered copy. The router's placement
+    must stay truthful (src still owns and serves at parity), and once
+    the partition heals, ``reconcile()`` must converge the fleet to a
+    SINGLE truthful placement table — the orphaned dst copy is
+    unregistered, never split-brained."""
+    import mpi_petsc4py_example_tpu as tps
+    from mpi_petsc4py_example_tpu.models import poisson2d_csr
+    from mpi_petsc4py_example_tpu.resilience import faults as _faults
+    from mpi_petsc4py_example_tpu.serving.transport import TransportError
+
+    problems: list[str] = []
+    comm = tps.DeviceComm()
+    A = poisson2d_csr(10)
+    xt = np.random.default_rng(2).random(A.shape[0])
+    b = np.asarray(A @ xt)
+    mgr = _transport_fleet(tps, comm, 2)
+    try:
+        mgr.register_operator("p", A, pc_type="jacobi", rtol=RTOL)
+        src = mgr.router.owner("p")
+        dst = next(n for n in mgr.stubs if n != src)
+        dst_idx = int(dst[1:])
+        migrate_failed = False
+        with tps.inject_faults(
+                f"rpc.recv=partition:device={dst_idx}:times=*"):
+            try:
+                mgr.router.migrate("p", dst)
+            except (TransportError, tps.DeadlineExceededError,
+                    RuntimeError):
+                migrate_failed = True
+            if not migrate_failed:
+                problems.append("migration across a partitioned "
+                                "destination reported success")
+            if mgr.router.owner("p") != src:
+                problems.append(f"placement lied during the partition: "
+                                f"owner={mgr.router.owner('p')} != {src}")
+            res = mgr.submit("p", b).result(timeout=120)
+            rr = np.linalg.norm(b - A @ res.x) / np.linalg.norm(b)
+            if not rr <= RTOL * 1.05:
+                problems.append(f"during-partition residual {rr:.3e} "
+                                "misses rtol")
+        # partition healed: the dst may hold an orphaned epoch-stamped
+        # copy — reconcile must remove it and keep ONE truthful owner
+        rep = mgr.reconcile()
+        res_src = mgr.stubs[src].client.call("resident", {}, deadline=10.0)
+        res_dst = mgr.stubs[dst].client.call("resident", {}, deadline=10.0)
+        if "p" not in res_src:
+            problems.append(f"the authoritative copy vanished from {src}")
+        if "p" in res_dst:
+            problems.append(f"split brain: {dst} still holds 'p' after "
+                            "reconcile")
+        if mgr.router.owner("p") != src:
+            problems.append(f"reconcile re-homed away from the healthy "
+                            f"owner: {mgr.router.owner('p')}")
+        res3 = mgr.submit("p", b).result(timeout=120)
+        r3 = np.linalg.norm(b - A @ res3.x) / np.linalg.norm(b)
+        if not r3 <= RTOL * 1.05:
+            problems.append(f"post-reconcile residual {r3:.3e} misses "
+                            "rtol")
+        status = "OK" if not problems else "FAIL"
+        print(f"[chaos] transport-partition: {status} src={src} dst={dst} "
+              f"orphans_removed={rep['orphans_removed']} "
+              f"true_rres={r3:.3e}")
+    finally:
+        mgr.shutdown(wait=False)
+        _faults.heal()
+    return [f"transport-partition: {p}" for p in problems]
+
+
 def validate_trace(trace_path: str, evict: bool) -> list[str]:
     """Structural validation of the exported Perfetto trace + flight
     dump — the CI telemetry job's schema gate."""
@@ -1089,6 +1296,16 @@ def main() -> int:
         failures += drill_multisplit_lost()
         failures += drill_multisplit_partition()
         what = "asynchronous-multisplit staleness/loss"
+    elif "--transport" in sys.argv[1:]:
+        # ISSUE 20 acceptance: host loss mid-load must resolve every
+        # pending future with the re-homed solve resuming past
+        # iteration 0; injected duplicate delivery must never
+        # double-solve or double-resolve; a healed partition must
+        # reconcile to a single truthful placement table
+        failures += drill_transport_loss()
+        failures += drill_transport_duplicate()
+        failures += drill_transport_partition()
+        what = "fleet-transport loss/duplicate/partition"
     elif "--persistent" in sys.argv[1:]:
         # ISSUE 18 acceptance: a bitflip across a fully-staged
         # persistent launch must resolve every slot with no silently-

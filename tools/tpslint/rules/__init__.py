@@ -7,7 +7,7 @@ from . import (tps001_host_sync, tps002_recompile, tps003_axis_name,
                tps009_sharding, tps010_grid_spec, tps011_psum_fusion,
                tps012_fault_registry, tps013_donation, tps014_telemetry,
                tps015_dispatch_loop, tps016_lock_order, tps017_channel_mix,
-               tps018_staleness_bound)
+               tps018_staleness_bound, tps019_rpc_deadline)
 
 
 def all_rules() -> dict:

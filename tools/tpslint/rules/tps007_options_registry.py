@@ -34,11 +34,13 @@ _GETTERS = ("get", "get_string", "get_int", "get_real", "get_bool", "has")
 #: flag-name shape the registry governs (solver-object prefixes, plus
 #: the serving layer's -solve_server_* family, the fleet router's
 #: -fleet_*/-qos_*/-autoscale_* families, the elastic degraded-mesh
-#: recovery's -elastic_* family, and the -telemetry* observability
-#: family — whose master switch is the bare flag 'telemetry')
+#: recovery's -elastic_* family, the transport tier's -rpc_* family
+#: (-fleet_transport_* rides the fleet prefix), and the -telemetry*
+#: observability family — whose master switch is the bare flag
+#: 'telemetry')
 _FLAG_RE = re.compile(
     r"^((ksp|eps|pc|svd|st|solve_server|elastic|fleet|qos|autoscale"
-    r"|multisplit)"
+    r"|multisplit|rpc)"
     r"_[a-z0-9_]+"
     r"|telemetry(_[a-z0-9_]+)?)$")
 

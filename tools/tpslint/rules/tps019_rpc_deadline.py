@@ -1,0 +1,194 @@
+"""TPS019 — every RPC/transport wait must carry a deadline or timeout.
+
+The multi-host transport (serving/transport.py + serving/remote.py) is
+built on one invariant: NO call path blocks forever. The client divides
+a per-call deadline across retry attempts, the host's duplicate-join
+wait is bounded, and in-flight futures fail over after their deadline
+instead of hanging. That invariant is only as strong as its weakest
+call site — one ``client.call("solve", payload)`` without a budget
+reintroduces the infinite hang the whole layer exists to remove, and it
+reintroduces it silently: the code works until the first real host
+loss.
+
+This rule enforces the call-site half, lexically and per-function, in
+the TPS018 taint style:
+
+* **Direct blocking sources** — ``.call(...)`` / ``.call_once(...)`` /
+  ``.send(...)`` / ``.recv(...)`` / ``.request(...)`` on a receiver
+  whose terminal name contains an RPC fragment (``rpc`` / ``transport``
+  / ``stub`` / ``remote`` / ``client``) must mention a budget among
+  their arguments — a keyword named (or an argument expression
+  mentioning) ``deadline`` / ``timeout`` / ``budget`` / ``remaining``.
+  A bare blocking call is a finding at that call.
+* **Future taint** — ``.submit(...)`` / ``.call_async(...)`` on an RPC
+  receiver taints the assigned names (transitively, to a fixpoint); a
+  ``.result()`` or ``.exception()`` on a tainted name with NO arguments
+  is an unbounded wait on a network future — a finding. Any argument
+  (positional or keyword) clears it: the stdlib signature's first
+  parameter IS the timeout.
+
+Like every tpslint rule this is conservative and syntactic: receivers
+are matched by name fragment, taint does not flow through helper calls
+or containers, and mentioning a budget name is trusted (the VALUE is
+not checked — ``timeout=None`` is an explicit, greppable decision,
+which is the point)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FUNCTION_NODES, terminal_name
+from .base import Rule, register
+
+#: methods that BLOCK on the wire when invoked on an RPC-ish receiver
+_BLOCKING_METHODS = frozenset({"call", "call_once", "send", "recv",
+                               "request"})
+#: methods that return a network-backed future (taint sources)
+_ASYNC_METHODS = frozenset({"submit", "call_async"})
+#: a receiver counts as RPC/transport when its terminal name contains
+#: one of these fragments (rpc / _rpc / transport / stub / remote /
+#: client / self.client ...)
+_RECEIVER_FRAGMENTS = ("rpc", "transport", "stub", "remote", "client")
+#: argument/keyword fragments that count as a blocking budget
+_BUDGET_FRAGMENTS = ("deadline", "timeout", "budget", "remaining")
+#: future methods that block unboundedly when called with no arguments
+_WAIT_METHODS = frozenset({"result", "exception"})
+
+
+def _rpc_receiver(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv = terminal_name(node.func.value)
+    if recv is None:
+        return False
+    low = recv.lower()
+    return any(f in low for f in _RECEIVER_FRAGMENTS)
+
+
+def _is_blocking_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_METHODS
+            and _rpc_receiver(node))
+
+
+def _is_async_source(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ASYNC_METHODS
+            and _rpc_receiver(node))
+
+
+def _has_budget(node: ast.Call) -> bool:
+    """A keyword named like a budget, or any argument expression that
+    mentions one (``timeout=5``, ``deadline=d``, a positional
+    ``remaining`` variable...)."""
+    for kw in node.keywords:
+        if kw.arg is not None:
+            low = kw.arg.lower()
+            if any(f in low for f in _BUDGET_FRAGMENTS):
+                return True
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None:
+                low = name.lower()
+                if any(f in low for f in _BUDGET_FRAGMENTS):
+                    return True
+    return False
+
+
+def _walk_local(func):
+    """Walk a function's OWN body, not descending into nested function
+    definitions (each gets analyzed as its own context)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FUNCTION_NODES + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _assign_name(target) -> str | None:
+    while isinstance(target, (ast.Subscript, ast.Starred)):
+        target = target.value
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _contains_source_or_taint(node, tainted) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if _is_async_source(sub):
+            return True
+    return False
+
+
+@register
+class RpcDeadlineRule(Rule):
+    id = "TPS019"
+    name = "rpc-deadline"
+    description = ("an RPC/transport call site may not issue a blocking "
+                   "wait without a deadline or timeout argument — one "
+                   "bare call reintroduces the infinite hang the "
+                   "transport layer exists to remove")
+    severity = "error"
+
+    def check(self, module):
+        for func in ast.walk(module.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(func)
+
+    def _check_function(self, func):
+        has_async_source = False
+        for node in _walk_local(func):
+            if _is_blocking_call(node) and not _has_budget(node):
+                yield self.finding(
+                    node,
+                    f"blocking RPC call .{node.func.attr}(...) without "
+                    "a deadline/timeout argument — pass the call budget "
+                    "explicitly (deadline=/timeout=); an unbounded "
+                    "transport wait hangs forever on the first lost "
+                    "reply")
+            if _is_async_source(node):
+                has_async_source = True
+        if not has_async_source:
+            return
+        # taint: names holding network-backed futures, to a fixpoint
+        tainted = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in _walk_local(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _contains_source_or_taint(node.value, tainted):
+                    continue
+                for tgt in node.targets:
+                    name = _assign_name(tgt)
+                    if name is not None and name not in tainted:
+                        tainted.add(name)
+                        changed = True
+        for node in _walk_local(func):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _WAIT_METHODS
+                    and not node.args and not node.keywords):
+                continue
+            recv = terminal_name(node.func.value)
+            if recv is not None and recv in tainted:
+                yield self.finding(
+                    node,
+                    f"unbounded .{node.func.attr}() on a network-backed "
+                    f"future ({recv!r} came from an RPC submit) — pass "
+                    "a timeout; a lost reply must fail the future over, "
+                    "not hang it")
